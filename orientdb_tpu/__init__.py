@@ -1,0 +1,46 @@
+"""orientdb_tpu — a TPU-native multi-model graph/document engine.
+
+A brand-new framework with the capabilities of OrientDB's (reference:
+AnsonT/orientdb, an OrientDB 3.x-era fork) document/graph model and SQL
+MATCH/TRAVERSE query layer, redesigned TPU-first:
+
+- host-side record store (documents, vertices, edges, schema, RIDs) that
+  plays the role of OrientDB's record/metadata layer (SURVEY.md §1 layers 6-7),
+- immutable columnar graph *snapshots* (CSR adjacency + property columns)
+  bulk-loaded into TPU HBM (the plocal-cluster -> HBM ingest of the north star),
+- a MATCH compiler that turns pattern ASTs into staged, batched frontier
+  expansions executed under jit/shard_map instead of OrientDB's per-record
+  interpreted ``MatchEdgeTraverser`` DFS,
+- sharded multi-chip execution over a ``jax.sharding.Mesh`` with XLA
+  collectives (psum / all_gather / ppermute) in place of Hazelcast + TCP
+  channels.
+
+Reference citations in docstrings use the ``[E] <path>`` convention from
+SURVEY.md: the reference mount was empty during the survey, so paths are
+expected upstream OrientDB 3.x Maven paths, to be re-verified when the
+reference source appears.
+"""
+
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.schema import Schema, SchemaClass, Property, PropertyType
+from orientdb_tpu.models.record import Document, Vertex, Edge, Direction
+from orientdb_tpu.models.database import Database, ConcurrentModificationError
+from orientdb_tpu.exec.result import Result, ResultSet
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "RID",
+    "Schema",
+    "SchemaClass",
+    "Property",
+    "PropertyType",
+    "Document",
+    "Vertex",
+    "Edge",
+    "Direction",
+    "Database",
+    "ConcurrentModificationError",
+    "Result",
+    "ResultSet",
+]

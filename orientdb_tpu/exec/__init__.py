@@ -1,0 +1,3 @@
+from orientdb_tpu.exec.result import Result, ResultSet
+
+__all__ = ["Result", "ResultSet"]

@@ -1,0 +1,25 @@
+"""Query engine entry points (wired from Database.query/command/explain).
+
+Placeholder until the SQL front door (parser + oracle + TPU engine) lands;
+keeping the module importable gives a clear error instead of an import crash.
+"""
+
+from __future__ import annotations
+
+
+def execute_query(db, sql, params, **kw):
+    raise NotImplementedError(
+        "the SQL engine is not built yet (parser/oracle land next milestone)"
+    )
+
+
+def execute_command(db, sql, params, **kw):
+    raise NotImplementedError(
+        "the SQL engine is not built yet (parser/oracle land next milestone)"
+    )
+
+
+def explain(db, sql, params):
+    raise NotImplementedError(
+        "the SQL engine is not built yet (parser/oracle land next milestone)"
+    )

@@ -1,0 +1,148 @@
+"""Query results.
+
+Parity layer for OrientDB's ``OResult`` / ``OResultInternal`` / ``OResultSet``
+([E] core/.../sql/executor/OResultInternal.java, SURVEY.md §1 layer 5): a
+result is either an *element* (a record) or a *projection* (a computed row of
+named properties); a result set is a forward-only stream with ``has_next`` /
+``next`` plus pythonic iteration.
+
+The TPU engine marshals device arrays back into these rows (the
+"OResultInternal-parity rows" requirement of the north star), so parity tests
+compare `[sorted] list(rs.to_dicts())` across engines.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional
+
+from orientdb_tpu.models.record import Document
+from orientdb_tpu.models.rid import RID
+
+
+class Result:
+    """One row: wraps a record or a projection map."""
+
+    __slots__ = ("_element", "_props", "_metadata")
+
+    def __init__(
+        self,
+        element: Optional[Document] = None,
+        props: Optional[Dict[str, object]] = None,
+    ) -> None:
+        self._element = element
+        self._props: Dict[str, object] = props or {}
+        self._metadata: Dict[str, object] = {}
+
+    # -- OResult surface ---------------------------------------------------
+
+    @property
+    def is_element(self) -> bool:
+        return self._element is not None and not self._props
+
+    @property
+    def element(self) -> Optional[Document]:
+        return self._element
+
+    def get_property(self, name: str, default=None):
+        if name in self._props:
+            return self._props[name]
+        if self._element is not None:
+            return self._element.get(name, default)
+        return default
+
+    def property_names(self) -> List[str]:
+        if self._props:
+            return list(self._props.keys())
+        if self._element is not None:
+            return self._element.field_names()
+        return []
+
+    def set_property(self, name: str, value) -> None:
+        self._props[name] = value
+
+    def set_metadata(self, name: str, value) -> None:
+        self._metadata[name] = value
+
+    def get_metadata(self, name: str, default=None):
+        return self._metadata.get(name, default)
+
+    @property
+    def rid(self) -> Optional[RID]:
+        return self._element.rid if self._element is not None else None
+
+    def __getitem__(self, name: str):
+        return self.get_property(name)
+
+    def to_dict(self) -> Dict[str, object]:
+        """Plain-python row; records are rendered as their RID string (the
+        stable identity used by parity comparisons)."""
+        if self.is_element:
+            assert self._element is not None
+            return self._element.to_dict()
+        return {k: _plain(v) for k, v in self._props.items()}
+
+    def __repr__(self) -> str:
+        if self.is_element:
+            return f"Result({self._element!r})"
+        return f"Result({self._props!r})"
+
+
+def _plain(v):
+    if isinstance(v, Document):
+        return str(v.rid) if v.rid.is_persistent else v.to_dict()
+    if isinstance(v, RID):
+        return str(v)
+    if isinstance(v, Result):
+        return v.to_dict()
+    if isinstance(v, (list, tuple)):
+        return [_plain(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _plain(x) for k, x in v.items()}
+    return v
+
+
+class ResultSet:
+    """Forward-only row stream ([E] OResultSet), with an attached execution
+    plan for EXPLAIN/PROFILE."""
+
+    def __init__(self, rows: Iterable[Result], plan=None) -> None:
+        self._it = iter(rows)
+        self._peeked: Optional[Result] = None
+        self._exhausted = False
+        self.plan = plan
+
+    def has_next(self) -> bool:
+        if self._peeked is not None:
+            return True
+        if self._exhausted:
+            return False
+        try:
+            self._peeked = next(self._it)
+            return True
+        except StopIteration:
+            self._exhausted = True
+            return False
+
+    def next(self) -> Result:
+        if not self.has_next():
+            raise StopIteration
+        row, self._peeked = self._peeked, None
+        assert row is not None
+        return row
+
+    def __iter__(self) -> Iterator[Result]:
+        while self.has_next():
+            yield self.next()
+
+    def __next__(self) -> Result:
+        return self.next()
+
+    def to_list(self) -> List[Result]:
+        return list(self)
+
+    def to_dicts(self) -> List[Dict[str, object]]:
+        return [r.to_dict() for r in self]
+
+    def close(self) -> None:  # API parity; nothing to release host-side
+        self._exhausted = True
+        self._peeked = None

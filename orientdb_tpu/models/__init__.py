@@ -1,0 +1,17 @@
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.schema import Schema, SchemaClass, Property, PropertyType
+from orientdb_tpu.models.record import Document, Vertex, Edge, Direction
+from orientdb_tpu.models.database import Database
+
+__all__ = [
+    "RID",
+    "Schema",
+    "SchemaClass",
+    "Property",
+    "PropertyType",
+    "Document",
+    "Vertex",
+    "Edge",
+    "Direction",
+    "Database",
+]

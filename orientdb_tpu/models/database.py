@@ -1,0 +1,344 @@
+"""The host-side record store.
+
+Plays the role of OrientDB's embedded database + storage layer for the new
+framework's host side ([E] core/.../db/document/ODatabaseDocumentEmbedded +
+core/.../storage/memory/ODirectMemoryStorage; SURVEY.md §2 "memory storage"):
+an in-RAM cluster-based record store behind the same conceptual API, with
+MVCC version checks on save (the OTransactionOptimistic commit-time check,
+[E] core/.../tx/OTransactionOptimistic.java — SURVEY.md §3.4).
+
+Writes live here on the host; the TPU path is a read-optimized accelerator
+over immutable columnar *snapshots* built from this store (north-star
+design: MATCH is a read workload, writes stay in the host store).
+
+Durability is provided by the storage layer (``orientdb_tpu.storage``):
+JSON export/import (the §3.5 ingest path) and snapshot epochs. A WAL analog
+guards the host store when ``config.wal_enabled`` is set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional
+
+from orientdb_tpu.models.rid import RID, NEW_RID
+from orientdb_tpu.models.record import Document, Edge, Vertex, Direction
+from orientdb_tpu.models.schema import Schema, PropertyType
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("database")
+
+
+class ConcurrentModificationError(Exception):
+    """MVCC conflict ([E] OConcurrentModificationException): the stored record
+    version moved past the version the writer read."""
+
+
+class RecordNotFoundError(Exception):
+    pass
+
+
+class _Cluster:
+    """One record bucket ([E] OPaginatedCluster): append-only position list.
+
+    Positions of deleted records hold ``None`` (OrientDB keeps deleted
+    positions as tombstones — RIDs are never reused within a cluster).
+    """
+
+    __slots__ = ("cluster_id", "records")
+
+    def __init__(self, cluster_id: int) -> None:
+        self.cluster_id = cluster_id
+        self.records: List[Optional[Document]] = []
+
+    def append(self, doc: Document) -> int:
+        self.records.append(doc)
+        return len(self.records) - 1
+
+    def get(self, position: int) -> Optional[Document]:
+        if 0 <= position < len(self.records):
+            return self.records[position]
+        return None
+
+    def tombstone(self, position: int) -> None:
+        if 0 <= position < len(self.records):
+            self.records[position] = None
+
+    def __iter__(self) -> Iterator[Document]:
+        for doc in self.records:
+            if doc is not None:
+                yield doc
+
+    def live_count(self) -> int:
+        return sum(1 for d in self.records if d is not None)
+
+
+class Database:
+    """An embedded multi-model database instance.
+
+    API shape follows OrientDB's ``ODatabaseSession``: ``new_vertex`` /
+    ``new_edge`` / ``save`` / ``load`` / ``delete`` / ``browse_class`` /
+    ``query`` / ``command``. One global lock serializes writes
+    (the reference's storage commit is effectively single-writer per
+    storage, SURVEY.md §3.4).
+    """
+
+    def __init__(self, name: str = "db") -> None:
+        self.name = name
+        self.schema = Schema()
+        self._clusters: Dict[int, _Cluster] = {}
+        self._lock = threading.RLock()
+        # Monotonic snapshot epoch: bumped on every committed write so the
+        # query layer knows when an attached TPU snapshot is stale.
+        self.mutation_epoch = 0
+        # Attached columnar snapshot (set by orientdb_tpu.storage.snapshot).
+        self._snapshot = None
+        self._snapshot_epoch = -1
+        # Index manager is attached lazily by orientdb_tpu.models.indexes.
+        self._indexes = None
+        # Round-robin cluster selection per class ([E] cluster selection
+        # strategies, SURVEY.md §2 "Clusters & RIDs").
+        self._rr_state: Dict[str, int] = {}
+
+    # -- cluster plumbing --------------------------------------------------
+
+    def _cluster(self, cid: int) -> _Cluster:
+        c = self._clusters.get(cid)
+        if c is None:
+            c = self._clusters[cid] = _Cluster(cid)
+        return c
+
+    def _select_cluster(self, class_name: str) -> int:
+        cls = self.schema.get_class_or_raise(class_name)
+        if not cls.cluster_ids:
+            raise ValueError(f"class '{class_name}' is abstract")
+        i = self._rr_state.get(cls.name, 0)
+        self._rr_state[cls.name] = i + 1
+        return cls.cluster_ids[i % len(cls.cluster_ids)]
+
+    # -- record lifecycle --------------------------------------------------
+
+    def new_element(self, class_name: str = "O", **fields) -> Document:
+        """Create (and save) a plain document."""
+        if not self.schema.exists_class(class_name):
+            self.schema.create_class(class_name)
+        doc = Document(class_name, fields)
+        doc._db = self
+        return self.save(doc)
+
+    def new_vertex(self, class_name: str = "V", **fields) -> Vertex:
+        cls = self.schema.get_class(class_name)
+        if cls is None:
+            cls = self.schema.create_vertex_class(class_name)
+        if not cls.is_vertex_type:
+            raise ValueError(f"class '{class_name}' is not a vertex class")
+        v = Vertex(cls.name, fields)
+        v._db = self
+        self.save(v)
+        return v
+
+    def new_edge(
+        self, class_name: str, src: Vertex, dst: Vertex, **fields
+    ) -> Edge:
+        """Create an edge src -OUT-> dst and wire both adjacency bags.
+
+        Mirrors OVertex.addEdge ([E]): the edge document gets out/in links,
+        the source vertex appends to ``out_<cls>``, the target to
+        ``in_<cls>``.
+        """
+        cls = self.schema.get_class(class_name)
+        if cls is None:
+            cls = self.schema.create_edge_class(class_name)
+        if not cls.is_edge_type:
+            raise ValueError(f"class '{class_name}' is not an edge class")
+        if not (src.rid.is_persistent and dst.rid.is_persistent):
+            raise ValueError("both endpoints must be saved before creating an edge")
+        with self._lock:
+            e = Edge(cls.name, fields)
+            e._db = self
+            e.out_rid = src.rid
+            e.in_rid = dst.rid
+            self.save(e)
+            src._bag(Direction.OUT, cls.name).append(e.rid)
+            dst._bag(Direction.IN, cls.name).append(e.rid)
+            src.version += 1
+            dst.version += 1
+        return e
+
+    def save(self, doc: Document) -> Document:
+        with self._lock:
+            cls = self.schema.get_class(doc.class_name)
+            if cls is None:
+                cls = self.schema.create_class(doc.class_name)
+            cls.validate(doc.fields())
+            if self._indexes is not None:
+                # Two-phase: unique-constraint check BEFORE any mutation so a
+                # violation can never leave store and indexes diverged
+                # (the reference rolls the tx back on
+                # ORecordDuplicatedException).
+                self._indexes.validate_save(doc)
+            is_new = doc.rid is NEW_RID or not doc.rid.is_persistent
+            if is_new:
+                cid = self._select_cluster(doc.class_name)
+                pos = self._cluster(cid).append(doc)
+                doc.rid = RID(cid, pos)
+                doc.version = 1
+                doc._db = self
+            else:
+                stored = self._load_raw(doc.rid)
+                if stored is None:
+                    raise RecordNotFoundError(str(doc.rid))
+                if stored is not doc and stored.version != doc.version:
+                    raise ConcurrentModificationError(
+                        f"{doc.rid}: stored v{stored.version} != tx v{doc.version}"
+                    )
+                doc.version += 1
+                self._cluster(doc.rid.cluster).records[doc.rid.position] = doc
+            if self._indexes is not None:
+                try:
+                    self._indexes.on_save(doc)
+                except Exception:
+                    # Defense in depth behind validate_save (non-unique
+                    # failures): don't leave a new record half-written.
+                    if is_new:
+                        self._cluster(doc.rid.cluster).tombstone(doc.rid.position)
+                        self._indexes.on_delete(doc)
+                        doc.rid = NEW_RID
+                        doc.version = 0
+                    raise
+            self.mutation_epoch += 1
+        return doc
+
+    def _load_raw(self, rid: RID) -> Optional[Document]:
+        c = self._clusters.get(rid.cluster)
+        return c.get(rid.position) if c else None
+
+    def load(self, rid: RID) -> Optional[Document]:
+        if isinstance(rid, str):
+            rid = RID.parse(rid)
+        return self._load_raw(rid)
+
+    def exists(self, rid: RID) -> bool:
+        return self._load_raw(rid) is not None
+
+    def delete(self, doc: Document) -> None:
+        """Delete a record; vertices cascade-delete their incident edges,
+        edges detach from both endpoint bags (OrientDB DELETE VERTEX/EDGE
+        semantics)."""
+        with self._lock:
+            if isinstance(doc, Vertex):
+                for edge in list(doc.edges(Direction.BOTH)):
+                    self._delete_edge(edge)
+            elif isinstance(doc, Edge):
+                self._delete_edge(doc)
+            if doc.rid.is_persistent:
+                if self._indexes is not None:
+                    self._indexes.on_delete(doc)
+                self._cluster(doc.rid.cluster).tombstone(doc.rid.position)
+            doc._deleted = True
+            self.mutation_epoch += 1
+
+    def _delete_edge(self, edge: Edge) -> None:
+        src = self.load(edge.out_rid)
+        dst = self.load(edge.in_rid)
+        if isinstance(src, Vertex):
+            bag = src._bag(Direction.OUT, edge.class_name)
+            if edge.rid in bag:
+                bag.remove(edge.rid)
+                src.version += 1  # adjacency changed: same MVCC bump as new_edge
+        if isinstance(dst, Vertex):
+            bag = dst._bag(Direction.IN, edge.class_name)
+            if edge.rid in bag:
+                bag.remove(edge.rid)
+                dst.version += 1
+        if edge.rid.is_persistent:
+            if self._indexes is not None:
+                self._indexes.on_delete(edge)
+            self._cluster(edge.rid.cluster).tombstone(edge.rid.position)
+
+    # -- scans -------------------------------------------------------------
+
+    def browse_class(
+        self, class_name: str, polymorphic: bool = True
+    ) -> Iterator[Document]:
+        """Scan all live records of a class ([E] browseClass)."""
+        cls = self.schema.get_class_or_raise(class_name)
+        cids = (
+            self.schema.polymorphic_cluster_ids(cls.name)
+            if polymorphic
+            else list(cls.cluster_ids)
+        )
+        for cid in cids:
+            c = self._clusters.get(cid)
+            if c is None:
+                continue
+            yield from c
+
+    def browse_cluster(self, cluster_id: int) -> Iterator[Document]:
+        c = self._clusters.get(cluster_id)
+        if c is not None:
+            yield from c
+
+    def count_class(self, class_name: str, polymorphic: bool = True) -> int:
+        return sum(1 for _ in self.browse_class(class_name, polymorphic))
+
+    def drop_class(self, class_name: str) -> None:
+        """Drop a schema class and its indexes (records are abandoned, as in
+        the reference's non-'UNSAFE' class drop which requires empty class;
+        here we require the class to have no live records)."""
+        with self._lock:
+            cls = self.schema.get_class_or_raise(class_name)
+            if any(True for _ in self.browse_class(cls.name, polymorphic=False)):
+                raise ValueError(f"class '{cls.name}' is not empty; delete records first")
+            if self._indexes is not None:
+                self._indexes.drop_for_class(cls.name)
+            self.schema.drop_class(cls.name)
+
+    # -- indexes -----------------------------------------------------------
+
+    @property
+    def indexes(self):
+        if self._indexes is None:
+            from orientdb_tpu.models.indexes import IndexManager
+
+            self._indexes = IndexManager(self)
+        return self._indexes
+
+    # -- query layer -------------------------------------------------------
+
+    def query(self, sql: str, params: Optional[Dict[str, object]] = None, **kw):
+        """Run an idempotent statement ([E] ODatabaseSession.query)."""
+        from orientdb_tpu.exec.engine import execute_query
+
+        return execute_query(self, sql, params or {}, **kw)
+
+    def command(self, sql: str, params: Optional[Dict[str, object]] = None, **kw):
+        """Run any statement, including writes ([E] ODatabaseSession.command)."""
+        from orientdb_tpu.exec.engine import execute_command
+
+        return execute_command(self, sql, params or {}, **kw)
+
+    def explain(self, sql: str, params: Optional[Dict[str, object]] = None):
+        from orientdb_tpu.exec.engine import explain
+
+        return explain(self, sql, params or {})
+
+    # -- snapshot attach ---------------------------------------------------
+
+    def attach_snapshot(self, snapshot) -> None:
+        self._snapshot = snapshot
+        self._snapshot_epoch = self.mutation_epoch
+
+    def current_snapshot(self, require_fresh: bool = False):
+        if self._snapshot is None:
+            return None
+        if require_fresh and self._snapshot_epoch != self.mutation_epoch:
+            return None
+        return self._snapshot
+
+    @property
+    def snapshot_is_stale(self) -> bool:
+        return (
+            self._snapshot is not None
+            and self._snapshot_epoch != self.mutation_epoch
+        )

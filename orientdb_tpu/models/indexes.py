@@ -1,0 +1,239 @@
+"""Host-side index framework.
+
+Analog of OrientDB's index layer ([E] core/.../index/ — OIndexManagerShared,
+OIndexAbstract over OSBTree/OCellBTree/OLocalHashTable durable structures;
+SURVEY.md §2 "Indexes"). The reference persists indexes as on-disk B-trees /
+extendible hash tables; the host store here is in-RAM, so the honest analogs
+are a dict (hash index) and a sorted key list (range-capable "sbtree" index).
+The TPU layer builds its *own* columnar sorted-array indexes inside snapshots
+(`orientdb_tpu/storage/snapshot.py`) — these host indexes serve the write
+path, uniqueness constraints, and the host executor's index-scan steps.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, Iterator, List, Optional, Set, Tuple, TYPE_CHECKING
+
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.record import Document
+
+if TYPE_CHECKING:  # pragma: no cover
+    from orientdb_tpu.models.database import Database
+
+
+class DuplicateKeyError(Exception):
+    """[E] ORecordDuplicatedException: unique-index violation."""
+
+
+class Index:
+    """One index over (class, fields).
+
+    types: UNIQUE / NOTUNIQUE (sbtree-style, range-capable) and
+    UNIQUE_HASH_INDEX / NOTUNIQUE_HASH_INDEX (point lookups only).
+    """
+
+    RANGE_TYPES = {"UNIQUE", "NOTUNIQUE"}
+    HASH_TYPES = {"UNIQUE_HASH_INDEX", "NOTUNIQUE_HASH_INDEX"}
+
+    def __init__(self, name: str, class_name: str, fields: List[str], index_type: str):
+        index_type = index_type.upper()
+        if index_type not in self.RANGE_TYPES | self.HASH_TYPES:
+            raise ValueError(f"unsupported index type {index_type}")
+        self.name = name
+        self.class_name = class_name
+        self.fields = list(fields)
+        self.type = index_type
+        self._map: Dict[object, Set[RID]] = {}
+        self._reverse: Dict[RID, object] = {}
+        self._sorted_keys: List[object] = []  # maintained for range types
+
+    @property
+    def unique(self) -> bool:
+        return self.type.startswith("UNIQUE")
+
+    @property
+    def range_capable(self) -> bool:
+        return self.type in self.RANGE_TYPES
+
+    def _key_of(self, doc: Document):
+        vals = tuple(doc.get(f) for f in self.fields)
+        if any(v is None for v in vals):
+            return None  # null keys are not indexed (OrientDB default)
+        return vals[0] if len(vals) == 1 else vals
+
+    # -- mutation ----------------------------------------------------------
+
+    def put(self, key, rid: RID) -> None:
+        if key is None:
+            return
+        bucket = self._map.get(key)
+        if bucket is None:
+            bucket = self._map[key] = set()
+            if self.range_capable:
+                bisect.insort(self._sorted_keys, key)
+        if self.unique and bucket and rid not in bucket:
+            other = next(iter(bucket))
+            raise DuplicateKeyError(
+                f"index '{self.name}': key {key!r} already mapped to {other}"
+            )
+        bucket.add(rid)
+        self._reverse[rid] = key
+
+    def remove(self, rid: RID) -> None:
+        key = self._reverse.pop(rid, None)
+        if key is None:
+            return
+        bucket = self._map.get(key)
+        if bucket is not None:
+            bucket.discard(rid)
+            if not bucket:
+                del self._map[key]
+                if self.range_capable:
+                    i = bisect.bisect_left(self._sorted_keys, key)
+                    if i < len(self._sorted_keys) and self._sorted_keys[i] == key:
+                        self._sorted_keys.pop(i)
+
+    # -- lookup ------------------------------------------------------------
+
+    def get(self, key) -> Set[RID]:
+        return set(self._map.get(key, ()))
+
+    def contains_key(self, key) -> bool:
+        return key in self._map
+
+    def range(
+        self,
+        lo=None,
+        hi=None,
+        lo_inclusive: bool = True,
+        hi_inclusive: bool = True,
+    ) -> Iterator[Tuple[object, Set[RID]]]:
+        if not self.range_capable:
+            raise ValueError(f"index '{self.name}' ({self.type}) is not range-capable")
+        keys = self._sorted_keys
+        start = 0
+        if lo is not None:
+            start = (
+                bisect.bisect_left(keys, lo)
+                if lo_inclusive
+                else bisect.bisect_right(keys, lo)
+            )
+        end = len(keys)
+        if hi is not None:
+            end = (
+                bisect.bisect_right(keys, hi)
+                if hi_inclusive
+                else bisect.bisect_left(keys, hi)
+            )
+        for i in range(start, end):
+            k = keys[i]
+            yield k, set(self._map[k])
+
+    def keys(self) -> List[object]:
+        return list(self._sorted_keys) if self.range_capable else list(self._map)
+
+    def size(self) -> int:
+        return sum(len(b) for b in self._map.values())
+
+    def __repr__(self) -> str:
+        return f"Index({self.name} {self.type} on {self.class_name}{self.fields})"
+
+
+class IndexManager:
+    """[E] OIndexManagerShared: registry + save/delete hooks."""
+
+    def __init__(self, db: "Database") -> None:
+        self._db = db
+        self._indexes: Dict[str, Index] = {}
+
+    def create_index(
+        self,
+        name: str,
+        class_name: str,
+        fields: List[str],
+        index_type: str = "NOTUNIQUE",
+    ) -> Index:
+        if name.lower() in self._indexes:
+            raise ValueError(f"index '{name}' already exists")
+        cls = self._db.schema.get_class_or_raise(class_name)
+        idx = Index(name, cls.name, fields, index_type)
+        # Build over existing records (OrientDB rebuilds on creation).
+        for doc in self._db.browse_class(cls.name, polymorphic=True):
+            idx.put(idx._key_of(doc), doc.rid)
+        self._indexes[name.lower()] = idx
+        return idx
+
+    def drop_index(self, name: str) -> None:
+        self._indexes.pop(name.lower(), None)
+
+    def get_index(self, name: str) -> Optional[Index]:
+        return self._indexes.get(name.lower())
+
+    def all(self) -> List[Index]:
+        return list(self._indexes.values())
+
+    def for_class(self, class_name: str) -> List[Index]:
+        cls = self._db.schema.get_class(class_name)
+        if cls is None:
+            return []
+        out = []
+        for i in self._indexes.values():
+            icls = self._db.schema.get_class(i.class_name)
+            if cls.is_subclass_of(i.class_name) or (
+                icls is not None and icls.is_subclass_of(cls.name)
+            ):
+                out.append(i)
+        return out
+
+    def drop_for_class(self, class_name: str) -> None:
+        """Drop every index defined directly on ``class_name`` (class drop)."""
+        for name in [
+            n for n, i in self._indexes.items() if i.class_name.lower() == class_name.lower()
+        ]:
+            del self._indexes[name]
+
+    def best_for(self, class_name: str, field: str) -> Optional[Index]:
+        """Single-field index usable for a lookup on ``class_name.field``."""
+        cls = self._db.schema.get_class(class_name)
+        if cls is None:
+            return None
+        for idx in self._indexes.values():
+            if idx.fields == [field] and cls.is_subclass_of(idx.class_name):
+                return idx
+        return None
+
+    # -- hooks wired from Database.save/delete -----------------------------
+
+    def validate_save(self, doc: Document, rid_hint=None) -> None:
+        """Raise DuplicateKeyError BEFORE any store/index mutation if saving
+        ``doc`` would violate a unique index (two-phase validate-then-apply:
+        keeps store and indexes consistent on constraint failure)."""
+        rid = rid_hint if rid_hint is not None else doc.rid
+        for idx in self._applicable(doc):
+            if not idx.unique:
+                continue
+            key = idx._key_of(doc)
+            if key is None:
+                continue
+            holders = idx.get(key) - {rid}
+            if holders:
+                raise DuplicateKeyError(
+                    f"index '{idx.name}': key {key!r} already mapped to "
+                    f"{next(iter(holders))}"
+                )
+
+    def on_save(self, doc: Document) -> None:
+        for idx in self._applicable(doc):
+            idx.remove(doc.rid)
+            idx.put(idx._key_of(doc), doc.rid)
+
+    def on_delete(self, doc: Document) -> None:
+        for idx in self._applicable(doc):
+            idx.remove(doc.rid)
+
+    def _applicable(self, doc: Document) -> List[Index]:
+        cls = self._db.schema.get_class(doc.class_name)
+        if cls is None:
+            return []
+        return [i for i in self._indexes.values() if cls.is_subclass_of(i.class_name)]

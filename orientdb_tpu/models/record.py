@@ -1,0 +1,257 @@
+"""Records: documents, vertices, edges.
+
+Analog of OrientDB's record layer ([E] core/.../record/impl/ — ODocument,
+OVertexDocument, OEdgeDocument; SURVEY.md §2 "Record types" / "Graph model"):
+
+- :class:`Document` — schema-hybrid field map with a version counter (MVCC)
+  and a RID once saved;
+- :class:`Vertex` — document + adjacency bags. OrientDB stores adjacency in
+  per-edge-class ``ORidBag`` fields named ``out_<EdgeClass>`` /
+  ``in_<EdgeClass>``; here the analog is a dict of edge-class -> list of edge
+  RIDs per direction (the embedded-list small-degree form; there is no
+  sbtree promotion because the host store is in-RAM — high-degree handling
+  happens in the columnar snapshot/TPU layer instead);
+- :class:`Edge` — document + ``out``/``in`` endpoint RIDs (OrientDB's edge
+  direction convention: ``out`` = source vertex, ``in`` = target vertex).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterator, List, Optional, TYPE_CHECKING
+
+from orientdb_tpu.models.rid import RID, NEW_RID
+
+if TYPE_CHECKING:  # pragma: no cover
+    from orientdb_tpu.models.database import Database
+
+
+class Direction(enum.Enum):
+    OUT = "out"
+    IN = "in"
+    BOTH = "both"
+
+    @property
+    def opposite(self) -> "Direction":
+        if self is Direction.OUT:
+            return Direction.IN
+        if self is Direction.IN:
+            return Direction.OUT
+        return Direction.BOTH
+
+
+class Document:
+    """A schema-hybrid record ([E] ODocument)."""
+
+    __slots__ = ("_db", "class_name", "rid", "version", "_fields", "_deleted")
+
+    def __init__(self, class_name: str, fields: Optional[Dict[str, object]] = None):
+        self._db: Optional["Database"] = None
+        self.class_name = class_name
+        self.rid: RID = NEW_RID
+        self.version = 0
+        self._fields: Dict[str, object] = dict(fields or {})
+        self._deleted = False
+
+    # -- fields ------------------------------------------------------------
+
+    def get(self, name: str, default=None):
+        # Attribute pseudo-fields, as in OrientDB SQL (@rid, @class, @version).
+        if name == "@rid":
+            return self.rid
+        if name == "@class":
+            return self.class_name
+        if name == "@version":
+            return self.version
+        return self._fields.get(name, default)
+
+    def set(self, name: str, value) -> "Document":
+        self._fields[name] = value
+        return self
+
+    def update(self, **fields) -> "Document":
+        self._fields.update(fields)
+        return self
+
+    def remove_field(self, name: str) -> None:
+        self._fields.pop(name, None)
+
+    def has(self, name: str) -> bool:
+        return name in self._fields
+
+    def field_names(self) -> List[str]:
+        return list(self._fields.keys())
+
+    def fields(self) -> Dict[str, object]:
+        return dict(self._fields)
+
+    def __getitem__(self, name: str):
+        return self.get(name)
+
+    def __setitem__(self, name: str, value):
+        self.set(name, value)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._fields
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self) -> "Document":
+        if self._db is None:
+            raise RuntimeError("record is not attached to a database")
+        self._db.save(self)
+        return self
+
+    def delete(self) -> None:
+        if self._db is None:
+            raise RuntimeError("record is not attached to a database")
+        self._db.delete(self)
+
+    @property
+    def is_vertex(self) -> bool:
+        return isinstance(self, Vertex)
+
+    @property
+    def is_edge(self) -> bool:
+        return isinstance(self, Edge)
+
+    def to_dict(self, include_meta: bool = True) -> Dict[str, object]:
+        out = dict(self._fields)
+        if include_meta:
+            out["@rid"] = str(self.rid)
+            out["@class"] = self.class_name
+            out["@version"] = self.version
+        return out
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.class_name}{self.rid} {self._fields})"
+
+    # Identity semantics (python default): the store returns the same object
+    # for the same RID, and RIDs mutate on first save, so rid-based
+    # hashing would break sets/dicts across save(). Semantic dedup in the
+    # query layer keys on `doc.rid` explicitly.
+
+
+class Vertex(Document):
+    """A vertex record with adjacency bags ([E] OVertexDocument)."""
+
+    __slots__ = ("_out_edges", "_in_edges")
+
+    def __init__(self, class_name: str, fields: Optional[Dict[str, object]] = None):
+        super().__init__(class_name, fields)
+        # edge class name -> ordered list of edge RIDs (the RidBag analog)
+        self._out_edges: Dict[str, List[RID]] = {}
+        self._in_edges: Dict[str, List[RID]] = {}
+
+    def _bag(self, direction: Direction, edge_class: str) -> List[RID]:
+        bags = self._out_edges if direction is Direction.OUT else self._in_edges
+        return bags.setdefault(edge_class, [])
+
+    def _edge_classes(self, direction: Direction) -> List[str]:
+        if direction is Direction.OUT:
+            return list(self._out_edges.keys())
+        if direction is Direction.IN:
+            return list(self._in_edges.keys())
+        seen = list(self._out_edges.keys())
+        seen += [k for k in self._in_edges.keys() if k not in seen]
+        return seen
+
+    def _resolve_edge_classes(self, direction: Direction, edge_class: Optional[str]) -> List[str]:
+        """Edge classes to scan, honoring polymorphism on the requested class."""
+        present = self._edge_classes(direction)
+        if edge_class is None:
+            return present
+        if self._db is None:
+            return [c for c in present if c == edge_class]
+        req = self._db.schema.get_class(edge_class)
+        if req is None:
+            return []
+        out = []
+        for c in present:
+            sc = self._db.schema.get_class(c)
+            if sc is not None and sc.is_subclass_of(req.name):
+                out.append(c)
+        return out
+
+    def edges(
+        self, direction: Direction = Direction.BOTH, edge_class: Optional[str] = None
+    ) -> Iterator["Edge"]:
+        """Iterate incident edges (analog of OVertex.getEdges)."""
+        assert self._db is not None
+        dirs = (
+            [Direction.OUT, Direction.IN]
+            if direction is Direction.BOTH
+            else [direction]
+        )
+        for d in dirs:
+            for cls_name in self._resolve_edge_classes(d, edge_class):
+                for erid in list(self._bag(d, cls_name)):
+                    e = self._db.load(erid)
+                    if e is not None:
+                        yield e  # type: ignore[misc]
+
+    def vertices(
+        self, direction: Direction = Direction.BOTH, edge_class: Optional[str] = None
+    ) -> Iterator["Vertex"]:
+        """Iterate adjacent vertices (analog of OVertex.getVertices).
+
+        This is the host-side, per-record traversal primitive — exactly the
+        hot loop the TPU engine replaces with batched CSR expansion
+        (SURVEY.md §3.3).
+        """
+        assert self._db is not None
+        for edge in self.edges(direction, edge_class):
+            if direction is Direction.BOTH:
+                other = edge.in_rid if edge.out_rid == self.rid else edge.out_rid
+            elif direction is Direction.OUT:
+                other = edge.in_rid
+            else:
+                other = edge.out_rid
+            v = self._db.load(other)
+            if v is not None:
+                yield v  # type: ignore[misc]
+
+    def degree(
+        self, direction: Direction = Direction.BOTH, edge_class: Optional[str] = None
+    ) -> int:
+        n = 0
+        dirs = (
+            [Direction.OUT, Direction.IN]
+            if direction is Direction.BOTH
+            else [direction]
+        )
+        for d in dirs:
+            for cls_name in self._resolve_edge_classes(d, edge_class):
+                n += len(self._bag(d, cls_name))
+        return n
+
+
+class Edge(Document):
+    """An edge record ([E] OEdgeDocument): out = source, in = target."""
+
+    __slots__ = ("out_rid", "in_rid")
+
+    def __init__(self, class_name: str, fields: Optional[Dict[str, object]] = None):
+        super().__init__(class_name, fields)
+        self.out_rid: RID = NEW_RID
+        self.in_rid: RID = NEW_RID
+
+    def get(self, name: str, default=None):
+        # OrientDB exposes the endpoints as the `out` / `in` link properties.
+        if name == "out":
+            return self.out_rid
+        if name == "in":
+            return self.in_rid
+        return super().get(name, default)
+
+    def from_vertex(self) -> Vertex:
+        assert self._db is not None
+        v = self._db.load(self.out_rid)
+        assert isinstance(v, Vertex)
+        return v
+
+    def to_vertex(self) -> Vertex:
+        assert self._db is not None
+        v = self._db.load(self.in_rid)
+        assert isinstance(v, Vertex)
+        return v

@@ -1,0 +1,42 @@
+"""Record identity.
+
+Analog of OrientDB's ``ORecordId`` ([E] core/.../id/ORecordId.java): every
+record is addressed ``#<clusterId>:<clusterPosition>``. Cluster ids map to
+schema classes through the schema (SURVEY.md §2 "Clusters & RIDs").
+
+In the TPU snapshot layer, RIDs are remapped to dense per-class vertex
+indices (the §3.5 RID-remapping-table concept); this class is the host-side
+identity only.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class RID(NamedTuple):
+    cluster: int
+    position: int
+
+    def __str__(self) -> str:
+        return f"#{self.cluster}:{self.position}"
+
+    def __repr__(self) -> str:
+        return f"RID({self.cluster}, {self.position})"
+
+    @property
+    def is_persistent(self) -> bool:
+        return self.cluster >= 0 and self.position >= 0
+
+    @classmethod
+    def parse(cls, text: str) -> "RID":
+        t = text.strip()
+        if not t.startswith("#"):
+            raise ValueError(f"not a RID: {text!r}")
+        c, _, p = t[1:].partition(":")
+        return cls(int(c), int(p))
+
+
+#: Placeholder RID for new, not-yet-saved records (OrientDB uses #-1:-1 style
+#: temporary RIDs inside transactions).
+NEW_RID = RID(-1, -1)

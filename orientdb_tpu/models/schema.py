@@ -1,0 +1,306 @@
+"""Schema: classes, properties, inheritance, cluster mapping.
+
+Analog of OrientDB's schema layer ([E] core/.../metadata/schema/ —
+OSchemaShared, OClassImpl, OPropertyImpl; SURVEY.md §2 "Schema/metadata"):
+
+- classes form a single-inheritance-plus-interfaces hierarchy; here we keep
+  multiple-superclass support the way OrientDB 3.x does (a class may have
+  several superclasses);
+- the roots ``V`` and ``E`` make a class a vertex or edge class;
+- each class owns one or more *clusters* (record buckets); polymorphic reads
+  on a class scan its clusters plus all subclasses' clusters;
+- properties carry a type and optional constraints (mandatory, notNull,
+  min/max, readOnly) and may be indexed.
+
+The TPU snapshot builder uses the schema to decide which columnar property
+arrays to materialize per class.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from orientdb_tpu.models.rid import RID
+
+
+class PropertyType(enum.Enum):
+    """Subset of OrientDB's OType ([E] core/.../metadata/schema/OType.java)."""
+
+    BOOLEAN = "BOOLEAN"
+    INTEGER = "INTEGER"
+    LONG = "LONG"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    STRING = "STRING"
+    DATETIME = "DATETIME"
+    EMBEDDED = "EMBEDDED"
+    EMBEDDEDLIST = "EMBEDDEDLIST"
+    EMBEDDEDMAP = "EMBEDDEDMAP"
+    LINK = "LINK"
+    LINKLIST = "LINKLIST"
+    LINKBAG = "LINKBAG"
+    BINARY = "BINARY"
+    ANY = "ANY"
+
+    @classmethod
+    def infer(cls, value) -> "PropertyType":
+        if isinstance(value, bool):
+            return cls.BOOLEAN
+        if isinstance(value, int):
+            return cls.LONG
+        if isinstance(value, float):
+            return cls.DOUBLE
+        if isinstance(value, str):
+            return cls.STRING
+        if isinstance(value, RID):
+            return cls.LINK
+        if isinstance(value, dict):
+            return cls.EMBEDDEDMAP
+        if isinstance(value, (list, tuple)):
+            return cls.EMBEDDEDLIST
+        if isinstance(value, bytes):
+            return cls.BINARY
+        return cls.ANY
+
+
+class Property:
+    """A schema property ([E] OPropertyImpl)."""
+
+    def __init__(
+        self,
+        name: str,
+        ptype: PropertyType,
+        mandatory: bool = False,
+        not_null: bool = False,
+        read_only: bool = False,
+        min_value=None,
+        max_value=None,
+        linked_class: Optional[str] = None,
+    ) -> None:
+        self.name = name
+        self.type = ptype
+        self.mandatory = mandatory
+        self.not_null = not_null
+        self.read_only = read_only
+        self.min_value = min_value
+        self.max_value = max_value
+        self.linked_class = linked_class
+
+    def validate(self, value) -> None:
+        if value is None:
+            if self.not_null or self.mandatory:
+                raise ValueError(f"property '{self.name}' cannot be null")
+            return
+        if self.min_value is not None and value < self.min_value:
+            raise ValueError(f"property '{self.name}' below min {self.min_value}")
+        if self.max_value is not None and value > self.max_value:
+            raise ValueError(f"property '{self.name}' above max {self.max_value}")
+
+    def __repr__(self) -> str:
+        return f"Property({self.name}:{self.type.value})"
+
+
+class SchemaClass:
+    """A schema class ([E] OClassImpl). Created through :class:`Schema`."""
+
+    def __init__(self, schema: "Schema", name: str, cluster_ids: Sequence[int]) -> None:
+        self._schema = schema
+        self.name = name
+        self.cluster_ids: List[int] = list(cluster_ids)
+        self.superclass_names: List[str] = []
+        self.properties: Dict[str, Property] = {}
+        self.abstract = False
+        # strict_mode: reject fields not declared in the schema
+        # (OrientDB schema-full mode; default is schema-hybrid).
+        self.strict_mode = False
+
+    # -- hierarchy ---------------------------------------------------------
+
+    @property
+    def superclasses(self) -> List["SchemaClass"]:
+        return [self._schema.get_class(n) for n in self.superclass_names]
+
+    def add_superclass(self, name: str) -> None:
+        sup = self._schema.get_class(name)
+        if sup is None:
+            raise ValueError(f"superclass '{name}' does not exist")
+        if self.name in sup.all_superclass_names() | {sup.name}:
+            raise ValueError(f"inheritance cycle: {self.name} <-> {name}")
+        if name not in self.superclass_names:
+            self.superclass_names.append(name)
+
+    def all_superclass_names(self) -> Set[str]:
+        out: Set[str] = set()
+        stack = list(self.superclass_names)
+        while stack:
+            n = stack.pop()
+            if n in out:
+                continue
+            out.add(n)
+            sup = self._schema.get_class(n)
+            if sup is not None:
+                stack.extend(sup.superclass_names)
+        return out
+
+    def is_subclass_of(self, name: str) -> bool:
+        return name == self.name or name in self.all_superclass_names()
+
+    def subclasses(self, include_self: bool = True) -> List["SchemaClass"]:
+        """All classes at or below this one (polymorphic scan set)."""
+        out = []
+        for c in self._schema.classes():
+            if c.is_subclass_of(self.name) and (include_self or c.name != self.name):
+                out.append(c)
+        return out
+
+    @property
+    def is_vertex_type(self) -> bool:
+        return self.is_subclass_of("V")
+
+    @property
+    def is_edge_type(self) -> bool:
+        return self.is_subclass_of("E")
+
+    # -- properties --------------------------------------------------------
+
+    def create_property(self, name: str, ptype: PropertyType, **kw) -> Property:
+        if name in self.properties:
+            raise ValueError(f"property '{name}' already exists on {self.name}")
+        prop = Property(name, ptype, **kw)
+        self.properties[name] = prop
+        return prop
+
+    def get_property(self, name: str) -> Optional[Property]:
+        """Property lookup, walking superclasses."""
+        if name in self.properties:
+            return self.properties[name]
+        for sup in self.superclasses:
+            p = sup.get_property(name)
+            if p is not None:
+                return p
+        return None
+
+    def effective_properties(self) -> Dict[str, Property]:
+        """All properties including inherited (nearest definition wins)."""
+        out: Dict[str, Property] = {}
+        for sup in reversed(self.superclasses):
+            out.update(sup.effective_properties())
+        out.update(self.properties)
+        return out
+
+    def validate(self, fields: Dict[str, object]) -> None:
+        props = self.effective_properties()
+        for pname, prop in props.items():
+            if prop.mandatory and pname not in fields:
+                raise ValueError(f"mandatory property '{pname}' missing on {self.name}")
+            if pname in fields:
+                prop.validate(fields[pname])
+        if self.strict_mode:
+            for fname in fields:
+                if fname not in props and not fname.startswith("@"):
+                    raise ValueError(
+                        f"field '{fname}' not declared in strict class {self.name}"
+                    )
+
+    def __repr__(self) -> str:
+        sup = f" extends {','.join(self.superclass_names)}" if self.superclass_names else ""
+        return f"SchemaClass({self.name}{sup})"
+
+
+class Schema:
+    """Class registry + cluster-id allocation ([E] OSchemaShared).
+
+    Cluster ids are allocated sequentially; cluster 0 is reserved for
+    internal metadata (OrientDB reserves low clusters for internal records).
+    """
+
+    FIRST_USER_CLUSTER = 1
+
+    def __init__(self) -> None:
+        self._classes: Dict[str, SchemaClass] = {}
+        self._next_cluster = Schema.FIRST_USER_CLUSTER
+        self._cluster_to_class: Dict[int, str] = {}
+        # Bootstrap the graph roots, like OrientDB's default V / E classes.
+        self.create_class("V")
+        self.create_class("E")
+
+    # -- classes -----------------------------------------------------------
+
+    def create_class(
+        self,
+        name: str,
+        superclasses: Iterable[str] = (),
+        abstract: bool = False,
+        clusters: int = 1,
+    ) -> SchemaClass:
+        if self.get_class(name) is not None:
+            raise ValueError(f"class '{name}' already exists")
+        # Validate superclasses and wire them BEFORE registering, so a bad
+        # superclass never leaves a half-registered class behind.
+        cls = SchemaClass(self, name, [])
+        cls.abstract = abstract
+        for sup in superclasses:
+            cls.add_superclass(sup)
+        ids = [] if abstract else [self._allocate_cluster() for _ in range(clusters)]
+        cls.cluster_ids = list(ids)
+        self._classes[name.lower()] = cls
+        for cid in ids:
+            self._cluster_to_class[cid] = name
+        return cls
+
+    def create_vertex_class(self, name: str, **kw) -> SchemaClass:
+        return self.create_class(name, superclasses=("V",), **kw)
+
+    def create_edge_class(self, name: str, **kw) -> SchemaClass:
+        return self.create_class(name, superclasses=("E",), **kw)
+
+    def get_class(self, name: str) -> Optional[SchemaClass]:
+        return self._classes.get(name.lower())
+
+    def get_class_or_raise(self, name: str) -> SchemaClass:
+        c = self.get_class(name)
+        if c is None:
+            raise ValueError(f"class '{name}' not found in schema")
+        return c
+
+    def drop_class(self, name: str) -> None:
+        cls = self.get_class_or_raise(name)
+        for c in self.classes():
+            if name in c.superclass_names:
+                raise ValueError(f"class '{name}' has subclass '{c.name}'")
+        for cid in cls.cluster_ids:
+            self._cluster_to_class.pop(cid, None)
+        del self._classes[name.lower()]
+
+    def exists_class(self, name: str) -> bool:
+        return self.get_class(name) is not None
+
+    def classes(self) -> List[SchemaClass]:
+        return list(self._classes.values())
+
+    # -- clusters ----------------------------------------------------------
+
+    def _allocate_cluster(self) -> int:
+        cid = self._next_cluster
+        self._next_cluster += 1
+        return cid
+
+    def add_cluster(self, class_name: str) -> int:
+        cls = self.get_class_or_raise(class_name)
+        cid = self._allocate_cluster()
+        cls.cluster_ids.append(cid)
+        self._cluster_to_class[cid] = cls.name
+        return cid
+
+    def class_of_cluster(self, cluster_id: int) -> Optional[SchemaClass]:
+        name = self._cluster_to_class.get(cluster_id)
+        return self.get_class(name) if name else None
+
+    def polymorphic_cluster_ids(self, class_name: str) -> List[int]:
+        """Cluster ids of the class and all its subclasses (scan set)."""
+        cls = self.get_class_or_raise(class_name)
+        out: List[int] = []
+        for sub in cls.subclasses(include_self=True):
+            out.extend(sub.cluster_ids)
+        return sorted(out)

@@ -1,0 +1,4 @@
+from orientdb_tpu.utils.config import GlobalConfiguration, config
+from orientdb_tpu.utils.logging import get_logger
+
+__all__ = ["GlobalConfiguration", "config", "get_logger"]

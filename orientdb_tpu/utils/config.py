@@ -1,0 +1,85 @@
+"""Typed global configuration.
+
+Analog of OrientDB's ``OGlobalConfiguration`` enum of typed keys
+([E] core/.../config/OGlobalConfiguration.java, SURVEY.md §5.6), redesigned as
+a single dataclass with environment-variable overrides (``ORIENTTPU_<FIELD>``)
+instead of JVM system properties.
+
+The per-session ``TRAVERSE_ENGINE`` switch (north star: sessions set
+``TRAVERSE_ENGINE=tpu`` to route MATCH through the TPU backend instead of the
+interpreted per-record path) lives here as the *default*; sessions may
+override it per query.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(f"ORIENTTPU_{name.upper()}")
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclasses.dataclass
+class GlobalConfiguration:
+    # Query engine selection: "tpu" (compiled batched path), "oracle"
+    # (pure-Python reference interpreter — the parity oracle), or "auto"
+    # (tpu when a snapshot is attached, oracle otherwise).
+    traverse_engine: str = "auto"
+
+    # Expansion output caps are padded to powers of two >= this to bound
+    # recompilation while keeping buffers small.
+    min_expansion_cap: int = 1024
+    # Hard ceiling on a single expansion output buffer (rows). Expansions
+    # that would exceed it are chunked over the binding table.
+    max_expansion_cap: int = 1 << 22
+
+    # Default max depth for WHILE-style variable-depth MATCH arms when the
+    # query gives no maxDepth (OrientDB requires WHILE or maxDepth; we keep a
+    # safety ceiling for the compiled path).
+    default_max_depth: int = 32
+
+    # Plan cache entries (analog of OExecutionPlanCache [E]).
+    plan_cache_size: int = 256
+    # Parsed-statement cache entries (analog of OStatementCache [E]).
+    statement_cache_size: int = 1024
+
+    # Snapshot build options.
+    string_dictionary_max: int = 1 << 24  # max distinct strings per column
+
+    # Sharding.
+    mesh_axis_name: str = "shard"
+
+    # Logging level for get_logger default.
+    log_level: str = "WARNING"
+
+    # WAL / durability for the host record store.
+    wal_enabled: bool = False
+    wal_dir: Optional[str] = None
+    wal_fsync: bool = False
+
+    @classmethod
+    def from_env(cls) -> "GlobalConfiguration":
+        c = cls()
+        for f in dataclasses.fields(cls):
+            cast = f.type if isinstance(f.type, type) else None
+            if cast is None:
+                # dataclass stores the annotation as a string under
+                # `from __future__ import annotations`
+                cast = {"str": str, "int": int, "bool": bool, "float": float}.get(
+                    str(f.type), str
+                )
+            setattr(c, f.name, _env(f.name, getattr(c, f.name), cast))
+        return c
+
+
+# Process-wide instance (OGlobalConfiguration is a static enum in the
+# reference; a module-level singleton is the honest analog).
+config = GlobalConfiguration.from_env()

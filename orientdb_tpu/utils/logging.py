@@ -1,0 +1,23 @@
+"""Structured logging (analog of OLogManager, [E] core/.../log/OLogManager.java)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+_configured = False
+
+
+def _ensure_configured() -> None:
+    global _configured
+    if _configured:
+        return
+    level = os.environ.get("ORIENTTPU_LOG_LEVEL", "WARNING").upper()
+    logging.basicConfig(level=getattr(logging, level, logging.WARNING), format=_FORMAT)
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _ensure_configured()
+    return logging.getLogger(f"orientdb_tpu.{name}")

@@ -1,0 +1,63 @@
+"""Test bootstrap: force JAX onto CPU with 8 virtual devices BEFORE jax
+imports anywhere, so sharded (mesh) tests run without TPU hardware —
+the SURVEY.md §4 analog of OrientDB's `memory:` fake-backend strategy and
+its multi-server-in-one-JVM distributed tests."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def db():
+    from orientdb_tpu import Database
+
+    return Database("testdb")
+
+
+@pytest.fixture
+def social_db():
+    """A small demodb-shaped social graph used across test modules.
+
+    Profiles: alice, bob, carol, dave, eve (ids 0..4)
+    HasFriend (directed): alice->bob, alice->carol, bob->carol, carol->dave,
+                          dave->eve, eve->alice
+    Likes: alice->dave (weight 5), bob->eve (weight 1)
+    """
+    from orientdb_tpu import Database, PropertyType
+
+    db = Database("social")
+    prof = db.schema.create_vertex_class("Profiles")
+    prof.create_property("name", PropertyType.STRING)
+    prof.create_property("age", PropertyType.LONG)
+    db.schema.create_edge_class("HasFriend")
+    likes = db.schema.create_edge_class("Likes")
+    likes.create_property("weight", PropertyType.LONG)
+
+    names = ["alice", "bob", "carol", "dave", "eve"]
+    ages = [30, 25, 35, 40, 28]
+    vs = {
+        n: db.new_vertex("Profiles", name=n, age=a, uid=i)
+        for i, (n, a) in enumerate(zip(names, ages))
+    }
+    friend_pairs = [
+        ("alice", "bob"),
+        ("alice", "carol"),
+        ("bob", "carol"),
+        ("carol", "dave"),
+        ("dave", "eve"),
+        ("eve", "alice"),
+    ]
+    for a, b in friend_pairs:
+        db.new_edge("HasFriend", vs[a], vs[b])
+    db.new_edge("Likes", vs["alice"], vs["dave"], weight=5)
+    db.new_edge("Likes", vs["bob"], vs["eve"], weight=1)
+    db._test_vertices = vs  # convenience for assertions
+    return db

@@ -1,0 +1,283 @@
+"""Data-model tests: RIDs, schema inheritance, records, adjacency, MVCC,
+indexes — the per-module unit-test layer of SURVEY.md §4."""
+
+import pytest
+
+from orientdb_tpu import (
+    ConcurrentModificationError,
+    Database,
+    Direction,
+    PropertyType,
+    RID,
+)
+from orientdb_tpu.models.indexes import DuplicateKeyError
+
+
+class TestRID:
+    def test_parse_roundtrip(self):
+        r = RID.parse("#12:345")
+        assert r == RID(12, 345)
+        assert str(r) == "#12:345"
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            RID.parse("12:345")
+
+    def test_persistence_flag(self):
+        assert RID(1, 0).is_persistent
+        assert not RID(-1, -1).is_persistent
+
+
+class TestSchema:
+    def test_v_e_bootstrap(self, db):
+        assert db.schema.exists_class("V")
+        assert db.schema.exists_class("E")
+
+    def test_inheritance_and_polymorphism(self, db):
+        db.schema.create_vertex_class("Person")
+        emp = db.schema.create_class("Employee", superclasses=("Person",))
+        assert emp.is_vertex_type
+        assert emp.is_subclass_of("V")
+        person = db.schema.get_class("Person")
+        assert {c.name for c in person.subclasses()} == {"Person", "Employee"}
+
+    def test_case_insensitive_lookup(self, db):
+        db.schema.create_vertex_class("Person")
+        assert db.schema.get_class("PERSON") is not None
+
+    def test_inheritance_cycle_rejected(self, db):
+        a = db.schema.create_class("A")
+        db.schema.create_class("B", superclasses=("A",))
+        with pytest.raises(ValueError):
+            a.add_superclass("B")
+
+    def test_property_validation(self, db):
+        p = db.schema.create_vertex_class("Person")
+        p.create_property("name", PropertyType.STRING, mandatory=True)
+        p.create_property("age", PropertyType.LONG, min_value=0)
+        with pytest.raises(ValueError):
+            db.new_vertex("Person", age=5)  # missing mandatory name
+        with pytest.raises(ValueError):
+            db.new_vertex("Person", name="x", age=-1)
+        v = db.new_vertex("Person", name="ok", age=1)
+        assert v.rid.is_persistent
+
+    def test_inherited_property_validation(self, db):
+        base = db.schema.create_vertex_class("Base")
+        base.create_property("k", PropertyType.STRING, mandatory=True)
+        db.schema.create_class("Sub", superclasses=("Base",))
+        with pytest.raises(ValueError):
+            db.new_vertex("Sub")
+        assert db.new_vertex("Sub", k="v").get("k") == "v"
+
+    def test_polymorphic_cluster_ids(self, db):
+        db.schema.create_vertex_class("Person")
+        db.schema.create_class("Employee", superclasses=("Person",))
+        cids = db.schema.polymorphic_cluster_ids("Person")
+        assert len(cids) == 2
+
+    def test_drop_class_with_subclass_refused(self, db):
+        db.schema.create_class("A")
+        db.schema.create_class("B", superclasses=("A",))
+        with pytest.raises(ValueError):
+            db.schema.drop_class("A")
+
+
+class TestRecords:
+    def test_document_crud(self, db):
+        d = db.new_element("Doc", x=1, y="two")
+        assert d.rid.is_persistent and d.version == 1
+        d.set("x", 2).save()
+        assert d.version == 2
+        loaded = db.load(d.rid)
+        assert loaded.get("x") == 2
+        d.delete()
+        assert db.load(d.rid) is None
+
+    def test_attribute_pseudofields(self, db):
+        d = db.new_element("Doc", x=1)
+        assert d.get("@class") == "Doc"
+        assert d.get("@version") == 1
+        assert d.get("@rid") == d.rid
+
+    def test_mvcc_conflict(self, db):
+        d = db.new_element("Doc", x=1)
+        stale_version = d.version
+        d.set("x", 2).save()
+        # Simulate a second session that read the old version.
+        clone = type(d)(d.class_name, d.fields())
+        clone._db = db
+        clone.rid = d.rid
+        clone.version = stale_version
+        with pytest.raises(ConcurrentModificationError):
+            clone.save()
+
+    def test_rid_not_reused_after_delete(self, db):
+        d1 = db.new_element("Doc", x=1)
+        rid1 = d1.rid
+        d1.delete()
+        d2 = db.new_element("Doc", x=2)
+        assert d2.rid != rid1
+
+
+class TestGraph:
+    def test_edge_wiring(self, social_db):
+        vs = social_db._test_vertices
+        alice = vs["alice"]
+        out_names = sorted(
+            v.get("name") for v in alice.vertices(Direction.OUT, "HasFriend")
+        )
+        assert out_names == ["bob", "carol"]
+        in_names = [v.get("name") for v in alice.vertices(Direction.IN, "HasFriend")]
+        assert in_names == ["eve"]
+        both = sorted(v.get("name") for v in alice.vertices(Direction.BOTH, "HasFriend"))
+        assert both == ["bob", "carol", "eve"]
+
+    def test_edge_class_filter(self, social_db):
+        vs = social_db._test_vertices
+        alice = vs["alice"]
+        all_out = sorted(v.get("name") for v in alice.vertices(Direction.OUT))
+        assert all_out == ["bob", "carol", "dave"]  # HasFriend + Likes
+        likes_only = [v.get("name") for v in alice.vertices(Direction.OUT, "Likes")]
+        assert likes_only == ["dave"]
+
+    def test_edge_polymorphic_class_filter(self, db):
+        db.schema.create_edge_class("Knows")
+        db.schema.create_class("WorksWith", superclasses=("Knows",))
+        a = db.new_vertex("V", name="a")
+        b = db.new_vertex("V", name="b")
+        db.new_edge("WorksWith", a, b)
+        assert [v.get("name") for v in a.vertices(Direction.OUT, "Knows")] == ["b"]
+        assert [v.get("name") for v in a.vertices(Direction.OUT, "E")] == ["b"]
+
+    def test_edge_properties(self, social_db):
+        vs = social_db._test_vertices
+        likes = list(vs["alice"].edges(Direction.OUT, "Likes"))
+        assert len(likes) == 1
+        assert likes[0].get("weight") == 5
+        assert likes[0].get("out") == vs["alice"].rid
+        assert likes[0].get("in") == vs["dave"].rid
+
+    def test_delete_vertex_cascades_edges(self, social_db):
+        vs = social_db._test_vertices
+        carol = vs["carol"]
+        social_db.delete(carol)
+        # alice -> carol edge must be gone from alice's out bag
+        assert sorted(
+            v.get("name") for v in vs["alice"].vertices(Direction.OUT, "HasFriend")
+        ) == ["bob"]
+        # dave lost his incoming edge from carol
+        assert list(vs["dave"].vertices(Direction.IN, "HasFriend")) == []
+
+    def test_delete_edge_detaches(self, social_db):
+        vs = social_db._test_vertices
+        e = next(iter(vs["alice"].edges(Direction.OUT, "Likes")))
+        social_db.delete(e)
+        assert list(vs["alice"].vertices(Direction.OUT, "Likes")) == []
+        assert list(vs["dave"].vertices(Direction.IN, "Likes")) == []
+
+    def test_degree(self, social_db):
+        vs = social_db._test_vertices
+        assert vs["alice"].degree(Direction.OUT, "HasFriend") == 2
+        assert vs["alice"].degree(Direction.BOTH) == 4  # 2 out HF + 1 in HF + 1 out Likes
+
+    def test_browse_and_count(self, social_db):
+        assert social_db.count_class("Profiles") == 5
+        assert social_db.count_class("HasFriend") == 6
+        assert social_db.count_class("E", polymorphic=True) == 8
+        assert social_db.count_class("V", polymorphic=True) == 5
+
+
+class TestIndexes:
+    def test_unique_index_enforced(self, db):
+        db.schema.create_vertex_class("User")
+        db.indexes.create_index("User.uid", "User", ["uid"], "UNIQUE")
+        db.new_vertex("User", uid=1)
+        with pytest.raises(DuplicateKeyError):
+            db.new_vertex("User", uid=1)
+
+    def test_index_backfill_and_lookup(self, social_db):
+        idx = social_db.indexes.create_index(
+            "Profiles.name", "Profiles", ["name"], "UNIQUE"
+        )
+        rids = idx.get("carol")
+        assert len(rids) == 1
+        assert social_db.load(next(iter(rids))).get("name") == "carol"
+
+    def test_index_updates_on_save_and_delete(self, social_db):
+        idx = social_db.indexes.create_index(
+            "Profiles.name", "Profiles", ["name"], "UNIQUE"
+        )
+        vs = social_db._test_vertices
+        vs["bob"].set("name", "robert").save()
+        assert idx.get("bob") == set()
+        assert len(idx.get("robert")) == 1
+        social_db.delete(vs["eve"])
+        assert idx.get("eve") == set()
+
+    def test_range_scan(self, social_db):
+        idx = social_db.indexes.create_index(
+            "Profiles.age", "Profiles", ["age"], "NOTUNIQUE"
+        )
+        keys = [k for k, _ in idx.range(lo=28, hi=35)]
+        assert keys == [28, 30, 35]
+        keys = [k for k, _ in idx.range(lo=28, hi=35, lo_inclusive=False)]
+        assert keys == [30, 35]
+
+    def test_composite_key(self, db):
+        db.schema.create_vertex_class("P")
+        idx = db.indexes.create_index("P.ab", "P", ["a", "b"], "NOTUNIQUE")
+        v = db.new_vertex("P", a=1, b=2)
+        assert idx.get((1, 2)) == {v.rid}
+
+    def test_null_keys_not_indexed(self, db):
+        db.schema.create_vertex_class("P")
+        idx = db.indexes.create_index("P.a", "P", ["a"], "UNIQUE")
+        db.new_vertex("P")  # a is null -> not indexed, no duplicate error
+        db.new_vertex("P")
+        assert idx.size() == 0
+
+    def test_unique_violation_rolls_back_record(self, db):
+        db.schema.create_vertex_class("User")
+        db.indexes.create_index("User.uid", "User", ["uid"], "UNIQUE")
+        db.new_vertex("User", uid=1)
+        with pytest.raises(DuplicateKeyError):
+            db.new_vertex("User", uid=1)
+        assert db.count_class("User") == 1
+
+    def test_unique_violation_on_update_keeps_index_consistent(self, db):
+        db.schema.create_vertex_class("User")
+        idx = db.indexes.create_index("User.uid", "User", ["uid"], "UNIQUE")
+        db.new_vertex("User", uid=1)
+        u2 = db.new_vertex("User", uid=2)
+        u2.set("uid", 1)
+        with pytest.raises(DuplicateKeyError):
+            u2.save()
+        # store unchanged, index still maps uid=2 -> u2
+        assert idx.get(2) == {u2.rid}
+        assert len(idx.get(1)) == 1
+        assert db.load(u2.rid).version == u2.version
+
+    def test_drop_class_drops_indexes(self, db):
+        db.schema.create_vertex_class("A")
+        db.schema.create_vertex_class("B")
+        db.indexes.create_index("A.x", "A", ["x"], "NOTUNIQUE")
+        db.drop_class("A")
+        assert db.indexes.get_index("A.x") is None
+        assert db.indexes.for_class("B") == []  # must not raise
+
+
+class TestSchemaRobustness:
+    def test_bad_superclass_leaves_no_half_registered_class(self, db):
+        with pytest.raises(ValueError):
+            db.schema.create_class("X", superclasses=("Missing",))
+        assert db.schema.get_class("X") is None
+        v = db.schema.create_class("X", superclasses=("V",))
+        assert v.is_vertex_type
+
+    def test_edge_delete_bumps_endpoint_versions(self, social_db):
+        vs = social_db._test_vertices
+        v_before = vs["alice"].version
+        e = next(iter(vs["alice"].edges(Direction.OUT, "Likes")))
+        social_db.delete(e)
+        assert vs["alice"].version == v_before + 1
