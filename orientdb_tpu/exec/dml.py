@@ -1,0 +1,357 @@
+"""Write-path and DDL statement execution (host side).
+
+Analog of the reference's Insert/Update/Delete execution planners ([E]
+core/.../sql/executor/OInsertExecutionPlanner etc.) and DDL statements.
+Writes always run on the host record store; the TPU snapshot is invalidated
+via Database.mutation_epoch (north-star design: the TPU path is a read
+accelerator, writes stay host-side — SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from orientdb_tpu.exec.eval import EvalContext, as_list, evaluate, resolve_links, truthy
+from orientdb_tpu.exec.result import Result
+from orientdb_tpu.models.record import Document, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.schema import PropertyType
+from orientdb_tpu.sql import ast as A
+
+
+class CommandError(Exception):
+    pass
+
+
+def execute(db, stmt: A.Statement, params, parent_ctx=None) -> List[Result]:
+    ctx = EvalContext(db, params=params, parent=parent_ctx)
+    if isinstance(stmt, A.InsertStatement):
+        return _insert(db, stmt, ctx, params)
+    if isinstance(stmt, A.CreateVertexStatement):
+        return _create_vertex(db, stmt, ctx)
+    if isinstance(stmt, A.CreateEdgeStatement):
+        return _create_edge(db, stmt, ctx, params)
+    if isinstance(stmt, A.UpdateStatement):
+        return _update(db, stmt, ctx, params)
+    if isinstance(stmt, A.DeleteStatement):
+        return _delete(db, stmt, ctx, params)
+    if isinstance(stmt, A.CreateClassStatement):
+        return _create_class(db, stmt)
+    if isinstance(stmt, A.CreatePropertyStatement):
+        return _create_property(db, stmt)
+    if isinstance(stmt, A.CreateIndexStatement):
+        return _create_index(db, stmt)
+    if isinstance(stmt, A.DropClassStatement):
+        return _drop_class(db, stmt)
+    if isinstance(stmt, A.DropPropertyStatement):
+        cls = db.schema.get_class_or_raise(stmt.class_name)
+        cls.properties.pop(stmt.property_name, None)
+        return [Result(props={"operation": "drop property"})]
+    if isinstance(stmt, A.DropIndexStatement):
+        db.indexes.drop_index(stmt.name)
+        return [Result(props={"operation": "drop index"})]
+    if isinstance(stmt, A.AlterPropertyStatement):
+        return _alter_property(db, stmt, ctx)
+    if isinstance(stmt, (A.BeginStatement, A.CommitStatement, A.RollbackStatement)):
+        from orientdb_tpu.exec import tx as _tx
+
+        return _tx.execute_tx_statement(db, stmt)
+    if isinstance(stmt, A.LiveSelectStatement):
+        from orientdb_tpu.exec import live
+
+        return live.subscribe(db, stmt, params)
+    raise CommandError(f"unsupported statement {type(stmt).__name__}")
+
+
+# -- INSERT / CREATE --------------------------------------------------------
+
+
+def _field_map(ctx, set_fields) -> Dict[str, object]:
+    return {name: evaluate(ctx, e) for name, e in set_fields}
+
+
+def _insert(db, stmt: A.InsertStatement, ctx, params) -> List[Result]:
+    class_name = stmt.class_name
+    if class_name is None and stmt.cluster is not None:
+        cls = db.schema.get_class(stmt.cluster)
+        if cls is None:
+            raise CommandError(f"cluster '{stmt.cluster}' not found")
+        class_name = cls.name
+    assert class_name is not None
+    cls = db.schema.get_class(class_name)
+    if cls is not None and cls.is_edge_type:
+        raise CommandError("cannot INSERT INTO an edge class; use CREATE EDGE")
+    rows_fields: List[Dict[str, object]] = []
+    if stmt.set_fields:
+        rows_fields.append(_field_map(ctx, stmt.set_fields))
+    elif stmt.content is not None:
+        content = evaluate(ctx, stmt.content)
+        for m in as_list(content):
+            if not isinstance(m, dict):
+                raise CommandError("INSERT CONTENT expects map(s)")
+            rows_fields.append(dict(m))
+    elif stmt.from_select is not None:
+        from orientdb_tpu.exec.oracle import execute_statement
+
+        for r in execute_statement(db, stmt.from_select, params, parent_ctx=ctx):
+            if r.is_element:
+                rows_fields.append(r.element.fields())  # type: ignore[union-attr]
+            else:
+                rows_fields.append({k: r.get_property(k) for k in r.property_names()})
+    else:
+        rows_fields.append({})
+    out = []
+    for fields in rows_fields:
+        if cls is not None and cls.is_vertex_type:
+            doc: Document = db.new_vertex(class_name, **fields)
+        else:
+            doc = db.new_element(class_name, **fields)
+        out.append(Result(element=doc))
+    return out
+
+
+def _create_vertex(db, stmt: A.CreateVertexStatement, ctx) -> List[Result]:
+    fields = _field_map(ctx, stmt.set_fields)
+    if stmt.content is not None:
+        c = evaluate(ctx, stmt.content)
+        if not isinstance(c, dict):
+            raise CommandError("CREATE VERTEX CONTENT expects a map")
+        fields.update(c)
+    v = db.new_vertex(stmt.class_name, **fields)
+    return [Result(element=v)]
+
+
+def _resolve_vertices(db, ctx, expr: A.Expression) -> List[Vertex]:
+    val = evaluate(ctx, expr)
+    out = []
+    for item in as_list(resolve_links(ctx, val)):
+        from orientdb_tpu.exec.result import Result as _R
+
+        if isinstance(item, _R) and item.is_element:
+            item = item.element
+        if isinstance(item, Vertex):
+            out.append(item)
+        elif isinstance(item, RID):
+            d = db.load(item)
+            if isinstance(d, Vertex):
+                out.append(d)
+    return out
+
+
+def _create_edge(db, stmt: A.CreateEdgeStatement, ctx, params) -> List[Result]:
+    sources = _resolve_vertices(db, ctx, stmt.from_expr)
+    targets = _resolve_vertices(db, ctx, stmt.to_expr)
+    if not sources or not targets:
+        raise CommandError("CREATE EDGE: FROM/TO resolved to no vertices")
+    fields = _field_map(ctx, stmt.set_fields)
+    if stmt.content is not None:
+        c = evaluate(ctx, stmt.content)
+        if not isinstance(c, dict):
+            raise CommandError("CREATE EDGE CONTENT expects a map")
+        fields.update(c)
+    out = []
+    for s in sources:
+        for t in targets:
+            e = db.new_edge(stmt.class_name, s, t, **fields)
+            out.append(Result(element=e))
+    return out
+
+
+# -- UPDATE / DELETE --------------------------------------------------------
+
+
+def _target_docs(db, target: A.Target, where, limit, ctx, params) -> List[Document]:
+    from orientdb_tpu.exec.oracle import resolve_target_rows
+
+    docs = []
+    for row in resolve_target_rows(db, target, ctx):
+        doc = row if isinstance(doc_candidate := row, Document) else (
+            row.element if isinstance(row, Result) and row.is_element else None
+        )
+        if doc is None:
+            continue
+        if where is not None:
+            rctx = EvalContext(db, current=doc, params=params, parent=ctx)
+            if not truthy(evaluate(rctx, where)):
+                continue
+        docs.append(doc)
+    if limit is not None:
+        n = int(evaluate(ctx, limit))
+        docs = docs[:n]
+    return docs
+
+
+def _update(db, stmt: A.UpdateStatement, ctx, params) -> List[Result]:
+    docs = _target_docs(db, stmt.target, stmt.where, stmt.limit, ctx, params)
+    if not docs and stmt.upsert:
+        # derive fields from a conjunction of equality predicates, as the
+        # reference's UPSERT does
+        fields = {}
+        _collect_eq_fields(stmt.where, fields, ctx)
+        if not isinstance(stmt.target, A.ClassTarget):
+            raise CommandError("UPSERT requires a class target")
+        doc = db.new_element(stmt.target.name, **fields)
+        docs = [doc]
+    before = []
+    if stmt.return_mode == "BEFORE":
+        before = [Result(props=d.to_dict()) for d in docs]
+    for doc in docs:
+        rctx = EvalContext(db, current=doc, params=params, parent=ctx)
+        for op in stmt.ops:
+            _apply_op(db, doc, op, rctx)
+        db.save(doc)
+    if stmt.return_mode == "BEFORE":
+        return before
+    if stmt.return_mode == "AFTER":
+        return [Result(element=d) for d in docs]
+    return [Result(props={"count": len(docs)})]
+
+
+def _collect_eq_fields(where, fields: Dict[str, object], ctx) -> None:
+    if isinstance(where, A.Binary):
+        if where.op == "AND":
+            _collect_eq_fields(where.left, fields, ctx)
+            _collect_eq_fields(where.right, fields, ctx)
+        elif where.op == "=" and isinstance(where.left, A.Identifier):
+            fields[where.left.name] = evaluate(ctx, where.right)
+
+
+def _apply_op(db, doc: Document, op: A.UpdateOp, rctx) -> None:
+    if op.kind == "SET":
+        for name, e in op.items:
+            doc.set(name, evaluate(rctx, e))
+    elif op.kind == "INCREMENT":
+        for name, e in op.items:
+            cur = doc.get(name) or 0
+            doc.set(name, cur + evaluate(rctx, e))
+    elif op.kind == "REMOVE":
+        for name, e in op.items:
+            val = evaluate(rctx, e)
+            if val is None:
+                doc.remove_field(name)
+            else:
+                lst = as_list(doc.get(name))
+                doc.set(name, [x for x in lst if x != val])
+    elif op.kind == "CONTENT":
+        new = evaluate(rctx, op.items[0][1])
+        if not isinstance(new, dict):
+            raise CommandError("UPDATE CONTENT expects a map")
+        for name in list(doc.field_names()):
+            doc.remove_field(name)
+        doc.update(**new)
+    elif op.kind == "MERGE":
+        new = evaluate(rctx, op.items[0][1])
+        if not isinstance(new, dict):
+            raise CommandError("UPDATE MERGE expects a map")
+        doc.update(**new)
+    else:
+        raise CommandError(f"unsupported UPDATE op {op.kind}")
+
+
+def _delete(db, stmt: A.DeleteStatement, ctx, params) -> List[Result]:
+    where = stmt.where
+    if stmt.kind == "EDGE" and (stmt.edge_from is not None or stmt.edge_to is not None):
+        docs = _edge_endpoint_docs(db, stmt, ctx)
+        if where is not None:
+            docs = [
+                d
+                for d in docs
+                if truthy(
+                    evaluate(EvalContext(db, current=d, params=params, parent=ctx), where)
+                )
+            ]
+    else:
+        docs = _target_docs(db, stmt.target, where, stmt.limit, ctx, params)
+    count = 0
+    for doc in docs:
+        if stmt.kind == "VERTEX" and not isinstance(doc, Vertex):
+            continue
+        if stmt.kind == "EDGE" and not isinstance(doc, Edge):
+            continue
+        db.delete(doc)
+        count += 1
+    return [Result(props={"count": count})]
+
+
+def _edge_endpoint_docs(db, stmt: A.DeleteStatement, ctx) -> List[Edge]:
+    src_rids = {
+        v.rid for v in _resolve_vertices(db, ctx, stmt.edge_from)
+    } if stmt.edge_from is not None else None
+    dst_rids = {
+        v.rid for v in _resolve_vertices(db, ctx, stmt.edge_to)
+    } if stmt.edge_to is not None else None
+    cls = stmt.target.name if isinstance(stmt.target, A.ClassTarget) else "E"
+    out = []
+    for doc in db.browse_class(cls):
+        if not isinstance(doc, Edge):
+            continue
+        if src_rids is not None and doc.out_rid not in src_rids:
+            continue
+        if dst_rids is not None and doc.in_rid not in dst_rids:
+            continue
+        out.append(doc)
+    return out
+
+
+# -- DDL --------------------------------------------------------------------
+
+
+def _create_class(db, stmt: A.CreateClassStatement) -> List[Result]:
+    if db.schema.exists_class(stmt.name):
+        if stmt.if_not_exists:
+            return [Result(props={"operation": "create class", "existed": True})]
+        raise CommandError(f"class '{stmt.name}' already exists")
+    db.schema.create_class(stmt.name, superclasses=stmt.superclasses, abstract=stmt.abstract)
+    return [Result(props={"operation": "create class", "name": stmt.name})]
+
+
+def _create_property(db, stmt: A.CreatePropertyStatement) -> List[Result]:
+    cls = db.schema.get_class_or_raise(stmt.class_name)
+    if stmt.property_name in cls.properties:
+        if stmt.if_not_exists:
+            return [Result(props={"operation": "create property", "existed": True})]
+        raise CommandError(f"property '{stmt.property_name}' already exists")
+    try:
+        ptype = PropertyType[stmt.property_type]
+    except KeyError:
+        raise CommandError(f"unknown property type {stmt.property_type}")
+    cls.create_property(stmt.property_name, ptype, linked_class=stmt.linked_class)
+    return [Result(props={"operation": "create property"})]
+
+
+def _create_index(db, stmt: A.CreateIndexStatement) -> List[Result]:
+    if stmt.class_name is None:
+        raise CommandError("CREATE INDEX needs a class (use name ON class (fields) or Class.field)")
+    db.indexes.create_index(stmt.name, stmt.class_name, list(stmt.fields), stmt.index_type)
+    return [Result(props={"operation": "create index", "name": stmt.name})]
+
+
+def _drop_class(db, stmt: A.DropClassStatement) -> List[Result]:
+    if not db.schema.exists_class(stmt.name):
+        if stmt.if_exists:
+            return [Result(props={"operation": "drop class", "existed": False})]
+        raise CommandError(f"class '{stmt.name}' not found")
+    db.drop_class(stmt.name)
+    return [Result(props={"operation": "drop class"})]
+
+
+def _alter_property(db, stmt: A.AlterPropertyStatement, ctx) -> List[Result]:
+    cls = db.schema.get_class_or_raise(stmt.class_name)
+    prop = cls.get_property(stmt.property_name)
+    if prop is None:
+        raise CommandError(f"property '{stmt.property_name}' not found")
+    value = evaluate(ctx, stmt.value)
+    attr = stmt.attribute.upper()
+    if attr == "MANDATORY":
+        prop.mandatory = bool(value)
+    elif attr == "NOTNULL":
+        prop.not_null = bool(value)
+    elif attr == "READONLY":
+        prop.read_only = bool(value)
+    elif attr == "MIN":
+        prop.min_value = value
+    elif attr == "MAX":
+        prop.max_value = value
+    else:
+        raise CommandError(f"unsupported ALTER PROPERTY attribute {attr}")
+    return [Result(props={"operation": "alter property"})]

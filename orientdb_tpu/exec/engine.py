@@ -1,25 +1,155 @@
-"""Query engine entry points (wired from Database.query/command/explain).
+"""Query engine front door.
 
-Placeholder until the SQL front door (parser + oracle + TPU engine) lands;
-keeping the module importable gives a clear error instead of an import crash.
+Analog of the reference's query dispatch ([E]
+ODatabaseDocumentEmbedded.query/command → OStatementCache →
+planner → step chain; SURVEY.md §3.2): parses (with a statement cache),
+routes idempotent statements to an execution engine, and wraps rows in a
+ResultSet.
+
+Engine selection (the north star's per-session ``TRAVERSE_ENGINE`` switch):
+- ``engine="oracle"`` — the pure-Python reference interpreter (parity oracle);
+- ``engine="tpu"`` — the compiled batched engine over the attached snapshot
+  (MATCH/TRAVERSE/SELECT subset); falls back to the oracle for statements it
+  cannot compile unless ``strict=True``;
+- ``engine="auto"`` (default, from config.traverse_engine) — tpu when a
+  fresh snapshot is attached, oracle otherwise.
 """
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
 
-def execute_query(db, sql, params, **kw):
-    raise NotImplementedError(
-        "the SQL engine is not built yet (parser/oracle land next milestone)"
+from orientdb_tpu.exec.result import ResultSet
+from orientdb_tpu.sql import ast as A
+from orientdb_tpu.sql.parser import parse
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("engine")
+
+_ENGINES = ("auto", "tpu", "oracle")
+
+# statement cache ([E] OStatementCache): sql text → AST. AST nodes are
+# frozen dataclasses, so sharing across threads is safe; the cache dict
+# itself needs the lock.
+_stmt_cache: "OrderedDict[str, A.Statement]" = OrderedDict()
+_stmt_cache_lock = threading.Lock()
+
+
+def parse_cached(sql: str) -> A.Statement:
+    with _stmt_cache_lock:
+        stmt = _stmt_cache.get(sql)
+        if stmt is not None:
+            _stmt_cache.move_to_end(sql)
+            return stmt
+    stmt = parse(sql)
+    with _stmt_cache_lock:
+        _stmt_cache[sql] = stmt
+        while len(_stmt_cache) > config.statement_cache_size:
+            _stmt_cache.popitem(last=False)
+    return stmt
+
+
+def _normalize_params(params) -> Dict:
+    if params is None:
+        return {}
+    if isinstance(params, dict):
+        return params
+    # positional list → {0: v0, 1: v1, …}
+    return {i: v for i, v in enumerate(params)}
+
+
+def _choose_engine(db, stmt: A.Statement, engine: Optional[str]) -> str:
+    eng = engine or config.traverse_engine
+    if eng not in _ENGINES:
+        raise ValueError(f"unknown engine {eng!r}; expected one of {_ENGINES}")
+    if eng == "auto":
+        if db.current_snapshot(require_fresh=True) is not None and isinstance(
+            stmt, (A.MatchStatement, A.TraverseStatement)
+        ):
+            return "tpu"
+        return "oracle"
+    return eng
+
+
+def _run(db, stmt: A.Statement, params, engine: Optional[str], strict: bool):
+    eng = _choose_engine(db, stmt, engine)
+    if eng == "tpu":
+        from orientdb_tpu.exec import tpu_engine
+
+        try:
+            return tpu_engine.execute(db, stmt, params), "tpu"
+        except tpu_engine.Uncompilable as e:
+            if strict:
+                raise
+            log.info("tpu engine fallback to oracle: %s", e)
+    from orientdb_tpu.exec.oracle import execute_statement
+
+    return execute_statement(db, stmt, params), "oracle"
+
+
+def _result_set(rows, engine_used: str) -> ResultSet:
+    rs = ResultSet(rows)
+    rs.engine = engine_used  # type: ignore[attr-defined]
+    return rs
+
+
+def execute_query(
+    db,
+    sql: str,
+    params=None,
+    engine: Optional[str] = None,
+    strict: bool = False,
+) -> ResultSet:
+    """Idempotent statements only ([E] ODatabaseSession.query contract).
+    PROFILE executes its inner statement, so a PROFILE of a write is
+    rejected here too."""
+    stmt = parse_cached(sql)
+    if isinstance(stmt, A.ExplainStatement):
+        inner_writes = stmt.profile and not stmt.inner.is_idempotent
+        if inner_writes:
+            raise ValueError(
+                "cannot PROFILE a non-idempotent statement via query(); use command()"
+            )
+        return explain_statement(db, stmt, _normalize_params(params))
+    if not stmt.is_idempotent:
+        raise ValueError(
+            f"cannot run non-idempotent {type(stmt).__name__} via query(); use command()"
+        )
+    rows, used = _run(db, stmt, _normalize_params(params), engine, strict)
+    return _result_set(rows, used)
+
+
+def execute_command(
+    db,
+    sql: str,
+    params=None,
+    engine: Optional[str] = None,
+    strict: bool = False,
+) -> ResultSet:
+    stmt = parse_cached(sql)
+    if isinstance(stmt, A.ExplainStatement):
+        return explain_statement(db, stmt, _normalize_params(params))
+    if stmt.is_idempotent:
+        rows, used = _run(db, stmt, _normalize_params(params), engine, strict)
+        return _result_set(rows, used)
+    from orientdb_tpu.exec.oracle import execute_statement
+
+    return _result_set(
+        execute_statement(db, stmt, _normalize_params(params)), "oracle"
     )
 
 
-def execute_command(db, sql, params, **kw):
-    raise NotImplementedError(
-        "the SQL engine is not built yet (parser/oracle land next milestone)"
-    )
+def explain(db, sql: str, params=None) -> ResultSet:
+    stmt = parse_cached(sql)
+    if not isinstance(stmt, A.ExplainStatement):
+        stmt = A.ExplainStatement(stmt, profile=False)
+    return explain_statement(db, stmt, _normalize_params(params))
 
 
-def explain(db, sql, params):
-    raise NotImplementedError(
-        "the SQL engine is not built yet (parser/oracle land next milestone)"
-    )
+def explain_statement(db, stmt: A.ExplainStatement, params) -> ResultSet:
+    from orientdb_tpu.exec.planner import explain_plan
+
+    return explain_plan(db, stmt, params)

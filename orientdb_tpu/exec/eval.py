@@ -1,0 +1,690 @@
+"""Expression evaluator (host side).
+
+Analog of the reference's interpreted expression tree ([E]
+core/.../sql/executor + OSQLFunction* / OSQLMethod* registries): evaluates
+the AST of `orientdb_tpu/sql/ast.py` against one record/row at a time. This
+is the *oracle* semantics definition — the TPU predicate compiler
+(`orientdb_tpu/ops/predicates.py`) must agree with it on the columnar subset
+(numeric/string comparisons, boolean logic, arithmetic), which parity tests
+enforce.
+
+Null semantics follow OrientDB: any comparison with null is false (only
+IS NULL / IS NOT NULL see nulls); arithmetic with null yields null;
+AND/OR use three-valued-ish collapse where null acts as false.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterable, List, Optional
+
+from orientdb_tpu.models.record import Document, Edge, Vertex, Direction
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.sql import ast as A
+
+
+class EvalError(Exception):
+    pass
+
+
+class EvalContext:
+    """Evaluation scope: current record/row, query params, $variables.
+
+    `current` may be a Document, a dict-like row, or a plain value (inside
+    method chains). `variables` holds LET results and MATCH context
+    ($matched, $depth, $path…). `parent` chains nested scopes (subqueries,
+    traversal)."""
+
+    __slots__ = ("db", "current", "params", "variables", "parent")
+
+    def __init__(self, db, current=None, params=None, variables=None, parent=None):
+        self.db = db
+        self.current = current
+        self.params = params or {}
+        self.variables: Dict[str, object] = variables or {}
+        self.parent: Optional[EvalContext] = parent
+
+    def child(self, current=None, variables=None) -> "EvalContext":
+        return EvalContext(
+            self.db,
+            current=current if current is not None else self.current,
+            params=self.params,
+            variables=variables if variables is not None else {},
+            parent=self,
+        )
+
+    def lookup_var(self, name: str):
+        ctx: Optional[EvalContext] = self
+        while ctx is not None:
+            if name in ctx.variables:
+                return ctx.variables[name]
+            ctx = ctx.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        ctx: Optional[EvalContext] = self
+        while ctx is not None:
+            if name in ctx.variables:
+                return True
+            ctx = ctx.parent
+        return False
+
+
+# ---------------------------------------------------------------------------
+# value helpers
+# ---------------------------------------------------------------------------
+
+
+def get_prop(obj, name: str):
+    """Property access on whatever the executor passes around."""
+    if obj is None:
+        return None
+    if isinstance(obj, Document):
+        v = obj.get(name)
+        # OrientDB resolves link fields transparently on chained access.
+        return v
+    if isinstance(obj, dict):
+        if name in obj:
+            return obj[name]
+        if name.startswith("@"):
+            return obj.get(name)
+        return None
+    # Result rows
+    from orientdb_tpu.exec.result import Result
+
+    if isinstance(obj, Result):
+        return obj.get_property(name)
+    if isinstance(obj, (list, tuple)):
+        # field access over a collection maps over items (OrientDB behavior
+        # for e.g. out('E').name)
+        out = []
+        for item in obj:
+            v = get_prop(item, name)
+            if isinstance(v, (list, tuple)):
+                out.extend(v)
+            elif v is not None:
+                out.append(v)
+        return out
+    return None
+
+
+def resolve_links(ctx: EvalContext, value):
+    """RIDs → records, lists thereof (for chained navigation)."""
+    if isinstance(value, RID):
+        return ctx.db.load(value)
+    if isinstance(value, (list, tuple)):
+        return [resolve_links(ctx, v) for v in value]
+    return value
+
+
+def is_collection(v) -> bool:
+    return isinstance(v, (list, tuple, set))
+
+
+def as_list(v) -> List[object]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple, set)):
+        return list(v)
+    return [v]
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def compare(a, b) -> Optional[int]:
+    """3-way compare; None if incomparable (null or type mismatch)."""
+    if a is None or b is None:
+        return None
+    if isinstance(a, Document):
+        a = a.rid
+    if isinstance(b, Document):
+        b = b.rid
+    if isinstance(a, RID) and isinstance(b, RID):
+        return (a > b) - (a < b)
+    if isinstance(a, bool) or isinstance(b, bool):
+        if isinstance(a, bool) and isinstance(b, bool):
+            return (a > b) - (a < b)
+        return None
+    if _numeric(a) and _numeric(b):
+        return (a > b) - (a < b)
+    if isinstance(a, str) and isinstance(b, str):
+        return (a > b) - (a < b)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        for x, y in zip(a, b):
+            c = compare(x, y)
+            if c is None:
+                return None
+            if c != 0:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    return None
+
+
+def values_equal(a, b) -> bool:
+    if a is None or b is None:
+        return False
+    c = compare(a, b)
+    if c is not None:
+        return c == 0
+    return a == b
+
+
+def like_match(value, pattern) -> bool:
+    if not isinstance(value, str) or not isinstance(pattern, str):
+        return False
+    rx = re.escape(pattern).replace("%", ".*").replace("_", ".")
+    return re.fullmatch(rx, value, flags=re.DOTALL) is not None
+
+
+# ---------------------------------------------------------------------------
+# graph navigation helpers (shared with oracle)
+# ---------------------------------------------------------------------------
+
+_DIRS = {"out": Direction.OUT, "in": Direction.IN, "both": Direction.BOTH}
+
+
+def nav_vertices(ctx: EvalContext, base, direction: str, classes) -> List[Vertex]:
+    out: List[Vertex] = []
+    for item in as_list(resolve_links(ctx, base)):
+        if isinstance(item, Vertex):
+            if classes:
+                for cname in classes:
+                    out.extend(item.vertices(_DIRS[direction], cname))
+            else:
+                out.extend(item.vertices(_DIRS[direction]))
+        elif isinstance(item, Edge):
+            # out()/in() on an edge → endpoint vertex (outV/inV semantics)
+            if direction == "out":
+                out.append(item.from_vertex())
+            elif direction == "in":
+                out.append(item.to_vertex())
+            else:
+                out.extend([item.from_vertex(), item.to_vertex()])
+    return out
+
+
+def nav_edges(ctx: EvalContext, base, direction: str, classes) -> List[Edge]:
+    out: List[Edge] = []
+    for item in as_list(resolve_links(ctx, base)):
+        if isinstance(item, Vertex):
+            if classes:
+                for cname in classes:
+                    out.extend(item.edges(_DIRS[direction], cname))
+            else:
+                out.extend(item.edges(_DIRS[direction]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SQL functions & methods
+# ---------------------------------------------------------------------------
+
+AGGREGATE_FUNCTIONS = {"count", "sum", "min", "max", "avg"}
+
+
+def _fn_coalesce(args):
+    for a in args:
+        if a is not None:
+            return a
+    return None
+
+
+def _check_finite(x):
+    return x
+
+
+_MATH_FNS = {
+    "abs": abs,
+    "sqrt": math.sqrt,
+    "floor": math.floor,
+    "ceil": math.ceil,
+    "exp": math.exp,
+    "log": math.log,
+}
+
+
+def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
+    """Non-aggregate function dispatch ([E] OSQLFunctionFactory)."""
+    name = name.lower()
+    if name in ("out", "in", "both", "oute", "ine", "bothe", "outv", "inv"):
+        classes = [evaluator(ctx, a) for a in arg_exprs]
+        base = ctx.current
+        if name in ("out", "in", "both"):
+            return nav_vertices(ctx, base, name, classes)
+        if name in ("oute", "ine", "bothe"):
+            return nav_edges(ctx, base, name[:-1], classes)
+        # outV/inV on edges
+        items = as_list(resolve_links(ctx, base))
+        res = []
+        for e in items:
+            if isinstance(e, Edge):
+                res.append(e.from_vertex() if name == "outv" else e.to_vertex())
+        return res
+    args = [evaluator(ctx, a) for a in arg_exprs]
+    if name == "coalesce" or name == "ifnull":
+        return _fn_coalesce(args)
+    if name == "if":
+        return args[1] if args[0] else (args[2] if len(args) > 2 else None)
+    if name == "format":
+        return str(args[0]) % tuple(args[1:]) if len(args) > 1 else str(args[0])
+    if name == "concat":
+        return "".join("" if a is None else str(a) for a in args)
+    if name == "first":
+        lst = as_list(args[0])
+        return lst[0] if lst else None
+    if name == "last":
+        lst = as_list(args[0])
+        return lst[-1] if lst else None
+    if name == "size":
+        return len(as_list(args[0]))
+    if name == "distinct":
+        seen, out = set(), []
+        for v in as_list(args[0]):
+            k = str(v.rid) if isinstance(v, Document) else repr(v)
+            if k not in seen:
+                seen.add(k)
+                out.append(v)
+        return out
+    if name == "unionall":
+        out = []
+        for a in args:
+            out.extend(as_list(a))
+        return out
+    if name == "intersect":
+        sets = [as_list(a) for a in args]
+        if not sets:
+            return []
+        out = sets[0]
+        for s in sets[1:]:
+            out = [v for v in out if any(values_equal(v, w) or v is w for w in s)]
+        return out
+    if name == "difference":
+        if not args:
+            return []
+        out = as_list(args[0])
+        for s in args[1:]:
+            sl = as_list(s)
+            out = [v for v in out if not any(values_equal(v, w) or v is w for w in sl)]
+        return out
+    if name in ("list", "set"):
+        vals = []
+        for a in args:
+            vals.extend(as_list(a))
+        if name == "set":
+            seen, out = set(), []
+            for v in vals:
+                k = str(v.rid) if isinstance(v, Document) else repr(v)
+                if k not in seen:
+                    seen.add(k)
+                    out.append(v)
+            return out
+        return vals
+    if name == "map":
+        return {str(args[i]): args[i + 1] for i in range(0, len(args) - 1, 2)}
+    if name in _MATH_FNS:
+        return None if args[0] is None else _MATH_FNS[name](args[0])
+    if name == "date":
+        return args[0]
+    if name == "sysdate":
+        import datetime
+
+        return datetime.datetime.now().isoformat()
+    if name == "uuid":
+        import uuid as _uuid
+
+        return str(_uuid.uuid4())
+    if name == "expand":
+        # expand() outside projections behaves as identity on the collection
+        return args[0]
+    raise EvalError(f"unknown function '{name}'")
+
+
+def eval_method(ctx: EvalContext, base, name: str, args) -> object:
+    """`value.method(args)` dispatch ([E] OSQLMethodFactory subset)."""
+    m = name.lower()
+    if m in ("out", "in", "both"):
+        return nav_vertices(ctx, base, m, args)
+    if m in ("oute", "ine", "bothe"):
+        return nav_edges(ctx, base, m[:-1], args)
+    if m == "outv":
+        return [e.from_vertex() for e in as_list(base) if isinstance(e, Edge)]
+    if m == "inv":
+        return [e.to_vertex() for e in as_list(base) if isinstance(e, Edge)]
+    if m == "size":
+        if base is None:
+            return 0
+        return len(as_list(base)) if not isinstance(base, (str, dict)) else len(base)
+    if m == "length":
+        return len(base) if isinstance(base, (str, list, tuple)) else None
+    if base is None:
+        return None
+    if m == "tolowercase":
+        return str(base).lower()
+    if m == "touppercase":
+        return str(base).upper()
+    if m == "trim":
+        return str(base).strip()
+    if m == "asstring":
+        return str(base.rid) if isinstance(base, Document) else str(base)
+    if m == "asinteger":
+        try:
+            return int(float(base))
+        except (TypeError, ValueError):
+            return None
+    if m == "asfloat":
+        try:
+            return float(base)
+        except (TypeError, ValueError):
+            return None
+    if m == "asboolean":
+        if isinstance(base, str):
+            return base.lower() == "true"
+        return bool(base)
+    if m == "aslist":
+        return as_list(base)
+    if m == "asset":
+        return list(dict.fromkeys(as_list(base)))
+    if m == "substring":
+        s = str(base)
+        if len(args) == 1:
+            return s[int(args[0]) :]
+        return s[int(args[0]) : int(args[1])]
+    if m == "left":
+        return str(base)[: int(args[0])]
+    if m == "right":
+        return str(base)[-int(args[0]) :]
+    if m == "charat":
+        s = str(base)
+        i = int(args[0])
+        return s[i] if 0 <= i < len(s) else None
+    if m == "indexof":
+        return str(base).find(str(args[0]))
+    if m == "split":
+        return str(base).split(str(args[0]))
+    if m == "replace":
+        return str(base).replace(str(args[0]), str(args[1]))
+    if m == "append":
+        return str(base) + str(args[0])
+    if m == "prefix":
+        return str(args[0]) + str(base)
+    if m == "keys":
+        return list(base.keys()) if isinstance(base, dict) else (
+            base.field_names() if isinstance(base, Document) else None
+        )
+    if m == "values":
+        return list(base.values()) if isinstance(base, dict) else None
+    if m == "type":
+        return type(base).__name__
+    if m == "javatype":
+        return type(base).__name__
+    if m == "field":
+        return get_prop(base, str(args[0]))
+    if m == "format":
+        return format(base, str(args[0])) if args else str(base)
+    if m == "include":
+        if isinstance(base, Document):
+            return {k: base.get(k) for k in map(str, args)}
+        return base
+    if m == "exclude":
+        if isinstance(base, Document):
+            d = base.to_dict()
+            for k in map(str, args):
+                d.pop(k, None)
+            return d
+        return base
+    raise EvalError(f"unknown method '{name}'")
+
+
+# ---------------------------------------------------------------------------
+# the evaluator
+# ---------------------------------------------------------------------------
+
+
+def evaluate(ctx: EvalContext, expr: A.Expression):
+    if isinstance(expr, A.Literal):
+        return expr.value
+    if isinstance(expr, A.Star):
+        return ctx.current
+    if isinstance(expr, A.RIDLiteral):
+        return RID(expr.cluster, expr.position)
+    if isinstance(expr, A.Parameter):
+        if expr.name is not None:
+            if expr.name not in ctx.params:
+                raise EvalError(f"missing parameter :{expr.name}")
+            return ctx.params[expr.name]
+        try:
+            return ctx.params[expr.index]
+        except (KeyError, IndexError):
+            raise EvalError(f"missing positional parameter ?{expr.index}")
+    if isinstance(expr, A.ContextVar):
+        name = expr.name
+        if name == "current":
+            # nearest non-None current up the scope chain (a subquery's
+            # FROM $current resolves before the subquery has rows)
+            c: Optional[EvalContext] = ctx
+            while c is not None:
+                if c.current is not None:
+                    return c.current
+                c = c.parent
+            return None
+        if name == "parent":
+            return ctx.parent
+        if ctx.has_var(name):
+            return ctx.lookup_var(name)
+        return None
+    if isinstance(expr, A.Identifier):
+        name = expr.name
+        # identifier resolution order: bound variable (MATCH alias / LET),
+        # then field of current record
+        if ctx.has_var(name):
+            return ctx.lookup_var(name)
+        return get_prop(ctx.current, name)
+    if isinstance(expr, A.ListExpr):
+        return [evaluate(ctx, e) for e in expr.items]
+    if isinstance(expr, A.MapExpr):
+        return {k: evaluate(ctx, v) for k, v in expr.pairs}
+    if isinstance(expr, A.FieldAccess):
+        base = evaluate(ctx, expr.base)
+        base = resolve_links(ctx, base)
+        return get_prop(base, expr.name)
+    if isinstance(expr, A.IndexAccess):
+        base = evaluate(ctx, expr.base)
+        idx = evaluate(ctx, expr.index)
+        if base is None:
+            return None
+        try:
+            if isinstance(base, dict):
+                return base.get(idx)
+            return as_list(base)[int(idx)]
+        except (IndexError, TypeError, ValueError):
+            return None
+    if isinstance(expr, A.MethodCall):
+        base = evaluate(ctx, expr.base)
+        args = [evaluate(ctx, a) for a in expr.args]
+        return eval_method(ctx, resolve_links(ctx, base), expr.name, args)
+    if isinstance(expr, A.FunctionCall):
+        if expr.name == "$subquery":
+            from orientdb_tpu.exec.oracle import execute_statement
+
+            sub = expr.args[0].value  # type: ignore[union-attr]
+            rows = execute_statement(ctx.db, sub, ctx.params, parent_ctx=ctx)
+            out = []
+            for r in rows:
+                out.append(r.element if r.is_element else r)
+            return out
+        if expr.name in AGGREGATE_FUNCTIONS:
+            raise EvalError(
+                f"aggregate {expr.name}() outside aggregation context"
+            )
+        return eval_function(ctx, expr.name, expr.args, evaluate)
+    if isinstance(expr, A.Unary):
+        v = evaluate(ctx, expr.expr)
+        if expr.op == "NOT":
+            return not truthy(v)
+        if v is None:
+            return None
+        return -v if expr.op == "-" else +v
+    if isinstance(expr, A.Between):
+        v = evaluate(ctx, expr.expr)
+        lo = evaluate(ctx, expr.low)
+        hi = evaluate(ctx, expr.high)
+        c1 = compare(v, lo)
+        c2 = compare(v, hi)
+        return c1 is not None and c2 is not None and c1 >= 0 and c2 <= 0
+    if isinstance(expr, A.IsNull):
+        v = evaluate(ctx, expr.expr)
+        return (v is not None) if expr.negated else (v is None)
+    if isinstance(expr, A.IsDefined):
+        defined = False
+        e = expr.expr
+        if isinstance(e, A.Identifier) and isinstance(ctx.current, Document):
+            defined = e.name in ctx.current or e.name.startswith("@")
+        elif isinstance(e, A.FieldAccess):
+            base = resolve_links(ctx, evaluate(ctx, e.base))
+            if isinstance(base, Document):
+                defined = e.name in base
+            elif isinstance(base, dict):
+                defined = e.name in base
+        else:
+            defined = evaluate(ctx, e) is not None
+        return (not defined) if expr.negated else defined
+    if isinstance(expr, A.Binary):
+        return eval_binary(ctx, expr)
+    raise EvalError(f"cannot evaluate {expr!r}")
+
+
+def truthy(v) -> bool:
+    if v is None:
+        return False
+    if isinstance(v, bool):
+        return v
+    # OrientDB: non-boolean where results are not truthy-coerced; be strict
+    # for numbers/strings but allow non-empty collection semantics for IN-ish
+    if isinstance(v, (list, tuple, set)):
+        return len(v) > 0
+    return bool(v)
+
+
+def eval_binary(ctx: EvalContext, expr: A.Binary):
+    op = expr.op
+    if op == "AND":
+        return truthy(evaluate(ctx, expr.left)) and truthy(evaluate(ctx, expr.right))
+    if op == "OR":
+        return truthy(evaluate(ctx, expr.left)) or truthy(evaluate(ctx, expr.right))
+    left = evaluate(ctx, expr.left)
+    right = evaluate(ctx, expr.right)
+    if op == "=":
+        if isinstance(left, Document) or isinstance(right, Document) or isinstance(
+            left, RID
+        ) or isinstance(right, RID):
+            lr = left.rid if isinstance(left, Document) else left
+            rr = right.rid if isinstance(right, Document) else right
+            return lr == rr
+        return values_equal(left, right)
+    if op == "!=":
+        if left is None or right is None:
+            return False
+        lr = left.rid if isinstance(left, Document) else left
+        rr = right.rid if isinstance(right, Document) else right
+        if isinstance(lr, RID) or isinstance(rr, RID):
+            return lr != rr
+        return not values_equal(left, right)
+    if op in ("<", "<=", ">", ">="):
+        c = compare(left, right)
+        if c is None:
+            return False
+        return {"<": c < 0, "<=": c <= 0, ">": c > 0, ">=": c >= 0}[op]
+    if op == "LIKE":
+        return like_match(left, right)
+    if op == "MATCHES":
+        if not isinstance(left, str) or not isinstance(right, str):
+            return False
+        return re.fullmatch(right, left) is not None
+    if op == "IN":
+        items = as_list(right)
+        if isinstance(left, Document) or isinstance(left, RID):
+            lrid = left.rid if isinstance(left, Document) else left
+            for it in items:
+                irid = it.rid if isinstance(it, Document) else it
+                if irid == lrid:
+                    return True
+            return False
+        return any(values_equal(left, it) for it in items)
+    if op == "CONTAINS":
+        items = as_list(left)
+        if isinstance(right, Document) or isinstance(right, RID):
+            rrid = right.rid if isinstance(right, Document) else right
+            return any(
+                (it.rid if isinstance(it, Document) else it) == rrid for it in items
+            )
+        return any(values_equal(it, right) for it in items)
+    if op == "CONTAINSANY":
+        items = as_list(left)
+        return any(any(values_equal(it, r) for it in items) for r in as_list(right))
+    if op == "CONTAINSALL":
+        items = as_list(left)
+        return all(any(values_equal(it, r) for it in items) for r in as_list(right))
+    if op == "CONTAINSKEY":
+        return isinstance(left, dict) and right in left
+    if op == "CONTAINSVALUE":
+        return isinstance(left, dict) and any(
+            values_equal(v, right) for v in left.values()
+        )
+    if op == "CONTAINSTEXT":
+        return isinstance(left, str) and isinstance(right, str) and right in left
+    if op == "INSTANCEOF":
+        name = right if isinstance(right, str) else str(right)
+        if isinstance(left, Document):
+            cls = ctx.db.schema.get_class(left.class_name)
+            return cls is not None and cls.is_subclass_of(name)
+        return False
+    if op in ("+", "-", "*", "/", "%", "||"):
+        if op == "||" or (op == "+" and (isinstance(left, str) or isinstance(right, str))):
+            if left is None or right is None:
+                return None
+            return str(left) + str(right)
+        if op == "+" and is_collection(left):
+            return as_list(left) + as_list(right)
+        if left is None or right is None:
+            return None
+        if not (_numeric(left) and _numeric(right)):
+            raise EvalError(f"non-numeric operands for {op}: {left!r}, {right!r}")
+        if op == "+":
+            return left + right
+        if op == "-":
+            return left - right
+        if op == "*":
+            return left * right
+        if op == "/":
+            if right == 0:
+                return None
+            # OrientDB integer division stays integral
+            if isinstance(left, int) and isinstance(right, int):
+                return left // right if left % right == 0 else left / right
+            return left / right
+        if op == "%":
+            return left % right if right != 0 else None
+    raise EvalError(f"unknown operator {op}")
+
+
+def contains_aggregate(expr: A.Expression) -> bool:
+    if isinstance(expr, A.FunctionCall):
+        if expr.name in AGGREGATE_FUNCTIONS:
+            return True
+        return any(contains_aggregate(a) for a in expr.args)
+    if isinstance(expr, A.Binary):
+        return contains_aggregate(expr.left) or contains_aggregate(expr.right)
+    if isinstance(expr, A.Unary):
+        return contains_aggregate(expr.expr)
+    if isinstance(expr, (A.FieldAccess,)):
+        return contains_aggregate(expr.base)
+    if isinstance(expr, A.MethodCall):
+        return contains_aggregate(expr.base) or any(
+            contains_aggregate(a) for a in expr.args
+        )
+    if isinstance(expr, A.IndexAccess):
+        return contains_aggregate(expr.base) or contains_aggregate(expr.index)
+    return False

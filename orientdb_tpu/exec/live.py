@@ -1,0 +1,15 @@
+"""Live queries (LIVE SELECT).
+
+Placeholder until the live-query hook system lands (analog of [E]
+OLiveQueryHookV2 / ORecordHook, SURVEY.md §2 "Live queries / hooks").
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from orientdb_tpu.exec.result import Result
+
+
+def subscribe(db, stmt, params) -> List[Result]:
+    raise NotImplementedError("live queries are not implemented yet")

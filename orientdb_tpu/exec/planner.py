@@ -1,0 +1,231 @@
+"""Execution planning + EXPLAIN/PROFILE.
+
+Analog of [E] OSelectExecutionPlanner / OMatchExecutionPlanner +
+OExecutionStepInternal.prettyPrint (SURVEY.md §5.1: the EXPLAIN plan dump is
+the parity debugging tool). The host oracle executes the AST directly; this
+module renders the plan the engines follow — the MATCH expansion order
+computed here is ALSO the order `exec/tpu_engine.py` compiles, so EXPLAIN
+reflects the real TPU schedule.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from orientdb_tpu.exec.result import Result, ResultSet
+from orientdb_tpu.sql import ast as A
+
+
+class PlanStep:
+    """[E] OExecutionStepInternal surface: name, detail, children, cost."""
+
+    def __init__(self, name: str, detail: str = "", cost: float = -1.0) -> None:
+        self.name = name
+        self.detail = detail
+        self.cost = cost  # microseconds when profiled; -1 unknown
+        self.children: List["PlanStep"] = []
+
+    def add(self, child: "PlanStep") -> "PlanStep":
+        self.children.append(child)
+        return child
+
+    def pretty(self, depth: int = 0) -> str:
+        pad = "  " * depth
+        cost = f" (cost≈{self.cost:.0f}µs)" if self.cost >= 0 else ""
+        line = f"{pad}+ {self.name}{': ' + self.detail if self.detail else ''}{cost}"
+        return "\n".join([line] + [c.pretty(depth + 1) for c in self.children])
+
+
+# ---------------------------------------------------------------------------
+# MATCH planning (shared with the TPU compiler)
+# ---------------------------------------------------------------------------
+
+
+def order_match_edges(db, stmt: A.MatchStatement):
+    """Greedy smallest-candidate-first expansion order ([E]
+    OMatchExecutionPlanner.createExecutionPlan): returns (pattern,
+    ordered edges, root alias)."""
+    from orientdb_tpu.exec.oracle import MatchInterpreter
+
+    interp = MatchInterpreter(db, stmt, {})
+    pattern = interp.pattern
+    edges = [e for e in pattern.edges]
+    if not edges:
+        roots = [n.alias for n in pattern.nodes.values() if n.filters]
+        return pattern, [], roots
+    # estimate each alias, pick cheapest as root, BFS outward
+    est = {a: interp.estimate(n) for a, n in pattern.nodes.items()}
+    ordered = []
+    bound = set()
+    remaining = list(edges)
+    roots: List[str] = []
+    while remaining:
+        candidates = [
+            e for e in remaining if e.from_alias in bound or e.to_alias in bound
+        ]
+        if not candidates:
+            # new component: root at the smallest-estimate alias in it
+            comp_aliases = {a for e in remaining for a in (e.from_alias, e.to_alias)}
+            root = min(comp_aliases, key=lambda a: est.get(a, 1 << 60))
+            roots.append(root)
+            bound.add(root)
+            continue
+        # prefer edges whose unbound endpoint is cheapest
+        def rank(e):
+            fb, tb = e.from_alias in bound, e.to_alias in bound
+            if fb and tb:
+                return (0, 0)
+            other = e.to_alias if fb else e.from_alias
+            return (1, est.get(other, 1 << 60))
+
+        e = min(candidates, key=rank)
+        remaining.remove(e)
+        ordered.append(e)
+        bound.add(e.from_alias)
+        bound.add(e.to_alias)
+    return pattern, ordered, roots
+
+
+# ---------------------------------------------------------------------------
+# plan rendering
+# ---------------------------------------------------------------------------
+
+
+def build_plan(db, stmt: A.Statement, engine: str = "oracle") -> PlanStep:
+    if isinstance(stmt, A.MatchStatement):
+        return _match_plan(db, stmt, engine)
+    if isinstance(stmt, A.SelectStatement):
+        return _select_plan(db, stmt)
+    if isinstance(stmt, A.TraverseStatement):
+        root = PlanStep("TRAVERSE", f"strategy={stmt.strategy}")
+        root.add(PlanStep("FetchTargets", _target_str(stmt.target)))
+        if stmt.while_cond is not None:
+            root.add(PlanStep("While", "gate traversal on condition"))
+        if stmt.max_depth is not None:
+            root.add(PlanStep("MaxDepth", str(stmt.max_depth)))
+        return root
+    return PlanStep(type(stmt).__name__.replace("Statement", "").upper())
+
+
+def _target_str(target: Optional[A.Target]) -> str:
+    if target is None:
+        return "(none)"
+    if isinstance(target, A.ClassTarget):
+        return f"class {target.name}"
+    if isinstance(target, A.ClusterTarget):
+        return f"cluster {target.name_or_id}"
+    if isinstance(target, A.RidTarget):
+        return ",".join(f"#{r.cluster}:{r.position}" for r in target.rids)
+    if isinstance(target, A.IndexTarget):
+        return f"index {target.name}"
+    if isinstance(target, A.SubQueryTarget):
+        return "(subquery)"
+    return "(expression)"
+
+
+def _select_plan(db, stmt: A.SelectStatement) -> PlanStep:
+    root = PlanStep("SELECT")
+    fetch = PlanStep("FetchFromTarget", _target_str(stmt.target))
+    # index-accelerated scan detection ([E] the planner's index-vs-scan
+    # choice, SURVEY.md §3.2)
+    if isinstance(stmt.target, A.ClassTarget) and stmt.where is not None:
+        idx_field = _indexable_eq_field(db, stmt.target.name, stmt.where)
+        if idx_field:
+            fetch = PlanStep("FetchFromIndex", f"{stmt.target.name}.{idx_field}")
+    root.add(fetch)
+    if stmt.lets:
+        root.add(PlanStep("Let", ", ".join(f"${l.name}" for l in stmt.lets)))
+    if stmt.where is not None:
+        root.add(PlanStep("Filter", "WHERE"))
+    if stmt.group_by:
+        root.add(PlanStep("Aggregate", f"group by {len(stmt.group_by)} key(s)"))
+    if stmt.projections:
+        root.add(PlanStep("Projection", f"{len(stmt.projections)} column(s)"))
+    for u in stmt.unwind:
+        root.add(PlanStep("Unwind", u))
+    if stmt.order_by:
+        root.add(PlanStep("OrderBy", f"{len(stmt.order_by)} key(s)"))
+    if stmt.skip is not None:
+        root.add(PlanStep("Skip"))
+    if stmt.limit is not None:
+        root.add(PlanStep("Limit"))
+    return root
+
+
+def _indexable_eq_field(db, class_name: str, where: A.Expression) -> Optional[str]:
+    if isinstance(where, A.Binary):
+        if where.op == "=" and isinstance(where.left, A.Identifier):
+            idx = db.indexes.best_for(class_name, where.left.name)
+            if idx is not None:
+                return where.left.name
+        if where.op == "AND":
+            return _indexable_eq_field(db, class_name, where.left) or _indexable_eq_field(
+                db, class_name, where.right
+            )
+    return None
+
+
+def _match_plan(db, stmt: A.MatchStatement, engine: str) -> PlanStep:
+    pattern, ordered, roots = order_match_edges(db, stmt)
+    root = PlanStep("MATCH", f"engine={engine}")
+    if roots:
+        root.add(PlanStep("MatchFirst", f"root alias(es): {', '.join(roots)}"))
+    for e in ordered:
+        item = e.item
+        arrow = {"out": "-[{}]->", "in": "<-[{}]-", "both": "-[{}]-"}.get(
+            item.direction, ".{}()"
+        )
+        label = arrow.format(",".join(item.edge_classes) or "*")
+        detail = f"{e.from_alias} {label} {e.to_alias}"
+        extras = []
+        if item.target.while_cond is not None:
+            extras.append("while")
+        if item.target.max_depth is not None:
+            extras.append(f"maxDepth={item.target.max_depth}")
+        if item.target.optional:
+            extras.append("optional")
+        if item.edge_filter is not None and item.edge_filter.where is not None:
+            extras.append("edge-where")
+        if extras:
+            detail += f" [{', '.join(extras)}]"
+        name = "TpuBatchExpand" if engine == "tpu" else "MatchStep"
+        root.add(PlanStep(name, detail))
+    if any(p.negated for p in stmt.paths):
+        root.add(PlanStep("NotPatternFilter"))
+    if stmt.distinct:
+        root.add(PlanStep("Distinct"))
+    if stmt.group_by:
+        root.add(PlanStep("Aggregate"))
+    root.add(PlanStep("ReturnProjection", f"{len(stmt.returns)} column(s)"))
+    if stmt.order_by:
+        root.add(PlanStep("OrderBy"))
+    if stmt.limit is not None:
+        root.add(PlanStep("Limit"))
+    return root
+
+
+def explain_plan(db, stmt: A.ExplainStatement, params) -> ResultSet:
+    from orientdb_tpu.exec.engine import _choose_engine
+
+    inner = stmt.inner
+    engine = _choose_engine(db, inner, None)
+    plan = build_plan(db, inner, engine)
+    props: Dict[str, object] = {
+        "executionPlan": plan.pretty(),
+        "engine": engine,
+        "statement": type(inner).__name__,
+    }
+    if stmt.profile:
+        from orientdb_tpu.exec.oracle import execute_statement
+
+        t0 = time.perf_counter()
+        rows = execute_statement(db, inner, params)
+        elapsed = (time.perf_counter() - t0) * 1e6
+        plan.cost = elapsed
+        props["executionPlan"] = plan.pretty()
+        props["elapsedUs"] = elapsed
+        props["rows"] = len(rows)
+    rs = ResultSet([Result(props=props)])
+    rs.plan = plan
+    return rs

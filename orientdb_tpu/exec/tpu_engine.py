@@ -1,0 +1,13 @@
+"""Compiled TPU execution engine (placeholder — lands with the snapshot
+layer; see `orientdb_tpu/ops/` and SURVEY.md §7 step 3)."""
+
+from __future__ import annotations
+
+
+class Uncompilable(Exception):
+    """Statement (or feature) the TPU engine cannot compile; the front door
+    falls back to the oracle unless strict."""
+
+
+def execute(db, stmt, params):
+    raise Uncompilable("TPU engine not built yet")

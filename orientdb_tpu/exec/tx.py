@@ -1,0 +1,19 @@
+"""Transaction statements (BEGIN/COMMIT/ROLLBACK).
+
+Placeholder until the optimistic transaction manager lands (analog of [E]
+OTransactionOptimistic, SURVEY.md §3.4); the host store currently
+auto-commits every statement.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from orientdb_tpu.exec.result import Result
+from orientdb_tpu.sql import ast as A
+
+
+def execute_tx_statement(db, stmt) -> List[Result]:
+    raise NotImplementedError(
+        "explicit transactions are not implemented yet; statements auto-commit"
+    )

@@ -1,0 +1,3 @@
+from orientdb_tpu.sql.parser import parse, ParseError
+
+__all__ = ["parse", "ParseError"]

@@ -1,0 +1,279 @@
+"""SELECT / DML / DDL oracle tests ([E] OSelectStatementExecutionTest
+analog, SURVEY.md §4)."""
+
+import pytest
+
+from orientdb_tpu.models.record import Vertex
+
+
+def q(db, sql, **params):
+    return db.query(sql, params).to_dicts()
+
+
+def c(db, sql, **params):
+    return db.command(sql, params).to_dicts()
+
+
+class TestSelect:
+    def test_select_all(self, social_db):
+        rows = q(social_db, "SELECT FROM Profiles")
+        assert len(rows) == 5
+        assert all("@rid" in r for r in rows)
+
+    def test_where_filters(self, social_db):
+        rows = q(social_db, "SELECT name FROM Profiles WHERE age > 28")
+        assert sorted(r["name"] for r in rows) == ["alice", "carol", "dave"]
+
+    def test_projection_alias_and_arith(self, social_db):
+        rows = q(social_db, "SELECT name, age + 1 AS next FROM Profiles WHERE name = 'bob'")
+        assert rows == [{"name": "bob", "next": 26}]
+
+    def test_order_by_skip_limit(self, social_db):
+        rows = q(social_db, "SELECT name FROM Profiles ORDER BY age DESC SKIP 1 LIMIT 2")
+        assert [r["name"] for r in rows] == ["carol", "alice"]
+
+    def test_order_by_two_keys(self, db):
+        db.schema.create_vertex_class("T")
+        for grp, v in [(1, "b"), (2, "a"), (1, "a"), (2, "b")]:
+            db.new_vertex("T", g=grp, v=v)
+        rows = q(db, "SELECT g, v FROM T ORDER BY g ASC, v DESC")
+        assert [(r["g"], r["v"]) for r in rows] == [(1, "b"), (1, "a"), (2, "b"), (2, "a")]
+
+    def test_params(self, social_db):
+        rows = q(social_db, "SELECT name FROM Profiles WHERE age >= :minage", minage=30)
+        assert sorted(r["name"] for r in rows) == ["alice", "carol", "dave"]
+        rows = social_db.query(
+            "SELECT name FROM Profiles WHERE name = ?", ["eve"]
+        ).to_dicts()
+        assert rows == [{"name": "eve"}]
+
+    def test_rid_target(self, social_db):
+        alice = social_db._test_vertices["alice"]
+        rows = q(social_db, f"SELECT name FROM {alice.rid}")
+        assert rows == [{"name": "alice"}]
+
+    def test_out_navigation(self, social_db):
+        rows = q(social_db, "SELECT out('HasFriend').name AS friends FROM Profiles WHERE name = 'alice'")
+        assert sorted(rows[0]["friends"]) == ["bob", "carol"]
+
+    def test_expand(self, social_db):
+        rows = q(
+            social_db,
+            "SELECT expand(out('HasFriend')) FROM Profiles WHERE name = 'alice'",
+        )
+        assert sorted(r["name"] for r in rows) == ["bob", "carol"]
+
+    def test_in_both(self, social_db):
+        rows = q(social_db, "SELECT in('HasFriend').name AS f FROM Profiles WHERE name = 'carol'")
+        assert sorted(rows[0]["f"]) == ["alice", "bob"]
+        rows = q(social_db, "SELECT both('HasFriend').size() AS n FROM Profiles WHERE name = 'alice'")
+        assert rows[0]["n"] == 3
+
+    def test_count_star(self, social_db):
+        rows = q(social_db, "SELECT count(*) AS n FROM Profiles")
+        assert rows == [{"n": 5}]
+
+    def test_aggregates(self, social_db):
+        rows = q(social_db, "SELECT min(age) AS lo, max(age) AS hi, sum(age) AS s, avg(age) AS m FROM Profiles")
+        assert rows == [{"lo": 25, "hi": 40, "s": 158, "m": 158 / 5}]
+
+    def test_group_by(self, db):
+        db.schema.create_vertex_class("T")
+        for grp, x in [("a", 1), ("a", 2), ("b", 5)]:
+            db.new_vertex("T", g=grp, x=x)
+        rows = q(db, "SELECT g, sum(x) AS s FROM T GROUP BY g ORDER BY g")
+        assert rows == [{"g": "a", "s": 3}, {"g": "b", "s": 5}]
+
+    def test_aggregate_empty_input(self, social_db):
+        rows = q(social_db, "SELECT count(*) AS n FROM Profiles WHERE age > 1000")
+        assert rows == [{"n": 0}]
+
+    def test_subquery_target(self, social_db):
+        rows = q(
+            social_db,
+            "SELECT name FROM (SELECT FROM Profiles WHERE age > 28) WHERE name LIKE '%a%'",
+        )
+        assert sorted(r["name"] for r in rows) == ["alice", "carol", "dave"]
+
+    def test_let_subquery(self, social_db):
+        rows = q(
+            social_db,
+            "SELECT name, $f.size() AS nf FROM Profiles LET $f = (SELECT expand(out('HasFriend')) FROM $current) ORDER BY name",
+        )
+        by_name = {r["name"]: r["nf"] for r in rows}
+        assert by_name == {"alice": 2, "bob": 1, "carol": 1, "dave": 1, "eve": 1}
+
+    def test_like_between_in(self, social_db):
+        assert len(q(social_db, "SELECT FROM Profiles WHERE name LIKE '%ve'")) == 2  # dave? eve
+        rows = q(social_db, "SELECT name FROM Profiles WHERE age BETWEEN 28 AND 30 ORDER BY name")
+        assert [r["name"] for r in rows] == ["alice", "eve"]
+        rows = q(social_db, "SELECT name FROM Profiles WHERE name IN ['bob', 'eve'] ORDER BY name")
+        assert [r["name"] for r in rows] == ["bob", "eve"]
+
+    def test_null_comparisons(self, db):
+        db.schema.create_vertex_class("N")
+        db.new_vertex("N", a=1)
+        db.new_vertex("N")  # a missing
+        assert len(q(db, "SELECT FROM N WHERE a = 1")) == 1
+        assert len(q(db, "SELECT FROM N WHERE a != 1")) == 0  # null != 1 is false
+        assert len(q(db, "SELECT FROM N WHERE a IS NULL")) == 1
+        assert len(q(db, "SELECT FROM N WHERE a IS NOT NULL")) == 1
+
+    def test_unwind(self, db):
+        db.schema.create_vertex_class("U")
+        db.new_vertex("U", name="x", tags=["a", "b"])
+        rows = q(db, "SELECT name, tags FROM U UNWIND tags")
+        assert rows == [
+            {"name": "x", "tags": "a"},
+            {"name": "x", "tags": "b"},
+        ]
+
+    def test_methods(self, social_db):
+        rows = q(social_db, "SELECT name.toUpperCase() AS u FROM Profiles WHERE name = 'eve'")
+        assert rows == [{"u": "EVE"}]
+
+    def test_index_target(self, social_db):
+        social_db.indexes.create_index("Profiles.name", "Profiles", ["name"], "UNIQUE")
+        rows = q(social_db, "SELECT key FROM INDEX:Profiles.name")
+        assert [r["key"] for r in rows] == ["alice", "bob", "carol", "dave", "eve"]
+
+    def test_select_no_target(self, db):
+        rows = q(db, "SELECT 1 + 2 AS x")
+        assert rows == [{"x": 3}]
+
+    def test_instanceof_and_class_attr(self, social_db):
+        rows = q(social_db, "SELECT name FROM V WHERE @class INSTANCEOF 'V' AND name = 'eve'")
+        # @class is a string; INSTANCEOF on string right-hand works on docs:
+        # use the document form instead
+        rows = q(social_db, "SELECT name FROM V WHERE $current INSTANCEOF 'Profiles' AND name = 'eve'")
+        assert rows == [{"name": "eve"}]
+
+
+class TestDML:
+    def test_insert_and_select(self, db):
+        c(db, "CREATE CLASS Person EXTENDS V")
+        r = c(db, "INSERT INTO Person SET name = 'zed', age = 1")
+        assert r[0]["name"] == "zed"
+        assert q(db, "SELECT name FROM Person") == [{"name": "zed"}]
+
+    def test_insert_values_and_content(self, db):
+        c(db, "CREATE CLASS P")
+        c(db, "INSERT INTO P (a, b) VALUES (1, 2)")
+        c(db, 'INSERT INTO P CONTENT {"a": 9, "b": 8}')
+        rows = q(db, "SELECT a, b FROM P ORDER BY a")
+        assert rows == [{"a": 1, "b": 2}, {"a": 9, "b": 8}]
+
+    def test_create_vertex_edge_sql(self, db):
+        c(db, "CREATE CLASS Person EXTENDS V")
+        c(db, "CREATE CLASS Knows EXTENDS E")
+        a = c(db, "CREATE VERTEX Person SET name = 'a'")[0]["@rid"]
+        b = c(db, "CREATE VERTEX Person SET name = 'b'")[0]["@rid"]
+        c(db, f"CREATE EDGE Knows FROM {a} TO {b} SET w = 1")
+        rows = q(db, "SELECT out('Knows').name AS o FROM Person WHERE name = 'a'")
+        assert rows[0]["o"] == ["b"]
+
+    def test_create_edge_from_subqueries(self, db):
+        c(db, "CREATE CLASS Person EXTENDS V")
+        c(db, "CREATE CLASS Knows EXTENDS E")
+        c(db, "CREATE VERTEX Person SET name = 'a'")
+        c(db, "CREATE VERTEX Person SET name = 'b'")
+        c(
+            db,
+            "CREATE EDGE Knows FROM (SELECT FROM Person WHERE name='a') TO (SELECT FROM Person WHERE name='b')",
+        )
+        assert q(db, "SELECT count(*) AS n FROM Knows") == [{"n": 1}]
+
+    def test_update(self, social_db):
+        r = c(social_db, "UPDATE Profiles SET age = 31 WHERE name = 'alice'")
+        assert r == [{"count": 1}]
+        assert q(social_db, "SELECT age FROM Profiles WHERE name='alice'") == [{"age": 31}]
+
+    def test_update_increment_return_after(self, social_db):
+        r = c(social_db, "UPDATE Profiles INCREMENT age = 2 RETURN AFTER WHERE name = 'bob'")
+        assert r[0]["age"] == 27
+
+    def test_update_upsert(self, db):
+        c(db, "CREATE CLASS P")
+        c(db, "UPDATE P SET x = 1 UPSERT WHERE k = 'a'")
+        rows = q(db, "SELECT k, x FROM P")
+        assert rows == [{"k": "a", "x": 1}]
+        c(db, "UPDATE P SET x = 2 UPSERT WHERE k = 'a'")
+        rows = q(db, "SELECT k, x FROM P")
+        assert rows == [{"k": "a", "x": 2}]
+
+    def test_delete(self, social_db):
+        r = c(social_db, "DELETE VERTEX Profiles WHERE name = 'eve'")
+        assert r == [{"count": 1}]
+        assert len(q(social_db, "SELECT FROM Profiles")) == 4
+        # eve's two incident edges (dave->eve, eve->alice) are gone too
+        assert q(social_db, "SELECT count(*) AS n FROM HasFriend") == [{"n": 4}]
+
+    def test_delete_edge_from_to(self, social_db):
+        vs = social_db._test_vertices
+        r = c(
+            social_db,
+            f"DELETE EDGE HasFriend FROM {vs['alice'].rid} TO {vs['bob'].rid}",
+        )
+        assert r == [{"count": 1}]
+        rows = q(social_db, "SELECT out('HasFriend').name AS o FROM Profiles WHERE name='alice'")
+        assert rows[0]["o"] == ["carol"]
+
+    def test_query_rejects_writes(self, db):
+        with pytest.raises(ValueError):
+            db.query("INSERT INTO X SET a = 1")
+
+
+class TestDDL:
+    def test_create_class_property_index(self, db):
+        c(db, "CREATE CLASS Person EXTENDS V")
+        c(db, "CREATE PROPERTY Person.name STRING")
+        c(db, "CREATE INDEX Person.name UNIQUE")
+        db.command("INSERT INTO Person SET name = 'a'")
+        from orientdb_tpu.models.indexes import DuplicateKeyError
+
+        with pytest.raises(DuplicateKeyError):
+            db.command("INSERT INTO Person SET name = 'a'")
+
+    def test_alter_property(self, db):
+        c(db, "CREATE CLASS P")
+        c(db, "CREATE PROPERTY P.a LONG")
+        c(db, "ALTER PROPERTY P.a MANDATORY true")
+        with pytest.raises(ValueError):
+            db.command("INSERT INTO P SET b = 1")
+
+    def test_drop_class(self, db):
+        c(db, "CREATE CLASS Temp")
+        c(db, "DROP CLASS Temp")
+        assert not db.schema.exists_class("Temp")
+        c(db, "DROP CLASS Temp IF EXISTS")  # no error
+
+    def test_explain(self, social_db):
+        rows = social_db.query("EXPLAIN SELECT FROM Profiles WHERE name = 'x'").to_dicts()
+        assert "FetchFromTarget" in rows[0]["executionPlan"]
+        social_db.indexes.create_index("Profiles.name", "Profiles", ["name"], "UNIQUE")
+        rows = social_db.query("EXPLAIN SELECT FROM Profiles WHERE name = 'x'").to_dicts()
+        assert "FetchFromIndex" in rows[0]["executionPlan"]
+
+    def test_profile(self, social_db):
+        rows = social_db.query("PROFILE SELECT FROM Profiles").to_dicts()
+        assert rows[0]["rows"] == 5
+        assert rows[0]["elapsedUs"] > 0
+
+
+class TestEngineFrontDoor:
+    def test_profile_of_write_rejected_via_query(self, db):
+        with pytest.raises(ValueError):
+            db.query("PROFILE INSERT INTO V SET x = 1")
+        # but fine via command()
+        rows = db.command("PROFILE INSERT INTO V SET x = 1").to_dicts()
+        assert rows[0]["rows"] == 1
+
+    def test_unknown_engine_rejected(self, social_db):
+        with pytest.raises(ValueError):
+            social_db.query("SELECT FROM Profiles", engine="Tpu")
+
+    def test_engine_attr_stamped_on_writes(self, db):
+        rs = db.command("CREATE CLASS Zz")
+        assert rs.engine == "oracle"
+        rs = db.query("SELECT FROM Zz")
+        assert rs.engine == "oracle"

@@ -1,0 +1,353 @@
+"""Parser tests — analog of the reference's parse-tree assertions
+([E] OMatchStatementTest / OSelectStatementTest, SURVEY.md §4)."""
+
+import pytest
+
+from orientdb_tpu.sql import parse, ParseError
+from orientdb_tpu.sql import ast as A
+
+
+class TestSelectParsing:
+    def test_bare_select(self):
+        s = parse("SELECT FROM V")
+        assert isinstance(s, A.SelectStatement)
+        assert s.projections == ()
+        assert s.target == A.ClassTarget("V")
+
+    def test_projections_aliases(self):
+        s = parse("SELECT name, age AS years FROM Person")
+        assert [p.alias for p in s.projections] == [None, "years"]
+        assert s.projections[0].expr == A.Identifier("name")
+
+    def test_where_precedence(self):
+        s = parse("SELECT FROM P WHERE a = 1 AND b > 2 OR c < 3")
+        # ((a=1 AND b>2) OR c<3)
+        assert isinstance(s.where, A.Binary) and s.where.op == "OR"
+        assert s.where.left.op == "AND"
+
+    def test_arithmetic_precedence(self):
+        s = parse("SELECT 1 + 2 * 3 AS x FROM V")
+        e = s.projections[0].expr
+        assert e.op == "+" and e.right.op == "*"
+
+    def test_order_skip_limit(self):
+        s = parse("SELECT FROM P ORDER BY age DESC, name SKIP 5 LIMIT 10")
+        assert s.order_by[0].ascending is False
+        assert s.order_by[1].ascending is True
+        assert s.skip == A.Literal(5)
+        assert s.limit == A.Literal(10)
+
+    def test_limit_before_skip(self):
+        s = parse("SELECT FROM P LIMIT 10 SKIP 5")
+        assert s.skip == A.Literal(5) and s.limit == A.Literal(10)
+
+    def test_rid_target(self):
+        s = parse("SELECT FROM #12:0")
+        assert s.target == A.RidTarget((A.RIDLiteral(12, 0),))
+
+    def test_rid_list_target(self):
+        s = parse("SELECT FROM [#12:0, #12:1]")
+        assert len(s.target.rids) == 2
+
+    def test_cluster_and_index_targets(self):
+        assert parse("SELECT FROM CLUSTER:person").target == A.ClusterTarget("person")
+        assert parse("SELECT FROM INDEX:Person.name").target == A.IndexTarget(
+            "Person.name"
+        )
+
+    def test_subquery_target(self):
+        s = parse("SELECT FROM (SELECT FROM V WHERE x = 1)")
+        assert isinstance(s.target, A.SubQueryTarget)
+
+    def test_graph_functions(self):
+        s = parse("SELECT out('HasFriend').name FROM Person")
+        e = s.projections[0].expr
+        assert isinstance(e, A.FieldAccess)
+        assert isinstance(e.base, A.FunctionCall) and e.base.name == "out"
+
+    def test_method_calls(self):
+        s = parse("SELECT name.toLowerCase() FROM P WHERE tags.size() > 2")
+        assert isinstance(s.projections[0].expr, A.MethodCall)
+
+    def test_named_and_positional_params(self):
+        s = parse("SELECT FROM P WHERE a = :pa AND b = ?")
+        assert s.where.left.right == A.Parameter(name="pa")
+        assert s.where.right.right == A.Parameter(index=0)
+
+    def test_in_between_like(self):
+        s = parse("SELECT FROM P WHERE a IN [1,2] AND b BETWEEN 1 AND 9 AND c LIKE 'x%'")
+        conj = s.where
+        assert conj.op == "AND"
+
+    def test_is_null(self):
+        s = parse("SELECT FROM P WHERE a IS NULL AND b IS NOT NULL")
+        assert s.where.left == A.IsNull(A.Identifier("a"), False)
+        assert s.where.right == A.IsNull(A.Identifier("b"), True)
+
+    def test_not_in(self):
+        s = parse("SELECT FROM P WHERE a NOT IN [1,2]")
+        assert isinstance(s.where, A.Unary) and s.where.op == "NOT"
+
+    def test_attrs(self):
+        s = parse("SELECT @rid, @class FROM P WHERE @version > 1")
+        assert s.projections[0].expr == A.Identifier("@rid")
+
+    def test_let(self):
+        s = parse("SELECT FROM P LET $f = (SELECT FROM Q), $n = a + 1 WHERE $f.size() > 0")
+        assert s.lets[0].name == "f"
+        assert isinstance(s.lets[0].value, A.SelectStatement)
+        assert s.lets[1].name == "n"
+
+    def test_group_by_unwind(self):
+        s = parse("SELECT count(*) AS n FROM P GROUP BY dept UNWIND tags")
+        assert s.group_by == (A.Identifier("dept"),)
+        assert s.unwind == ("tags",)
+
+    def test_expand(self):
+        s = parse("SELECT expand(out()) FROM #9:0")
+        f = s.projections[0].expr
+        assert f.name == "expand"
+
+    def test_count_star(self):
+        s = parse("SELECT count(*) FROM V")
+        assert s.projections[0].expr == A.FunctionCall("count", (A.Star(),))
+
+    def test_backtick_ident_and_string_escape(self):
+        s = parse("SELECT `weird name` FROM P WHERE a = 'it\\'s'")
+        assert s.projections[0].expr == A.Identifier("weird name")
+        assert s.where.right == A.Literal("it's")
+
+    def test_comments(self):
+        s = parse("SELECT FROM V WHERE /* block */ a = 1")
+        assert s.where is not None
+
+
+class TestMatchParsing:
+    def test_one_hop(self):
+        s = parse("MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN p, f")
+        assert isinstance(s, A.MatchStatement)
+        path = s.paths[0]
+        assert path.first.class_name == "Profiles" and path.first.alias == "p"
+        item = path.items[0]
+        assert item.direction == "out"
+        assert item.edge_classes == ("HasFriend",)
+        assert item.target.alias == "f"
+        assert [p.expr for p in s.returns] == [A.Identifier("p"), A.Identifier("f")]
+
+    def test_in_and_both_arrows(self):
+        s = parse("MATCH {as:a}<-E1-{as:b}-E2-{as:c} RETURN a")
+        assert s.paths[0].items[0].direction == "in"
+        assert s.paths[0].items[1].direction == "both"
+
+    def test_anonymous_arrows(self):
+        s = parse("MATCH {as:a}-->{as:b}<--{as:c}--{as:d} RETURN a")
+        dirs = [i.direction for i in s.paths[0].items]
+        assert dirs == ["out", "in", "both"]
+        assert s.paths[0].items[0].edge_classes == ()
+
+    def test_node_where(self):
+        s = parse(
+            "MATCH {class:Person, as:p, where:(age > 30 AND name <> 'x')}--{as:q} RETURN p"
+        )
+        assert isinstance(s.paths[0].first.where, A.Binary)
+
+    def test_while_maxdepth(self):
+        s = parse(
+            "MATCH {class:P, as:a}-F->{as:b, while:($depth < 3), maxDepth: 5} RETURN b"
+        )
+        tgt = s.paths[0].items[0].target
+        assert tgt.max_depth == 5
+        assert isinstance(tgt.while_cond, A.Binary)
+        assert tgt.while_cond.left == A.ContextVar("depth")
+
+    def test_optional(self):
+        s = parse("MATCH {as:a}-F->{as:b, optional:true} RETURN a, b")
+        assert s.paths[0].items[0].target.optional is True
+
+    def test_multiple_paths(self):
+        s = parse("MATCH {class:A, as:a}-E->{as:b}, {as:b}-F->{as:c} RETURN a, c")
+        assert len(s.paths) == 2
+        assert s.paths[1].first.alias == "b"
+
+    def test_not_pattern(self):
+        s = parse("MATCH {class:A, as:a}, NOT {as:a}-E->{as:b} RETURN a")
+        assert s.paths[1].negated is True
+
+    def test_method_form(self):
+        s = parse("MATCH {class:A, as:a}.out('E'){as:b} RETURN b")
+        item = s.paths[0].items[0]
+        assert item.method == "out" and item.direction == "out"
+        assert item.edge_classes == ("E",)
+        assert item.target.alias == "b"
+
+    def test_oute_inv_edge_filter(self):
+        s = parse(
+            "MATCH {class:A, as:a}.outE('E'){as:e, where:(w > 2)}.inV(){as:b} RETURN e, b"
+        )
+        item = s.paths[0].items[0]
+        assert item.edge_filter.alias == "e"
+        assert isinstance(item.edge_filter.where, A.Binary)
+        assert item.target.alias == "b"
+
+    def test_edge_filter_arrow_form(self):
+        s = parse("MATCH {as:a}-{class:E, where:(w > 1)}->{as:b} RETURN a")
+        item = s.paths[0].items[0]
+        assert item.edge_classes == ("E",)
+        assert item.edge_filter.where is not None
+
+    def test_return_distinct_forms(self):
+        s = parse("MATCH {class:A, as:a} RETURN DISTINCT a.name AS n, $matches LIMIT 3")
+        assert s.distinct is True
+        assert s.returns[0].alias == "n"
+        assert s.returns[1].expr == A.ContextVar("matches")
+        assert s.limit == A.Literal(3)
+
+    def test_rid_anchor(self):
+        s = parse("MATCH {rid:#9:1, as:a}-E->{as:b} RETURN b")
+        assert s.paths[0].first.rid == A.RIDLiteral(9, 1)
+
+    def test_depth_alias(self):
+        s = parse("MATCH {as:a}-E->{as:b, while:($depth<2), depthAlias: d} RETURN d")
+        assert s.paths[0].items[0].target.depth_alias == "d"
+
+    def test_order_by_group_by(self):
+        s = parse("MATCH {class:A, as:a} RETURN a.x GROUP BY a.y ORDER BY a.x DESC SKIP 1 LIMIT 2")
+        assert s.group_by and s.order_by and s.skip and s.limit
+
+
+class TestTraverseParsing:
+    def test_basic(self):
+        s = parse("TRAVERSE out() FROM #9:0")
+        assert isinstance(s, A.TraverseStatement)
+        assert s.fields[0] == A.FunctionCall("out", ())
+        assert s.strategy == "DEPTH_FIRST"
+
+    def test_full(self):
+        s = parse(
+            "TRAVERSE out('E'), in('F') FROM (SELECT FROM V) MAXDEPTH 3 WHILE $depth < 2 LIMIT 10 STRATEGY BREADTH_FIRST"
+        )
+        assert len(s.fields) == 2
+        assert s.max_depth == 3
+        assert s.while_cond is not None
+        assert s.strategy == "BREADTH_FIRST"
+
+    def test_star(self):
+        s = parse("TRAVERSE * FROM V")
+        assert isinstance(s.fields[0], A.Star)
+
+
+class TestDMLParsing:
+    def test_insert_set(self):
+        s = parse("INSERT INTO Person SET name = 'x', age = 3")
+        assert s.class_name == "Person"
+        assert s.set_fields[0] == ("name", A.Literal("x"))
+
+    def test_insert_values(self):
+        s = parse("INSERT INTO Person (name, age) VALUES ('x', 3)")
+        assert dict(s.set_fields) == {"name": A.Literal("x"), "age": A.Literal(3)}
+
+    def test_insert_multi_values(self):
+        s = parse("INSERT INTO P (a) VALUES (1), (2)")
+        assert isinstance(s.content, A.ListExpr) and len(s.content.items) == 2
+
+    def test_insert_content(self):
+        s = parse('INSERT INTO P CONTENT {"a": 1, "b": [1,2]}')
+        assert isinstance(s.content, A.MapExpr)
+
+    def test_update(self):
+        s = parse("UPDATE Person SET age = 4 INCREMENT views = 1 UPSERT WHERE name = 'x' LIMIT 2")
+        assert s.ops[0].kind == "SET" and s.ops[1].kind == "INCREMENT"
+        assert s.upsert is True
+        assert s.limit == A.Literal(2)
+
+    def test_update_remove_return(self):
+        s = parse("UPDATE P REMOVE a RETURN AFTER WHERE b = 1")
+        assert s.ops[0].kind == "REMOVE"
+        assert s.return_mode == "AFTER"
+
+    def test_delete_variants(self):
+        assert parse("DELETE FROM P WHERE a = 1").kind == "RECORD"
+        s = parse("DELETE VERTEX Person WHERE name = 'x'")
+        assert s.kind == "VERTEX" and s.target == A.ClassTarget("Person")
+        s = parse("DELETE EDGE HasFriend FROM #1:0 TO #1:1")
+        assert s.kind == "EDGE"
+        assert s.edge_from == A.RIDLiteral(1, 0)
+
+    def test_create_vertex_edge(self):
+        s = parse("CREATE VERTEX Person SET name = 'x'")
+        assert s.class_name == "Person"
+        s = parse("CREATE EDGE Knows FROM #1:0 TO #1:1 SET w = 2")
+        assert s.class_name == "Knows"
+        s = parse("CREATE EDGE Knows FROM (SELECT FROM A) TO (SELECT FROM B)")
+        assert s.from_expr.name == "$subquery"
+
+
+class TestDDLParsing:
+    def test_create_class(self):
+        s = parse("CREATE CLASS Person EXTENDS V")
+        assert s.superclasses == ("V",)
+        s = parse("CREATE CLASS X IF NOT EXISTS EXTENDS V, Y ABSTRACT")
+        assert s.if_not_exists and s.abstract and s.superclasses == ("V", "Y")
+
+    def test_create_property(self):
+        s = parse("CREATE PROPERTY Person.name STRING")
+        assert (s.class_name, s.property_name, s.property_type) == (
+            "Person",
+            "name",
+            "STRING",
+        )
+
+    def test_create_index(self):
+        s = parse("CREATE INDEX Person.name UNIQUE")
+        assert s.class_name == "Person" and s.fields == ("name",)
+        s = parse("CREATE INDEX idx ON Person (name, age) NOTUNIQUE")
+        assert s.fields == ("name", "age") and s.index_type == "NOTUNIQUE"
+        s = parse("CREATE INDEX idx2 ON P (a) UNIQUE HASH_INDEX")
+        assert s.index_type == "UNIQUE_HASH_INDEX"
+
+    def test_drop(self):
+        assert parse("DROP CLASS X IF EXISTS").if_exists is True
+        assert parse("DROP INDEX Person.name").name == "Person.name"
+
+    def test_alter_property(self):
+        s = parse("ALTER PROPERTY P.a MANDATORY true")
+        assert s.attribute == "MANDATORY" and s.value == A.Literal(True)
+
+    def test_explain_profile(self):
+        s = parse("EXPLAIN SELECT FROM V")
+        assert isinstance(s, A.ExplainStatement) and not s.profile
+        s = parse("PROFILE MATCH {as:a} RETURN a")
+        assert s.profile and isinstance(s.inner, A.MatchStatement)
+
+    def test_tx_statements(self):
+        assert isinstance(parse("BEGIN"), A.BeginStatement)
+        assert parse("COMMIT RETRY 5").retries == 5
+        assert isinstance(parse("ROLLBACK"), A.RollbackStatement)
+
+    def test_live_select(self):
+        s = parse("LIVE SELECT FROM Person")
+        assert isinstance(s, A.LiveSelectStatement)
+
+
+class TestParseErrors:
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "SELEC FROM V",
+            "SELECT FROM",
+            "MATCH {class:A, as:a} RETURN",
+            "MATCH {unknownKey: 1} RETURN x",
+            "SELECT FROM P WHERE a = ",
+            "INSERT INTO P (a,b) VALUES (1)",
+            "SELECT FROM P WHERE a IS BANANA",
+            "MATCH {as:a}-E-{as:b RETURN a",
+            "SELECT 'unterminated FROM V",
+        ],
+    )
+    def test_raises(self, bad):
+        with pytest.raises(ParseError):
+            parse(bad)
+
+    def test_trailing_garbage(self):
+        with pytest.raises(ParseError):
+            parse("SELECT FROM V garbage garbage")
