@@ -106,7 +106,11 @@ def _insert(db, stmt: A.InsertStatement, ctx, params) -> List[Result]:
             doc: Document = db.new_vertex(class_name, **fields)
         else:
             doc = db.new_element(class_name, **fields)
-        out.append(Result(element=doc))
+        if stmt.return_expr is not None:
+            rctx = EvalContext(db, current=doc, params=ctx.params, parent=ctx)
+            out.append(Result(props={"result": evaluate(rctx, stmt.return_expr)}))
+        else:
+            out.append(Result(element=doc))
     return out
 
 
@@ -165,7 +169,7 @@ def _target_docs(db, target: A.Target, where, limit, ctx, params) -> List[Docume
 
     docs = []
     for row in resolve_target_rows(db, target, ctx):
-        doc = row if isinstance(doc_candidate := row, Document) else (
+        doc = row if isinstance(row, Document) else (
             row.element if isinstance(row, Result) and row.is_element else None
         )
         if doc is None:
@@ -260,6 +264,8 @@ def _delete(db, stmt: A.DeleteStatement, ctx, params) -> List[Result]:
                     evaluate(EvalContext(db, current=d, params=params, parent=ctx), where)
                 )
             ]
+        if stmt.limit is not None:
+            docs = docs[: int(evaluate(ctx, stmt.limit))]
     else:
         docs = _target_docs(db, stmt.target, where, stmt.limit, ctx, params)
     count = 0

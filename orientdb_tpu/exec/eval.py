@@ -232,10 +232,6 @@ def _fn_coalesce(args):
     return None
 
 
-def _check_finite(x):
-    return x
-
-
 _MATH_FNS = {
     "abs": abs,
     "sqrt": math.sqrt,

@@ -323,6 +323,19 @@ def execute_select(db, stmt: A.SelectStatement, params, parent_ctx=None) -> List
             [row for _, row in filtered] if len(rows) == len(filtered) else None
         )
 
+    if stmt.distinct:
+        seen = set()
+        deduped, dd_sources = [], []
+        for i, r in enumerate(rows):
+            key = _canonical(r)
+            if key not in seen:
+                seen.add(key)
+                deduped.append(r)
+                if sources is not None:
+                    dd_sources.append(sources[i])
+        rows = deduped
+        sources = dd_sources if sources is not None else None
+
     for field in stmt.unwind:
         unwound: List[Result] = []
         unwound_sources: List[object] = []
@@ -503,6 +516,43 @@ def build_pattern(stmt: A.MatchStatement) -> Tuple[Pattern, List[A.MatchPath]]:
 _REVERSE_DIR = {"out": "in", "in": "out", "both": "both"}
 
 
+def _expr_uses_bindings(expr, pattern_nodes: Dict[str, "PatternNode"]) -> bool:
+    """True if a where-expression references other aliases ($matched,
+    $currentMatch, or an alias name used as an identifier)."""
+    if isinstance(expr, A.ContextVar):
+        return expr.name in ("matched", "currentMatch")
+    if isinstance(expr, A.Identifier):
+        return expr.name in pattern_nodes
+    if isinstance(expr, A.Binary):
+        return _expr_uses_bindings(expr.left, pattern_nodes) or _expr_uses_bindings(
+            expr.right, pattern_nodes
+        )
+    if isinstance(expr, A.Unary):
+        return _expr_uses_bindings(expr.expr, pattern_nodes)
+    if isinstance(expr, A.Between):
+        return any(
+            _expr_uses_bindings(e, pattern_nodes)
+            for e in (expr.expr, expr.low, expr.high)
+        )
+    if isinstance(expr, (A.IsNull, A.IsDefined)):
+        return _expr_uses_bindings(expr.expr, pattern_nodes)
+    if isinstance(expr, A.FieldAccess):
+        return _expr_uses_bindings(expr.base, pattern_nodes)
+    if isinstance(expr, A.IndexAccess):
+        return _expr_uses_bindings(expr.base, pattern_nodes) or _expr_uses_bindings(
+            expr.index, pattern_nodes
+        )
+    if isinstance(expr, A.MethodCall):
+        return _expr_uses_bindings(expr.base, pattern_nodes) or any(
+            _expr_uses_bindings(a, pattern_nodes) for a in expr.args
+        )
+    if isinstance(expr, A.FunctionCall):
+        return any(_expr_uses_bindings(a, pattern_nodes) for a in expr.args)
+    if isinstance(expr, A.ListExpr):
+        return any(_expr_uses_bindings(a, pattern_nodes) for a in expr.items)
+    return False
+
+
 class MatchInterpreter:
     """Per-record DFS, the [E] MatchEdgeTraverser analog."""
 
@@ -512,10 +562,19 @@ class MatchInterpreter:
         self.params = params
         self.parent_ctx = parent_ctx
         self.pattern, self.not_paths = build_pattern(stmt)
+        # alias → binding-independent candidate list, computed once per query
+        self._cand_cache: Dict[str, List[Document]] = {}
 
     # -- candidate sets ----------------------------------------------------
 
     def node_candidates(self, node: PatternNode) -> List[Document]:
+        """Binding-independent candidate set for an alias, cached per query.
+        Where-clauses that reference other bindings ($matched / alias names)
+        are NOT applied here — callers re-check with
+        `check_node(node, cand, bindings)` once bindings exist."""
+        cached = self._cand_cache.get(node.alias)
+        if cached is not None:
+            return cached
         rid = None
         class_names = []
         for f in node.filters:
@@ -537,7 +596,9 @@ class MatchInterpreter:
             docs = list(self.db.browse_class("E"))
         else:
             docs = list(self.db.browse_class("V"))
-        return [d for d in docs if self.check_node(node, d, {})]
+        out = [d for d in docs if self.check_node(node, d, {}, prefilter=True)]
+        self._cand_cache[node.alias] = out
+        return out
 
     def estimate(self, node: PatternNode) -> int:
         for f in node.filters:
@@ -555,14 +616,23 @@ class MatchInterpreter:
         return cls is not None and cls.is_subclass_of(class_name)
 
     def check_node(
-        self, node: PatternNode, doc: Document, bindings: Dict[str, object]
+        self,
+        node: PatternNode,
+        doc: Document,
+        bindings: Dict[str, object],
+        prefilter: bool = False,
     ) -> bool:
+        """With ``prefilter=True``, binding-dependent where-clauses are
+        skipped (evaluating them with empty bindings would wrongly drop
+        every candidate)."""
         for f in node.filters:
             if f.class_name and not self._doc_is_class(doc, f.class_name):
                 return False
             if f.rid is not None and doc.rid != RID(f.rid.cluster, f.rid.position):
                 return False
             if f.where is not None:
+                if prefilter and _expr_uses_bindings(f.where, self.pattern.nodes):
+                    continue
                 ctx = self._where_ctx(doc, bindings)
                 if not truthy(evaluate(ctx, f.where)):
                     return False
@@ -762,13 +832,17 @@ class MatchInterpreter:
         tb = e.to_alias in bindings
         if not fb and not tb:
             # new component: enumerate candidates for the cheaper endpoint
+            # ([E] OMatchExecutionPlanner's smallest-first root choice)
             from_node = self.pattern.nodes[e.from_alias]
             to_node = self.pattern.nodes[e.to_alias]
-            if self.estimate(from_node) <= self.estimate(to_node):
-                root, anchor_from = from_node, True
-            else:
-                root, anchor_from = to_node, False
+            root = (
+                from_node
+                if self.estimate(from_node) <= self.estimate(to_node)
+                else to_node
+            )
             for cand in self.node_candidates(root):
+                if not self.check_node(root, cand, bindings):
+                    continue
                 nb = dict(bindings)
                 nb[root.alias] = cand
                 yield from self._solve_required([e] + rest, isolated, nb)

@@ -233,6 +233,7 @@ class SelectStatement(Statement):
     limit: Optional[Expression] = None
     lets: Tuple[LetItem, ...] = ()
     timeout_ms: Optional[int] = None
+    distinct: bool = False
 
     is_idempotent = True
 

@@ -159,6 +159,12 @@ class Parser:
 
     def parse_select(self) -> A.SelectStatement:
         self.eat_kw("SELECT")
+        distinct = False
+        if self.at_kw("DISTINCT") and not (
+            self.at_op("(", 1)  # legacy distinct(expr) function call
+        ):
+            self.next()
+            distinct = True
         projections: List[A.Projection] = []
         if not (self.at_kw("FROM") or self.peek().kind == "EOF"):
             projections = self.parse_projections()
@@ -193,6 +199,7 @@ class Parser:
             limit=limit,
             lets=tuple(lets),
             timeout_ms=timeout,
+            distinct=distinct,
         )
 
     def parse_projections(self) -> List[A.Projection]:
@@ -258,12 +265,13 @@ class Parser:
 
     def parse_skip_limit(self):
         skip = limit = None
-        # OrientDB allows SKIP/LIMIT in either order
+        # OrientDB allows SKIP/LIMIT in either order; parse_unary admits the
+        # idiomatic `LIMIT -1` (unlimited)
         for _ in range(2):
             if self.try_kw("SKIP"):
-                skip = self.parse_primary()
+                skip = self.parse_unary()
             elif self.try_kw("LIMIT"):
-                limit = self.parse_primary()
+                limit = self.parse_unary()
         return skip, limit
 
     def parse_expr_list(self) -> List[A.Expression]:
@@ -574,14 +582,14 @@ class Parser:
             cluster = self.eat_ident()
         else:
             class_name = self.eat_ident()
+        set_fields: Tuple[Tuple[str, A.Expression], ...] = ()
+        content: Optional[A.Expression] = None
+        from_select: Optional[A.Statement] = None
         if self.try_kw("SET"):
-            return A.InsertStatement(
-                class_name, cluster, set_fields=tuple(self.parse_set_items())
-            )
-        if self.try_kw("CONTENT"):
+            set_fields = tuple(self.parse_set_items())
+        elif self.try_kw("CONTENT"):
             content = self.parse_expression()
-            return A.InsertStatement(class_name, cluster, content=content)
-        if self.at_op("("):
+        elif self.at_op("("):
             self.next()
             names = self.parse_name_list()
             self.eat_op(")")
@@ -597,18 +605,29 @@ class Parser:
                 if not self.try_op(","):
                     break
             if len(rows) == 1:
-                return A.InsertStatement(class_name, cluster, set_fields=rows[0])
-            # multi-row insert: encode as content list of maps
-            maps = tuple(
-                A.MapExpr(tuple((k, v) for k, v in row)) for row in rows
+                set_fields = rows[0]
+            else:
+                # multi-row insert: encode as content list of maps
+                content = A.ListExpr(
+                    tuple(A.MapExpr(tuple((k, v) for k, v in row)) for row in rows)
+                )
+        elif self.try_kw("FROM"):
+            from_select = self.parse_statement()
+        else:
+            raise ParseError(
+                "expected SET / CONTENT / VALUES / FROM in INSERT", self.peek()
             )
-            return A.InsertStatement(
-                class_name, cluster, content=A.ListExpr(maps)
-            )
-        if self.try_kw("FROM"):
-            sub = self.parse_statement()
-            return A.InsertStatement(class_name, cluster, from_select=sub)
-        raise ParseError("expected SET / CONTENT / VALUES / FROM in INSERT", self.peek())
+        return_expr: Optional[A.Expression] = None
+        if self.try_kw("RETURN"):
+            return_expr = self.parse_expression()
+        return A.InsertStatement(
+            class_name,
+            cluster,
+            set_fields=set_fields,
+            content=content,
+            from_select=from_select,
+            return_expr=return_expr,
+        )
 
     def parse_set_items(self) -> List[Tuple[str, A.Expression]]:
         out = []
@@ -882,12 +901,19 @@ class Parser:
                     return A.IsDefined(left, negated)
                 raise ParseError("expected NULL or DEFINED after IS", self.peek())
             if kw == "NOT":
-                # NOT IN / NOT LIKE / NOT CONTAINS...
+                # NOT IN / NOT LIKE / NOT CONTAINS... / NOT BETWEEN
                 nxt = self.peek(1)
                 if nxt.kind == "IDENT" and nxt.text.upper() in _CMP_KEYWORDS:
                     self.next()
                     op = self.next().text.upper()
                     return A.Unary("NOT", A.Binary(op, left, self.parse_additive()))
+                if nxt.kind == "IDENT" and nxt.text.upper() == "BETWEEN":
+                    self.next()
+                    self.next()
+                    low = self.parse_additive()
+                    self.eat_kw("AND")
+                    high = self.parse_additive()
+                    return A.Unary("NOT", A.Between(left, low, high))
         return left
 
     def parse_additive(self) -> A.Expression:

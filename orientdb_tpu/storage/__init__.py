@@ -1,0 +1,3 @@
+from orientdb_tpu.storage.snapshot import GraphSnapshot, build_snapshot
+
+__all__ = ["GraphSnapshot", "build_snapshot"]

@@ -1,0 +1,360 @@
+"""Data ingest: synthetic generators and portable JSON export/import.
+
+- `generate_demodb` — a demodb-shaped social graph (Profiles/HasFriend/
+  Likes), the bundled-sample-database analog ([E] distribution/ demodb,
+  SURVEY.md §4) used by BASELINE configs 1/2/4;
+- `generate_ldbc_snb` — a simplified LDBC SNB interactive graph (Person/
+  City/Tag + knows/isLocatedIn/hasInterest) for BASELINE configs 3/5; the
+  official SNB generator is unavailable offline, so this reproduces its
+  *shape* (power-law-ish knows degree, typed properties) deterministically;
+- `export_database` / `import_database` — portable JSON with RID remapping
+  on import (the [E] ODatabaseExport/ODatabaseImport path, SURVEY.md §3.5 —
+  exported RIDs are remapped to freshly allocated ones, the same remap-table
+  concept the snapshot loader uses for RID → dense index).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.record import Document, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.schema import PropertyType
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("ingest")
+
+_FIRST = [
+    "alice", "bob", "carol", "dave", "eve", "frank", "grace", "heidi",
+    "ivan", "judy", "mallory", "niaj", "olivia", "peggy", "rupert", "sybil",
+    "trent", "victor", "wendy", "zane",
+]
+_LAST = [
+    "smith", "jones", "brown", "wilson", "taylor", "lee", "khan", "singh",
+    "garcia", "lopez", "muller", "rossi", "ivanov", "sato", "chen", "kim",
+]
+
+
+def generate_demodb(
+    db: Optional[Database] = None,
+    n_profiles: int = 1000,
+    avg_friends: int = 10,
+    seed: int = 7,
+) -> Database:
+    """Demodb-shaped social network with deterministic content."""
+    if db is None:
+        db = Database("demodb")
+    rng = np.random.default_rng(seed)
+    prof = db.schema.create_vertex_class("Profiles")
+    prof.create_property("name", PropertyType.STRING)
+    prof.create_property("surname", PropertyType.STRING)
+    prof.create_property("age", PropertyType.LONG)
+    prof.create_property("uid", PropertyType.LONG)
+    db.schema.create_edge_class("HasFriend")
+    likes = db.schema.create_edge_class("Likes")
+    likes.create_property("weight", PropertyType.LONG)
+
+    names = rng.integers(0, len(_FIRST), n_profiles)
+    surnames = rng.integers(0, len(_LAST), n_profiles)
+    ages = rng.integers(18, 80, n_profiles)
+    vs: List[Vertex] = []
+    for i in range(n_profiles):
+        vs.append(
+            db.new_vertex(
+                "Profiles",
+                name=f"{_FIRST[names[i]]}{i}",
+                surname=_LAST[surnames[i]],
+                age=int(ages[i]),
+                uid=i,
+            )
+        )
+    # HasFriend: out-degree ~ Poisson(avg_friends), no self loops, no dup
+    # (src,dst) pairs
+    degrees = rng.poisson(avg_friends, n_profiles)
+    for i in range(n_profiles):
+        if degrees[i] == 0:
+            continue
+        targets = rng.choice(n_profiles, size=min(int(degrees[i]), n_profiles - 1), replace=False)
+        for t in targets:
+            if t == i:
+                continue
+            db.new_edge("HasFriend", vs[i], vs[int(t)])
+    # Likes: sparser, weighted
+    n_likes = n_profiles // 2
+    srcs = rng.integers(0, n_profiles, n_likes)
+    dsts = rng.integers(0, n_profiles, n_likes)
+    weights = rng.integers(1, 10, n_likes)
+    for s, d, w in zip(srcs, dsts, weights):
+        if s != d:
+            db.new_edge("Likes", vs[int(s)], vs[int(d)], weight=int(w))
+    log.info(
+        "demodb: %d profiles, %d HasFriend, %d Likes",
+        n_profiles,
+        db.count_class("HasFriend"),
+        db.count_class("Likes"),
+    )
+    return db
+
+
+def generate_ldbc_snb(
+    db: Optional[Database] = None,
+    n_persons: int = 1000,
+    seed: int = 11,
+) -> Database:
+    """Simplified LDBC SNB interactive graph (shape-faithful, offline)."""
+    if db is None:
+        db = Database("snb")
+    rng = np.random.default_rng(seed)
+    person = db.schema.create_vertex_class("Person")
+    for pname, pt in [
+        ("id", PropertyType.LONG),
+        ("firstName", PropertyType.STRING),
+        ("lastName", PropertyType.STRING),
+        ("birthday", PropertyType.LONG),
+        ("creationDate", PropertyType.LONG),
+        ("browserUsed", PropertyType.STRING),
+        ("locationIP", PropertyType.STRING),
+    ]:
+        person.create_property(pname, pt)
+    city = db.schema.create_vertex_class("City")
+    city.create_property("name", PropertyType.STRING)
+    tag = db.schema.create_vertex_class("Tag")
+    tag.create_property("name", PropertyType.STRING)
+    knows = db.schema.create_edge_class("knows")
+    knows.create_property("creationDate", PropertyType.LONG)
+    db.schema.create_edge_class("isLocatedIn")
+    db.schema.create_edge_class("hasInterest")
+
+    n_cities = max(4, n_persons // 100)
+    n_tags = max(8, n_persons // 50)
+    cities = [db.new_vertex("City", name=f"city{i}") for i in range(n_cities)]
+    tags = [db.new_vertex("Tag", name=f"tag{i}") for i in range(n_tags)]
+    browsers = ["Firefox", "Chrome", "Safari"]
+    persons: List[Vertex] = []
+    first = rng.integers(0, len(_FIRST), n_persons)
+    last = rng.integers(0, len(_LAST), n_persons)
+    bdays = rng.integers(0, 2**30, n_persons)
+    created = rng.integers(2**28, 2**31 - 1, n_persons)
+    browser_pick = rng.integers(0, 3, n_persons)
+    for i in range(n_persons):
+        persons.append(
+            db.new_vertex(
+                "Person",
+                id=int(i),
+                firstName=_FIRST[first[i]].capitalize(),
+                lastName=_LAST[last[i]].capitalize(),
+                birthday=int(bdays[i]),
+                creationDate=int(created[i]),
+                browserUsed=browsers[browser_pick[i]],
+                locationIP=f"10.0.{i % 256}.{(i // 256) % 256}",
+            )
+        )
+    # knows: power-law-ish degrees (Zipf capped), undirected modeled as one
+    # directed edge per pair (SNB stores one direction + symmetric query)
+    raw = rng.zipf(2.0, n_persons)
+    degrees = np.minimum(raw, 50)
+    for i in range(n_persons):
+        k = int(degrees[i])
+        if k <= 0:
+            continue
+        targets = rng.choice(n_persons, size=min(k, n_persons - 1), replace=False)
+        for t in targets:
+            if int(t) != i:
+                db.new_edge(
+                    "knows",
+                    persons[i],
+                    persons[int(t)],
+                    creationDate=int(rng.integers(2**28, 2**31 - 1)),
+                )
+    city_pick = rng.integers(0, n_cities, n_persons)
+    for i in range(n_persons):
+        db.new_edge("isLocatedIn", persons[i], cities[city_pick[i]])
+    n_interests = rng.integers(1, 5, n_persons)
+    for i in range(n_persons):
+        for t in rng.choice(n_tags, size=int(n_interests[i]), replace=False):
+            db.new_edge("hasInterest", persons[i], tags[int(t)])
+    log.info(
+        "snb-ish: %d persons, %d knows", n_persons, db.count_class("knows")
+    )
+    return db
+
+
+# ---------------------------------------------------------------------------
+# portable JSON export / import (RID remapping)
+# ---------------------------------------------------------------------------
+
+
+def _value_to_json(v):
+    if isinstance(v, RID):
+        return {"@link": str(v)}
+    if isinstance(v, Document):
+        return {"@link": str(v.rid)}
+    if isinstance(v, (list, tuple)):
+        return [_value_to_json(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _value_to_json(x) for k, x in v.items()}
+    return v
+
+
+def export_database(db: Database, path: str) -> None:
+    """Portable JSON export ([E] ODatabaseExport). `.gz` paths gzip."""
+    schema = []
+    for cls in db.schema.classes():
+        if cls.name in ("V", "E"):
+            continue
+        schema.append(
+            {
+                "name": cls.name,
+                "superclasses": cls.superclass_names,
+                "abstract": cls.abstract,
+                "properties": [
+                    {
+                        "name": p.name,
+                        "type": p.type.value,
+                        "mandatory": p.mandatory,
+                        "notNull": p.not_null,
+                        "min": p.min_value,
+                        "max": p.max_value,
+                    }
+                    for p in cls.properties.values()
+                ],
+            }
+        )
+    indexes = [
+        {
+            "name": i.name,
+            "class": i.class_name,
+            "fields": i.fields,
+            "type": i.type,
+        }
+        for i in (db._indexes.all() if db._indexes is not None else [])
+    ]
+    records = []
+    for cls in db.schema.classes():
+        if cls.is_edge_type:
+            continue
+        for doc in db.browse_class(cls.name, polymorphic=False):
+            rec = {
+                "@rid": str(doc.rid),
+                "@class": doc.class_name,
+                "@type": "vertex" if isinstance(doc, Vertex) else "document",
+                "fields": _value_to_json(doc.fields()),
+            }
+            records.append(rec)
+    edges = []
+    for cls in db.schema.classes():
+        if not cls.is_edge_type or cls.name == "E":
+            continue
+        for doc in db.browse_class(cls.name, polymorphic=False):
+            if isinstance(doc, Edge):
+                edges.append(
+                    {
+                        "@rid": str(doc.rid),
+                        "@class": doc.class_name,
+                        "out": str(doc.out_rid),
+                        "in": str(doc.in_rid),
+                        "fields": _value_to_json(doc.fields()),
+                    }
+                )
+    payload = {
+        "name": db.name,
+        "schema": schema,
+        "indexes": indexes,
+        "records": records,
+        "edges": edges,
+    }
+    data = json.dumps(payload).encode()
+    if path.endswith(".gz"):
+        with gzip.open(path, "wb") as f:
+            f.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def import_database(path: str, name: Optional[str] = None) -> Database:
+    """Portable JSON import with RID remapping ([E] ODatabaseImport: new
+    RIDs are allocated and link fields rewritten through the remap table)."""
+    if path.endswith(".gz"):
+        with gzip.open(path, "rb") as f:
+            payload = json.loads(f.read())
+    else:
+        with open(path, "rb") as f:
+            payload = json.loads(f.read())
+    db = Database(name or payload.get("name", "imported"))
+    # schema first (superclasses before subclasses: simple fixpoint loop)
+    pending = list(payload["schema"])
+    while pending:
+        progressed = False
+        for entry in list(pending):
+            if all(db.schema.exists_class(s) for s in entry["superclasses"]):
+                cls = db.schema.create_class(
+                    entry["name"],
+                    superclasses=entry["superclasses"],
+                    abstract=entry["abstract"],
+                )
+                for p in entry["properties"]:
+                    cls.create_property(
+                        p["name"],
+                        PropertyType(p["type"]),
+                        mandatory=p["mandatory"],
+                        not_null=p["notNull"],
+                        min_value=p.get("min"),
+                        max_value=p.get("max"),
+                    )
+                pending.remove(entry)
+                progressed = True
+        if not progressed:
+            raise ValueError(f"unresolvable schema superclasses: {pending}")
+    remap: Dict[str, RID] = {}
+    deferred_links: List[tuple] = []
+
+    def _value_from_json(v):
+        if isinstance(v, dict):
+            if "@link" in v:
+                return ("@deferred", v["@link"])
+            return {k: _value_from_json(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [_value_from_json(x) for x in v]
+        return v
+
+    for rec in payload["records"]:
+        fields = {k: _value_from_json(v) for k, v in rec["fields"].items()}
+        clean = {
+            k: v
+            for k, v in fields.items()
+            if not (isinstance(v, tuple) and v and v[0] == "@deferred")
+        }
+        if rec["@type"] == "vertex":
+            doc: Document = db.new_vertex(rec["@class"], **clean)
+        else:
+            doc = db.new_element(rec["@class"], **clean)
+        remap[rec["@rid"]] = doc.rid
+        for k, v in fields.items():
+            if isinstance(v, tuple) and v and v[0] == "@deferred":
+                deferred_links.append((doc.rid, k, v[1]))
+    for edge in payload["edges"]:
+        src = db.load(remap[edge["out"]])
+        dst = db.load(remap[edge["in"]])
+        assert isinstance(src, Vertex) and isinstance(dst, Vertex)
+        fields = {
+            k: _value_from_json(v)
+            for k, v in edge["fields"].items()
+            if not isinstance(_value_from_json(v), tuple)
+        }
+        e = db.new_edge(edge["@class"], src, dst, **fields)
+        remap[edge["@rid"]] = e.rid
+    # second pass: rewrite deferred link fields through the remap table
+    for rid, field, old in deferred_links:
+        doc = db.load(rid)
+        if doc is not None and old in remap:
+            doc.set(field, remap[old])
+            db.save(doc)
+    for idx in payload["indexes"]:
+        db.indexes.create_index(idx["name"], idx["class"], idx["fields"], idx["type"])
+    return db

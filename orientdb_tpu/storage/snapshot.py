@@ -1,0 +1,381 @@
+"""Immutable columnar graph snapshots — the TPU-side data layout.
+
+This is the ingest/snapshot layer of the TPU-native design (SURVEY.md §1
+"TPU-native restatement" and §7 step 2): the host record store's vertices
+and edges are exported into dense columnar arrays that `jax.device_put`
+moves into TPU HBM:
+
+- a **dense vertex universe**: every vertex gets an int32 index (the RID →
+  dense-index remap table of [E] ODatabaseImport's RID remapping,
+  SURVEY.md §3.5); RIDs are recoverable per index for result marshalling;
+- **per-edge-class CSR adjacency**, both directions (out CSR and in CSR),
+  with an edge-id array aligned to CSR order so edge property columns can
+  be gathered alongside neighbor gathers — this is the HBM form of the
+  reference's per-vertex ORidBag adjacency ([E] ORidBag / sbtree bonsai,
+  SURVEY.md §2 "RidBag"), flattened for batched frontier expansion;
+- **global vertex property columns** keyed by property name (int32 /
+  float32 / bool with presence masks; strings dictionary-encoded with a
+  *sorted* dictionary so code order == lexicographic order, letting <,>,=
+  run as int32 compares on device);
+- **per-edge-class edge property columns** in CSR-out edge order;
+- a **class-id column** + subclass closure table so `class:X` polymorphic
+  filters compile to `isin(class_id, …)` masks.
+
+Snapshots are immutable; `Database.mutation_epoch` tracks staleness and
+`build_snapshot` is re-run to refresh (the snapshot-epoch model of
+SURVEY.md §5.4 — no WAL needed on the read-only TPU path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.record import Document, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("snapshot")
+
+#: sentinel for "property missing" in numeric columns (presence tracked in
+#: the mask; the sentinel only keeps padded math well-defined)
+MISSING_INT = np.int32(-(2**31) + 1)
+MISSING_FLOAT = np.float32(np.nan)
+
+
+class PropertyColumn:
+    """One global vertex (or per-class edge) property column."""
+
+    __slots__ = ("name", "kind", "values", "present", "dictionary", "dict_lookup")
+
+    def __init__(self, name: str, kind: str, values, present, dictionary=None):
+        self.name = name
+        self.kind = kind  # 'int' | 'float' | 'bool' | 'str'
+        self.values = values  # np.ndarray
+        self.present = present  # np.ndarray bool
+        self.dictionary: Optional[List[str]] = dictionary  # for 'str'
+        self.dict_lookup: Optional[Dict[str, int]] = (
+            {s: i for i, s in enumerate(dictionary)} if dictionary else None
+        )
+
+    def encode(self, value) -> Optional[np.int32]:
+        """Host-side scalar → column code/value for predicate compilation."""
+        if self.kind == "str":
+            if not isinstance(value, str) or self.dict_lookup is None:
+                return None
+            code = self.dict_lookup.get(value)
+            return np.int32(code) if code is not None else None
+        if self.kind == "int":
+            return np.int32(value)
+        if self.kind == "float":
+            return np.float32(value)
+        if self.kind == "bool":
+            return np.int32(bool(value))
+        return None
+
+    def decode(self, raw, present: bool):
+        if not present:
+            return None
+        if self.kind == "str":
+            assert self.dictionary is not None
+            return self.dictionary[int(raw)]
+        if self.kind == "int":
+            return int(raw)
+        if self.kind == "float":
+            return float(raw)
+        if self.kind == "bool":
+            return bool(raw)
+        return None
+
+
+class EdgeClassCSR:
+    """CSR adjacency for one concrete edge class, both directions.
+
+    out:  indptr_out[V+1], dst[E]      (CSR order == edge dense order)
+    in:   indptr_in[V+1], src[E], edge_id_in[E] (edge ids into out order)
+    """
+
+    __slots__ = (
+        "class_name",
+        "indptr_out",
+        "dst",
+        "indptr_in",
+        "src",
+        "edge_id_in",
+        "edge_rids",
+        "edge_columns",
+        "out_degree_max",
+        "in_degree_max",
+    )
+
+    def __init__(self, class_name: str):
+        self.class_name = class_name
+        self.indptr_out: np.ndarray = np.zeros(1, np.int32)
+        self.dst: np.ndarray = np.zeros(0, np.int32)
+        self.indptr_in: np.ndarray = np.zeros(1, np.int32)
+        self.src: np.ndarray = np.zeros(0, np.int32)
+        self.edge_id_in: np.ndarray = np.zeros(0, np.int32)
+        self.edge_rids: List[RID] = []
+        self.edge_columns: Dict[str, PropertyColumn] = {}
+        self.out_degree_max = 0
+        self.in_degree_max = 0
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.dst.shape[0])
+
+
+class GraphSnapshot:
+    """The immutable columnar snapshot (host numpy form; `device()` yields
+    the jnp pytree the compiled engine consumes)."""
+
+    def __init__(self) -> None:
+        self.epoch: int = -1
+        self.num_vertices: int = 0
+        # dense index → RID (parallel int32 arrays), and the reverse map
+        self.v_cluster: np.ndarray = np.zeros(0, np.int32)
+        self.v_position: np.ndarray = np.zeros(0, np.int32)
+        self.rid_to_idx: Dict[RID, int] = {}
+        # class metadata
+        self.class_names: List[str] = []  # class_id → name
+        self.class_id_of: Dict[str, int] = {}
+        self.v_class: np.ndarray = np.zeros(0, np.int32)
+        #: class name (lower) → sorted np.int32 array of class ids in its
+        #: polymorphic closure (vertex classes)
+        self.class_closure: Dict[str, np.ndarray] = {}
+        # property columns (global over the vertex universe)
+        self.v_columns: Dict[str, PropertyColumn] = {}
+        # per-edge-class CSR (concrete classes)
+        self.edge_classes: Dict[str, EdgeClassCSR] = {}
+        #: edge class name (lower) → list of concrete edge class names
+        self.edge_closure: Dict[str, List[str]] = {}
+        self._device_cache = None
+
+    # -- lookups -----------------------------------------------------------
+
+    def rid_of(self, idx: int) -> RID:
+        return RID(int(self.v_cluster[idx]), int(self.v_position[idx]))
+
+    def idx_of(self, rid: RID) -> Optional[int]:
+        return self.rid_to_idx.get(rid)
+
+    def vertex_class_ids(self, class_name: str) -> np.ndarray:
+        return self.class_closure.get(class_name.lower(), np.zeros(0, np.int32))
+
+    def concrete_edge_classes(self, class_name: Optional[str]) -> List[str]:
+        if class_name is None:
+            out: List[str] = []
+            for names in self.edge_closure.values():
+                for n in names:
+                    if n not in out:
+                        out.append(n)
+            return sorted(out)
+        return self.edge_closure.get(class_name.lower(), [])
+
+    def class_mask(self, class_name: str) -> np.ndarray:
+        """Boolean mask over the vertex universe for a polymorphic class."""
+        ids = self.vertex_class_ids(class_name)
+        return np.isin(self.v_class, ids)
+
+    def vertex_value(self, idx: int, prop: str):
+        col = self.v_columns.get(prop)
+        if col is None:
+            return None
+        return col.decode(col.values[idx], bool(col.present[idx]))
+
+    # -- stats -------------------------------------------------------------
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "vertices": self.num_vertices,
+            "edge_classes": {
+                n: c.num_edges for n, c in sorted(self.edge_classes.items())
+            },
+            "columns": sorted(self.v_columns.keys()),
+            "epoch": self.epoch,
+        }
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def _column_from_values(name: str, raw: List, present: np.ndarray) -> Optional[PropertyColumn]:
+    """Choose a columnar encoding for a property from its observed values."""
+    kinds = set()
+    for v, p in zip(raw, present):
+        if not p or v is None:
+            continue
+        if isinstance(v, bool):
+            kinds.add("bool")
+        elif isinstance(v, int):
+            kinds.add("int")
+        elif isinstance(v, float):
+            kinds.add("float")
+        elif isinstance(v, str):
+            kinds.add("str")
+        else:
+            return None  # lists/maps/links: not columnar; host fallback
+    if not kinds:
+        return None
+    if kinds <= {"bool"}:
+        kind = "bool"
+    elif kinds <= {"int", "bool"}:
+        kind = "int"
+    elif kinds <= {"int", "float", "bool"}:
+        kind = "float"
+    elif kinds == {"str"}:
+        kind = "str"
+    else:
+        return None  # mixed string/number: host fallback
+    n = len(raw)
+    if kind == "str":
+        # sorted dictionary => int32 code comparisons preserve lex order
+        uniq = sorted({v for v, p in zip(raw, present) if p and v is not None})
+        lookup = {s: i for i, s in enumerate(uniq)}
+        vals = np.full(n, MISSING_INT, np.int32)
+        for i, (v, p) in enumerate(zip(raw, present)):
+            if p and v is not None:
+                vals[i] = lookup[v]
+        return PropertyColumn(name, "str", vals, present, uniq)
+    if kind == "float":
+        vals = np.full(n, MISSING_FLOAT, np.float32)
+        for i, (v, p) in enumerate(zip(raw, present)):
+            if p and v is not None:
+                vals[i] = float(v)
+        return PropertyColumn(name, "float", vals, present)
+    # int / bool
+    vals = np.full(n, MISSING_INT, np.int32)
+    for i, (v, p) in enumerate(zip(raw, present)):
+        if p and v is not None:
+            iv = int(v)
+            if not (-(2**31) + 2 <= iv < 2**31):
+                # out-of-range int: promote the whole column to float
+                return _column_from_values(
+                    name, [float(x) if x is not None else None for x in raw], present
+                )
+            vals[i] = iv
+    return PropertyColumn(name, kind, vals, present)
+
+
+def _build_columns(docs: Sequence[Document]) -> Dict[str, PropertyColumn]:
+    n = len(docs)
+    names: List[str] = []
+    seen = set()
+    for d in docs:
+        for f in d.field_names():
+            if f not in seen:
+                seen.add(f)
+                names.append(f)
+    out: Dict[str, PropertyColumn] = {}
+    for name in names:
+        raw = [d.get(name) for d in docs]
+        present = np.array([d.has(name) and d.get(name) is not None for d in docs])
+        col = _column_from_values(name, raw, present)
+        if col is not None:
+            out[name] = col
+        else:
+            log.info("property %r not columnar; TPU predicates fall back", name)
+    return out
+
+
+def build_snapshot(db: Database) -> GraphSnapshot:
+    """Export the host store into a columnar snapshot (the bulk-load step of
+    the north star: plocal clusters → CSR in HBM)."""
+    snap = GraphSnapshot()
+    snap.epoch = db.mutation_epoch
+
+    # ---- vertex universe (deterministic RID order) ----
+    vertex_classes = [
+        c for c in db.schema.classes() if c.is_vertex_type and not c.abstract
+    ]
+    vertices: List[Vertex] = []
+    for cls in sorted(vertex_classes, key=lambda c: c.name):
+        for doc in db.browse_class(cls.name, polymorphic=False):
+            if isinstance(doc, Vertex):
+                vertices.append(doc)
+    vertices.sort(key=lambda v: (v.rid.cluster, v.rid.position))
+    V = len(vertices)
+    snap.num_vertices = V
+    snap.v_cluster = np.array([v.rid.cluster for v in vertices], np.int32)
+    snap.v_position = np.array([v.rid.position for v in vertices], np.int32)
+    snap.rid_to_idx = {v.rid: i for i, v in enumerate(vertices)}
+
+    # ---- classes ----
+    all_classes = sorted(db.schema.classes(), key=lambda c: c.name)
+    snap.class_names = [c.name for c in all_classes]
+    snap.class_id_of = {c.name.lower(): i for i, c in enumerate(all_classes)}
+    snap.v_class = np.array(
+        [snap.class_id_of[v.class_name.lower()] for v in vertices], np.int32
+    )
+    for c in all_classes:
+        closure = [
+            snap.class_id_of[s.name.lower()] for s in c.subclasses(include_self=True)
+        ]
+        snap.class_closure[c.name.lower()] = np.array(sorted(closure), np.int32)
+
+    # ---- vertex property columns ----
+    snap.v_columns = _build_columns(vertices)
+
+    # ---- edges per concrete edge class ----
+    edge_classes = [c for c in db.schema.classes() if c.is_edge_type and not c.abstract]
+    for cls in sorted(edge_classes, key=lambda c: c.name):
+        edges: List[Edge] = [
+            e
+            for e in db.browse_class(cls.name, polymorphic=False)
+            if isinstance(e, Edge)
+        ]
+        # drop dangling edges defensively (cascade delete should prevent them)
+        edges = [
+            e
+            for e in edges
+            if e.out_rid in snap.rid_to_idx and e.in_rid in snap.rid_to_idx
+        ]
+        csr = EdgeClassCSR(cls.name)
+        E = len(edges)
+        src = np.array([snap.rid_to_idx[e.out_rid] for e in edges], np.int64)
+        dst = np.array([snap.rid_to_idx[e.in_rid] for e in edges], np.int64)
+        # CSR out: stable sort by src keeps per-vertex bag order (parity with
+        # the host store's RidBag iteration order)
+        order = np.argsort(src, kind="stable")
+        csr.dst = dst[order].astype(np.int32)
+        counts = np.bincount(src, minlength=V).astype(np.int64)
+        csr.indptr_out = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        csr.out_degree_max = int(counts.max()) if V else 0
+        ordered_edges = [edges[i] for i in order]
+        csr.edge_rids = [e.rid for e in ordered_edges]
+        csr.edge_columns = _build_columns(ordered_edges)
+        # CSR in: sort (dst, position) — edge ids refer to out order
+        src_o = src[order]
+        dst_o = dst[order]
+        order_in = np.argsort(dst_o, kind="stable")
+        csr.src = src_o[order_in].astype(np.int32)
+        csr.edge_id_in = order_in.astype(np.int32)
+        counts_in = np.bincount(dst_o, minlength=V).astype(np.int64)
+        csr.indptr_in = np.concatenate([[0], np.cumsum(counts_in)]).astype(np.int32)
+        csr.in_degree_max = int(counts_in.max()) if V else 0
+        snap.edge_classes[cls.name] = csr
+        del E
+    # polymorphic edge closure
+    for c in sorted(db.schema.classes(), key=lambda c: c.name):
+        if not c.is_edge_type:
+            continue
+        concrete = [
+            s.name
+            for s in c.subclasses(include_self=True)
+            if s.name in snap.edge_classes
+        ]
+        snap.edge_closure[c.name.lower()] = sorted(concrete)
+
+    log.info("built snapshot: %s", snap.summary())
+    return snap
+
+
+def attach_fresh_snapshot(db: Database) -> GraphSnapshot:
+    """Build + attach in one step (convenience for the query front door)."""
+    snap = build_snapshot(db)
+    db.attach_snapshot(snap)
+    return snap

@@ -277,3 +277,52 @@ class TestEngineFrontDoor:
         assert rs.engine == "oracle"
         rs = db.query("SELECT FROM Zz")
         assert rs.engine == "oracle"
+
+
+class TestReviewRegressions:
+    """Regression tests for code-review findings on the SQL layer."""
+
+    def test_select_distinct(self, social_db):
+        rows = q(social_db, "SELECT DISTINCT surname FROM Profiles")
+        # fixture has no surname field; use a created one
+        c(social_db, "UPDATE Profiles SET grp = 'x' WHERE age < 30")
+        c(social_db, "UPDATE Profiles SET grp = 'y' WHERE age >= 30")
+        rows = q(social_db, "SELECT DISTINCT grp FROM Profiles ORDER BY grp")
+        assert rows == [{"grp": "x"}, {"grp": "y"}]
+
+    def test_limit_minus_one_unlimited(self, social_db):
+        rows = q(social_db, "SELECT FROM Profiles LIMIT -1")
+        assert len(rows) == 5
+        rows = q(social_db, "SELECT FROM Profiles SKIP 1 LIMIT -1")
+        assert len(rows) == 4
+
+    def test_not_between(self, social_db):
+        rows = q(social_db, "SELECT name FROM Profiles WHERE age NOT BETWEEN 26 AND 39 ORDER BY name")
+        assert [r["name"] for r in rows] == ["bob", "dave"]
+
+    def test_insert_return(self, db):
+        c(db, "CREATE CLASS P")
+        rows = c(db, "INSERT INTO P SET a = 41 RETURN a + 1")
+        assert rows == [{"result": 42}]
+
+    def test_delete_edge_from_to_limit(self, db):
+        c(db, "CREATE CLASS Knows EXTENDS E")
+        a = db.new_vertex("V", n="a")
+        b = db.new_vertex("V", n="b")
+        db.new_edge("Knows", a, b)
+        db.new_edge("Knows", a, b)
+        r = c(db, f"DELETE EDGE Knows FROM {a.rid} TO {b.rid} LIMIT 1")
+        assert r == [{"count": 1}]
+        assert q(db, "SELECT count(*) AS n FROM Knows") == [{"n": 1}]
+
+    def test_match_cross_arm_matched_where(self, db):
+        db.schema.create_vertex_class("A")
+        db.schema.create_vertex_class("B")
+        db.new_vertex("A", x=1)
+        db.new_vertex("B", x=1)
+        db.new_vertex("B", x=2)
+        rows = q(
+            db,
+            "MATCH {class:A, as:a}, {class:B, as:b, where:(x = $matched.a.x)} RETURN b.x AS bx",
+        )
+        assert rows == [{"bx": 1}]
