@@ -975,94 +975,102 @@ class MatchInterpreter:
     # -- RETURN ------------------------------------------------------------
 
     def rows(self) -> List[Result]:
-        stmt = self.stmt
-        out: List[Result] = []
         named = [
-            n.alias
-            for n in self.pattern.nodes.values()
-            if not n.anonymous
+            n.alias for n in self.pattern.nodes.values() if not n.anonymous
         ]
-        returns = stmt.returns
-        special = None
-        if len(returns) == 1 and isinstance(returns[0].expr, A.ContextVar):
-            cv = returns[0].expr.name.lower()
-            if cv in ("matches", "paths", "elements", "pathelements"):
-                special = cv
-        aggregate_mode = bool(stmt.group_by) or any(
-            contains_aggregate(p.expr) for p in returns
+        return match_rows_from_bindings(
+            self.db, self.stmt, named, self.solve(), self.params, self.parent_ctx
         )
-        if aggregate_mode:
-            sel = A.SelectStatement(
-                projections=returns, target=None, group_by=stmt.group_by
-            )
-            filtered = []
-            for bindings in self.solve():
-                ctx = EvalContext(
-                    self.db,
-                    current=None,
-                    params=self.params,
-                    variables=_return_vars(bindings, named),
-                    parent=self.parent_ctx,
-                )
-                filtered.append((ctx, None))
-            out = _aggregate_rows(self.db, sel, filtered, self.params, self.parent_ctx)
-            out = _order_rows(out, stmt.order_by, self.db, self.params, self.parent_ctx)
-            base_ctx = EvalContext(self.db, params=self.params, parent=self.parent_ctx)
-            return _skip_limit(out, stmt.skip, stmt.limit, base_ctx)
-        for bindings in self.solve():
-            if special in ("matches", "paths"):
-                aliases = (
-                    named
-                    if special == "matches"
-                    else [a for a in bindings if not _is_internal_alias(a, named)]
-                )
-                props = {a: bindings.get(a) for a in aliases}
-                out.append(Result(props=props))
-                continue
-            if special in ("elements", "pathelements"):
-                aliases = named if special == "elements" else list(bindings.keys())
-                for a in aliases:
-                    v = bindings.get(a)
-                    if isinstance(v, Document):
-                        out.append(Result(element=v))
-                continue
-            ctx = EvalContext(
-                self.db,
-                current=None,
-                params=self.params,
-                variables=_return_vars(bindings, named),
-                parent=self.parent_ctx,
-            )
-            props = {}
-            for i, p in enumerate(returns):
-                name = p.alias or _match_proj_name(p.expr, i)
-                props[name] = evaluate(ctx, p.expr)
-            out.append(Result(props=props))
 
-        if stmt.distinct:
-            seen = set()
-            deduped = []
-            for r in out:
-                key = _canonical(r)
-                if key not in seen:
-                    seen.add(key)
-                    deduped.append(r)
-            out = deduped
-        for field in stmt.unwind:
-            unwound = []
-            for r in out:
-                vals = as_list(r.get_property(field))
-                if not vals:
-                    unwound.append(r)
-                for v in vals:
-                    rr = Result(props={k: r.get_property(k) for k in r.property_names()})
-                    rr.set_property(field, v)
-                    unwound.append(rr)
-            out = unwound
-        out = _order_rows(out, stmt.order_by, self.db, self.params, self.parent_ctx)
-        base_ctx = EvalContext(self.db, params=self.params, parent=self.parent_ctx)
-        out = _skip_limit(out, stmt.skip, stmt.limit, base_ctx)
-        return out
+
+def match_rows_from_bindings(
+    db, stmt: A.MatchStatement, named: List[str], bindings_iter, params, parent_ctx
+) -> List[Result]:
+    """RETURN/DISTINCT/UNWIND/ORDER/SKIP/LIMIT marshalling shared by the
+    oracle interpreter and the TPU engine — both produce binding dicts
+    (alias → Document/None), so result semantics are defined once here."""
+    out: List[Result] = []
+    returns = stmt.returns
+    special = None
+    if len(returns) == 1 and isinstance(returns[0].expr, A.ContextVar):
+        cv = returns[0].expr.name.lower()
+        if cv in ("matches", "paths", "elements", "pathelements"):
+            special = cv
+    aggregate_mode = bool(stmt.group_by) or any(
+        contains_aggregate(p.expr) for p in returns
+    )
+    if aggregate_mode:
+        sel = A.SelectStatement(
+            projections=returns, target=None, group_by=stmt.group_by
+        )
+        filtered = []
+        for bindings in bindings_iter:
+            ctx = EvalContext(
+                db,
+                current=None,
+                params=params,
+                variables=_return_vars(bindings, named),
+                parent=parent_ctx,
+            )
+            filtered.append((ctx, None))
+        out = _aggregate_rows(db, sel, filtered, params, parent_ctx)
+        out = _order_rows(out, stmt.order_by, db, params, parent_ctx)
+        base_ctx = EvalContext(db, params=params, parent=parent_ctx)
+        return _skip_limit(out, stmt.skip, stmt.limit, base_ctx)
+    for bindings in bindings_iter:
+        if special in ("matches", "paths"):
+            aliases = (
+                named
+                if special == "matches"
+                else [a for a in bindings if not _is_internal_alias(a, named)]
+            )
+            props = {a: bindings.get(a) for a in aliases}
+            out.append(Result(props=props))
+            continue
+        if special in ("elements", "pathelements"):
+            aliases = named if special == "elements" else list(bindings.keys())
+            for a in aliases:
+                v = bindings.get(a)
+                if isinstance(v, Document):
+                    out.append(Result(element=v))
+            continue
+        ctx = EvalContext(
+            db,
+            current=None,
+            params=params,
+            variables=_return_vars(bindings, named),
+            parent=parent_ctx,
+        )
+        props = {}
+        for i, p in enumerate(returns):
+            name = p.alias or _match_proj_name(p.expr, i)
+            props[name] = evaluate(ctx, p.expr)
+        out.append(Result(props=props))
+
+    if stmt.distinct:
+        seen = set()
+        deduped = []
+        for r in out:
+            key = _canonical(r)
+            if key not in seen:
+                seen.add(key)
+                deduped.append(r)
+        out = deduped
+    for field in stmt.unwind:
+        unwound = []
+        for r in out:
+            vals = as_list(r.get_property(field))
+            if not vals:
+                unwound.append(r)
+            for v in vals:
+                rr = Result(props={k: r.get_property(k) for k in r.property_names()})
+                rr.set_property(field, v)
+                unwound.append(rr)
+        out = unwound
+    out = _order_rows(out, stmt.order_by, db, params, parent_ctx)
+    base_ctx = EvalContext(db, params=params, parent=parent_ctx)
+    out = _skip_limit(out, stmt.skip, stmt.limit, base_ctx)
+    return out
 
 
 def _is_internal_alias(a: str, named: List[str]) -> bool:

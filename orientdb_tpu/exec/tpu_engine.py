@@ -1,13 +1,606 @@
-"""Compiled TPU execution engine (placeholder — lands with the snapshot
-layer; see `orientdb_tpu/ops/` and SURVEY.md §7 step 3)."""
+"""Compiled TPU MATCH engine — batched binding-table execution.
+
+The reference executes MATCH as a per-record interpreted DFS
+([E] OMatchExecutionPlanner → MatchStep → MatchEdgeTraverser,
+SURVEY.md §3.3): one RidBag walk, N document loads and an interpreted WHERE
+per candidate edge. This engine replaces that hot loop wholesale:
+
+- the pattern graph compiles to a **static plan** of steps (root scan,
+  edge expansion, optional left-join) whose ordering replicates the
+  oracle's greedy smallest-candidate-first choice ([E]
+  OMatchExecutionPlanner's ordering) — the order is data-independent given
+  host-side class counts, so the whole plan is known before launch;
+- intermediate state is a **binding table**: one int32 device column per
+  alias (dense vertex index, -1 = null), plus (class, edge-pos) column
+  pairs for edge aliases and int32 columns for depth aliases;
+- each pattern-edge hop is a batched CSR **count → scan → rank-search
+  gather** (`orientdb_tpu/ops/csr.py`) with node/edge WHERE predicates
+  applied as fused columnar masks (`orientdb_tpu/ops/predicates.py`);
+- results marshal back through the SAME RETURN/DISTINCT/ORDER path as the
+  oracle (`oracle.match_rows_from_bindings`), so result semantics are
+  defined once and parity is structural.
+
+Anything outside the compiled subset raises `Uncompilable` and the front
+door falls back to the oracle — behavior stays total while the compiled
+surface grows.
+"""
 
 from __future__ import annotations
 
+from typing import Dict, List, Optional, Tuple
 
-class Uncompilable(Exception):
-    """Statement (or feature) the TPU engine cannot compile; the front door
-    falls back to the oracle unless strict."""
+import jax.numpy as jnp
+import numpy as np
+
+from orientdb_tpu.exec.oracle import (
+    MatchInterpreter,
+    Pattern,
+    PatternEdge,
+    PatternNode,
+    match_rows_from_bindings,
+    _expr_uses_bindings,
+    _REVERSE_DIR,
+)
+from orientdb_tpu.exec.result import Result
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.ops import csr as K
+from orientdb_tpu.ops.device_graph import DeviceGraph, device_graph
+from orientdb_tpu.ops.predicates import ColumnScope, Uncompilable, compile_predicate
+from orientdb_tpu.sql import ast as A
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("tpu_engine")
 
 
-def execute(db, stmt, params):
-    raise Uncompilable("TPU engine not built yet")
+
+# ---------------------------------------------------------------------------
+# binding table
+# ---------------------------------------------------------------------------
+
+
+class Table:
+    """Device binding table: padded columns + a host-known valid count."""
+
+    def __init__(self, count: int = 1, width: int = 0) -> None:
+        #: alias → int32 [B] dense vertex index (-1 null / padding)
+        self.cols: Dict[str, jnp.ndarray] = {}
+        #: edge alias → (class_idx int32 [B], edge_pos int32 [B])
+        self.edge_cols: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        #: depth alias → int32 [B]
+        self.depth_cols: Dict[str, jnp.ndarray] = {}
+        self.count = count  # valid rows; starts at 1 (the empty binding)
+        self.width = width  # bucketed column length (0 = no columns yet)
+
+    def empty(self) -> bool:
+        return self.count == 0
+
+    def has(self, alias: str) -> bool:
+        return alias in self.cols or alias in self.edge_cols
+
+    def gather(self, rows: jnp.ndarray) -> "Table":
+        """New table selecting `rows` (padded with -1) from this one."""
+        t = Table(count=self.count, width=int(rows.shape[0]))
+        for a, c in self.cols.items():
+            t.cols[a] = K.take_pad(c, rows, jnp.int32(-1))
+        for a, (ci, pos) in self.edge_cols.items():
+            t.edge_cols[a] = (
+                K.take_pad(ci, rows, jnp.int32(-1)),
+                K.take_pad(pos, rows, jnp.int32(-1)),
+            )
+        for a, c in self.depth_cols.items():
+            t.depth_cols[a] = K.take_pad(c, rows, jnp.int32(-1))
+        return t
+
+
+def _concat_tables(parts: List[Table], counts: List[int]) -> Table:
+    """Concatenate gathered part-tables (same column sets) and re-bucket."""
+    total = sum(counts)
+    out = Table(count=total, width=K.bucket(total))
+    if not parts:
+        out.count = 0
+        return out
+    keys = parts[0].cols.keys()
+    for a in keys:
+        segs = [p.cols[a][: c] for p, c in zip(parts, counts)]
+        out.cols[a] = _pad_concat(segs, out.width)
+    for a in parts[0].edge_cols.keys():
+        ci = _pad_concat([p.edge_cols[a][0][:c] for p, c in zip(parts, counts)], out.width)
+        ps = _pad_concat([p.edge_cols[a][1][:c] for p, c in zip(parts, counts)], out.width)
+        out.edge_cols[a] = (ci, ps)
+    for a in parts[0].depth_cols.keys():
+        out.depth_cols[a] = _pad_concat(
+            [p.depth_cols[a][:c] for p, c in zip(parts, counts)], out.width
+        )
+    return out
+
+
+def _pad_concat(segs: List[jnp.ndarray], width: int) -> jnp.ndarray:
+    cat = jnp.concatenate(segs) if segs else jnp.zeros(0, jnp.int32)
+    pad = width - cat.shape[0]
+    if pad > 0:
+        cat = jnp.concatenate([cat, jnp.full(pad, -1, jnp.int32)])
+    return cat
+
+
+# ---------------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------------
+
+
+class PlanStep:
+    __slots__ = ("kind", "alias", "edge", "reverse", "close")
+
+    def __init__(self, kind, alias=None, edge=None, reverse=False, close=False):
+        self.kind = kind  # 'root' | 'expand' | 'optional'
+        self.alias = alias
+        self.edge: Optional[PatternEdge] = edge
+        self.reverse = reverse
+        self.close = close
+
+    def describe(self) -> str:
+        if self.kind == "root":
+            return f"ROOT {self.alias}"
+        e = self.edge
+        arrow = "<-" if self.reverse else "->"
+        return f"{self.kind.upper()} {e.from_alias}{arrow}{e.to_alias}"
+
+
+def build_plan(pattern: Pattern, interp: MatchInterpreter) -> List[PlanStep]:
+    """Static replay of the oracle's dynamic edge ordering (the bound-alias
+    set evolves data-independently, so the greedy choice is a compile-time
+    computation here; [E] OMatchExecutionPlanner does the analogous
+    estimate-driven ordering once per query)."""
+    steps: List[PlanStep] = []
+    bound: set = set()
+    required = [e for e in pattern.edges if not interp._edge_is_optional(e)]
+    optionals = [e for e in pattern.edges if interp._edge_is_optional(e)]
+    edges = list(required)
+    while edges:
+        def rank(e: PatternEdge) -> int:
+            fb, tb = e.from_alias in bound, e.to_alias in bound
+            if fb and tb:
+                return 0
+            if fb:
+                return 1
+            if tb:
+                return 2
+            return 3
+
+        order = sorted(range(len(edges)), key=lambda i: rank(edges[i]))
+        i = order[0]
+        e = edges.pop(i)
+        r = rank(e)
+        if r == 3:
+            fn, tn = pattern.nodes[e.from_alias], pattern.nodes[e.to_alias]
+            root = fn if interp.estimate(fn) <= interp.estimate(tn) else tn
+            steps.append(PlanStep("root", alias=root.alias))
+            bound.add(root.alias)
+            edges.insert(0, e)
+            continue
+        if r == 0:
+            steps.append(PlanStep("expand", edge=e, close=True))
+        elif r == 1:
+            steps.append(PlanStep("expand", edge=e))
+        else:
+            steps.append(PlanStep("expand", edge=e, reverse=True))
+        bound.add(e.from_alias)
+        bound.add(e.to_alias)
+        f = e.item.edge_filter
+        if f is not None and f.alias:
+            bound.add(f.alias)
+    # isolated nodes (same admission rule as oracle.solve)
+    for n in pattern.nodes.values():
+        if (
+            not any(
+                e.from_alias == n.alias or e.to_alias == n.alias for e in required
+            )
+            and not n.optional
+            and n.filters
+            and n.alias not in bound
+        ):
+            if n.is_edge_alias:
+                raise Uncompilable("unbound edge alias would scan all edges")
+            steps.append(PlanStep("root", alias=n.alias))
+            bound.add(n.alias)
+    # optional edges: oracle picks (in list order) the first with a decided
+    # endpoint; replay statically
+    opts = list(optionals)
+    while opts:
+        pick = None
+        for i, e in enumerate(opts):
+            if e.from_alias in bound or e.to_alias in bound:
+                pick = i
+                break
+        if pick is None:
+            # fully detached optional arms bind null; no step needed (their
+            # aliases marshal as None)
+            for e in opts:
+                bound.add(e.from_alias)
+                bound.add(e.to_alias)
+            break
+        e = opts.pop(pick)
+        fb = e.from_alias in bound
+        tb = e.to_alias in bound
+        steps.append(
+            PlanStep("optional", edge=e, reverse=not fb, close=(fb and tb))
+        )
+        bound.add(e.from_alias)
+        bound.add(e.to_alias)
+    return steps
+
+
+# ---------------------------------------------------------------------------
+# the solver
+# ---------------------------------------------------------------------------
+
+
+class TpuMatchSolver:
+    def __init__(self, db, stmt: A.MatchStatement, params: Dict) -> None:
+        self.db = db
+        self.stmt = stmt
+        self.params = params
+        snap = db.current_snapshot(require_fresh=True)
+        if snap is None:
+            raise Uncompilable("no fresh snapshot attached")
+        self.snap = snap
+        self.dg: DeviceGraph = device_graph(snap)
+        # reuse the oracle's pattern build + estimates (host planning data)
+        self.interp = MatchInterpreter(db, stmt, params)
+        self.pattern = self.interp.pattern
+        self.not_paths = self.interp.not_paths
+        self.edge_class_list = sorted(self.dg.edges.keys())
+        self.edge_class_idx = {n: i for i, n in enumerate(self.edge_class_list)}
+        self._vertex_scope_cache: Optional[ColumnScope] = None
+        self._check_supported()
+        self.plan = build_plan(self.pattern, self.interp)
+        # pre-compile all node/edge predicates (fail fast → fallback)
+        self._node_masks: Dict[str, object] = {}
+        for alias, node in self.pattern.nodes.items():
+            self._node_masks[alias] = self._compile_node(node)
+
+    # -- compile-time gating ------------------------------------------------
+
+    def _check_supported(self) -> None:
+        if self.not_paths:
+            raise Uncompilable("NOT patterns not compiled yet")
+        reserved = set(self.pattern.nodes.keys())
+        for e in self.pattern.edges:
+            item = e.item
+            m = (item.method or "").lower()
+            if m in ("outv", "inv", "bothv", "oute", "ine", "bothe"):
+                raise Uncompilable(f"method form .{m}() not compiled yet")
+            if item.target.while_cond is not None or item.target.max_depth is not None:
+                raise Uncompilable("WHILE/maxDepth not compiled yet")
+            if item.target.path_alias:
+                raise Uncompilable("pathAlias not compiled (per-path state)")
+            if item.negated:
+                raise Uncompilable("negated path item")
+            f = item.edge_filter
+            if f is not None and f.where is not None and _expr_uses_bindings(
+                f.where, self.pattern.nodes
+            ):
+                raise Uncompilable("edge WHERE references bindings")
+        # edge-alias nodes are fine when bound by an edge-filter alias during
+        # a (required or close) expansion; a bare edge-alias root is not
+        edge_filter_aliases = {
+            e.item.edge_filter.alias
+            for e in self.pattern.edges
+            if e.item.edge_filter is not None and e.item.edge_filter.alias
+        }
+        for node in self.pattern.nodes.values():
+            if node.is_edge_alias and node.alias not in edge_filter_aliases:
+                raise Uncompilable("edge-alias pattern nodes not compiled yet")
+            for f in node.filters:
+                if f.where is not None and _expr_uses_bindings(
+                    f.where, self.pattern.nodes
+                ):
+                    raise Uncompilable("node WHERE references bindings")
+
+    # -- predicate compilation ---------------------------------------------
+
+    def _vertex_scope(self) -> ColumnScope:
+        if self._vertex_scope_cache is None:
+            self._vertex_scope_cache = ColumnScope(
+                self.dg.columns,
+                self.dg.non_columnar,
+                reserved=set(self.pattern.nodes.keys()),
+            )
+        return self._vertex_scope_cache
+
+    def _compile_node(self, node: PatternNode):
+        """Node admission mask: fn(idx_array) -> bool mask over vertex ids.
+
+        Mirrors oracle.check_node: class closure ∧ rid ∧ WHERE."""
+        parts = []
+        for f in node.filters:
+            if f.class_name:
+                ids = self.dg.class_ids(f.class_name)
+                parts.append(self._class_mask_fn(ids))
+            if f.rid is not None:
+                want = self.snap.idx_of(RID(f.rid.cluster, f.rid.position))
+                wi = -2 if want is None else want  # -2 matches nothing (≠ -1 pad)
+                parts.append(lambda idx, env, wi=wi: idx == wi)
+            if f.where is not None:
+                fn = compile_predicate(f.where, self._vertex_scope(), self.params)
+                parts.append(fn)
+
+        def mask(idx, env=None, parts=parts):
+            env = env or {}
+            m = idx >= 0
+            for p in parts:
+                m = m & p(idx, env)
+            return m
+
+        return mask
+
+    def _class_mask_fn(self, ids: jnp.ndarray):
+        def fn(idx, env, ids=ids):
+            cls = K.take_pad(self.dg.v_class, idx, jnp.int32(-1))
+            if ids.shape[0] == 0:
+                return jnp.zeros(idx.shape, bool)
+            return jnp.isin(cls, ids)
+
+        return fn
+
+    def _edge_where(self, concrete: str, where: A.Expression):
+        dec = self.dg.edges[concrete]
+        scope = ColumnScope(
+            dec.columns, dec.non_columnar, reserved=set(self.pattern.nodes.keys())
+        )
+        return compile_predicate(where, scope, self.params)
+
+    # -- execution ----------------------------------------------------------
+
+    def solve_table(self) -> Table:
+        table = Table(count=1, width=0)
+        for step in self.plan:
+            if table.empty():
+                # required-edge pipeline already empty → no rows; optional
+                # steps cannot resurrect rows
+                return table
+            if step.kind == "root":
+                table = self._root(table, step.alias)
+            elif step.kind == "expand":
+                table = self._expand(table, step, optional=False)
+            else:
+                table = self._expand(table, step, optional=True)
+        return table
+
+    def _root_candidates(self, alias: str) -> Tuple[jnp.ndarray, int]:
+        node = self.pattern.nodes[alias]
+        V = self.dg.num_vertices
+        idx = jnp.arange(K.bucket(max(V, 1)), dtype=jnp.int32)
+        idx = jnp.where(idx < V, idx, -1)
+        mask = self._node_masks[alias](idx)
+        cand, n = K.compact(mask)
+        cand = K.take_pad(idx, cand, jnp.int32(-1))
+        return cand, n
+
+    def _root(self, table: Table, alias: str) -> Table:
+        cand, n = self._root_candidates(alias)
+        if table.width == 0 and not table.cols:
+            t = Table(count=n, width=int(cand.shape[0]))
+            t.cols[alias] = cand
+            return t
+        # cartesian product with the existing table
+        old_n, new_n = table.count, n
+        total = old_n * new_n
+        width = K.bucket(max(total, 1))
+        pos = jnp.arange(width, dtype=jnp.int32)
+        valid = pos < total
+        if new_n == 0:
+            rows = jnp.full(width, -1, jnp.int32)
+            t = table.gather(rows)
+            t.count = 0
+            t.cols[alias] = rows
+            return t
+        rows = jnp.where(valid, pos // new_n, -1)
+        sel = jnp.where(valid, pos % new_n, -1)
+        t = table.gather(rows)
+        t.count = total
+        t.cols[alias] = K.take_pad(cand, sel, jnp.int32(-1))
+        return t
+
+    def _expand(self, table: Table, step: PlanStep, optional: bool) -> Table:
+        e = step.edge
+        item = e.item
+        direction = item.direction
+        reverse = step.reverse
+        if reverse:
+            direction = _REVERSE_DIR[direction]
+        src_alias = e.to_alias if reverse else e.from_alias
+        dst_alias = e.from_alias if reverse else e.to_alias
+        dst_node = self.pattern.nodes[dst_alias]
+        srcs = table.cols.get(src_alias)
+        if srcs is None:
+            raise Uncompilable(f"alias {src_alias} not bound before expansion")
+        # concrete edge classes in declaration order
+        names = item.edge_classes or (None,)
+        concrete: List[str] = []
+        for nm in names:
+            concrete.extend(self.snap.concrete_edge_classes(nm))
+        # edge-filter class restriction is a host-side subclass check
+        f = item.edge_filter
+        if f is not None and f.class_name:
+            keep = []
+            for c in concrete:
+                cls = self.db.schema.get_class(c)
+                if cls is not None and cls.is_subclass_of(f.class_name):
+                    keep.append(c)
+            concrete = keep
+        sub_dirs = ("out", "in") if direction == "both" else (direction,)
+        parts: List[Table] = []
+        counts: List[int] = []
+        matched_any = jnp.zeros(table.width or 1, jnp.int32)
+        for cname in concrete:
+            dec = self.dg.edges[cname]
+            where_fn = (
+                self._edge_where(cname, f.where)
+                if (f is not None and f.where is not None)
+                else None
+            )
+            for d in sub_dirs:
+                if d == "out":
+                    indptr, nbrs = dec.indptr_out, dec.dst
+                else:
+                    indptr, nbrs = dec.indptr_in, dec.src
+                row, edge_pos, nbr, total = K.expand_step(indptr, nbrs, srcs)
+                if total == 0:
+                    continue
+                # edge ids in out-CSR order (edge property columns / RIDs)
+                if d == "out":
+                    eid = edge_pos
+                else:
+                    eid = K.take_pad(dec.edge_id_in, edge_pos, jnp.int32(-1))
+                mask = row >= 0
+                if where_fn is not None:
+                    mask = mask & where_fn(eid, {})
+                # destination node admission
+                mask = mask & self._node_masks[dst_alias](nbr)
+                if step.close:
+                    bound = K.take_pad(table.cols[dst_alias], row, jnp.int32(-2))
+                    mask = mask & (nbr == bound)
+                if optional:
+                    matched_any = matched_any + K.rows_with_matches(
+                        row, mask, table.width or 1
+                    )
+                keep, kn = K.compact(mask)
+                if kn == 0:
+                    continue
+                krow = K.take_pad(row, keep, jnp.int32(-1))
+                part = table.gather(krow)
+                part.count = kn
+                part.cols[dst_alias] = K.take_pad(nbr, keep, jnp.int32(-1))
+                ecls_idx = self.edge_class_idx[cname]
+                keid = K.take_pad(eid, keep, jnp.int32(-1))
+                self._bind_edge_alias(part, item, ecls_idx, keid)
+                if item.target.depth_alias:
+                    part.depth_cols[item.target.depth_alias] = jnp.where(
+                        part.cols[dst_alias] >= 0, 1, -1
+                    )
+                parts.append(part)
+                counts.append(kn)
+        if optional:
+            # left-join: rows with zero matches keep their binding, dst=null
+            matched = matched_any[: table.width] > 0 if table.width else matched_any[:0]
+            rowids = jnp.arange(table.width, dtype=jnp.int32)
+            valid_rows = rowids < table.count
+            unmatched = valid_rows & ~matched
+            ukeep, un = K.compact(unmatched)
+            if un > 0:
+                upart = table.gather(ukeep)
+                upart.count = un
+                null_col = jnp.full(upart.width, -1, jnp.int32)
+                if step.close:
+                    # oracle: null src uses setdefault (keeps the bound dst);
+                    # non-null src with no match explicitly nulls it
+                    src_g = K.take_pad(srcs, ukeep, jnp.int32(-1))
+                    upart.cols[dst_alias] = jnp.where(
+                        src_g < 0, upart.cols[dst_alias], -1
+                    )
+                else:
+                    upart.cols[dst_alias] = null_col
+                self._bind_edge_alias(upart, item, -1, null_col)
+                if item.target.depth_alias:
+                    upart.depth_cols[item.target.depth_alias] = null_col
+                parts.append(upart)
+                counts.append(un)
+        if not parts:
+            # preserve column structure for downstream steps
+            t = table.gather(jnp.full(K.bucket(1), -1, jnp.int32))
+            t.count = 0
+            t.cols[dst_alias] = jnp.full(t.width, -1, jnp.int32)
+            self._bind_edge_alias(t, item, -1, jnp.full(t.width, -1, jnp.int32))
+            if item.target.depth_alias:
+                t.depth_cols[item.target.depth_alias] = jnp.full(
+                    t.width, -1, jnp.int32
+                )
+            return t
+        return _concat_tables(parts, counts)
+
+    def _bind_edge_alias(self, part: Table, item: A.MatchPathItem, ecls_idx, eid):
+        f = item.edge_filter
+        if f is not None and f.alias:
+            if isinstance(ecls_idx, int):
+                ci = jnp.where(eid >= 0, ecls_idx, -1)
+            else:
+                ci = ecls_idx
+            part.edge_cols[f.alias] = (ci, eid)
+
+    # -- marshalling --------------------------------------------------------
+
+    def bindings(self) -> List[Dict[str, object]]:
+        table = self.solve_table()
+        n = table.count
+        cols = {a: np.asarray(c)[:n] for a, c in table.cols.items()}
+        ecols = {
+            a: (np.asarray(ci)[:n], np.asarray(pos)[:n])
+            for a, (ci, pos) in table.edge_cols.items()
+        }
+        dcols = {a: np.asarray(c)[:n] for a, c in table.depth_cols.items()}
+        # aliases that never hit a table column (fully detached optional
+        # arms) marshal as None
+        missing = [
+            a
+            for a in self.pattern.nodes
+            if a not in cols and a not in ecols
+        ]
+        out: List[Dict[str, object]] = []
+        doc_cache: Dict[int, object] = {}
+        edge_cache: Dict[Tuple[int, int], object] = {}
+        for i in range(n):
+            b: Dict[str, object] = {}
+            for a, arr in cols.items():
+                v = int(arr[i])
+                if v < 0:
+                    b[a] = None
+                else:
+                    doc = doc_cache.get(v)
+                    if doc is None:
+                        doc = self.db.load(self.snap.rid_of(v))
+                        doc_cache[v] = doc
+                    b[a] = doc
+            for a, (ci, pos) in ecols.items():
+                c, p = int(ci[i]), int(pos[i])
+                if c < 0 or p < 0:
+                    b[a] = None
+                else:
+                    ed = edge_cache.get((c, p))
+                    if ed is None:
+                        rid = self.snap.edge_classes[self.edge_class_list[c]].edge_rids[p]
+                        ed = self.db.load(rid)
+                        edge_cache[(c, p)] = ed
+                    b[a] = ed
+            for a, arr in dcols.items():
+                v = int(arr[i])
+                b[a] = None if v < 0 else v
+            for a in missing:
+                b[a] = None
+            out.append(b)
+        return out
+
+    def rows(self) -> List[Result]:
+        named = [
+            n.alias for n in self.pattern.nodes.values() if not n.anonymous
+        ]
+        return match_rows_from_bindings(
+            self.db, self.stmt, named, self.bindings(), self.params, None
+        )
+
+
+# ---------------------------------------------------------------------------
+# front door
+# ---------------------------------------------------------------------------
+
+
+def execute(db, stmt, params) -> List[Result]:
+    if isinstance(stmt, A.MatchStatement):
+        solver = TpuMatchSolver(db, stmt, params or {})
+        return solver.rows()
+    raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
+
+
+def explain_plan_steps(db, stmt) -> List[str]:
+    """Plan description for EXPLAIN (the [E] prettyPrint analog)."""
+    solver = TpuMatchSolver(db, stmt, {})
+    return [s.describe() for s in solver.plan]

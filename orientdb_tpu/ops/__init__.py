@@ -1,0 +1,2 @@
+"""TPU kernel layer: device-resident graph arrays and batched frontier
+expansion primitives (SURVEY.md §1 "Pallas/XLA kernel layer")."""

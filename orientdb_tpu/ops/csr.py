@@ -1,0 +1,146 @@
+"""Batched CSR frontier-expansion primitives.
+
+This is the TPU replacement for the reference's per-record hot loop
+([E] MatchStep.syncPull → MatchEdgeTraverser.next → ORidBag iteration →
+per-RID document load, SURVEY.md §3.3): one `PatternEdge` hop over the whole
+frontier becomes a **count → exclusive-scan → rank-search gather** over the
+CSR arrays — a handful of fused XLA ops instead of millions of interpreted
+iterator pulls.
+
+Shape discipline (XLA wants static shapes): every kernel takes sizes that
+are **bucketed to powers of two** (`bucket()`), padding rows carry src=-1
+and are masked out, so the jit cache holds O(log n) specializations per
+kernel instead of one per distinct frontier size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+MIN_BUCKET = 8
+
+
+def bucket(n: int, minimum: int = MIN_BUCKET) -> int:
+    """Round up to a power of two (≥ minimum) to bound the jit cache."""
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+@jax.jit
+def degree_counts(indptr: jnp.ndarray, srcs: jnp.ndarray) -> jnp.ndarray:
+    """Per-source neighbor counts; padding (src=-1) counts 0."""
+    valid = srcs >= 0
+    s = jnp.where(valid, srcs, 0)
+    return jnp.where(valid, jnp.take(indptr, s + 1) - jnp.take(indptr, s), 0)
+
+
+@jax.jit
+def exclusive_cumsum(counts: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
+
+
+@partial(jax.jit, static_argnames=("out_size",))
+def gather_expand(
+    indptr: jnp.ndarray,
+    neighbors: jnp.ndarray,
+    srcs: jnp.ndarray,
+    offsets: jnp.ndarray,
+    total: jnp.ndarray,
+    out_size: int,
+):
+    """Expand every source's CSR slice into flat (row, edge_pos, neighbor).
+
+    `offsets` is the exclusive cumsum of `degree_counts(indptr, srcs)` and
+    `total` its sum (device scalar); `out_size` is a static bucket ≥ total.
+    Returns int32 arrays of length `out_size`:
+      row      — index into `srcs` this output came from (-1 on padding)
+      edge_pos — position in CSR edge order (edge-property gathers use this)
+      neighbor — the reached vertex (dst for out-CSR, src for in-CSR)
+    """
+    K = srcs.shape[0]
+    pos = jnp.arange(out_size, dtype=jnp.int32)
+    valid = pos < total
+    # rank-search: which source row owns flat position `pos`
+    row = jnp.clip(
+        jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1, 0, K - 1
+    )
+    src = jnp.take(srcs, row)
+    s = jnp.clip(src, 0, indptr.shape[0] - 2)
+    edge_pos = jnp.take(indptr, s) + (pos - jnp.take(offsets, row))
+    if neighbors.shape[0]:
+        edge_pos_c = jnp.clip(edge_pos, 0, neighbors.shape[0] - 1)
+        nbr = jnp.take(neighbors, edge_pos_c)
+    else:
+        nbr = jnp.full((out_size,), -1, jnp.int32)
+    row = jnp.where(valid, row, -1)
+    edge_pos = jnp.where(valid, edge_pos, -1)
+    nbr = jnp.where(valid, nbr, -1)
+    return row, edge_pos, nbr
+
+
+@partial(jax.jit, static_argnames=("out_size",))
+def compact_indices(mask: jnp.ndarray, out_size: int) -> jnp.ndarray:
+    """Indices of True entries, -1-padded to the static `out_size`."""
+    (idx,) = jnp.nonzero(mask, size=out_size, fill_value=-1)
+    return idx.astype(jnp.int32)
+
+
+@jax.jit
+def take_pad(values: jnp.ndarray, idx: jnp.ndarray, fill) -> jnp.ndarray:
+    """`values[idx]` where idx ≥ 0, else `fill` (padding-safe gather)."""
+    n = values.shape[0]
+    if n == 0:
+        return jnp.full(idx.shape, fill, values.dtype)
+    ok = idx >= 0
+    v = jnp.take(values, jnp.clip(idx, 0, n - 1))
+    return jnp.where(ok, v, fill)
+
+
+@jax.jit
+def mask_count(mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(mask.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def rows_with_matches(rows: jnp.ndarray, mask: jnp.ndarray, num_segments: int):
+    """Per-source-row match counts (OPTIONAL-arm left-join bookkeeping):
+    scatter-add 1 for every surviving expansion into its origin row."""
+    ok = mask & (rows >= 0)
+    r = jnp.where(ok, rows, 0)
+    return jax.ops.segment_sum(
+        ok.astype(jnp.int32), r, num_segments=num_segments
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-driven orchestration helpers (one device→host sync per step)
+# ---------------------------------------------------------------------------
+
+
+def expand_step(indptr, neighbors, srcs):
+    """One full expansion: returns (row, edge_pos, neighbor, total:int).
+
+    Host-syncs once on the total count to pick the output bucket — the
+    price of dynamic frontiers under XLA's static-shape model; everything
+    else stays on device.
+    """
+    counts = degree_counts(indptr, srcs)
+    offsets = exclusive_cumsum(counts)
+    total_dev = counts.sum()
+    total = int(total_dev)
+    out_size = bucket(total)
+    row, edge_pos, nbr = gather_expand(
+        indptr, neighbors, srcs, offsets, total_dev, out_size
+    )
+    return row, edge_pos, nbr, total
+
+
+def compact(mask):
+    """Indices of surviving rows (bucketed, -1 padded) + exact count."""
+    count = int(mask_count(mask))
+    idx = compact_indices(mask, bucket(count))
+    return idx, count

@@ -1,0 +1,100 @@
+"""Device-resident graph snapshot.
+
+The HBM form of the columnar snapshot (`orientdb_tpu/storage/snapshot.py`):
+every array `jax.device_put` once per snapshot epoch and cached, so repeated
+queries over the same snapshot pay zero host↔device traffic for graph data —
+the TPU-native answer to the reference's per-record page-cache reads on every
+hop ([E] O2QCache / OPaginatedCluster.readRecord, SURVEY.md §3.2-3.3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+import jax.numpy as jnp
+import numpy as np
+
+from orientdb_tpu.storage.snapshot import GraphSnapshot, PropertyColumn
+
+
+class DeviceColumn:
+    """A property column on device: values + presence mask.
+
+    `dictionary` (host-side) stays with the column so string predicates can
+    be evaluated over the (small) dictionary on host and pushed to device as
+    code-set membership masks.
+    """
+
+    __slots__ = ("name", "kind", "values", "present", "dictionary")
+
+    def __init__(self, col: PropertyColumn):
+        self.name = col.name
+        self.kind = col.kind
+        self.values = jnp.asarray(col.values)
+        self.present = jnp.asarray(col.present)
+        self.dictionary = col.dictionary
+
+
+class DeviceEdgeClass:
+    """One edge class's CSR adjacency (both directions) in HBM."""
+
+    __slots__ = (
+        "class_name",
+        "indptr_out",
+        "dst",
+        "indptr_in",
+        "src",
+        "edge_id_in",
+        "columns",
+        "non_columnar",
+        "num_edges",
+    )
+
+    def __init__(self, csr) -> None:
+        self.class_name = csr.class_name
+        self.indptr_out = jnp.asarray(csr.indptr_out)
+        self.dst = jnp.asarray(csr.dst)
+        self.indptr_in = jnp.asarray(csr.indptr_in)
+        self.src = jnp.asarray(csr.src)
+        self.edge_id_in = jnp.asarray(csr.edge_id_in)
+        self.columns: Dict[str, DeviceColumn] = {
+            n: DeviceColumn(c) for n, c in csr.edge_columns.items()
+        }
+        self.non_columnar: Set[str] = set(getattr(csr, "non_columnar", ()))
+        self.num_edges = int(csr.dst.shape[0])
+
+
+class DeviceGraph:
+    """The full snapshot in HBM plus host metadata for planning/marshal."""
+
+    def __init__(self, snap: GraphSnapshot) -> None:
+        self.snap = snap
+        self.num_vertices = snap.num_vertices
+        self.v_class = jnp.asarray(snap.v_class)
+        self.columns: Dict[str, DeviceColumn] = {
+            n: DeviceColumn(c) for n, c in snap.v_columns.items()
+        }
+        self.non_columnar: Set[str] = set(getattr(snap, "v_non_columnar", ()))
+        self.edges: Dict[str, DeviceEdgeClass] = {
+            n: DeviceEdgeClass(c) for n, c in snap.edge_classes.items()
+        }
+        #: device-side polymorphic class-id sets (vertex classes)
+        self._class_ids: Dict[str, jnp.ndarray] = {}
+
+    def class_ids(self, class_name: str) -> jnp.ndarray:
+        key = class_name.lower()
+        ids = self._class_ids.get(key)
+        if ids is None:
+            ids = jnp.asarray(self.snap.vertex_class_ids(class_name))
+            self._class_ids[key] = ids
+        return ids
+
+
+def device_graph(snap: GraphSnapshot) -> DeviceGraph:
+    """Build (or fetch the cached) device form of a snapshot."""
+    cached: Optional[DeviceGraph] = getattr(snap, "_device_cache", None)
+    if cached is not None:
+        return cached
+    dg = DeviceGraph(snap)
+    snap._device_cache = dg
+    return dg

@@ -1,0 +1,483 @@
+"""Columnar predicate compiler: WHERE AST → fused device masks.
+
+The reference evaluates `WHERE` as an interpreted expression tree per
+candidate record inside the MATCH hot loop ([E] OExpression eval inside
+MatchEdgeTraverser, SURVEY.md §3.3). Here the same AST compiles once per
+query into a closure over device property columns; applied to a whole
+frontier it is a handful of vectorized compares/selects that XLA fuses into
+the expansion gathers ("edge-property WHERE predicates fused in" — the
+north star).
+
+Semantics contract: must agree with `orientdb_tpu/exec/eval.py` on the
+columnar subset — parity tests replay the golden corpus through both
+engines. Key OrientDB null rules preserved:
+  - any comparison with null is false (only IS NULL sees nulls);
+  - `!=` additionally needs both sides non-null;
+  - AND/OR collapse null to false; NOT(null) is true;
+  - type-mismatched `=` is false, `<` family is false, while `!=` of two
+    non-null incomparable values is true (values_equal falls back to
+    Python `==`).
+
+String columns are dictionary-encoded with a *sorted* dictionary, so:
+  - ordered compares against a literal become int32 compares versus the
+    literal's bisect rank;
+  - LIKE / MATCHES / CONTAINSTEXT are evaluated host-side over the (small)
+    dictionary and pushed to device as a boolean code-membership table.
+
+Anything outside the subset raises `Uncompilable`; the engine front door
+falls back to the oracle interpreter, keeping behavior total.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+from typing import Callable, Dict, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from orientdb_tpu.exec.eval import like_match
+from orientdb_tpu.ops.device_graph import DeviceColumn
+from orientdb_tpu.sql import ast as A
+
+
+class Uncompilable(Exception):
+    """Expression outside the columnar subset; caller falls back."""
+
+
+class ColumnScope:
+    """Resolves bare field names for one predicate scope (a vertex alias or
+    an edge class' property columns)."""
+
+    def __init__(
+        self,
+        columns: Dict[str, DeviceColumn],
+        non_columnar: Set[str],
+        reserved: Set[str] = frozenset(),
+    ) -> None:
+        self.columns = columns
+        self.non_columnar = non_columnar
+        #: names that are MATCH aliases / variables → binding-dependent
+        self.reserved = reserved
+
+    def resolve(self, name: str) -> Optional[DeviceColumn]:
+        if name in self.reserved:
+            raise Uncompilable(f"identifier {name!r} is a bound alias/variable")
+        if name.startswith("@") or name.startswith("$"):
+            raise Uncompilable(f"meta field {name!r} not columnar")
+        if name in self.columns:
+            return self.columns[name]
+        if name in self.non_columnar:
+            raise Uncompilable(f"property {name!r} has no columnar encoding")
+        return None  # never present → null column
+
+
+# A value node: kind + emit(idx, env) -> (values, present). kind one of
+# 'int' 'float' 'bool' 'str' 'null'. For 'str', `dictionary` carries the
+# sorted host dictionary. A bool node: emit(idx, env) -> mask.
+class _Val:
+    __slots__ = ("kind", "emit", "dictionary")
+
+    def __init__(self, kind: str, emit, dictionary=None):
+        self.kind = kind
+        self.emit = emit
+        self.dictionary = dictionary
+
+
+BoolFn = Callable[[jnp.ndarray, dict], jnp.ndarray]
+
+
+def _const_val(v) -> _Val:
+    if v is None:
+        return _Val("null", lambda idx, env: (jnp.zeros(idx.shape, jnp.int32), jnp.zeros(idx.shape, bool)))
+    if isinstance(v, bool):
+        return _Val("bool", lambda idx, env, v=v: (
+            jnp.full(idx.shape, int(v), jnp.int32), jnp.ones(idx.shape, bool)))
+    if isinstance(v, int):
+        if not (-(2**31) < v < 2**31):
+            # float32 demotion would lose precision vs the oracle's exact
+            # integer compare near the boundary — fall back instead
+            raise Uncompilable(f"integer literal {v} outside int32 range")
+        return _Val("int", lambda idx, env, v=v: (
+            jnp.full(idx.shape, v, jnp.int32), jnp.ones(idx.shape, bool)))
+    if isinstance(v, float):
+        return _Val("float", lambda idx, env, v=v: (
+            jnp.full(idx.shape, v, jnp.float32), jnp.ones(idx.shape, bool)))
+    if isinstance(v, str):
+        # literal strings stay host-side; comparisons handle them specially
+        return _Val("strlit", lambda idx, env: None, dictionary=v)
+    raise Uncompilable(f"literal {v!r} not columnar")
+
+
+def _column_val(col: DeviceColumn) -> _Val:
+    def emit(idx, env, col=col):
+        n = col.values.shape[0]
+        if n == 0:
+            return (jnp.zeros(idx.shape, col.values.dtype), jnp.zeros(idx.shape, bool))
+        ok = idx >= 0
+        ci = jnp.clip(idx, 0, n - 1)
+        return (
+            jnp.take(col.values, ci),
+            jnp.take(col.present, ci) & ok,
+        )
+
+    return _Val(col.kind, emit, dictionary=col.dictionary)
+
+
+_NUMERIC = ("int", "float", "bool")
+
+
+def _promote(a: _Val, b: _Val):
+    """Numeric promotion for arithmetic/compare: int32 unless any float."""
+    return "float" if "float" in (a.kind, b.kind) else "int"
+
+
+def _as_dtype(vals, present, kind):
+    if kind == "float":
+        return vals.astype(jnp.float32), present
+    return vals.astype(jnp.int32), present
+
+
+class Compiler:
+    def __init__(self, scope: ColumnScope, params: Dict, allow_depth: bool = False):
+        self.scope = scope
+        self.params = params
+        self.allow_depth = allow_depth
+
+    # -- entry -------------------------------------------------------------
+
+    def compile_bool(self, expr: A.Expression) -> BoolFn:
+        return self._bool(expr)
+
+    # -- value nodes -------------------------------------------------------
+
+    def _value(self, expr: A.Expression) -> _Val:
+        if isinstance(expr, A.Literal):
+            return _const_val(expr.value)
+        if isinstance(expr, A.Parameter):
+            if expr.name is not None:
+                if expr.name not in self.params:
+                    raise Uncompilable(f"missing parameter :{expr.name}")
+                return _const_val(self.params[expr.name])
+            if expr.index not in self.params:
+                raise Uncompilable(f"missing positional parameter ?{expr.index}")
+            return _const_val(self.params[expr.index])
+        if isinstance(expr, A.Identifier):
+            col = self.scope.resolve(expr.name)
+            if col is None:
+                return _const_val(None)
+            return _column_val(col)
+        if isinstance(expr, A.ContextVar):
+            if expr.name == "depth" and self.allow_depth:
+                return _Val(
+                    "int",
+                    lambda idx, env: (
+                        jnp.full(idx.shape, env["depth"], jnp.int32),
+                        jnp.ones(idx.shape, bool),
+                    ),
+                )
+            raise Uncompilable(f"context var ${expr.name} not columnar")
+        if isinstance(expr, A.Unary):
+            if expr.op in ("-", "+"):
+                v = self._value(expr.expr)
+                if v.kind not in _NUMERIC:
+                    raise Uncompilable("unary minus on non-numeric")
+                if expr.op == "+":
+                    return v
+
+                def emit(idx, env, v=v):
+                    vals, pres = v.emit(idx, env)
+                    return -vals, pres
+
+                return _Val("int" if v.kind in ("int", "bool") else "float", emit)
+            raise Uncompilable(f"unary {expr.op} is boolean")
+        if isinstance(expr, A.Binary) and expr.op in ("+", "-", "*", "/", "%"):
+            return self._arith(expr)
+        raise Uncompilable(f"expression {type(expr).__name__} not columnar")
+
+    def _arith(self, expr: A.Binary) -> _Val:
+        a = self._value(expr.left)
+        b = self._value(expr.right)
+        if a.kind in ("strlit", "str") or b.kind in ("strlit", "str"):
+            raise Uncompilable("string arithmetic not columnar")
+        if a.kind == "null" or b.kind == "null":
+            return _const_val(None)
+        if a.kind not in _NUMERIC or b.kind not in _NUMERIC:
+            raise Uncompilable("non-numeric arithmetic")
+        op = expr.op
+        kind = _promote(a, b)
+        if op == "/":
+            kind = "float"  # exact-int division equals float division numerically
+
+        def emit(idx, env, a=a, b=b, op=op, kind=kind):
+            av, ap = _as_dtype(*a.emit(idx, env), kind)
+            bv, bp = _as_dtype(*b.emit(idx, env), kind)
+            pres = ap & bp
+            if op == "+":
+                out = av + bv
+            elif op == "-":
+                out = av - bv
+            elif op == "*":
+                out = av * bv
+            elif op == "/":
+                pres = pres & (bv != 0)
+                out = av / jnp.where(bv != 0, bv, 1)
+            else:  # %
+                pres = pres & (bv != 0)
+                out = jnp.mod(av, jnp.where(bv != 0, bv, 1))
+            return out, pres
+
+        return _Val(kind, emit)
+
+    # -- boolean nodes -----------------------------------------------------
+
+    def _bool(self, expr: A.Expression) -> BoolFn:
+        if isinstance(expr, A.Binary):
+            op = expr.op
+            if op == "AND":
+                l, r = self._bool(expr.left), self._bool(expr.right)
+                return lambda idx, env: l(idx, env) & r(idx, env)
+            if op == "OR":
+                l, r = self._bool(expr.left), self._bool(expr.right)
+                return lambda idx, env: l(idx, env) | r(idx, env)
+            if op in ("=", "!=", "<", "<=", ">", ">="):
+                return self._compare(op, expr.left, expr.right)
+            if op in ("LIKE", "MATCHES", "CONTAINSTEXT"):
+                return self._string_table_op(op, expr.left, expr.right)
+            if op == "IN":
+                return self._in(expr.left, expr.right)
+            raise Uncompilable(f"operator {op} not columnar")
+        if isinstance(expr, A.Unary) and expr.op == "NOT":
+            inner = self._bool(expr.expr)
+            return lambda idx, env: ~inner(idx, env)
+        if isinstance(expr, A.Between):
+            ge = self._compare(">=", expr.expr, expr.low)
+            le = self._compare("<=", expr.expr, expr.high)
+            return lambda idx, env: ge(idx, env) & le(idx, env)
+        if isinstance(expr, A.IsNull):
+            v = self._value(expr.expr)
+            if v.kind == "strlit":
+                raise Uncompilable("IS NULL on string literal")
+            neg = expr.negated
+
+            def isnull(idx, env, v=v, neg=neg):
+                if v.kind == "null":
+                    pres = jnp.zeros(idx.shape, bool)
+                else:
+                    _, pres = v.emit(idx, env)
+                return pres if neg else ~pres
+
+            return isnull
+        if isinstance(expr, A.Literal) and isinstance(expr.value, bool):
+            b = expr.value
+            return lambda idx, env: jnp.full(idx.shape, b, bool)
+        # truthiness of a bare value (where:(flag))
+        try:
+            v = self._value(expr)
+        except Uncompilable:
+            raise
+        return self._truthy(v)
+
+    def _truthy(self, v: _Val) -> BoolFn:
+        if v.kind == "null":
+            return lambda idx, env: jnp.zeros(idx.shape, bool)
+        if v.kind == "strlit":
+            b = bool(v.dictionary)
+            return lambda idx, env: jnp.full(idx.shape, b, bool)
+        if v.kind == "str":
+            # non-empty string is truthy: host-eval over the dictionary
+            table = np.array([bool(s) for s in (v.dictionary or [])], bool)
+            return self._code_table_mask(v, table)
+
+        def fn(idx, env, v=v):
+            vals, pres = v.emit(idx, env)
+            return pres & (vals != 0)
+
+        return fn
+
+    def _code_table_mask(self, v: _Val, table: np.ndarray) -> BoolFn:
+        dev = jnp.asarray(table) if table.size else jnp.zeros(1, bool)
+
+        def fn(idx, env, v=v, dev=dev, empty=not table.size):
+            vals, pres = v.emit(idx, env)
+            if empty:
+                return jnp.zeros(idx.shape, bool)
+            code = jnp.clip(vals, 0, dev.shape[0] - 1)
+            return pres & jnp.take(dev, code)
+
+        return fn
+
+    def _string_table_op(self, op: str, left: A.Expression, right: A.Expression) -> BoolFn:
+        lv = self._value(left)
+        rv = self._value(right)
+        if rv.kind != "strlit":
+            raise Uncompilable(f"{op} needs a literal pattern")
+        pat = rv.dictionary
+        if lv.kind == "null":
+            return lambda idx, env: jnp.zeros(idx.shape, bool)
+        if lv.kind == "strlit":
+            # literal op literal: host constant (oracle semantics)
+            s = lv.dictionary
+            if op == "LIKE":
+                res = like_match(s, pat)
+            elif op == "MATCHES":
+                res = re.fullmatch(pat, s) is not None
+            else:
+                res = pat in s
+            return lambda idx, env, res=res: jnp.full(idx.shape, res, bool)
+        if lv.kind != "str":
+            return lambda idx, env: jnp.zeros(idx.shape, bool)  # non-str LIKE → false
+        d = lv.dictionary or []
+        if op == "LIKE":
+            table = np.array([like_match(s, pat) for s in d], bool)
+        elif op == "MATCHES":
+            table = np.array([re.fullmatch(pat, s) is not None for s in d], bool)
+        else:  # CONTAINSTEXT
+            table = np.array([pat in s for s in d], bool)
+        return self._code_table_mask(lv, table)
+
+    def _in(self, left: A.Expression, right: A.Expression) -> BoolFn:
+        if not isinstance(right, A.ListExpr):
+            raise Uncompilable("IN needs a literal list")
+        eqs = [self._compare("=", left, item) for item in right.items]
+        if not eqs:
+            return lambda idx, env: jnp.zeros(idx.shape, bool)
+
+        def fn(idx, env, eqs=eqs):
+            m = eqs[0](idx, env)
+            for e in eqs[1:]:
+                m = m | e(idx, env)
+            return m
+
+        return fn
+
+    # -- comparisons -------------------------------------------------------
+
+    def _compare(self, op: str, left: A.Expression, right: A.Expression) -> BoolFn:
+        a = self._value(left)
+        b = self._value(right)
+        # null on either side: every compare false (incl. !=)
+        if a.kind == "null" or b.kind == "null":
+            return lambda idx, env: jnp.zeros(idx.shape, bool)
+        # string literal vs string literal: host constant
+        if a.kind == "strlit" and b.kind == "strlit":
+            res = _host_cmp(op, a.dictionary, b.dictionary)
+            return lambda idx, env, res=res: jnp.full(idx.shape, res, bool)
+        # string column vs literal (either side)
+        if a.kind == "str" and b.kind == "strlit":
+            return self._cmp_str_lit(op, a, b.dictionary)
+        if a.kind == "strlit" and b.kind == "str":
+            return self._cmp_str_lit(_flip(op), b, a.dictionary)
+        # type-mismatch across order classes
+        a_num = a.kind in _NUMERIC
+        b_num = b.kind in _NUMERIC
+        a_str = a.kind == "str"
+        b_str = b.kind in ("str", "strlit")
+        if (a_num and b_str) or (a_str and b_num) or (a.kind == "strlit" and b_num):
+            if op == "!=":
+                # non-null incomparables are "not equal" (values_equal fallback)
+                def fn(idx, env, a=a, b=b):
+                    ap = _presence(a, idx, env)
+                    bp = _presence(b, idx, env)
+                    return ap & bp
+
+                return fn
+            return lambda idx, env: jnp.zeros(idx.shape, bool)
+        if a_str and b.kind == "str":
+            raise Uncompilable("string column vs string column compare")
+        # numeric vs numeric (bool included)
+        if not (a_num and b_num):
+            raise Uncompilable(f"cannot compare {a.kind} with {b.kind}")
+        ordered_ok = True
+        if ("bool" in (a.kind, b.kind)) and a.kind != b.kind and op not in ("=", "!="):
+            # compare() yields None for bool vs non-bool → ordered ops false
+            ordered_ok = False
+        kind = _promote(a, b)
+
+        def fn(idx, env, a=a, b=b, op=op, kind=kind, ordered_ok=ordered_ok):
+            av, ap = _as_dtype(*a.emit(idx, env), kind)
+            bv, bp = _as_dtype(*b.emit(idx, env), kind)
+            pres = ap & bp
+            if op not in ("=", "!=") and not ordered_ok:
+                return jnp.zeros(idx.shape, bool)
+            if op == "=":
+                c = av == bv
+            elif op == "!=":
+                c = av != bv
+            elif op == "<":
+                c = av < bv
+            elif op == "<=":
+                c = av <= bv
+            elif op == ">":
+                c = av > bv
+            else:
+                c = av >= bv
+            return pres & c
+
+        return fn
+
+    def _cmp_str_lit(self, op: str, col: _Val, lit: str) -> BoolFn:
+        d: Sequence[str] = col.dictionary or []
+        exact = None
+        i = bisect.bisect_left(d, lit)
+        if i < len(d) and d[i] == lit:
+            exact = i
+        lo = bisect.bisect_left(d, lit)
+        hi = bisect.bisect_right(d, lit)
+
+        def fn(idx, env, col=col, op=op, exact=exact, lo=lo, hi=hi):
+            vals, pres = col.emit(idx, env)
+            if op == "=":
+                if exact is None:
+                    return jnp.zeros(idx.shape, bool)
+                return pres & (vals == exact)
+            if op == "!=":
+                if exact is None:
+                    return pres
+                return pres & (vals != exact)
+            if op == "<":
+                return pres & (vals < lo)
+            if op == "<=":
+                return pres & (vals < hi)
+            if op == ">":
+                return pres & (vals >= hi)
+            return pres & (vals >= lo)  # >=
+
+        return fn
+
+
+def _presence(v: _Val, idx, env) -> jnp.ndarray:
+    if v.kind == "strlit":
+        return jnp.ones(idx.shape, bool)
+    if v.kind == "null":
+        return jnp.zeros(idx.shape, bool)
+    _, pres = v.emit(idx, env)
+    return pres
+
+
+def _flip(op: str) -> str:
+    return {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}[op]
+
+
+def _host_cmp(op: str, a: str, b: str) -> bool:
+    return {
+        "=": a == b,
+        "!=": a != b,
+        "<": a < b,
+        "<=": a <= b,
+        ">": a > b,
+        ">=": a >= b,
+    }[op]
+
+
+def compile_predicate(
+    expr: A.Expression,
+    scope: ColumnScope,
+    params: Dict,
+    allow_depth: bool = False,
+) -> BoolFn:
+    """Compile a WHERE AST into `fn(idx_array, env) -> bool mask`.
+
+    Raises Uncompilable outside the columnar subset."""
+    return Compiler(scope, params, allow_depth=allow_depth).compile_bool(expr)

@@ -106,6 +106,7 @@ class EdgeClassCSR:
         "edge_id_in",
         "edge_rids",
         "edge_columns",
+        "non_columnar",
         "out_degree_max",
         "in_degree_max",
     )
@@ -119,6 +120,7 @@ class EdgeClassCSR:
         self.edge_id_in: np.ndarray = np.zeros(0, np.int32)
         self.edge_rids: List[RID] = []
         self.edge_columns: Dict[str, PropertyColumn] = {}
+        self.non_columnar: set = set()
         self.out_degree_max = 0
         self.in_degree_max = 0
 
@@ -147,6 +149,9 @@ class GraphSnapshot:
         self.class_closure: Dict[str, np.ndarray] = {}
         # property columns (global over the vertex universe)
         self.v_columns: Dict[str, PropertyColumn] = {}
+        #: property names observed but not columnar-encodable (lists, links,
+        #: mixed types) — device predicates on these must fall back
+        self.v_non_columnar: set = set()
         # per-edge-class CSR (concrete classes)
         self.edge_classes: Dict[str, EdgeClassCSR] = {}
         #: edge class name (lower) → list of concrete edge class names
@@ -261,7 +266,7 @@ def _column_from_values(name: str, raw: List, present: np.ndarray) -> Optional[P
     return PropertyColumn(name, kind, vals, present)
 
 
-def _build_columns(docs: Sequence[Document]) -> Dict[str, PropertyColumn]:
+def _build_columns(docs: Sequence[Document]) -> Tuple[Dict[str, PropertyColumn], set]:
     n = len(docs)
     names: List[str] = []
     seen = set()
@@ -271,6 +276,7 @@ def _build_columns(docs: Sequence[Document]) -> Dict[str, PropertyColumn]:
                 seen.add(f)
                 names.append(f)
     out: Dict[str, PropertyColumn] = {}
+    dropped: set = set()
     for name in names:
         raw = [d.get(name) for d in docs]
         present = np.array([d.has(name) and d.get(name) is not None for d in docs])
@@ -278,8 +284,9 @@ def _build_columns(docs: Sequence[Document]) -> Dict[str, PropertyColumn]:
         if col is not None:
             out[name] = col
         else:
+            dropped.add(name)
             log.info("property %r not columnar; TPU predicates fall back", name)
-    return out
+    return out, dropped
 
 
 def build_snapshot(db: Database) -> GraphSnapshot:
@@ -318,7 +325,7 @@ def build_snapshot(db: Database) -> GraphSnapshot:
         snap.class_closure[c.name.lower()] = np.array(sorted(closure), np.int32)
 
     # ---- vertex property columns ----
-    snap.v_columns = _build_columns(vertices)
+    snap.v_columns, snap.v_non_columnar = _build_columns(vertices)
 
     # ---- edges per concrete edge class ----
     edge_classes = [c for c in db.schema.classes() if c.is_edge_type and not c.abstract]
@@ -347,7 +354,7 @@ def build_snapshot(db: Database) -> GraphSnapshot:
         csr.out_degree_max = int(counts.max()) if V else 0
         ordered_edges = [edges[i] for i in order]
         csr.edge_rids = [e.rid for e in ordered_edges]
-        csr.edge_columns = _build_columns(ordered_edges)
+        csr.edge_columns, csr.non_columnar = _build_columns(ordered_edges)
         # CSR in: sort (dst, position) — edge ids refer to out order
         src_o = src[order]
         dst_o = dst[order]
