@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Headline benchmark: analytic 2-hop MATCH COUNT(*) throughput, TPU engine
+vs the pure-Python oracle interpreter (a row-returning 1-hop MATCH is also
+parity-gated before timing).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "queries/sec", "vs_baseline": N}
+
+Baseline note (SURVEY.md §6): the reference Java executor is not available
+in this image (empty /root/reference mount), so the measured baseline is
+the oracle interpreter — the same role the single-node Java MATCH executor
+plays in BASELINE.json config #2 (multi-hop MATCH over a demodb-shaped
+graph), with result-set parity asserted before timing. Ratios are
+vs-Python until the reference appears; BASELINE.md records this.
+
+Env knobs: BENCH_PROFILES (default 20000), BENCH_AVG_FRIENDS (10),
+BENCH_ITERS (10), BENCH_ORACLE_ITERS (1 — the oracle takes ~13 s per
+2-hop query at the default size).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    n_profiles = int(os.environ.get("BENCH_PROFILES", "20000"))
+    avg_friends = int(os.environ.get("BENCH_AVG_FRIENDS", "10"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    oracle_iters = int(os.environ.get("BENCH_ORACLE_ITERS", "1"))
+
+    from orientdb_tpu.storage.ingest import generate_demodb
+    from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+    db = generate_demodb(n_profiles=n_profiles, avg_friends=avg_friends)
+    attach_fresh_snapshot(db)
+
+    # headline: the analytic multi-hop pattern (BASELINE config #2 shape) —
+    # whole-class 2-hop expansion with vertex predicates on both ends
+    sql = (
+        "MATCH {class:Profiles, as:p, where:(age > 40)}"
+        "-HasFriend->{as:f}"
+        "-HasFriend->{as:g, where:(age < 30)} "
+        "RETURN count(*) AS n"
+    )
+    # parity gate also covers a row-returning 1-hop (marshalling path)
+    sql_rows = (
+        "MATCH {class:Profiles, as:p, where:(age > 40)}"
+        "-HasFriend->{as:f, where:(age < 30)} "
+        "RETURN p.uid AS p, f.uid AS f"
+    )
+
+    def run(engine, q=sql):
+        rs = db.query(q, engine=engine, strict=(engine == "tpu"))
+        return rs.to_dicts()
+
+    # parity gates before timing (result-set parity is part of the metric)
+    def canon(rows):
+        return sorted(tuple(sorted(r.items())) for r in rows)
+
+    ok = canon(run("tpu")) == canon(run("oracle")) and canon(
+        run("tpu", sql_rows)
+    ) == canon(run("oracle", sql_rows))
+    if not ok:
+        print(
+            json.dumps(
+                {
+                    "metric": "demodb_match_2hop_count_qps",
+                    "value": 0.0,
+                    "unit": "queries/sec",
+                    "vs_baseline": 0.0,
+                    "error": "parity mismatch",
+                }
+            )
+        )
+        sys.exit(1)
+
+    run("tpu")  # second warmup (compiles the sync-free replay plan)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run("tpu")
+    tpu_qps = iters / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for _ in range(oracle_iters):
+        run("oracle")
+    oracle_qps = oracle_iters / (time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "demodb_match_2hop_count_qps",
+                "value": round(tpu_qps, 3),
+                "unit": "queries/sec",
+                "vs_baseline": round(tpu_qps / oracle_qps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

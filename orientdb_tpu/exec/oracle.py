@@ -1047,6 +1047,14 @@ def match_rows_from_bindings(
             props[name] = evaluate(ctx, p.expr)
         out.append(Result(props=props))
 
+    return finalize_match_rows(db, stmt, out, params, parent_ctx)
+
+
+def finalize_match_rows(
+    db, stmt: A.MatchStatement, out: List[Result], params, parent_ctx
+) -> List[Result]:
+    """DISTINCT/UNWIND/ORDER/SKIP/LIMIT tail, shared with the TPU engine's
+    columnar fast path (which builds `out` straight from device columns)."""
     if stmt.distinct:
         seen = set()
         deduped = []

@@ -29,16 +29,22 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orientdb_tpu.exec.eval import EvalContext
 from orientdb_tpu.exec.oracle import (
     MatchInterpreter,
     Pattern,
     PatternEdge,
     PatternNode,
+    finalize_match_rows,
     match_rows_from_bindings,
     _expr_uses_bindings,
+    _match_proj_name,
+    _order_rows,
+    _skip_limit,
     _REVERSE_DIR,
 )
 from orientdb_tpu.exec.result import Result
@@ -70,6 +76,15 @@ class Table:
         self.depth_cols: Dict[str, jnp.ndarray] = {}
         self.count = count  # valid rows; starts at 1 (the empty binding)
         self.width = width  # bucketed column length (0 = no columns yet)
+        #: device-side twin of `count` (threaded so COUNT(*) plans can fetch
+        #: one scalar instead of the whole table); None until a step sets it
+        self.count_dev = None
+
+    @property
+    def count_device(self):
+        if self.count_dev is None:
+            return jnp.int32(self.count)
+        return self.count_dev
 
     def empty(self) -> bool:
         return self.count == 0
@@ -98,7 +113,11 @@ def _concat_tables(parts: List[Table], counts: List[int]) -> Table:
     out = Table(count=total, width=K.bucket(total))
     if not parts:
         out.count = 0
+        out.count_dev = jnp.int32(0)
         return out
+    out.count_dev = parts[0].count_device
+    for p in parts[1:]:
+        out.count_dev = out.count_dev + p.count_device
     keys = parts[0].cols.keys()
     for a in keys:
         segs = [p.cols[a][: c] for p, c in zip(parts, counts)]
@@ -120,6 +139,41 @@ def _pad_concat(segs: List[jnp.ndarray], width: int) -> jnp.ndarray:
     if pad > 0:
         cat = jnp.concatenate([cat, jnp.full(pad, -1, jnp.int32)])
     return cat
+
+
+# ---------------------------------------------------------------------------
+# size schedule (the compiled-plan-cache mechanism)
+# ---------------------------------------------------------------------------
+
+
+class SizeSchedule:
+    """Records every host-observed device scalar (frontier totals, compact
+    counts) on the first execution; replays them sync-free afterwards.
+
+    XLA needs static shapes, frontiers are dynamic — the first run pays one
+    blocking device→host sync per observation to learn the shape schedule.
+    Sizes are deterministic given (snapshot epoch, statement, params), so a
+    replay under `jit` executes the whole multi-hop solve as a single
+    device dispatch with zero syncs — the TPU-native analog of the
+    reference's prepared-plan reuse ([E] OExecutionPlanCache)."""
+
+    def __init__(self) -> None:
+        self.values: List[int] = []
+        self.pos = 0
+        self.recording = True
+
+    def observe(self, dev_scalar) -> int:
+        if self.recording:
+            v = int(dev_scalar)
+            self.values.append(v)
+            return v
+        v = self.values[self.pos]
+        self.pos += 1
+        return v
+
+    def start_replay(self) -> None:
+        self.recording = False
+        self.pos = 0
 
 
 # ---------------------------------------------------------------------------
@@ -244,6 +298,7 @@ class TpuMatchSolver:
             raise Uncompilable("no fresh snapshot attached")
         self.snap = snap
         self.dg: DeviceGraph = device_graph(snap)
+        self.sched = SizeSchedule()
         # reuse the oracle's pattern build + estimates (host planning data)
         self.interp = MatchInterpreter(db, stmt, params)
         self.pattern = self.interp.pattern
@@ -351,6 +406,23 @@ class TpuMatchSolver:
 
     # -- execution ----------------------------------------------------------
 
+    def _compact(self, mask):
+        """Surviving-row indices; size via the schedule (sync on record,
+        free on replay). Returns (indices, host count, device count)."""
+        count_dev = K.mask_count(mask)
+        count = self.sched.observe(count_dev)
+        return K.compact_indices(mask, K.bucket(count)), count, count_dev
+
+    def _expand_csr(self, indptr, nbrs, srcs):
+        counts = K.degree_counts(indptr, srcs)
+        offsets = K.exclusive_cumsum(counts)
+        total_dev = counts.sum()
+        total = self.sched.observe(total_dev)
+        row, edge_pos, nbr = K.gather_expand(
+            indptr, nbrs, srcs, offsets, total_dev, K.bucket(total)
+        )
+        return row, edge_pos, nbr, total
+
     def solve_table(self) -> Table:
         table = Table(count=1, width=0)
         for step in self.plan:
@@ -366,24 +438,26 @@ class TpuMatchSolver:
                 table = self._expand(table, step, optional=True)
         return table
 
-    def _root_candidates(self, alias: str) -> Tuple[jnp.ndarray, int]:
+    def _root_candidates(self, alias: str):
         node = self.pattern.nodes[alias]
         V = self.dg.num_vertices
         idx = jnp.arange(K.bucket(max(V, 1)), dtype=jnp.int32)
         idx = jnp.where(idx < V, idx, -1)
         mask = self._node_masks[alias](idx)
-        cand, n = K.compact(mask)
+        cand, n, n_dev = self._compact(mask)
         cand = K.take_pad(idx, cand, jnp.int32(-1))
-        return cand, n
+        return cand, n, n_dev
 
     def _root(self, table: Table, alias: str) -> Table:
-        cand, n = self._root_candidates(alias)
+        cand, n, n_dev = self._root_candidates(alias)
         if table.width == 0 and not table.cols:
             t = Table(count=n, width=int(cand.shape[0]))
             t.cols[alias] = cand
+            t.count_dev = n_dev
             return t
         # cartesian product with the existing table
         old_n, new_n = table.count, n
+        old_dev = table.count_device
         total = old_n * new_n
         width = K.bucket(max(total, 1))
         pos = jnp.arange(width, dtype=jnp.int32)
@@ -392,12 +466,14 @@ class TpuMatchSolver:
             rows = jnp.full(width, -1, jnp.int32)
             t = table.gather(rows)
             t.count = 0
+            t.count_dev = jnp.int32(0)
             t.cols[alias] = rows
             return t
         rows = jnp.where(valid, pos // new_n, -1)
         sel = jnp.where(valid, pos % new_n, -1)
         t = table.gather(rows)
         t.count = total
+        t.count_dev = old_dev * n_dev
         t.cols[alias] = K.take_pad(cand, sel, jnp.int32(-1))
         return t
 
@@ -444,7 +520,7 @@ class TpuMatchSolver:
                     indptr, nbrs = dec.indptr_out, dec.dst
                 else:
                     indptr, nbrs = dec.indptr_in, dec.src
-                row, edge_pos, nbr, total = K.expand_step(indptr, nbrs, srcs)
+                row, edge_pos, nbr, total = self._expand_csr(indptr, nbrs, srcs)
                 if total == 0:
                     continue
                 # edge ids in out-CSR order (edge property columns / RIDs)
@@ -464,12 +540,13 @@ class TpuMatchSolver:
                     matched_any = matched_any + K.rows_with_matches(
                         row, mask, table.width or 1
                     )
-                keep, kn = K.compact(mask)
+                keep, kn, kn_dev = self._compact(mask)
                 if kn == 0:
                     continue
                 krow = K.take_pad(row, keep, jnp.int32(-1))
                 part = table.gather(krow)
                 part.count = kn
+                part.count_dev = kn_dev
                 part.cols[dst_alias] = K.take_pad(nbr, keep, jnp.int32(-1))
                 ecls_idx = self.edge_class_idx[cname]
                 keid = K.take_pad(eid, keep, jnp.int32(-1))
@@ -486,10 +563,11 @@ class TpuMatchSolver:
             rowids = jnp.arange(table.width, dtype=jnp.int32)
             valid_rows = rowids < table.count
             unmatched = valid_rows & ~matched
-            ukeep, un = K.compact(unmatched)
+            ukeep, un, un_dev = self._compact(unmatched)
             if un > 0:
                 upart = table.gather(ukeep)
                 upart.count = un
+                upart.count_dev = un_dev
                 null_col = jnp.full(upart.width, -1, jnp.int32)
                 if step.close:
                     # oracle: null src uses setdefault (keeps the bound dst);
@@ -509,6 +587,7 @@ class TpuMatchSolver:
             # preserve column structure for downstream steps
             t = table.gather(jnp.full(K.bucket(1), -1, jnp.int32))
             t.count = 0
+            t.count_dev = jnp.int32(0)
             t.cols[dst_alias] = jnp.full(t.width, -1, jnp.int32)
             self._bind_edge_alias(t, item, -1, jnp.full(t.width, -1, jnp.int32))
             if item.target.depth_alias:
@@ -529,8 +608,7 @@ class TpuMatchSolver:
 
     # -- marshalling --------------------------------------------------------
 
-    def bindings(self) -> List[Dict[str, object]]:
-        table = self.solve_table()
+    def bindings_from_table(self, table: Table) -> List[Dict[str, object]]:
         n = table.count
         cols = {a: np.asarray(c)[:n] for a, c in table.cols.items()}
         ecols = {
@@ -579,13 +657,194 @@ class TpuMatchSolver:
             out.append(b)
         return out
 
-    def rows(self) -> List[Result]:
+    def rows_from_table(self, table: Table) -> List[Result]:
+        fast = self._fast_rows(table)
+        if fast is not None:
+            return fast
         named = [
             n.alias for n in self.pattern.nodes.values() if not n.anonymous
         ]
         return match_rows_from_bindings(
-            self.db, self.stmt, named, self.bindings(), self.params, None
+            self.db,
+            self.stmt,
+            named,
+            self.bindings_from_table(table),
+            self.params,
+            None,
         )
+
+    # -- columnar fast RETURN path -----------------------------------------
+
+    def count_only_name(self) -> Optional[str]:
+        """Projection name when RETURN is a lone COUNT(*) (no grouping)."""
+        stmt = self.stmt
+        if stmt.group_by or stmt.unwind:
+            return None
+        r = stmt.returns
+        if (
+            len(r) == 1
+            and isinstance(r[0].expr, A.FunctionCall)
+            and r[0].expr.name.lower() == "count"
+            and len(r[0].expr.args) == 1
+            and isinstance(r[0].expr.args[0], A.Star)
+        ):
+            from orientdb_tpu.exec.oracle import expr_name
+
+            return r[0].alias or expr_name(r[0].expr, 0)
+        return None
+
+    def finalize_count(self, name: str, count: int) -> List[Result]:
+        # aggregate path applies only ORDER/SKIP/LIMIT (no DISTINCT)
+        out = [Result(props={name: count})]
+        out = _order_rows(out, self.stmt.order_by, self.db, self.params, None)
+        base_ctx = EvalContext(self.db, params=self.params)
+        return _skip_limit(out, self.stmt.skip, self.stmt.limit, base_ctx)
+
+    def _fast_rows(self, table: Table) -> Optional[List[Result]]:
+        """Build result rows straight from device columns when RETURN is a
+        count(*) or plain columnar projections — skipping per-row Document
+        loads entirely (the [E] OResultInternal marshalling cost the north
+        star calls out). Returns None when ineligible (shared slow path)."""
+        stmt = self.stmt
+        if stmt.group_by or stmt.unwind:
+            return None
+        returns = stmt.returns
+        if len(returns) == 1 and isinstance(returns[0].expr, A.ContextVar):
+            return None  # $matches/$paths/$elements need Documents
+        # lone COUNT(*) → O(1): the table's valid row count
+        name = self.count_only_name()
+        if name is not None:
+            return self.finalize_count(name, table.count)
+        # plain columnar projections: alias.prop / depth aliases
+        from orientdb_tpu.exec.eval import contains_aggregate
+
+        if any(contains_aggregate(p.expr) for p in returns):
+            return None
+        plans = []  # (name, values np | None, present np | None, decode)
+        n = table.count
+        for i, p in enumerate(returns):
+            e = p.expr
+            name = p.alias or _match_proj_name(e, i)
+            if isinstance(e, A.Identifier) and e.name in table.depth_cols:
+                arr = np.asarray(table.depth_cols[e.name])[:n]
+                plans.append((name, arr, arr >= 0, None))
+                continue
+            if (
+                isinstance(e, A.FieldAccess)
+                and isinstance(e.base, A.Identifier)
+                and e.base.name in table.cols
+            ):
+                prop = e.name
+                if prop in self.dg.non_columnar or prop.startswith("@"):
+                    return None
+                idx = np.asarray(table.cols[e.base.name])[:n]
+                col = self.snap.v_columns.get(prop)
+                if col is None:
+                    plans.append((name, None, None, None))  # never present
+                    continue
+                ci = np.clip(idx, 0, max(len(col.values) - 1, 0))
+                vals = col.values[ci]
+                pres = col.present[ci] & (idx >= 0)
+                plans.append((name, vals, pres, col))
+                continue
+            return None
+        # vectorized decode: one object column per projection (no per-value
+        # Python decode calls — this loop runs per result row otherwise)
+        names = []
+        obj_cols = []
+        for name, vals, pres, col in plans:
+            names.append(name)
+            if vals is None:
+                obj_cols.append(np.full(n, None, object))
+                continue
+            if col is None:  # depth alias: plain ints
+                o = vals.astype(object)
+            elif col.kind == "str":
+                d = np.asarray(col.dictionary if col.dictionary else [""], object)
+                o = d[np.clip(vals, 0, len(d) - 1)]
+            elif col.kind == "bool":
+                o = (vals != 0).astype(object)
+            elif col.kind == "float":
+                o = vals.astype(float).astype(object)
+            else:
+                o = vals.astype(object)
+            o[~pres] = None
+            obj_cols.append(o)
+        out = [
+            Result(props=dict(zip(names, vals_row)))
+            for vals_row in zip(*obj_cols)
+        ] if obj_cols else [Result(props={}) for _ in range(n)]
+        return finalize_match_rows(self.db, stmt, out, self.params, None)
+
+
+# ---------------------------------------------------------------------------
+# compiled plan cache ([E] OExecutionPlanCache analog)
+# ---------------------------------------------------------------------------
+
+
+class _CompiledPlan:
+    """A solver whose size schedule is learned: re-executions replay the
+    whole solve as one jitted, sync-free device dispatch."""
+
+    def __init__(self, solver: TpuMatchSolver, table: Table) -> None:
+        self.solver = solver
+        self.v_names = sorted(table.cols)
+        self.e_names = sorted(table.edge_cols)
+        self.d_names = sorted(table.depth_cols)
+        self.count = table.count
+        self.width = table.width
+        self.count_name = solver.count_only_name()
+        self.jitted = jax.jit(self._replay)
+
+    def _replay(self):
+        self.solver.sched.start_replay()
+        table = self.solver.solve_table()
+        if self.count_name is not None:
+            # COUNT(*) plan: one device scalar is the whole result
+            return table.count_device
+        flat: List[jnp.ndarray] = [table.cols[a] for a in self.v_names]
+        for a in self.e_names:
+            flat.extend(table.edge_cols[a])
+        flat.extend(table.depth_cols[a] for a in self.d_names)
+        if not flat:  # no columns (e.g. fully-detached optional pattern)
+            return table.count_device
+        # one stacked buffer → ONE device→host transfer per query (the
+        # tunneled-TPU fetch RTT dominates small-result queries otherwise)
+        return jnp.stack(flat)
+
+    def rows(self) -> List[Result]:
+        if self.count_name is not None:
+            val = int(np.asarray(self.jitted()))
+            return self.solver.finalize_count(self.count_name, val)
+        return self.solver.rows_from_table(self.run())
+
+    def run(self) -> Table:
+        stacked = np.asarray(self.jitted())
+        t = Table(count=self.count, width=self.width)
+        i = 0
+        for a in self.v_names:
+            t.cols[a] = stacked[i]
+            i += 1
+        for a in self.e_names:
+            t.edge_cols[a] = (stacked[i], stacked[i + 1])
+            i += 2
+        for a in self.d_names:
+            t.depth_cols[a] = stacked[i]
+            i += 1
+        return t
+
+
+def _params_key(params) -> Optional[Tuple]:
+    try:
+        # include the value's type: 1 / True / 1.0 hash equal but compile
+        # to different predicates
+        t = tuple(
+            sorted((str(k), type(v).__name__, v) for k, v in params.items())
+        )
+        hash(t)
+        return t
+    except TypeError:
+        return None  # unhashable param values → skip plan cache
 
 
 # ---------------------------------------------------------------------------
@@ -594,10 +853,33 @@ class TpuMatchSolver:
 
 
 def execute(db, stmt, params) -> List[Result]:
-    if isinstance(stmt, A.MatchStatement):
-        solver = TpuMatchSolver(db, stmt, params or {})
-        return solver.rows()
-    raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
+    if not isinstance(stmt, A.MatchStatement):
+        raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
+    params = params or {}
+    snap = db.current_snapshot(require_fresh=True)
+    if snap is None:
+        raise Uncompilable("no fresh snapshot attached")
+    cache: Dict = getattr(snap, "_plan_cache", None)
+    if cache is None:
+        cache = snap._plan_cache = {}
+    pk = _params_key(params)
+    key, plan = None, None
+    if pk is not None:
+        try:
+            key = (stmt, pk)
+            plan = cache.get(key)
+        except TypeError:  # statement holds an unhashable literal
+            key = None
+    if plan is not None:
+        return plan.rows()
+    solver = TpuMatchSolver(db, stmt, params)
+    table = solver.solve_table()
+    rows = solver.rows_from_table(table)
+    if key is not None:
+        if len(cache) > 128:
+            cache.clear()
+        cache[key] = _CompiledPlan(solver, table)
+    return rows
 
 
 def explain_plan_steps(db, stmt) -> List[str]:

@@ -64,10 +64,12 @@ def gather_expand(
     K = srcs.shape[0]
     pos = jnp.arange(out_size, dtype=jnp.int32)
     valid = pos < total
-    # rank-search: which source row owns flat position `pos`
-    row = jnp.clip(
-        jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1, 0, K - 1
-    )
+    # rank search via scatter+cumsum (binary-search gathers serialize badly
+    # on TPU; a one-hot scatter then prefix-sum stays on the VPU): mark each
+    # row's start offset, then row(pos) = #starts ≤ pos − 1. Zero-count rows
+    # share an offset with their successor and never own a position.
+    marks = jnp.zeros(out_size, jnp.int32).at[offsets].add(1, mode="drop")
+    row = jnp.clip(jnp.cumsum(marks) - 1, 0, K - 1).astype(jnp.int32)
     src = jnp.take(srcs, row)
     s = jnp.clip(src, 0, indptr.shape[0] - 2)
     edge_pos = jnp.take(indptr, s) + (pos - jnp.take(offsets, row))
@@ -116,31 +118,3 @@ def rows_with_matches(rows: jnp.ndarray, mask: jnp.ndarray, num_segments: int):
     )
 
 
-# ---------------------------------------------------------------------------
-# host-driven orchestration helpers (one device→host sync per step)
-# ---------------------------------------------------------------------------
-
-
-def expand_step(indptr, neighbors, srcs):
-    """One full expansion: returns (row, edge_pos, neighbor, total:int).
-
-    Host-syncs once on the total count to pick the output bucket — the
-    price of dynamic frontiers under XLA's static-shape model; everything
-    else stays on device.
-    """
-    counts = degree_counts(indptr, srcs)
-    offsets = exclusive_cumsum(counts)
-    total_dev = counts.sum()
-    total = int(total_dev)
-    out_size = bucket(total)
-    row, edge_pos, nbr = gather_expand(
-        indptr, neighbors, srcs, offsets, total_dev, out_size
-    )
-    return row, edge_pos, nbr, total
-
-
-def compact(mask):
-    """Indices of surviving rows (bucketed, -1 padded) + exact count."""
-    count = int(mask_count(mask))
-    idx = compact_indices(mask, bucket(count))
-    return idx, count

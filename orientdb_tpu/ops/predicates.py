@@ -419,12 +419,9 @@ class Compiler:
 
     def _cmp_str_lit(self, op: str, col: _Val, lit: str) -> BoolFn:
         d: Sequence[str] = col.dictionary or []
-        exact = None
-        i = bisect.bisect_left(d, lit)
-        if i < len(d) and d[i] == lit:
-            exact = i
         lo = bisect.bisect_left(d, lit)
         hi = bisect.bisect_right(d, lit)
+        exact = lo if (lo < len(d) and d[lo] == lit) else None
 
         def fn(idx, env, col=col, op=op, exact=exact, lo=lo, hi=hi):
             vals, pres = col.emit(idx, env)

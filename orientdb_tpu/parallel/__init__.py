@@ -1,0 +1,2 @@
+"""Distributed execution: device meshes, sharded CSR, collective frontier
+expansion (SURVEY.md §2 "Parallelism strategies" and §5.7/5.8)."""

@@ -1,0 +1,183 @@
+"""Sharded graph execution over a device mesh.
+
+The reference scales out with Hazelcast replication and per-cluster server
+ownership ([E] OHazelcastPlugin / ODistributedConfiguration, SURVEY.md §2
+"Distributed"); the TPU-native design shards the **CSR by source-vertex
+range across chips** and merges per-hop frontiers with XLA collectives over
+ICI (`psum` OR-merge of frontier bitmaps — SURVEY.md §5.7's ring-attention
+analog for deep traversal).
+
+Mesh axes (the DP×TP analog for a graph engine):
+  - ``replicas`` — independent query streams (each replica holds a block of
+    the query batch; the data-parallel axis);
+  - ``shards`` — CSR row ranges (each shard owns vertices
+    [s·rows_per_shard, (s+1)·rows_per_shard) and their out-edges; the
+    model-parallel axis).
+
+Everything compiles under one `jit(shard_map(...))`: the per-hop schedule is
+  local edge-activation gather → scatter-OR into a [Q, V] bitmap → psum
+over `shards`, iterated by `lax.fori_loop` for multi-hop BFS with a visited
+bitmap (the columnar analog of [E] OTraverseStatement's visited set).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from orientdb_tpu.storage.snapshot import GraphSnapshot
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, replicas: int = 1
+) -> Mesh:
+    """1-D or 2-D mesh: (replicas, shards). `n_devices` defaults to all."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"need {n} devices, have {len(devs)} "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU)"
+        )
+    if n % replicas:
+        raise ValueError(f"{n} devices not divisible into {replicas} replicas")
+    arr = np.array(devs[:n]).reshape(replicas, n // replicas)
+    return Mesh(arr, ("replicas", "shards"))
+
+
+class ShardedCSR:
+    """One edge class's out-CSR, row-sharded by vertex range.
+
+    Host layout: [n_shards, rows_per_shard+1] locally-rebased indptr and
+    [n_shards, max_local_edges] destination arrays (-1 padded), placed with
+    a NamedSharding so each device holds exactly its shard.
+    """
+
+    def __init__(self, mesh: Mesh, indptr: np.ndarray, dst: np.ndarray):
+        self.mesh = mesh
+        n_shards = mesh.shape["shards"]
+        V = int(indptr.shape[0]) - 1
+        rows = max(1, math.ceil(V / n_shards))
+        V_pad = rows * n_shards
+        self.num_vertices = V
+        self.rows_per_shard = rows
+        self.padded_vertices = V_pad
+        ind_l = np.zeros((n_shards, rows + 1), np.int32)
+        counts = []
+        locals_ = []
+        for s in range(n_shards):
+            r0 = min(s * rows, V)
+            r1 = min(r0 + rows, V)
+            seg = indptr[r0 : r1 + 1] - indptr[r0]
+            ind_l[s, : seg.shape[0]] = seg
+            if seg.shape[0] < rows + 1:
+                ind_l[s, seg.shape[0] :] = seg[-1] if seg.shape[0] else 0
+            locals_.append(dst[indptr[r0] : indptr[r1]])
+            counts.append(int(indptr[r1] - indptr[r0]))
+        e_max = max(max(counts), 1)
+        dst_l = np.full((n_shards, e_max), -1, np.int32)
+        for s, seg in enumerate(locals_):
+            dst_l[s, : seg.shape[0]] = seg
+        shard_spec = NamedSharding(mesh, P("shards", None))
+        self.indptr = jax.device_put(jnp.asarray(ind_l), shard_spec)
+        self.dst = jax.device_put(jnp.asarray(dst_l), shard_spec)
+
+    @classmethod
+    def from_snapshot(
+        cls, snap: GraphSnapshot, mesh: Mesh, edge_class: str
+    ) -> "ShardedCSR":
+        csr = snap.edge_classes[edge_class]
+        return cls(mesh, csr.indptr_out, csr.dst)
+
+
+def _local_hop(indptr_l, dst_l, frontier, rows_per_shard, v_pad):
+    """One shard's contribution to the next frontier.
+
+    indptr_l [rows+1] local CSR; dst_l [E_max] global dst (-1 pad);
+    frontier [Q, V_pad] replicated bitmap. Returns [Q, V_pad] bitmap of
+    vertices reached through this shard's edges.
+    """
+    e_max = dst_l.shape[0]
+    epos = jnp.arange(e_max, dtype=jnp.int32)
+    src_local = jnp.clip(
+        jnp.searchsorted(indptr_l, epos, side="right").astype(jnp.int32) - 1,
+        0,
+        rows_per_shard - 1,
+    )
+    shard_id = jax.lax.axis_index("shards")
+    src_global = src_local + shard_id * rows_per_shard
+    edge_live = (dst_l >= 0) & (epos < indptr_l[-1])
+    # [Q, E_max]: edge active iff its source is in that query's frontier
+    active = frontier[:, src_global] & edge_live[None, :]
+    dst_c = jnp.clip(dst_l, 0, v_pad - 1)
+    contrib = jnp.zeros(frontier.shape, bool).at[:, dst_c].max(active)
+    return contrib
+
+
+def build_bfs_step(
+    mesh: Mesh, rows_per_shard: int, v_pad: int, max_depth: int
+):
+    """Compile the sharded multi-hop BFS step (the framework's
+    `dryrun_multichip` "training step": DP over query replicas × TP over
+    CSR shards, psum OR-merge per hop over ICI)."""
+
+    def step(indptr_sh, dst_sh, roots):
+        # roots: [Q, V_pad] bool, replica-sharded on axis 0
+        def inner(indptr_l, dst_l, frontier0):
+            indptr_l = indptr_l[0]  # drop the size-1 sharded block dims
+            dst_l = dst_l[0]
+
+            def body(_, state):
+                frontier, visited = state
+                contrib = _local_hop(
+                    indptr_l, dst_l, frontier, rows_per_shard, v_pad
+                )
+                merged = (
+                    jax.lax.psum(contrib.astype(jnp.int32), "shards") > 0
+                )
+                nxt = merged & ~visited
+                return nxt, visited | nxt
+
+            frontier, visited = jax.lax.fori_loop(
+                0, max_depth, body, (frontier0, frontier0)
+            )
+            return visited
+
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P("shards", None), P("shards", None), P("replicas", None)),
+            out_specs=P("replicas", None),
+            check_vma=False,
+        )(indptr_sh, dst_sh, roots)
+
+    return jax.jit(step)
+
+
+def bfs_reachability(
+    scsr: ShardedCSR, roots: np.ndarray, max_depth: int
+) -> np.ndarray:
+    """Multi-source BFS closure: roots [Q, V] bool → visited [Q, V] bool
+    (roots included at depth 0, like TRAVERSE / MATCH-WHILE emit-origin
+    semantics)."""
+    mesh = scsr.mesh
+    Q = roots.shape[0]
+    reps = mesh.shape["replicas"]
+    q_pad = max(1, math.ceil(Q / reps)) * reps
+    fr = np.zeros((q_pad, scsr.padded_vertices), bool)
+    fr[:Q, : roots.shape[1]] = roots
+    fr_dev = jax.device_put(
+        jnp.asarray(fr), NamedSharding(mesh, P("replicas", None))
+    )
+    step = build_bfs_step(
+        mesh, scsr.rows_per_shard, scsr.padded_vertices, max_depth
+    )
+    visited = step(scsr.indptr, scsr.dst, fr_dev)
+    return np.asarray(visited)[:Q, : scsr.num_vertices]

@@ -5,12 +5,21 @@ its multi-server-in-one-JVM distributed tests."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# overwrite, not setdefault: the axon environment exports JAX_PLATFORMS=axon
+# globally (and its sitecustomize imports jax before conftest runs), which
+# would put the whole unit suite on the (single, tunneled) real TPU chip —
+# slow compiles and no 8-device mesh. jax.config.update works post-import
+# as long as no backend has been initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

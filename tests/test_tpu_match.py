@@ -163,3 +163,38 @@ def test_missing_property_null_semantics(snap_db):
         "-HasFriend->{as:f} RETURN f.name AS f"
     )
     assert_parity(snap_db, sql2, strict=True)
+
+
+def test_plan_cache_replay_parity(snap_db):
+    """2nd+ executions run the jitted sync-free replay — same rows."""
+    sql = (
+        "MATCH {class:Profiles, as:p, where:(age > 25)}-HasFriend->{as:f} "
+        "RETURN p.name AS p, f.name AS f"
+    )
+    first = canon(snap_db.query(sql, engine="tpu", strict=True).to_dicts())
+    snap = snap_db.current_snapshot()
+    assert getattr(snap, "_plan_cache", None), "plan not cached"
+    for _ in range(3):
+        again = canon(snap_db.query(sql, engine="tpu", strict=True).to_dicts())
+        assert again == first
+
+
+def test_plan_cache_param_type_distinct(snap_db):
+    """1 vs True hash equal but compile differently — no stale plan."""
+    sql = (
+        "MATCH {class:Profiles, as:p, where:(age > :minage)}-HasFriend->{as:f} "
+        "RETURN p.name AS p"
+    )
+    r_int = len(snap_db.query(sql, {"minage": 1}, engine="tpu", strict=True).to_dicts())
+    r_bool = snap_db.query(sql, {"minage": True}, engine="tpu", strict=True).to_dicts()
+    o_bool = snap_db.query(sql, {"minage": True}, engine="oracle").to_dicts()
+    assert canon(r_bool) == canon(o_bool)
+    assert r_int == 6
+
+
+def test_all_optional_pattern_replay(snap_db):
+    """Column-less table: cached replay must not crash on re-execution."""
+    sql = "MATCH {class:Profiles, as:p, optional:true} RETURN p.name AS p"
+    first = canon(snap_db.query(sql, engine="tpu").to_dicts())
+    for _ in range(2):
+        assert canon(snap_db.query(sql, engine="tpu").to_dicts()) == first
