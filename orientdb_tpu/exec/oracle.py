@@ -27,6 +27,8 @@ MATCH semantics implemented here (the golden-corpus spec, mirroring
 from __future__ import annotations
 
 import itertools
+from collections import deque
+from typing import Deque
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 from orientdb_tpu.exec.eval import (
@@ -708,13 +710,17 @@ class MatchInterpreter:
                     if other is not None:
                         yield other, edge, 1, [start, other]
             return
-        # variable-depth: DFS with visited set; emit every reached node
-        # including the origin at depth 0
+        # variable-depth: BFS with visited-at-enqueue; emit every reached
+        # node including the origin at depth 0. BFS (not the reference's
+        # per-record DFS) makes $depth the MINIMUM depth and the emitted
+        # set independent of traversal order — DFS can reach a node first
+        # through a long path and then refuse to expand it under
+        # WHILE($depth<N), making results order-dependent on cyclic graphs.
         visited: Set[RID] = {start.rid}
         yield start, None, 0, [start]
-        stack: List[Tuple[Document, int, List[Document]]] = [(start, 0, [start])]
-        while stack:
-            node, depth, path = stack.pop()
+        queue: Deque[Tuple[Document, int, List[Document]]] = deque([(start, 0, [start])])
+        while queue:
+            node, depth, path = queue.popleft()
             # gate traversal: while-condition at the current node
             if not self._while_ok(node, depth, while_cond, max_depth, bindings):
                 continue
@@ -730,7 +736,7 @@ class MatchInterpreter:
                     visited.add(other.rid)
                     npath = path + [other]
                     yield other, edge, depth + 1, npath
-                    stack.append((other, depth + 1, npath))
+                    queue.append((other, depth + 1, npath))
 
     def _while_ok(self, node, depth, while_cond, max_depth, bindings) -> bool:
         if max_depth is not None and depth >= max_depth:

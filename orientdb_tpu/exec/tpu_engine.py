@@ -312,6 +312,14 @@ class TpuMatchSolver:
         self._node_masks: Dict[str, object] = {}
         for alias, node in self.pattern.nodes.items():
             self._node_masks[alias] = self._compile_node(node)
+        # WHILE conditions compile with $depth as a per-level scalar
+        self._while_fns: Dict[int, object] = {}
+        for e in self.pattern.edges:
+            w = e.item.target.while_cond
+            if w is not None:
+                self._while_fns[id(e)] = compile_predicate(
+                    w, self._vertex_scope(), self.params, allow_depth=True
+                )
 
     # -- compile-time gating ------------------------------------------------
 
@@ -324,8 +332,10 @@ class TpuMatchSolver:
             m = (item.method or "").lower()
             if m in ("outv", "inv", "bothv", "oute", "ine", "bothe"):
                 raise Uncompilable(f"method form .{m}() not compiled yet")
-            if item.target.while_cond is not None or item.target.max_depth is not None:
-                raise Uncompilable("WHILE/maxDepth not compiled yet")
+            var_depth = (
+                item.target.while_cond is not None
+                or item.target.max_depth is not None
+            )
             if item.target.path_alias:
                 raise Uncompilable("pathAlias not compiled (per-path state)")
             if item.negated:
@@ -335,6 +345,14 @@ class TpuMatchSolver:
                 f.where, self.pattern.nodes
             ):
                 raise Uncompilable("edge WHERE references bindings")
+            if var_depth:
+                if f is not None and f.alias:
+                    raise Uncompilable(
+                        "edge alias on a WHILE arrow (discovery-edge binding)"
+                    )
+                w = item.target.while_cond
+                if w is not None and _expr_uses_bindings(w, self.pattern.nodes):
+                    raise Uncompilable("WHILE condition references bindings")
         # edge-alias nodes are fine when bound by an edge-filter alias during
         # a (required or close) expansion; a bare edge-alias root is not
         edge_filter_aliases = {
@@ -477,9 +495,28 @@ class TpuMatchSolver:
         t.cols[alias] = K.take_pad(cand, sel, jnp.int32(-1))
         return t
 
+    def _resolve_edge_classes(self, item: A.MatchPathItem) -> List[str]:
+        """Concrete edge classes for a path item, with the edge-filter's
+        class restriction applied as a host-side subclass check."""
+        names = item.edge_classes or (None,)
+        concrete: List[str] = []
+        for nm in names:
+            concrete.extend(self.snap.concrete_edge_classes(nm))
+        f = item.edge_filter
+        if f is not None and f.class_name:
+            keep = []
+            for c in concrete:
+                cls = self.db.schema.get_class(c)
+                if cls is not None and cls.is_subclass_of(f.class_name):
+                    keep.append(c)
+            concrete = keep
+        return concrete
+
     def _expand(self, table: Table, step: PlanStep, optional: bool) -> Table:
         e = step.edge
         item = e.item
+        if item.target.while_cond is not None or item.target.max_depth is not None:
+            return self._expand_var_depth(table, step, optional)
         direction = item.direction
         reverse = step.reverse
         if reverse:
@@ -490,20 +527,8 @@ class TpuMatchSolver:
         srcs = table.cols.get(src_alias)
         if srcs is None:
             raise Uncompilable(f"alias {src_alias} not bound before expansion")
-        # concrete edge classes in declaration order
-        names = item.edge_classes or (None,)
-        concrete: List[str] = []
-        for nm in names:
-            concrete.extend(self.snap.concrete_edge_classes(nm))
-        # edge-filter class restriction is a host-side subclass check
+        concrete = self._resolve_edge_classes(item)
         f = item.edge_filter
-        if f is not None and f.class_name:
-            keep = []
-            for c in concrete:
-                cls = self.db.schema.get_class(c)
-                if cls is not None and cls.is_subclass_of(f.class_name):
-                    keep.append(c)
-            concrete = keep
         sub_dirs = ("out", "in") if direction == "both" else (direction,)
         parts: List[Table] = []
         counts: List[int] = []
@@ -596,6 +621,180 @@ class TpuMatchSolver:
                 )
             return t
         return _concat_tables(parts, counts)
+
+    # -- variable-depth (WHILE / maxDepth) expansion ------------------------
+
+    _VAR_DEPTH_CHUNK = 256
+
+    def _expand_var_depth(self, table: Table, step: PlanStep, optional: bool) -> Table:
+        """Breadth-wise frontier iteration with per-row visited bitmaps —
+        the SURVEY §5.7 design for the reference's per-record WHILE-DFS
+        ([E] OWhileMatchPathItem): emit the origin at depth 0, then one
+        bitmap hop per level, gating expansion with the WHILE mask at the
+        level's $depth and stopping at maxDepth / frontier exhaustion.
+        Depths are minimum-discovery depths (the oracle's BFS semantics).
+        """
+        e = step.edge
+        item = e.item
+        direction = item.direction
+        reverse = step.reverse
+        if reverse:
+            direction = _REVERSE_DIR[direction]
+        src_alias = e.to_alias if reverse else e.from_alias
+        dst_alias = e.from_alias if reverse else e.to_alias
+        srcs = table.cols.get(src_alias)
+        if srcs is None:
+            raise Uncompilable(f"alias {src_alias} not bound before expansion")
+        max_depth = item.target.max_depth
+        while_fn = self._while_fns.get(id(e))
+        depth_alias = item.target.depth_alias
+        V = self.dg.num_vertices
+        vb = K.bucket(max(V, 1))
+        univ = jnp.arange(vb, dtype=jnp.int32)
+        univ = jnp.where(univ < V, univ, -1)
+        node_mask_vec = self._node_masks[dst_alias](univ)  # [vb]
+        # per-(class, dir) edge hop arrays; edge WHERE fused as edge masks
+        f = item.edge_filter
+        hops = []
+        for cname in self._resolve_edge_classes(item):
+            dec = self.dg.edges[cname]
+            E = dec.num_edges
+            eids = jnp.arange(E, dtype=jnp.int32)
+            emask = (
+                self._edge_where(cname, f.where)(eids, {})
+                if (f is not None and f.where is not None)
+                else jnp.ones(E, bool)
+            )
+            for d in ("out", "in") if direction == "both" else (direction,):
+                if d == "out":
+                    hops.append((dec.edge_src, dec.dst, emask))
+                else:  # follow edges backwards: activate on dst, emit src
+                    hops.append((dec.dst, dec.edge_src, emask))
+        parts: List[Table] = []
+        counts: List[int] = []
+        width = table.width or 1
+        matched_chunks = []
+        C = self._VAR_DEPTH_CHUNK
+        for cs in range(0, max(table.count, 1), C):
+            chunk_rows = jnp.arange(cs, cs + C, dtype=jnp.int32)
+            chunk_valid = chunk_rows < table.count
+            chunk_rows = jnp.where(chunk_valid, chunk_rows, -1)
+            src_chunk = K.take_pad(srcs, chunk_rows, jnp.int32(-1))
+            roots = K.rows_to_bitmap(src_chunk, vb)
+            bound_chunk = None
+            if step.close:
+                bound_chunk = K.take_pad(
+                    table.cols[dst_alias], chunk_rows, jnp.int32(-2)
+                )
+            matched = jnp.zeros(C, bool)
+            visited = roots
+            frontier = roots
+            depth = 0
+            # emit the origin at depth 0
+            matched = matched | self._emit_var_level(
+                table, roots, node_mask_vec, bound_chunk, cs, depth,
+                dst_alias, depth_alias, vb, parts, counts,
+            )
+            while True:
+                if max_depth is not None and depth >= max_depth:
+                    break
+                expandable = frontier
+                if while_fn is not None:
+                    gate = while_fn(univ, {"depth": depth})
+                    expandable = expandable & gate[None, :]
+                nxt = jnp.zeros_like(frontier)
+                for act_idx, emit_idx, emask in hops:
+                    nxt = nxt | K.bitmap_hop(act_idx, emit_idx, emask, expandable)
+                nxt = nxt & ~visited
+                alive = self.sched.observe(K.mask_count(nxt))
+                if alive == 0:
+                    break
+                visited = visited | nxt
+                depth += 1
+                matched = matched | self._emit_var_level(
+                    table, nxt, node_mask_vec, bound_chunk, cs, depth,
+                    dst_alias, depth_alias, vb, parts, counts,
+                )
+                frontier = nxt
+                if depth > V:  # safety: no graph has longer shortest paths
+                    break
+            matched_chunks.append(matched)
+        if optional:
+            matched_all = jnp.concatenate(matched_chunks)[:width]
+            if matched_all.shape[0] < width:
+                matched_all = jnp.concatenate(
+                    [
+                        matched_all,
+                        jnp.zeros(width - matched_all.shape[0], bool),
+                    ]
+                )
+            rowids = jnp.arange(width, dtype=jnp.int32)
+            unmatched = (rowids < table.count) & ~matched_all
+            ukeep, un, un_dev = self._compact(unmatched)
+            if un > 0:
+                upart = table.gather(ukeep)
+                upart.count = un
+                upart.count_dev = un_dev
+                null_col = jnp.full(upart.width, -1, jnp.int32)
+                if step.close:
+                    src_g = K.take_pad(srcs, ukeep, jnp.int32(-1))
+                    upart.cols[dst_alias] = jnp.where(
+                        src_g < 0, upart.cols[dst_alias], -1
+                    )
+                else:
+                    upart.cols[dst_alias] = null_col
+                if depth_alias:
+                    upart.depth_cols[depth_alias] = null_col
+                parts.append(upart)
+                counts.append(un)
+        if not parts:
+            t = table.gather(jnp.full(K.bucket(1), -1, jnp.int32))
+            t.count = 0
+            t.count_dev = jnp.int32(0)
+            t.cols[dst_alias] = jnp.full(t.width, -1, jnp.int32)
+            if depth_alias:
+                t.depth_cols[depth_alias] = jnp.full(t.width, -1, jnp.int32)
+            return t
+        return _concat_tables(parts, counts)
+
+    def _emit_var_level(
+        self,
+        table: Table,
+        reached: jnp.ndarray,
+        node_mask_vec: jnp.ndarray,
+        bound_chunk,
+        cs: int,
+        depth: int,
+        dst_alias: str,
+        depth_alias,
+        vb: int,
+        parts: List[Table],
+        counts: List[int],
+    ) -> jnp.ndarray:
+        """Emit one BFS level's (row, vertex, depth) bindings; returns the
+        per-chunk-row matched mask (for OPTIONAL bookkeeping)."""
+        emit = reached & node_mask_vec[None, :]
+        if bound_chunk is not None:
+            vcol = jnp.arange(vb, dtype=jnp.int32)
+            emit = emit & (vcol[None, :] == bound_chunk[:, None])
+        matched = emit.any(axis=1)
+        flat = emit.reshape(-1)
+        keep, kn, kn_dev = self._compact(flat)
+        if kn == 0:
+            return matched
+        ok = keep >= 0
+        c = jnp.where(ok, keep // vb, -1)
+        v = jnp.where(ok, keep % vb, -1)
+        rowid = jnp.where(ok, cs + c, -1)
+        part = table.gather(rowid)
+        part.count = kn
+        part.count_dev = kn_dev
+        part.cols[dst_alias] = v
+        if depth_alias:
+            part.depth_cols[depth_alias] = jnp.where(ok, depth, -1)
+        parts.append(part)
+        counts.append(kn)
+        return matched
 
     def _bind_edge_alias(self, part: Table, item: A.MatchPathItem, ecls_idx, eid):
         f = item.edge_filter
@@ -796,9 +995,18 @@ class _CompiledPlan:
         self.count_name = solver.count_only_name()
         self.jitted = jax.jit(self._replay)
 
-    def _replay(self):
-        self.solver.sched.start_replay()
-        table = self.solver.solve_table()
+    def _replay(self, arrays):
+        # swap the tracer pytree into the device graph for the trace so the
+        # graph buffers become jit ARGUMENTS (shared across every cached
+        # plan) rather than per-executable HLO constants
+        dg = self.solver.dg
+        saved = dg.arrays
+        dg.arrays = arrays
+        try:
+            self.solver.sched.start_replay()
+            table = self.solver.solve_table()
+        finally:
+            dg.arrays = saved
         if self.count_name is not None:
             # COUNT(*) plan: one device scalar is the whole result
             return table.count_device
@@ -814,12 +1022,12 @@ class _CompiledPlan:
 
     def rows(self) -> List[Result]:
         if self.count_name is not None:
-            val = int(np.asarray(self.jitted()))
+            val = int(np.asarray(self.jitted(self.solver.dg.arrays)))
             return self.solver.finalize_count(self.count_name, val)
         return self.solver.rows_from_table(self.run())
 
     def run(self) -> Table:
-        stacked = np.asarray(self.jitted())
+        stacked = np.asarray(self.jitted(self.solver.dg.arrays))
         t = Table(count=self.count, width=self.width)
         i = 0
         for a in self.v_names:
@@ -877,7 +1085,7 @@ def execute(db, stmt, params) -> List[Result]:
     rows = solver.rows_from_table(table)
     if key is not None:
         if len(cache) > 128:
-            cache.clear()
+            cache.pop(next(iter(cache)))  # evict oldest, keep hot plans
         cache[key] = _CompiledPlan(solver, table)
     return rows
 

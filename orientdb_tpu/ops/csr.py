@@ -107,6 +107,38 @@ def mask_count(mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(mask.astype(jnp.int32))
 
 
+@partial(jax.jit, static_argnames=("vb",))
+def rows_to_bitmap(rows: jnp.ndarray, vb: int) -> jnp.ndarray:
+    """[C] vertex ids (-1 = none) → [C, vb] one-hot frontier bitmap."""
+    C = rows.shape[0]
+    ok = rows >= 0
+    r = jnp.clip(rows, 0, vb - 1)
+    return jnp.zeros((C, vb), bool).at[jnp.arange(C), r].max(ok)
+
+
+@jax.jit
+def bitmap_hop(
+    act_idx: jnp.ndarray,
+    emit_idx: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    frontier: jnp.ndarray,
+) -> jnp.ndarray:
+    """One frontier hop over an edge list as dense bitmaps.
+
+    act_idx/emit_idx [E]: the edge endpoint that must be in the frontier
+    and the endpoint reached (swap them to walk edges backwards);
+    edge_mask [E] prefilters edges (fused edge-property WHERE);
+    frontier [C, vb] per-row bitmaps. The scatter-OR is the SURVEY §5.7
+    frontier-bitmap step of variable-depth traversal.
+    """
+    vb = frontier.shape[1]
+    if act_idx.shape[0] == 0:
+        return jnp.zeros_like(frontier)
+    act = frontier[:, jnp.clip(act_idx, 0, vb - 1)] & edge_mask[None, :]
+    emit_c = jnp.clip(emit_idx, 0, vb - 1)
+    return jnp.zeros_like(frontier).at[:, emit_c].max(act)
+
+
 @partial(jax.jit, static_argnames=("num_segments",))
 def rows_with_matches(rows: jnp.ndarray, mask: jnp.ndarray, num_segments: int):
     """Per-source-row match counts (OPTIONAL-arm left-join bookkeeping):

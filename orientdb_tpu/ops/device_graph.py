@@ -5,11 +5,18 @@ every array `jax.device_put` once per snapshot epoch and cached, so repeated
 queries over the same snapshot pay zero host↔device traffic for graph data —
 the TPU-native answer to the reference's per-record page-cache reads on every
 hop ([E] O2QCache / OPaginatedCluster.readRecord, SURVEY.md §3.2-3.3).
+
+All arrays live in one flat ``DeviceGraph.arrays`` dict and are read through
+lightweight proxies (`DeviceColumn`, `DeviceEdgeClass`). Compiled plans pass
+that dict as a jit *argument* pytree — temporarily swapping in the tracer
+dict during tracing — so the (potentially multi-GB) graph is shared across
+every cached plan executable instead of being baked into each one as HLO
+constants.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set
 
 import jax.numpy as jnp
 import numpy as np
@@ -18,50 +25,84 @@ from orientdb_tpu.storage.snapshot import GraphSnapshot, PropertyColumn
 
 
 class DeviceColumn:
-    """A property column on device: values + presence mask.
+    """A property column proxy: values + presence mask in ``graph.arrays``.
 
     `dictionary` (host-side) stays with the column so string predicates can
     be evaluated over the (small) dictionary on host and pushed to device as
     code-set membership masks.
     """
 
-    __slots__ = ("name", "kind", "values", "present", "dictionary")
+    __slots__ = ("name", "kind", "dictionary", "_g", "_kv", "_kp")
 
-    def __init__(self, col: PropertyColumn):
+    def __init__(self, col: PropertyColumn, g: "DeviceGraph", prefix: str):
         self.name = col.name
         self.kind = col.kind
-        self.values = jnp.asarray(col.values)
-        self.present = jnp.asarray(col.present)
         self.dictionary = col.dictionary
+        self._g = g
+        self._kv = g._put(f"{prefix}:v", col.values)
+        self._kp = g._put(f"{prefix}:p", col.present)
+
+    @property
+    def values(self):
+        return self._g.arrays[self._kv]
+
+    @property
+    def present(self):
+        return self._g.arrays[self._kp]
 
 
 class DeviceEdgeClass:
     """One edge class's CSR adjacency (both directions) in HBM."""
 
-    __slots__ = (
-        "class_name",
-        "indptr_out",
-        "dst",
-        "indptr_in",
-        "src",
-        "edge_id_in",
-        "columns",
-        "non_columnar",
-        "num_edges",
-    )
+    __slots__ = ("class_name", "columns", "non_columnar", "num_edges", "_g", "_p")
 
-    def __init__(self, csr) -> None:
+    def __init__(self, csr, g: "DeviceGraph") -> None:
         self.class_name = csr.class_name
-        self.indptr_out = jnp.asarray(csr.indptr_out)
-        self.dst = jnp.asarray(csr.dst)
-        self.indptr_in = jnp.asarray(csr.indptr_in)
-        self.src = jnp.asarray(csr.src)
-        self.edge_id_in = jnp.asarray(csr.edge_id_in)
+        self._g = g
+        p = self._p = f"e:{csr.class_name}"
+        g._put(f"{p}:indptr_out", csr.indptr_out)
+        g._put(f"{p}:dst", csr.dst)
+        # per-edge source vertex in out-CSR order (bitmap-hop kernels index
+        # edges directly instead of walking indptr)
+        g._put(
+            f"{p}:edge_src",
+            np.repeat(
+                np.arange(csr.indptr_out.shape[0] - 1, dtype=np.int32),
+                np.diff(csr.indptr_out),
+            ),
+        )
+        g._put(f"{p}:indptr_in", csr.indptr_in)
+        g._put(f"{p}:src", csr.src)
+        g._put(f"{p}:edge_id_in", csr.edge_id_in)
         self.columns: Dict[str, DeviceColumn] = {
-            n: DeviceColumn(c) for n, c in csr.edge_columns.items()
+            n: DeviceColumn(c, g, f"{p}:c:{n}") for n, c in csr.edge_columns.items()
         }
         self.non_columnar: Set[str] = set(getattr(csr, "non_columnar", ()))
         self.num_edges = int(csr.dst.shape[0])
+
+    @property
+    def indptr_out(self):
+        return self._g.arrays[f"{self._p}:indptr_out"]
+
+    @property
+    def dst(self):
+        return self._g.arrays[f"{self._p}:dst"]
+
+    @property
+    def edge_src(self):
+        return self._g.arrays[f"{self._p}:edge_src"]
+
+    @property
+    def indptr_in(self):
+        return self._g.arrays[f"{self._p}:indptr_in"]
+
+    @property
+    def src(self):
+        return self._g.arrays[f"{self._p}:src"]
+
+    @property
+    def edge_id_in(self):
+        return self._g.arrays[f"{self._p}:edge_id_in"]
 
 
 class DeviceGraph:
@@ -70,24 +111,30 @@ class DeviceGraph:
     def __init__(self, snap: GraphSnapshot) -> None:
         self.snap = snap
         self.num_vertices = snap.num_vertices
-        self.v_class = jnp.asarray(snap.v_class)
+        #: the single flat array store — a jit-arg pytree for compiled plans
+        self.arrays: Dict[str, jnp.ndarray] = {}
+        self._put("v_class", snap.v_class)
         self.columns: Dict[str, DeviceColumn] = {
-            n: DeviceColumn(c) for n, c in snap.v_columns.items()
+            n: DeviceColumn(c, self, f"v:{n}") for n, c in snap.v_columns.items()
         }
         self.non_columnar: Set[str] = set(getattr(snap, "v_non_columnar", ()))
         self.edges: Dict[str, DeviceEdgeClass] = {
-            n: DeviceEdgeClass(c) for n, c in snap.edge_classes.items()
+            n: DeviceEdgeClass(c, self) for n, c in snap.edge_classes.items()
         }
-        #: device-side polymorphic class-id sets (vertex classes)
-        self._class_ids: Dict[str, jnp.ndarray] = {}
+
+    def _put(self, key: str, arr) -> str:
+        self.arrays[key] = jnp.asarray(arr)
+        return key
+
+    @property
+    def v_class(self):
+        return self.arrays["v_class"]
 
     def class_ids(self, class_name: str) -> jnp.ndarray:
-        key = class_name.lower()
-        ids = self._class_ids.get(key)
-        if ids is None:
-            ids = jnp.asarray(self.snap.vertex_class_ids(class_name))
-            self._class_ids[key] = ids
-        return ids
+        key = f"classids:{class_name.lower()}"
+        if key not in self.arrays:
+            self._put(key, self.snap.vertex_class_ids(class_name))
+        return self.arrays[key]
 
 
 def device_graph(snap: GraphSnapshot) -> DeviceGraph:

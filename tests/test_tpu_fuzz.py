@@ -1,0 +1,74 @@
+"""Randomized parity stress: random graphs × query templates, both engines.
+
+Complements the hand-written corpus the way the reference's generated
+TestNG data suites do ([E] tests/ module, SURVEY.md §4): structure varies
+(degree skew, multiple edge classes, missing properties, cycles), results
+must stay multiset-identical between the oracle and the compiled engine.
+"""
+
+import numpy as np
+import pytest
+
+from orientdb_tpu import Database, PropertyType
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+
+def random_db(seed: int, n: int = 40) -> Database:
+    rng = np.random.default_rng(seed)
+    db = Database(f"fuzz{seed}")
+    person = db.schema.create_vertex_class("Person")
+    person.create_property("age", PropertyType.LONG)
+    person.create_property("name", PropertyType.STRING)
+    db.schema.create_edge_class("Knows")
+    rel = db.schema.create_edge_class("Follows")
+    rel.create_property("w", PropertyType.LONG)
+    vs = []
+    for i in range(n):
+        fields = {"uid": i}
+        if rng.random() > 0.15:  # some vertices miss properties
+            fields["age"] = int(rng.integers(10, 80))
+        if rng.random() > 0.1:
+            fields["name"] = f"n{int(rng.integers(0, 15))}"
+        vs.append(db.new_vertex("Person", **fields))
+    # skewed degrees incl. a supernode, self-loops allowed in Follows
+    for _ in range(n * 4):
+        s = int(rng.zipf(1.6)) % n
+        d = int(rng.integers(0, n))
+        if s != d:
+            db.new_edge("Knows", vs[s], vs[d])
+    for _ in range(n * 2):
+        s, d = int(rng.integers(0, n)), int(rng.integers(0, n))
+        db.new_edge("Follows", vs[s], vs[d], w=int(rng.integers(0, 5)))
+    attach_fresh_snapshot(db)
+    return db
+
+
+TEMPLATES = [
+    "MATCH {class:Person, as:a}-Knows->{as:b} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a, where:(age > 40)}-Knows->{as:b, where:(age < 50)} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a}-Knows->{as:b}-Knows->{as:c} RETURN count(*) AS n",
+    "MATCH {class:Person, as:a}-Knows->{as:b}, {as:b}-Knows->{as:a} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a}-Follows->{as:b, where:(age IS NOT NULL)} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a, where:(name = 'n3')}-Knows-{as:b} RETURN b.uid AS b",
+    "MATCH {class:Person, as:a, where:(uid < 5)}-Knows->{as:b, maxDepth:3} RETURN b.uid AS b",
+    "MATCH {class:Person, as:a, where:(uid = 0)}-Knows->{as:b, while:($depth < 3 AND age > 20), depthAlias:d} RETURN b.uid AS b, d AS d",
+    "MATCH {class:Person, as:a, where:(uid < 3)}<-Knows-{as:b, maxDepth:2} RETURN b.uid AS b",
+    "MATCH {class:Person, as:a, where:(uid < 4)}-Follows->{as:b, optional:true} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a}-->{as:b, where:(uid > 30)} RETURN a.uid AS a, b.uid AS b",
+]
+
+
+def canon(rows):
+    return sorted(tuple(sorted((k, repr(v)) for k, v in r.items())) for r in rows)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fuzz_parity(seed):
+    db = random_db(seed)
+    for sql in TEMPLATES:
+        oracle = db.query(sql, engine="oracle").to_dicts()
+        tpu = db.query(sql, engine="tpu", strict=True).to_dicts()
+        assert canon(tpu) == canon(oracle), (seed, sql)
+        # replay path (plan cache) must agree too
+        tpu2 = db.query(sql, engine="tpu", strict=True).to_dicts()
+        assert canon(tpu2) == canon(oracle), (seed, sql, "replay")

@@ -70,13 +70,21 @@ COMPILED = [
     "MATCH {class:Profiles, as:p}-Likes->{as:l, optional:true} RETURN p.name AS p, l.name AS l",
     # disjoint patterns (cartesian product)
     "MATCH {class:Profiles, as:a, where:(name='alice')}, {class:Profiles, as:b, where:(age > 34)} RETURN a.name AS a, b.name AS b",
-    # parameterized
+    # variable depth: WHILE / maxDepth / depthAlias (BFS min-depth)
+    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend->{as:f, while:($depth < 2)} RETURN f.name AS f",
+    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend->{as:f, maxDepth:2} RETURN f.name AS f",
+    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend->{as:f, while:($depth < 3), where:(age < 36)} RETURN f.name AS f",
+    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend->{as:f, maxDepth:3, depthAlias:d} RETURN f.name AS f, d AS d",
+    "MATCH {class:Profiles, as:p, where:(name='alice')}<-HasFriend-{as:f, maxDepth:2} RETURN f.name AS f",
+    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend-{as:f, while:($depth < 2)} RETURN f.name AS f",
+    # while gated by vertex property (traversal stops at old profiles)
+    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend->{as:f, while:($depth < 4 AND age < 39)} RETURN f.name AS f",
+    # whole-class var-depth (every profile as root)
+    "MATCH {class:Profiles, as:p}-HasFriend->{as:f, maxDepth:2} RETURN count(*) AS n",
 ]
 
 # not-yet-compiled surface: must still answer correctly via fallback
 FALLBACK = [
-    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend->{as:f, while:($depth < 2)} RETURN f.name AS f",
-    "MATCH {class:Profiles, as:p, where:(name='alice')}-HasFriend->{as:f, maxDepth:2} RETURN f.name AS f",
     "MATCH {class:Profiles, as:a}-HasFriend->{as:b}, NOT {as:a}-Likes->{as:b} RETURN a.name AS a, b.name AS b",
     "MATCH {class:Profiles, as:p}.outE('Likes'){as:e} RETURN p.name AS p",
     "MATCH {class:Profiles, as:p, where:(name.toUpperCase() = 'ALICE')}-HasFriend->{as:f} RETURN f.name AS f",
