@@ -1,0 +1,78 @@
+"""Record-level hooks.
+
+Analog of the reference's trigger SPI ([E] ORecordHook / ORecordHookAbstract,
+SURVEY.md §2 "Live queries / hooks"): callbacks fire around every record
+create/update/delete on the host store. BEFORE hooks may mutate the record
+or veto by raising; AFTER hooks observe the committed state (live queries
+are implemented on top of AFTER hooks — `orientdb_tpu/exec/live.py`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+BEFORE_CREATE = "before_create"
+AFTER_CREATE = "after_create"
+BEFORE_UPDATE = "before_update"
+AFTER_UPDATE = "after_update"
+BEFORE_DELETE = "before_delete"
+AFTER_DELETE = "after_delete"
+
+EVENTS = (
+    BEFORE_CREATE,
+    AFTER_CREATE,
+    BEFORE_UPDATE,
+    AFTER_UPDATE,
+    BEFORE_DELETE,
+    AFTER_DELETE,
+)
+
+
+class HookManager:
+    """Registry of (event, class filter) → callbacks."""
+
+    def __init__(self, db) -> None:
+        self._db = db
+        self._lock = threading.Lock()
+        self._next_id = 1
+        #: token → (event or None=all, class_name or None=all, fn)
+        self._hooks: Dict[int, Tuple[Optional[str], Optional[str], Callable]] = {}
+
+    def register(
+        self,
+        fn: Callable,
+        event: Optional[str] = None,
+        class_name: Optional[str] = None,
+    ) -> int:
+        """Register `fn(event, doc)`; returns an unregister token."""
+        if event is not None and event not in EVENTS:
+            raise ValueError(f"unknown hook event {event!r}; one of {EVENTS}")
+        with self._lock:
+            token = self._next_id
+            self._next_id += 1
+            self._hooks[token] = (event, class_name, fn)
+            return token
+
+    def unregister(self, token: int) -> bool:
+        with self._lock:
+            return self._hooks.pop(token, None) is not None
+
+    def _matches_class(self, class_name: Optional[str], doc) -> bool:
+        if class_name is None:
+            return True
+        cls = self._db.schema.get_class(doc.class_name)
+        return cls is not None and cls.is_subclass_of(class_name)
+
+    def fire(self, event: str, doc) -> None:
+        with self._lock:
+            snapshot = list(self._hooks.values())
+        for ev, cname, fn in snapshot:
+            if ev is not None and ev != event:
+                continue
+            if not self._matches_class(cname, doc):
+                continue
+            fn(event, doc)  # BEFORE hooks veto by raising
+
+    def __len__(self) -> int:
+        return len(self._hooks)

@@ -1,15 +1,140 @@
 """Live queries (LIVE SELECT).
 
-Placeholder until the live-query hook system lands (analog of [E]
-OLiveQueryHookV2 / ORecordHook, SURVEY.md §2 "Live queries / hooks").
+Analog of [E] OLiveQueryHookV2 / OLiveQueryMonitor (SURVEY.md §2 "Live
+queries / hooks"): a LIVE SELECT subscribes to post-commit record events on
+its target class; every matching create/update/delete pushes an event
+``{"token", "operation", "rid", "record"}`` to the subscriber callback.
+The WHERE clause (if any) is evaluated against the record for create/update
+(delete events always fire, as in the reference, since the stored record no
+longer matches anything).
+
+Python API: ``monitor = live_query(db, sql, callback)`` →
+``monitor.unsubscribe()``. SQL surface: ``LIVE SELECT FROM Class`` returns
+a row with the monitor token and buffers events on the monitor
+(``live_unsubscribe(db, token)`` cancels).
 """
 
 from __future__ import annotations
 
-from typing import List
+import threading
+from typing import Callable, Dict, List
 
 from orientdb_tpu.exec.result import Result
+from orientdb_tpu.sql import ast as A
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("live")
+
+_EVENT_OPS = {
+    "after_create": "CREATE",
+    "after_update": "UPDATE",
+    "after_delete": "DELETE",
+}
 
 
-def subscribe(db, stmt, params) -> List[Result]:
-    raise NotImplementedError("live queries are not implemented yet")
+class LiveQueryMonitor:
+    """One live subscription ([E] OLiveQueryMonitor)."""
+
+    def __init__(self, db, stmt: A.SelectStatement, callback: Callable) -> None:
+        if not isinstance(stmt.target, A.ClassTarget):
+            raise ValueError("LIVE SELECT supports class targets only")
+        self.db = db
+        self.stmt = stmt
+        self.callback = callback
+        self.class_name = stmt.target.name
+        self._lock = threading.Lock()
+        self._active = True
+        self.token = db.hooks.register(self._on_event, class_name=self.class_name)
+
+    def _on_event(self, event: str, doc) -> None:
+        op = _EVENT_OPS.get(event)
+        if op is None or not self._active:
+            return
+        if op in ("CREATE", "UPDATE") and self.stmt.where is not None:
+            from orientdb_tpu.exec.eval import EvalContext, evaluate, truthy
+
+            ctx = EvalContext(self.db, current=doc)
+            try:
+                if not truthy(evaluate(ctx, self.stmt.where)):
+                    return
+            except Exception:
+                return
+        try:
+            self.callback(
+                {
+                    "token": self.token,
+                    "operation": op,
+                    "rid": str(doc.rid),
+                    "record": doc.to_dict(),
+                }
+            )
+        except Exception:  # subscriber errors must not break commits
+            log.exception("live subscriber %s failed", self.token)
+
+    def unsubscribe(self) -> None:
+        with self._lock:
+            if self._active:
+                self._active = False
+                self.db.hooks.unregister(self.token)
+                reg = getattr(self.db, "_live_registry", None)
+                if reg is not None:
+                    reg.monitors.pop(self.token, None)
+
+
+class LiveQueryRegistry:
+    def __init__(self) -> None:
+        self.monitors: Dict[int, LiveQueryMonitor] = {}
+
+    def add(self, m: LiveQueryMonitor) -> None:
+        self.monitors[m.token] = m
+
+    def get(self, token: int):
+        return self.monitors.get(token)
+
+    def remove(self, token: int) -> bool:
+        m = self.monitors.pop(token, None)
+        if m is None:
+            return False
+        m.unsubscribe()
+        return True
+
+
+def _registry(db) -> LiveQueryRegistry:
+    reg = getattr(db, "_live_registry", None)
+    if reg is None:
+        reg = db._live_registry = LiveQueryRegistry()
+    return reg
+
+
+def live_query(db, sql_or_stmt, callback: Callable) -> LiveQueryMonitor:
+    """Subscribe; returns the monitor (Python API entry)."""
+    if isinstance(sql_or_stmt, str):
+        from orientdb_tpu.exec.engine import parse_cached
+
+        stmt = parse_cached(sql_or_stmt)
+    else:
+        stmt = sql_or_stmt
+    if isinstance(stmt, A.LiveSelectStatement):
+        stmt = stmt.inner
+    if not isinstance(stmt, A.SelectStatement):
+        raise ValueError("live queries wrap a SELECT")
+    m = LiveQueryMonitor(db, stmt, callback)
+    _registry(db).add(m)
+    return m
+
+
+def live_monitor(db, token: int):
+    return _registry(db).get(token)
+
+
+def live_unsubscribe(db, token: int) -> bool:
+    return _registry(db).remove(token)
+
+
+def subscribe(db, stmt: A.LiveSelectStatement, params) -> List[Result]:
+    """SQL surface: events buffer on the monitor until consumed (pull style)
+    or a callback replaces the buffer."""
+    events: List[dict] = []
+    m = live_query(db, stmt, events.append)
+    m.events = events  # buffered for pull-style consumers
+    return [Result(props={"token": m.token, "operation": "live"})]

@@ -1,19 +1,337 @@
-"""Transaction statements (BEGIN/COMMIT/ROLLBACK).
+"""Optimistic MVCC transactions.
 
-Placeholder until the optimistic transaction manager lands (analog of [E]
-OTransactionOptimistic, SURVEY.md §3.4); the host store currently
-auto-commits every statement.
+Analog of [E] OTransactionOptimistic (SURVEY.md §3.4): changes buffer in a
+tx-local workspace; ``commit()`` takes the storage lock once, re-checks
+every touched record's version against the store (MVCC), then applies
+creates → edges → updates → deletes. A version mismatch raises
+``ConcurrentModificationError`` before any mutation (the reference's
+OConcurrentModificationException), and a mid-apply failure (e.g. a unique
+index violation) triggers compensating rollback of already-applied ops so
+the store never holds a half-committed transaction.
+
+Tx-local visibility: ``load``/``browse_class``/queries inside the tx see
+tx-created records, tx-updated field values, and hide tx-deleted records
+(read-your-writes). New records carry temporary RIDs ``#-1:-N`` (the
+reference's negative temp RIDs) remapped to real RIDs at commit.
+Divergence from the reference, documented: adjacency bags of *existing*
+vertices do not show uncommitted edges until commit.
 """
 
 from __future__ import annotations
 
-from typing import List
+import itertools
+from typing import Dict, List, Optional, Tuple
 
 from orientdb_tpu.exec.result import Result
+from orientdb_tpu.models.record import Document, Edge, Vertex
+from orientdb_tpu.models.rid import NEW_RID, RID
 from orientdb_tpu.sql import ast as A
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("tx")
+
+
+class TxError(Exception):
+    pass
+
+
+def _clone(doc: Document) -> Document:
+    """Tx-local copy: same identity/version, independent fields/bags."""
+    c = type(doc)(doc.class_name, dict(doc.fields()))
+    c.rid = doc.rid
+    c.version = doc.version
+    c._db = doc._db
+    if isinstance(doc, Vertex) and isinstance(c, Vertex):
+        c._out_edges = {k: list(v) for k, v in doc._out_edges.items()}
+        c._in_edges = {k: list(v) for k, v in doc._in_edges.items()}
+    if isinstance(doc, Edge) and isinstance(c, Edge):
+        c.out_rid = doc.out_rid
+        c.in_rid = doc.in_rid
+    return c
+
+
+class Transaction:
+    """One optimistic transaction bound to a Database session."""
+
+    def __init__(self, db) -> None:
+        self.db = db
+        self._temp_seq = itertools.count(2)
+        #: rid → tx-local doc (updates and tx-loaded copies)
+        self.workspace: Dict[RID, Document] = {}
+        #: rids written through the tx → base version for the MVCC check
+        self.dirty: Dict[RID, int] = {}
+        #: pre-images for store-shared objects mutated in place
+        self._preimages: Dict[RID, Tuple[Dict, int]] = {}
+        self.created: List[Document] = []  # temp-RID docs in creation order
+        self.deleted: Dict[RID, Document] = {}
+        #: (edge_doc, src_rid, dst_rid) — rids may be temporary
+        self.edge_ops: List[Tuple[Edge, RID, RID]] = []
+        self.active = True
+
+    # -- tx-local operations ------------------------------------------------
+
+    def _temp_rid(self) -> RID:
+        return RID(-1, -next(self._temp_seq))
+
+    def save(self, doc: Document) -> Document:
+        if doc.rid in self.deleted:
+            raise TxError(f"{doc.rid} deleted in this transaction")
+        if not doc.rid.is_persistent:
+            if doc.rid not in self.workspace:
+                cls = self.db.schema.get_class(doc.class_name)
+                if cls is None:
+                    cls = self.db.schema.create_class(doc.class_name)
+                cls.validate(doc.fields())
+                doc.rid = self._temp_rid()
+                doc.version = 0
+                doc._db = self.db
+                self.created.append(doc)
+                self.workspace[doc.rid] = doc
+            # already temp-registered: fields live on the doc itself
+            return doc
+        if doc.rid not in self.dirty:
+            stored = self.db._load_raw(doc.rid)
+            if stored is None:
+                raise TxError(f"{doc.rid} not found")
+            # base = the version THIS tx read (clone keeps it from load
+            # time); using the store's current version here would silently
+            # swallow concurrent commits between tx.load and tx.save
+            self.dirty[doc.rid] = doc.version
+            if stored is doc and doc.rid not in self._preimages:
+                # mutating the shared store object in place: capture the
+                # pre-image so rollback can restore it (touch() may already
+                # have captured it BEFORE the first field mutation)
+                self._preimages[doc.rid] = (dict(stored.fields()), stored.version)
+        self.workspace[doc.rid] = doc
+        return doc
+
+    def touch(self, doc: Document) -> None:
+        """Capture a pre-image for a shared store object about to be
+        mutated in place (called from Document.set before the write)."""
+        rid = doc.rid
+        if rid in self._preimages or rid in self.deleted:
+            return
+        stored = self.db._load_raw(rid)
+        if stored is doc:
+            self._preimages[rid] = (dict(stored.fields()), stored.version)
+
+    def load(self, rid: RID) -> Optional[Document]:
+        if rid in self.deleted:
+            return None
+        hit = self.workspace.get(rid)
+        if hit is not None:
+            return hit
+        stored = self.db._load_raw(rid)
+        if stored is None:
+            return None
+        copy = _clone(stored)
+        self.workspace[rid] = copy
+        return copy
+
+    def delete(self, doc: Document) -> None:
+        rid = doc.rid
+        if not rid.is_persistent:
+            # deleting an uncommitted record: drop it from the tx, and (for
+            # a vertex) cascade-drop uncommitted edges touching it — the
+            # tx-buffered mirror of the store's cascade delete
+            self.created = [d for d in self.created if d.rid != rid]
+            self.edge_ops = [
+                op
+                for op in self.edge_ops
+                if op[0].rid != rid and op[1] != rid and op[2] != rid
+            ]
+            self.workspace.pop(rid, None)
+            return
+        stored = self.db._load_raw(rid)
+        if stored is None:
+            return
+        self.dirty.setdefault(rid, stored.version)
+        self.deleted[rid] = stored
+        self.workspace.pop(rid, None)
+
+    def new_edge(self, class_name: str, src: Vertex, dst: Vertex, **fields) -> Edge:
+        cls = self.db.schema.get_class(class_name)
+        if cls is None:
+            cls = self.db.schema.create_edge_class(class_name)
+        if not cls.is_edge_type:
+            raise ValueError(f"class '{class_name}' is not an edge class")
+        e = Edge(cls.name, fields)
+        e._db = self.db
+        e.rid = self._temp_rid()
+        e.out_rid = src.rid
+        e.in_rid = dst.rid
+        self.workspace[e.rid] = e
+        self.edge_ops.append((e, src.rid, dst.rid))
+        return e
+
+    # -- visibility ----------------------------------------------------------
+
+    def browse_extra(self, class_name: str, polymorphic: bool):
+        """Tx-created docs visible to scans (read-your-writes)."""
+        def _member(doc):
+            cls = self.db.schema.get_class(doc.class_name)
+            if cls is None:
+                return False
+            if cls.name.lower() == class_name.lower():
+                return True
+            return polymorphic and cls.is_subclass_of(class_name)
+
+        for doc in self.created:
+            if _member(doc):
+                yield doc
+        for e, _s, _d in self.edge_ops:
+            if _member(e):
+                yield e
+
+    def overlay(self, doc: Document) -> Optional[Document]:
+        """Committed doc → tx view (updated copy, or None if tx-deleted)."""
+        if doc.rid in self.deleted:
+            return None
+        return self.workspace.get(doc.rid, doc)
+
+    # -- terminal operations -------------------------------------------------
+
+    def commit(self) -> Dict[RID, RID]:
+        """Apply the tx atomically; returns the temp→real RID map."""
+        if not self.active:
+            raise TxError("transaction no longer active")
+        db = self.db
+        try:
+            with db._lock:
+                return self._commit_locked(db)
+        except Exception:
+            # a failed commit invalidates the tx (the reference rolls the
+            # whole transaction back on OConcurrentModificationException /
+            # ORecordDuplicatedException)
+            self.rollback()
+            raise
+
+    def _commit_locked(self, db) -> Dict[RID, RID]:
+            # phase 1: MVCC checks before any mutation (atomic fail-fast)
+            for rid, base in self.dirty.items():
+                stored = db._load_raw(rid)
+                if rid in self.deleted:
+                    if stored is not None and stored.version != base:
+                        self._fail_conflict(rid, stored.version, base)
+                    continue
+                if stored is None:
+                    raise TxError(f"{rid} vanished before commit")
+                if stored.version != base:
+                    self._fail_conflict(rid, stored.version, base)
+            # phase 2: apply, with compensating rollback on failure
+            applied: List[Tuple[str, object]] = []
+            rid_map: Dict[RID, RID] = {}
+            db._tx_suspended = True
+            try:
+                for doc in self.created:
+                    temp = doc.rid
+                    doc.rid = NEW_RID
+                    db.save(doc)
+                    rid_map[temp] = doc.rid
+                    applied.append(("create", doc))
+                for e, src_rid, dst_rid in self.edge_ops:
+                    sr = rid_map.get(src_rid, src_rid)
+                    dr = rid_map.get(dst_rid, dst_rid)
+                    src = db._load_raw(sr)
+                    dst = db._load_raw(dr)
+                    if not isinstance(src, Vertex) or not isinstance(dst, Vertex):
+                        raise TxError("edge endpoint is not a vertex")
+                    real = db.new_edge(e.class_name, src, dst, **e.fields())
+                    rid_map[e.rid] = real.rid
+                    applied.append(("edge", real))
+                for rid in list(self.dirty):
+                    if rid in self.deleted:
+                        continue
+                    doc = self.workspace.get(rid)
+                    stored = db._load_raw(rid)
+                    if doc is None or stored is None or stored is doc:
+                        if doc is not None and stored is doc:
+                            # in-place mutation of the shared object: commit
+                            # it through save for indexes/hooks/epoch
+                            pre = (dict(self._preimages[rid][0]), self._preimages[rid][1])
+                            db.save(doc)
+                            applied.append(("update_pre", (rid, pre)))
+                        continue
+                    pre_clone = _clone(stored)
+                    doc.version = stored.version  # save() re-checks MVCC
+                    db.save(doc)
+                    applied.append(("update", pre_clone))
+                for rid in list(self.deleted):
+                    live = db._load_raw(rid)
+                    if live is not None:
+                        db.delete(live)
+                        applied.append(("delete", live))
+            except Exception:
+                self._compensate(applied)
+                raise
+            finally:
+                db._tx_suspended = False
+            self.active = False
+            db._end_tx(self)
+            return rid_map
+
+    def _fail_conflict(self, rid, stored_v, base_v):
+        from orientdb_tpu.models.database import ConcurrentModificationError
+
+        raise ConcurrentModificationError(
+            f"{rid}: stored v{stored_v} != tx base v{base_v}"
+        )
+
+    def _compensate(self, applied) -> None:
+        """Undo already-applied ops after a mid-commit failure."""
+        db = self.db
+        for kind, payload in reversed(applied):
+            try:
+                if kind in ("create", "edge"):
+                    db.delete(payload)
+                elif kind == "update":
+                    pre: Document = payload
+                    db._cluster(pre.rid.cluster).records[pre.rid.position] = pre
+                elif kind == "update_pre":
+                    rid, (fields, version) = payload
+                    live = db._load_raw(rid)
+                    if live is not None:
+                        live._fields = dict(fields)
+                        live.version = version
+                elif kind == "delete":
+                    doc: Document = payload
+                    db._cluster(doc.rid.cluster).records[doc.rid.position] = doc
+                    doc._deleted = False
+            except Exception:  # pragma: no cover - best effort
+                log.exception("compensation failed for %s", kind)
+
+    def rollback(self) -> None:
+        if not self.active:
+            return
+        for rid, (fields, version) in self._preimages.items():
+            live = self.db._load_raw(rid)
+            if live is not None:
+                live._fields = dict(fields)
+                live.version = version
+        self.active = False
+        self.db._end_tx(self)
+
+
+# ---------------------------------------------------------------------------
+# SQL surface (BEGIN / COMMIT / ROLLBACK)
+# ---------------------------------------------------------------------------
 
 
 def execute_tx_statement(db, stmt) -> List[Result]:
-    raise NotImplementedError(
-        "explicit transactions are not implemented yet; statements auto-commit"
-    )
+    if isinstance(stmt, A.BeginStatement):
+        db.begin()
+        return [Result(props={"operation": "begin"})]
+    if isinstance(stmt, A.CommitStatement):
+        rid_map = db.commit()
+        return [
+            Result(
+                props={
+                    "operation": "commit",
+                    "created": {str(k): str(v) for k, v in rid_map.items()},
+                }
+            )
+        ]
+    if isinstance(stmt, A.RollbackStatement):
+        db.rollback()
+        return [Result(props={"operation": "rollback"})]
+    raise TxError(f"not a tx statement: {type(stmt).__name__}")

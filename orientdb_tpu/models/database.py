@@ -96,6 +96,13 @@ class Database:
         self._snapshot_epoch = -1
         # Index manager is attached lazily by orientdb_tpu.models.indexes.
         self._indexes = None
+        # Hook manager ([E] ORecordHook registry) attached lazily.
+        self._hooks = None
+        # Optimistic transactions ([E] OTransactionOptimistic): one active
+        # tx per thread; the per-thread suspended flag routes writes
+        # directly to the store while THAT thread's commit is applying its
+        # buffered ops (other threads' transactions stay routed).
+        self._tx_local = threading.local()
         # Round-robin cluster selection per class ([E] cluster selection
         # strategies, SURVEY.md §2 "Clusters & RIDs").
         self._rr_state: Dict[str, int] = {}
@@ -151,6 +158,9 @@ class Database:
             cls = self.schema.create_edge_class(class_name)
         if not cls.is_edge_type:
             raise ValueError(f"class '{class_name}' is not an edge class")
+        tx = self.tx
+        if tx is not None and not self._tx_suspended:
+            return tx.new_edge(cls.name, src, dst, **fields)
         if not (src.rid.is_persistent and dst.rid.is_persistent):
             raise ValueError("both endpoints must be saved before creating an edge")
         with self._lock:
@@ -166,6 +176,9 @@ class Database:
         return e
 
     def save(self, doc: Document) -> Document:
+        tx = self.tx
+        if tx is not None and not self._tx_suspended:
+            return tx.save(doc)
         with self._lock:
             cls = self.schema.get_class(doc.class_name)
             if cls is None:
@@ -178,6 +191,10 @@ class Database:
                 # ORecordDuplicatedException).
                 self._indexes.validate_save(doc)
             is_new = doc.rid is NEW_RID or not doc.rid.is_persistent
+            if self._hooks is not None:
+                self._hooks.fire(
+                    "before_create" if is_new else "before_update", doc
+                )
             if is_new:
                 cid = self._select_cluster(doc.class_name)
                 pos = self._cluster(cid).append(doc)
@@ -207,6 +224,8 @@ class Database:
                         doc.version = 0
                     raise
             self.mutation_epoch += 1
+            if self._hooks is not None:
+                self._hooks.fire("after_create" if is_new else "after_update", doc)
         return doc
 
     def _load_raw(self, rid: RID) -> Optional[Document]:
@@ -216,6 +235,9 @@ class Database:
     def load(self, rid: RID) -> Optional[Document]:
         if isinstance(rid, str):
             rid = RID.parse(rid)
+        tx = self.tx
+        if tx is not None and not self._tx_suspended:
+            return tx.load(rid)
         return self._load_raw(rid)
 
     def exists(self, rid: RID) -> bool:
@@ -225,10 +247,18 @@ class Database:
         """Delete a record; vertices cascade-delete their incident edges,
         edges detach from both endpoint bags (OrientDB DELETE VERTEX/EDGE
         semantics)."""
+        tx = self.tx
+        if tx is not None and not self._tx_suspended:
+            tx.delete(doc)
+            return
         with self._lock:
+            if self._hooks is not None:
+                self._hooks.fire("before_delete", doc)
             if isinstance(doc, Vertex):
                 for edge in list(doc.edges(Direction.BOTH)):
-                    self._delete_edge(edge)
+                    # cascaded edges go through the full hook pipeline too
+                    # (the reference fires ORecordHook per deleted record)
+                    self._delete_edge(edge, fire_hooks=True)
             elif isinstance(doc, Edge):
                 self._delete_edge(doc)
             if doc.rid.is_persistent:
@@ -237,8 +267,12 @@ class Database:
                 self._cluster(doc.rid.cluster).tombstone(doc.rid.position)
             doc._deleted = True
             self.mutation_epoch += 1
+            if self._hooks is not None:
+                self._hooks.fire("after_delete", doc)
 
-    def _delete_edge(self, edge: Edge) -> None:
+    def _delete_edge(self, edge: Edge, fire_hooks: bool = False) -> None:
+        if fire_hooks and self._hooks is not None:
+            self._hooks.fire("before_delete", edge)
         src = self.load(edge.out_rid)
         dst = self.load(edge.in_rid)
         if isinstance(src, Vertex):
@@ -255,6 +289,10 @@ class Database:
             if self._indexes is not None:
                 self._indexes.on_delete(edge)
             self._cluster(edge.rid.cluster).tombstone(edge.rid.position)
+        if fire_hooks:
+            edge._deleted = True
+            if self._hooks is not None:
+                self._hooks.fire("after_delete", edge)
 
     # -- scans -------------------------------------------------------------
 
@@ -268,11 +306,20 @@ class Database:
             if polymorphic
             else list(cls.cluster_ids)
         )
+        tx = self.tx if not self._tx_suspended else None
         for cid in cids:
             c = self._clusters.get(cid)
             if c is None:
                 continue
-            yield from c
+            if tx is None:
+                yield from c
+            else:
+                for doc in c:
+                    view = tx.overlay(doc)
+                    if view is not None:
+                        yield view
+        if tx is not None:
+            yield from tx.browse_extra(cls.name, polymorphic)
 
     def browse_cluster(self, cluster_id: int) -> Iterator[Document]:
         c = self._clusters.get(cluster_id)
@@ -303,6 +350,56 @@ class Database:
 
             self._indexes = IndexManager(self)
         return self._indexes
+
+    # -- hooks & transactions ----------------------------------------------
+
+    @property
+    def hooks(self):
+        """Record hook registry ([E] ORecordHook)."""
+        if self._hooks is None:
+            from orientdb_tpu.exec.hooks import HookManager
+
+            self._hooks = HookManager(self)
+        return self._hooks
+
+    @property
+    def tx(self):
+        """The thread's active transaction, if any."""
+        return getattr(self._tx_local, "tx", None)
+
+    @property
+    def _tx_suspended(self) -> bool:
+        return getattr(self._tx_local, "suspended", False)
+
+    @_tx_suspended.setter
+    def _tx_suspended(self, value: bool) -> None:
+        self._tx_local.suspended = value
+
+    def begin(self):
+        """Start an optimistic transaction ([E] ODatabaseSession.begin)."""
+        if self.tx is not None:
+            raise RuntimeError("transaction already active on this thread")
+        from orientdb_tpu.exec.tx import Transaction
+
+        t = Transaction(self)
+        self._tx_local.tx = t
+        return t
+
+    def commit(self):
+        t = self.tx
+        if t is None:
+            raise RuntimeError("no active transaction")
+        return t.commit()
+
+    def rollback(self) -> None:
+        t = self.tx
+        if t is None:
+            raise RuntimeError("no active transaction")
+        t.rollback()
+
+    def _end_tx(self, t) -> None:
+        if self.tx is t:
+            self._tx_local.tx = None
 
     # -- query layer -------------------------------------------------------
 

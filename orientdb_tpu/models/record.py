@@ -65,15 +65,29 @@ class Document:
             return self.version
         return self._fields.get(name, default)
 
+    def _tx_touch(self) -> None:
+        """Let an active transaction capture this record's pre-image BEFORE
+        an in-place mutation, so rollback can restore it (tx-local copies
+        returned by tx.load don't need this — only shared store objects)."""
+        db = self._db
+        if db is None or not self.rid.is_persistent:
+            return
+        tx = db.tx
+        if tx is not None and tx.active and not db._tx_suspended:
+            tx.touch(self)
+
     def set(self, name: str, value) -> "Document":
+        self._tx_touch()
         self._fields[name] = value
         return self
 
     def update(self, **fields) -> "Document":
+        self._tx_touch()
         self._fields.update(fields)
         return self
 
     def remove_field(self, name: str) -> None:
+        self._tx_touch()
         self._fields.pop(name, None)
 
     def has(self, name: str) -> bool:

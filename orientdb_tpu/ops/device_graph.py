@@ -121,6 +121,11 @@ class DeviceGraph:
         self.edges: Dict[str, DeviceEdgeClass] = {
             n: DeviceEdgeClass(c, self) for n, c in snap.edge_classes.items()
         }
+        # class-id sets stay OUTSIDE `arrays`: they are lazily created per
+        # query, and growing the jit-arg pytree would change its structure
+        # and silently retrace every cached plan. They are tiny (a few
+        # int32s), so being baked into plan executables as constants is fine.
+        self._class_ids: Dict[str, jnp.ndarray] = {}
 
     def _put(self, key: str, arr) -> str:
         self.arrays[key] = jnp.asarray(arr)
@@ -131,10 +136,13 @@ class DeviceGraph:
         return self.arrays["v_class"]
 
     def class_ids(self, class_name: str) -> jnp.ndarray:
-        key = f"classids:{class_name.lower()}"
-        if key not in self.arrays:
-            self._put(key, self.snap.vertex_class_ids(class_name))
-        return self.arrays[key]
+        key = class_name.lower()
+        ids = self._class_ids.get(key)
+        if ids is None:
+            ids = self._class_ids[key] = jnp.asarray(
+                self.snap.vertex_class_ids(class_name)
+            )
+        return ids
 
 
 def device_graph(snap: GraphSnapshot) -> DeviceGraph:

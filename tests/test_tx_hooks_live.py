@@ -1,0 +1,258 @@
+"""Optimistic transactions, record hooks, live queries.
+
+The MVCC cases mirror the reference's tx semantics ([E]
+OTransactionOptimistic: version check at commit, temp-RID remap, rollback;
+SURVEY.md §3.4); hook/live cases mirror [E] ORecordHook / OLiveQueryHookV2.
+"""
+
+import pytest
+
+from orientdb_tpu import Database, PropertyType
+from orientdb_tpu.exec.live import live_query, live_unsubscribe
+from orientdb_tpu.models.database import ConcurrentModificationError
+
+
+@pytest.fixture
+def pdb():
+    db = Database("txdb")
+    cls = db.schema.create_vertex_class("Person")
+    cls.create_property("name", PropertyType.STRING)
+    db.schema.create_edge_class("Knows")
+    return db
+
+
+class TestTransactions:
+    def test_commit_applies_and_remaps_temp_rids(self, pdb):
+        tx = pdb.begin()
+        a = pdb.new_vertex("Person", name="a")
+        b = pdb.new_vertex("Person", name="b")
+        assert not a.rid.is_persistent  # temp RID #-1:-N
+        e = pdb.new_edge("Knows", a, b)
+        rid_map = pdb.commit()
+        assert a.rid.is_persistent and b.rid.is_persistent
+        assert len(rid_map) == 3
+        assert pdb.count_class("Person") == 2
+        # edge wired into bags only at commit
+        stored_a = pdb.load(a.rid)
+        assert [v["name"] for v in stored_a.vertices()] == ["b"]
+        assert tx.active is False
+
+    def test_rollback_discards_creates(self, pdb):
+        pdb.begin()
+        pdb.new_vertex("Person", name="ghost")
+        assert pdb.count_class("Person") == 1  # read-your-writes
+        pdb.rollback()
+        assert pdb.count_class("Person") == 0
+
+    def test_rollback_restores_inplace_update(self, pdb):
+        v = pdb.new_vertex("Person", name="before")
+        pdb.begin()
+        v.set("name", "after")
+        pdb.save(v)
+        pdb.rollback()
+        assert pdb.load(v.rid)["name"] == "before"
+
+    def test_tx_update_of_loaded_copy_isolated_until_commit(self, pdb):
+        v = pdb.new_vertex("Person", name="x")
+        pdb.begin()
+        copy = pdb.load(v.rid)
+        copy.set("name", "y")
+        pdb.save(copy)
+        assert v["name"] == "x"  # store untouched pre-commit
+        pdb.commit()
+        assert pdb.load(v.rid)["name"] == "y"
+
+    def test_mvcc_conflict_detected_at_commit(self, pdb):
+        v = pdb.new_vertex("Person", name="x")
+        pdb.begin()
+        copy = pdb.load(v.rid)
+        copy.set("name", "tx-side")
+        pdb.save(copy)
+        # concurrent writer (simulated: suspend tx routing)
+        pdb._tx_suspended = True
+        v.set("name", "raced")
+        pdb.save(v)
+        pdb._tx_suspended = False
+        with pytest.raises(ConcurrentModificationError):
+            pdb.commit()
+        assert pdb.tx is None or not pdb.tx.active
+
+    def test_tx_delete_hidden_then_applied(self, pdb):
+        v = pdb.new_vertex("Person", name="gone")
+        pdb.begin()
+        pdb.delete(v)
+        assert pdb.count_class("Person") == 0
+        assert pdb.load(v.rid) is None
+        pdb.commit()
+        assert pdb.count_class("Person") == 0
+
+    def test_sql_begin_commit(self, pdb):
+        pdb.command("BEGIN")
+        pdb.command("INSERT INTO Person SET name = 'sqltx'")
+        assert pdb.count_class("Person") == 1
+        rows = pdb.command("COMMIT").to_dicts()
+        assert rows[0]["operation"] == "commit"
+        assert pdb.tx is None
+        assert pdb.count_class("Person") == 1
+
+    def test_sql_rollback(self, pdb):
+        pdb.command("BEGIN")
+        pdb.command("INSERT INTO Person SET name = 'never'")
+        pdb.command("ROLLBACK")
+        assert pdb.count_class("Person") == 0
+
+    def test_unique_index_violation_rolls_back_whole_tx(self, pdb):
+        pdb.command("CREATE INDEX Person.name ON Person (name) UNIQUE")
+        pdb.new_vertex("Person", name="dup")
+        pdb.begin()
+        pdb.new_vertex("Person", name="ok")
+        pdb.new_vertex("Person", name="dup")  # will fail at commit
+        with pytest.raises(Exception):
+            pdb.commit()
+        # compensating rollback removed 'ok' too
+        names = sorted(d["name"] for d in pdb.browse_class("Person"))
+        assert names == ["dup"]
+
+    def test_queries_see_tx_changes(self, pdb):
+        pdb.new_vertex("Person", name="committed")
+        pdb.begin()
+        pdb.new_vertex("Person", name="pending")
+        rows = pdb.query("SELECT name FROM Person ORDER BY name").to_dicts()
+        assert [r["name"] for r in rows] == ["committed", "pending"]
+        pdb.rollback()
+
+
+class TestHooks:
+    def test_hook_events_fire(self, pdb):
+        seen = []
+        pdb.hooks.register(lambda ev, doc: seen.append((ev, doc.get("name"))))
+        v = pdb.new_vertex("Person", name="h")
+        v.set("name", "h2")
+        pdb.save(v)
+        pdb.delete(v)
+        evs = [e for e, _ in seen]
+        assert evs == [
+            "before_create",
+            "after_create",
+            "before_update",
+            "after_update",
+            "before_delete",
+            "after_delete",
+        ]
+
+    def test_before_hook_veto(self, pdb):
+        def veto(ev, doc):
+            if ev == "before_create" and doc.get("name") == "bad":
+                raise ValueError("vetoed")
+
+        pdb.hooks.register(veto, event="before_create", class_name="Person")
+        pdb.new_vertex("Person", name="good")
+        with pytest.raises(ValueError):
+            pdb.new_vertex("Person", name="bad")
+        assert pdb.count_class("Person") == 1
+
+    def test_class_filter(self, pdb):
+        seen = []
+        pdb.hooks.register(
+            lambda ev, doc: seen.append(ev), event="after_create", class_name="Person"
+        )
+        pdb.new_vertex("Person", name="p")
+        pdb.new_element("Other", x=1)
+        assert seen == ["after_create"]
+
+    def test_unregister(self, pdb):
+        seen = []
+        token = pdb.hooks.register(lambda ev, doc: seen.append(ev))
+        pdb.new_vertex("Person", name="a")
+        assert pdb.hooks.unregister(token)
+        pdb.new_vertex("Person", name="b")
+        assert len(seen) == 2  # before+after of first create only
+
+
+class TestLiveQueries:
+    def test_live_events(self, pdb):
+        events = []
+        mon = live_query(pdb, "LIVE SELECT FROM Person", events.append)
+        v = pdb.new_vertex("Person", name="L")
+        v.set("name", "L2")
+        pdb.save(v)
+        pdb.delete(v)
+        assert [e["operation"] for e in events] == ["CREATE", "UPDATE", "DELETE"]
+        mon.unsubscribe()
+        pdb.new_vertex("Person", name="after")
+        assert len(events) == 3
+
+    def test_live_where_filter(self, pdb):
+        events = []
+        live_query(
+            pdb, "LIVE SELECT FROM Person WHERE name = 'match'", events.append
+        )
+        pdb.new_vertex("Person", name="nope")
+        pdb.new_vertex("Person", name="match")
+        assert [e["record"]["name"] for e in events] == ["match"]
+
+    def test_sql_live_select_buffers(self, pdb):
+        rows = pdb.command("LIVE SELECT FROM Person").to_dicts()
+        token = rows[0]["token"]
+        pdb.new_vertex("Person", name="buffered")
+        from orientdb_tpu.exec.live import live_monitor
+
+        mon = live_monitor(pdb, token)
+        assert [e["operation"] for e in mon.events] == ["CREATE"]
+        assert live_unsubscribe(pdb, token)
+
+    def test_tx_commit_fires_live_events_once(self, pdb):
+        events = []
+        live_query(pdb, "LIVE SELECT FROM Person", events.append)
+        pdb.begin()
+        pdb.new_vertex("Person", name="txlive")
+        assert events == []  # nothing until commit
+        pdb.commit()
+        assert [e["operation"] for e in events] == ["CREATE"]
+
+
+class TestReviewRegressions:
+    def test_stale_clone_conflict_detected(self, pdb):
+        """Concurrent commit between tx.load and tx.save must conflict."""
+        v = pdb.new_vertex("Person", name="x")
+        pdb.begin()
+        copy = pdb.load(v.rid)  # clone at v1
+        # concurrent session bumps the store
+        pdb._tx_suspended = True
+        v.set("name", "raced")
+        pdb.save(v)
+        pdb._tx_suspended = False
+        copy.set("name", "stale-write")
+        pdb.save(copy)
+        with pytest.raises(ConcurrentModificationError):
+            pdb.commit()
+        assert pdb.load(v.rid)["name"] == "raced"
+
+    def test_delete_temp_vertex_cascades_buffered_edges(self, pdb):
+        pdb.begin()
+        a = pdb.new_vertex("Person", name="a")
+        b = pdb.new_vertex("Person", name="b")
+        pdb.new_edge("Knows", a, b)
+        pdb.delete(a)
+        pdb.commit()  # must not raise on dangling endpoint
+        assert pdb.count_class("Person") == 1
+        assert pdb.count_class("Knows") == 0
+
+    def test_cascade_edge_delete_fires_hooks(self, pdb):
+        events = []
+        from orientdb_tpu.exec.live import live_query
+
+        live_query(pdb, "LIVE SELECT FROM Knows", events.append)
+        a = pdb.new_vertex("Person", name="a")
+        b = pdb.new_vertex("Person", name="b")
+        pdb.new_edge("Knows", a, b)
+        pdb.delete(a)  # cascades the edge
+        ops = [e["operation"] for e in events]
+        assert ops == ["CREATE", "DELETE"]
+
+    def test_unsubscribe_removes_from_registry(self, pdb):
+        from orientdb_tpu.exec.live import live_monitor, live_query
+
+        mon = live_query(pdb, "LIVE SELECT FROM Person", lambda e: None)
+        mon.unsubscribe()
+        assert live_monitor(pdb, mon.token) is None
