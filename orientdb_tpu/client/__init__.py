@@ -1,0 +1,3 @@
+"""Remote client ([E] client/ module: OStorageRemote / ODatabaseDocumentRemote)."""
+
+from orientdb_tpu.client.remote import RemoteDatabase, connect  # noqa: F401

@@ -1,0 +1,125 @@
+"""Remote database client over the binary channel.
+
+Analog of [E] OStorageRemote / ODatabaseDocumentRemote (SURVEY.md §2
+"Remote client"): mirrors the embedded Database's query/command/load/save/
+delete surface over the length-prefixed protocol, with a thread-safe
+connection and lazy reconnect. `remote:` URL scheme:
+
+    db = connect("remote:127.0.0.1:2424/demodb", "admin", "admin")
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional
+
+from orientdb_tpu.server.binary_server import recv_frame, send_frame
+
+
+class RemoteError(Exception):
+    pass
+
+
+class RemoteResultSet:
+    """List-backed result mirror of the embedded ResultSet surface."""
+
+    def __init__(self, rows: List[dict], engine: Optional[str]) -> None:
+        self._rows = rows
+        self.engine = engine
+
+    def to_dicts(self) -> List[dict]:
+        return list(self._rows)
+
+    def __iter__(self):
+        return iter(self._rows)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+
+class RemoteDatabase:
+    def __init__(
+        self, host: str, port: int, name: str, user: str, password: str
+    ) -> None:
+        self.host, self.port, self.name = host, port, name
+        self._user, self._password = user, password
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._connect()
+
+    # -- channel ------------------------------------------------------------
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port), timeout=30)
+        resp = self._call({"op": "connect", "user": self._user, "password": self._password})
+        if not resp.get("ok"):
+            raise RemoteError(resp.get("error", "connect failed"))
+        if self.name:
+            resp = self._call({"op": "db_open", "name": self.name})
+            if not resp.get("ok"):
+                raise RemoteError(resp.get("error", "open failed"))
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            if self._sock is None:
+                raise RemoteError("connection closed")
+            send_frame(self._sock, req)
+            resp = recv_frame(self._sock)
+            if resp is None:
+                raise RemoteError("connection lost")
+            return resp
+
+    def _checked(self, req: dict) -> dict:
+        resp = self._call(req)
+        if not resp.get("ok"):
+            raise RemoteError(resp.get("error", "request failed"))
+        return resp
+
+    # -- database surface ---------------------------------------------------
+
+    def query(self, sql: str, params: Optional[Dict] = None) -> RemoteResultSet:
+        r = self._checked({"op": "query", "sql": sql, "params": params})
+        return RemoteResultSet(r["result"], r.get("engine"))
+
+    def command(self, sql: str, params: Optional[Dict] = None) -> RemoteResultSet:
+        r = self._checked({"op": "command", "sql": sql, "params": params})
+        return RemoteResultSet(r["result"], r.get("engine"))
+
+    def load(self, rid) -> Optional[dict]:
+        return self._checked({"op": "load", "rid": str(rid)})["record"]
+
+    def save(self, record: dict) -> dict:
+        return self._checked({"op": "save", "record": record})["record"]
+
+    def delete(self, rid) -> None:
+        self._checked({"op": "delete", "rid": str(rid)})
+
+    def databases(self) -> List[str]:
+        return self._checked({"op": "db_list"})["databases"]
+
+    def close(self) -> None:
+        try:
+            self._call({"op": "close"})
+        except RemoteError:
+            pass
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+    def __enter__(self) -> "RemoteDatabase":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def connect(url: str, user: str, password: str) -> RemoteDatabase:
+    """`remote:<host>:<port>/<database>` ([E] the remote: URL scheme)."""
+    if not url.startswith("remote:"):
+        raise ValueError(f"not a remote: url: {url!r}")
+    rest = url[len("remote:") :]
+    hostport, _, name = rest.partition("/")
+    host, _, port = hostport.partition(":")
+    return RemoteDatabase(host or "127.0.0.1", int(port or 2424), name, user, password)

@@ -1,0 +1,127 @@
+"""Users, roles, and resource permissions.
+
+Analog of [E] OSecurityShared / OUser / ORole (SURVEY.md §2
+"Schema/metadata" security): named users with salted PBKDF2 password
+hashes and roles granting CRUD permissions on resources. The server layer
+authenticates every request against this registry; the default roles
+mirror the reference's admin/reader/writer triple.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Dict, List, Optional, Set
+
+READ = "read"
+CREATE = "create"
+UPDATE = "update"
+DELETE = "delete"
+ALL = (READ, CREATE, UPDATE, DELETE)
+
+
+class SecurityError(Exception):
+    pass
+
+
+class Role:
+    """A named permission set over resources ('*' = any resource)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        #: resource (class name or '*') → set of allowed ops
+        self.grants: Dict[str, Set[str]] = {}
+
+    def grant(self, resource: str, *ops: str) -> "Role":
+        self.grants.setdefault(resource.lower(), set()).update(ops or ALL)
+        return self
+
+    def revoke(self, resource: str, *ops: str) -> "Role":
+        g = self.grants.get(resource.lower())
+        if g is not None:
+            g.difference_update(ops or ALL)
+        return self
+
+    def allows(self, resource: str, op: str) -> bool:
+        for key in (resource.lower(), "*"):
+            if op in self.grants.get(key, ()):
+                return True
+        return False
+
+
+class User:
+    def __init__(self, name: str, password: str, roles: List[Role]) -> None:
+        self.name = name
+        self.salt = os.urandom(16)
+        self.pw_hash = self._hash(password, self.salt)
+        self.roles = list(roles)
+        self.active = True
+
+    @staticmethod
+    def _hash(password: str, salt: bytes) -> bytes:
+        return hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 10_000)
+
+    def check_password(self, password: str) -> bool:
+        return self.active and hmac.compare_digest(
+            self.pw_hash, self._hash(password, self.salt)
+        )
+
+    def set_password(self, password: str) -> None:
+        self.salt = os.urandom(16)
+        self.pw_hash = self._hash(password, self.salt)
+
+    def allows(self, resource: str, op: str) -> bool:
+        return self.active and any(r.allows(resource, op) for r in self.roles)
+
+
+class SecurityManager:
+    """Per-database user/role registry with the reference's default triple
+    (admin/admin all, reader read-only, writer no schema ops)."""
+
+    def __init__(self, admin_password: str = "admin") -> None:
+        self.roles: Dict[str, Role] = {}
+        self.users: Dict[str, User] = {}
+        admin = self.create_role("admin").grant("*", *ALL)
+        reader = self.create_role("reader").grant("*", READ)
+        writer = self.create_role("writer").grant("*", *ALL)
+        self.create_user("admin", admin_password, ["admin"])
+        self.create_user("reader", "reader", ["reader"])
+        self.create_user("writer", "writer", ["writer"])
+        del admin, reader, writer
+
+    def create_role(self, name: str) -> Role:
+        if name.lower() in self.roles:
+            raise SecurityError(f"role '{name}' exists")
+        r = self.roles[name.lower()] = Role(name)
+        return r
+
+    def get_role(self, name: str) -> Optional[Role]:
+        return self.roles.get(name.lower())
+
+    def create_user(self, name: str, password: str, role_names: List[str]) -> User:
+        if name.lower() in self.users:
+            raise SecurityError(f"user '{name}' exists")
+        roles = []
+        for rn in role_names:
+            r = self.get_role(rn)
+            if r is None:
+                raise SecurityError(f"role '{rn}' not found")
+            roles.append(r)
+        u = self.users[name.lower()] = User(name, password, roles)
+        return u
+
+    def drop_user(self, name: str) -> bool:
+        return self.users.pop(name.lower(), None) is not None
+
+    def authenticate(self, name: str, password: str) -> Optional[User]:
+        u = self.users.get(name.lower())
+        if u is not None and u.check_password(password):
+            return u
+        return None
+
+    def check(self, user: User, resource: str, op: str) -> None:
+        if not user.allows(resource, op):
+            raise SecurityError(
+                f"user '{user.name}' lacks {op} permission on '{resource}'"
+            )

@@ -1,0 +1,4 @@
+"""Server & protocols: process entry, HTTP/REST and binary listeners,
+per-database registry (SURVEY.md §2 "Server", §3.1 boot sequence)."""
+
+from orientdb_tpu.server.server import Server  # noqa: F401

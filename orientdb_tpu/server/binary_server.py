@@ -1,0 +1,192 @@
+"""Binary protocol listener.
+
+Analog of [E] ONetworkProtocolBinary / OChannelBinaryServer (port 2424,
+SURVEY.md §2 "Binary protocol"): a persistent, session-oriented channel —
+each frame is a 4-byte big-endian length followed by a MessagePack-ish
+compact JSON payload (JSON chosen over a bespoke binary record format: the
+wire cost is dominated by the result rows, and the reference's
+ORecordSerializerNetwork role — one canonical wire encoding — is played by
+`to_dicts` rows).
+
+Requests: {"op": ..., ...}. Ops: connect, db_list, db_create, db_open,
+query, command, load, save, delete, close. All ops after `connect` run
+under the authenticated user's permissions.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Optional
+
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.security import SecurityError
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("binary")
+
+
+def send_frame(sock: socket.socket, payload: dict) -> None:
+    data = json.dumps(payload, default=str).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack(">I", head)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return json.loads(body.decode())
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _Session:
+    def __init__(self, server, sock: socket.socket) -> None:
+        self.server = server
+        self.sock = sock
+        self.user = None
+        self.db = None
+
+    def run(self) -> None:
+        try:
+            while True:
+                req = recv_frame(self.sock)
+                if req is None:
+                    break
+                resp = self._dispatch(req)
+                send_frame(self.sock, resp)
+                if req.get("op") == "close":
+                    break
+        except OSError:
+            pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        try:
+            if op == "connect":
+                u = self.server.security.authenticate(
+                    req.get("user", ""), req.get("password", "")
+                )
+                if u is None:
+                    return {"ok": False, "error": "invalid credentials"}
+                self.user = u
+                return {"ok": True, "user": u.name}
+            if self.user is None:
+                return {"ok": False, "error": "not authenticated"}
+            if op == "db_list":
+                return {"ok": True, "databases": sorted(self.server.databases)}
+            if op == "db_create":
+                self.server.security.check(self.user, "*", "create")
+                self.server.create_database(req["name"])
+                self.db = self.server.get_database(req["name"])
+                return {"ok": True}
+            if op == "db_open":
+                db = self.server.get_database(req["name"])
+                if db is None:
+                    return {"ok": False, "error": f"no database '{req['name']}'"}
+                self.db = db
+                return {"ok": True}
+            if self.db is None and op != "close":
+                return {"ok": False, "error": "no database open"}
+            if op == "query":
+                self.server.security.check(self.user, "*", "read")
+                rs = self.db.query(req["sql"], req.get("params"))
+                return {"ok": True, "result": rs.to_dicts(), "engine": rs.engine}
+            if op == "command":
+                self.server.security.check(self.user, "*", "update")
+                rs = self.db.command(req["sql"], req.get("params"))
+                return {"ok": True, "result": rs.to_dicts(), "engine": rs.engine}
+            if op == "load":
+                self.server.security.check(self.user, "*", "read")
+                doc = self.db.load(RID.parse(req["rid"]))
+                if doc is None:
+                    return {"ok": True, "record": None}
+                return {"ok": True, "record": doc.to_dict()}
+            if op == "save":
+                self.server.security.check(self.user, "*", "update")
+                payload = dict(req.get("record") or {})
+                cls = payload.pop("@class", "O")
+                rid = payload.pop("@rid", None)
+                payload = {k: v for k, v in payload.items() if not k.startswith("@")}
+                if rid:
+                    doc = self.db.load(RID.parse(rid))
+                    if doc is None:
+                        return {"ok": False, "error": f"record {rid} not found"}
+                    for k, v in payload.items():
+                        doc.set(k, v)
+                    self.db.save(doc)
+                else:
+                    c = self.db.schema.get_class(cls)
+                    if c is not None and c.is_vertex_type:
+                        doc = self.db.new_vertex(cls, **payload)
+                    else:
+                        doc = self.db.new_element(cls, **payload)
+                return {"ok": True, "record": doc.to_dict()}
+            if op == "delete":
+                self.server.security.check(self.user, "*", "delete")
+                doc = self.db.load(RID.parse(req["rid"]))
+                if doc is not None:
+                    self.db.delete(doc)
+                return {"ok": True}
+            if op == "close":
+                return {"ok": True}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except SecurityError as e:
+            return {"ok": False, "error": str(e), "code": 403}
+        except Exception as e:  # protocol errors must not kill the session
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+class BinaryListener:
+    def __init__(self, ot_server, port: int = 0) -> None:
+        self.server = ot_server
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", port))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="binary-listener", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self.sock.accept()
+            except OSError:
+                break
+            # one thread per accepted socket, like the reference's listener
+            threading.Thread(
+                target=_Session(self.server, conn).run, daemon=True
+            ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,289 @@
+"""HTTP/REST listener.
+
+Analog of [E] ONetworkProtocolHttpDb (port 2480, SURVEY.md §2 "HTTP/REST"),
+with the reference's REST shapes:
+
+  GET    /listDatabases
+  POST   /database/<db>                    create database
+  GET    /database/<db>                    database info
+  GET    /query/<db>/sql/<urlencoded sql>[/<limit>]
+  POST   /command/<db>/sql                 body = sql text or {"command": ...}
+  GET    /document/<db>/<rid>
+  POST   /document/<db>                    body = JSON doc with @class
+  PUT    /document/<db>/<rid>              body = JSON fields
+  DELETE /document/<db>/<rid>
+  GET    /class/<db>/<name>                schema info
+
+All endpoints require HTTP Basic auth against the server's security
+manager; query/command check read/write permission on the target.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from orientdb_tpu.models.record import Document, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.security import SecurityError
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("http")
+
+
+def _doc_json(doc: Document) -> dict:
+    out = dict(doc.to_dict())
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "orientdb-tpu/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route through our logger
+        log.debug("http: " + fmt, *args)
+
+    def _send(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, msg: str) -> None:
+        self._send(code, {"errors": [{"code": code, "content": msg}]})
+
+    def _auth(self):
+        hdr = self.headers.get("Authorization", "")
+        if hdr.startswith("Basic "):
+            try:
+                user, _, pw = base64.b64decode(hdr[6:]).decode().partition(":")
+            except Exception:
+                user, pw = "", ""
+            u = self.server.ot_server.security.authenticate(user, pw)
+            if u is not None:
+                return u
+        self.send_response(401)
+        self.send_header("WWW-Authenticate", 'Basic realm="orientdb-tpu"')
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return None
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _route(self) -> Tuple[str, list]:
+        path = urllib.parse.urlparse(self.path).path
+        parts = [urllib.parse.unquote(p) for p in path.split("/") if p]
+        return (parts[0] if parts else ""), parts[1:]
+
+    def _db(self, name: str):
+        db = self.server.ot_server.get_database(name)
+        if db is None:
+            self._error(404, f"database '{name}' not found")
+        return db
+
+    # -- verbs --------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        user = self._auth()
+        if user is None:
+            return
+        head, rest = self._route()
+        try:
+            if head == "listDatabases":
+                return self._send(
+                    200, {"databases": sorted(self.server.ot_server.databases)}
+                )
+            if head == "database" and rest:
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                classes = [
+                    {
+                        "name": c.name,
+                        "records": db.count_class(c.name, polymorphic=False)
+                        if not c.abstract
+                        else 0,
+                    }
+                    for c in db.schema.classes()
+                ]
+                return self._send(200, {"server": {}, "classes": classes})
+            if head == "query" and len(rest) >= 3 and rest[1] == "sql":
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, "*", "read")
+                sql = rest[2]
+                limit = int(rest[3]) if len(rest) > 3 else None
+                rows = db.query(sql).to_dicts()
+                if limit is not None:
+                    rows = rows[:limit]
+                return self._send(200, {"result": rows})
+            if head == "document" and len(rest) == 2:
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, "*", "read")
+                doc = db.load(RID.parse(rest[1]))
+                if doc is None:
+                    return self._error(404, f"record {rest[1]} not found")
+                return self._send(200, _doc_json(doc))
+            if head == "class" and len(rest) == 2:
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                cls = db.schema.get_class(rest[1])
+                if cls is None:
+                    return self._error(404, f"class '{rest[1]}' not found")
+                return self._send(
+                    200,
+                    {
+                        "name": cls.name,
+                        "superClasses": [s.name for s in cls.superclasses],
+                        "abstract": cls.abstract,
+                        "properties": [
+                            {"name": p.name, "type": p.type.name}
+                            for p in cls.properties.values()
+                        ],
+                        "records": 0
+                        if cls.abstract
+                        else db.count_class(cls.name, polymorphic=False),
+                    },
+                )
+            return self._error(404, f"no route for GET /{head}")
+        except SecurityError as e:
+            return self._error(403, str(e))
+        except Exception as e:
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_POST(self):  # noqa: N802
+        user = self._auth()
+        if user is None:
+            return
+        head, rest = self._route()
+        try:
+            if head == "database" and rest:
+                self.server.ot_server.security.check(user, "*", "create")
+                db = self.server.ot_server.create_database(rest[0])
+                return self._send(200, {"created": db.name})
+            if head == "command" and len(rest) >= 2 and rest[1] == "sql":
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                body = self._body().decode()
+                try:
+                    sql = json.loads(body).get("command", body)
+                except (json.JSONDecodeError, AttributeError):
+                    sql = body
+                op = "read"
+                stripped = sql.lstrip().lower()
+                if not (
+                    stripped.startswith("select")
+                    or stripped.startswith("match")
+                    or stripped.startswith("traverse")
+                    or stripped.startswith("explain")
+                ):
+                    op = "update"
+                self.server.ot_server.security.check(user, "*", op)
+                rows = db.command(sql).to_dicts()
+                return self._send(200, {"result": rows})
+            if head == "document" and len(rest) == 1:
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, "*", "create")
+                payload = json.loads(self._body() or b"{}")
+                cls = payload.pop("@class", "O")
+                payload = {k: v for k, v in payload.items() if not k.startswith("@")}
+                c = db.schema.get_class(cls)
+                if c is not None and c.is_vertex_type:
+                    doc = db.new_vertex(cls, **payload)
+                else:
+                    doc = db.new_element(cls, **payload)
+                return self._send(201, _doc_json(doc))
+            return self._error(404, f"no route for POST /{head}")
+        except SecurityError as e:
+            return self._error(403, str(e))
+        except Exception as e:
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_PUT(self):  # noqa: N802
+        user = self._auth()
+        if user is None:
+            return
+        head, rest = self._route()
+        try:
+            if head == "document" and len(rest) == 2:
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, "*", "update")
+                doc = db.load(RID.parse(rest[1]))
+                if doc is None:
+                    return self._error(404, f"record {rest[1]} not found")
+                payload = json.loads(self._body() or b"{}")
+                for k, v in payload.items():
+                    if not k.startswith("@"):
+                        doc.set(k, v)
+                db.save(doc)
+                return self._send(200, _doc_json(doc))
+            return self._error(404, f"no route for PUT /{head}")
+        except SecurityError as e:
+            return self._error(403, str(e))
+        except Exception as e:
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_DELETE(self):  # noqa: N802
+        user = self._auth()
+        if user is None:
+            return
+        head, rest = self._route()
+        try:
+            if head == "document" and len(rest) == 2:
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, "*", "delete")
+                doc = db.load(RID.parse(rest[1]))
+                if doc is None:
+                    return self._error(404, f"record {rest[1]} not found")
+                db.delete(doc)
+                return self._send(204, {})
+            if head == "database" and rest:
+                self.server.ot_server.security.check(user, "*", "delete")
+                ok = self.server.ot_server.drop_database(rest[0])
+                return self._send(200 if ok else 404, {"dropped": ok})
+            return self._error(404, f"no route for DELETE /{head}")
+        except SecurityError as e:
+            return self._error(403, str(e))
+        except Exception as e:
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+
+class HttpListener:
+    """Threaded HTTP listener bound to an ephemeral port by default."""
+
+    def __init__(self, ot_server, port: int = 0) -> None:
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.httpd.ot_server = ot_server
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="http-listener", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

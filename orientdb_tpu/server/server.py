@@ -1,0 +1,133 @@
+"""The server process ([E] OServer / OServerMain, SURVEY.md §3.1).
+
+Hosts named databases, a security manager, a plugin registry (the
+OServerPluginAbstract seam the north star hooks into), and two listeners:
+HTTP/REST (`http_server`, the port-2480 analog) and the length-prefixed
+binary channel (`binary_server`, the port-2424 analog). Listeners bind
+ephemeral ports by default so in-process multi-server tests work exactly
+like the reference's multi-OServer-per-JVM distributed tests
+(SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.security import SecurityManager
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("server")
+
+
+class ServerPlugin:
+    """Lifecycle SPI ([E] OServerPluginAbstract): subclass and register."""
+
+    name = "plugin"
+
+    def config(self, server: "Server", params: Dict) -> None:  # noqa: D401
+        pass
+
+    def startup(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+
+class Server:
+    def __init__(
+        self,
+        name: str = "orientdb-tpu",
+        admin_password: str = "admin",
+        http_port: int = 0,
+        binary_port: int = 0,
+    ) -> None:
+        self.name = name
+        self.databases: Dict[str, Database] = {}
+        self.security = SecurityManager(admin_password)
+        self.plugins: List[ServerPlugin] = []
+        self._lock = threading.Lock()
+        self._http = None
+        self._binary = None
+        self._http_port = http_port
+        self._binary_port = binary_port
+        self.running = False
+
+    # -- databases ----------------------------------------------------------
+
+    def create_database(self, name: str) -> Database:
+        with self._lock:
+            if name in self.databases:
+                raise ValueError(f"database '{name}' exists")
+            db = self.databases[name] = Database(name)
+            return db
+
+    def get_database(self, name: str) -> Optional[Database]:
+        return self.databases.get(name)
+
+    def drop_database(self, name: str) -> bool:
+        with self._lock:
+            return self.databases.pop(name, None) is not None
+
+    def attach_database(self, db: Database) -> Database:
+        with self._lock:
+            self.databases[db.name] = db
+            return db
+
+    # -- plugins ------------------------------------------------------------
+
+    def register_plugin(self, plugin: ServerPlugin, params: Optional[Dict] = None):
+        plugin.config(self, params or {})
+        self.plugins.append(plugin)
+        if self.running:
+            plugin.startup()
+        return plugin
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def startup(self) -> "Server":
+        from orientdb_tpu.server.binary_server import BinaryListener
+        from orientdb_tpu.server.http_server import HttpListener
+
+        for p in self.plugins:
+            p.startup()
+        self._http = HttpListener(self, self._http_port)
+        self._http.start()
+        self._binary = BinaryListener(self, self._binary_port)
+        self._binary.start()
+        self.running = True
+        log.info(
+            "server '%s' up: http=%d binary=%d",
+            self.name,
+            self.http_port,
+            self.binary_port,
+        )
+        return self
+
+    def shutdown(self) -> None:
+        self.running = False
+        for p in self.plugins:
+            try:
+                p.shutdown()
+            except Exception:
+                log.exception("plugin %s shutdown failed", p.name)
+        if self._http is not None:
+            self._http.stop()
+        if self._binary is not None:
+            self._binary.stop()
+
+    @property
+    def http_port(self) -> int:
+        return self._http.port if self._http else self._http_port
+
+    @property
+    def binary_port(self) -> int:
+        return self._binary.port if self._binary else self._binary_port
+
+    def __enter__(self) -> "Server":
+        return self.startup()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,1 @@
+"""CLI tools ([E] tools/ module: console, export/import)."""

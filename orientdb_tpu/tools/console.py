@@ -1,0 +1,209 @@
+"""Interactive console.
+
+Analog of [E] OConsoleDatabaseApp (`console.sh`, SURVEY.md §2 "Console"):
+connect to an embedded (`embedded:<name>`) or remote
+(`remote:<host>:<port>/<db>`) database, run SQL, inspect schema, and
+export/import portable JSON dumps.
+
+Commands (case-insensitive; anything unrecognized is sent as SQL):
+  CONNECT <url> [user] [password]     CREATE DATABASE <name>
+  LIST DATABASES                      INFO
+  CLASSES                             BROWSE CLASS <name>
+  LOAD RECORD <rid>                   EXPORT DATABASE <path>
+  IMPORT DATABASE <path>              DISCONNECT / QUIT / EXIT
+"""
+
+from __future__ import annotations
+
+import cmd
+import shlex
+import sys
+from typing import Optional
+
+from orientdb_tpu.models.database import Database
+
+
+class Console(cmd.Cmd):
+    intro = "orientdb-tpu console — CONNECT embedded:<name> to begin; QUIT to exit."
+    prompt = "orientdb-tpu> "
+
+    def __init__(self, stdout=None) -> None:
+        super().__init__(stdout=stdout or sys.stdout)
+        self.db = None
+        self.remote = None
+        self._embedded: dict = {}
+
+    # -- helpers ------------------------------------------------------------
+
+    def parseline(self, line):
+        # commands are case-insensitive (CONNECT == connect); the raw line
+        # still reaches default() untouched so SQL keeps its case
+        c, arg, ln = super().parseline(line)
+        return (c.lower() if c else c), arg, ln
+
+    def _p(self, *lines) -> None:
+        for ln in lines:
+            print(ln, file=self.stdout)
+
+    def _need_db(self) -> bool:
+        if self.db is None and self.remote is None:
+            self._p("!! not connected; use CONNECT embedded:<name>")
+            return False
+        return True
+
+    def _run_sql(self, sql: str) -> None:
+        try:
+            target = self.remote if self.remote is not None else self.db
+            rows = target.command(sql).to_dicts()
+            for i, r in enumerate(rows):
+                self._p(f"# {i}: {r}")
+            self._p(f"({len(rows)} rows)")
+        except Exception as e:
+            self._p(f"!! {type(e).__name__}: {e}")
+
+    # -- commands ------------------------------------------------------------
+
+    def do_connect(self, arg: str) -> None:
+        """CONNECT embedded:<name> | remote:<host>:<port>/<db> [user] [pw]"""
+        parts = shlex.split(arg)
+        if not parts:
+            self._p("!! usage: CONNECT <url> [user] [password]")
+            return
+        url = parts[0]
+        user = parts[1] if len(parts) > 1 else "admin"
+        pw = parts[2] if len(parts) > 2 else "admin"
+        try:
+            if url.startswith("remote:"):
+                from orientdb_tpu.client.remote import connect
+
+                self.remote = connect(url, user, pw)
+                self.db = None
+                self._p(f"connected to {url}")
+            else:
+                name = url.split(":", 1)[1] if ":" in url else url
+                self.db = self._embedded.setdefault(name, Database(name))
+                self.remote = None
+                self._p(f"connected to embedded database '{name}'")
+        except Exception as e:
+            self._p(f"!! {type(e).__name__}: {e}")
+
+    def do_disconnect(self, _arg: str) -> None:
+        if self.remote is not None:
+            self.remote.close()
+        self.db = self.remote = None
+        self._p("disconnected")
+
+    def do_create(self, arg: str) -> None:
+        """CREATE DATABASE <name> (embedded); other CREATE ... goes to SQL."""
+        parts = shlex.split(arg)
+        if len(parts) == 2 and parts[0].lower() == "database":
+            name = parts[1]
+            self.db = self._embedded.setdefault(name, Database(name))
+            self.remote = None
+            self._p(f"database '{name}' created")
+            return
+        self.default(f"create {arg}")
+
+    def do_list(self, arg: str) -> None:
+        """LIST DATABASES"""
+        if arg.lower().strip() == "databases":
+            if self.remote is not None:
+                self._p(*self.remote.databases())
+            else:
+                self._p(*sorted(self._embedded))
+            return
+        self.default(f"list {arg}")
+
+    def do_info(self, _arg: str) -> None:
+        if not self._need_db():
+            return
+        if self.remote is not None:
+            self._p(f"remote database '{self.remote.name}'")
+            return
+        s = self.db.current_snapshot()
+        self._p(
+            f"database '{self.db.name}'",
+            f"classes: {len(list(self.db.schema.classes()))}",
+            f"mutation epoch: {self.db.mutation_epoch}",
+            f"snapshot: {'attached' if s is not None else 'none'}"
+            + (" (stale)" if self.db.snapshot_is_stale else ""),
+        )
+
+    def do_classes(self, _arg: str) -> None:
+        if not self._need_db() or self.db is None:
+            return
+        for c in sorted(self.db.schema.classes(), key=lambda c: c.name):
+            kind = "V" if c.is_vertex_type else "E" if c.is_edge_type else "O"
+            n = 0 if c.abstract else self.db.count_class(c.name, polymorphic=False)
+            self._p(f"{c.name:<24} {kind} abstract={c.abstract} records={n}")
+
+    def do_browse(self, arg: str) -> None:
+        """BROWSE CLASS <name>"""
+        parts = shlex.split(arg)
+        if len(parts) == 2 and parts[0].lower() == "class":
+            self._run_sql(f"SELECT FROM {parts[1]}")
+            return
+        self.default(f"browse {arg}")
+
+    def do_load(self, arg: str) -> None:
+        """LOAD RECORD <rid>"""
+        parts = shlex.split(arg)
+        if len(parts) == 2 and parts[0].lower() == "record":
+            if not self._need_db():
+                return
+            target = self.remote if self.remote is not None else self.db
+            doc = target.load(parts[1])
+            if doc is None:
+                self._p(f"!! record {parts[1]} not found")
+            else:
+                self._p(str(doc.to_dict() if hasattr(doc, "to_dict") else doc))
+            return
+        self.default(f"load {arg}")
+
+    def do_export(self, arg: str) -> None:
+        """EXPORT DATABASE <path>"""
+        parts = shlex.split(arg)
+        if len(parts) == 2 and parts[0].lower() == "database":
+            if not self._need_db() or self.db is None:
+                return
+            from orientdb_tpu.storage.ingest import export_database
+
+            export_database(self.db, parts[1])
+            self._p(f"exported to {parts[1]}")
+            return
+        self.default(f"export {arg}")
+
+    def do_import(self, arg: str) -> None:
+        """IMPORT DATABASE <path>"""
+        parts = shlex.split(arg)
+        if len(parts) == 2 and parts[0].lower() == "database":
+            from orientdb_tpu.storage.ingest import import_database
+
+            self.db = import_database(parts[1])
+            self._embedded[self.db.name] = self.db
+            self.remote = None
+            self._p(f"imported database '{self.db.name}'")
+            return
+        self.default(f"import {arg}")
+
+    def do_quit(self, _arg: str) -> bool:
+        return True
+
+    do_exit = do_quit
+    do_EOF = do_quit
+
+    def default(self, line: str) -> None:
+        if not self._need_db():
+            return
+        self._run_sql(line)
+
+    def emptyline(self) -> None:
+        pass
+
+
+def main() -> None:  # pragma: no cover - interactive entry
+    Console().cmdloop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,72 @@
+"""Console tool ([E] OConsoleDatabaseApp analog)."""
+
+import io
+
+from orientdb_tpu.tools.console import Console
+
+
+def run(console, *lines):
+    out = io.StringIO()
+    console.stdout = out
+    for ln in lines:
+        console.onecmd(ln)
+    return out.getvalue()
+
+
+def test_embedded_session(tmp_path):
+    c = Console(stdout=io.StringIO())
+    out = run(
+        c,
+        "CREATE DATABASE demo",
+        "CREATE CLASS Profiles EXTENDS V",
+        "INSERT INTO Profiles SET name = 'alice'",
+        "SELECT name FROM Profiles",
+    )
+    assert "alice" in out and "(1 rows)" in out
+
+
+def test_classes_and_info():
+    c = Console(stdout=io.StringIO())
+    out = run(c, "CREATE DATABASE d2", "CREATE CLASS Person EXTENDS V", "classes")
+    assert "Person" in out
+    out = run(c, "info")
+    assert "database 'd2'" in out
+
+
+def test_export_import_roundtrip(tmp_path):
+    c = Console(stdout=io.StringIO())
+    path = str(tmp_path / "dump.json")
+    run(
+        c,
+        "CREATE DATABASE src",
+        "CREATE CLASS Person EXTENDS V",
+        "INSERT INTO Person SET name = 'x'",
+        f"EXPORT DATABASE {path}",
+    )
+    out = run(c, f"IMPORT DATABASE {path}", "SELECT count(*) AS n FROM Person")
+    assert "'n': 1" in out
+
+
+def test_not_connected_error():
+    c = Console(stdout=io.StringIO())
+    out = run(c, "SELECT FROM V")
+    assert "not connected" in out
+
+
+def test_sql_error_reported():
+    c = Console(stdout=io.StringIO())
+    out = run(c, "CREATE DATABASE e1", "SELECT FROM NoSuchClass")
+    assert "!!" in out
+
+
+def test_load_record():
+    c = Console(stdout=io.StringIO())
+    out = run(
+        c,
+        "CREATE DATABASE d3",
+        "CREATE CLASS P EXTENDS V",
+        "INSERT INTO P SET name = 'r'",
+    )
+    rid = [tok for tok in out.split() if tok.startswith("'#")][0].strip("',")
+    out = run(c, f"LOAD RECORD {rid}")
+    assert "'r'" in out

@@ -1,0 +1,208 @@
+"""Server, protocols, remote client, security.
+
+The in-process ephemeral-port pattern mirrors the reference's
+multi-OServer-per-JVM tests ([E] AbstractServerClusterTest, SURVEY.md §4).
+"""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from orientdb_tpu.client.remote import RemoteError, connect
+from orientdb_tpu.models.security import SecurityError, SecurityManager
+from orientdb_tpu.server import Server
+from orientdb_tpu.storage.ingest import generate_demodb
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = Server(admin_password="pw")
+    db = srv.create_database("demo")
+    db.schema.create_vertex_class("Profiles").create_property(
+        "name", __import__("orientdb_tpu").PropertyType.STRING
+    )
+    db.schema.create_edge_class("HasFriend")
+    a = db.new_vertex("Profiles", name="alice")
+    b = db.new_vertex("Profiles", name="bob")
+    db.new_edge("HasFriend", a, b)
+    srv.startup()
+    yield srv
+    srv.shutdown()
+
+
+def http(server, method, path, body=None, user="admin", pw="pw"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.http_port}{path}", method=method
+    )
+    req.add_header(
+        "Authorization",
+        "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode(),
+    )
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, data=data) as resp:
+        raw = resp.read()
+        return resp.status, json.loads(raw) if raw else {}
+
+
+class TestHttp:
+    def test_list_databases(self, server):
+        status, body = http(server, "GET", "/listDatabases")
+        assert status == 200 and body["databases"] == ["demo"]
+
+    def test_query(self, server):
+        status, body = http(
+            server, "GET", "/query/demo/sql/SELECT%20name%20FROM%20Profiles%20ORDER%20BY%20name"
+        )
+        assert [r["name"] for r in body["result"]] == ["alice", "bob"]
+
+    def test_query_match(self, server):
+        sql = urllib.parse.quote(
+            "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN p.name AS p, f.name AS f"
+        )
+        _, body = http(server, "GET", f"/query/demo/sql/{sql}")
+        assert body["result"] == [{"p": "alice", "f": "bob"}]
+
+    def test_document_crud(self, server):
+        status, doc = http(
+            server, "POST", "/document/demo", {"@class": "Profiles", "name": "carol"}
+        )
+        assert status == 201
+        rid = doc["@rid"].replace("#", "%23")
+        _, got = http(server, "GET", f"/document/demo/{rid}")
+        assert got["name"] == "carol"
+        _, upd = http(server, "PUT", f"/document/demo/{rid}", {"name": "carol2"})
+        assert upd["name"] == "carol2"
+        status, _ = http(server, "DELETE", f"/document/demo/{rid}")
+        assert status == 204
+
+    def test_command(self, server):
+        _, body = http(
+            server,
+            "POST",
+            "/command/demo/sql",
+            {"command": "INSERT INTO Profiles SET name = 'dave'"},
+        )
+        assert body["result"][0]["name"] == "dave"
+
+    def test_auth_required(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http(server, "GET", "/listDatabases", user="admin", pw="wrong")
+        assert e.value.code == 401
+
+    def test_reader_cannot_write(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http(
+                server,
+                "POST",
+                "/command/demo/sql",
+                {"command": "INSERT INTO Profiles SET name='x'"},
+                user="reader",
+                pw="reader",
+            )
+        assert e.value.code == 403
+
+    def test_class_info(self, server):
+        _, body = http(server, "GET", "/class/demo/Profiles")
+        assert body["name"] == "Profiles"
+        assert "V" in body["superClasses"]
+
+    def test_404_database(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http(server, "GET", "/database/nope")
+        assert e.value.code == 404
+
+
+class TestBinaryRemote:
+    def test_query_roundtrip(self, server):
+        with connect(
+            f"remote:127.0.0.1:{server.binary_port}/demo", "admin", "pw"
+        ) as db:
+            rows = db.query("SELECT name FROM Profiles ORDER BY name").to_dicts()
+            assert "alice" in [r["name"] for r in rows]
+
+    def test_save_load_delete(self, server):
+        with connect(
+            f"remote:127.0.0.1:{server.binary_port}/demo", "admin", "pw"
+        ) as db:
+            rec = db.save({"@class": "Profiles", "name": "remote-created"})
+            rid = rec["@rid"]
+            got = db.load(rid)
+            assert got["name"] == "remote-created"
+            rec["name"] = "remote-updated"
+            upd = db.save(rec)
+            assert upd["name"] == "remote-updated"
+            db.delete(rid)
+            assert db.load(rid) is None
+
+    def test_bad_credentials(self, server):
+        with pytest.raises(RemoteError):
+            connect(f"remote:127.0.0.1:{server.binary_port}/demo", "admin", "no")
+
+    def test_reader_permission_enforced(self, server):
+        with connect(
+            f"remote:127.0.0.1:{server.binary_port}/demo", "reader", "reader"
+        ) as db:
+            with pytest.raises(RemoteError):
+                db.command("INSERT INTO Profiles SET name='x'")
+
+    def test_db_list(self, server):
+        with connect(
+            f"remote:127.0.0.1:{server.binary_port}/demo", "admin", "pw"
+        ) as db:
+            assert "demo" in db.databases()
+
+
+class TestSecurity:
+    def test_roles_and_grants(self):
+        sec = SecurityManager()
+        u = sec.authenticate("admin", "admin")
+        assert u is not None and u.allows("Profiles", "delete")
+        r = sec.authenticate("reader", "reader")
+        assert r.allows("x", "read") and not r.allows("x", "update")
+
+    def test_custom_role(self):
+        sec = SecurityManager()
+        sec.create_role("auditor").grant("AuditLog", "read", "create")
+        u = sec.create_user("aud", "secret", ["auditor"])
+        assert u.allows("AuditLog", "create")
+        assert not u.allows("Other", "read")
+        with pytest.raises(SecurityError):
+            sec.check(u, "Other", "read")
+
+    def test_password_change(self):
+        sec = SecurityManager()
+        u = sec.users["admin"]
+        u.set_password("new")
+        assert sec.authenticate("admin", "admin") is None
+        assert sec.authenticate("admin", "new") is u
+
+
+class TestPlugin:
+    def test_plugin_lifecycle(self):
+        from orientdb_tpu.server.server import ServerPlugin
+
+        calls = []
+
+        class P(ServerPlugin):
+            name = "p"
+
+            def config(self, server, params):
+                calls.append(("config", params))
+
+            def startup(self):
+                calls.append(("startup", None))
+
+            def shutdown(self):
+                calls.append(("shutdown", None))
+
+        srv = Server()
+        srv.register_plugin(P(), {"k": 1})
+        srv.startup()
+        srv.shutdown()
+        assert [c[0] for c in calls] == ["config", "startup", "shutdown"]
