@@ -35,17 +35,56 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from orientdb_tpu.storage.snapshot import GraphSnapshot
 
 
+def provision_devices(n_devices: int) -> list:
+    """Return >= n_devices JAX devices, self-provisioning virtual CPU
+    devices when the default backend (e.g. the single tunneled TPU chip)
+    has fewer.
+
+    `jax.config.update('jax_num_cpu_devices', n)` works even with a TPU
+    plugin active and after jax import, as long as the CPU backend has not
+    been initialized yet — unlike XLA_FLAGS/JAX_PLATFORMS env vars, which
+    the axon plugin ignores once its sitecustomize has imported jax.
+    """
+    # Must run BEFORE any backend is initialized (any jax.devices() call
+    # anywhere): once backends exist the update raises, and we can only
+    # fall through to whatever device count is already live.
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:
+        pass
+    devs = jax.devices()
+    if len(devs) >= n_devices:
+        return devs
+    cpus = jax.devices("cpu")
+    if len(cpus) >= n_devices:
+        return cpus
+    raise ValueError(
+        f"need {n_devices} devices, have {len(devs)} "
+        f"(and only {len(cpus)} CPU devices could be provisioned)"
+    )
+
+
 def make_mesh(
-    n_devices: Optional[int] = None, replicas: int = 1
+    n_devices: Optional[int] = None,
+    replicas: int = 1,
+    devices: Optional[list] = None,
 ) -> Mesh:
     """1-D or 2-D mesh: (replicas, shards). `n_devices` defaults to all."""
-    devs = jax.devices()
-    n = n_devices or len(devs)
-    if n > len(devs):
-        raise ValueError(
-            f"need {n} devices, have {len(devs)} "
-            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU)"
-        )
+    if devices is not None:
+        devs = devices
+        n = n_devices or len(devs)
+        if n > len(devs):
+            raise ValueError(
+                f"need {n} devices but explicit list has {len(devs)}"
+            )
+    elif n_devices is not None:
+        # provision BEFORE jax.devices(): initializing any backend blocks
+        # the jax_num_cpu_devices update provision_devices relies on
+        devs = provision_devices(n_devices)
+        n = n_devices
+    else:
+        devs = jax.devices()
+        n = len(devs)
     if n % replicas:
         raise ValueError(f"{n} devices not divisible into {replicas} replicas")
     arr = np.array(devs[:n]).reshape(replicas, n // replicas)
