@@ -98,6 +98,11 @@ class RemoteDatabase:
     def databases(self) -> List[str]:
         return self._checked({"op": "db_list"})["databases"]
 
+    def create_database(self, name: str) -> None:
+        """Create (and open) a database on the server ([E] OServerAdmin
+        createDatabase); requires database-create permission."""
+        self._checked({"op": "db_create", "name": name})
+
     def close(self) -> None:
         try:
             self._call({"op": "close"})

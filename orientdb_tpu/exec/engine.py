@@ -66,8 +66,10 @@ def _choose_engine(db, stmt: A.Statement, engine: Optional[str]) -> str:
     if eng not in _ENGINES:
         raise ValueError(f"unknown engine {eng!r}; expected one of {_ENGINES}")
     if eng == "auto":
-        if db.current_snapshot(require_fresh=True) is not None and isinstance(
-            stmt, (A.MatchStatement, A.TraverseStatement)
+        if (
+            db.tx is None
+            and db.current_snapshot(require_fresh=True) is not None
+            and isinstance(stmt, (A.MatchStatement, A.TraverseStatement))
         ):
             return "tpu"
         return "oracle"
@@ -80,6 +82,11 @@ def _run(db, stmt: A.Statement, params, engine: Optional[str], strict: bool):
         from orientdb_tpu.exec import tpu_engine
 
         try:
+            # an active tx means the snapshot no longer reflects this
+            # session's view (tx-created/-deleted records) — the oracle is
+            # the only engine that applies the tx overlay
+            if db.tx is not None:
+                raise tpu_engine.Uncompilable("active transaction on this thread")
             return tpu_engine.execute(db, stmt, params), "tpu"
         except tpu_engine.Uncompilable as e:
             if strict:

@@ -65,6 +65,14 @@ class HookManager:
         return cls is not None and cls.is_subclass_of(class_name)
 
     def fire(self, event: str, doc) -> None:
+        # During a tx commit apply, AFTER events are buffered (flushed by
+        # the tx only once the whole commit succeeds, dropped if it is
+        # compensated away); BEFORE hooks still fire inline so they can
+        # veto the op that is about to apply.
+        buf = getattr(self._db._tx_local, "hook_buffer", None)
+        if buf is not None and event.startswith("after_"):
+            buf.append((event, doc))
+            return
         with self._lock:
             snapshot = list(self._hooks.values())
         for ev, cname, fn in snapshot:

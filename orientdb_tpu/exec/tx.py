@@ -23,7 +23,7 @@ import itertools
 from typing import Dict, List, Optional, Tuple
 
 from orientdb_tpu.exec.result import Result
-from orientdb_tpu.models.record import Document, Edge, Vertex
+from orientdb_tpu.models.record import Direction, Document, Edge, Vertex
 from orientdb_tpu.models.rid import NEW_RID, RID
 from orientdb_tpu.sql import ast as A
 from orientdb_tpu.utils.logging import get_logger
@@ -218,10 +218,17 @@ class Transaction:
                     raise TxError(f"{rid} vanished before commit")
                 if stored.version != base:
                     self._fail_conflict(rid, stored.version, base)
-            # phase 2: apply, with compensating rollback on failure
+            # phase 2: apply, with compensating rollback on failure.
+            # AFTER hooks (and live-query delivery built on them) are
+            # buffered for the duration of the apply and flushed only once
+            # the whole commit has succeeded — a mid-apply failure discards
+            # them, so subscribers never observe compensated-away ops (the
+            # reference's post-commit-only OLiveQueryHookV2 delivery).
             applied: List[Tuple[str, object]] = []
             rid_map: Dict[RID, RID] = {}
             db._tx_suspended = True
+            after_events: List = []
+            db._tx_local.hook_buffer = after_events
             try:
                 for doc in self.created:
                     temp = doc.rid
@@ -259,15 +266,32 @@ class Transaction:
                 for rid in list(self.deleted):
                     live = db._load_raw(rid)
                     if live is not None:
+                        # capture incident edges BEFORE the cascade so a
+                        # compensating restore can re-wire them
+                        edges = (
+                            list(live.edges(Direction.BOTH))
+                            if isinstance(live, Vertex)
+                            else []
+                        )
                         db.delete(live)
-                        applied.append(("delete", live))
+                        applied.append(("delete", (live, edges)))
             except Exception:
                 self._compensate(applied)
                 raise
             finally:
                 db._tx_suspended = False
+                db._tx_local.hook_buffer = None
             self.active = False
             db._end_tx(self)
+            if db._hooks is not None:
+                for ev, doc in after_events:
+                    # best-effort: the commit is already durable — a raising
+                    # subscriber must not make a persisted commit look failed
+                    # or starve later subscribers
+                    try:
+                        db._hooks.fire(ev, doc)
+                    except Exception:
+                        log.exception("post-commit %s hook failed", ev)
             return rid_map
 
     def _fail_conflict(self, rid, stored_v, base_v):
@@ -278,27 +302,65 @@ class Transaction:
         )
 
     def _compensate(self, applied) -> None:
-        """Undo already-applied ops after a mid-commit failure."""
+        """Undo already-applied ops after a mid-commit failure.
+
+        Every restore routes through the index manager too — writing a
+        pre-image straight into the cluster would leave unique indexes
+        mapping the compensated-away values forever (a phantom
+        DuplicateKeyError on every future insert of that key).
+        """
         db = self.db
+        idx = db._indexes
         for kind, payload in reversed(applied):
             try:
                 if kind in ("create", "edge"):
                     db.delete(payload)
                 elif kind == "update":
                     pre: Document = payload
+                    cur = db._load_raw(pre.rid)
+                    if idx is not None and cur is not None:
+                        idx.on_delete(cur)
                     db._cluster(pre.rid.cluster).records[pre.rid.position] = pre
+                    if idx is not None:
+                        idx.on_save(pre)
                 elif kind == "update_pre":
                     rid, (fields, version) = payload
                     live = db._load_raw(rid)
                     if live is not None:
+                        if idx is not None:
+                            idx.on_delete(live)
                         live._fields = dict(fields)
                         live.version = version
+                        if idx is not None:
+                            idx.on_save(live)
                 elif kind == "delete":
-                    doc: Document = payload
-                    db._cluster(doc.rid.cluster).records[doc.rid.position] = doc
-                    doc._deleted = False
+                    doc, edges = payload
+                    self._restore_deleted(doc)
+                    for e in edges:
+                        self._restore_deleted(e)
             except Exception:  # pragma: no cover - best effort
                 log.exception("compensation failed for %s", kind)
+
+    def _restore_deleted(self, doc: Document) -> None:
+        """Resurrect a deleted record: cluster slot, index entries, and (for
+        edges) both endpoint adjacency bags."""
+        db = self.db
+        if db._load_raw(doc.rid) is None:
+            db._cluster(doc.rid.cluster).records[doc.rid.position] = doc
+        doc._deleted = False
+        if db._indexes is not None:
+            db._indexes.on_save(doc)
+        if isinstance(doc, Edge):
+            src = db._load_raw(doc.out_rid)
+            dst = db._load_raw(doc.in_rid)
+            if isinstance(src, Vertex):
+                bag = src._bag(Direction.OUT, doc.class_name)
+                if doc.rid not in bag:
+                    bag.append(doc.rid)
+            if isinstance(dst, Vertex):
+                bag = dst._bag(Direction.IN, doc.class_name)
+                if doc.rid not in bag:
+                    bag.append(doc.rid)
 
     def rollback(self) -> None:
         if not self.active:

@@ -12,8 +12,7 @@ over immutable columnar *snapshots* built from this store (north-star
 design: MATCH is a read workload, writes stay in the host store).
 
 Durability is provided by the storage layer (``orientdb_tpu.storage``):
-JSON export/import (the §3.5 ingest path) and snapshot epochs. A WAL analog
-guards the host store when ``config.wal_enabled`` is set.
+JSON export/import (the §3.5 ingest path) and snapshot epochs.
 """
 
 from __future__ import annotations

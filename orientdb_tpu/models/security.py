@@ -20,6 +20,40 @@ UPDATE = "update"
 DELETE = "delete"
 ALL = (READ, CREATE, UPDATE, DELETE)
 
+# Distinct resource kinds (the reference's ORule resource tree:
+# database.class.*, database.schema, server.databases …). Record CRUD,
+# schema DDL, and database create/drop are separate resources so the
+# writer role can hold record CRUD without server-level powers.
+RES_RECORD = "record"
+RES_SCHEMA = "schema"
+RES_DATABASE = "database"
+
+_SCHEMA_DDL_HEADS = ("class", "property", "index", "sequence", "function")
+
+
+def classify_sql(sql: str):
+    """Map a statement to its (resource, op) for permission checks.
+
+    SELECT/MATCH/TRAVERSE/EXPLAIN → (record, read); CREATE/DROP/ALTER/
+    TRUNCATE of schema objects → (schema, update); everything else
+    (DML, BEGIN/COMMIT…) → (record, update).
+    """
+    toks = sql.split(None, 2)
+    head = toks[0].lower() if toks else ""
+    if head in ("select", "match", "traverse", "explain", "profile"):
+        return RES_RECORD, READ
+    if head == "insert":
+        return RES_RECORD, CREATE
+    if head == "delete":
+        return RES_RECORD, DELETE
+    if head in ("create", "drop", "alter", "truncate", "rebuild"):
+        target = toks[1].lower() if len(toks) > 1 else ""
+        if target in _SCHEMA_DDL_HEADS:
+            return RES_SCHEMA, UPDATE
+        if head == "create" and target in ("vertex", "edge"):
+            return RES_RECORD, CREATE
+    return RES_RECORD, UPDATE
+
 
 class SecurityError(Exception):
     pass
@@ -82,13 +116,25 @@ class SecurityManager:
     def __init__(self, admin_password: str = "admin") -> None:
         self.roles: Dict[str, Role] = {}
         self.users: Dict[str, User] = {}
-        admin = self.create_role("admin").grant("*", *ALL)
-        reader = self.create_role("reader").grant("*", READ)
-        writer = self.create_role("writer").grant("*", *ALL)
+        # admin's '*' grant covers record/schema/database via the fallback;
+        # reader and writer get per-resource grants only — writer has
+        # record CRUD but cannot touch the schema or create/drop databases.
+        self.create_role("admin").grant("*", *ALL)
+        (
+            self.create_role("reader")
+            .grant(RES_RECORD, READ)
+            .grant(RES_SCHEMA, READ)
+            .grant(RES_DATABASE, READ)
+        )
+        (
+            self.create_role("writer")
+            .grant(RES_RECORD, *ALL)
+            .grant(RES_SCHEMA, READ)
+            .grant(RES_DATABASE, READ)
+        )
         self.create_user("admin", admin_password, ["admin"])
         self.create_user("reader", "reader", ["reader"])
         self.create_user("writer", "writer", ["writer"])
-        del admin, reader, writer
 
     def create_role(self, name: str) -> Role:
         if name.lower() in self.roles:

@@ -22,7 +22,12 @@ import threading
 from typing import Optional
 
 from orientdb_tpu.models.rid import RID
-from orientdb_tpu.models.security import SecurityError
+from orientdb_tpu.models.security import (
+    RES_DATABASE,
+    RES_RECORD,
+    SecurityError,
+    classify_sql,
+)
 from orientdb_tpu.utils.logging import get_logger
 
 log = get_logger("binary")
@@ -95,7 +100,7 @@ class _Session:
             if op == "db_list":
                 return {"ok": True, "databases": sorted(self.server.databases)}
             if op == "db_create":
-                self.server.security.check(self.user, "*", "create")
+                self.server.security.check(self.user, RES_DATABASE, "create")
                 self.server.create_database(req["name"])
                 self.db = self.server.get_database(req["name"])
                 return {"ok": True}
@@ -108,21 +113,22 @@ class _Session:
             if self.db is None and op != "close":
                 return {"ok": False, "error": "no database open"}
             if op == "query":
-                self.server.security.check(self.user, "*", "read")
+                self.server.security.check(self.user, RES_RECORD, "read")
                 rs = self.db.query(req["sql"], req.get("params"))
                 return {"ok": True, "result": rs.to_dicts(), "engine": rs.engine}
             if op == "command":
-                self.server.security.check(self.user, "*", "update")
+                resource, cop = classify_sql(req["sql"])
+                self.server.security.check(self.user, resource, cop)
                 rs = self.db.command(req["sql"], req.get("params"))
                 return {"ok": True, "result": rs.to_dicts(), "engine": rs.engine}
             if op == "load":
-                self.server.security.check(self.user, "*", "read")
+                self.server.security.check(self.user, RES_RECORD, "read")
                 doc = self.db.load(RID.parse(req["rid"]))
                 if doc is None:
                     return {"ok": True, "record": None}
                 return {"ok": True, "record": doc.to_dict()}
             if op == "save":
-                self.server.security.check(self.user, "*", "update")
+                self.server.security.check(self.user, RES_RECORD, "update")
                 payload = dict(req.get("record") or {})
                 cls = payload.pop("@class", "O")
                 rid = payload.pop("@rid", None)
@@ -142,7 +148,7 @@ class _Session:
                         doc = self.db.new_element(cls, **payload)
                 return {"ok": True, "record": doc.to_dict()}
             if op == "delete":
-                self.server.security.check(self.user, "*", "delete")
+                self.server.security.check(self.user, RES_RECORD, "delete")
                 doc = self.db.load(RID.parse(req["rid"]))
                 if doc is not None:
                     self.db.delete(doc)

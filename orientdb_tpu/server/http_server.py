@@ -29,7 +29,12 @@ from typing import Optional, Tuple
 
 from orientdb_tpu.models.record import Document, Edge, Vertex
 from orientdb_tpu.models.rid import RID
-from orientdb_tpu.models.security import SecurityError
+from orientdb_tpu.models.security import (
+    RES_DATABASE,
+    RES_RECORD,
+    SecurityError,
+    classify_sql,
+)
 from orientdb_tpu.utils.logging import get_logger
 
 log = get_logger("http")
@@ -121,7 +126,7 @@ class _Handler(BaseHTTPRequestHandler):
                 db = self._db(rest[0])
                 if db is None:
                     return
-                self.server.ot_server.security.check(user, "*", "read")
+                self.server.ot_server.security.check(user, RES_RECORD, "read")
                 sql = rest[2]
                 limit = int(rest[3]) if len(rest) > 3 else None
                 rows = db.query(sql).to_dicts()
@@ -132,7 +137,7 @@ class _Handler(BaseHTTPRequestHandler):
                 db = self._db(rest[0])
                 if db is None:
                     return
-                self.server.ot_server.security.check(user, "*", "read")
+                self.server.ot_server.security.check(user, RES_RECORD, "read")
                 doc = db.load(RID.parse(rest[1]))
                 if doc is None:
                     return self._error(404, f"record {rest[1]} not found")
@@ -172,7 +177,7 @@ class _Handler(BaseHTTPRequestHandler):
         head, rest = self._route()
         try:
             if head == "database" and rest:
-                self.server.ot_server.security.check(user, "*", "create")
+                self.server.ot_server.security.check(user, RES_DATABASE, "create")
                 db = self.server.ot_server.create_database(rest[0])
                 return self._send(200, {"created": db.name})
             if head == "command" and len(rest) >= 2 and rest[1] == "sql":
@@ -184,23 +189,15 @@ class _Handler(BaseHTTPRequestHandler):
                     sql = json.loads(body).get("command", body)
                 except (json.JSONDecodeError, AttributeError):
                     sql = body
-                op = "read"
-                stripped = sql.lstrip().lower()
-                if not (
-                    stripped.startswith("select")
-                    or stripped.startswith("match")
-                    or stripped.startswith("traverse")
-                    or stripped.startswith("explain")
-                ):
-                    op = "update"
-                self.server.ot_server.security.check(user, "*", op)
+                resource, op = classify_sql(sql)
+                self.server.ot_server.security.check(user, resource, op)
                 rows = db.command(sql).to_dicts()
                 return self._send(200, {"result": rows})
             if head == "document" and len(rest) == 1:
                 db = self._db(rest[0])
                 if db is None:
                     return
-                self.server.ot_server.security.check(user, "*", "create")
+                self.server.ot_server.security.check(user, RES_RECORD, "create")
                 payload = json.loads(self._body() or b"{}")
                 cls = payload.pop("@class", "O")
                 payload = {k: v for k, v in payload.items() if not k.startswith("@")}
@@ -226,7 +223,7 @@ class _Handler(BaseHTTPRequestHandler):
                 db = self._db(rest[0])
                 if db is None:
                     return
-                self.server.ot_server.security.check(user, "*", "update")
+                self.server.ot_server.security.check(user, RES_RECORD, "update")
                 doc = db.load(RID.parse(rest[1]))
                 if doc is None:
                     return self._error(404, f"record {rest[1]} not found")
@@ -252,14 +249,17 @@ class _Handler(BaseHTTPRequestHandler):
                 db = self._db(rest[0])
                 if db is None:
                     return
-                self.server.ot_server.security.check(user, "*", "delete")
+                self.server.ot_server.security.check(user, RES_RECORD, "delete")
                 doc = db.load(RID.parse(rest[1]))
                 if doc is None:
                     return self._error(404, f"record {rest[1]} not found")
                 db.delete(doc)
-                return self._send(204, {})
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
             if head == "database" and rest:
-                self.server.ot_server.security.check(user, "*", "delete")
+                self.server.ot_server.security.check(user, RES_DATABASE, "delete")
                 ok = self.server.ot_server.drop_database(rest[0])
                 return self._send(200 if ok else 404, {"dropped": ok})
             return self._error(404, f"no route for DELETE /{head}")

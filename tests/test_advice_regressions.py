@@ -1,0 +1,271 @@
+"""Regressions for the round-1 advisor findings (ADVICE.md):
+
+1. the TPU engine must not serve reads inside an active transaction
+   (snapshot cannot see the tx overlay — read-your-writes);
+2. compensating rollback must restore index entries, not just cluster
+   slots;
+3. HTTP DELETE must send 204 with no body (keep-alive correctness);
+4. the writer role is record-CRUD only — no schema DDL, no database
+   create/drop;
+5. live/AFTER events must not be delivered for ops a failed commit
+   compensated away.
+"""
+
+import http.client
+import json
+
+import pytest
+
+from orientdb_tpu import Database, PropertyType
+from orientdb_tpu.exec.live import live_query
+from orientdb_tpu.models.indexes import DuplicateKeyError
+from orientdb_tpu.storage.snapshot import build_snapshot
+
+
+@pytest.fixture
+def pdb():
+    db = Database("advdb")
+    cls = db.schema.create_vertex_class("Person")
+    cls.create_property("name", PropertyType.STRING)
+    db.schema.create_edge_class("Knows")
+    return db
+
+
+class TestTxEngineRouting:
+    def _snap_db(self):
+        db = Database("snapdb")
+        db.schema.create_vertex_class("Profiles")
+        db.schema.create_edge_class("HasFriend")
+        a = db.new_vertex("Profiles", name="alice")
+        b = db.new_vertex("Profiles", name="bob")
+        db.new_edge("HasFriend", a, b)
+        db.attach_snapshot(build_snapshot(db))
+        return db, a
+
+    MATCH = "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN p.name, f.name"
+
+    def test_tpu_engine_sees_tx_delete(self):
+        db, a = self._snap_db()
+        assert db.query(self.MATCH, engine="tpu").to_dicts()  # row exists
+        db.begin()
+        db.delete(db.load(a.rid))
+        rs = db.query(self.MATCH, engine="tpu")
+        assert rs.to_dicts() == []  # tx-deleted row must be invisible
+        assert rs.engine == "oracle"  # served by the tx-aware engine
+        db.rollback()
+
+    def test_auto_engine_routes_to_oracle_in_tx(self):
+        db, _ = self._snap_db()
+        assert db.query(self.MATCH).engine == "tpu"  # fresh snapshot: tpu
+        db.begin()
+        assert db.query(self.MATCH).engine == "oracle"
+        db.rollback()
+
+    def test_strict_tpu_raises_in_tx(self):
+        from orientdb_tpu.exec.tpu_engine import Uncompilable
+
+        db, _ = self._snap_db()
+        db.begin()
+        with pytest.raises(Uncompilable):
+            db.query(self.MATCH, engine="tpu", strict=True)
+        db.rollback()
+
+
+class TestCompensationRestoresIndexes:
+    def test_failed_commit_unwinds_unique_index(self, pdb):
+        pdb.command("CREATE INDEX Person.name ON Person (name) UNIQUE")
+        v1 = pdb.new_vertex("Person", name="one")
+        pdb.new_vertex("Person", name="two")
+        pdb.begin()
+        c1 = pdb.load(v1.rid)
+        c1.set("name", "moved")
+        pdb.save(c1)
+        # second update collides with v1's new key mid-apply → compensation
+        rows = pdb.query("SELECT FROM Person WHERE name='two'").to_dicts()
+        c2 = pdb.load(rows[0]["@rid"])
+        c2.set("name", "moved")
+        pdb.save(c2)
+        with pytest.raises(DuplicateKeyError):
+            pdb.commit()
+        assert pdb.load(v1.rid)["name"] == "one"
+        # the index must have dropped the phantom 'moved' → v1 mapping
+        pdb.new_vertex("Person", name="moved")  # must not raise
+
+    def test_failed_commit_unwinds_deleted_vertex_and_edges(self, pdb):
+        pdb.command("CREATE INDEX Person.name ON Person (name) UNIQUE")
+        a = pdb.new_vertex("Person", name="a")
+        b = pdb.new_vertex("Person", name="b")
+        pdb.new_edge("Knows", a, b)
+        pdb.begin()
+        pdb.delete(pdb.load(a.rid))  # applies first
+        pdb.new_vertex("Person", name="b")  # unique violation at apply
+        with pytest.raises(DuplicateKeyError):
+            pdb.commit()
+        # vertex, its index entry, AND the cascaded edge are all restored
+        restored = pdb.load(a.rid)
+        assert restored is not None and restored["name"] == "a"
+        assert [v["name"] for v in restored.vertices()] == ["b"]
+        assert pdb.count_class("Knows") == 1
+        with pytest.raises(DuplicateKeyError):
+            pdb.new_vertex("Person", name="a")  # index entry is back
+
+
+class TestLiveDeliveryPostCommitOnly:
+    def test_failed_commit_delivers_nothing(self, pdb):
+        pdb.command("CREATE INDEX Person.name ON Person (name) UNIQUE")
+        pdb.new_vertex("Person", name="dup")
+        events = []
+        live_query(pdb, "LIVE SELECT FROM Person", events.append)
+        pdb.begin()
+        pdb.new_vertex("Person", name="ok")  # applies, then compensated
+        pdb.new_vertex("Person", name="dup")  # fails commit
+        with pytest.raises(DuplicateKeyError):
+            pdb.commit()
+        assert events == []  # no spurious CREATE for the compensated 'ok'
+
+    def test_successful_commit_delivers_after_apply(self, pdb):
+        events = []
+        live_query(pdb, "LIVE SELECT FROM Person", events.append)
+        pdb.begin()
+        pdb.new_vertex("Person", name="x")
+        pdb.new_vertex("Person", name="y")
+        assert events == []
+        pdb.commit()
+        assert [e["operation"] for e in events] == ["CREATE", "CREATE"]
+
+
+@pytest.fixture(scope="module")
+def server():
+    from orientdb_tpu.server import Server
+
+    srv = Server(admin_password="pw")
+    db = srv.create_database("demo")
+    db.schema.create_vertex_class("Profiles")
+    srv.startup()
+    yield srv
+    srv.shutdown()
+
+
+def _basic(user, pw):
+    import base64
+
+    return "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode()
+
+
+class TestHttp204KeepAlive:
+    def test_delete_then_reuse_connection(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.http_port)
+        hdrs = {"Authorization": _basic("admin", "pw")}
+        conn.request(
+            "POST",
+            "/document/demo",
+            json.dumps({"@class": "Profiles", "name": "tmp"}),
+            hdrs,
+        )
+        resp = conn.getresponse()
+        rid = json.loads(resp.read())["@rid"]
+        conn.request("DELETE", f"/document/demo/{rid.replace('#', '%23')}", None, hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 204
+        assert resp.read() == b""  # RFC: no body on 204
+        # the SAME connection must survive for the next request
+        conn.request("GET", "/listDatabases", None, hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert "demo" in json.loads(resp.read())["databases"]
+        conn.close()
+
+
+class TestWriterRoleScoped:
+    def test_writer_record_crud_allowed(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.http_port)
+        hdrs = {"Authorization": _basic("writer", "writer")}
+        conn.request(
+            "POST",
+            "/command/demo/sql",
+            json.dumps({"command": "INSERT INTO Profiles SET name='w'"}),
+            hdrs,
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        conn.close()
+
+    def test_writer_cannot_ddl(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.http_port)
+        hdrs = {"Authorization": _basic("writer", "writer")}
+        conn.request(
+            "POST",
+            "/command/demo/sql",
+            json.dumps({"command": "CREATE CLASS Sneaky"}),
+            hdrs,
+        )
+        resp = conn.getresponse()
+        assert resp.status == 403
+        resp.read()
+        conn.close()
+
+    def test_writer_cannot_create_or_drop_database_http(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.http_port)
+        hdrs = {"Authorization": _basic("writer", "writer")}
+        conn.request("POST", "/database/sneaky", None, hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 403
+        resp.read()
+        conn.request("DELETE", "/database/demo", None, hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 403
+        resp.read()
+        conn.close()
+
+    def test_writer_cannot_create_database_binary(self, server):
+        from orientdb_tpu.client.remote import RemoteError, connect
+
+        with connect(
+            f"remote:127.0.0.1:{server.binary_port}/demo", "writer", "writer"
+        ) as db:
+            with pytest.raises(RemoteError):
+                db.create_database("sneaky2")
+
+    def test_classify_sql_op_granularity(self):
+        from orientdb_tpu.models.security import classify_sql
+
+        assert classify_sql("SELECT FROM V") == ("record", "read")
+        assert classify_sql("INSERT INTO Person SET a=1") == ("record", "create")
+        assert classify_sql("CREATE VERTEX Person SET a=1") == ("record", "create")
+        assert classify_sql("DELETE VERTEX Person") == ("record", "delete")
+        assert classify_sql("UPDATE Person SET a=1") == ("record", "update")
+        assert classify_sql("CREATE CLASS Foo") == ("schema", "update")
+        assert classify_sql("CREATE INDEX i ON P (a) UNIQUE") == ("schema", "update")
+        assert classify_sql("DROP CLASS Foo") == ("schema", "update")
+
+    def test_update_only_role_cannot_delete_via_command(self, server):
+        sec = server.security
+        sec.create_role("updonly").grant("record", "read", "update")
+        sec.create_user("upd", "upd", ["updonly"])
+        conn = http.client.HTTPConnection("127.0.0.1", server.http_port)
+        hdrs = {"Authorization": _basic("upd", "upd")}
+        conn.request(
+            "POST",
+            "/command/demo/sql",
+            json.dumps({"command": "DELETE VERTEX Profiles"}),
+            hdrs,
+        )
+        resp = conn.getresponse()
+        assert resp.status == 403
+        resp.read()
+        conn.close()
+
+    def test_admin_still_all_powerful(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.http_port)
+        hdrs = {"Authorization": _basic("admin", "pw")}
+        conn.request(
+            "POST",
+            "/command/demo/sql",
+            json.dumps({"command": "CREATE CLASS AdminMade"}),
+            hdrs,
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        conn.close()

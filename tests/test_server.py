@@ -164,7 +164,12 @@ class TestSecurity:
         u = sec.authenticate("admin", "admin")
         assert u is not None and u.allows("Profiles", "delete")
         r = sec.authenticate("reader", "reader")
-        assert r.allows("x", "read") and not r.allows("x", "update")
+        assert r.allows("record", "read") and not r.allows("record", "update")
+        w = sec.authenticate("writer", "writer")
+        # writer: record CRUD only — no schema DDL, no database create/drop
+        assert w.allows("record", "delete")
+        assert not w.allows("schema", "update")
+        assert not w.allows("database", "create")
 
     def test_custom_role(self):
         sec = SecurityManager()
