@@ -149,6 +149,61 @@ def execute_command(
     )
 
 
+def execute_query_batch(
+    db,
+    sqls,
+    params_list=None,
+    engine: Optional[str] = None,
+    strict: bool = False,
+) -> List[ResultSet]:
+    """Run a batch of idempotent statements in ~one device round trip.
+
+    The TPU-engine members dispatch together and overlap their
+    device→host transfers (``tpu_engine.execute_batch``) — the DP-axis
+    answer to the tunneled-TPU's fixed per-transfer RTT. Per-statement
+    Uncompilable failures fall back to the oracle (unless ``strict``).
+    """
+    n = len(sqls)
+    if params_list is None:
+        params_list = [None] * n
+    if len(params_list) != n:
+        raise ValueError("params_list length must match sqls length")
+    items = []
+    for sql, p in zip(sqls, params_list):
+        stmt = parse_cached(sql)
+        if isinstance(stmt, A.ExplainStatement) or not stmt.is_idempotent:
+            raise ValueError(
+                f"cannot run non-idempotent {type(stmt).__name__} via query_batch()"
+            )
+        items.append((stmt, _normalize_params(p)))
+    engines = [_choose_engine(db, s, engine) for s, _ in items]
+    out: List[Optional[ResultSet]] = [None] * n
+    tpu_idx = [i for i, e in enumerate(engines) if e == "tpu"]
+    if tpu_idx and db.tx is None:
+        from orientdb_tpu.exec import tpu_engine
+
+        batch = tpu_engine.execute_batch(db, [items[i] for i in tpu_idx])
+        for i, res in zip(tpu_idx, batch):
+            if isinstance(res, tpu_engine.Uncompilable):
+                if strict:
+                    raise res
+                log.info("tpu batch fallback to oracle: %s", res)
+            else:
+                out[i] = _result_set(res, "tpu")
+    elif tpu_idx:  # active tx: snapshot cannot see the tx overlay
+        if strict:
+            from orientdb_tpu.exec.tpu_engine import Uncompilable
+
+            raise Uncompilable("active transaction on this thread")
+    from orientdb_tpu.exec.oracle import execute_statement
+
+    for i in range(n):
+        if out[i] is None:
+            stmt, p = items[i]
+            out[i] = _result_set(execute_statement(db, stmt, p), "oracle")
+    return out
+
+
 def explain(db, sql: str, params=None) -> ResultSet:
     stmt = parse_cached(sql)
     if not isinstance(stmt, A.ExplainStatement):

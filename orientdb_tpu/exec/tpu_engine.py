@@ -27,6 +27,7 @@ surface grows.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -53,6 +54,7 @@ from orientdb_tpu.ops import csr as K
 from orientdb_tpu.ops.device_graph import DeviceGraph, device_graph
 from orientdb_tpu.ops.predicates import ColumnScope, Uncompilable, compile_predicate
 from orientdb_tpu.sql import ast as A
+from orientdb_tpu.utils.config import config
 from orientdb_tpu.utils.logging import get_logger
 
 log = get_logger("tpu_engine")
@@ -442,8 +444,10 @@ class TpuMatchSolver:
         return row, edge_pos, nbr, total
 
     def solve_table(self) -> Table:
+        pushdown = self._count_pushdown_steps()
+        steps = self.plan[: len(self.plan) - len(pushdown)] if pushdown else self.plan
         table = Table(count=1, width=0)
-        for step in self.plan:
+        for step in steps:
             if table.empty():
                 # required-edge pipeline already empty → no rows; optional
                 # steps cannot resurrect rows
@@ -454,7 +458,144 @@ class TpuMatchSolver:
                 table = self._expand(table, step, optional=False)
             else:
                 table = self._expand(table, step, optional=True)
+        if pushdown and not table.empty():
+            return self._apply_count_pushdown(table, pushdown)
         return table
+
+    # -- COUNT(*) aggregate pushdown ----------------------------------------
+
+    def _count_pushdown_steps(self) -> List[PlanStep]:
+        """Longest plan suffix of terminal chain expansions a lone COUNT(*)
+        can aggregate without materializing binding tables.
+
+        The reference counts MATCH results by draining the full traverser
+        chain row by row ([E] the MatchStep pipeline under a COUNT
+        projection); here every terminal hop collapses to one O(E)
+        segment-sum pass — ``w_k[v] = Σ_{edges v→u} emask(e)·mask(u)·
+        w_{k+1}[u]`` (a sparse matvec over the edge list) — and the count
+        is ``Σ_rows w_1[src]``. This keeps the per-query device program at
+        O(E + V) instead of O(result rows), which is what makes batched
+        COUNT throughput independent of fan-out.
+        """
+        if self.count_only_name() is None or self.stmt.group_by:
+            return []
+        suffix: List[PlanStep] = []
+        # alias usage counts over all edges (from/to + edge-filter aliases)
+        for step in reversed(self.plan):
+            if step.kind != "expand" or step.close:
+                break
+            e = step.edge
+            item = e.item
+            if (
+                item.target.while_cond is not None
+                or item.target.max_depth is not None
+                or item.target.depth_alias
+                or (item.edge_filter is not None and item.edge_filter.alias)
+            ):
+                break
+            dst_alias = e.from_alias if step.reverse else e.to_alias
+            # dst must be terminal: referenced by no OTHER edge than this one
+            # and (for non-last suffix members) only as the src of the next
+            # pushdown step — checked by walking backwards: the "next" step
+            # is already in `suffix`, and its src is this dst.
+            used_elsewhere = False
+            for e2 in self.pattern.edges:
+                if e2 is e:
+                    continue
+                in_suffix_head = suffix and e2 is suffix[0].edge
+                touches = dst_alias in (e2.from_alias, e2.to_alias)
+                f2 = e2.item.edge_filter
+                if f2 is not None and f2.alias == dst_alias:
+                    used_elsewhere = True
+                if touches and not in_suffix_head:
+                    used_elsewhere = True
+            if used_elsewhere:
+                break
+            if suffix:
+                nxt = suffix[0]
+                nxt_src = (
+                    nxt.edge.to_alias if nxt.reverse else nxt.edge.from_alias
+                )
+                if nxt_src != dst_alias:
+                    break
+            suffix.insert(0, step)
+        return suffix
+
+    def _apply_count_pushdown(self, table: Table, steps: List[PlanStep]) -> Table:
+        first = steps[0]
+        src_alias = (
+            first.edge.to_alias if first.reverse else first.edge.from_alias
+        )
+        srcs = table.cols.get(src_alias)
+        if srcs is None:
+            raise Uncompilable(f"alias {src_alias} not bound before expansion")
+        w = self._pushdown_weights(steps, jnp.int32)
+        per_row = K.take_pad(w, srcs, jnp.int32(0))
+        total_dev = per_row.sum()
+        if self.sched.recording:
+            # int32 overflow guard (x64 is disabled on TPU): a float32 twin
+            # of the whole weight chain detects wraps anywhere in the
+            # segment sums — float32 is inexact above 2^24 but its ~1e-7
+            # relative error is far below the mismatch a wrap produces.
+            # Record-time only: the snapshot is immutable, so replay sees
+            # the same data.
+            wf = self._pushdown_weights(steps, jnp.float32)
+            approx = float(K.take_pad(wf, srcs, jnp.float32(0)).sum())
+            exact = int(total_dev)
+            if not (
+                0 <= approx < 2**31 * 0.99
+                and abs(approx - exact) <= max(1e-3 * approx, 1.0)
+            ):
+                raise Uncompilable(
+                    f"COUNT pushdown overflows int32 (≈{approx:.6g} vs {exact})"
+                )
+        total = self.sched.observe(total_dev)
+        t = Table(count=int(total), width=0)
+        t.count_dev = total_dev
+        return t
+
+    def _pushdown_weights(self, steps: List[PlanStep], dtype) -> jnp.ndarray:
+        V = self.dg.num_vertices
+        vb = K.bucket(max(V, 1))
+        w = None  # None ≡ all-ones (the implicit weight after the last hop)
+        for step in reversed(steps):
+            item = step.edge.item
+            direction = item.direction
+            if step.reverse:
+                direction = _REVERSE_DIR[direction]
+            dst_alias = (
+                step.edge.from_alias if step.reverse else step.edge.to_alias
+            )
+            node_mask = self._node_masks[dst_alias]
+            f = item.edge_filter
+            new_w = jnp.zeros(vb, dtype)
+            for cname in self._resolve_edge_classes(item):
+                dec = self.dg.edges[cname]
+                E = dec.num_edges
+                if E == 0:
+                    continue
+                eids = jnp.arange(E, dtype=jnp.int32)
+                emask = (
+                    self._edge_where(cname, f.where)(eids, {})
+                    if (f is not None and f.where is not None)
+                    else jnp.ones(E, bool)
+                )
+                for d in ("out", "in") if direction == "both" else (direction,):
+                    # scanning the full out-CSR edge list covers both
+                    # directions: eid == position for either walk
+                    if d == "out":
+                        seg, emit = dec.edge_src, dec.dst
+                    else:
+                        seg, emit = dec.dst, dec.edge_src
+                    contrib = emask & node_mask(emit)
+                    vals = contrib.astype(dtype)
+                    if w is not None:
+                        vals = vals * K.take_pad(w, emit, dtype(0))
+                    new_w = new_w + jax.ops.segment_sum(
+                        vals, jnp.clip(seg, 0, vb - 1), num_segments=vb
+                    )
+            w = new_w
+        return w
 
     def _root_candidates(self, alias: str):
         node = self.pattern.nodes[alias]
@@ -983,7 +1124,14 @@ class TpuMatchSolver:
 
 class _CompiledPlan:
     """A solver whose size schedule is learned: re-executions replay the
-    whole solve as one jitted, sync-free device dispatch."""
+    whole solve as one jitted, sync-free device dispatch.
+
+    Execution is split into ``dispatch()`` (enqueue the device work —
+    microseconds) and ``materialize()`` (device→host transfer + row
+    marshalling). On a tunneled TPU the transfer carries a fixed ~90 ms
+    RTT regardless of size, so ``execute_batch`` dispatches a whole batch,
+    starts async host copies for every result, and only then materializes —
+    overlapping N round trips into ~one."""
 
     def __init__(self, solver: TpuMatchSolver, table: Table) -> None:
         self.solver = solver
@@ -1020,14 +1168,23 @@ class _CompiledPlan:
         # tunneled-TPU fetch RTT dominates small-result queries otherwise)
         return jnp.stack(flat)
 
-    def rows(self) -> List[Result]:
+    def dispatch(self):
+        """Enqueue the replay on device; returns the un-fetched result."""
+        return self.jitted(self.solver.dg.arrays)
+
+    def materialize(self, dev) -> List[Result]:
+        """Fetch a dispatched result and marshal rows."""
         if self.count_name is not None:
-            val = int(np.asarray(self.jitted(self.solver.dg.arrays)))
-            return self.solver.finalize_count(self.count_name, val)
-        return self.solver.rows_from_table(self.run())
+            return self.solver.finalize_count(self.count_name, int(dev))
+        return self.solver.rows_from_table(self._table_from(np.asarray(dev)))
+
+    def rows(self) -> List[Result]:
+        return self.materialize(self.dispatch())
 
     def run(self) -> Table:
-        stacked = np.asarray(self.jitted(self.solver.dg.arrays))
+        return self._table_from(np.asarray(self.dispatch()))
+
+    def _table_from(self, stacked: np.ndarray) -> Table:
         t = Table(count=self.count, width=self.width)
         i = 0
         for a in self.v_names:
@@ -1060,34 +1217,88 @@ def _params_key(params) -> Optional[Tuple]:
 # ---------------------------------------------------------------------------
 
 
-def execute(db, stmt, params) -> List[Result]:
+def _plan_cache(snap) -> "OrderedDict":
+    cache = getattr(snap, "_plan_cache", None)
+    if cache is None:
+        cache = snap._plan_cache = OrderedDict()
+    return cache
+
+
+def _cache_key(stmt, params) -> Optional[Tuple]:
+    pk = _params_key(params)
+    if pk is None:
+        return None
+    try:
+        key = (stmt, pk)
+        hash(key)
+        return key
+    except TypeError:  # statement holds an unhashable literal
+        return None
+
+
+def _prepare(db, stmt, params) -> Tuple[Optional[_CompiledPlan], Optional[List[Result]]]:
+    """Plan-cache lookup, compiling (and executing) on miss.
+
+    Returns ``(plan, None)`` on a cache hit — the caller dispatches — or
+    ``(None, rows)`` when this call WAS the recording first execution."""
     if not isinstance(stmt, A.MatchStatement):
         raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
     params = params or {}
     snap = db.current_snapshot(require_fresh=True)
     if snap is None:
         raise Uncompilable("no fresh snapshot attached")
-    cache: Dict = getattr(snap, "_plan_cache", None)
-    if cache is None:
-        cache = snap._plan_cache = {}
-    pk = _params_key(params)
-    key, plan = None, None
-    if pk is not None:
-        try:
-            key = (stmt, pk)
-            plan = cache.get(key)
-        except TypeError:  # statement holds an unhashable literal
-            key = None
-    if plan is not None:
-        return plan.rows()
+    cache = _plan_cache(snap)
+    key = _cache_key(stmt, params)
+    if key is not None:
+        plan = cache.get(key)
+        if plan is not None:
+            cache.move_to_end(key)  # LRU: keep hot plans
+            return plan, None
     solver = TpuMatchSolver(db, stmt, params)
     table = solver.solve_table()
     rows = solver.rows_from_table(table)
-    if key is not None:
-        if len(cache) > 128:
-            cache.pop(next(iter(cache)))  # evict oldest, keep hot plans
+    if key is not None and config.plan_cache_size > 0:
+        while len(cache) >= config.plan_cache_size:
+            cache.popitem(last=False)
         cache[key] = _CompiledPlan(solver, table)
-    return rows
+    return None, rows
+
+
+def execute(db, stmt, params) -> List[Result]:
+    plan, rows = _prepare(db, stmt, params)
+    return rows if plan is None else plan.rows()
+
+
+def execute_batch(db, items) -> List:
+    """Execute ``[(stmt, params), ...]`` with one overlapped transfer phase.
+
+    The single-chip DP axis (SURVEY.md §5 "replicas = independent query
+    streams"): every cached plan dispatches back-to-back (~40 µs each),
+    async host copies start for all results, and only then does
+    materialization block — so N queries cost ~one tunnel RTT instead of N.
+
+    Per-item failures (Uncompilable) are returned in-place as the exception
+    instance so the engine front door can fall back per statement."""
+    out: List = [None] * len(items)
+    pending = []
+    for i, (stmt, params) in enumerate(items):
+        try:
+            plan, rows = _prepare(db, stmt, params)
+        except Uncompilable as e:
+            out[i] = e
+            continue
+        if plan is None:
+            out[i] = rows
+        else:
+            pending.append((i, plan, plan.dispatch()))
+    for _i, _plan, dev in pending:
+        try:
+            dev.copy_to_host_async()
+        except Exception:  # CPU backend: already host-resident
+            pass
+    for i, plan, dev in pending:
+        out[i] = plan.materialize(dev)
+    return out
 
 
 def explain_plan_steps(db, stmt) -> List[str]:

@@ -408,6 +408,15 @@ class Database:
 
         return execute_query(self, sql, params or {}, **kw)
 
+    def query_batch(self, sqls, params_list=None, **kw):
+        """Run a batch of idempotent statements in ~one device round trip
+        (the single-chip DP axis: dispatch all compiled plans back-to-back,
+        overlap every device→host transfer). Returns one ResultSet per
+        statement, in order."""
+        from orientdb_tpu.exec.engine import execute_query_batch
+
+        return execute_query_batch(self, sqls, params_list, **kw)
+
     def command(self, sql: str, params: Optional[Dict[str, object]] = None, **kw):
         """Run any statement, including writes ([E] ODatabaseSession.command)."""
         from orientdb_tpu.exec.engine import execute_command

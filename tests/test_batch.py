@@ -1,0 +1,99 @@
+"""Batched query execution (`db.query_batch`).
+
+The single-chip DP axis (SURVEY.md §5 "replicas = independent query
+streams"): a batch dispatches every cached compiled plan back-to-back and
+overlaps the device→host transfers, so N queries cost ~one transfer RTT.
+Semantics must be identical to running each query alone.
+"""
+
+import pytest
+
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+
+def canon(rows):
+    return sorted(tuple(sorted(r.items())) for r in rows)
+
+
+MATCH_1HOP = "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN p.name, f.name"
+MATCH_COUNT = "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN count(*) AS n"
+MATCH_WHERE = (
+    "MATCH {class:Profiles, as:p, where:(age > 30)}-HasFriend->{as:f} "
+    "RETURN p.name AS a, f.name AS b"
+)
+
+
+@pytest.fixture
+def sdb(social_db):
+    attach_fresh_snapshot(social_db)
+    return social_db
+
+
+class TestQueryBatch:
+    def test_batch_matches_single(self, sdb):
+        sqls = [MATCH_1HOP, MATCH_COUNT, MATCH_WHERE]
+        batch = sdb.query_batch(sqls, engine="tpu", strict=True)
+        for sql, rs in zip(sqls, batch):
+            assert canon(rs.to_dicts()) == canon(
+                sdb.query(sql, engine="oracle").to_dicts()
+            )
+            assert rs.engine == "tpu"
+
+    def test_batch_reuses_cached_plans(self, sdb):
+        sqls = [MATCH_COUNT] * 8
+        first = sdb.query_batch(sqls, engine="tpu", strict=True)
+        again = sdb.query_batch(sqls, engine="tpu", strict=True)
+        for rs in first + again:
+            assert rs.to_dicts()[0]["n"] == 6
+
+    def test_batch_order_preserved(self, sdb):
+        sqls = [MATCH_COUNT, MATCH_1HOP, MATCH_COUNT]
+        rss = sdb.query_batch(sqls, engine="tpu", strict=True)
+        assert "n" in rss[0].to_dicts()[0]
+        assert "p.name" in rss[1].to_dicts()[0]
+        assert "n" in rss[2].to_dicts()[0]
+
+    def test_batch_uncompilable_falls_back_to_oracle(self, sdb):
+        # SELECT has no TPU compilation → per-item oracle fallback
+        sqls = [MATCH_COUNT, "SELECT name FROM Profiles ORDER BY name"]
+        rss = sdb.query_batch(sqls)
+        assert rss[0].to_dicts()[0]["n"] == 6
+        assert [r["name"] for r in rss[1].to_dicts()] == [
+            "alice",
+            "bob",
+            "carol",
+            "dave",
+            "eve",
+        ]
+        assert rss[1].engine == "oracle"
+
+    def test_batch_strict_raises_on_uncompilable(self, sdb):
+        from orientdb_tpu.exec.tpu_engine import Uncompilable
+
+        with pytest.raises(Uncompilable):
+            sdb.query_batch(
+                ["SELECT FROM Profiles"], engine="tpu", strict=True
+            )
+
+    def test_batch_rejects_writes(self, sdb):
+        with pytest.raises(ValueError):
+            sdb.query_batch(["INSERT INTO Profiles SET name='x'"])
+
+    def test_batch_params(self, sdb):
+        sql = "SELECT name FROM Profiles WHERE age > :a ORDER BY name"
+        rss = sdb.query_batch([sql, sql], params_list=[{"a": 30}, {"a": 38}])
+        assert [r["name"] for r in rss[0].to_dicts()] == ["carol", "dave"]
+        assert [r["name"] for r in rss[1].to_dicts()] == ["dave"]
+
+    def test_batch_in_tx_routes_to_oracle(self, sdb):
+        sdb.begin()
+        rss = sdb.query_batch([MATCH_COUNT])
+        assert rss[0].engine == "oracle"
+        sdb.rollback()
+
+    def test_empty_batch(self, sdb):
+        assert sdb.query_batch([]) == []
+
+    def test_params_list_length_mismatch(self, sdb):
+        with pytest.raises(ValueError):
+            sdb.query_batch([MATCH_COUNT], params_list=[{}, {}])
