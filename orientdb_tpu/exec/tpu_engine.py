@@ -443,6 +443,46 @@ class TpuMatchSolver:
         )
         return row, edge_pos, nbr, total
 
+    def _expand_one_dir(self, dec, d: str, srcs):
+        """One (edge class, direction) expansion → (row, global edge id,
+        neighbor, host total), on the single-device or mesh-sharded path."""
+        mg = self.dg.mesh_graph
+        if mg is None:
+            if d == "out":
+                indptr, nbrs = dec.indptr_out, dec.dst
+            else:
+                indptr, nbrs = dec.indptr_in, dec.src
+            row, edge_pos, nbr, total = self._expand_csr(indptr, nbrs, srcs)
+            if d == "out":
+                eid = edge_pos
+            else:
+                eid = K.take_pad(dec.edge_id_in, edge_pos, jnp.int32(-1))
+            return row, eid, nbr, total
+        from orientdb_tpu.parallel.mesh_graph import expand_gather, expand_totals
+
+        arrays = self.dg.arrays
+        p = mg.edge[dec.class_name].prefix
+        ind_sh = arrays[f"{p}:{d}:indptr"]
+        nbr_sh = arrays[f"{p}:{d}:nbr"]
+        extra_sh = (
+            arrays[f"{p}:out:ebase"] if d == "out" else arrays[f"{p}:in:eid"]
+        )
+        tots = expand_totals(mg.mesh, mg.rows_per_shard, ind_sh, srcs)
+        total = self.sched.observe(tots.sum())
+        max_local = self.sched.observe(tots.max())
+        cap = K.bucket(max(max_local, 1))
+        row, eid, nbr = expand_gather(
+            mg.mesh,
+            mg.rows_per_shard,
+            ind_sh,
+            nbr_sh,
+            extra_sh,
+            srcs,
+            cap,
+            is_out=(d == "out"),
+        )
+        return row, eid, nbr, total
+
     def solve_table(self) -> Table:
         pushdown = self._count_pushdown_steps()
         steps = self.plan[: len(self.plan) - len(pushdown)] if pushdown else self.plan
@@ -557,6 +597,10 @@ class TpuMatchSolver:
     def _pushdown_weights(self, steps: List[PlanStep], dtype) -> jnp.ndarray:
         V = self.dg.num_vertices
         vb = K.bucket(max(V, 1))
+        mg = self.dg.mesh_graph
+        if mg is not None:
+            univ = jnp.arange(vb, dtype=jnp.int32)
+            univ = jnp.where(univ < V, univ, -1)
         w = None  # None ≡ all-ones (the implicit weight after the last hop)
         for step in reversed(steps):
             item = step.edge.item
@@ -567,6 +611,7 @@ class TpuMatchSolver:
                 step.edge.from_alias if step.reverse else step.edge.to_alias
             )
             node_mask = self._node_masks[dst_alias]
+            ok_vec = node_mask(univ) if mg is not None else None
             f = item.edge_filter
             new_w = jnp.zeros(vb, dtype)
             for cname in self._resolve_edge_classes(item):
@@ -583,6 +628,29 @@ class TpuMatchSolver:
                 for d in ("out", "in") if direction == "both" else (direction,):
                     # scanning the full out-CSR edge list covers both
                     # directions: eid == position for either walk
+                    if mg is not None:
+                        from orientdb_tpu.parallel.mesh_graph import (
+                            sharded_weight_pass,
+                        )
+
+                        p = mg.edge[cname].prefix
+                        src_sh = self.dg.arrays[f"{p}:el:src"]
+                        dst_sh = self.dg.arrays[f"{p}:el:dst"]
+                        eid_sh = self.dg.arrays[f"{p}:el:eid"]
+                        seg_sh, emit_sh = (
+                            (src_sh, dst_sh) if d == "out" else (dst_sh, src_sh)
+                        )
+                        new_w = new_w + sharded_weight_pass(
+                            mg.mesh,
+                            seg_sh,
+                            emit_sh,
+                            eid_sh,
+                            emask,
+                            ok_vec,
+                            w if w is not None else jnp.ones(vb, dtype),
+                            vb,
+                        )
+                        continue
                     if d == "out":
                         seg, emit = dec.edge_src, dec.dst
                     else:
@@ -682,18 +750,9 @@ class TpuMatchSolver:
                 else None
             )
             for d in sub_dirs:
-                if d == "out":
-                    indptr, nbrs = dec.indptr_out, dec.dst
-                else:
-                    indptr, nbrs = dec.indptr_in, dec.src
-                row, edge_pos, nbr, total = self._expand_csr(indptr, nbrs, srcs)
+                row, eid, nbr, total = self._expand_one_dir(dec, d, srcs)
                 if total == 0:
                     continue
-                # edge ids in out-CSR order (edge property columns / RIDs)
-                if d == "out":
-                    eid = edge_pos
-                else:
-                    eid = K.take_pad(dec.edge_id_in, edge_pos, jnp.int32(-1))
                 mask = row >= 0
                 if where_fn is not None:
                     mask = mask & where_fn(eid, {})
@@ -794,8 +853,11 @@ class TpuMatchSolver:
         univ = jnp.arange(vb, dtype=jnp.int32)
         univ = jnp.where(univ < V, univ, -1)
         node_mask_vec = self._node_masks[dst_alias](univ)  # [vb]
-        # per-(class, dir) edge hop arrays; edge WHERE fused as edge masks
+        # per-(class, dir) edge hop closures; edge WHERE fused as edge
+        # masks. Mesh-sharded graphs hop via the sharded edge-list slices
+        # with a psum-OR bitmap merge over the shards axis.
         f = item.edge_filter
+        mg = self.dg.mesh_graph
         hops = []
         for cname in self._resolve_edge_classes(item):
             dec = self.dg.edges[cname]
@@ -807,10 +869,31 @@ class TpuMatchSolver:
                 else jnp.ones(E, bool)
             )
             for d in ("out", "in") if direction == "both" else (direction,):
-                if d == "out":
-                    hops.append((dec.edge_src, dec.dst, emask))
-                else:  # follow edges backwards: activate on dst, emit src
-                    hops.append((dec.dst, dec.edge_src, emask))
+                if mg is None:
+                    if d == "out":
+                        a, em = dec.edge_src, dec.dst
+                    else:  # follow edges backwards: activate dst, emit src
+                        a, em = dec.dst, dec.edge_src
+                    hops.append(
+                        lambda fr, a=a, em=em, m=emask: K.bitmap_hop(a, em, m, fr)
+                    )
+                else:
+                    from orientdb_tpu.parallel.mesh_graph import (
+                        sharded_bitmap_hop,
+                    )
+
+                    p = mg.edge[cname].prefix
+                    src_sh = self.dg.arrays[f"{p}:el:src"]
+                    dst_sh = self.dg.arrays[f"{p}:el:dst"]
+                    eid_sh = self.dg.arrays[f"{p}:el:eid"]
+                    a_sh, e_sh = (
+                        (src_sh, dst_sh) if d == "out" else (dst_sh, src_sh)
+                    )
+                    hops.append(
+                        lambda fr, a=a_sh, em=e_sh, i=eid_sh, m=emask: (
+                            sharded_bitmap_hop(mg.mesh, a, em, i, m, fr)
+                        )
+                    )
         parts: List[Table] = []
         counts: List[int] = []
         width = table.width or 1
@@ -844,8 +927,8 @@ class TpuMatchSolver:
                     gate = while_fn(univ, {"depth": depth})
                     expandable = expandable & gate[None, :]
                 nxt = jnp.zeros_like(frontier)
-                for act_idx, emit_idx, emask in hops:
-                    nxt = nxt | K.bitmap_hop(act_idx, emit_idx, emask, expandable)
+                for hop in hops:
+                    nxt = nxt | hop(expandable)
                 nxt = nxt & ~visited
                 alive = self.sched.observe(K.mask_count(nxt))
                 if alive == 0:

@@ -430,7 +430,9 @@ class Database:
 
     # -- snapshot attach ---------------------------------------------------
 
-    def attach_snapshot(self, snapshot) -> None:
+    def attach_snapshot(self, snapshot, mesh=None) -> None:
+        if mesh is not None:
+            snapshot._mesh = mesh
         self._snapshot = snapshot
         self._snapshot_epoch = self.mutation_epoch
 

@@ -64,13 +64,7 @@ class DeviceEdgeClass:
         g._put(f"{p}:dst", csr.dst)
         # per-edge source vertex in out-CSR order (bitmap-hop kernels index
         # edges directly instead of walking indptr)
-        g._put(
-            f"{p}:edge_src",
-            np.repeat(
-                np.arange(csr.indptr_out.shape[0] - 1, dtype=np.int32),
-                np.diff(csr.indptr_out),
-            ),
-        )
+        g._put(f"{p}:edge_src", csr.edge_src_np())
         g._put(f"{p}:indptr_in", csr.indptr_in)
         g._put(f"{p}:src", csr.src)
         g._put(f"{p}:edge_id_in", csr.edge_id_in)
@@ -106,11 +100,26 @@ class DeviceEdgeClass:
 
 
 class DeviceGraph:
-    """The full snapshot in HBM plus host metadata for planning/marshal."""
+    """The full snapshot in HBM plus host metadata for planning/marshal.
+
+    When the snapshot was attached with a device mesh, adjacency is
+    additionally laid out shard-wise (`orientdb_tpu/parallel/mesh_graph.py`)
+    and `self.mesh_graph` carries the sharding metadata; replicated arrays
+    get an explicit fully-replicated NamedSharding so every jit argument
+    agrees about the mesh."""
 
     def __init__(self, snap: GraphSnapshot) -> None:
         self.snap = snap
         self.num_vertices = snap.num_vertices
+        self.mesh_graph = None
+        self._replicated_spec = None
+        mesh = getattr(snap, "_mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from orientdb_tpu.parallel.mesh_graph import MeshGraph
+
+            self.mesh_graph = MeshGraph(mesh)
+            self._replicated_spec = NamedSharding(mesh, PartitionSpec())
         #: the single flat array store — a jit-arg pytree for compiled plans
         self.arrays: Dict[str, jnp.ndarray] = {}
         self._put("v_class", snap.v_class)
@@ -126,9 +135,20 @@ class DeviceGraph:
         # and silently retrace every cached plan. They are tiny (a few
         # int32s), so being baked into plan executables as constants is fine.
         self._class_ids: Dict[str, jnp.ndarray] = {}
+        if self.mesh_graph is not None:
+            self.mesh_graph.build(self)
+
+    @property
+    def mesh(self):
+        return self.mesh_graph.mesh if self.mesh_graph is not None else None
 
     def _put(self, key: str, arr) -> str:
-        self.arrays[key] = jnp.asarray(arr)
+        a = jnp.asarray(arr)
+        if self._replicated_spec is not None:
+            import jax
+
+            a = jax.device_put(a, self._replicated_spec)
+        self.arrays[key] = a
         return key
 
     @property

@@ -1,0 +1,304 @@
+"""Mesh-sharded graph layout for the compiled MATCH engine.
+
+The reference distributes a database by Hazelcast-replicating clusters to
+server nodes ([E] OHazelcastPlugin / ODistributedStorage, SURVEY.md §2
+"Distributed"); the TPU-native design instead **shards the adjacency
+structure itself across the device mesh** and lets XLA collectives do the
+merging:
+
+- **out-CSR** row-sharded by source-vertex range ``[s·R, (s+1)·R)``:
+  each shard holds a locally-rebased ``indptr`` and its slice of ``dst``;
+- **in-CSR** row-sharded by destination-vertex range (reverse walks);
+- the flat **edge list** (``edge_src``/``edge_dst``/``edge_id``) sliced
+  into equal ranges for edge-parallel kernels (variable-depth bitmap hops,
+  COUNT-pushdown segment sums).
+
+Vertex property columns stay replicated: they are O(V) while adjacency is
+O(E), and predicates gather from them on every device anyway. Binding
+tables are replicated too; each expansion step computes its shard's local
+contribution under ``shard_map`` and the shards merge with ``all_gather``
+(tables) or ``psum`` (bitmaps / weights) over ICI — the SURVEY.md §5.7
+frontier-merge design applied to the *real* engine, not a BFS toy.
+
+All sharded buffers live in the owning ``DeviceGraph.arrays`` dict (keys
+prefixed ``sh:``), placed with a ``NamedSharding`` over the mesh's
+``shards`` axis, so compiled plans still receive ONE arg pytree shared by
+every cached executable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from orientdb_tpu.ops import csr as K
+
+
+class ShardedEdgeArrays:
+    """Host metadata for one edge class's sharded adjacency (the arrays
+    themselves live in the DeviceGraph's flat dict)."""
+
+    __slots__ = ("class_name", "prefix", "e_slice", "out_emax", "in_emax")
+
+    def __init__(self, class_name: str, prefix: str):
+        self.class_name = class_name
+        self.prefix = prefix
+        self.e_slice = 0  # edge-list slice width per shard
+        self.out_emax = 0  # max local out-CSR edges across shards
+        self.in_emax = 0
+
+
+class MeshGraph:
+    """Sharding context attached to a DeviceGraph."""
+
+    def __init__(self, mesh: Mesh) -> None:
+        if "shards" not in mesh.shape:
+            raise ValueError("mesh must have a 'shards' axis")
+        self.mesh = mesh
+        self.n_shards = mesh.shape["shards"]
+        self.rows_per_shard = 0
+        self.edge: Dict[str, ShardedEdgeArrays] = {}
+
+    def _spec(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("shards", None))
+
+    def build(self, dg) -> None:
+        """Populate ``dg.arrays`` with sharded adjacency for every edge
+        class of the snapshot behind ``dg``."""
+        S = self.n_shards
+        V = dg.num_vertices
+        self.rows_per_shard = max(1, math.ceil(max(V, 1) / S))
+        for name, dec in dg.edges.items():
+            csr = dg.snap.edge_classes[name]
+            sea = ShardedEdgeArrays(name, f"sh:{name}")
+            self.edge[name] = sea
+            self._put_csr(
+                dg, sea, "out", csr.indptr_out, csr.dst, eid_map=None
+            )
+            self._put_csr(
+                dg, sea, "in", csr.indptr_in, csr.src, eid_map=csr.edge_id_in
+            )
+            self._put_edge_list(dg, sea, csr)
+
+    # -- layout builders -----------------------------------------------------
+
+    def _shard_rows(self, indptr: np.ndarray):
+        """Split a global CSR into per-shard locally-rebased rows."""
+        S, R = self.n_shards, self.rows_per_shard
+        V = indptr.shape[0] - 1
+        ind_l = np.zeros((S, R + 1), np.int64)
+        bases = np.zeros(S, np.int32)
+        slices = []
+        for s in range(S):
+            r0 = min(s * R, V)
+            r1 = min(r0 + R, V)
+            seg = indptr[r0 : r1 + 1].astype(np.int64) - int(indptr[r0])
+            ind_l[s, : seg.shape[0]] = seg
+            if seg.shape[0] < R + 1:
+                ind_l[s, seg.shape[0] :] = seg[-1] if seg.shape[0] else 0
+            bases[s] = int(indptr[r0])
+            slices.append((int(indptr[r0]), int(indptr[r1])))
+        return ind_l.astype(np.int32), bases, slices
+
+    def _put_csr(self, dg, sea, tag, indptr, nbrs, eid_map):
+        spec = self._spec()
+        S = self.n_shards
+        ind_l, bases, slices = self._shard_rows(indptr)
+        emax = max(1, max((b - a) for a, b in slices))
+        nbr_l = np.full((S, emax), -1, np.int32)
+        eid_l = np.full((S, emax), -1, np.int32) if eid_map is not None else None
+        for s, (a, b) in enumerate(slices):
+            nbr_l[s, : b - a] = nbrs[a:b]
+            if eid_l is not None:
+                eid_l[s, : b - a] = eid_map[a:b]
+        p = sea.prefix
+        dg.arrays[f"{p}:{tag}:indptr"] = jax.device_put(jnp.asarray(ind_l), spec)
+        dg.arrays[f"{p}:{tag}:nbr"] = jax.device_put(jnp.asarray(nbr_l), spec)
+        dg.arrays[f"{p}:{tag}:ebase"] = jax.device_put(
+            jnp.asarray(bases[:, None]), spec
+        )
+        if eid_l is not None:
+            dg.arrays[f"{p}:{tag}:eid"] = jax.device_put(jnp.asarray(eid_l), spec)
+        if tag == "out":
+            sea.out_emax = emax
+        else:
+            sea.in_emax = emax
+
+    def _put_edge_list(self, dg, sea, csr):
+        """Equal edge-range slices for edge-parallel kernels."""
+        spec = self._spec()
+        S = self.n_shards
+        E = csr.num_edges
+        W = max(1, math.ceil(max(E, 1) / S))
+        sea.e_slice = W
+        src_l = np.full((S, W), -1, np.int32)
+        dst_l = np.full((S, W), -1, np.int32)
+        eid_l = np.full((S, W), -1, np.int32)
+        edge_src = csr.edge_src_np()
+        for s in range(S):
+            a, b = min(s * W, E), min((s + 1) * W, E)
+            src_l[s, : b - a] = edge_src[a:b]
+            dst_l[s, : b - a] = csr.dst[a:b]
+            eid_l[s, : b - a] = np.arange(a, b, dtype=np.int32)
+        p = sea.prefix
+        dg.arrays[f"{p}:el:src"] = jax.device_put(jnp.asarray(src_l), spec)
+        dg.arrays[f"{p}:el:dst"] = jax.device_put(jnp.asarray(dst_l), spec)
+        dg.arrays[f"{p}:el:eid"] = jax.device_put(jnp.asarray(eid_l), spec)
+
+
+# ---------------------------------------------------------------------------
+# sharded execution kernels (called from TpuMatchSolver when a mesh is
+# attached; all run under shard_map inside the solver's eager record run
+# and inside the compiled replay's single jit alike)
+# ---------------------------------------------------------------------------
+
+
+def expand_totals(mesh: Mesh, R: int, ind_sh, srcs) -> jnp.ndarray:
+    """Per-shard expansion totals [S] (replicated on every device).
+
+    Each shard counts the out-degrees of the binding-table sources it owns
+    (global ids in ``[s·R, (s+1)·R)``); the result sizes the static
+    expansion cap and the global total for the SizeSchedule.
+    """
+
+    def local(ind_l, srcs_rep):
+        ind_l = ind_l[0]
+        sid = jax.lax.axis_index("shards")
+        lo = sid * R
+        owned = (srcs_rep >= lo) & (srcs_rep < lo + R)
+        ls = jnp.where(owned, srcs_rep - lo, -1)
+        counts = K.degree_counts(ind_l, ls)
+        tot = counts.sum()[None]
+        return jax.lax.all_gather(tot, "shards").reshape(-1)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P("shards", None), P(None)),
+        out_specs=P(None),
+        check_vma=False,
+    )(ind_sh, srcs)
+
+
+def expand_gather(
+    mesh: Mesh,
+    R: int,
+    ind_sh,
+    nbr_sh,
+    extra_sh,
+    srcs,
+    cap: int,
+    is_out: bool,
+):
+    """Sharded CSR expansion: every shard expands its owned sources into a
+    static ``cap``-row block, then the blocks ``all_gather`` into one
+    replicated ``[S·cap]`` table segment — the binding-table analog of the
+    §5.7 psum frontier merge, carrying (row, global edge id, neighbor).
+
+    ``extra_sh`` is the per-shard global-edge-offset column (out-CSR:
+    ``eid = local edge pos + base``) or the sharded ``edge_id_in`` map
+    (in-CSR: local pos → out-order id)."""
+
+    def local(ind_l, nbr_l, extra_l, srcs_rep):
+        ind_l, nbr_l, extra_l = ind_l[0], nbr_l[0], extra_l[0]
+        sid = jax.lax.axis_index("shards")
+        lo = sid * R
+        owned = (srcs_rep >= lo) & (srcs_rep < lo + R)
+        ls = jnp.where(owned, srcs_rep - lo, -1)
+        counts = K.degree_counts(ind_l, ls)
+        offsets = K.exclusive_cumsum(counts)
+        tot = counts.sum()
+        row, epos, nbr = K.gather_expand(ind_l, nbr_l, ls, offsets, tot, cap)
+        if is_out:
+            eid = jnp.where(epos >= 0, epos + extra_l[0], -1)
+        else:
+            eid = K.take_pad(extra_l, epos, jnp.int32(-1))
+
+        def ga(x):
+            return jax.lax.all_gather(x, "shards").reshape(-1)
+
+        return ga(row), ga(eid), ga(nbr)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("shards", None),
+            P("shards", None),
+            P("shards", None),
+            P(None),
+        ),
+        out_specs=(P(None), P(None), P(None)),
+        check_vma=False,
+    )(ind_sh, nbr_sh, extra_sh, srcs)
+
+
+def sharded_bitmap_hop(
+    mesh: Mesh, act_sh, emit_sh, eid_sh, emask_global, frontier
+) -> jnp.ndarray:
+    """One variable-depth frontier hop over the sharded edge list: each
+    shard scatter-ORs its edge slice's activations, and the [C, vb] bitmaps
+    merge with a psum over the shards axis (SURVEY.md §5.7)."""
+
+    def local(act_l, emit_l, eid_l, emask_rep, frontier_rep):
+        act_l, emit_l, eid_l = act_l[0], emit_l[0], eid_l[0]
+        em = K.take_pad(emask_rep, eid_l, False) & (act_l >= 0)
+        contrib = K.bitmap_hop(act_l, emit_l, em, frontier_rep)
+        return jax.lax.psum(contrib.astype(jnp.int32), "shards") > 0
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("shards", None),
+            P("shards", None),
+            P("shards", None),
+            P(None),
+            P(None, None),
+        ),
+        out_specs=P(None, None),
+        check_vma=False,
+    )(act_sh, emit_sh, eid_sh, emask_global, frontier)
+
+
+def sharded_weight_pass(
+    mesh: Mesh, seg_sh, emit_sh, eid_sh, emask_global, dst_ok_global, w, vb: int
+):
+    """One COUNT-pushdown weight pass over the sharded edge list:
+    ``new_w[v] = Σ_{local edges v→u} emask(e)·dst_ok(u)·w[u]`` per shard,
+    psum-merged. ``dst_ok_global`` is the destination node-admission mask
+    over the vertex universe (replicated); ``w`` [vb] carries the weights
+    of the level below (all-ones for the last hop)."""
+
+    def local(seg_l, emit_l, eid_l, emask_rep, ok_rep, w_rep):
+        seg_l, emit_l, eid_l = seg_l[0], emit_l[0], eid_l[0]
+        em = K.take_pad(emask_rep, eid_l, False) & (seg_l >= 0)
+        ok = K.take_pad(ok_rep, emit_l, False)
+        vals = (em & ok).astype(w_rep.dtype) * K.take_pad(
+            w_rep, emit_l, jnp.zeros((), w_rep.dtype)
+        )
+        part = jax.ops.segment_sum(
+            vals, jnp.clip(seg_l, 0, vb - 1), num_segments=vb
+        )
+        return jax.lax.psum(part, "shards")
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("shards", None),
+            P("shards", None),
+            P("shards", None),
+            P(None),
+            P(None),
+            P(None),
+        ),
+        out_specs=P(None),
+        check_vma=False,
+    )(seg_sh, emit_sh, eid_sh, emask_global, dst_ok_global, w)

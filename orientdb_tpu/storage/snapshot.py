@@ -109,6 +109,7 @@ class EdgeClassCSR:
         "non_columnar",
         "out_degree_max",
         "in_degree_max",
+        "_edge_src",
     )
 
     def __init__(self, class_name: str):
@@ -127,6 +128,17 @@ class EdgeClassCSR:
     @property
     def num_edges(self) -> int:
         return int(self.dst.shape[0])
+
+    def edge_src_np(self) -> np.ndarray:
+        """Per-edge source vertex in out-CSR order (cached; shared by the
+        device edge-list form and the mesh-sharded slices)."""
+        cached = getattr(self, "_edge_src", None)
+        if cached is None:
+            cached = self._edge_src = np.repeat(
+                np.arange(self.indptr_out.shape[0] - 1, dtype=np.int32),
+                np.diff(self.indptr_out),
+            )
+        return cached
 
 
 class GraphSnapshot:
@@ -157,6 +169,9 @@ class GraphSnapshot:
         #: edge class name (lower) → list of concrete edge class names
         self.edge_closure: Dict[str, List[str]] = {}
         self._device_cache = None
+        #: optional jax.sharding.Mesh — set via attach, consumed by
+        #: DeviceGraph to lay adjacency out shard-wise (parallel/mesh_graph)
+        self._mesh = None
 
     # -- lookups -----------------------------------------------------------
 
@@ -381,8 +396,12 @@ def build_snapshot(db: Database) -> GraphSnapshot:
     return snap
 
 
-def attach_fresh_snapshot(db: Database) -> GraphSnapshot:
-    """Build + attach in one step (convenience for the query front door)."""
+def attach_fresh_snapshot(db: Database, mesh=None) -> GraphSnapshot:
+    """Build + attach in one step (convenience for the query front door).
+
+    With ``mesh``, adjacency is additionally laid out shard-wise over the
+    mesh's ``shards`` axis and the compiled engine executes every
+    expansion under shard_map (`orientdb_tpu/parallel/mesh_graph.py`)."""
     snap = build_snapshot(db)
-    db.attach_snapshot(snap)
+    db.attach_snapshot(snap, mesh=mesh)
     return snap

@@ -1,0 +1,97 @@
+"""Mesh-sharded MATCH execution parity.
+
+The real compiled engine over a device mesh (SURVEY.md §2 "Distributed"
+redesigned TPU-first): adjacency row-sharded over the mesh's ``shards``
+axis, expansions under shard_map with all_gather (binding tables) / psum
+(bitmaps, pushdown weights) merges. Every query here runs through
+``db.query(engine="tpu", strict=True)`` on an 8-CPU mesh and must match
+the oracle AND the unsharded single-device engine row-for-row.
+"""
+
+import pytest
+
+from orientdb_tpu.parallel.sharded import make_mesh
+from orientdb_tpu.storage.ingest import generate_demodb
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+
+def canon(rows):
+    return sorted(tuple(sorted(r.items())) for r in rows)
+
+
+QUERIES = [
+    # BASELINE config #1 shape: 1-hop RETURN p, f
+    "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN p.uid AS p, f.uid AS f",
+    # predicates both ends
+    "MATCH {class:Profiles, as:p, where:(age > 40)}-HasFriend->"
+    "{as:f, where:(age < 30)} RETURN p.uid AS p, f.uid AS f",
+    # 2-hop COUNT (pushdown path, sharded weight passes)
+    "MATCH {class:Profiles, as:p, where:(age > 40)}-HasFriend->{as:f}"
+    "-HasFriend->{as:g, where:(age < 30)} RETURN count(*) AS n",
+    # reversed + both directions
+    "MATCH {class:Profiles, as:p, where:(uid < 40)}<-HasFriend-{as:f} "
+    "RETURN p.uid AS p, f.uid AS f",
+    "MATCH {class:Profiles, as:p, where:(uid < 15)}-HasFriend-{as:f} "
+    "RETURN p.uid AS p, f.uid AS f",
+    # BASELINE config #2 shape: variable-depth WHILE (sharded bitmap hops)
+    "MATCH {class:Profiles, as:p, where:(uid < 10)}-HasFriend->"
+    "{as:f, while:($depth < 3)} RETURN p.uid AS p, f.uid AS f",
+    # edge-property WHERE
+    "MATCH {class:Profiles, as:p}-{class:Likes, where:(weight > 3)}->{as:t} "
+    "RETURN p.uid AS p, t.uid AS t",
+    # OPTIONAL left-join over the sharded expansion
+    "MATCH {class:Profiles, as:p, where:(uid < 12)}-Likes->"
+    "{as:t, optional:true} RETURN p.uid AS p, t.uid AS t",
+]
+
+
+@pytest.fixture(scope="module")
+def dbs():
+    db_sharded = generate_demodb(n_profiles=300, avg_friends=5, seed=7)
+    mesh = make_mesh(8, replicas=2)  # 2D mesh: replicas axis must be inert
+    attach_fresh_snapshot(db_sharded, mesh=mesh)
+    db_single = generate_demodb(n_profiles=300, avg_friends=5, seed=7)
+    attach_fresh_snapshot(db_single)
+    return db_sharded, db_single
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_sharded_matches_oracle_and_single_device(dbs, sql):
+    db_sharded, db_single = dbs
+    sh = canon(db_sharded.query(sql, engine="tpu", strict=True).to_dicts())
+    single = canon(db_single.query(sql, engine="tpu", strict=True).to_dicts())
+    oracle = canon(db_single.query(sql, engine="oracle").to_dicts())
+    assert sh == oracle
+    assert single == oracle
+
+
+def test_sharded_replay_cache(dbs):
+    """Second execution goes through the jitted sharded replay."""
+    db_sharded, _ = dbs
+    sql = QUERIES[2]
+    first = db_sharded.query(sql, engine="tpu", strict=True).to_dicts()
+    again = db_sharded.query(sql, engine="tpu", strict=True).to_dicts()
+    assert first == again
+
+
+def test_sharded_batch(dbs):
+    db_sharded, db_single = dbs
+    rss = db_sharded.query_batch(QUERIES[:3], engine="tpu", strict=True)
+    for sql, rs in zip(QUERIES[:3], rss):
+        assert canon(rs.to_dicts()) == canon(
+            db_single.query(sql, engine="oracle").to_dicts()
+        )
+
+
+def test_adjacency_is_actually_sharded(dbs):
+    """The CSR buffers must live shard-per-device, not replicated."""
+    db_sharded, _ = dbs
+    snap = db_sharded.current_snapshot()
+    dg = snap._device_cache
+    assert dg is not None and dg.mesh_graph is not None
+    key = next(k for k in dg.arrays if k.startswith("sh:") and k.endswith("out:indptr"))
+    arr = dg.arrays[key]
+    # row dim split over the 4 shards of the (2, 4) mesh
+    assert arr.sharding.spec[0] == "shards"
+    shard_rows = {s.data.shape[0] for s in arr.addressable_shards}
+    assert shard_rows == {arr.shape[0] // 4}
