@@ -72,12 +72,18 @@ def main() -> None:
         "-HasFriend->{as:f, while:($depth < 3), where:(age < 30)} "
         "RETURN count(*) AS n"
     )
+    # TRAVERSE (BASELINE config #4 shape): bitmap-BFS with depth gate
+    sql_trav = (
+        "TRAVERSE out('HasFriend') FROM (SELECT FROM Profiles WHERE uid < 50) "
+        "WHILE $depth < 2 STRATEGY BREADTH_FIRST"
+    )
 
     def run(engine, q=sql):
         return db.query(q, engine=engine, strict=(engine == "tpu")).to_dicts()
 
-    # parity gates before timing (result-set parity is part of the metric)
-    for q in (sql, sql_rows, sql_var):
+    # parity gates before timing (result-set parity is part of the metric);
+    # TRAVERSE rows are records, so canon compares @rid dicts
+    for q in (sql, sql_rows, sql_var, sql_trav):
         if canon(run("tpu", q)) != canon(run("oracle", q)):
             print(
                 json.dumps(
@@ -113,6 +119,7 @@ def main() -> None:
     batched_qps = time_batched(sql)
     rows_qps = time_batched(sql_rows)
     var_qps = time_batched(sql_var)
+    trav_qps = time_batched(sql_trav)
 
     t0 = time.perf_counter()
     for _ in range(oracle_iters):
@@ -131,6 +138,7 @@ def main() -> None:
                     "single_query_qps": round(single_qps, 3),
                     "rows_1hop_batched_qps": round(rows_qps, 3),
                     "var_depth_while_batched_qps": round(var_qps, 3),
+                    "traverse_bfs_batched_qps": round(trav_qps, 3),
                     "oracle_2hop_qps": round(oracle_qps, 4),
                     "graph": {
                         "profiles": n_profiles,

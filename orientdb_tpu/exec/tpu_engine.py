@@ -49,6 +49,7 @@ from orientdb_tpu.exec.oracle import (
     _REVERSE_DIR,
 )
 from orientdb_tpu.exec.result import Result
+from orientdb_tpu.models.record import Document
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.ops import csr as K
 from orientdb_tpu.ops.device_graph import DeviceGraph, device_graph
@@ -146,6 +147,15 @@ def _pad_concat(segs: List[jnp.ndarray], width: int) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 # size schedule (the compiled-plan-cache mechanism)
 # ---------------------------------------------------------------------------
+
+
+def _observe_compact(sched: "SizeSchedule", mask):
+    """Shared compaction protocol: surviving-row indices sized via the
+    schedule (one blocking sync on the recording run, free on replay).
+    Returns (indices, host count, device count)."""
+    count_dev = K.mask_count(mask)
+    count = sched.observe(count_dev)
+    return K.compact_indices(mask, K.bucket(count)), count, count_dev
 
 
 class SizeSchedule:
@@ -283,6 +293,49 @@ def build_plan(pattern: Pattern, interp: MatchInterpreter) -> List[PlanStep]:
         bound.add(e.from_alias)
         bound.add(e.to_alias)
     return steps
+
+
+# ---------------------------------------------------------------------------
+# shared bitmap-hop construction (variable-depth MATCH and TRAVERSE)
+# ---------------------------------------------------------------------------
+
+
+def build_bitmap_hops(dg: DeviceGraph, items) -> List:
+    """Frontier-hop closures for ``(class, direction, emask)`` items.
+
+    Each closure maps a ``[C, vb]`` frontier bitmap to the bitmap of
+    vertices reached over that class+direction. Mesh-sharded graphs hop
+    via the sharded edge-list slices with a psum-OR merge over the shards
+    axis (SURVEY.md §5.7); single-device graphs scatter over the flat
+    edge list. ``emask`` is an optional [E] per-edge prefilter in
+    out-CSR order (fused edge WHERE)."""
+    mg = dg.mesh_graph
+    hops = []
+    for cname, d, emask in items:
+        dec = dg.edges[cname]
+        m = emask if emask is not None else jnp.ones(dec.num_edges, bool)
+        if mg is None:
+            if d == "out":
+                a, em = dec.edge_src, dec.dst
+            else:  # follow edges backwards: activate dst, emit src
+                a, em = dec.dst, dec.edge_src
+            hops.append(
+                lambda fr, a=a, em=em, m=m: K.bitmap_hop(a, em, m, fr)
+            )
+        else:
+            from orientdb_tpu.parallel.mesh_graph import sharded_bitmap_hop
+
+            p = mg.edge[cname].prefix
+            src_sh = dg.arrays[f"{p}:el:src"]
+            dst_sh = dg.arrays[f"{p}:el:dst"]
+            eid_sh = dg.arrays[f"{p}:el:eid"]
+            a_sh, e_sh = (src_sh, dst_sh) if d == "out" else (dst_sh, src_sh)
+            hops.append(
+                lambda fr, a=a_sh, em=e_sh, i=eid_sh, m=m, mesh=mg.mesh: (
+                    sharded_bitmap_hop(mesh, a, em, i, m, fr)
+                )
+            )
+    return hops
 
 
 # ---------------------------------------------------------------------------
@@ -427,11 +480,7 @@ class TpuMatchSolver:
     # -- execution ----------------------------------------------------------
 
     def _compact(self, mask):
-        """Surviving-row indices; size via the schedule (sync on record,
-        free on replay). Returns (indices, host count, device count)."""
-        count_dev = K.mask_count(mask)
-        count = self.sched.observe(count_dev)
-        return K.compact_indices(mask, K.bucket(count)), count, count_dev
+        return _observe_compact(self.sched, mask)
 
     def _expand_csr(self, indptr, nbrs, srcs):
         counts = K.degree_counts(indptr, srcs)
@@ -853,47 +902,18 @@ class TpuMatchSolver:
         univ = jnp.arange(vb, dtype=jnp.int32)
         univ = jnp.where(univ < V, univ, -1)
         node_mask_vec = self._node_masks[dst_alias](univ)  # [vb]
-        # per-(class, dir) edge hop closures; edge WHERE fused as edge
-        # masks. Mesh-sharded graphs hop via the sharded edge-list slices
-        # with a psum-OR bitmap merge over the shards axis.
+        # per-(class, dir) edge hop closures; edge WHERE fused as edge masks
         f = item.edge_filter
-        mg = self.dg.mesh_graph
-        hops = []
+        items = []
         for cname in self._resolve_edge_classes(item):
             dec = self.dg.edges[cname]
-            E = dec.num_edges
-            eids = jnp.arange(E, dtype=jnp.int32)
-            emask = (
-                self._edge_where(cname, f.where)(eids, {})
-                if (f is not None and f.where is not None)
-                else jnp.ones(E, bool)
-            )
+            emask = None
+            if f is not None and f.where is not None:
+                eids = jnp.arange(dec.num_edges, dtype=jnp.int32)
+                emask = self._edge_where(cname, f.where)(eids, {})
             for d in ("out", "in") if direction == "both" else (direction,):
-                if mg is None:
-                    if d == "out":
-                        a, em = dec.edge_src, dec.dst
-                    else:  # follow edges backwards: activate dst, emit src
-                        a, em = dec.dst, dec.edge_src
-                    hops.append(
-                        lambda fr, a=a, em=em, m=emask: K.bitmap_hop(a, em, m, fr)
-                    )
-                else:
-                    from orientdb_tpu.parallel.mesh_graph import (
-                        sharded_bitmap_hop,
-                    )
-
-                    p = mg.edge[cname].prefix
-                    src_sh = self.dg.arrays[f"{p}:el:src"]
-                    dst_sh = self.dg.arrays[f"{p}:el:dst"]
-                    eid_sh = self.dg.arrays[f"{p}:el:eid"]
-                    a_sh, e_sh = (
-                        (src_sh, dst_sh) if d == "out" else (dst_sh, src_sh)
-                    )
-                    hops.append(
-                        lambda fr, a=a_sh, em=e_sh, i=eid_sh, m=emask: (
-                            sharded_bitmap_hop(mg.mesh, a, em, i, m, fr)
-                        )
-                    )
+                items.append((cname, d, emask))
+        hops = build_bitmap_hops(self.dg, items)
         parts: List[Table] = []
         counts: List[int] = []
         width = table.width or 1
@@ -1201,6 +1221,195 @@ class TpuMatchSolver:
 
 
 # ---------------------------------------------------------------------------
+# TRAVERSE compilation
+# ---------------------------------------------------------------------------
+
+
+class TpuTraverseSolver:
+    """Compiled TRAVERSE: bitmap-BFS levels over the device CSR.
+
+    The reference walks TRAVERSE per-record with a visited set ([E]
+    OTraverseStatement → Depth/BreadthFirstTraverseStep, SURVEY.md §1
+    layer 5); here each level is ONE frontier bitmap hop over the whole
+    graph (psum-OR merged across mesh shards when sharded), with
+    MAXDEPTH / WHILE($depth, fields) applied as level masks.
+
+    Semantics vs the oracle (`oracle.execute_traverse`):
+    - BREADTH_FIRST pops FIFO, so every record is admitted at its minimum
+      discovery depth — exactly what level-wise bitmap BFS computes; the
+      result SET matches the oracle, while within-level order is vertex
+      index order (the oracle's is parent-enumeration order; TRAVERSE
+      order within a level is enumeration-defined in the reference too).
+    - DEPTH_FIRST admits records at possibly non-minimal depths, so it
+      compiles only when no MAXDEPTH/WHILE can observe the difference —
+      then the result set is the plain reachability closure.
+    - LIMIT slices in traversal order → always falls back to the oracle.
+
+    Fields compile for out()/in()/both() with literal class names (or
+    none); '*' / outE/inE/bothE / link fields emit edge documents and
+    fall back.
+    """
+
+    def __init__(self, db, stmt: A.TraverseStatement, params: Dict) -> None:
+        self.db = db
+        self.stmt = stmt
+        self.params = params or {}
+        snap = db.current_snapshot(require_fresh=True)
+        if snap is None:
+            raise Uncompilable("no fresh snapshot attached")
+        self.snap = snap
+        self.dg: DeviceGraph = device_graph(snap)
+        self.sched = SizeSchedule()
+        if stmt.limit is not None:
+            raise Uncompilable("TRAVERSE LIMIT slices in traversal order")
+        if stmt.strategy == "DEPTH_FIRST" and (
+            stmt.max_depth is not None or stmt.while_cond is not None
+        ):
+            raise Uncompilable(
+                "DEPTH_FIRST with MAXDEPTH/WHILE admits at non-minimal depths"
+            )
+        self.hop_items = self._compile_fields(stmt.fields)
+        self.while_fn = None
+        if stmt.while_cond is not None:
+            scope = ColumnScope(self.dg.columns, self.dg.non_columnar)
+            self.while_fn = compile_predicate(
+                stmt.while_cond, scope, self.params, allow_depth=True
+            )
+        self.roots = self._resolve_roots()
+
+    def _compile_fields(self, fields) -> List[Tuple[str, str, None]]:
+        dirs: List[Tuple[str, Optional[str]]] = []
+        if not fields:
+            raise Uncompilable("TRAVERSE * follows edges as records")
+        for f in fields:
+            if not isinstance(f, A.FunctionCall):
+                raise Uncompilable("TRAVERSE field is not out()/in()/both()")
+            name = f.name.lower()
+            if name not in ("out", "in", "both"):
+                raise Uncompilable(f"TRAVERSE {name}() emits non-vertex records")
+            classes: List[Optional[str]] = []
+            if not f.args:
+                classes.append(None)
+            for a in f.args:
+                if not (isinstance(a, A.Literal) and isinstance(a.value, str)):
+                    raise Uncompilable("non-literal edge class in TRAVERSE field")
+                classes.append(a.value)
+            for cls in classes:
+                dirs.append((name, cls))
+        items = []
+        for direction, cls in dirs:
+            for cname in self.snap.concrete_edge_classes(cls):
+                for d in ("out", "in") if direction == "both" else (direction,):
+                    items.append((cname, d, None))
+        return items
+
+    def _resolve_roots(self) -> np.ndarray:
+        """Root record → dense vertex indices, via the oracle's target
+        resolution (host-side; supports class / rid / subquery targets)."""
+        from orientdb_tpu.exec.oracle import resolve_target_rows
+
+        base_ctx = EvalContext(self.db, params=self.params)
+        idxs: List[int] = []
+        for row in resolve_target_rows(self.db, self.stmt.target, base_ctx):
+            doc = row if isinstance(row, Document) else (
+                row.element if isinstance(row, Result) else None
+            )
+            if doc is None:
+                continue
+            i = self.snap.idx_of(doc.rid)
+            if i is None:
+                raise Uncompilable("TRAVERSE root is not a snapshot vertex")
+            idxs.append(i)
+        # preserve first-occurrence order for depth-0 emission; BFS admits
+        # each root once
+        seen = set()
+        uniq = [i for i in idxs if not (i in seen or seen.add(i))]
+        return np.asarray(uniq, np.int32)
+
+    def solve(self) -> Tuple[jnp.ndarray, int]:
+        """Returns (emitted vertex indices [bucketed], emitted count),
+        level by level (depth-0 roots first, then each BFS level)."""
+        V = self.dg.num_vertices
+        vb = K.bucket(max(V, 1))
+        univ = jnp.arange(vb, dtype=jnp.int32)
+        univ = jnp.where(univ < V, univ, -1)
+        hops = build_bitmap_hops(self.dg, self.hop_items)
+        # one logical traversal row: [1, vb] bitmap with every root set
+        roots = jnp.zeros((1, vb), bool)
+        if self.roots.shape[0]:
+            roots = roots.at[0, jnp.asarray(self.roots)].set(True)
+        visited = roots
+        frontier = roots
+        depth = 0
+        # depth-0 emits the caller's root order (host-known), not index order
+        parts: List[jnp.ndarray] = [jnp.asarray(self.roots)]
+        counts: List[int] = [int(self.roots.shape[0])]
+        max_depth = self.stmt.max_depth
+        while True:
+            if max_depth is not None and depth >= max_depth:
+                break
+            nxt = jnp.zeros_like(frontier)
+            for hop in hops:
+                nxt = nxt | hop(frontier)
+            nxt = nxt & ~visited
+            if self.while_fn is not None:
+                gate = self.while_fn(univ, {"depth": depth + 1})
+                nxt = nxt & gate[None, :]
+            keep, kn, _dev = _observe_compact(self.sched, nxt.reshape(-1))
+            if kn == 0:
+                break
+            visited = visited | nxt
+            depth += 1
+            parts.append(keep)
+            counts.append(kn)
+            frontier = nxt
+            if depth > V:  # safety: no min-depth exceeds |V|
+                break
+        total = sum(counts)
+        width = K.bucket(max(total, 1))
+        idx = _pad_concat([p[:c] for p, c in zip(parts, counts)], width)
+        return idx, total
+
+    def rows_from(self, idx: np.ndarray, count: int) -> List[Result]:
+        out: List[Result] = []
+        for i in np.asarray(idx)[:count]:
+            doc = self.db.load(self.snap.rid_of(int(i)))
+            if doc is not None:
+                out.append(Result(element=doc))
+        return out
+
+
+class _CompiledTraverse:
+    """Replayable TRAVERSE plan (same dispatch/materialize protocol as
+    `_CompiledPlan` so `execute_batch` treats both uniformly)."""
+
+    def __init__(self, solver: TpuTraverseSolver, count: int) -> None:
+        self.solver = solver
+        self.count = count
+        self.jitted = jax.jit(self._replay)
+
+    def _replay(self, arrays):
+        dg = self.solver.dg
+        saved = dg.arrays
+        dg.arrays = arrays
+        try:
+            self.solver.sched.start_replay()
+            idx, _n = self.solver.solve()
+        finally:
+            dg.arrays = saved
+        return idx
+
+    def dispatch(self):
+        return self.jitted(self.solver.dg.arrays)
+
+    def materialize(self, dev) -> List[Result]:
+        return self.solver.rows_from(np.asarray(dev), self.count)
+
+    def rows(self) -> List[Result]:
+        return self.materialize(self.dispatch())
+
+
+# ---------------------------------------------------------------------------
 # compiled plan cache ([E] OExecutionPlanCache analog)
 # ---------------------------------------------------------------------------
 
@@ -1319,12 +1528,12 @@ def _cache_key(stmt, params) -> Optional[Tuple]:
         return None
 
 
-def _prepare(db, stmt, params) -> Tuple[Optional[_CompiledPlan], Optional[List[Result]]]:
+def _prepare(db, stmt, params):
     """Plan-cache lookup, compiling (and executing) on miss.
 
     Returns ``(plan, None)`` on a cache hit — the caller dispatches — or
     ``(None, rows)`` when this call WAS the recording first execution."""
-    if not isinstance(stmt, A.MatchStatement):
+    if not isinstance(stmt, (A.MatchStatement, A.TraverseStatement)):
         raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
     params = params or {}
     snap = db.current_snapshot(require_fresh=True)
@@ -1337,13 +1546,20 @@ def _prepare(db, stmt, params) -> Tuple[Optional[_CompiledPlan], Optional[List[R
         if plan is not None:
             cache.move_to_end(key)  # LRU: keep hot plans
             return plan, None
-    solver = TpuMatchSolver(db, stmt, params)
-    table = solver.solve_table()
-    rows = solver.rows_from_table(table)
+    if isinstance(stmt, A.MatchStatement):
+        solver = TpuMatchSolver(db, stmt, params)
+        table = solver.solve_table()
+        rows = solver.rows_from_table(table)
+        plan_obj = _CompiledPlan(solver, table)
+    else:
+        tsolver = TpuTraverseSolver(db, stmt, params)
+        idx, total = tsolver.solve()
+        rows = tsolver.rows_from(np.asarray(idx), total)
+        plan_obj = _CompiledTraverse(tsolver, total)
     if key is not None and config.plan_cache_size > 0:
         while len(cache) >= config.plan_cache_size:
             cache.popitem(last=False)
-        cache[key] = _CompiledPlan(solver, table)
+        cache[key] = plan_obj
     return None, rows
 
 

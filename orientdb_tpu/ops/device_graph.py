@@ -52,7 +52,14 @@ class DeviceColumn:
 
 
 class DeviceEdgeClass:
-    """One edge class's CSR adjacency (both directions) in HBM."""
+    """One edge class's CSR adjacency (both directions) in HBM.
+
+    On a mesh-sharded graph the flat adjacency is NOT uploaded — every
+    mesh execution path reads the ``sh:*`` shard-wise layout instead
+    (`orientdb_tpu/parallel/mesh_graph.py`), and uploading both would
+    leave per-device HBM at O(E·(1+1/S)) instead of O(E/S). Edge property
+    columns stay replicated either way (predicate gathers run on every
+    device)."""
 
     __slots__ = ("class_name", "columns", "non_columnar", "num_edges", "_g", "_p")
 
@@ -60,14 +67,15 @@ class DeviceEdgeClass:
         self.class_name = csr.class_name
         self._g = g
         p = self._p = f"e:{csr.class_name}"
-        g._put(f"{p}:indptr_out", csr.indptr_out)
-        g._put(f"{p}:dst", csr.dst)
-        # per-edge source vertex in out-CSR order (bitmap-hop kernels index
-        # edges directly instead of walking indptr)
-        g._put(f"{p}:edge_src", csr.edge_src_np())
-        g._put(f"{p}:indptr_in", csr.indptr_in)
-        g._put(f"{p}:src", csr.src)
-        g._put(f"{p}:edge_id_in", csr.edge_id_in)
+        if g.mesh_graph is None:
+            g._put(f"{p}:indptr_out", csr.indptr_out)
+            g._put(f"{p}:dst", csr.dst)
+            # per-edge source vertex in out-CSR order (bitmap-hop kernels
+            # index edges directly instead of walking indptr)
+            g._put(f"{p}:edge_src", csr.edge_src_np())
+            g._put(f"{p}:indptr_in", csr.indptr_in)
+            g._put(f"{p}:src", csr.src)
+            g._put(f"{p}:edge_id_in", csr.edge_id_in)
         self.columns: Dict[str, DeviceColumn] = {
             n: DeviceColumn(c, g, f"{p}:c:{n}") for n, c in csr.edge_columns.items()
         }
