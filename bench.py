@@ -22,10 +22,20 @@ the oracle interpreter — the same role the single-node Java MATCH executor
 plays in BASELINE.json config #2 — and ratios are vs-Python until the
 reference appears; BASELINE.md records this.
 
+`extras.ldbc_is` reports per-query batched throughput for the LDBC SNB
+interactive short reads IS1–IS7 (BASELINE configs[2]; SURVEY.md §6 row 3)
+on an SF1-shaped SNB graph, parity-gated the same way. Each query is
+timed with ONE fixed parameter value per batch — compiled plans are
+currently cached per (statement, parameter values), so varying the
+parameter across the batch would time plan compilation, not execution
+(parameter-generic plans are the planned fix; broad parameter coverage
+is tested in tests/test_ldbc_is.py).
+
 Env knobs: BENCH_PROFILES (default 20000), BENCH_AVG_FRIENDS (10),
 BENCH_BATCH (64), BENCH_ITERS (3 batched iterations), BENCH_SINGLE_ITERS
 (10), BENCH_ORACLE_ITERS (1 — the oracle takes ~13 s per 2-hop query at
-the default size).
+the default size), BENCH_SNB_PERSONS (default 10000; 0 skips the IS
+section).
 """
 
 import json
@@ -121,6 +131,59 @@ def main() -> None:
     var_qps = time_batched(sql_var)
     trav_qps = time_batched(sql_trav)
 
+    # LDBC SNB interactive short reads (IS1–IS7) on an SF1-shaped graph
+    snb_persons = int(os.environ.get("BENCH_SNB_PERSONS", "10000"))
+    ldbc_is = {}
+    if snb_persons > 0:
+        from orientdb_tpu.storage.ingest import generate_ldbc_snb
+        from orientdb_tpu.workloads.ldbc import IS_QUERIES
+
+        snb = generate_ldbc_snb(n_persons=snb_persons, seed=13)
+        attach_fresh_snapshot(snb)
+        # posts + comments share one contiguous id space starting at 0
+        n_messages = snb.count_class("Post") + snb.count_class("Comment")
+
+        def is_params(q, i):
+            if ":personId" in q:
+                return {"personId": (i * 37) % snb_persons}
+            return {"messageId": (i * 101) % n_messages}
+
+        for name in sorted(IS_QUERIES):
+            q = IS_QUERIES[name]
+            p = is_params(q, 5)
+            # parity gate on the timed parameter (broad parameter coverage
+            # lives in tests/test_ldbc_is.py; compiling one plan per
+            # parameter value here would turn the bench into a compile
+            # benchmark — see the plan-cache note in SURVEY.md §5)
+            o = snb.query(q, params=p, engine="oracle").to_dicts()
+            t = snb.query(q, params=p, engine="tpu", strict=True).to_dicts()
+            if ("ORDER BY" in q and o != t) or (
+                "ORDER BY" not in q and canon(o) != canon(t)
+            ):
+                print(
+                    json.dumps(
+                        {
+                            "metric": "demodb_match_2hop_count_qps",
+                            "value": 0.0,
+                            "unit": "queries/sec",
+                            "vs_baseline": 0.0,
+                            "error": f"IS parity mismatch: {name} {p}",
+                        }
+                    )
+                )
+                sys.exit(1)
+            qs = [q] * batch
+            plist = [p] * batch
+            snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)  # warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                rss = snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)
+                for rs in rss:
+                    rs.to_dicts()
+            ldbc_is[name] = round(
+                (iters * batch) / (time.perf_counter() - t0), 3
+            )
+
     t0 = time.perf_counter()
     for _ in range(oracle_iters):
         run("oracle")
@@ -139,6 +202,8 @@ def main() -> None:
                     "rows_1hop_batched_qps": round(rows_qps, 3),
                     "var_depth_while_batched_qps": round(var_qps, 3),
                     "traverse_bfs_batched_qps": round(trav_qps, 3),
+                    "ldbc_is": ldbc_is,
+                    "snb_persons": snb_persons,
                     "oracle_2hop_qps": round(oracle_qps, 4),
                     "graph": {
                         "profiles": n_profiles,

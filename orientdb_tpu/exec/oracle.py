@@ -774,11 +774,35 @@ class MatchInterpreter:
     def solve(self) -> Iterator[Dict[str, object]]:
         required = [e for e in self.pattern.edges if not self._edge_is_optional(e)]
         optionals = [e for e in self.pattern.edges if self._edge_is_optional(e)]
-        # aliases not touched by any REQUIRED edge still need candidate
-        # enumeration (isolated nodes, and the from-side of optional-only
-        # arms) — nodes created only for NOT-arm sharing (no filters) are
-        # skipped
-        isolated = [
+        isolated = self.enumerable_isolated(required, optionals)
+        for bindings in self._solve_required(required, isolated, {}):
+            for full in self._solve_optionals(optionals, bindings):
+                if self._not_arms_ok(full):
+                    yield full
+
+    def enumerable_isolated(
+        self, required: List[PatternEdge], optionals: List[PatternEdge]
+    ) -> List[PatternNode]:
+        """Nodes needing up-front candidate enumeration: not touched by any
+        REQUIRED edge (isolated nodes, and the from-side of optional-only
+        arms), but excluding
+        - optional nodes (they bind null when unmatched),
+        - filterless nodes created only for NOT-arm sharing,
+        - aliases bound as a side effect of some arm's edge braces
+          ({as:kn} between the dashes) — they bind when their arm runs,
+        - targets of optional arms: enumerating a filtered target of an
+          arm-optional probe would turn the left join into a cross product
+          (the probe must *bind* it, nulling on no-match).
+
+        This is the shared admission rule — the TPU planner replays it, so
+        any edit here is an engine-parity change."""
+        arm_bound = {
+            e.item.edge_filter.alias
+            for e in self.pattern.edges
+            if e.item.edge_filter is not None and e.item.edge_filter.alias
+        }
+        opt_targets = {e.to_alias for e in optionals}
+        return [
             n
             for n in self.pattern.nodes.values()
             if not any(
@@ -786,14 +810,22 @@ class MatchInterpreter:
             )
             and not n.optional
             and n.filters
+            and n.alias not in arm_bound
+            and n.alias not in opt_targets
         ]
-        for bindings in self._solve_required(required, isolated, {}):
-            for full in self._solve_optionals(optionals, bindings):
-                if self._not_arms_ok(full):
-                    yield full
 
     def _edge_is_optional(self, e: PatternEdge) -> bool:
-        return self.pattern.nodes[e.to_alias].optional
+        # node-level (reference semantics: an optional target binds null
+        # when unmatched) or arm-level — `optional:true` inside the edge
+        # braces marks just this arm as a left join, so a cyclic arm
+        # between two required aliases can probe edge existence (the IS7
+        # "knows" flag) without making either endpoint optional.
+        return self.pattern.nodes[e.to_alias].optional or self._arm_optional(e)
+
+    @staticmethod
+    def _arm_optional(e: PatternEdge) -> bool:
+        f = e.item.edge_filter
+        return f is not None and f.optional
 
     def _solve_required(
         self,
@@ -941,7 +973,19 @@ class MatchInterpreter:
             yield from iter(results)
         else:
             nb = dict(bindings)
-            nb[e.to_alias if e.from_alias in bindings else e.from_alias] = None
+            both_bound = e.from_alias in bindings and e.to_alias in bindings
+            if not (both_bound and self._arm_optional(e)):
+                # node-optional: the undecided endpoint binds null. An
+                # arm-optional probe between two bound aliases must NOT
+                # overwrite either endpoint — only its own extras null.
+                nb[e.to_alias if e.from_alias in bindings else e.from_alias] = None
+            f = e.item.edge_filter
+            if f is not None and f.alias:
+                nb[f.alias] = None
+            if e.item.target.depth_alias:
+                nb[e.item.target.depth_alias] = None
+            if e.item.target.path_alias:
+                nb[e.item.target.path_alias] = None
             yield from self._solve_optionals(rest, nb)
 
     def _not_arms_ok(self, bindings: Dict[str, object]) -> bool:

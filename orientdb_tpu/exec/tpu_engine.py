@@ -254,20 +254,15 @@ def build_plan(pattern: Pattern, interp: MatchInterpreter) -> List[PlanStep]:
         f = e.item.edge_filter
         if f is not None and f.alias:
             bound.add(f.alias)
-    # isolated nodes (same admission rule as oracle.solve)
-    for n in pattern.nodes.values():
-        if (
-            not any(
-                e.from_alias == n.alias or e.to_alias == n.alias for e in required
-            )
-            and not n.optional
-            and n.filters
-            and n.alias not in bound
-        ):
-            if n.is_edge_alias:
-                raise Uncompilable("unbound edge alias would scan all edges")
-            steps.append(PlanStep("root", alias=n.alias))
-            bound.add(n.alias)
+    # isolated nodes: the shared admission rule lives in
+    # MatchInterpreter.enumerable_isolated so both engines stay in lockstep
+    for n in interp.enumerable_isolated(required, optionals):
+        if n.alias in bound:
+            continue
+        if n.is_edge_alias:
+            raise Uncompilable("unbound edge alias would scan all edges")
+        steps.append(PlanStep("root", alias=n.alias))
+        bound.add(n.alias)
     # optional edges: oracle picks (in list order) the first with a decided
     # endpoint; replay statically
     opts = list(optionals)
@@ -843,7 +838,12 @@ class TpuMatchSolver:
                 upart.count = un
                 upart.count_dev = un_dev
                 null_col = jnp.full(upart.width, -1, jnp.int32)
-                if step.close:
+                arm_opt = item.edge_filter is not None and item.edge_filter.optional
+                if step.close and arm_opt:
+                    # arm-optional probe between two bound aliases: both
+                    # endpoints survive; only the edge alias binds null
+                    pass
+                elif step.close:
                     # oracle: null src uses setdefault (keeps the bound dst);
                     # non-null src with no match explicitly nulls it
                     src_g = K.take_pad(srcs, ukeep, jnp.int32(-1))
@@ -980,7 +980,10 @@ class TpuMatchSolver:
                 upart.count = un
                 upart.count_dev = un_dev
                 null_col = jnp.full(upart.width, -1, jnp.int32)
-                if step.close:
+                arm_opt = item.edge_filter is not None and item.edge_filter.optional
+                if step.close and arm_opt:
+                    pass  # arm-optional probe: endpoints survive (see _expand)
+                elif step.close:
                     src_g = K.take_pad(srcs, ukeep, jnp.int32(-1))
                     upart.cols[dst_alias] = jnp.where(
                         src_g < 0, upart.cols[dst_alias], -1

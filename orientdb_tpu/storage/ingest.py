@@ -105,8 +105,19 @@ def generate_ldbc_snb(
     db: Optional[Database] = None,
     n_persons: int = 1000,
     seed: int = 11,
+    with_messages: bool = True,
 ) -> Database:
-    """Simplified LDBC SNB interactive graph (shape-faithful, offline)."""
+    """Simplified LDBC SNB interactive graph (shape-faithful, offline).
+
+    Covers the entity/edge subset the interactive *short reads* IS1–IS7
+    touch (BASELINE configs 3/5; SURVEY.md §6 row 3): Person/City/Tag plus,
+    when ``with_messages`` (default), the message layer — abstract Message
+    with Post/Comment subclasses, Forum — and the edges hasCreator
+    (Message→Person), replyOf (Comment→Message, forming reply trees rooted
+    at Posts), containerOf (Forum→Post), hasModerator (Forum→Person).
+    Message ids share one id space (posts first, then comments) so IS4–IS7
+    can address any message by ``id`` the way SNB parameters do.
+    """
     if db is None:
         db = Database("snb")
     rng = np.random.default_rng(seed)
@@ -155,16 +166,21 @@ def generate_ldbc_snb(
             )
         )
     # knows: power-law-ish degrees (Zipf capped), undirected modeled as one
-    # directed edge per pair (SNB stores one direction + symmetric query)
+    # directed edge per pair (SNB stores one direction + symmetric query) —
+    # the pair set dedup keeps reciprocal i↔t draws from emitting two edges,
+    # which would double-count friendships in undirected IS3/IS7 reads
     raw = rng.zipf(2.0, n_persons)
     degrees = np.minimum(raw, 50)
+    known_pairs = set()
     for i in range(n_persons):
         k = int(degrees[i])
         if k <= 0:
             continue
         targets = rng.choice(n_persons, size=min(k, n_persons - 1), replace=False)
         for t in targets:
-            if int(t) != i:
+            pair = (min(i, int(t)), max(i, int(t)))
+            if int(t) != i and pair not in known_pairs:
+                known_pairs.add(pair)
                 db.new_edge(
                     "knows",
                     persons[i],
@@ -178,10 +194,85 @@ def generate_ldbc_snb(
     for i in range(n_persons):
         for t in rng.choice(n_tags, size=int(n_interests[i]), replace=False):
             db.new_edge("hasInterest", persons[i], tags[int(t)])
+    if with_messages:
+        _generate_snb_messages(db, persons, rng)
     log.info(
         "snb-ish: %d persons, %d knows", n_persons, db.count_class("knows")
     )
     return db
+
+
+def _generate_snb_messages(db: Database, persons: List[Vertex], rng) -> None:
+    """Forum/Post/Comment layer for the IS1–IS7 short reads."""
+    n_persons = len(persons)
+    message = db.schema.create_vertex_class("Message", abstract=True)
+    for pname, pt in [
+        ("id", PropertyType.LONG),
+        ("content", PropertyType.STRING),
+        ("creationDate", PropertyType.LONG),
+        ("browserUsed", PropertyType.STRING),
+        ("locationIP", PropertyType.STRING),
+    ]:
+        message.create_property(pname, pt)
+    db.schema.create_class("Post", superclasses=["Message"])
+    db.schema.create_class("Comment", superclasses=["Message"])
+    forum = db.schema.create_vertex_class("Forum")
+    forum.create_property("id", PropertyType.LONG)
+    forum.create_property("title", PropertyType.STRING)
+    forum.create_property("creationDate", PropertyType.LONG)
+    db.schema.create_edge_class("hasCreator")
+    db.schema.create_edge_class("containerOf")
+    db.schema.create_edge_class("hasModerator")
+    db.schema.create_edge_class("replyOf")
+
+    browsers = ["Firefox", "Chrome", "Safari"]
+    n_forums = max(2, n_persons // 25)
+    n_posts = n_persons * 2
+    n_comments = n_posts * 2
+    forums: List[Vertex] = []
+    for i in range(n_forums):
+        f = db.new_vertex(
+            "Forum",
+            id=int(i),
+            title=f"forum{i}",
+            creationDate=int(rng.integers(2**28, 2**31 - 1)),
+        )
+        forums.append(f)
+        db.new_edge("hasModerator", f, persons[int(rng.integers(0, n_persons))])
+    # posts: ids [0, n_posts); comments continue the same id space —
+    # one message-id namespace, as SNB's substitution parameters assume
+    messages: List[Vertex] = []
+    post_forum = rng.integers(0, n_forums, n_posts)
+    post_creator = rng.integers(0, n_persons, n_posts)
+    for i in range(n_posts):
+        p = db.new_vertex(
+            "Post",
+            id=int(i),
+            content=f"post {i} text",
+            creationDate=int(rng.integers(2**28, 2**31 - 1)),
+            browserUsed=browsers[int(rng.integers(0, 3))],
+            locationIP=f"10.1.{i % 256}.{(i // 256) % 256}",
+        )
+        messages.append(p)
+        db.new_edge("containerOf", forums[int(post_forum[i])], p)
+        db.new_edge("hasCreator", p, persons[int(post_creator[i])])
+    # comments: each replies to a uniformly random earlier message, giving
+    # reply trees of expected logarithmic depth rooted at posts
+    comment_creator = rng.integers(0, n_persons, n_comments)
+    for j in range(n_comments):
+        mid = n_posts + j
+        parent = messages[int(rng.integers(0, len(messages)))]
+        c = db.new_vertex(
+            "Comment",
+            id=int(mid),
+            content=f"comment {mid} text",
+            creationDate=int(rng.integers(2**28, 2**31 - 1)),
+            browserUsed=browsers[int(rng.integers(0, 3))],
+            locationIP=f"10.2.{mid % 256}.{(mid // 256) % 256}",
+        )
+        messages.append(c)
+        db.new_edge("replyOf", c, parent)
+        db.new_edge("hasCreator", c, persons[int(comment_creator[j])])
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,1 @@
+from orientdb_tpu.workloads.ldbc import IS_QUERIES, is_query  # noqa: F401
