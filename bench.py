@@ -24,12 +24,11 @@ reference appears; BASELINE.md records this.
 
 `extras.ldbc_is` reports per-query batched throughput for the LDBC SNB
 interactive short reads IS1–IS7 (BASELINE configs[2]; SURVEY.md §6 row 3)
-on an SF1-shaped SNB graph, parity-gated the same way. Each query is
-timed with ONE fixed parameter value per batch — compiled plans are
-currently cached per (statement, parameter values), so varying the
-parameter across the batch would time plan compilation, not execution
-(parameter-generic plans are the planned fix; broad parameter coverage
-is tested in tests/test_ldbc_is.py).
+on an SF1-shaped SNB graph, parity-gated the same way. Parameters VARY
+across the batch the way the SNB driver issues them: numeric parameters
+are jit arguments of one cached parameter-generic plan
+(predicates.ParamBox), so each batch measures plan replay across many
+parameter values, not compilation.
 
 Env knobs: BENCH_PROFILES (default 20000), BENCH_AVG_FRIENDS (10),
 BENCH_BATCH (64), BENCH_ITERS (3 batched iterations), BENCH_SINGLE_ITERS
@@ -150,30 +149,29 @@ def main() -> None:
 
         for name in sorted(IS_QUERIES):
             q = IS_QUERIES[name]
-            p = is_params(q, 5)
-            # parity gate on the timed parameter (broad parameter coverage
-            # lives in tests/test_ldbc_is.py; compiling one plan per
-            # parameter value here would turn the bench into a compile
-            # benchmark — see the plan-cache note in SURVEY.md §5)
-            o = snb.query(q, params=p, engine="oracle").to_dicts()
-            t = snb.query(q, params=p, engine="tpu", strict=True).to_dicts()
-            if ("ORDER BY" in q and o != t) or (
-                "ORDER BY" not in q and canon(o) != canon(t)
-            ):
-                print(
-                    json.dumps(
-                        {
-                            "metric": "demodb_match_2hop_count_qps",
-                            "value": 0.0,
-                            "unit": "queries/sec",
-                            "vs_baseline": 0.0,
-                            "error": f"IS parity mismatch: {name} {p}",
-                        }
+            # parity gate on a few parameter values (broad coverage lives
+            # in tests/test_ldbc_is.py)
+            for i in (0, 5, 9):
+                p = is_params(q, i)
+                o = snb.query(q, params=p, engine="oracle").to_dicts()
+                t = snb.query(q, params=p, engine="tpu", strict=True).to_dicts()
+                if ("ORDER BY" in q and o != t) or (
+                    "ORDER BY" not in q and canon(o) != canon(t)
+                ):
+                    print(
+                        json.dumps(
+                            {
+                                "metric": "demodb_match_2hop_count_qps",
+                                "value": 0.0,
+                                "unit": "queries/sec",
+                                "vs_baseline": 0.0,
+                                "error": f"IS parity mismatch: {name} {p}",
+                            }
+                        )
                     )
-                )
-                sys.exit(1)
+                    sys.exit(1)
             qs = [q] * batch
-            plist = [p] * batch
+            plist = [is_params(q, i) for i in range(batch)]
             snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)  # warm
             t0 = time.perf_counter()
             for _ in range(iters):

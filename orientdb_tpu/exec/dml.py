@@ -52,6 +52,29 @@ def execute(db, stmt: A.Statement, params, parent_ctx=None) -> List[Result]:
         return [Result(props={"operation": "drop index"})]
     if isinstance(stmt, A.AlterPropertyStatement):
         return _alter_property(db, stmt, ctx)
+    if isinstance(stmt, A.CreateSequenceStatement):
+        s = db.sequences.create(
+            stmt.name, stmt.seq_type, stmt.start, stmt.increment, stmt.cache
+        )
+        return [Result(props={"operation": "create sequence", "name": s.name})]
+    if isinstance(stmt, A.AlterSequenceStatement):
+        s = db.sequences.alter(stmt.name, stmt.start, stmt.increment, stmt.cache)
+        return [Result(props={"operation": "alter sequence", "name": s.name})]
+    if isinstance(stmt, A.DropSequenceStatement):
+        db.sequences.drop(stmt.name)
+        return [Result(props={"operation": "drop sequence"})]
+    if isinstance(stmt, A.CreateFunctionStatement):
+        f = db.functions.create(
+            stmt.name,
+            stmt.body,
+            stmt.parameters,
+            language=stmt.language,
+            idempotent=stmt.idempotent,
+        )
+        return [Result(props={"operation": "create function", "name": f.name})]
+    if isinstance(stmt, A.DropFunctionStatement):
+        db.functions.drop(stmt.name)
+        return [Result(props={"operation": "drop function"})]
     if isinstance(stmt, (A.BeginStatement, A.CommitStatement, A.RollbackStatement)):
         from orientdb_tpu.exec import tx as _tx
 
@@ -360,4 +383,14 @@ def _alter_property(db, stmt: A.AlterPropertyStatement, ctx) -> List[Result]:
         prop.max_value = value
     else:
         raise CommandError(f"unsupported ALTER PROPERTY attribute {attr}")
+    if db.schema.on_ddl is not None:
+        db.schema.on_ddl(
+            {
+                "op": "alter_property",
+                "class": cls.name,
+                "name": prop.name,
+                "attribute": attr,
+                "value": value,
+            }
+        )
     return [Result(props={"operation": "alter property"})]

@@ -77,6 +77,8 @@ def _choose_engine(db, stmt: A.Statement, engine: Optional[str]) -> str:
 
 
 def _run(db, stmt: A.Statement, params, engine: Optional[str], strict: bool):
+    from orientdb_tpu.utils.metrics import metrics
+
     eng = _choose_engine(db, stmt, engine)
     if eng == "tpu":
         from orientdb_tpu.exec import tpu_engine
@@ -87,11 +89,15 @@ def _run(db, stmt: A.Statement, params, engine: Optional[str], strict: bool):
             # the only engine that applies the tx overlay
             if db.tx is not None:
                 raise tpu_engine.Uncompilable("active transaction on this thread")
-            return tpu_engine.execute(db, stmt, params), "tpu"
+            rows = tpu_engine.execute(db, stmt, params)
+            metrics.incr("query.tpu")
+            return rows, "tpu"
         except tpu_engine.Uncompilable as e:
             if strict:
                 raise
+            metrics.incr("query.tpu.fallback")
             log.info("tpu engine fallback to oracle: %s", e)
+    metrics.incr("query.oracle")
     from orientdb_tpu.exec.oracle import execute_statement
 
     return execute_statement(db, stmt, params), "oracle"

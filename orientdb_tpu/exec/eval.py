@@ -335,12 +335,31 @@ def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
     if name == "expand":
         # expand() outside projections behaves as identity on the collection
         return args[0]
+    if name == "sequence":
+        # sequence('s').next()/.current()/.reset() ([E] OSequence in SQL)
+        if ctx.db is None or not args:
+            raise EvalError("sequence() needs a database and a name")
+        return ctx.db.sequences.get_or_raise(str(args[0]))
+    if ctx.db is not None and ctx.db._functions is not None:
+        fn = ctx.db._functions.get(name)
+        if fn is not None:
+            return fn.invoke(ctx.db, args, parent_ctx=ctx)
     raise EvalError(f"unknown function '{name}'")
 
 
 def eval_method(ctx: EvalContext, base, name: str, args) -> object:
     """`value.method(args)` dispatch ([E] OSQLMethodFactory subset)."""
     m = name.lower()
+    from orientdb_tpu.models.metadata import Sequence
+
+    if isinstance(base, Sequence):
+        if m == "next":
+            return base.next()
+        if m == "current":
+            return base.current()
+        if m == "reset":
+            return base.reset()
+        raise EvalError(f"sequence has no method '{name}'")
     if m in ("out", "in", "both"):
         return nav_vertices(ctx, base, m, args)
     if m in ("oute", "ine", "bothe"):

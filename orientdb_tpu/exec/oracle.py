@@ -130,6 +130,122 @@ def _row_ctx(db, row, params, parent_ctx) -> EvalContext:
     return EvalContext(db, current=row, params=params, parent=parent_ctx)
 
 
+# ---------------------------------------------------------------------------
+# index-driven candidate pruning ([E] the planner's index-vs-scan choice,
+# SURVEY.md §3.2: "OSelectExecutionPlanner … index vs scan choice")
+# ---------------------------------------------------------------------------
+
+
+def _const_operand(expr: A.Expression, ctx: EvalContext):
+    """(ok, value) for expressions that cannot reference the current row —
+    literals, parameters, and their negations. Anything else is not a
+    constant for index-probe purposes."""
+    if isinstance(expr, A.Literal):
+        return True, expr.value
+    if isinstance(expr, A.Parameter):
+        key = expr.name if expr.name is not None else expr.index
+        if key in ctx.params:
+            return True, ctx.params[key]
+        return False, None
+    if isinstance(expr, A.Unary) and expr.op in ("-", "+"):
+        ok, v = _const_operand(expr.expr, ctx)
+        if ok and isinstance(v, (int, float)) and not isinstance(v, bool):
+            return True, (-v if expr.op == "-" else v)
+        return False, None
+    return False, None
+
+
+_FLIP_OP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+
+
+def index_lookup_rids(db, class_name: str, where: A.Expression, ctx: EvalContext):
+    """RIDs satisfying ONE indexable conjunct of ``where``, or None when no
+    single-field index applies. The caller still evaluates the FULL WHERE
+    per row — the index is a pruning prefetch, so using it can only shrink
+    the scanned set, never change results."""
+    if isinstance(where, A.Binary) and where.op == "AND":
+        left = index_lookup_rids(db, class_name, where.left, ctx)
+        if left is not None:
+            return left
+        return index_lookup_rids(db, class_name, where.right, ctx)
+    if db._indexes is None:
+        return None
+
+    def probe(lhs, rhs, op):
+        if not isinstance(lhs, A.Identifier):
+            return None
+        idx = db._indexes.best_for(class_name, lhs.name)
+        if idx is None:
+            return None
+        ok, v = _const_operand(rhs, ctx)
+        if not ok or v is None:
+            return None
+        try:
+            if op == "=":
+                return set(idx.get(v))
+            if not idx.range_capable:
+                return None
+            lo, hi = (v, None) if op in (">", ">=") else (None, v)
+            out = set()
+            for _k, rids in idx.range(
+                lo=lo,
+                hi=hi,
+                lo_inclusive=(op != ">"),
+                hi_inclusive=(op != "<"),
+            ):
+                out |= rids
+            return out
+        except TypeError:
+            return None  # mixed-type keys: leave it to the row filter
+
+    if isinstance(where, A.Binary) and where.op in _FLIP_OP:
+        return probe(where.left, where.right, where.op) if isinstance(
+            where.left, A.Identifier
+        ) else probe(where.right, where.left, _FLIP_OP[where.op])
+    if isinstance(where, A.Between) and isinstance(where.expr, A.Identifier):
+        idx = db._indexes.best_for(class_name, where.expr.name)
+        if idx is None or not idx.range_capable:
+            return None
+        ok_lo, lo = _const_operand(where.low, ctx)
+        ok_hi, hi = _const_operand(where.high, ctx)
+        if not (ok_lo and ok_hi) or lo is None or hi is None:
+            return None
+        try:
+            out = set()
+            for _k, rids in idx.range(lo=lo, hi=hi):
+                out |= rids
+            return out
+        except TypeError:
+            return None
+    return None
+
+
+def indexed_class_docs(db, class_name: str, polymorphic: bool, where, ctx):
+    """Documents of ``class_name`` pruned through an index, or None → the
+    caller scans. Disabled under an active transaction (indexes don't see
+    the tx overlay)."""
+    if db.tx is not None or where is None:
+        return None
+    cls = db.schema.get_class(class_name)
+    if cls is None:
+        return None
+    rids = index_lookup_rids(db, cls.name, where, ctx)
+    if rids is None:
+        return None
+    docs = []
+    for rid in sorted(rids):
+        d = db._load_raw(rid)
+        if d is None:
+            continue
+        dcls = db.schema.get_class(d.class_name)
+        if dcls is None or not dcls.is_subclass_of(cls.name):
+            continue  # the index may span sibling subclasses
+        if not polymorphic and d.class_name != cls.name:
+            continue
+        docs.append(d)
+    return docs
+
+
 def _skip_limit(rows: List, skip_expr, limit_expr, ctx) -> List:
     skip = int(evaluate(ctx, skip_expr)) if skip_expr is not None else 0
     limit = int(evaluate(ctx, limit_expr)) if limit_expr is not None else None
@@ -289,7 +405,17 @@ def _collect_aggregates(expr: A.Expression, out: List[A.FunctionCall]) -> None:
 
 def execute_select(db, stmt: A.SelectStatement, params, parent_ctx=None) -> List[Result]:
     base_ctx = EvalContext(db, params=params, parent=parent_ctx)
-    source = resolve_target_rows(db, stmt.target, base_ctx)
+    source = None
+    if isinstance(stmt.target, A.ClassTarget) and db.schema.exists_class(
+        stmt.target.name
+    ):
+        pruned = indexed_class_docs(
+            db, stmt.target.name, stmt.target.polymorphic, stmt.where, base_ctx
+        )
+        if pruned is not None:
+            source = iter(pruned)
+    if source is None:
+        source = resolve_target_rows(db, stmt.target, base_ctx)
 
     # per-row context with LET variables
     def contexts() -> Iterator[Tuple[EvalContext, object]]:
@@ -588,12 +714,33 @@ class MatchInterpreter:
             doc = self.db.load(rid)
             docs = [doc] if doc is not None else []
         elif class_names:
-            # most selective: intersect by scanning the first and checking all
-            docs = [
-                d
-                for d in self.db.browse_class(class_names[0])
-                if all(self._doc_is_class(d, c) for c in class_names[1:])
-            ]
+            # index-seeded when some filter's WHERE has an indexable
+            # conjunct ([E] MatchPrefetchStep's index use, SURVEY.md §3.3);
+            # check_node below still applies every filter in full
+            docs = None
+            if self.db.tx is None:
+                ctx = EvalContext(self.db, params=self.params)
+                for f in node.filters:
+                    if f.where is None:
+                        continue
+                    seeded = indexed_class_docs(
+                        self.db, class_names[0], True, f.where, ctx
+                    )
+                    if seeded is not None:
+                        docs = [
+                            d
+                            for d in seeded
+                            if all(self._doc_is_class(d, c) for c in class_names[1:])
+                        ]
+                        break
+            if docs is None:
+                # most selective: intersect by scanning the first and
+                # checking all
+                docs = [
+                    d
+                    for d in self.db.browse_class(class_names[0])
+                    if all(self._doc_is_class(d, c) for c in class_names[1:])
+                ]
         elif node.is_edge_alias:
             docs = list(self.db.browse_class("E"))
         else:
@@ -603,15 +750,66 @@ class MatchInterpreter:
         return out
 
     def estimate(self, node: PatternNode) -> int:
+        """Candidate-set size estimate for greedy root/expansion ordering
+        ([E] OMatchExecutionPlanner's index-aware estimates): class count
+        scaled by a WHERE-selectivity prior — an equality on a
+        unique-indexed field is a point lookup; plain equalities and
+        ranges get blunt priors. Without this, a `where:(id = ?)` root is
+        costed like a full class scan and the planner roots at the wrong
+        alias (e.g. walking every Post's reply tree backwards instead of
+        starting from the one matched Message)."""
         for f in node.filters:
             if f.rid is not None:
                 return 1
+        base = None
+        cname = None
         for f in node.filters:
             if f.class_name:
                 cls = self.db.schema.get_class(f.class_name)
                 if cls is not None:
-                    return self.db.count_class(cls.name)
-        return self.db.count_class("E" if node.is_edge_alias else "V") + 10**6
+                    base = self.db.count_class(cls.name)
+                    cname = cls.name
+                    break
+        if base is None:
+            base = self.db.count_class("E" if node.is_edge_alias else "V") + 10**6
+        sel = 1.0
+        for f in node.filters:
+            if f.where is not None:
+                sel = min(sel, self._where_selectivity(cname, f.where))
+        return max(1, int(base * sel))
+
+    def _where_selectivity(self, cname: Optional[str], w) -> float:
+        if isinstance(w, A.Binary):
+            if w.op == "AND":
+                return max(
+                    1e-6,
+                    self._where_selectivity(cname, w.left)
+                    * self._where_selectivity(cname, w.right),
+                )
+            if w.op == "OR":
+                return min(
+                    1.0,
+                    self._where_selectivity(cname, w.left)
+                    + self._where_selectivity(cname, w.right),
+                )
+            if w.op == "=":
+                fld = None
+                if isinstance(w.left, A.Identifier):
+                    fld = w.left.name
+                elif isinstance(w.right, A.Identifier):
+                    fld = w.right.name
+                if fld and cname and self.db._indexes is not None:
+                    idx = self.db._indexes.best_for(cname, fld)
+                    if idx is not None and idx.unique:
+                        return 1e-9  # point lookup
+                return 0.01 if fld else 1.0
+            if w.op in ("<", "<=", ">", ">="):
+                return 0.3
+            if w.op == "IN":
+                return 0.05
+        if isinstance(w, A.Between):
+            return 0.2
+        return 1.0
 
     def _doc_is_class(self, doc: Document, class_name: str) -> bool:
         cls = self.db.schema.get_class(doc.class_name)

@@ -217,12 +217,25 @@ def explain_plan(db, stmt: A.ExplainStatement, params) -> ResultSet:
         "statement": type(inner).__name__,
     }
     if stmt.profile:
-        from orientdb_tpu.exec.oracle import execute_statement
-
         t0 = time.perf_counter()
-        rows = execute_statement(db, inner, params)
+        if engine == "tpu":
+            # compiled-path PROFILE: per-phase timings + schedule stats
+            # (SURVEY.md §5.1 — this is the tool for dispatch-overhead work)
+            from orientdb_tpu.exec import tpu_engine
+
+            try:
+                rows, phases = tpu_engine.profile_execute(db, inner, params)
+                props["tpuPhases"] = phases
+            except tpu_engine.Uncompilable as e:
+                props["fallback"] = str(e)
+                engine = "oracle"
+        if engine != "tpu":
+            from orientdb_tpu.exec.oracle import execute_statement
+
+            rows = execute_statement(db, inner, params)
         elapsed = (time.perf_counter() - t0) * 1e6
         plan.cost = elapsed
+        props["engine"] = engine
         props["executionPlan"] = plan.pretty()
         props["elapsedUs"] = elapsed
         props["rows"] = len(rows)

@@ -53,7 +53,13 @@ from orientdb_tpu.models.record import Document
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.ops import csr as K
 from orientdb_tpu.ops.device_graph import DeviceGraph, device_graph
-from orientdb_tpu.ops.predicates import ColumnScope, Uncompilable, compile_predicate
+from orientdb_tpu.ops.predicates import (
+    ColumnScope,
+    ParamBox,
+    Uncompilable,
+    compile_predicate,
+    split_params,
+)
 from orientdb_tpu.sql import ast as A
 from orientdb_tpu.utils.config import config
 from orientdb_tpu.utils.logging import get_logger
@@ -82,12 +88,25 @@ class Table:
         #: device-side twin of `count` (threaded so COUNT(*) plans can fetch
         #: one scalar instead of the whole table); None until a step sets it
         self.count_dev = None
+        #: device-side per-slot liveness (int32 1/0). On a recording run the
+        #: first `count` slots are exactly the live ones, so None ≡
+        #: arange(width) < count; a parameter-generic REPLAY can have live
+        #: rows interleaved with recorded-size padding, and this mask is
+        #: what lets materialization pick the true rows.
+        self.valid = None
 
     @property
     def count_device(self):
         if self.count_dev is None:
             return jnp.int32(self.count)
         return self.count_dev
+
+    @property
+    def valid_device(self):
+        if self.valid is not None:
+            return self.valid
+        pos = jnp.arange(max(self.width, 1), dtype=jnp.int32)
+        return (pos < self.count_device).astype(jnp.int32)
 
     def empty(self) -> bool:
         return self.count == 0
@@ -107,13 +126,23 @@ class Table:
             )
         for a, c in self.depth_cols.items():
             t.depth_cols[a] = K.take_pad(c, rows, jnp.int32(-1))
+        t.valid = K.take_pad(self.valid_device, rows, jnp.int32(0))
         return t
 
 
 def _concat_tables(parts: List[Table], counts: List[int]) -> Table:
-    """Concatenate gathered part-tables (same column sets) and re-bucket."""
+    """Concatenate gathered part-tables (same column sets) and re-bucket.
+
+    Parts keep their FULL bucketed capacity (not just the recorded live
+    prefix): a parameter-generic replay can have up to bucket(recorded)
+    live rows per part, so slicing at the recorded count would silently
+    truncate them. Liveness flows through the per-slot valid mask; the
+    recorded host count is bookkeeping only."""
     total = sum(counts)
-    out = Table(count=total, width=K.bucket(total))
+    # parts are already bucket-sized; their sum is deterministic given the
+    # schedule, so no re-bucketing (it would only double the padding)
+    cap = sum(p.width for p in parts)
+    out = Table(count=total, width=max(cap, K.bucket(0)))
     if not parts:
         out.count = 0
         out.count_dev = jnp.int32(0)
@@ -123,24 +152,24 @@ def _concat_tables(parts: List[Table], counts: List[int]) -> Table:
         out.count_dev = out.count_dev + p.count_device
     keys = parts[0].cols.keys()
     for a in keys:
-        segs = [p.cols[a][: c] for p, c in zip(parts, counts)]
-        out.cols[a] = _pad_concat(segs, out.width)
+        out.cols[a] = _pad_concat([p.cols[a] for p in parts], out.width)
     for a in parts[0].edge_cols.keys():
-        ci = _pad_concat([p.edge_cols[a][0][:c] for p, c in zip(parts, counts)], out.width)
-        ps = _pad_concat([p.edge_cols[a][1][:c] for p, c in zip(parts, counts)], out.width)
+        ci = _pad_concat([p.edge_cols[a][0] for p in parts], out.width)
+        ps = _pad_concat([p.edge_cols[a][1] for p in parts], out.width)
         out.edge_cols[a] = (ci, ps)
     for a in parts[0].depth_cols.keys():
         out.depth_cols[a] = _pad_concat(
-            [p.depth_cols[a][:c] for p, c in zip(parts, counts)], out.width
+            [p.depth_cols[a] for p in parts], out.width
         )
+    out.valid = _pad_concat([p.valid_device for p in parts], out.width, pad=0)
     return out
 
 
-def _pad_concat(segs: List[jnp.ndarray], width: int) -> jnp.ndarray:
+def _pad_concat(segs: List[jnp.ndarray], width: int, pad: int = -1) -> jnp.ndarray:
     cat = jnp.concatenate(segs) if segs else jnp.zeros(0, jnp.int32)
-    pad = width - cat.shape[0]
-    if pad > 0:
-        cat = jnp.concatenate([cat, jnp.full(pad, -1, jnp.int32)])
+    n = width - cat.shape[0]
+    if n > 0:
+        cat = jnp.concatenate([cat, jnp.full(n, pad, jnp.int32)])
     return cat
 
 
@@ -149,13 +178,27 @@ def _pad_concat(segs: List[jnp.ndarray], width: int) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _observe_compact(sched: "SizeSchedule", mask):
+def _cap_of(n: int) -> int:
+    """Replay-tolerant buffer capacity for an observed count: bucketed
+    with ``config.schedule_headroom`` growth, so parameter-generic replays
+    whose live sizes land within the headroom run without an overflow
+    re-record."""
+    if n <= 0:
+        return K.bucket(0)
+    return K.bucket(max(1, int(n * config.schedule_headroom)))
+
+
+def _observe_compact(sched: "SizeSchedule", mask, min_capacity: int = 0):
     """Shared compaction protocol: surviving-row indices sized via the
     schedule (one blocking sync on the recording run, free on replay).
     Returns (indices, host count, device count)."""
     count_dev = K.mask_count(mask)
-    count = sched.observe(count_dev)
-    return K.compact_indices(mask, K.bucket(count)), count, count_dev
+    count = sched.observe(count_dev, min_capacity=min_capacity)
+    return (
+        K.compact_indices(mask, max(min_capacity, _cap_of(count))),
+        count,
+        count_dev,
+    )
 
 
 class SizeSchedule:
@@ -167,25 +210,51 @@ class SizeSchedule:
     Sizes are deterministic given (snapshot epoch, statement, params), so a
     replay under `jit` executes the whole multi-hop solve as a single
     device dispatch with zero syncs — the TPU-native analog of the
-    reference's prepared-plan reuse ([E] OExecutionPlanCache)."""
+    reference's prepared-plan reuse ([E] OExecutionPlanCache).
+
+    Parameter-generic replay: numeric parameters are jit ARGUMENTS, so a
+    replay may see different live sizes than were recorded. Every non-free
+    observation therefore accumulates a device-side ``overflow`` flag —
+    live count exceeding the recorded bucket capacity (or any liveness
+    where the recording saw zero and structurally skipped work) means the
+    replay's buffers were too small and its result must be discarded; the
+    caller re-records with the new parameters (buckets grow monotonically,
+    so re-records converge). Live counts *under* the recorded capacity are
+    handled exactly via the table's device valid mask + count."""
 
     def __init__(self) -> None:
         self.values: List[int] = []
         self.pos = 0
         self.recording = True
+        self.overflow = None  # traced bool scalar during replay
 
-    def observe(self, dev_scalar) -> int:
+    def observe(self, dev_scalar, free: bool = False, min_capacity: int = 0) -> int:
+        """``free=True`` marks a value that sizes no buffer and gates no
+        control flow (e.g. the COUNT(*) pushdown total) — exempt from the
+        overflow check. ``min_capacity`` is the buffer floor the call site
+        allocates even for a recorded zero (kept-empty parts): replays may
+        fill it without flagging."""
         if self.recording:
             v = int(dev_scalar)
             self.values.append(v)
             return v
         v = self.values[self.pos]
         self.pos += 1
+        if not free:
+            cap = max(min_capacity, _cap_of(v) if v > 0 else 0)
+            flag = dev_scalar > cap
+            self.overflow = flag if self.overflow is None else (self.overflow | flag)
         return v
+
+    def overflow_flag(self):
+        if self.overflow is None:
+            return jnp.zeros((), bool)
+        return self.overflow
 
     def start_replay(self) -> None:
         self.recording = False
         self.pos = 0
+        self.overflow = None
 
 
 # ---------------------------------------------------------------------------
@@ -343,6 +412,9 @@ class TpuMatchSolver:
         self.db = db
         self.stmt = stmt
         self.params = params
+        # numeric parameters compile to reads of this box so one cached
+        # plan replays for any value (predicates.ParamBox)
+        self.param_box = ParamBox(params)
         snap = db.current_snapshot(require_fresh=True)
         if snap is None:
             raise Uncompilable("no fresh snapshot attached")
@@ -368,7 +440,7 @@ class TpuMatchSolver:
             w = e.item.target.while_cond
             if w is not None:
                 self._while_fns[id(e)] = compile_predicate(
-                    w, self._vertex_scope(), self.params, allow_depth=True
+                    w, self._vertex_scope(), self.param_box, allow_depth=True
                 )
 
     # -- compile-time gating ------------------------------------------------
@@ -444,7 +516,7 @@ class TpuMatchSolver:
                 wi = -2 if want is None else want  # -2 matches nothing (≠ -1 pad)
                 parts.append(lambda idx, env, wi=wi: idx == wi)
             if f.where is not None:
-                fn = compile_predicate(f.where, self._vertex_scope(), self.params)
+                fn = compile_predicate(f.where, self._vertex_scope(), self.param_box)
                 parts.append(fn)
 
         def mask(idx, env=None, parts=parts):
@@ -470,7 +542,7 @@ class TpuMatchSolver:
         scope = ColumnScope(
             dec.columns, dec.non_columnar, reserved=set(self.pattern.nodes.keys())
         )
-        return compile_predicate(where, scope, self.params)
+        return compile_predicate(where, scope, self.param_box)
 
     # -- execution ----------------------------------------------------------
 
@@ -483,7 +555,7 @@ class TpuMatchSolver:
         total_dev = counts.sum()
         total = self.sched.observe(total_dev)
         row, edge_pos, nbr = K.gather_expand(
-            indptr, nbrs, srcs, offsets, total_dev, K.bucket(total)
+            indptr, nbrs, srcs, offsets, total_dev, _cap_of(total)
         )
         return row, edge_pos, nbr, total
 
@@ -514,7 +586,7 @@ class TpuMatchSolver:
         tots = expand_totals(mg.mesh, mg.rows_per_shard, ind_sh, srcs)
         total = self.sched.observe(tots.sum())
         max_local = self.sched.observe(tots.max())
-        cap = K.bucket(max(max_local, 1))
+        cap = _cap_of(max(max_local, 1))
         row, eid, nbr = expand_gather(
             mg.mesh,
             mg.rows_per_shard,
@@ -633,7 +705,9 @@ class TpuMatchSolver:
                 raise Uncompilable(
                     f"COUNT pushdown overflows int32 (≈{approx:.6g} vs {exact})"
                 )
-        total = self.sched.observe(total_dev)
+        # free observe: the count IS the device scalar result — it sizes no
+        # buffer and gates no control flow, so it must not trip overflow
+        total = self.sched.observe(total_dev, free=True)
         t = Table(count=int(total), width=0)
         t.count_dev = total_dev
         return t
@@ -725,10 +799,28 @@ class TpuMatchSolver:
             t = Table(count=n, width=int(cand.shape[0]))
             t.cols[alias] = cand
             t.count_dev = n_dev
+            t.valid = (cand >= 0).astype(jnp.int32)
             return t
-        # cartesian product with the existing table
+        # cartesian product with the existing table. Live rows may be
+        # scattered among bucket padding (parts keep full capacity), but
+        # the pairing below indexes a contiguous prefix — compact first.
+        live = table.valid_device[: table.width].astype(bool)
+        keep, packed_n, packed_dev = self._compact(live)
+        table = table.gather(keep)
+        table.count = packed_n
+        table.count_dev = packed_dev
+        # The pairing stride is the RECORDED new_n, so a parameter-generic
+        # replay is only valid when both cardinalities match the recording
+        # exactly — require it (single-component patterns, i.e. everything
+        # without a cartesian, stay fully parameter-generic).
         old_n, new_n = table.count, n
         old_dev = table.count_device
+        sched = self.sched
+        if not sched.recording:
+            flag = (old_dev != old_n) | (n_dev != new_n)
+            sched.overflow = (
+                flag if sched.overflow is None else (sched.overflow | flag)
+            )
         total = old_n * new_n
         width = K.bucket(max(total, 1))
         pos = jnp.arange(width, dtype=jnp.int32)
@@ -827,10 +919,12 @@ class TpuMatchSolver:
                 parts.append(part)
                 counts.append(kn)
         if optional:
-            # left-join: rows with zero matches keep their binding, dst=null
+            # left-join: rows with zero matches keep their binding, dst=null.
+            # Liveness comes from the device valid mask, not the recorded
+            # host count — a parameter-generic replay can have live rows
+            # anywhere under the recorded capacity.
             matched = matched_any[: table.width] > 0 if table.width else matched_any[:0]
-            rowids = jnp.arange(table.width, dtype=jnp.int32)
-            valid_rows = rowids < table.count
+            valid_rows = table.valid_device[: table.width].astype(bool)
             unmatched = valid_rows & ~matched
             ukeep, un, un_dev = self._compact(unmatched)
             if un > 0:
@@ -918,10 +1012,20 @@ class TpuMatchSolver:
         counts: List[int] = []
         width = table.width or 1
         matched_chunks = []
-        C = self._VAR_DEPTH_CHUNK
-        for cs in range(0, max(table.count, 1), C):
+        # chunk rows: no wider than the (bucketed) table itself — a
+        # point-lookup query walks 8-row bitmaps, not 256-row ones
+        C = min(self._VAR_DEPTH_CHUNK, width)
+        # chunk over the bucketed WIDTH (not the recorded count): on a
+        # parameter-generic replay live rows can occupy any slot under the
+        # recorded capacity, and the per-slot valid mask (not a host count)
+        # decides liveness
+        valid_dev = table.valid_device
+        for cs in range(0, width, C):
             chunk_rows = jnp.arange(cs, cs + C, dtype=jnp.int32)
-            chunk_valid = chunk_rows < table.count
+            # take_pad clips (rather than fills) indices past the end, so
+            # out-of-width slots must be sent negative explicitly
+            in_range = jnp.where(chunk_rows < valid_dev.shape[0], chunk_rows, -1)
+            chunk_valid = K.take_pad(valid_dev, in_range, jnp.int32(0)) > 0
             chunk_rows = jnp.where(chunk_valid, chunk_rows, -1)
             src_chunk = K.take_pad(srcs, chunk_rows, jnp.int32(-1))
             roots = K.rows_to_bitmap(src_chunk, vb)
@@ -939,8 +1043,20 @@ class TpuMatchSolver:
                 table, roots, node_mask_vec, bound_chunk, cs, depth,
                 dst_alias, depth_alias, vb, parts, counts,
             )
+            # level loop with PADDED trailing levels: recording runs
+            # `var_depth_pad_levels` extra (empty) levels past frontier
+            # exhaustion and keeps min-capacity emissions at every level,
+            # so a replay whose walk is up to `pad` levels deeper — depth
+            # varies with the query parameter — executes in place instead
+            # of re-recording. The alive observes are free (the loop's
+            # trip count replays from the schedule); the post-loop
+            # structural observe flags replays needing even deeper walks.
+            pad = max(1, config.var_depth_pad_levels)
+            empty_streak = 0
+            ended_by_bound = False
             while True:
                 if max_depth is not None and depth >= max_depth:
+                    ended_by_bound = True
                     break
                 expandable = frontier
                 if while_fn is not None:
@@ -950,9 +1066,8 @@ class TpuMatchSolver:
                 for hop in hops:
                     nxt = nxt | hop(expandable)
                 nxt = nxt & ~visited
-                alive = self.sched.observe(K.mask_count(nxt))
-                if alive == 0:
-                    break
+                alive = self.sched.observe(K.mask_count(nxt), free=True)
+                empty_streak = empty_streak + 1 if alive == 0 else 0
                 visited = visited | nxt
                 depth += 1
                 matched = matched | self._emit_var_level(
@@ -960,8 +1075,15 @@ class TpuMatchSolver:
                     dst_alias, depth_alias, vb, parts, counts,
                 )
                 frontier = nxt
-                if depth > V:  # safety: no graph has longer shortest paths
+                if empty_streak >= pad:
                     break
+                if depth > V:  # safety: no graph has longer shortest paths
+                    ended_by_bound = True
+                    break
+            if not ended_by_bound:
+                # exhaustion-ended: a replay still alive here needs more
+                # levels than recorded+pad → overflow (recorded value is 0)
+                self.sched.observe(K.mask_count(frontier))
             matched_chunks.append(matched)
         if optional:
             matched_all = jnp.concatenate(matched_chunks)[:width]
@@ -972,8 +1094,7 @@ class TpuMatchSolver:
                         jnp.zeros(width - matched_all.shape[0], bool),
                     ]
                 )
-            rowids = jnp.arange(width, dtype=jnp.int32)
-            unmatched = (rowids < table.count) & ~matched_all
+            unmatched = valid_dev[:width].astype(bool) & ~matched_all
             ukeep, un, un_dev = self._compact(unmatched)
             if un > 0:
                 upart = table.gather(ukeep)
@@ -1019,16 +1140,19 @@ class TpuMatchSolver:
         counts: List[int],
     ) -> jnp.ndarray:
         """Emit one BFS level's (row, vertex, depth) bindings; returns the
-        per-chunk-row matched mask (for OPTIONAL bookkeeping)."""
+        per-chunk-row matched mask (for OPTIONAL bookkeeping).
+
+        Levels whose recorded emission is EMPTY still append a
+        min-capacity part: parameter-generic replays can emit up to that
+        capacity at any level (incl. the padded post-exhaustion ones)
+        without re-recording."""
         emit = reached & node_mask_vec[None, :]
         if bound_chunk is not None:
             vcol = jnp.arange(vb, dtype=jnp.int32)
             emit = emit & (vcol[None, :] == bound_chunk[:, None])
         matched = emit.any(axis=1)
         flat = emit.reshape(-1)
-        keep, kn, kn_dev = self._compact(flat)
-        if kn == 0:
-            return matched
+        keep, kn, kn_dev = _observe_compact(self.sched, flat, min_capacity=K.bucket(0))
         ok = keep >= 0
         c = jnp.where(ok, keep // vb, -1)
         v = jnp.where(ok, keep % vb, -1)
@@ -1054,14 +1178,26 @@ class TpuMatchSolver:
 
     # -- marshalling --------------------------------------------------------
 
+    @staticmethod
+    def _live_rows(table: Table):
+        """Row selector for marshalling: tables carry live rows scattered
+        among bucket padding (the valid mask is authoritative); tables
+        without a mask are contiguous-prefix (host-rebuilt ones)."""
+        if table.valid is None:
+            return slice(0, table.count)
+        return np.flatnonzero(np.asarray(table.valid) > 0)
+
     def bindings_from_table(self, table: Table) -> List[Dict[str, object]]:
-        n = table.count
-        cols = {a: np.asarray(c)[:n] for a, c in table.cols.items()}
+        sel = self._live_rows(table)
+        cols = {a: np.asarray(c)[sel] for a, c in table.cols.items()}
         ecols = {
-            a: (np.asarray(ci)[:n], np.asarray(pos)[:n])
+            a: (np.asarray(ci)[sel], np.asarray(pos)[sel])
             for a, (ci, pos) in table.edge_cols.items()
         }
-        dcols = {a: np.asarray(c)[:n] for a, c in table.depth_cols.items()}
+        dcols = {a: np.asarray(c)[sel] for a, c in table.depth_cols.items()}
+        n = next(iter(cols.values())).shape[0] if cols else (
+            next(iter(ecols.values()))[0].shape[0] if ecols else table.count
+        )
         # aliases that never hit a table column (fully detached optional
         # arms) marshal as None
         missing = [
@@ -1103,8 +1239,9 @@ class TpuMatchSolver:
             out.append(b)
         return out
 
-    def rows_from_table(self, table: Table) -> List[Result]:
-        fast = self._fast_rows(table)
+    def rows_from_table(self, table: Table, params: Optional[Dict] = None) -> List[Result]:
+        params = self.params if params is None else params
+        fast = self._fast_rows(table, params)
         if fast is not None:
             return fast
         named = [
@@ -1115,7 +1252,7 @@ class TpuMatchSolver:
             self.stmt,
             named,
             self.bindings_from_table(table),
-            self.params,
+            params,
             None,
         )
 
@@ -1139,14 +1276,19 @@ class TpuMatchSolver:
             return r[0].alias or expr_name(r[0].expr, 0)
         return None
 
-    def finalize_count(self, name: str, count: int) -> List[Result]:
+    def finalize_count(
+        self, name: str, count: int, params: Optional[Dict] = None
+    ) -> List[Result]:
         # aggregate path applies only ORDER/SKIP/LIMIT (no DISTINCT)
+        params = self.params if params is None else params
         out = [Result(props={name: count})]
-        out = _order_rows(out, self.stmt.order_by, self.db, self.params, None)
-        base_ctx = EvalContext(self.db, params=self.params)
+        out = _order_rows(out, self.stmt.order_by, self.db, params, None)
+        base_ctx = EvalContext(self.db, params=params)
         return _skip_limit(out, self.stmt.skip, self.stmt.limit, base_ctx)
 
-    def _fast_rows(self, table: Table) -> Optional[List[Result]]:
+    def _fast_rows(
+        self, table: Table, params: Optional[Dict] = None
+    ) -> Optional[List[Result]]:
         """Build result rows straight from device columns when RETURN is a
         count(*) or plain columnar projections — skipping per-row Document
         loads entirely (the [E] OResultInternal marshalling cost the north
@@ -1160,19 +1302,20 @@ class TpuMatchSolver:
         # lone COUNT(*) → O(1): the table's valid row count
         name = self.count_only_name()
         if name is not None:
-            return self.finalize_count(name, table.count)
+            return self.finalize_count(name, table.count, params)
         # plain columnar projections: alias.prop / depth aliases
         from orientdb_tpu.exec.eval import contains_aggregate
 
         if any(contains_aggregate(p.expr) for p in returns):
             return None
         plans = []  # (name, values np | None, present np | None, decode)
-        n = table.count
+        sel = self._live_rows(table)
+        n = table.count if isinstance(sel, slice) else int(sel.shape[0])
         for i, p in enumerate(returns):
             e = p.expr
             name = p.alias or _match_proj_name(e, i)
             if isinstance(e, A.Identifier) and e.name in table.depth_cols:
-                arr = np.asarray(table.depth_cols[e.name])[:n]
+                arr = np.asarray(table.depth_cols[e.name])[sel]
                 plans.append((name, arr, arr >= 0, None))
                 continue
             if (
@@ -1183,7 +1326,7 @@ class TpuMatchSolver:
                 prop = e.name
                 if prop in self.dg.non_columnar or prop.startswith("@"):
                     return None
-                idx = np.asarray(table.cols[e.base.name])[:n]
+                idx = np.asarray(table.cols[e.base.name])[sel]
                 col = self.snap.v_columns.get(prop)
                 if col is None:
                     plans.append((name, None, None, None))  # never present
@@ -1220,7 +1363,7 @@ class TpuMatchSolver:
             Result(props=dict(zip(names, vals_row)))
             for vals_row in zip(*obj_cols)
         ] if obj_cols else [Result(props={}) for _ in range(n)]
-        return finalize_match_rows(self.db, stmt, out, self.params, None)
+        return finalize_match_rows(self.db, stmt, out, params or self.params, None)
 
 
 # ---------------------------------------------------------------------------
@@ -1402,13 +1545,16 @@ class _CompiledTraverse:
             dg.arrays = saved
         return idx
 
-    def dispatch(self):
+    def dispatch(self, params: Optional[Dict] = None):
+        # TRAVERSE plans bake parameter values (their full values join the
+        # plan-cache key), so `params` is accepted for interface parity
+        # with _CompiledPlan and ignored
         return self.jitted(self.solver.dg.arrays)
 
-    def materialize(self, dev) -> List[Result]:
+    def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
         return self.solver.rows_from(np.asarray(dev), self.count)
 
-    def rows(self) -> List[Result]:
+    def rows(self, params: Optional[Dict] = None) -> List[Result]:
         return self.materialize(self.dispatch())
 
 
@@ -1417,9 +1563,24 @@ class _CompiledTraverse:
 # ---------------------------------------------------------------------------
 
 
+class ScheduleOverflow(Exception):
+    """A parameter-generic replay's live sizes exceeded the recorded
+    schedule's capacities; the result was discarded. Caller re-records."""
+
+
 class _CompiledPlan:
     """A solver whose size schedule is learned: re-executions replay the
     whole solve as one jitted, sync-free device dispatch.
+
+    Numeric query parameters are jit ARGUMENTS of the replay (see
+    predicates.ParamBox), so ONE recorded plan serves every parameter
+    value — the way the reference's [E] OExecutionPlanCache caches per
+    statement. Because buffer sizes were recorded under the recording
+    parameters, the replay returns (alongside the result) a device valid
+    mask, the true row count, and an overflow flag; materialization uses
+    the live mask/count, and an overflow raises ScheduleOverflow so the
+    front door re-records with the new parameters (bucket capacities grow
+    monotonically, so this converges).
 
     Execution is split into ``dispatch()`` (enqueue the device work —
     microseconds) and ``materialize()`` (device→host transfer + row
@@ -1436,70 +1597,121 @@ class _CompiledPlan:
         self.count = table.count
         self.width = table.width
         self.count_name = solver.count_only_name()
+        #: dynamic parameters the compiled predicates actually read
+        self.dyn_spec = dict(solver.param_box.used)
         self.jitted = jax.jit(self._replay)
 
-    def _replay(self, arrays):
+    def _replay(self, arrays, dyn):
         # swap the tracer pytree into the device graph for the trace so the
         # graph buffers become jit ARGUMENTS (shared across every cached
-        # plan) rather than per-executable HLO constants
-        dg = self.solver.dg
+        # plan) rather than per-executable HLO constants; same for the
+        # dynamic parameter scalars via the param box
+        solver = self.solver
+        dg = solver.dg
         saved = dg.arrays
         dg.arrays = arrays
+        solver.param_box.set_current(dyn)
         try:
-            self.solver.sched.start_replay()
-            table = self.solver.solve_table()
+            solver.sched.start_replay()
+            table = solver.solve_table()
         finally:
             dg.arrays = saved
-        if self.count_name is not None:
-            # COUNT(*) plan: one device scalar is the whole result
-            return table.count_device
+            solver.param_box.reset()
+        overflow = solver.sched.overflow_flag().astype(jnp.int32)
+        count_dev = table.count_device.astype(jnp.int32)
+        if self.count_name is not None or self.width == 0:
+            # COUNT(*) plan (or column-less table): two scalars suffice
+            return jnp.stack([count_dev, overflow])
         flat: List[jnp.ndarray] = [table.cols[a] for a in self.v_names]
         for a in self.e_names:
             flat.extend(table.edge_cols[a])
         flat.extend(table.depth_cols[a] for a in self.d_names)
         if not flat:  # no columns (e.g. fully-detached optional pattern)
-            return table.count_device
+            return jnp.stack([count_dev, overflow])
+        width = flat[0].shape[0]
+        meta = jnp.zeros(width, jnp.int32).at[0].set(count_dev).at[1].set(overflow)
         # one stacked buffer → ONE device→host transfer per query (the
-        # tunneled-TPU fetch RTT dominates small-result queries otherwise)
-        return jnp.stack(flat)
+        # tunneled-TPU fetch RTT dominates small-result queries otherwise);
+        # the last two rows are the per-slot valid mask and [count,
+        # overflow] metadata
+        return jnp.stack(flat + [table.valid_device[:width], meta])
 
-    def dispatch(self):
+    def _dyn_args(self, params: Optional[Dict]) -> Dict:
+        params = params if params is not None else self.solver.params
+        dyn = {}
+        for k, kind in self.dyn_spec.items():
+            v = params[k]
+            dtype = jnp.float32 if kind == "float" else jnp.int32
+            dyn[k] = jnp.asarray(int(v) if kind != "float" else v, dtype)
+        return dyn
+
+    def dispatch(self, params: Optional[Dict] = None):
         """Enqueue the replay on device; returns the un-fetched result."""
-        return self.jitted(self.solver.dg.arrays)
+        return self.jitted(self.solver.dg.arrays, self._dyn_args(params))
 
-    def materialize(self, dev) -> List[Result]:
-        """Fetch a dispatched result and marshal rows."""
-        if self.count_name is not None:
-            return self.solver.finalize_count(self.count_name, int(dev))
-        return self.solver.rows_from_table(self._table_from(np.asarray(dev)))
+    def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
+        """Fetch a dispatched result and marshal rows (live count/mask)."""
+        arr = np.asarray(dev)
+        if self.count_name is not None or arr.ndim == 1:
+            count, overflow = int(arr[0]), int(arr[1])
+            if overflow:
+                raise ScheduleOverflow(str(self.solver.stmt))
+            if self.count_name is not None:
+                return self.solver.finalize_count(self.count_name, count, params)
+            # column-less non-count table (degenerate): count empty rows
+            t = Table(count=count, width=0)
+            return self.solver.rows_from_table(t, params)
+        meta = arr[-1]
+        if int(meta[1]):
+            raise ScheduleOverflow(str(self.solver.stmt))
+        return self.solver.rows_from_table(self._table_from(arr), params)
 
-    def rows(self) -> List[Result]:
-        return self.materialize(self.dispatch())
+    def rows(self, params: Optional[Dict] = None) -> List[Result]:
+        return self.materialize(self.dispatch(params), params)
 
     def run(self) -> Table:
-        return self._table_from(np.asarray(self.dispatch()))
+        arr = np.asarray(self.dispatch())
+        if arr.ndim == 1:
+            if int(arr[1]):
+                raise ScheduleOverflow(str(self.solver.stmt))
+            return Table(count=int(arr[0]), width=0)
+        if int(arr[-1][1]):
+            raise ScheduleOverflow(str(self.solver.stmt))
+        return self._table_from(arr)
 
-    def _table_from(self, stacked: np.ndarray) -> Table:
-        t = Table(count=self.count, width=self.width)
+    def _table_from(self, arr: np.ndarray) -> Table:
+        """Host table from the stacked transfer, compacted to live rows
+        via the valid mask (replay row counts are parameter-dependent)."""
+        valid = arr[-2]
+        sel = np.flatnonzero(valid > 0)
+        count = int(arr[-1][0])
+        # live count and mask population agree by construction; trust the
+        # mask for layout, the scalar for bookkeeping
+        t = Table(count=count, width=int(sel.shape[0]))
         i = 0
         for a in self.v_names:
-            t.cols[a] = stacked[i]
+            t.cols[a] = arr[i][sel]
             i += 1
         for a in self.e_names:
-            t.edge_cols[a] = (stacked[i], stacked[i + 1])
+            t.edge_cols[a] = (arr[i][sel], arr[i + 1][sel])
             i += 2
         for a in self.d_names:
-            t.depth_cols[a] = stacked[i]
+            t.depth_cols[a] = arr[i][sel]
             i += 1
         return t
 
 
 def _params_key(params) -> Optional[Tuple]:
+    """Plan-cache key fragment: STATIC parameter values plus the
+    names/kinds of dynamic (numeric) ones — dynamic values are jit
+    arguments, so plans are shared across them."""
+    dyn, static = split_params(params)
     try:
-        # include the value's type: 1 / True / 1.0 hash equal but compile
-        # to different predicates
-        t = tuple(
-            sorted((str(k), type(v).__name__, v) for k, v in params.items())
+        t = (
+            tuple(sorted((str(k), kind) for k, kind in dyn.items())),
+            tuple(
+                sorted((str(k), type(v).__name__, v) for k, v in static.items())
+            ),
         )
         hash(t)
         return t
@@ -1519,8 +1731,24 @@ def _plan_cache(snap) -> "OrderedDict":
     return cache
 
 
+def _all_values_key(params) -> Optional[Tuple]:
+    """Every parameter value in the key (TRAVERSE plans bake values)."""
+    try:
+        t = tuple(
+            sorted((str(k), type(v).__name__, v) for k, v in params.items())
+        )
+        hash(t)
+        return t
+    except TypeError:
+        return None
+
+
 def _cache_key(stmt, params) -> Optional[Tuple]:
-    pk = _params_key(params)
+    pk = (
+        _params_key(params)
+        if isinstance(stmt, A.MatchStatement)
+        else _all_values_key(params)
+    )
     if pk is None:
         return None
     try:
@@ -1531,10 +1759,25 @@ def _cache_key(stmt, params) -> Optional[Tuple]:
         return None
 
 
+def _record(db, stmt, params):
+    """Recording first execution: eager solve with blocking size observes.
+    Returns (plan, rows)."""
+    if isinstance(stmt, A.MatchStatement):
+        solver = TpuMatchSolver(db, stmt, params)
+        table = solver.solve_table()
+        rows = solver.rows_from_table(table)
+        return _CompiledPlan(solver, table), rows
+    tsolver = TpuTraverseSolver(db, stmt, params)
+    idx, total = tsolver.solve()
+    rows = tsolver.rows_from(np.asarray(idx), total)
+    return _CompiledTraverse(tsolver, total), rows
+
+
 def _prepare(db, stmt, params):
     """Plan-cache lookup, compiling (and executing) on miss.
 
-    Returns ``(plan, None)`` on a cache hit — the caller dispatches — or
+    Returns ``(variants, None)`` on a cache hit — `variants` is the
+    MRU-ordered list of schedule variants for this statement — or
     ``(None, rows)`` when this call WAS the recording first execution."""
     if not isinstance(stmt, (A.MatchStatement, A.TraverseStatement)):
         raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
@@ -1542,33 +1785,105 @@ def _prepare(db, stmt, params):
     snap = db.current_snapshot(require_fresh=True)
     if snap is None:
         raise Uncompilable("no fresh snapshot attached")
+    from orientdb_tpu.utils.metrics import metrics
+
     cache = _plan_cache(snap)
     key = _cache_key(stmt, params)
     if key is not None:
-        plan = cache.get(key)
-        if plan is not None:
+        variants = cache.get(key)
+        if variants is not None:
             cache.move_to_end(key)  # LRU: keep hot plans
-            return plan, None
-    if isinstance(stmt, A.MatchStatement):
-        solver = TpuMatchSolver(db, stmt, params)
-        table = solver.solve_table()
-        rows = solver.rows_from_table(table)
-        plan_obj = _CompiledPlan(solver, table)
-    else:
-        tsolver = TpuTraverseSolver(db, stmt, params)
-        idx, total = tsolver.solve()
-        rows = tsolver.rows_from(np.asarray(idx), total)
-        plan_obj = _CompiledTraverse(tsolver, total)
+            metrics.incr("plan_cache.hit")
+            return variants, None
+    metrics.incr("plan_cache.miss")
+    plan_obj, rows = _record(db, stmt, params)
     if key is not None and config.plan_cache_size > 0:
         while len(cache) >= config.plan_cache_size:
             cache.popitem(last=False)
-        cache[key] = plan_obj
+        v = PlanVariants(plan_obj)
+        v.remember(params, plan_obj)
+        cache[key] = v
     return None, rows
 
 
+class PlanVariants:
+    """Schedule variants for one cached statement, with a sticky
+    per-parameter routing map: parameter populations whose live sizes
+    cluster differently (e.g. shallow vs deep reply trees) each keep a
+    fitting variant, and repeated parameter values dispatch straight to
+    the variant that last served them — no retry round trips on the
+    steady-state path."""
+
+    __slots__ = ("plans", "by_param")
+
+    _STICKY_MAX = 4096
+
+    def __init__(self, first) -> None:
+        self.plans = [first]
+        self.by_param: Dict = {}
+
+    @staticmethod
+    def _pkey(params):
+        try:
+            t = tuple(sorted((str(k), str(v)) for k, v in (params or {}).items()))
+            hash(t)
+            return t
+        except TypeError:
+            return None
+
+    def pick(self, params):
+        plan = self.by_param.get(self._pkey(params))
+        return plan if plan in self.plans else self.plans[0]
+
+    def remember(self, params, plan) -> None:
+        k = self._pkey(params)
+        if k is None:
+            return
+        if len(self.by_param) >= self._STICKY_MAX:
+            self.by_param.clear()
+        self.by_param[k] = plan
+
+    def add(self, plan) -> None:
+        self.plans.insert(0, plan)
+        del self.plans[max(1, config.plan_variants):]
+        self.by_param = {
+            k: p for k, p in self.by_param.items() if p in self.plans
+        }
+
+
+def _run_variants(db, stmt, params, variants: PlanVariants, tried=None) -> List[Result]:
+    """Walk the remaining variants after a miss; when every one overflows,
+    record a NEW variant under these parameters. ``tried`` is the plan the
+    caller already dispatched and saw overflow from."""
+    for plan in list(variants.plans):
+        if plan is tried:
+            continue
+        try:
+            rows = plan.rows(params or {})
+        except ScheduleOverflow:
+            continue
+        variants.remember(params, plan)
+        return rows
+    from orientdb_tpu.utils.metrics import metrics
+
+    metrics.incr("plan_cache.overflow_rerecord")
+    plan_obj, rows = _record(db, stmt, params)
+    variants.add(plan_obj)
+    variants.remember(params, plan_obj)
+    return rows
+
+
 def execute(db, stmt, params) -> List[Result]:
-    plan, rows = _prepare(db, stmt, params)
-    return rows if plan is None else plan.rows()
+    variants, rows = _prepare(db, stmt, params)
+    if variants is None:
+        return rows
+    plan = variants.pick(params)
+    try:
+        rows = plan.rows(params or {})
+        variants.remember(params, plan)
+        return rows
+    except ScheduleOverflow:
+        return _run_variants(db, stmt, params, variants, tried=plan)
 
 
 def execute_batch(db, items) -> List:
@@ -1585,21 +1900,29 @@ def execute_batch(db, items) -> List:
     pending = []
     for i, (stmt, params) in enumerate(items):
         try:
-            plan, rows = _prepare(db, stmt, params)
+            variants, rows = _prepare(db, stmt, params)
         except Uncompilable as e:
             out[i] = e
             continue
-        if plan is None:
+        if variants is None:
             out[i] = rows
         else:
-            pending.append((i, plan, plan.dispatch()))
-    for _i, _plan, dev in pending:
+            # sticky routing: repeated parameter values dispatch straight
+            # to the variant that last served them
+            plan = variants.pick(params)
+            pending.append((i, variants, plan, plan.dispatch(params or {})))
+    for _i, _v, _plan, dev in pending:
         try:
             dev.copy_to_host_async()
         except Exception:  # CPU backend: already host-resident
             pass
-    for i, plan, dev in pending:
-        out[i] = plan.materialize(dev)
+    for i, variants, plan, dev in pending:
+        stmt, params = items[i]
+        try:
+            out[i] = plan.materialize(dev, params or {})
+            variants.remember(params, plan)
+        except ScheduleOverflow:
+            out[i] = _run_variants(db, stmt, params, variants, tried=plan)
     return out
 
 
@@ -1607,3 +1930,49 @@ def explain_plan_steps(db, stmt) -> List[str]:
     """Plan description for EXPLAIN (the [E] prettyPrint analog)."""
     solver = TpuMatchSolver(db, stmt, {})
     return [s.describe() for s in solver.plan]
+
+
+def profile_execute(db, stmt, params) -> Tuple[List[Result], Dict]:
+    """Execute on the compiled path with per-phase wall timings — the
+    observability PROFILE needs to attack dispatch overhead (SURVEY.md
+    §5.1; the whole device solve is ONE fused dispatch, so phases — not
+    per-step device kernels — are the honest breakdown)."""
+    import time as _time
+
+    if db.tx is not None:
+        # same guard as engine._run: the snapshot cannot see the tx overlay
+        raise Uncompilable("active transaction on this thread")
+    phases: Dict[str, object] = {}
+    t0 = _time.perf_counter()
+    variants, rows = _prepare(db, stmt, params)
+    phases["prepareUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+    if variants is None:
+        # recording first execution: eager, one blocking sync per observe
+        phases["mode"] = "record"
+        return rows, phases
+    plan = variants.pick(params)
+    phases["mode"] = "replay"
+    phases["variants"] = len(variants.plans)
+    t0 = _time.perf_counter()
+    dev = plan.dispatch(params or {})
+    phases["dispatchUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+    t0 = _time.perf_counter()
+    jax.block_until_ready(dev)
+    phases["deviceUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+    t0 = _time.perf_counter()
+    try:
+        rows = plan.materialize(dev, params or {})
+        variants.remember(params, plan)
+    except ScheduleOverflow:
+        rows = _run_variants(db, stmt, params, variants, tried=plan)
+        phases["mode"] = "overflow-variant"
+    phases["fetchMarshalUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+    solver = plan.solver
+    sched = getattr(solver, "sched", None)
+    if sched is not None:
+        phases["scheduleObserves"] = len(sched.values)
+        phases["scheduleSizes"] = sched.values[:32]
+    steps = getattr(solver, "plan", None)
+    if steps:
+        phases["steps"] = [s.describe() for s in steps]
+    return rows, phases

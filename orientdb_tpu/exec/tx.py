@@ -229,6 +229,11 @@ class Transaction:
             db._tx_suspended = True
             after_events: List = []
             db._tx_local.hook_buffer = after_events
+            # WAL ops buffer during apply and flush as ONE atomic entry
+            # only on success — compensation discards them, so the log
+            # never shows a half-commit (the [E] tx-boundary WAL records)
+            wal_ops: List = []
+            db._tx_local.wal_buffer = wal_ops
             try:
                 for doc in self.created:
                     temp = doc.rid
@@ -281,6 +286,12 @@ class Transaction:
             finally:
                 db._tx_suspended = False
                 db._tx_local.hook_buffer = None
+                db._tx_local.wal_buffer = None
+            if db._wal is not None and wal_ops and not db._wal.replaying:
+                db._wal.append({"op": "tx", "ops": wal_ops})
+            from orientdb_tpu.utils.metrics import metrics
+
+            metrics.incr("tx.commit")
             self.active = False
             db._end_tx(self)
             if db._hooks is not None:
@@ -296,7 +307,9 @@ class Transaction:
 
     def _fail_conflict(self, rid, stored_v, base_v):
         from orientdb_tpu.models.database import ConcurrentModificationError
+        from orientdb_tpu.utils.metrics import metrics
 
+        metrics.incr("tx.conflict")
         raise ConcurrentModificationError(
             f"{rid}: stored v{stored_v} != tx base v{base_v}"
         )

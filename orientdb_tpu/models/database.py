@@ -12,7 +12,10 @@ over immutable columnar *snapshots* built from this store (north-star
 design: MATCH is a read workload, writes stay in the host store).
 
 Durability is provided by the storage layer (``orientdb_tpu.storage``):
-JSON export/import (the §3.5 ingest path) and snapshot epochs.
+an op-level write-ahead log with checkpoint/recovery
+(``storage/durability.py`` — armed via ``enable_durability`` /
+``open_database``; the pure in-memory engine remains the default), plus
+portable JSON export/import (the §3.5 ingest path) and snapshot epochs.
 """
 
 from __future__ import annotations
@@ -95,6 +98,9 @@ class Database:
         self._snapshot_epoch = -1
         # Index manager is attached lazily by orientdb_tpu.models.indexes.
         self._indexes = None
+        # Sequence/function libraries (models/metadata.py), lazy.
+        self._sequences = None
+        self._functions = None
         # Hook manager ([E] ORecordHook registry) attached lazily.
         self._hooks = None
         # Optimistic transactions ([E] OTransactionOptimistic): one active
@@ -105,6 +111,27 @@ class Database:
         # Round-robin cluster selection per class ([E] cluster selection
         # strategies, SURVEY.md §2 "Clusters & RIDs").
         self._rr_state: Dict[str, int] = {}
+        # Write-ahead log (orientdb_tpu.storage.durability). None = the
+        # pure in-memory engine; armed via enable_durability/open_database.
+        self._wal = None
+        self._durability_dir = None
+
+    # -- WAL ---------------------------------------------------------------
+
+    def _wal_log(self, entry: Dict) -> None:
+        """Append a logical op to the WAL. During a transaction commit
+        apply (suspended writes) ops buffer and flush as ONE atomic tx
+        entry only after the commit succeeds — a compensated commit leaves
+        no WAL trace (see exec/tx.py)."""
+        w = self._wal
+        if w is None or w.replaying:
+            return
+        if self._tx_suspended:
+            buf = getattr(self._tx_local, "wal_buffer", None)
+            if buf is not None:
+                buf.append(entry)
+                return
+        w.append(entry)
 
     # -- cluster plumbing --------------------------------------------------
 
@@ -223,6 +250,10 @@ class Database:
                         doc.version = 0
                     raise
             self.mutation_epoch += 1
+            if self._wal is not None:
+                from orientdb_tpu.storage.durability import entry_for_save
+
+                self._wal_log(entry_for_save(doc, is_new))
             if self._hooks is not None:
                 self._hooks.fire("after_create" if is_new else "after_update", doc)
         return doc
@@ -260,12 +291,17 @@ class Database:
                     self._delete_edge(edge, fire_hooks=True)
             elif isinstance(doc, Edge):
                 self._delete_edge(doc)
-            if doc.rid.is_persistent:
+            was_persistent = doc.rid.is_persistent
+            if was_persistent:
                 if self._indexes is not None:
                     self._indexes.on_delete(doc)
                 self._cluster(doc.rid.cluster).tombstone(doc.rid.position)
             doc._deleted = True
             self.mutation_epoch += 1
+            if was_persistent and self._wal is not None:
+                from orientdb_tpu.storage.durability import entry_for_delete
+
+                self._wal_log(entry_for_delete(doc))
             if self._hooks is not None:
                 self._hooks.fire("after_delete", doc)
 
@@ -326,7 +362,23 @@ class Database:
             yield from c
 
     def count_class(self, class_name: str, polymorphic: bool = True) -> int:
-        return sum(1 for _ in self.browse_class(class_name, polymorphic))
+        tx = self.tx if not self._tx_suspended else None
+        if tx is not None:
+            return sum(1 for _ in self.browse_class(class_name, polymorphic))
+        # no tx overlay: tally cluster tombstone-free slots directly —
+        # planner estimates call this per query, so it must not iterate
+        # records ([E] OClass.count reads cluster sizes, not records)
+        cls = self.schema.get_class_or_raise(class_name)
+        cids = (
+            self.schema.polymorphic_cluster_ids(cls.name)
+            if polymorphic
+            else list(cls.cluster_ids)
+        )
+        return sum(
+            self._clusters[cid].live_count()
+            for cid in cids
+            if cid in self._clusters
+        )
 
     def drop_class(self, class_name: str) -> None:
         """Drop a schema class and its indexes (records are abandoned, as in
@@ -349,6 +401,26 @@ class Database:
 
             self._indexes = IndexManager(self)
         return self._indexes
+
+    # -- metadata: sequences & stored functions ----------------------------
+
+    @property
+    def sequences(self):
+        """[E] OSequenceLibrary."""
+        if self._sequences is None:
+            from orientdb_tpu.models.metadata import SequenceManager
+
+            self._sequences = SequenceManager(self)
+        return self._sequences
+
+    @property
+    def functions(self):
+        """[E] OFunctionLibrary."""
+        if self._functions is None:
+            from orientdb_tpu.models.metadata import FunctionManager
+
+            self._functions = FunctionManager(self)
+        return self._functions
 
     # -- hooks & transactions ----------------------------------------------
 

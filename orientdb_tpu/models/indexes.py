@@ -162,10 +162,20 @@ class IndexManager:
         for doc in self._db.browse_class(cls.name, polymorphic=True):
             idx.put(idx._key_of(doc), doc.rid)
         self._indexes[name.lower()] = idx
+        self._db._wal_log(
+            {
+                "op": "create_index",
+                "name": name,
+                "class": cls.name,
+                "fields": list(fields),
+                "type": index_type,
+            }
+        )
         return idx
 
     def drop_index(self, name: str) -> None:
-        self._indexes.pop(name.lower(), None)
+        if self._indexes.pop(name.lower(), None) is not None:
+            self._db._wal_log({"op": "drop_index", "name": name})
 
     def get_index(self, name: str) -> Optional[Index]:
         return self._indexes.get(name.lower())

@@ -1,0 +1,248 @@
+"""Schema-level stored functions and sequences.
+
+Analog of the reference's function/sequence metadata ([E]
+core/.../metadata/function/OFunction + core/.../metadata/sequence/
+OSequence, OSequenceLibrary — SURVEY.md §2 "Schema/metadata" row lists
+"functions, sequences" as part of the metadata surface).
+
+- ``Sequence`` — monotonic id generator: ``sequence('s').next()`` /
+  ``.current()`` / ``.reset()`` from SQL. ORDERED semantics (every next
+  durable when a WAL is armed); CACHED reserves ``cache`` ids per WAL
+  record, trading at-most-``cache`` lost ids on crash for fewer appends
+  (the reference's cached sequence makes the same trade).
+- ``StoredFunction`` — a named SQL statement or expression invocable as
+  ``name(args...)`` in any expression context ([E] OFunction with
+  language=SQL; the reference's javascript language has no sandboxed
+  analog here and is rejected).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from orientdb_tpu.exec.result import Result
+
+
+class SequenceError(Exception):
+    pass
+
+
+class Sequence:
+    __slots__ = ("name", "seq_type", "start", "increment", "cache", "_value",
+                 "_reserved_until", "_db", "_lock")
+
+    def __init__(self, db, name, seq_type="ORDERED", start=0, increment=1, cache=20):
+        self.name = name
+        self.seq_type = seq_type.upper()
+        self.start = start
+        self.increment = increment
+        self.cache = max(1, cache)
+        self._value = start
+        self._reserved_until = start
+        self._db = db
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += self.increment
+            if self._db is not None and self._db._wal is not None:
+                if self.seq_type == "CACHED":
+                    # reserve a block: replay resumes past the reservation,
+                    # losing at most `cache` ids on crash
+                    if (self._value - self._reserved_until) * self.increment >= 0:
+                        self._reserved_until = (
+                            self._value + self.increment * self.cache
+                        )
+                        self._db._wal_log(
+                            {"op": "seq_set", "name": self.name,
+                             "value": self._reserved_until}
+                        )
+                else:
+                    self._db._wal_log(
+                        {"op": "seq_set", "name": self.name, "value": self._value}
+                    )
+            return self._value
+
+    def current(self) -> int:
+        return self._value
+
+    def reset(self) -> int:
+        with self._lock:
+            self._value = self.start
+            self._reserved_until = self.start
+            if self._db is not None and self._db._wal is not None:
+                self._db._wal_log(
+                    {"op": "seq_set", "name": self.name, "value": self._value}
+                )
+            return self._value
+
+    def set_value(self, v: int) -> None:
+        with self._lock:
+            self._value = v
+            self._reserved_until = v
+
+    def __repr__(self) -> str:
+        return f"Sequence({self.name}={self._value})"
+
+
+class SequenceManager:
+    """[E] OSequenceLibrary."""
+
+    def __init__(self, db) -> None:
+        self._db = db
+        self._seqs: Dict[str, Sequence] = {}
+
+    def create(self, name, seq_type="ORDERED", start=0, increment=1, cache=20) -> Sequence:
+        key = name.lower()
+        if key in self._seqs:
+            raise SequenceError(f"sequence '{name}' already exists")
+        if seq_type.upper() not in ("ORDERED", "CACHED"):
+            raise SequenceError(f"unknown sequence type {seq_type!r}")
+        s = Sequence(self._db, name, seq_type, start, increment, cache)
+        self._seqs[key] = s
+        self._db._wal_log(
+            {
+                "op": "create_sequence",
+                "name": name,
+                "type": s.seq_type,
+                "start": start,
+                "increment": increment,
+                "cache": cache,
+            }
+        )
+        return s
+
+    def get(self, name: str) -> Optional[Sequence]:
+        return self._seqs.get(name.lower())
+
+    def get_or_raise(self, name: str) -> Sequence:
+        s = self.get(name)
+        if s is None:
+            raise SequenceError(f"sequence '{name}' not found")
+        return s
+
+    def drop(self, name: str) -> None:
+        if self._seqs.pop(name.lower(), None) is not None:
+            self._db._wal_log({"op": "drop_sequence", "name": name})
+
+    def alter(self, name, start=None, increment=None, cache=None) -> Sequence:
+        s = self.get_or_raise(name)
+        if start is not None:
+            s.start = start
+            s.set_value(start)
+        if increment is not None:
+            s.increment = increment
+        if cache is not None:
+            s.cache = max(1, cache)
+        self._db._wal_log(
+            {
+                "op": "create_sequence",  # idempotent re-spec on replay
+                "name": s.name,
+                "type": s.seq_type,
+                "start": s.start,
+                "increment": s.increment,
+                "cache": s.cache,
+                "alter": True,
+            }
+        )
+        return s
+
+    def all(self) -> List[Sequence]:
+        return list(self._seqs.values())
+
+
+class FunctionError(Exception):
+    pass
+
+
+class StoredFunction:
+    __slots__ = ("name", "parameters", "body", "language", "idempotent", "_compiled")
+
+    def __init__(self, name, body, parameters=(), language="sql", idempotent=True):
+        self.name = name
+        self.body = body
+        self.parameters = list(parameters)
+        self.language = language.lower()
+        self.idempotent = idempotent
+        self._compiled = None
+
+    def _compile(self):
+        if self._compiled is None:
+            from orientdb_tpu.sql.parser import ParseError, parse
+
+            try:
+                self._compiled = ("stmt", parse(self.body))
+            except ParseError:
+                # an expression body: wrap as a SELECT projection
+                self._compiled = ("expr", parse(f"SELECT {self.body} AS result"))
+        return self._compiled
+
+    def invoke(self, db, args, parent_ctx=None):
+        """Run the function body with the declared parameter names bound
+        as context VARIABLES (the body references them bare, the way [E]
+        OFunction binds its parameters); returns the scalar for expression
+        bodies, the row list otherwise."""
+        if len(args) > len(self.parameters):
+            raise FunctionError(
+                f"function '{self.name}' takes {len(self.parameters)} args"
+            )
+        from orientdb_tpu.exec.eval import EvalContext
+        from orientdb_tpu.exec.oracle import execute_statement
+
+        call_ctx = EvalContext(db, params={}, parent=parent_ctx)
+        for i, p in enumerate(self.parameters):
+            call_ctx.variables[p] = args[i] if i < len(args) else None
+        kind, stmt = self._compile()
+        rows = execute_statement(db, stmt, {}, parent_ctx=call_ctx)
+        if kind == "expr":
+            return rows[0].get_property("result") if rows else None
+        out = [r.element if r.is_element else r for r in rows]
+        return out
+
+
+class FunctionManager:
+    """[E] OFunctionLibrary-ish registry."""
+
+    def __init__(self, db) -> None:
+        self._db = db
+        self._fns: Dict[str, StoredFunction] = {}
+
+    def create(self, name, body, parameters=(), language="sql", idempotent=True) -> StoredFunction:
+        key = name.lower()
+        if key in self._fns:
+            raise FunctionError(f"function '{name}' already exists")
+        if language.lower() not in ("sql",):
+            raise FunctionError(
+                f"language {language!r} not supported (sql only; the "
+                "reference's javascript has no sandboxed analog here)"
+            )
+        f = StoredFunction(name, body, parameters, language, idempotent)
+        # compile eagerly: a syntactically bad body fails at CREATE
+        f._compile()
+        self._fns[key] = f
+        self._db._wal_log(
+            {
+                "op": "create_function",
+                "name": name,
+                "body": body,
+                "parameters": list(parameters),
+                "language": language,
+                "idempotent": idempotent,
+            }
+        )
+        return f
+
+    def get(self, name: str) -> Optional[StoredFunction]:
+        return self._fns.get(name.lower())
+
+    def drop(self, name: str) -> None:
+        if self._fns.pop(name.lower(), None) is not None:
+            self._db._wal_log({"op": "drop_function", "name": name})
+
+    def all(self) -> List[StoredFunction]:
+        return list(self._fns.values())
+
+
+def rows_for(op: str, **props) -> List[Result]:
+    return [Result(props={"operation": op, **props})]

@@ -169,6 +169,23 @@ class SchemaClass:
             raise ValueError(f"property '{name}' already exists on {self.name}")
         prop = Property(name, ptype, **kw)
         self.properties[name] = prop
+        if self._schema.on_ddl is not None:
+            self._schema.on_ddl(
+                {
+                    "op": "create_property",
+                    "class": self.name,
+                    "name": name,
+                    "ptype": ptype.value,
+                    "kw": {
+                        "mandatory": prop.mandatory,
+                        "not_null": prop.not_null,
+                        "read_only": prop.read_only,
+                        "min_value": prop.min_value,
+                        "max_value": prop.max_value,
+                        "linked_class": prop.linked_class,
+                    },
+                }
+            )
         return prop
 
     def get_property(self, name: str) -> Optional[Property]:
@@ -221,6 +238,9 @@ class Schema:
         self._classes: Dict[str, SchemaClass] = {}
         self._next_cluster = Schema.FIRST_USER_CLUSTER
         self._cluster_to_class: Dict[int, str] = {}
+        # DDL observer (the WAL hooks in here when durability is armed —
+        # orientdb_tpu.storage.durability). None while bootstrapping.
+        self.on_ddl = None
         # Bootstrap the graph roots, like OrientDB's default V / E classes.
         self.create_class("V")
         self.create_class("E")
@@ -247,6 +267,16 @@ class Schema:
         self._classes[name.lower()] = cls
         for cid in ids:
             self._cluster_to_class[cid] = name
+        if self.on_ddl is not None:
+            self.on_ddl(
+                {
+                    "op": "create_class",
+                    "name": cls.name,
+                    "superclasses": list(cls.superclass_names),
+                    "abstract": abstract,
+                    "clusters": clusters,
+                }
+            )
         return cls
 
     def create_vertex_class(self, name: str, **kw) -> SchemaClass:
@@ -272,6 +302,8 @@ class Schema:
         for cid in cls.cluster_ids:
             self._cluster_to_class.pop(cid, None)
         del self._classes[name.lower()]
+        if self.on_ddl is not None:
+            self.on_ddl({"op": "drop_class", "name": cls.name})
 
     def exists_class(self, name: str) -> bool:
         return self.get_class(name) is not None
@@ -291,6 +323,8 @@ class Schema:
         cid = self._allocate_cluster()
         cls.cluster_ids.append(cid)
         self._cluster_to_class[cid] = cls.name
+        if self.on_ddl is not None:
+            self.on_ddl({"op": "add_cluster", "class": cls.name})
         return cid
 
     def class_of_cluster(self, cluster_id: int) -> Optional[SchemaClass]:

@@ -46,6 +46,55 @@ class Uncompilable(Exception):
     """Expression outside the columnar subset; caller falls back."""
 
 
+def split_params(params: Dict) -> Tuple[Dict[object, str], Dict[object, object]]:
+    """Partition query parameters into *dynamic* (numeric — become jit
+    arguments of the cached plan, so one compiled plan serves every value)
+    and *static* (everything else — baked into the compiled predicates, so
+    their values join the plan-cache key). int32 range is the TPU-native
+    integer width; out-of-range ints stay static and hit `_const_val`'s
+    range gate (→ oracle fallback)."""
+    dyn: Dict[object, str] = {}
+    static: Dict[object, object] = {}
+    for k, v in params.items():
+        if isinstance(v, bool):
+            dyn[k] = "bool"
+        elif isinstance(v, int) and -(2**31) < v < 2**31:
+            dyn[k] = "int"
+        elif isinstance(v, float):
+            dyn[k] = "float"
+        else:
+            static[k] = v
+    return dyn, static
+
+
+class ParamBox:
+    """Mutable parameter environment shared by a solver's compiled
+    predicate closures.
+
+    Recording runs read the concrete values from ``current``; a cached
+    plan's replay swaps traced jit-argument scalars into ``current`` for
+    the duration of the trace, making every numeric parameter a runtime
+    input of ONE compiled executable instead of a compile-time constant
+    (the [E] OExecutionPlanCache caches per *statement*, not per binding
+    set — this is the TPU-native equivalent)."""
+
+    def __init__(self, params: Dict) -> None:
+        self.initial = dict(params)
+        self.current = dict(params)
+        self.dynamic, self.static = split_params(params)
+        #: dynamic keys actually referenced by some compiled predicate
+        self.used: Dict[object, str] = {}
+
+    def __contains__(self, k) -> bool:
+        return k in self.initial
+
+    def set_current(self, values: Dict) -> None:
+        self.current = {**self.initial, **values}
+
+    def reset(self) -> None:
+        self.current = dict(self.initial)
+
+
 class ColumnScope:
     """Resolves bare field names for one predicate scope (a vertex alias or
     an edge class' property columns)."""
@@ -156,13 +205,11 @@ class Compiler:
         if isinstance(expr, A.Literal):
             return _const_val(expr.value)
         if isinstance(expr, A.Parameter):
-            if expr.name is not None:
-                if expr.name not in self.params:
-                    raise Uncompilable(f"missing parameter :{expr.name}")
-                return _const_val(self.params[expr.name])
-            if expr.index not in self.params:
-                raise Uncompilable(f"missing positional parameter ?{expr.index}")
-            return _const_val(self.params[expr.index])
+            key = expr.name if expr.name is not None else expr.index
+            if key not in self.params:
+                sig = f":{expr.name}" if expr.name is not None else f"?{expr.index}"
+                raise Uncompilable(f"missing parameter {sig}")
+            return self._param_val(key)
         if isinstance(expr, A.Identifier):
             col = self.scope.resolve(expr.name)
             if col is None:
@@ -195,6 +242,27 @@ class Compiler:
         if isinstance(expr, A.Binary) and expr.op in ("+", "-", "*", "/", "%"):
             return self._arith(expr)
         raise Uncompilable(f"expression {type(expr).__name__} not columnar")
+
+    def _param_val(self, key) -> _Val:
+        """A parameter reference: dynamic numerics read the box's current
+        value (a concrete number while recording, a traced jit argument on
+        replay); everything else bakes as a constant."""
+        box = self.params
+        if not isinstance(box, ParamBox) or key not in box.dynamic:
+            v = box.initial[key] if isinstance(box, ParamBox) else box[key]
+            return _const_val(v)
+        kind = box.dynamic[key]
+        box.used[key] = kind
+        dtype = jnp.float32 if kind == "float" else jnp.int32
+
+        def emit(idx, env, box=box, key=key, dtype=dtype):
+            v = jnp.asarray(box.current[key]).astype(dtype)
+            return (
+                jnp.broadcast_to(v, idx.shape),
+                jnp.ones(idx.shape, bool),
+            )
+
+        return _Val(kind, emit)
 
     def _arith(self, expr: A.Binary) -> _Val:
         a = self._value(expr.left)

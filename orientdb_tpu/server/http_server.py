@@ -108,6 +108,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(
                     200, {"databases": sorted(self.server.ot_server.databases)}
                 )
+            if head == "metrics":
+                # the [E] /profiler analog (SURVEY.md §5.1/§5.5): process
+                # counters + duration stats as JSON
+                from orientdb_tpu.utils.metrics import metrics
+
+                return self._send(200, metrics.snapshot())
             if head == "database" and rest:
                 db = self._db(rest[0])
                 if db is None:

@@ -57,11 +57,43 @@ class Server:
 
     # -- databases ----------------------------------------------------------
 
+    _DB_NAME_RE = None  # compiled lazily
+
+    @classmethod
+    def _check_db_name(cls, name: str) -> None:
+        """Database names become directory names under wal_dir — reject
+        anything that could traverse out of it (client-supplied via the
+        HTTP/binary create-database endpoints)."""
+        import re
+
+        if cls._DB_NAME_RE is None:
+            cls._DB_NAME_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*\Z")
+        if (
+            not name
+            or len(name) > 128
+            or ".." in name
+            or not cls._DB_NAME_RE.match(name)
+        ):
+            raise ValueError(f"invalid database name {name!r}")
+
     def create_database(self, name: str) -> Database:
         with self._lock:
+            self._check_db_name(name)
             if name in self.databases:
                 raise ValueError(f"database '{name}' exists")
-            db = self.databases[name] = Database(name)
+            from orientdb_tpu.utils.config import config
+
+            if config.wal_enabled and config.wal_dir:
+                # durable server databases: recover-or-create under
+                # <wal_dir>/<name> (the plocal-analog path)
+                from orientdb_tpu.storage.durability import open_database
+
+                import os
+
+                db = open_database(os.path.join(config.wal_dir, name), name)
+            else:
+                db = Database(name)
+            self.databases[name] = db
             return db
 
     def get_database(self, name: str) -> Optional[Database]:

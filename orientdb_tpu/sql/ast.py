@@ -425,6 +425,46 @@ class AlterPropertyStatement(Statement):
     value: Expression
 
 
+@dataclasses.dataclass(frozen=True)
+class CreateSequenceStatement(Statement):
+    """[E] OSequence DDL: CREATE SEQUENCE s TYPE ORDERED START n INCREMENT n."""
+
+    name: str
+    seq_type: str = "ORDERED"
+    start: int = 0
+    increment: int = 1
+    cache: int = 20
+
+
+@dataclasses.dataclass(frozen=True)
+class AlterSequenceStatement(Statement):
+    name: str
+    start: Optional[int] = None
+    increment: Optional[int] = None
+    cache: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DropSequenceStatement(Statement):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateFunctionStatement(Statement):
+    """[E] OFunction DDL: CREATE FUNCTION name "body" PARAMETERS [a,b]."""
+
+    name: str
+    body: str
+    parameters: Tuple[str, ...] = ()
+    idempotent: bool = True
+    language: str = "sql"
+
+
+@dataclasses.dataclass(frozen=True)
+class DropFunctionStatement(Statement):
+    name: str
+
+
 # -- misc -------------------------------------------------------------------
 
 

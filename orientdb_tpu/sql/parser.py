@@ -799,7 +799,52 @@ class Parser:
                     cls, from_expr, to_expr, content=self.parse_expression()
                 )
             return A.CreateEdgeStatement(cls, from_expr, to_expr)
+        if self.try_kw("SEQUENCE"):
+            name = self.eat_ident()
+            seq_type, start, increment, cache = "ORDERED", 0, 1, 20
+            while True:
+                if self.try_kw("TYPE"):
+                    seq_type = self.eat_ident().upper()
+                elif self.try_kw("START"):
+                    start = self._int_value()
+                elif self.try_kw("INCREMENT"):
+                    increment = self._int_value()
+                elif self.try_kw("CACHE"):
+                    cache = self._int_value()
+                else:
+                    break
+            return A.CreateSequenceStatement(name, seq_type, start, increment, cache)
+        if self.try_kw("FUNCTION"):
+            name = self.eat_ident()
+            t = self.next()
+            if t.kind != "STRING":
+                raise ParseError("expected quoted function body", t)
+            body = t.value
+            parameters: Tuple[str, ...] = ()
+            idempotent = True
+            language = "sql"
+            while True:
+                if self.try_kw("PARAMETERS"):
+                    self.eat_op("[")
+                    parameters = tuple(self.parse_name_list())
+                    self.eat_op("]")
+                elif self.try_kw("IDEMPOTENT"):
+                    v = self.next()
+                    idempotent = str(v.value).lower() == "true"
+                elif self.try_kw("LANGUAGE"):
+                    language = self.eat_ident().lower()
+                else:
+                    break
+            return A.CreateFunctionStatement(name, body, parameters, idempotent, language)
         raise ParseError("unsupported CREATE", self.peek())
+
+    def _int_value(self) -> int:
+        neg = self.try_op("-")
+        t = self.next()
+        if t.kind != "NUMBER":
+            raise ParseError("expected number", t)
+        v = int(t.value)
+        return -v if neg else v
 
     def parse_from_to_operand(self) -> A.Expression:
         """CREATE EDGE FROM/TO operand: RID, (subquery), list, or param."""
@@ -838,10 +883,27 @@ class Parser:
                 self.next()
                 name += "." + self.eat_ident()
             return A.DropIndexStatement(name)
+        if self.try_kw("SEQUENCE"):
+            return A.DropSequenceStatement(self.eat_ident())
+        if self.try_kw("FUNCTION"):
+            return A.DropFunctionStatement(self.eat_ident())
         raise ParseError("unsupported DROP", self.peek())
 
     def parse_alter(self) -> A.Statement:
         self.eat_kw("ALTER")
+        if self.try_kw("SEQUENCE"):
+            name = self.eat_ident()
+            start = increment = cache = None
+            while True:
+                if self.try_kw("START"):
+                    start = self._int_value()
+                elif self.try_kw("INCREMENT"):
+                    increment = self._int_value()
+                elif self.try_kw("CACHE"):
+                    cache = self._int_value()
+                else:
+                    break
+            return A.AlterSequenceStatement(name, start, increment, cache)
         self.eat_kw("PROPERTY")
         cls = self.eat_ident()
         self.eat_op(".")

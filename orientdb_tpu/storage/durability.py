@@ -1,0 +1,690 @@
+"""Durable storage for the host record store: WAL + checkpoints.
+
+The reference's durability stack is a page-oriented WAL with fuzzy/full
+checkpoints and crash-recovery replay ([E]
+core/.../storage/impl/local/paginated/wal/ `CASDiskWriteAheadLog`,
+`OLogSequenceNumber`; SURVEY.md §2 "WAL", §3.4, §5.4). This redesign
+logs *logical* operations instead of page deltas — the host store is an
+in-RAM object store whose pages don't exist; what must survive a crash
+is the op stream:
+
+- ``WriteAheadLog`` — append-only file of CRC-framed JSON entries, each
+  carrying a monotonically increasing LSN. A torn tail (crash mid-append)
+  is detected by the CRC/framing and discarded, which is exactly the
+  atomicity boundary: entries are whole or gone.
+- transactions commit as ONE ``{"op": "tx", "ops": [...]}`` entry,
+  appended only after the in-memory commit succeeded — a crash between
+  apply and append loses the tx wholesale (it was never acknowledged
+  durable), never partially ([E] OTransactionOptimistic's all-or-nothing
+  commit, SURVEY.md §3.4).
+- ``checkpoint(db)`` — RID-faithful full snapshot of schema + clusters +
+  indexes (the [E] full-checkpoint analog), stamped with the mutation
+  epoch and the last LSN it covers; recovery loads the newest valid
+  checkpoint and replays only WAL entries with ``lsn >`` that.
+- ``open_database(dir)`` — recovery entry point: checkpoint load + WAL
+  tail replay + re-arm logging.
+
+Unlike EXPORT/IMPORT (``storage/ingest.py``), which remaps RIDs for
+portability, everything here preserves RIDs exactly — WAL entries
+reference records by RID, so the checkpoint beneath them must too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.record import Direction, Document, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.schema import PropertyType
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("durability")
+
+WAL_FILE = "wal.log"
+CHECKPOINT_PREFIX = "checkpoint-"
+
+
+# ---------------------------------------------------------------------------
+# value codec (RID-faithful; contrast ingest._value_to_json which remaps)
+# ---------------------------------------------------------------------------
+
+
+def _enc(v):
+    if isinstance(v, RID):
+        return {"@link": str(v)}
+    if isinstance(v, Document):
+        return {"@link": str(v.rid)}
+    if isinstance(v, (list, tuple)):
+        return [_enc(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _enc(x) for k, x in v.items()}
+    return v
+
+
+def _dec(v):
+    if isinstance(v, dict):
+        if "@link" in v and len(v) == 1:
+            return RID.parse(v["@link"])
+        return {k: _dec(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_dec(x) for x in v]
+    return v
+
+
+def _enc_fields(doc: Document) -> Dict:
+    return {k: _enc(v) for k, v in doc.fields().items()}
+
+
+# ---------------------------------------------------------------------------
+# the WAL
+# ---------------------------------------------------------------------------
+
+
+class WriteAheadLog:
+    """Append-only logical op log with CRC framing and LSNs.
+
+    Line format: ``<crc32-hex-8> <json>\\n`` where the CRC covers the JSON
+    bytes. Reading stops at the first torn/corrupt line — everything
+    before it is durable, everything from it on never happened."""
+
+    def __init__(self, path: str, fsync: Optional[bool] = None) -> None:
+        self.path = path
+        self.fsync = config.wal_fsync if fsync is None else fsync
+        self.next_lsn = 1
+        self.replaying = False
+        self._fh = None
+
+    # -- append ------------------------------------------------------------
+
+    def _handle(self):
+        if self._fh is None:
+            self._fh = open(self.path, "ab")
+        return self._fh
+
+    def append(self, entry: Dict) -> int:
+        lsn = self.next_lsn
+        self.next_lsn += 1
+        entry = {"lsn": lsn, **entry}
+        data = json.dumps(entry, separators=(",", ":")).encode()
+        line = b"%08x %s\n" % (zlib.crc32(data) & 0xFFFFFFFF, data)
+        fh = self._handle()
+        fh.write(line)
+        fh.flush()
+        if self.fsync:
+            os.fsync(fh.fileno())
+        metrics.incr("wal.append")
+        return lsn
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- read --------------------------------------------------------------
+
+    def read_entries(self) -> List[Dict]:
+        """All intact entries, in order; a torn/corrupt tail is dropped."""
+        if not os.path.exists(self.path):
+            return []
+        out: List[Dict] = []
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        for line in raw.split(b"\n"):
+            if not line:
+                continue
+            if len(line) < 10 or line[8:9] != b" ":
+                log.warning("wal: torn/corrupt line after lsn=%s; truncating",
+                            out[-1]["lsn"] if out else 0)
+                break
+            crc_hex, data = line[:8], line[9:]
+            try:
+                if int(crc_hex, 16) != (zlib.crc32(data) & 0xFFFFFFFF):
+                    log.warning("wal: CRC mismatch after lsn=%s; truncating",
+                                out[-1]["lsn"] if out else 0)
+                    break
+                out.append(json.loads(data))
+            except Exception:
+                log.warning("wal: undecodable line; truncating tail")
+                break
+        return out
+
+    def reset(self) -> None:
+        """Truncate after a checkpoint has made the log redundant."""
+        self.close()
+        with open(self.path, "wb"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# entry construction (called from Database/Schema/IndexManager hooks)
+# ---------------------------------------------------------------------------
+
+
+def entry_for_save(doc: Document, is_new: bool) -> Dict:
+    if is_new:
+        e: Dict = {
+            "op": "create",
+            "rid": str(doc.rid),
+            "class": doc.class_name,
+            "type": (
+                "vertex"
+                if isinstance(doc, Vertex)
+                else "edge" if isinstance(doc, Edge) else "document"
+            ),
+            "version": doc.version,
+            "fields": _enc_fields(doc),
+        }
+        if isinstance(doc, Edge):
+            e["out"] = str(doc.out_rid)
+            e["in"] = str(doc.in_rid)
+        return e
+    return {
+        "op": "update",
+        "rid": str(doc.rid),
+        "version": doc.version,
+        "fields": _enc_fields(doc),
+    }
+
+
+def entry_for_delete(doc: Document) -> Dict:
+    return {"op": "delete", "rid": str(doc.rid)}
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+
+def _place(db: Database, rid: RID, doc: Document) -> None:
+    c = db._cluster(rid.cluster)
+    while len(c.records) <= rid.position:
+        c.records.append(None)
+    c.records[rid.position] = doc
+
+
+def _apply_entry(db: Database, e: Dict) -> None:
+    op = e["op"]
+    if op == "tx":
+        for sub in e["ops"]:
+            _apply_entry(db, sub)
+        return
+    if op == "create":
+        rid = RID.parse(e["rid"])
+        fields = {k: _dec(v) for k, v in e["fields"].items()}
+        typ = e["type"]
+        if typ == "vertex":
+            doc: Document = Vertex(e["class"], fields)
+        elif typ == "edge":
+            doc = Edge(e["class"], fields)
+            doc.out_rid = RID.parse(e["out"])
+            doc.in_rid = RID.parse(e["in"])
+        else:
+            doc = Document(e["class"], fields)
+        doc._db = db
+        doc.rid = rid
+        doc.version = e.get("version", 1)
+        _place(db, rid, doc)
+        if db._indexes is not None:
+            db._indexes.on_save(doc)
+        if isinstance(doc, Edge):
+            # re-wire adjacency exactly as new_edge does
+            src = db._load_raw(doc.out_rid)
+            dst = db._load_raw(doc.in_rid)
+            if isinstance(src, Vertex):
+                bag = src._bag(Direction.OUT, doc.class_name)
+                if rid not in bag:
+                    bag.append(rid)
+                    src.version += 1
+            if isinstance(dst, Vertex):
+                bag = dst._bag(Direction.IN, doc.class_name)
+                if rid not in bag:
+                    bag.append(rid)
+                    dst.version += 1
+        db.mutation_epoch += 1
+    elif op == "update":
+        rid = RID.parse(e["rid"])
+        doc = db._load_raw(rid)
+        if doc is None:
+            log.warning("wal replay: update of missing %s skipped", rid)
+            return
+        if db._indexes is not None:
+            db._indexes.on_delete(doc)
+        doc._fields = {k: _dec(v) for k, v in e["fields"].items()}
+        doc.version = e["version"]
+        if db._indexes is not None:
+            db._indexes.on_save(doc)
+        db.mutation_epoch += 1
+    elif op == "delete":
+        rid = RID.parse(e["rid"])
+        doc = db._load_raw(rid)
+        if doc is not None:
+            db.delete(doc)  # cascades exactly as the original did
+    elif op == "create_class":
+        db.schema.create_class(
+            e["name"],
+            superclasses=e.get("superclasses", ()),
+            abstract=e.get("abstract", False),
+            clusters=e.get("clusters", 1),
+        )
+    elif op == "create_property":
+        cls = db.schema.get_class_or_raise(e["class"])
+        cls.create_property(
+            e["name"], PropertyType(e["ptype"]), **e.get("kw", {})
+        )
+    elif op == "alter_property":
+        cls = db.schema.get_class_or_raise(e["class"])
+        prop = cls.get_property(e["name"])
+        if prop is not None:
+            attr, v = e["attribute"], e["value"]
+            if attr == "MANDATORY":
+                prop.mandatory = bool(v)
+            elif attr == "NOTNULL":
+                prop.not_null = bool(v)
+            elif attr == "READONLY":
+                prop.read_only = bool(v)
+            elif attr == "MIN":
+                prop.min_value = v
+            elif attr == "MAX":
+                prop.max_value = v
+    elif op == "drop_class":
+        db.schema.drop_class(e["name"])
+    elif op == "add_cluster":
+        db.schema.add_cluster(e["class"])
+    elif op == "create_index":
+        db.indexes.create_index(e["name"], e["class"], e["fields"], e["type"])
+    elif op == "drop_index":
+        db.indexes.drop_index(e["name"])
+    elif op == "create_sequence":
+        if e.get("alter") and db.sequences.get(e["name"]) is not None:
+            db.sequences.alter(
+                e["name"], e.get("start"), e.get("increment"), e.get("cache")
+            )
+        else:
+            db.sequences.create(
+                e["name"], e.get("type", "ORDERED"), e.get("start", 0),
+                e.get("increment", 1), e.get("cache", 20),
+            )
+    elif op == "drop_sequence":
+        db.sequences.drop(e["name"])
+    elif op == "seq_set":
+        s = db.sequences.get(e["name"])
+        if s is not None:
+            s.set_value(e["value"])
+    elif op == "create_function":
+        db.functions.create(
+            e["name"], e["body"], e.get("parameters", ()),
+            language=e.get("language", "sql"),
+            idempotent=e.get("idempotent", True),
+        )
+    elif op == "drop_function":
+        db.functions.drop(e["name"])
+    else:
+        log.warning("wal replay: unknown op %r skipped", op)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint (RID-faithful full snapshot)
+# ---------------------------------------------------------------------------
+
+
+def _checkpoint_payload(db: Database) -> Dict:
+    classes = []
+    for cls in db.schema.classes():
+        classes.append(
+            {
+                "name": cls.name,
+                "superclasses": cls.superclass_names,
+                "abstract": cls.abstract,
+                "cluster_ids": list(cls.cluster_ids),
+                "properties": [
+                    {
+                        "name": p.name,
+                        "type": p.type.value,
+                        "mandatory": p.mandatory,
+                        "notNull": p.not_null,
+                        "readOnly": p.read_only,
+                        "min": p.min_value,
+                        "max": p.max_value,
+                        "linkedClass": p.linked_class,
+                    }
+                    for p in cls.properties.values()
+                ],
+            }
+        )
+    indexes = [
+        {"name": i.name, "class": i.class_name, "fields": i.fields, "type": i.type}
+        for i in (db._indexes.all() if db._indexes is not None else [])
+    ]
+    clusters = {}
+    for cid, c in db._clusters.items():
+        recs = []
+        for pos, doc in enumerate(c.records):
+            if doc is None:
+                continue
+            r: Dict = {
+                "pos": pos,
+                "class": doc.class_name,
+                "type": (
+                    "vertex"
+                    if isinstance(doc, Vertex)
+                    else "edge" if isinstance(doc, Edge) else "document"
+                ),
+                "version": doc.version,
+                "fields": _enc_fields(doc),
+            }
+            if isinstance(doc, Edge):
+                r["out"] = str(doc.out_rid)
+                r["in"] = str(doc.in_rid)
+            if isinstance(doc, Vertex):
+                bags = {}
+                for dname, table in (("out", doc._out_edges), ("in", doc._in_edges)):
+                    b = {k: [str(x) for x in v] for k, v in table.items() if v}
+                    if b:
+                        bags[dname] = b
+                if bags:
+                    r["bags"] = bags
+            recs.append(r)
+        clusters[str(cid)] = {"len": len(c.records), "records": recs}
+    sequences = [
+        {
+            "name": s.name,
+            "type": s.seq_type,
+            "start": s.start,
+            "increment": s.increment,
+            "cache": s.cache,
+            "value": s.current(),
+        }
+        for s in (db._sequences.all() if db._sequences is not None else [])
+    ]
+    functions = [
+        {
+            "name": f.name,
+            "body": f.body,
+            "parameters": list(f.parameters),
+            "language": f.language,
+            "idempotent": f.idempotent,
+        }
+        for f in (db._functions.all() if db._functions is not None else [])
+    ]
+    return {
+        "format": 1,
+        "name": db.name,
+        "epoch": db.mutation_epoch,
+        "next_cluster": db.schema._next_cluster,
+        "classes": classes,
+        "indexes": indexes,
+        "sequences": sequences,
+        "functions": functions,
+        "clusters": clusters,
+        "rr_state": dict(db._rr_state),
+    }
+
+
+def _ckpt_lsn_from_name(filename: str) -> int:
+    """checkpoint-<epoch>-<lsn>-<digest>.json → lsn (0 if unparsable)."""
+    try:
+        return int(filename[len(CHECKPOINT_PREFIX):].split("-")[1])
+    except (IndexError, ValueError):
+        return 0
+
+
+def checkpoint(db: Database, directory: Optional[str] = None) -> str:
+    """Write a full checkpoint; returns its path. With an attached WAL the
+    checkpoint records the last covered LSN and ARCHIVES the log segment
+    (``wal-<uptolsn>.log``) rather than deleting it — recovery that has to
+    fall back to an older checkpoint (newest corrupt) replays the archived
+    segments between the two, so no acknowledged write is ever lost (the
+    [E] full-checkpoint + WAL-segment cut behavior)."""
+    directory = directory or _dir_of(db)
+    os.makedirs(directory, exist_ok=True)
+    payload = _checkpoint_payload(db)
+    wal: Optional[WriteAheadLog] = getattr(db, "_wal", None)
+    payload["lsn"] = (wal.next_lsn - 1) if wal is not None else 0
+    data = json.dumps(payload, separators=(",", ":")).encode()
+    digest = format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
+    name = (
+        f"{CHECKPOINT_PREFIX}{payload['epoch']:012d}-"
+        f"{payload['lsn']:012d}-{digest}.json"
+    )
+    path = os.path.join(directory, name)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # atomic publish
+    if wal is not None:
+        upto = payload["lsn"]
+        wal.close()
+        if upto > 0 and os.path.exists(wal.path):
+            os.replace(
+                wal.path, os.path.join(directory, f"wal-{upto:012d}.log")
+            )
+        wal.next_lsn = upto + 1
+    # retire older checkpoints (keep the newest two for paranoia) and WAL
+    # archives fully covered by the oldest KEPT checkpoint
+    cps = sorted(
+        p for p in os.listdir(directory) if p.startswith(CHECKPOINT_PREFIX)
+    )
+    for old in cps[:-2]:
+        try:
+            os.remove(os.path.join(directory, old))
+        except OSError:
+            pass
+    kept = cps[-2:]
+    if kept:
+        oldest_kept_lsn = min(_ckpt_lsn_from_name(c) for c in kept)
+        for f2 in os.listdir(directory):
+            if f2.startswith("wal-") and f2.endswith(".log"):
+                try:
+                    if int(f2[4:-4]) <= oldest_kept_lsn:
+                        os.remove(os.path.join(directory, f2))
+                except (ValueError, OSError):
+                    pass
+    return path
+
+
+def _load_checkpoint(db: Database, path: str) -> int:
+    with open(path, "rb") as f:
+        payload = json.loads(f.read())
+    schema = db.schema
+    # classes: fixpoint loop honors superclass order; cluster ids forced
+    # to the checkpointed values (V/E already exist from bootstrap)
+    pending = [c for c in payload["classes"]]
+    while pending:
+        progressed = False
+        for entry in list(pending):
+            if not all(schema.exists_class(s) for s in entry["superclasses"]):
+                continue
+            cls = schema.get_class(entry["name"])
+            if cls is None:
+                cls = schema.create_class(
+                    entry["name"],
+                    superclasses=entry["superclasses"],
+                    abstract=entry["abstract"],
+                    clusters=0,
+                )
+            # force exact cluster ids
+            for cid in cls.cluster_ids:
+                schema._cluster_to_class.pop(cid, None)
+            cls.cluster_ids = list(entry["cluster_ids"])
+            for cid in cls.cluster_ids:
+                schema._cluster_to_class[cid] = cls.name
+            for p in entry["properties"]:
+                if cls.get_property(p["name"]) is None:
+                    cls.create_property(
+                        p["name"],
+                        PropertyType(p["type"]),
+                        mandatory=p["mandatory"],
+                        not_null=p["notNull"],
+                        read_only=p.get("readOnly", False),
+                        min_value=p.get("min"),
+                        max_value=p.get("max"),
+                        linked_class=p.get("linkedClass"),
+                    )
+            pending.remove(entry)
+            progressed = True
+        if not progressed:
+            raise ValueError(f"checkpoint schema unresolvable: {pending}")
+    schema._next_cluster = payload["next_cluster"]
+    # records: vertices/documents first, then edges, then bags verbatim
+    deferred_edges: List[Tuple[RID, Dict]] = []
+    bags_by_rid: List[Tuple[RID, Dict]] = []
+    for cid_s, cdata in payload["clusters"].items():
+        cid = int(cid_s)
+        c = db._cluster(cid)
+        while len(c.records) < cdata["len"]:
+            c.records.append(None)
+        for r in cdata["records"]:
+            rid = RID(cid, r["pos"])
+            if r["type"] == "edge":
+                deferred_edges.append((rid, r))
+                continue
+            fields = {k: _dec(v) for k, v in r["fields"].items()}
+            doc = Vertex(r["class"], fields) if r["type"] == "vertex" else Document(
+                r["class"], fields
+            )
+            doc._db = db
+            doc.rid = rid
+            doc.version = r["version"]
+            c.records[rid.position] = doc
+            if r.get("bags"):
+                bags_by_rid.append((rid, r["bags"]))
+    for rid, r in deferred_edges:
+        fields = {k: _dec(v) for k, v in r["fields"].items()}
+        e = Edge(r["class"], fields)
+        e._db = db
+        e.rid = rid
+        e.version = r["version"]
+        e.out_rid = RID.parse(r["out"])
+        e.in_rid = RID.parse(r["in"])
+        db._cluster(rid.cluster).records[rid.position] = e
+    for rid, bags in bags_by_rid:
+        doc = db._load_raw(rid)
+        if not isinstance(doc, Vertex):
+            continue
+        for dname, table in bags.items():
+            target = doc._out_edges if dname == "out" else doc._in_edges
+            for cls_name, rids in table.items():
+                target[cls_name] = [RID.parse(x) for x in rids]
+    # indexes last: definitions re-created, contents rebuilt from records
+    for idx in payload["indexes"]:
+        db.indexes.create_index(idx["name"], idx["class"], idx["fields"], idx["type"])
+    for s in payload.get("sequences", ()):
+        seq = db.sequences.create(
+            s["name"], s["type"], s["start"], s["increment"], s["cache"]
+        )
+        seq.set_value(s["value"])
+    for f in payload.get("functions", ()):
+        db.functions.create(
+            f["name"], f["body"], f.get("parameters", ()),
+            language=f.get("language", "sql"),
+            idempotent=f.get("idempotent", True),
+        )
+    db._rr_state = dict(payload.get("rr_state", {}))
+    db.mutation_epoch = payload["epoch"]
+    return payload.get("lsn", 0)
+
+
+# ---------------------------------------------------------------------------
+# open / attach
+# ---------------------------------------------------------------------------
+
+
+def _dir_of(db: Database) -> str:
+    d = getattr(db, "_durability_dir", None) or config.wal_dir
+    if d is None:
+        raise ValueError(
+            "no durability directory: pass one or set config.wal_dir"
+        )
+    return d
+
+
+def enable_durability(
+    db: Database, directory: Optional[str] = None, fsync: Optional[bool] = None
+) -> Database:
+    """Arm WAL logging on a live database (new writes become durable).
+
+    Honors ``config.wal_enabled``'s companions ``wal_dir``/``wal_fsync``
+    when arguments are omitted."""
+    directory = directory or config.wal_dir
+    if directory is None:
+        raise ValueError("enable_durability needs a directory (or config.wal_dir)")
+    os.makedirs(directory, exist_ok=True)
+    db._durability_dir = directory
+    wal = WriteAheadLog(os.path.join(directory, WAL_FILE), fsync=fsync)
+    # continue LSNs after whatever the log (and its archives) already hold
+    last = 0
+    for seg in _wal_segments(directory):
+        entries = WriteAheadLog(seg).read_entries()
+        if entries:
+            last = max(last, entries[-1]["lsn"])
+    wal.next_lsn = last + 1
+    db._wal = wal
+    db.schema.on_ddl = db._wal_log
+    return db
+
+
+def _wal_segments(directory: str) -> List[str]:
+    """All WAL segment paths, archives first (ordered by covered lsn),
+    the live log last."""
+    archives = sorted(
+        f for f in os.listdir(directory)
+        if f.startswith("wal-") and f.endswith(".log")
+    )
+    out = [os.path.join(directory, f) for f in archives]
+    live = os.path.join(directory, WAL_FILE)
+    if os.path.exists(live):
+        out.append(live)
+    return out
+
+
+def open_database(directory: str, name: Optional[str] = None) -> Database:
+    """Recover a database from ``directory``: newest valid checkpoint (if
+    any) + WAL tail replay, then re-arm logging ([E] the
+    OLocalPaginatedStorage open → WAL recovery path, SURVEY.md §3.4)."""
+    db = Database(name or os.path.basename(os.path.abspath(directory)))
+    db._durability_dir = directory
+    os.makedirs(directory, exist_ok=True)
+    ckpt_lsn = 0
+    cps = sorted(
+        p for p in os.listdir(directory) if p.startswith(CHECKPOINT_PREFIX)
+    )
+    for cp in reversed(cps):
+        try:
+            ckpt_lsn = _load_checkpoint(db, os.path.join(directory, cp))
+            break
+        except Exception:
+            log.exception("checkpoint %s unreadable; trying older", cp)
+            db = Database(name or os.path.basename(os.path.abspath(directory)))
+            db._durability_dir = directory
+    wal = WriteAheadLog(os.path.join(directory, WAL_FILE))
+    # gather every segment (archives + live log): falling back to an older
+    # checkpoint needs the archived tail between the two checkpoints
+    entries: List[Dict] = []
+    for seg in _wal_segments(directory):
+        entries.extend(WriteAheadLog(seg).read_entries())
+    entries.sort(key=lambda e: e["lsn"])
+    wal.replaying = True
+    db._wal = wal
+    try:
+        for e in entries:
+            if e["lsn"] <= ckpt_lsn:
+                continue
+            try:
+                _apply_entry(db, e)
+            except Exception:
+                log.exception("wal replay failed at lsn=%s; stopping", e["lsn"])
+                break
+    finally:
+        wal.replaying = False
+    if entries:
+        wal.next_lsn = max(wal.next_lsn, entries[-1]["lsn"] + 1)
+    db.schema.on_ddl = db._wal_log
+    return db

@@ -46,6 +46,23 @@ class GlobalConfiguration:
     # safety ceiling for the compiled path).
     default_max_depth: int = 32
 
+    # Buffer headroom multiplier for recorded size schedules: compiled
+    # plans size buffers at bucket(observed * headroom), so
+    # parameter-generic replays tolerate result sets up to that much
+    # larger before an overflow re-record. 1.0 = exact-bucket sizing.
+    schedule_headroom: float = 2.0
+
+    # Extra empty BFS levels recorded past frontier exhaustion in
+    # variable-depth (WHILE) plans: replays whose walks go up to this many
+    # levels deeper than the recording still execute in place instead of
+    # re-recording (depth varies with the query parameter).
+    var_depth_pad_levels: int = 2
+
+    # Schedule variants kept per cached statement: parameter values whose
+    # live sizes exceed every variant's capacities record a new variant
+    # rather than thrash-replacing one plan.
+    plan_variants: int = 3
+
     # Plan cache entries (analog of OExecutionPlanCache [E]).
     plan_cache_size: int = 256
     # Parsed-statement cache entries (analog of OStatementCache [E]).
@@ -60,7 +77,11 @@ class GlobalConfiguration:
     # Logging level for get_logger default.
     log_level: str = "WARNING"
 
-    # WAL / durability for the host record store.
+    # WAL / durability for the host record store
+    # (orientdb_tpu.storage.durability): when wal_enabled and wal_dir are
+    # set, server-created databases recover-or-create durably under
+    # <wal_dir>/<name>; embedded databases opt in via
+    # enable_durability/open_database. wal_fsync fsyncs every append.
     wal_enabled: bool = False
     wal_dir: Optional[str] = None
     wal_fsync: bool = False

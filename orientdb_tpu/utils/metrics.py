@@ -1,0 +1,76 @@
+"""Process-wide metrics registry.
+
+Analog of the reference's ``OProfiler``/``OAbstractProfiler`` ([E]
+core/.../common/profiler/; SURVEY.md §5.1/§5.5): named counters and
+duration stats, exported over the HTTP server's ``/metrics`` endpoint
+(the JMX/`/profiler` analog) and readable in-process for tests.
+
+Two primitive kinds, both thread-safe:
+- counters   — ``incr("query.tpu")``
+- durations  — ``observe("query.tpu.dispatch", seconds)`` keeping
+  count/total/max so rates and tails are recoverable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._durations: Dict[str, Dict[str, float]] = {}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            d = self._durations.get(name)
+            if d is None:
+                d = self._durations[name] = {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            d["count"] += 1
+            d["total_s"] += seconds
+            d["max_s"] = max(d["max_s"], seconds)
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "durations": {k: dict(v) for k, v in self._durations.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._durations.clear()
+
+
+#: the process-wide instance (the reference's OProfiler is a singleton too)
+metrics = MetricsRegistry()
+
+
+class timed:
+    """Context manager: ``with timed("query.tpu.dispatch"): ...``"""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __enter__(self):
+        import time
+
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        metrics.observe(self.name, time.perf_counter() - self._t0)
+        return False

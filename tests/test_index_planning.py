@@ -1,0 +1,115 @@
+"""Index-driven query planning (VERDICT r1 item 7; SURVEY.md §3.2
+"index vs scan choice"): SELECT WHERE equality/range and MATCH root
+seeding go through Index.best_for instead of full class scans, EXPLAIN
+shows the choice, and results are identical either way."""
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.schema import PropertyType
+
+
+@pytest.fixture()
+def db():
+    d = Database("idx")
+    p = d.schema.create_vertex_class("P")
+    p.create_property("uid", PropertyType.LONG)
+    p.create_property("name", PropertyType.STRING)
+    d.schema.create_edge_class("K")
+    d.indexes.create_index("P.uid", "P", ["uid"], "UNIQUE")
+    d.indexes.create_index("P.name", "P", ["name"], "NOTUNIQUE_HASH_INDEX")
+    vs = [d.new_vertex("P", uid=i, name=f"n{i % 10}") for i in range(100)]
+    for i in range(99):
+        d.new_edge("K", vs[i], vs[i + 1])
+    return d
+
+
+def _count_scans(db):
+    """Wrap browse_class with a call counter."""
+    counter = {"n": 0}
+    orig = db.browse_class
+
+    def wrapped(*a, **k):
+        counter["n"] += 1
+        return orig(*a, **k)
+
+    db.browse_class = wrapped
+    return counter
+
+
+def test_select_eq_uses_index(db):
+    c = _count_scans(db)
+    rows = db.query("SELECT uid FROM P WHERE uid = 42").to_dicts()
+    assert rows == [{"uid": 42}]
+    assert c["n"] == 0, "equality WHERE must not scan the class"
+
+
+def test_select_range_uses_index(db):
+    c = _count_scans(db)
+    rows = db.query("SELECT uid FROM P WHERE uid > 95 ORDER BY uid").to_dicts()
+    assert [r["uid"] for r in rows] == [96, 97, 98, 99]
+    assert c["n"] == 0
+    rows = db.query(
+        "SELECT uid FROM P WHERE uid BETWEEN 10 AND 12 ORDER BY uid"
+    ).to_dicts()
+    assert [r["uid"] for r in rows] == [10, 11, 12]
+    assert c["n"] == 0
+
+
+def test_select_param_and_conjunct(db):
+    c = _count_scans(db)
+    rows = db.query(
+        "SELECT uid FROM P WHERE uid = :u AND name = 'n2'", params={"u": 12}
+    ).to_dicts()
+    assert rows == [{"uid": 12}]
+    assert c["n"] == 0
+    # conjunct that fails on the indexed row: index prunes, filter decides
+    rows = db.query(
+        "SELECT uid FROM P WHERE uid = :u AND name = 'nope'", params={"u": 12}
+    ).to_dicts()
+    assert rows == []
+
+
+def test_non_range_index_rejects_range_op(db):
+    # hash index on name: equality fine, range must fall back to scan
+    c = _count_scans(db)
+    rows = db.query("SELECT count(*) AS n FROM P WHERE name = 'n3'").to_dicts()
+    assert rows == [{"n": 10}]
+    assert c["n"] == 0
+    db.query("SELECT count(*) AS n FROM P WHERE name >= 'n8'").to_dicts()
+    assert c["n"] >= 1  # scanned
+
+
+def test_match_root_seeding_uses_index(db):
+    c = _count_scans(db)
+    rows = db.query(
+        "MATCH {class:P, as:a, where:(uid = 10)}-K->{as:b} RETURN b.uid AS b",
+        engine="oracle",
+    ).to_dicts()
+    assert rows == [{"b": 11}]
+    assert c["n"] == 0, "MATCH root with indexable WHERE must not scan"
+
+
+def test_explain_shows_index_choice(db):
+    rs = db.explain("SELECT FROM P WHERE uid = 3")
+    plan = rs.to_dicts()[0]["executionPlan"]
+    assert "FetchFromIndex" in plan
+    rs = db.explain("SELECT FROM P WHERE name > 'x'")
+    plan = rs.to_dicts()[0]["executionPlan"]
+    assert "FetchFromIndex" not in plan
+
+
+def test_tx_overlay_disables_index_path(db):
+    tx = db.begin()
+    db.new_vertex("P", uid=1000, name="fresh")
+    rows = db.query("SELECT uid FROM P WHERE uid = 1000").to_dicts()
+    assert rows == [{"uid": 1000}], "tx-created record must be visible"
+    tx.rollback()
+
+
+def test_index_and_scan_agree(db):
+    q = "SELECT uid FROM P WHERE uid >= 90 AND name = 'n5' ORDER BY uid"
+    indexed = db.query(q).to_dicts()
+    db.indexes.drop_index("P.uid")
+    scanned = db.query(q).to_dicts()
+    assert indexed == scanned

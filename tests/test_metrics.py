@@ -1,0 +1,91 @@
+"""Observability: metrics registry, engine counters, /metrics endpoint,
+compiled-path PROFILE phases (VERDICT r1 item 9 / SURVEY.md §5.1,5.5)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.storage.ingest import generate_demodb
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+from orientdb_tpu.utils.metrics import metrics, timed
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = generate_demodb(n_profiles=120, avg_friends=4, seed=3)
+    attach_fresh_snapshot(d)
+    return d
+
+
+def test_registry_counters_and_durations():
+    metrics.incr("t.x")
+    metrics.incr("t.x", 2)
+    with timed("t.dur"):
+        pass
+    snap = metrics.snapshot()
+    assert snap["counters"]["t.x"] == 3
+    assert snap["durations"]["t.dur"]["count"] == 1
+
+
+def test_engine_counters(db):
+    base_tpu = metrics.counter("query.tpu")
+    base_fb = metrics.counter("query.tpu.fallback")
+    db.query(
+        "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN count(*) AS n",
+        engine="tpu",
+        strict=True,
+    )
+    assert metrics.counter("query.tpu") == base_tpu + 1
+    # a shape the compiler rejects (pathAlias) falls back and counts
+    db.query(
+        "MATCH {class:Profiles, as:p}-HasFriend->{as:f, pathAlias:pp} "
+        "RETURN p.name AS n",
+        engine="tpu",
+    )
+    assert metrics.counter("query.tpu.fallback") == base_fb + 1
+
+
+def test_plan_cache_counters(db):
+    q = "MATCH {class:Profiles, as:p, where:(uid < :u)}-HasFriend->{as:f} RETURN count(*) AS n"
+    h0, m0 = metrics.counter("plan_cache.hit"), metrics.counter("plan_cache.miss")
+    db.query(q, params={"u": 5}, engine="tpu", strict=True)
+    db.query(q, params={"u": 7}, engine="tpu", strict=True)
+    assert metrics.counter("plan_cache.miss") >= m0 + 1
+    assert metrics.counter("plan_cache.hit") >= h0 + 1
+
+
+def test_profile_tpu_phases(db):
+    q = "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN count(*) AS n"
+    db.query(q, engine="tpu", strict=True)  # record
+    rs = db.query(f"PROFILE {q}")
+    row = rs.to_dicts()[0]
+    phases = row.get("tpuPhases")
+    assert phases is not None and phases["mode"] in ("replay", "record")
+    if phases["mode"] == "replay":
+        for k in ("prepareUs", "dispatchUs", "deviceUs", "fetchMarshalUs"):
+            assert k in phases
+        assert phases["scheduleObserves"] >= 1
+        assert any("EXPAND" in s or "ROOT" in s for s in phases["steps"])
+
+
+def test_http_metrics_endpoint():
+    import base64
+
+    from orientdb_tpu.server.server import Server
+
+    s = Server(admin_password="pw")
+    s.create_database("m1")
+    s.startup()
+    try:
+        cred = base64.b64encode(b"admin:pw").decode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.http_port}/metrics",
+            headers={"Authorization": f"Basic {cred}"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            payload = json.loads(r.read())
+        assert "counters" in payload and "durations" in payload
+    finally:
+        s.shutdown()
