@@ -347,11 +347,12 @@ def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
     raise EvalError(f"unknown function '{name}'")
 
 
+from orientdb_tpu.models.metadata import Sequence
+
+
 def eval_method(ctx: EvalContext, base, name: str, args) -> object:
     """`value.method(args)` dispatch ([E] OSQLMethodFactory subset)."""
     m = name.lower()
-    from orientdb_tpu.models.metadata import Sequence
-
     if isinstance(base, Sequence):
         if m == "next":
             return base.next()

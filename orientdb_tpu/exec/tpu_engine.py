@@ -430,10 +430,39 @@ class TpuMatchSolver:
         self._vertex_scope_cache: Optional[ColumnScope] = None
         self._check_supported()
         self.plan = build_plan(self.pattern, self.interp)
-        # pre-compile all node/edge predicates (fail fast → fallback)
+        # binding visibility: which (vertex) aliases are bound BEFORE each
+        # alias' first bind / each step — this is the scope a
+        # binding-referencing WHERE may see (mirrors the oracle, whose
+        # check_node/edge-where run with the bindings accumulated so far)
+        self._vertex_aliases = {
+            a for a, n in self.pattern.nodes.items() if not n.is_edge_alias
+        }
+        self._alias_visible: Dict[str, set] = {}
+        self._step_visible: Dict[int, set] = {}
+        bound_so_far: set = set()
+        for step in self.plan:
+            if step.kind == "root":
+                self._alias_visible.setdefault(step.alias, set())
+                bound_so_far.add(step.alias)
+                continue
+            e = step.edge
+            src = e.to_alias if step.reverse else e.from_alias
+            dst = e.from_alias if step.reverse else e.to_alias
+            vis = bound_so_far & self._vertex_aliases
+            self._step_visible[id(step)] = vis
+            self._alias_visible.setdefault(dst, vis)
+            bound_so_far.add(src)
+            bound_so_far.add(dst)
+            f = e.item.edge_filter
+            if f is not None and f.alias:
+                bound_so_far.add(f.alias)
+        # pre-compile all node/edge predicates (fail fast → fallback);
+        # edge-alias nodes carry EDGE-scope filters, which the
+        # edge-binding expansion compiles per concrete class itself
         self._node_masks: Dict[str, object] = {}
         for alias, node in self.pattern.nodes.items():
-            self._node_masks[alias] = self._compile_node(node)
+            if not node.is_edge_alias:
+                self._node_masks[alias] = self._compile_node(node)
         # WHILE conditions compile with $depth as a per-level scalar
         self._while_fns: Dict[int, object] = {}
         for e in self.pattern.edges:
@@ -442,18 +471,63 @@ class TpuMatchSolver:
                 self._while_fns[id(e)] = compile_predicate(
                     w, self._vertex_scope(), self.param_box, allow_depth=True
                 )
+        # NOT arms: per-path (aliases, admission masks, path items) for the
+        # bitmap anti-join — compiled here so an unsupported arm fails
+        # fast into the oracle fallback
+        self._not_compiled = []
+        for path in self.not_paths:
+            sub = Pattern()
+            prev = sub.node(path.first)
+            aliases = [prev.alias]
+            for it in path.items:
+                aliases.append(sub.node(it.target).alias)
+            masks = [self._compile_node(sub.nodes[a]) for a in aliases]
+            self._not_compiled.append((aliases, masks, list(path.items)))
 
     # -- compile-time gating ------------------------------------------------
 
     def _check_supported(self) -> None:
-        if self.not_paths:
-            raise Uncompilable("NOT patterns not compiled yet")
+        for path in self.not_paths:
+            # NOT arms compile to a bitmap anti-join (see
+            # _apply_not_path); the chain subset mirrors what that
+            # machinery evaluates — no variable depth, methods, optional
+            # flags, edge aliases, or binding references inside the arm
+            flts = [path.first] + [it.target for it in path.items]
+            for flt in flts:
+                if flt is None:
+                    continue
+                if flt.while_cond is not None or flt.max_depth is not None:
+                    raise Uncompilable("variable-depth NOT arm")
+                if flt.optional or flt.depth_alias or flt.path_alias:
+                    raise Uncompilable("optional/depth/path alias in NOT arm")
+                if flt.where is not None and _expr_uses_bindings(
+                    flt.where, self.pattern.nodes
+                ):
+                    raise Uncompilable("NOT-arm WHERE references bindings")
+            for it in path.items:
+                if (it.method or "").lower() in (
+                    "outv", "inv", "bothv", "oute", "ine", "bothe"
+                ):
+                    raise Uncompilable("method form in NOT arm")
+                f = it.edge_filter
+                if f is not None and f.alias:
+                    raise Uncompilable("edge alias in NOT arm")
+                if f is not None and f.where is not None and _expr_uses_bindings(
+                    f.where, self.pattern.nodes
+                ):
+                    raise Uncompilable("NOT-arm edge WHERE references bindings")
         reserved = set(self.pattern.nodes.keys())
         for e in self.pattern.edges:
             item = e.item
             m = (item.method or "").lower()
-            if m in ("outv", "inv", "bothv", "oute", "ine", "bothe"):
-                raise Uncompilable(f"method form .{m}() not compiled yet")
+            if m in ("oute", "ine", "bothe") and item.edge_filter is None:
+                # bare edge-binding arm (.outE(){as:e}) — compiled by
+                # _expand_bind_edge; an edge target with a rid filter has
+                # no device analog
+                if any(f.rid is not None for f in self.pattern.nodes[e.to_alias].filters):
+                    raise Uncompilable("rid filter on an edge-binding target")
+            if m in ("outv", "inv", "bothv") and item.target.while_cond is not None:
+                raise Uncompilable("variable-depth endpoint arm")
             var_depth = (
                 item.target.while_cond is not None
                 or item.target.max_depth is not None
@@ -463,11 +537,17 @@ class TpuMatchSolver:
             if item.negated:
                 raise Uncompilable("negated path item")
             f = item.edge_filter
-            if f is not None and f.where is not None and _expr_uses_bindings(
-                f.where, self.pattern.nodes
-            ):
-                raise Uncompilable("edge WHERE references bindings")
             if var_depth:
+                # variable-depth arms evaluate masks vertex-wise (no
+                # per-row env), so binding references stay interpreted
+                if f is not None and f.where is not None and _expr_uses_bindings(
+                    f.where, self.pattern.nodes
+                ):
+                    raise Uncompilable("edge WHERE references bindings (WHILE arm)")
+                if item.target.where is not None and _expr_uses_bindings(
+                    item.target.where, self.pattern.nodes
+                ):
+                    raise Uncompilable("node WHERE references bindings (WHILE arm)")
                 if f is not None and f.alias:
                     raise Uncompilable(
                         "edge alias on a WHILE arrow (discovery-edge binding)"
@@ -475,21 +555,27 @@ class TpuMatchSolver:
                 w = item.target.while_cond
                 if w is not None and _expr_uses_bindings(w, self.pattern.nodes):
                     raise Uncompilable("WHILE condition references bindings")
-        # edge-alias nodes are fine when bound by an edge-filter alias during
-        # a (required or close) expansion; a bare edge-alias root is not
+        # edge-alias nodes are fine when bound by an edge-filter alias or
+        # as the target of a bare edge-binding arm (.outE(){as:e}); a bare
+        # edge-alias root is not
         edge_filter_aliases = {
             e.item.edge_filter.alias
             for e in self.pattern.edges
             if e.item.edge_filter is not None and e.item.edge_filter.alias
         }
+        edge_bind_targets = {
+            e.to_alias
+            for e in self.pattern.edges
+            if (e.item.method or "").lower() in ("oute", "ine", "bothe")
+            and e.item.edge_filter is None
+        }
         for node in self.pattern.nodes.values():
-            if node.is_edge_alias and node.alias not in edge_filter_aliases:
+            if (
+                node.is_edge_alias
+                and node.alias not in edge_filter_aliases
+                and node.alias not in edge_bind_targets
+            ):
                 raise Uncompilable("edge-alias pattern nodes not compiled yet")
-            for f in node.filters:
-                if f.where is not None and _expr_uses_bindings(
-                    f.where, self.pattern.nodes
-                ):
-                    raise Uncompilable("node WHERE references bindings")
 
     # -- predicate compilation ---------------------------------------------
 
@@ -505,8 +591,12 @@ class TpuMatchSolver:
     def _compile_node(self, node: PatternNode):
         """Node admission mask: fn(idx_array) -> bool mask over vertex ids.
 
-        Mirrors oracle.check_node: class closure ∧ rid ∧ WHERE."""
+        Mirrors oracle.check_node: class closure ∧ rid ∧ WHERE. A WHERE
+        referencing earlier bindings (``alias.prop``) compiles against the
+        alias-visibility set at this node's first bind; the mask then
+        needs env["bindings"] at evaluation (``mask.uses_bindings``)."""
         parts = []
+        uses_bindings = False
         for f in node.filters:
             if f.class_name:
                 ids = self.dg.class_ids(f.class_name)
@@ -516,7 +606,23 @@ class TpuMatchSolver:
                 wi = -2 if want is None else want  # -2 matches nothing (≠ -1 pad)
                 parts.append(lambda idx, env, wi=wi: idx == wi)
             if f.where is not None:
-                fn = compile_predicate(f.where, self._vertex_scope(), self.param_box)
+                if _expr_uses_bindings(f.where, self.pattern.nodes):
+                    scope = ColumnScope(
+                        self.dg.columns,
+                        self.dg.non_columnar,
+                        reserved=set(self.pattern.nodes.keys()),
+                        binding_columns=self.dg.columns,
+                        binding_non_columnar=self.dg.non_columnar,
+                        visible_aliases=self._alias_visible.get(
+                            node.alias, set()
+                        ),
+                    )
+                    fn = compile_predicate(f.where, scope, self.param_box)
+                    uses_bindings = uses_bindings or scope.uses_bindings
+                else:
+                    fn = compile_predicate(
+                        f.where, self._vertex_scope(), self.param_box
+                    )
                 parts.append(fn)
 
         def mask(idx, env=None, parts=parts):
@@ -526,6 +632,7 @@ class TpuMatchSolver:
                 m = m & p(idx, env)
             return m
 
+        mask.uses_bindings = uses_bindings
         return mask
 
     def _class_mask_fn(self, ids: jnp.ndarray):
@@ -537,12 +644,28 @@ class TpuMatchSolver:
 
         return fn
 
-    def _edge_where(self, concrete: str, where: A.Expression):
+    def _edge_where(
+        self, concrete: str, where: A.Expression, visible: Optional[set] = None
+    ):
+        """Edge-property predicate over edge ids; with ``visible`` given,
+        ``alias.prop`` references to those (vertex) aliases compile too —
+        the returned fn then carries ``uses_bindings`` and needs
+        env["bindings"] arrays aligned with its idx slots."""
         dec = self.dg.edges[concrete]
         scope = ColumnScope(
-            dec.columns, dec.non_columnar, reserved=set(self.pattern.nodes.keys())
+            dec.columns,
+            dec.non_columnar,
+            reserved=set(self.pattern.nodes.keys()),
+            binding_columns=self.dg.columns if visible else None,
+            binding_non_columnar=self.dg.non_columnar,
+            visible_aliases=visible or set(),
         )
-        return compile_predicate(where, scope, self.param_box)
+        fn = compile_predicate(where, scope, self.param_box)
+        try:
+            fn.uses_bindings = scope.uses_bindings
+        except AttributeError:  # pragma: no cover - plain closures accept attrs
+            pass
+        return fn
 
     # -- execution ----------------------------------------------------------
 
@@ -614,11 +737,85 @@ class TpuMatchSolver:
                 table = self._expand(table, step, optional=False)
             else:
                 table = self._expand(table, step, optional=True)
+        if self._not_compiled and not table.empty():
+            table = self._apply_not_paths(table)
         if pushdown and not table.empty():
             return self._apply_count_pushdown(table, pushdown)
         return table
 
     # -- COUNT(*) aggregate pushdown ----------------------------------------
+
+    # -- NOT patterns: bitmap anti-join -------------------------------------
+
+    def _apply_not_paths(self, table: Table) -> Table:
+        """Reject rows for which any NOT arm is satisfiable — the [E]
+        NOT-pattern filter of OMatchStatement, evaluated as a chunked
+        bitmap chain: candidates for the arm's first position (one-hot of
+        the shared binding, or its admission mask over all vertices), one
+        frontier hop per arm item, target masks/bindings ANDed in; a row
+        with any survivor at the chain's end matched the NOT arm."""
+        for aliases, masks, items in self._not_compiled:
+            if table.empty():
+                return table
+            table = self._apply_not_path(table, aliases, masks, items)
+        return table
+
+    def _apply_not_path(self, table: Table, aliases, masks, items) -> Table:
+        width = table.width or 1
+        V = self.dg.num_vertices
+        vb = K.bucket(max(V, 1))
+        univ = jnp.arange(vb, dtype=jnp.int32)
+        univ = jnp.where(univ < V, univ, -1)
+        node_vecs = [m(univ) for m in masks]
+        hops_per_item = []
+        for it in items:
+            hop_items = []
+            f = it.edge_filter
+            for cname in self._resolve_edge_classes(it):
+                dec = self.dg.edges[cname]
+                emask = None
+                if f is not None and f.where is not None:
+                    eids = jnp.arange(dec.num_edges, dtype=jnp.int32)
+                    emask = self._edge_where(cname, f.where)(eids, {})
+                dirs = ("out", "in") if it.direction == "both" else (it.direction,)
+                for d in dirs:
+                    hop_items.append((cname, d, emask))
+            hops_per_item.append(build_bitmap_hops(self.dg, hop_items))
+        vcol = jnp.arange(vb, dtype=jnp.int32)
+        valid_dev = table.valid_device
+        exists_chunks = []
+        C = min(self._VAR_DEPTH_CHUNK, width)
+        for cs in range(0, width, C):
+            chunk_rows = jnp.arange(cs, cs + C, dtype=jnp.int32)
+            in_range = jnp.where(chunk_rows < valid_dev.shape[0], chunk_rows, -1)
+            chunk_valid = K.take_pad(valid_dev, in_range, jnp.int32(0)) > 0
+            chunk_rows = jnp.where(chunk_valid, chunk_rows, -1)
+            a0 = aliases[0]
+            if a0 in table.cols:
+                src = K.take_pad(table.cols[a0], chunk_rows, jnp.int32(-1))
+                cur = K.rows_to_bitmap(src, vb) & node_vecs[0][None, :]
+            else:
+                cur = node_vecs[0][None, :] & chunk_valid[:, None]
+            for k, hops in enumerate(hops_per_item):
+                nxt = jnp.zeros_like(cur)
+                for hop in hops:
+                    nxt = nxt | hop(cur)
+                nxt = nxt & node_vecs[k + 1][None, :]
+                tgt = aliases[k + 1]
+                if tgt in table.cols:
+                    bound = K.take_pad(
+                        table.cols[tgt], chunk_rows, jnp.int32(-2)
+                    )
+                    nxt = nxt & (vcol[None, :] == bound[:, None])
+                cur = nxt
+            exists_chunks.append(cur.any(axis=1))
+        exists = jnp.concatenate(exists_chunks)[:width]
+        keep_mask = valid_dev[:width].astype(bool) & ~exists
+        keep, kn, kn_dev = self._compact(keep_mask)
+        t = table.gather(keep)
+        t.count = kn
+        t.count_dev = kn_dev
+        return t
 
     def _count_pushdown_steps(self) -> List[PlanStep]:
         """Longest plan suffix of terminal chain expansions a lone COUNT(*)
@@ -633,7 +830,7 @@ class TpuMatchSolver:
         O(E + V) instead of O(result rows), which is what makes batched
         COUNT throughput independent of fan-out.
         """
-        if self.count_only_name() is None or self.stmt.group_by:
+        if self.count_only_name() is None or self.stmt.group_by or self._not_compiled:
             return []
         suffix: List[PlanStep] = []
         # alias usage counts over all edges (from/to + edge-filter aliases)
@@ -649,7 +846,22 @@ class TpuMatchSolver:
                 or (item.edge_filter is not None and item.edge_filter.alias)
             ):
                 break
+            # binding-referencing predicates need per-row env — the
+            # pushdown's vertex-wise weight passes cannot provide one
+            if (
+                item.edge_filter is not None
+                and item.edge_filter.where is not None
+                and _expr_uses_bindings(item.edge_filter.where, self.pattern.nodes)
+            ):
+                break
+            mm = (item.method or "").lower()
+            if (mm in ("oute", "ine", "bothe") and item.edge_filter is None) or mm in (
+                "outv", "inv", "bothv"
+            ):
+                break  # edge-binding / endpoint arms have no weight pass
             dst_alias = e.from_alias if step.reverse else e.to_alias
+            if getattr(self._node_masks[dst_alias], "uses_bindings", False):
+                break
             # dst must be terminal: referenced by no OTHER edge than this one
             # and (for non-last suffix members) only as the src of the next
             # pushdown step — checked by walking backwards: the "next" step
@@ -862,6 +1074,11 @@ class TpuMatchSolver:
         item = e.item
         if item.target.while_cond is not None or item.target.max_depth is not None:
             return self._expand_var_depth(table, step, optional)
+        m = (item.method or "").lower()
+        if m in ("oute", "ine", "bothe") and item.edge_filter is None:
+            return self._expand_bind_edge(table, step, optional)
+        if m in ("outv", "inv", "bothv"):
+            return self._expand_edge_endpoint(table, step, optional, m)
         direction = item.direction
         reverse = step.reverse
         if reverse:
@@ -878,22 +1095,45 @@ class TpuMatchSolver:
         parts: List[Table] = []
         counts: List[int] = []
         matched_any = jnp.zeros(table.width or 1, jnp.int32)
+        visible = self._step_visible.get(id(step), set())
+        node_mask = self._node_masks[dst_alias]
+        node_uses = getattr(node_mask, "uses_bindings", False)
         for cname in concrete:
             dec = self.dg.edges[cname]
             where_fn = (
-                self._edge_where(cname, f.where)
+                self._edge_where(cname, f.where, visible)
                 if (f is not None and f.where is not None)
                 else None
+            )
+            edge_uses = where_fn is not None and getattr(
+                where_fn, "uses_bindings", False
             )
             for d in sub_dirs:
                 row, eid, nbr, total = self._expand_one_dir(dec, d, srcs)
                 if total == 0:
                     continue
+                env = {}
+                if node_uses or edge_uses:
+                    # per-slot binding arrays for alias.prop references
+                    env = {
+                        "bindings": {
+                            a: (
+                                K.take_pad(table.cols[a], row, jnp.int32(-1))
+                                if a in table.cols
+                                else jnp.full(row.shape, -1, jnp.int32)
+                            )
+                            for a in visible
+                        }
+                    }
                 mask = row >= 0
                 if where_fn is not None:
-                    mask = mask & where_fn(eid, {})
-                # destination node admission
-                mask = mask & self._node_masks[dst_alias](nbr)
+                    mask = mask & where_fn(eid, env)
+                # destination node admission; close steps skip a
+                # binding-referencing re-check (the oracle doesn't re-run
+                # node filters when closing onto an already-bound alias,
+                # and the visibility set at first bind differs)
+                if not (step.close and node_uses):
+                    mask = mask & node_mask(nbr, env)
                 if step.close:
                     bound = K.take_pad(table.cols[dst_alias], row, jnp.int32(-2))
                     mask = mask & (nbr == bound)
@@ -962,6 +1202,189 @@ class TpuMatchSolver:
                 t.depth_cols[item.target.depth_alias] = jnp.full(
                     t.width, -1, jnp.int32
                 )
+            return t
+        return _concat_tables(parts, counts)
+
+    # -- method-form arms ---------------------------------------------------
+
+    def _expand_bind_edge(self, table: Table, step: PlanStep, optional: bool) -> Table:
+        """Bare ``.outE('EC'){as:e}``: the target alias binds the EDGE
+        ([E] MatchFieldTraverser's edge-step). Expansion slots carry the
+        global edge id; target-filter class/where apply to the edge."""
+        e = step.edge
+        item = e.item
+        if step.reverse:
+            raise Uncompilable("reverse edge-binding arm")
+        if self.dg.mesh_graph is not None:
+            raise Uncompilable("method arms not sharded yet")
+        src_alias, dst_alias = e.from_alias, e.to_alias
+        srcs = table.cols.get(src_alias)
+        if srcs is None:
+            raise Uncompilable(f"alias {src_alias} not bound before expansion")
+        dst_node = self.pattern.nodes[dst_alias]
+        tgt_classes = [f.class_name for f in dst_node.filters if f.class_name]
+        tgt_wheres = [f.where for f in dst_node.filters if f.where is not None]
+        concrete = self._resolve_edge_classes(item)
+        for tc in tgt_classes:
+            concrete = [
+                c
+                for c in concrete
+                if (cl := self.db.schema.get_class(c)) is not None
+                and cl.is_subclass_of(tc)
+            ]
+        visible = self._step_visible.get(id(step), set())
+        sub_dirs = (
+            ("out", "in") if item.direction == "both" else (item.direction,)
+        )
+        parts: List[Table] = []
+        counts: List[int] = []
+        width = table.width or 1
+        matched_any = jnp.zeros(width, jnp.int32)
+        for cname in concrete:
+            dec = self.dg.edges[cname]
+            where_fns = [self._edge_where(cname, w, visible) for w in tgt_wheres]
+            uses = any(getattr(f, "uses_bindings", False) for f in where_fns)
+            for d in sub_dirs:
+                row, eid, nbr, total = self._expand_one_dir(dec, d, srcs)
+                if total == 0:
+                    continue
+                env = {}
+                if uses:
+                    env = {
+                        "bindings": {
+                            a: (
+                                K.take_pad(table.cols[a], row, jnp.int32(-1))
+                                if a in table.cols
+                                else jnp.full(row.shape, -1, jnp.int32)
+                            )
+                            for a in visible
+                        }
+                    }
+                mask = (row >= 0) & (eid >= 0)
+                for fn in where_fns:
+                    mask = mask & fn(eid, env)
+                ecls_idx = self.edge_class_idx[cname]
+                if step.close:
+                    bci, beid = table.edge_cols[dst_alias]
+                    mask = mask & (
+                        K.take_pad(bci, row, jnp.int32(-2)) == ecls_idx
+                    ) & (K.take_pad(beid, row, jnp.int32(-2)) == eid)
+                if optional:
+                    matched_any = matched_any + K.rows_with_matches(
+                        row, mask, width
+                    )
+                keep, kn, kn_dev = self._compact(mask)
+                if kn == 0:
+                    continue
+                krow = K.take_pad(row, keep, jnp.int32(-1))
+                part = table.gather(krow)
+                part.count = kn
+                part.count_dev = kn_dev
+                keid = K.take_pad(eid, keep, jnp.int32(-1))
+                part.edge_cols[dst_alias] = (
+                    jnp.where(keid >= 0, ecls_idx, -1),
+                    keid,
+                )
+                parts.append(part)
+                counts.append(kn)
+        if optional:
+            matched = matched_any[:width] > 0
+            unmatched = table.valid_device[:width].astype(bool) & ~matched
+            ukeep, un, un_dev = self._compact(unmatched)
+            if un > 0:
+                upart = table.gather(ukeep)
+                upart.count = un
+                upart.count_dev = un_dev
+                null_col = jnp.full(upart.width, -1, jnp.int32)
+                if not step.close:
+                    upart.edge_cols[dst_alias] = (null_col, null_col)
+                parts.append(upart)
+                counts.append(un)
+        if not parts:
+            t = table.gather(jnp.full(K.bucket(1), -1, jnp.int32))
+            t.count = 0
+            t.count_dev = jnp.int32(0)
+            null_col = jnp.full(t.width, -1, jnp.int32)
+            t.edge_cols[dst_alias] = (null_col, null_col)
+            return t
+        return _concat_tables(parts, counts)
+
+    def _expand_edge_endpoint(
+        self, table: Table, step: PlanStep, optional: bool, m: str
+    ) -> Table:
+        """``.outV()/.inV()/.bothV()`` from a bound edge alias to its
+        endpoint vertex: a 1:1 (or 1:2 for bothV) per-row gather through
+        the edge-id columns — no fan-out expansion."""
+        e = step.edge
+        item = e.item
+        if step.reverse:
+            raise Uncompilable("reverse endpoint arm")
+        if self.dg.mesh_graph is not None:
+            raise Uncompilable("method arms not sharded yet")
+        src_alias, dst_alias = e.from_alias, e.to_alias
+        ecols = table.edge_cols.get(src_alias)
+        if ecols is None:
+            raise Uncompilable(f"edge alias {src_alias} not bound before endpoint step")
+        ci, eid = ecols
+        width = table.width or 1
+        node_mask = self._node_masks[dst_alias]
+        node_uses = getattr(node_mask, "uses_bindings", False)
+        env = {}
+        if node_uses:
+            visible = self._step_visible.get(id(step), set())
+            env = {
+                "bindings": {
+                    a: (
+                        table.cols[a]
+                        if a in table.cols
+                        else jnp.full(width, -1, jnp.int32)
+                    )
+                    for a in visible
+                }
+            }
+        kinds = {"outv": ("src",), "inv": ("dst",), "bothv": ("src", "dst")}[m]
+        live = table.valid_device[:width].astype(bool)
+        parts: List[Table] = []
+        counts: List[int] = []
+        matched_any = jnp.zeros(width, bool)
+        for kind in kinds:
+            cand = jnp.full(width, -1, jnp.int32)
+            for k, cname in enumerate(self.edge_class_list):
+                dec = self.dg.edges[cname]
+                if dec.num_edges == 0:
+                    continue
+                arr = dec.edge_src if kind == "src" else dec.dst
+                g = K.take_pad(arr, jnp.where(ci == k, eid, -1), jnp.int32(-1))
+                cand = jnp.where(ci == k, g, cand)
+            mask = live & (cand >= 0) & node_mask(cand, env)
+            if step.close:
+                mask = mask & (cand == table.cols[dst_alias])
+            matched_any = matched_any | mask
+            keep, kn, kn_dev = self._compact(mask)
+            if kn == 0:
+                continue
+            part = table.gather(keep)
+            part.count = kn
+            part.count_dev = kn_dev
+            part.cols[dst_alias] = K.take_pad(cand, keep, jnp.int32(-1))
+            parts.append(part)
+            counts.append(kn)
+        if optional:
+            unmatched = live & ~matched_any
+            ukeep, un, un_dev = self._compact(unmatched)
+            if un > 0:
+                upart = table.gather(ukeep)
+                upart.count = un
+                upart.count_dev = un_dev
+                if not step.close:
+                    upart.cols[dst_alias] = jnp.full(upart.width, -1, jnp.int32)
+                parts.append(upart)
+                counts.append(un)
+        if not parts:
+            t = table.gather(jnp.full(K.bucket(1), -1, jnp.int32))
+            t.count = 0
+            t.count_dev = jnp.int32(0)
+            t.cols[dst_alias] = jnp.full(t.width, -1, jnp.int32)
             return t
         return _concat_tables(parts, counts)
 

@@ -135,15 +135,16 @@ class SequenceManager:
             s.increment = increment
         if cache is not None:
             s.cache = max(1, cache)
+        # log only the EXPLICITLY altered fields: replaying an
+        # increment-only alter must not reset the live value to start
+        # (sequence ids feed unique keys — a reset reissues them)
         self._db._wal_log(
             {
-                "op": "create_sequence",  # idempotent re-spec on replay
+                "op": "alter_sequence",
                 "name": s.name,
-                "type": s.seq_type,
-                "start": s.start,
-                "increment": s.increment,
-                "cache": s.cache,
-                "alter": True,
+                "start": start,
+                "increment": increment,
+                "cache": cache,
             }
         )
         return s

@@ -97,18 +97,36 @@ class ParamBox:
 
 class ColumnScope:
     """Resolves bare field names for one predicate scope (a vertex alias or
-    an edge class' property columns)."""
+    an edge class' property columns).
+
+    With ``binding_columns`` set (a second, vertex-property scope) the
+    compiler also accepts ``alias.prop`` references for aliases in
+    ``visible_aliases``: they emit per-slot gathers through the alias'
+    binding column, which the caller provides at evaluation time via
+    ``env["bindings"][alias]`` (an int32 vertex-index array aligned with
+    the mask slots). This is how edge/node WHERE clauses that reference
+    earlier MATCH bindings compile ([E] the reference evaluates them
+    per-candidate inside MatchEdgeTraverser with the binding context)."""
 
     def __init__(
         self,
         columns: Dict[str, DeviceColumn],
         non_columnar: Set[str],
         reserved: Set[str] = frozenset(),
+        binding_columns: Optional[Dict[str, DeviceColumn]] = None,
+        binding_non_columnar: Set[str] = frozenset(),
+        visible_aliases: Set[str] = frozenset(),
     ) -> None:
         self.columns = columns
         self.non_columnar = non_columnar
         #: names that are MATCH aliases / variables → binding-dependent
         self.reserved = reserved
+        self.binding_columns = binding_columns
+        self.binding_non_columnar = binding_non_columnar
+        self.visible_aliases = visible_aliases
+        #: set True by the compiler when any binding reference compiled —
+        #: callers must then pass env["bindings"] at evaluation time
+        self.uses_bindings = False
 
     def resolve(self, name: str) -> Optional[DeviceColumn]:
         if name in self.reserved:
@@ -119,6 +137,21 @@ class ColumnScope:
             return self.columns[name]
         if name in self.non_columnar:
             raise Uncompilable(f"property {name!r} has no columnar encoding")
+        return None  # never present → null column
+
+    def resolve_binding(self, alias: str, prop: str) -> Optional[DeviceColumn]:
+        """Column for ``alias.prop`` where alias is a visible bound alias;
+        raises Uncompilable when ineligible."""
+        if self.binding_columns is None or alias not in self.visible_aliases:
+            raise Uncompilable(f"alias {alias!r} not visible to this predicate")
+        if prop.startswith("@") or prop.startswith("$"):
+            raise Uncompilable(f"meta field {prop!r} not columnar")
+        if prop in self.binding_columns:
+            self.uses_bindings = True
+            return self.binding_columns[prop]
+        if prop in self.binding_non_columnar:
+            raise Uncompilable(f"property {prop!r} has no columnar encoding")
+        self.uses_bindings = True
         return None  # never present → null column
 
 
@@ -174,6 +207,26 @@ def _column_val(col: DeviceColumn) -> _Val:
     return _Val(col.kind, emit, dictionary=col.dictionary)
 
 
+def _binding_val(alias: str, col: DeviceColumn) -> _Val:
+    """``alias.prop``: the per-slot vertex index comes from
+    env["bindings"][alias] (same length as the mask slots), then the
+    property gathers through it."""
+
+    def emit(idx, env, alias=alias, col=col):
+        rows = env["bindings"][alias]
+        n = col.values.shape[0]
+        if n == 0:
+            return (jnp.zeros(rows.shape, col.values.dtype), jnp.zeros(rows.shape, bool))
+        ok = rows >= 0
+        ci = jnp.clip(rows, 0, n - 1)
+        return (
+            jnp.take(col.values, ci),
+            jnp.take(col.present, ci) & ok,
+        )
+
+    return _Val(col.kind, emit, dictionary=col.dictionary)
+
+
 _NUMERIC = ("int", "float", "bool")
 
 
@@ -215,6 +268,17 @@ class Compiler:
             if col is None:
                 return _const_val(None)
             return _column_val(col)
+        if (
+            isinstance(expr, A.FieldAccess)
+            and isinstance(expr.base, A.Identifier)
+            and self.scope.binding_columns is not None
+            and expr.base.name in self.scope.visible_aliases
+        ):
+            alias = expr.base.name
+            col = self.scope.resolve_binding(alias, expr.name)
+            if col is None:
+                return _const_val(None)
+            return _binding_val(alias, col)
         if isinstance(expr, A.ContextVar):
             if expr.name == "depth" and self.allow_depth:
                 return _Val(
@@ -453,7 +517,15 @@ class Compiler:
                 return fn
             return lambda idx, env: jnp.zeros(idx.shape, bool)
         if a_str and b.kind == "str":
-            raise Uncompilable("string column vs string column compare")
+            if a.dictionary is not None and a.dictionary is b.dictionary:
+                # same sorted dictionary (same property column on both
+                # sides): code rank order == lexicographic order, so the
+                # codes compare directly as ints
+                a = _Val("int", a.emit)
+                b = _Val("int", b.emit)
+                a_num = b_num = True
+            else:
+                raise Uncompilable("string column vs string column compare")
         # numeric vs numeric (bool included)
         if not (a_num and b_num):
             raise Uncompilable(f"cannot compare {a.kind} with {b.kind}")

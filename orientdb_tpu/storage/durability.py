@@ -99,6 +99,12 @@ class WriteAheadLog:
         self.next_lsn = 1
         self.replaying = False
         self._fh = None
+        # append serialization: record saves run under the database lock,
+        # but DDL observers and sequence.next() append from arbitrary
+        # threads — LSN allocation and the file write must be atomic
+        import threading
+
+        self._lock = threading.Lock()
 
     # -- append ------------------------------------------------------------
 
@@ -108,51 +114,78 @@ class WriteAheadLog:
         return self._fh
 
     def append(self, entry: Dict) -> int:
-        lsn = self.next_lsn
-        self.next_lsn += 1
-        entry = {"lsn": lsn, **entry}
-        data = json.dumps(entry, separators=(",", ":")).encode()
-        line = b"%08x %s\n" % (zlib.crc32(data) & 0xFFFFFFFF, data)
-        fh = self._handle()
-        fh.write(line)
-        fh.flush()
-        if self.fsync:
-            os.fsync(fh.fileno())
+        with self._lock:
+            lsn = self.next_lsn
+            self.next_lsn += 1
+            entry = {"lsn": lsn, **entry}
+            data = json.dumps(entry, separators=(",", ":")).encode()
+            line = b"%08x %s\n" % (zlib.crc32(data) & 0xFFFFFFFF, data)
+            fh = self._handle()
+            fh.write(line)
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
         metrics.incr("wal.append")
         return lsn
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     # -- read --------------------------------------------------------------
 
-    def read_entries(self) -> List[Dict]:
-        """All intact entries, in order; a torn/corrupt tail is dropped."""
+    def _scan(self) -> Tuple[List[Dict], int]:
+        """(intact entries in order, byte length of the valid prefix);
+        a torn/corrupt tail is excluded from both."""
         if not os.path.exists(self.path):
-            return []
+            return [], 0
         out: List[Dict] = []
         with open(self.path, "rb") as f:
             raw = f.read()
-        for line in raw.split(b"\n"):
+        pos = 0
+        while pos < len(raw):
+            nl = raw.find(b"\n", pos)
+            if nl < 0:
+                break  # torn final line (no newline)
+            line = raw[pos:nl]
             if not line:
+                pos = nl + 1
                 continue
             if len(line) < 10 or line[8:9] != b" ":
-                log.warning("wal: torn/corrupt line after lsn=%s; truncating",
-                            out[-1]["lsn"] if out else 0)
                 break
             crc_hex, data = line[:8], line[9:]
             try:
                 if int(crc_hex, 16) != (zlib.crc32(data) & 0xFFFFFFFF):
-                    log.warning("wal: CRC mismatch after lsn=%s; truncating",
-                                out[-1]["lsn"] if out else 0)
                     break
                 out.append(json.loads(data))
             except Exception:
-                log.warning("wal: undecodable line; truncating tail")
                 break
-        return out
+            pos = nl + 1
+        if pos < len(raw):
+            log.warning(
+                "wal %s: torn/corrupt tail after lsn=%s",
+                os.path.basename(self.path),
+                out[-1]["lsn"] if out else 0,
+            )
+        return out, pos
+
+    def read_entries(self) -> List[Dict]:
+        """All intact entries, in order; a torn/corrupt tail is dropped."""
+        return self._scan()[0]
+
+    def truncate_torn_tail(self) -> None:
+        """Cut the file back to its valid prefix — recovery MUST do this
+        before re-arming appends, or new (acknowledged!) entries land
+        after the garbage and every later recovery discards them."""
+        with self._lock:
+            entries, valid = self._scan()
+            if os.path.exists(self.path):
+                size = os.path.getsize(self.path)
+                if valid < size:
+                    with open(self.path, "rb+") as f:
+                        f.truncate(valid)
 
     def reset(self) -> None:
         """Truncate after a checkpoint has made the log redundant."""
@@ -301,14 +334,14 @@ def _apply_entry(db: Database, e: Dict) -> None:
     elif op == "drop_index":
         db.indexes.drop_index(e["name"])
     elif op == "create_sequence":
-        if e.get("alter") and db.sequences.get(e["name"]) is not None:
+        db.sequences.create(
+            e["name"], e.get("type", "ORDERED"), e.get("start", 0),
+            e.get("increment", 1), e.get("cache", 20),
+        )
+    elif op == "alter_sequence":
+        if db.sequences.get(e["name"]) is not None:
             db.sequences.alter(
                 e["name"], e.get("start"), e.get("increment"), e.get("cache")
-            )
-        else:
-            db.sequences.create(
-                e["name"], e.get("type", "ORDERED"), e.get("start", 0),
-                e.get("increment", 1), e.get("cache", 20),
             )
     elif op == "drop_sequence":
         db.sequences.drop(e["name"])
@@ -665,6 +698,10 @@ def open_database(directory: str, name: Optional[str] = None) -> Database:
             db = Database(name or os.path.basename(os.path.abspath(directory)))
             db._durability_dir = directory
     wal = WriteAheadLog(os.path.join(directory, WAL_FILE))
+    # a torn tail (crash mid-append) must be CUT, not just skipped: the
+    # recovered process appends new acknowledged entries to this file, and
+    # readers stop at the first corrupt line
+    wal.truncate_torn_tail()
     # gather every segment (archives + live log): falling back to an older
     # checkpoint needs the archived tail between the two checkpoints
     entries: List[Dict] = []

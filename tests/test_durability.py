@@ -161,6 +161,39 @@ class TestTornTail:
 
 
 class TestReviewRegressions:
+    def test_torn_tail_truncated_so_new_writes_survive(self, tmp_path):
+        """Recovery must CUT a torn tail: post-recovery acknowledged
+        writes land after it and must survive the NEXT recovery."""
+        db = _mkdb(tmp_path)
+        db.schema.create_vertex_class("P")
+        db.new_vertex("P", n=1)
+        db.new_vertex("P", n=2)
+        db._wal.close()
+        wal_path = os.path.join(str(tmp_path), "wal.log")
+        with open(wal_path, "rb") as f:
+            raw = f.read()
+        with open(wal_path, "wb") as f:
+            f.write(raw[:-5])  # torn mid-entry
+        re1 = open_database(str(tmp_path))
+        assert re1.count_class("P") == 1
+        re1.new_vertex("P", n=3)
+        re1.new_vertex("P", n=4)
+        re1._wal.close()
+        re2 = open_database(str(tmp_path))
+        assert sorted(d["n"] for d in re2.browse_class("P")) == [1, 3, 4]
+
+    def test_alter_sequence_replay_keeps_value(self, tmp_path):
+        db = _mkdb(tmp_path)
+        db.command("CREATE SEQUENCE s")
+        for _ in range(50):
+            db.query("SELECT sequence('s').next()")
+        db.command("ALTER SEQUENCE s INCREMENT 2")
+        db._wal.close()
+        re = open_database(str(tmp_path))
+        seq = re.sequences.get("s")
+        assert seq.current() == 50, "increment-only alter must not reset"
+        assert seq.next() == 52
+
     def test_fallback_to_older_checkpoint_replays_archived_tail(self, tmp_path):
         """checkpoint A → W1 → checkpoint B → W2 → B corrupted: recovery
         from A must still see W1 (archived segment) and W2."""

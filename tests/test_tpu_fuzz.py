@@ -55,6 +55,21 @@ TEMPLATES = [
     "MATCH {class:Person, as:a, where:(uid < 3)}<-Knows-{as:b, maxDepth:2} RETURN b.uid AS b",
     "MATCH {class:Person, as:a, where:(uid < 4)}-Follows->{as:b, optional:true} RETURN a.uid AS a, b.uid AS b",
     "MATCH {class:Person, as:a}-->{as:b, where:(uid > 30)} RETURN a.uid AS a, b.uid AS b",
+    # binding-referencing predicates (node + edge WHERE)
+    "MATCH {class:Person, as:a}-Knows->{as:b, where:(age < a.age)} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a}-Follows{where:(w > 1 AND a.age > 30)}->{as:b} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a}-Knows->{as:b}-Knows->{as:c, where:(age > a.age AND uid != b.uid)} RETURN count(*) AS n",
+    "MATCH {class:Person, as:a}-Knows->{as:b, where:(name = a.name)} RETURN a.uid AS a, b.uid AS b",
+    # NOT patterns (anti-joins)
+    "MATCH {class:Person, as:a}-Knows->{as:b}, NOT {as:b}-Knows->{as:a} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a}, NOT {as:a}-Follows->{where:(age > 60)} RETURN a.uid AS a",
+    "MATCH {class:Person, as:a, where:(uid < 20)}, NOT {as:a}-Knows->{}-Knows->{where:(age > 70)} RETURN a.uid AS a",
+    "MATCH {class:Person, as:a}, NOT {as:a}-Follows{where:(w > 3)}->{} RETURN count(*) AS n",
+    # method-form arms: edge bindings and endpoint walks
+    "MATCH {class:Person, as:a}.outE('Follows'){as:e} RETURN a.uid AS a, e.w AS w",
+    "MATCH {class:Person, as:a}.outE('Follows'){as:e, where:(w > 2)}.inV(){as:b} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a, where:(uid < 10)}.outE('Follows'){as:e}, {as:e}.inV(){as:b} RETURN a.uid AS a, b.uid AS b",
+    "MATCH {class:Person, as:a, where:(uid < 8)}.bothE('Knows'){as:e}, {as:e}.bothV(){as:v} RETURN a.uid AS a, v.uid AS v",
 ]
 
 
