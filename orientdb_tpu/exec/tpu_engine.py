@@ -523,10 +523,19 @@ class TpuMatchSolver:
             if m in ("oute", "ine", "bothe") and item.edge_filter is None:
                 # bare edge-binding arm (.outE(){as:e}) — compiled by
                 # _expand_bind_edge; an edge target with a rid filter has
-                # no device analog
+                # no device analog, and variable depth on an edge binding
+                # has no compiled form
                 if any(f.rid is not None for f in self.pattern.nodes[e.to_alias].filters):
                     raise Uncompilable("rid filter on an edge-binding target")
-            if m in ("outv", "inv", "bothv") and item.target.while_cond is not None:
+                if (
+                    item.target.while_cond is not None
+                    or item.target.max_depth is not None
+                ):
+                    raise Uncompilable("variable-depth edge-binding arm")
+            if m in ("outv", "inv", "bothv") and (
+                item.target.while_cond is not None
+                or item.target.max_depth is not None
+            ):
                 raise Uncompilable("variable-depth endpoint arm")
             var_depth = (
                 item.target.while_cond is not None
@@ -669,6 +678,22 @@ class TpuMatchSolver:
 
     # -- execution ----------------------------------------------------------
 
+    @staticmethod
+    def _binding_env(table: Table, row: jnp.ndarray, visible: set) -> Dict:
+        """env for binding-referencing predicates: per-slot vertex-index
+        arrays for each visible alias, aligned with ``row`` (the source
+        binding-table row per expansion slot; pass None for identity
+        row mapping on width-aligned masks)."""
+        def col(a):
+            if a not in table.cols:
+                shape = row.shape if row is not None else (table.width or 1,)
+                return jnp.full(shape, -1, jnp.int32)
+            if row is None:
+                return table.cols[a]
+            return K.take_pad(table.cols[a], row, jnp.int32(-1))
+
+        return {"bindings": {a: col(a) for a in visible}}
+
     def _compact(self, mask):
         return _observe_compact(self.sched, mask)
 
@@ -681,6 +706,38 @@ class TpuMatchSolver:
             indptr, nbrs, srcs, offsets, total_dev, _cap_of(total)
         )
         return row, edge_pos, nbr, total
+
+    def _expand_one_dir_chunked(self, dec, d: str, srcs):
+        """Expansion slabs for one (class, direction): usually ONE
+        ``(row, eid, nbr, total)``, but when the output would exceed
+        config.max_expansion_cap rows, the binding table splits into
+        contiguous row ranges expanded separately — intermediate buffers
+        stay bounded however large the fan-out (the SURVEY.md §7
+        binding-table-blowup mitigation). The chunk count derives from
+        the RECORDED total, so replays keep the structure; per-chunk
+        observes catch parameter-driven growth."""
+        mg = self.dg.mesh_graph
+        cap = max(1, config.max_expansion_cap)
+        if mg is not None:
+            return [self._expand_one_dir(dec, d, srcs)]
+        if d == "out":
+            indptr = dec.indptr_out
+        else:
+            indptr = dec.indptr_in
+        counts = K.degree_counts(indptr, srcs)
+        total = self.sched.observe(counts.sum(), free=True)
+        n_chunks = max(1, -(-_cap_of(total) // cap))
+        if n_chunks == 1:
+            return [self._expand_one_dir(dec, d, srcs)]
+        width = int(srcs.shape[0])
+        step = -(-width // n_chunks)
+        slabs = []
+        for a in range(0, width, step):
+            sub = srcs[a : a + step]
+            row, eid, nbr, t = self._expand_one_dir(dec, d, sub)
+            row = jnp.where(row >= 0, row + a, row)  # local → table rows
+            slabs.append((row, eid, nbr, t))
+        return slabs
 
     def _expand_one_dir(self, dec, d: str, srcs):
         """One (edge class, direction) expansion → (row, global edge id,
@@ -784,7 +841,7 @@ class TpuMatchSolver:
         vcol = jnp.arange(vb, dtype=jnp.int32)
         valid_dev = table.valid_device
         exists_chunks = []
-        C = min(self._VAR_DEPTH_CHUNK, width)
+        C = self._var_chunk_rows(width, vb)
         for cs in range(0, width, C):
             chunk_rows = jnp.arange(cs, cs + C, dtype=jnp.int32)
             in_range = jnp.where(chunk_rows < valid_dev.shape[0], chunk_rows, -1)
@@ -1109,55 +1166,47 @@ class TpuMatchSolver:
                 where_fn, "uses_bindings", False
             )
             for d in sub_dirs:
-                row, eid, nbr, total = self._expand_one_dir(dec, d, srcs)
-                if total == 0:
-                    continue
-                env = {}
-                if node_uses or edge_uses:
-                    # per-slot binding arrays for alias.prop references
-                    env = {
-                        "bindings": {
-                            a: (
-                                K.take_pad(table.cols[a], row, jnp.int32(-1))
-                                if a in table.cols
-                                else jnp.full(row.shape, -1, jnp.int32)
-                            )
-                            for a in visible
-                        }
-                    }
-                mask = row >= 0
-                if where_fn is not None:
-                    mask = mask & where_fn(eid, env)
-                # destination node admission; close steps skip a
-                # binding-referencing re-check (the oracle doesn't re-run
-                # node filters when closing onto an already-bound alias,
-                # and the visibility set at first bind differs)
-                if not (step.close and node_uses):
-                    mask = mask & node_mask(nbr, env)
-                if step.close:
-                    bound = K.take_pad(table.cols[dst_alias], row, jnp.int32(-2))
-                    mask = mask & (nbr == bound)
-                if optional:
-                    matched_any = matched_any + K.rows_with_matches(
-                        row, mask, table.width or 1
-                    )
-                keep, kn, kn_dev = self._compact(mask)
-                if kn == 0:
-                    continue
-                krow = K.take_pad(row, keep, jnp.int32(-1))
-                part = table.gather(krow)
-                part.count = kn
-                part.count_dev = kn_dev
-                part.cols[dst_alias] = K.take_pad(nbr, keep, jnp.int32(-1))
-                ecls_idx = self.edge_class_idx[cname]
-                keid = K.take_pad(eid, keep, jnp.int32(-1))
-                self._bind_edge_alias(part, item, ecls_idx, keid)
-                if item.target.depth_alias:
-                    part.depth_cols[item.target.depth_alias] = jnp.where(
-                        part.cols[dst_alias] >= 0, 1, -1
-                    )
-                parts.append(part)
-                counts.append(kn)
+                for row, eid, nbr, total in self._expand_one_dir_chunked(
+                    dec, d, srcs
+                ):
+                    if total == 0:
+                        continue
+                    env = {}
+                    if node_uses or edge_uses:
+                        env = self._binding_env(table, row, visible)
+                    mask = row >= 0
+                    if where_fn is not None:
+                        mask = mask & where_fn(eid, env)
+                    # destination node admission; close steps skip a
+                    # binding-referencing re-check (the oracle doesn't re-run
+                    # node filters when closing onto an already-bound alias,
+                    # and the visibility set at first bind differs)
+                    if not (step.close and node_uses):
+                        mask = mask & node_mask(nbr, env)
+                    if step.close:
+                        bound = K.take_pad(table.cols[dst_alias], row, jnp.int32(-2))
+                        mask = mask & (nbr == bound)
+                    if optional:
+                        matched_any = matched_any + K.rows_with_matches(
+                            row, mask, table.width or 1
+                        )
+                    keep, kn, kn_dev = self._compact(mask)
+                    if kn == 0:
+                        continue
+                    krow = K.take_pad(row, keep, jnp.int32(-1))
+                    part = table.gather(krow)
+                    part.count = kn
+                    part.count_dev = kn_dev
+                    part.cols[dst_alias] = K.take_pad(nbr, keep, jnp.int32(-1))
+                    ecls_idx = self.edge_class_idx[cname]
+                    keid = K.take_pad(eid, keep, jnp.int32(-1))
+                    self._bind_edge_alias(part, item, ecls_idx, keid)
+                    if item.target.depth_alias:
+                        part.depth_cols[item.target.depth_alias] = jnp.where(
+                            part.cols[dst_alias] >= 0, 1, -1
+                        )
+                    parts.append(part)
+                    counts.append(kn)
         if optional:
             # left-join: rows with zero matches keep their binding, dst=null.
             # Liveness comes from the device valid mask, not the recorded
@@ -1250,16 +1299,7 @@ class TpuMatchSolver:
                     continue
                 env = {}
                 if uses:
-                    env = {
-                        "bindings": {
-                            a: (
-                                K.take_pad(table.cols[a], row, jnp.int32(-1))
-                                if a in table.cols
-                                else jnp.full(row.shape, -1, jnp.int32)
-                            )
-                            for a in visible
-                        }
-                    }
+                    env = self._binding_env(table, row, visible)
                 mask = (row >= 0) & (eid >= 0)
                 for fn in where_fns:
                     mask = mask & fn(eid, env)
@@ -1332,16 +1372,7 @@ class TpuMatchSolver:
         env = {}
         if node_uses:
             visible = self._step_visible.get(id(step), set())
-            env = {
-                "bindings": {
-                    a: (
-                        table.cols[a]
-                        if a in table.cols
-                        else jnp.full(width, -1, jnp.int32)
-                    )
-                    for a in visible
-                }
-            }
+            env = self._binding_env(table, None, visible)
         kinds = {"outv": ("src",), "inv": ("dst",), "bothv": ("src", "dst")}[m]
         live = table.valid_device[:width].astype(bool)
         parts: List[Table] = []
@@ -1392,6 +1423,15 @@ class TpuMatchSolver:
 
     _VAR_DEPTH_CHUNK = 256
 
+    @staticmethod
+    def _var_chunk_rows(width: int, vb: int) -> int:
+        """Rows per frontier-bitmap chunk: no wider than the (bucketed)
+        binding table — a point lookup walks 8-row bitmaps, not 256 — and
+        capped so one [rows, bucket(V)] bool chunk stays inside
+        config.var_depth_bitmap_budget bytes at SF100-scale V."""
+        budget_rows = max(1, config.var_depth_bitmap_budget // max(vb, 1))
+        return max(1, min(TpuMatchSolver._VAR_DEPTH_CHUNK, width, budget_rows))
+
     def _expand_var_depth(self, table: Table, step: PlanStep, optional: bool) -> Table:
         """Breadth-wise frontier iteration with per-row visited bitmaps —
         the SURVEY §5.7 design for the reference's per-record WHILE-DFS
@@ -1435,9 +1475,7 @@ class TpuMatchSolver:
         counts: List[int] = []
         width = table.width or 1
         matched_chunks = []
-        # chunk rows: no wider than the (bucketed) table itself — a
-        # point-lookup query walks 8-row bitmaps, not 256-row ones
-        C = min(self._VAR_DEPTH_CHUNK, width)
+        C = self._var_chunk_rows(width, vb)
         # chunk over the bucketed WIDTH (not the recorded count): on a
         # parameter-generic replay live rows can occupy any slot under the
         # recorded capacity, and the per-slot valid mask (not a host count)

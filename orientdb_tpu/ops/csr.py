@@ -23,8 +23,13 @@ import jax.numpy as jnp
 MIN_BUCKET = 8
 
 
-def bucket(n: int, minimum: int = MIN_BUCKET) -> int:
-    """Round up to a power of two (≥ minimum) to bound the jit cache."""
+def bucket(n: int, minimum: int = 0) -> int:
+    """Round up to a power of two (≥ minimum, default
+    config.min_expansion_cap) to bound the jit cache."""
+    if minimum <= 0:
+        from orientdb_tpu.utils.config import config
+
+        minimum = max(1, config.min_expansion_cap)
     if n <= minimum:
         return minimum
     return 1 << (n - 1).bit_length()

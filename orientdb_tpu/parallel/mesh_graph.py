@@ -38,6 +38,8 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from orientdb_tpu.ops import csr as K
+from orientdb_tpu.utils.config import config
+
 
 
 class ShardedEdgeArrays:
@@ -58,15 +60,17 @@ class MeshGraph:
     """Sharding context attached to a DeviceGraph."""
 
     def __init__(self, mesh: Mesh) -> None:
-        if "shards" not in mesh.shape:
-            raise ValueError("mesh must have a 'shards' axis")
+        if config.mesh_shard_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh must have a {config.mesh_shard_axis!r} axis"
+            )
         self.mesh = mesh
-        self.n_shards = mesh.shape["shards"]
+        self.n_shards = mesh.shape[config.mesh_shard_axis]
         self.rows_per_shard = 0
         self.edge: Dict[str, ShardedEdgeArrays] = {}
 
     def _spec(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P("shards", None))
+        return NamedSharding(self.mesh, P(config.mesh_shard_axis, None))
 
     def build(self, dg) -> None:
         """Populate ``dg.arrays`` with sharded adjacency for every edge
@@ -169,18 +173,18 @@ def expand_totals(mesh: Mesh, R: int, ind_sh, srcs) -> jnp.ndarray:
 
     def local(ind_l, srcs_rep):
         ind_l = ind_l[0]
-        sid = jax.lax.axis_index("shards")
+        sid = jax.lax.axis_index(config.mesh_shard_axis)
         lo = sid * R
         owned = (srcs_rep >= lo) & (srcs_rep < lo + R)
         ls = jnp.where(owned, srcs_rep - lo, -1)
         counts = K.degree_counts(ind_l, ls)
         tot = counts.sum()[None]
-        return jax.lax.all_gather(tot, "shards").reshape(-1)
+        return jax.lax.all_gather(tot, config.mesh_shard_axis).reshape(-1)
 
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(P("shards", None), P(None)),
+        in_specs=(P(config.mesh_shard_axis, None), P(None)),
         out_specs=P(None),
         check_vma=False,
     )(ind_sh, srcs)
@@ -207,7 +211,7 @@ def expand_gather(
 
     def local(ind_l, nbr_l, extra_l, srcs_rep):
         ind_l, nbr_l, extra_l = ind_l[0], nbr_l[0], extra_l[0]
-        sid = jax.lax.axis_index("shards")
+        sid = jax.lax.axis_index(config.mesh_shard_axis)
         lo = sid * R
         owned = (srcs_rep >= lo) & (srcs_rep < lo + R)
         ls = jnp.where(owned, srcs_rep - lo, -1)
@@ -221,7 +225,7 @@ def expand_gather(
             eid = K.take_pad(extra_l, epos, jnp.int32(-1))
 
         def ga(x):
-            return jax.lax.all_gather(x, "shards").reshape(-1)
+            return jax.lax.all_gather(x, config.mesh_shard_axis).reshape(-1)
 
         return ga(row), ga(eid), ga(nbr)
 
@@ -229,9 +233,9 @@ def expand_gather(
         local,
         mesh=mesh,
         in_specs=(
-            P("shards", None),
-            P("shards", None),
-            P("shards", None),
+            P(config.mesh_shard_axis, None),
+            P(config.mesh_shard_axis, None),
+            P(config.mesh_shard_axis, None),
             P(None),
         ),
         out_specs=(P(None), P(None), P(None)),
@@ -250,15 +254,15 @@ def sharded_bitmap_hop(
         act_l, emit_l, eid_l = act_l[0], emit_l[0], eid_l[0]
         em = K.take_pad(emask_rep, eid_l, False) & (act_l >= 0)
         contrib = K.bitmap_hop(act_l, emit_l, em, frontier_rep)
-        return jax.lax.psum(contrib.astype(jnp.int32), "shards") > 0
+        return jax.lax.psum(contrib.astype(jnp.int32), config.mesh_shard_axis) > 0
 
     return shard_map(
         local,
         mesh=mesh,
         in_specs=(
-            P("shards", None),
-            P("shards", None),
-            P("shards", None),
+            P(config.mesh_shard_axis, None),
+            P(config.mesh_shard_axis, None),
+            P(config.mesh_shard_axis, None),
             P(None),
             P(None, None),
         ),
@@ -286,15 +290,15 @@ def sharded_weight_pass(
         part = jax.ops.segment_sum(
             vals, jnp.clip(seg_l, 0, vb - 1), num_segments=vb
         )
-        return jax.lax.psum(part, "shards")
+        return jax.lax.psum(part, config.mesh_shard_axis)
 
     return shard_map(
         local,
         mesh=mesh,
         in_specs=(
-            P("shards", None),
-            P("shards", None),
-            P("shards", None),
+            P(config.mesh_shard_axis, None),
+            P(config.mesh_shard_axis, None),
+            P(config.mesh_shard_axis, None),
             P(None),
             P(None),
             P(None),

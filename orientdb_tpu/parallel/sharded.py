@@ -33,6 +33,8 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from orientdb_tpu.storage.snapshot import GraphSnapshot
+from orientdb_tpu.utils.config import config
+
 
 
 def provision_devices(n_devices: int) -> list:
@@ -88,7 +90,7 @@ def make_mesh(
     if n % replicas:
         raise ValueError(f"{n} devices not divisible into {replicas} replicas")
     arr = np.array(devs[:n]).reshape(replicas, n // replicas)
-    return Mesh(arr, ("replicas", "shards"))
+    return Mesh(arr, (config.mesh_replica_axis, config.mesh_shard_axis))
 
 
 class ShardedCSR:
@@ -101,7 +103,7 @@ class ShardedCSR:
 
     def __init__(self, mesh: Mesh, indptr: np.ndarray, dst: np.ndarray):
         self.mesh = mesh
-        n_shards = mesh.shape["shards"]
+        n_shards = mesh.shape[config.mesh_shard_axis]
         V = int(indptr.shape[0]) - 1
         rows = max(1, math.ceil(V / n_shards))
         V_pad = rows * n_shards
@@ -124,7 +126,7 @@ class ShardedCSR:
         dst_l = np.full((n_shards, e_max), -1, np.int32)
         for s, seg in enumerate(locals_):
             dst_l[s, : seg.shape[0]] = seg
-        shard_spec = NamedSharding(mesh, P("shards", None))
+        shard_spec = NamedSharding(mesh, P(config.mesh_shard_axis, None))
         self.indptr = jax.device_put(jnp.asarray(ind_l), shard_spec)
         self.dst = jax.device_put(jnp.asarray(dst_l), shard_spec)
 
@@ -150,7 +152,7 @@ def _local_hop(indptr_l, dst_l, frontier, rows_per_shard, v_pad):
         0,
         rows_per_shard - 1,
     )
-    shard_id = jax.lax.axis_index("shards")
+    shard_id = jax.lax.axis_index(config.mesh_shard_axis)
     src_global = src_local + shard_id * rows_per_shard
     edge_live = (dst_l >= 0) & (epos < indptr_l[-1])
     # [Q, E_max]: edge active iff its source is in that query's frontier
@@ -179,7 +181,7 @@ def build_bfs_step(
                     indptr_l, dst_l, frontier, rows_per_shard, v_pad
                 )
                 merged = (
-                    jax.lax.psum(contrib.astype(jnp.int32), "shards") > 0
+                    jax.lax.psum(contrib.astype(jnp.int32), config.mesh_shard_axis) > 0
                 )
                 nxt = merged & ~visited
                 return nxt, visited | nxt
@@ -192,8 +194,8 @@ def build_bfs_step(
         return shard_map(
             inner,
             mesh=mesh,
-            in_specs=(P("shards", None), P("shards", None), P("replicas", None)),
-            out_specs=P("replicas", None),
+            in_specs=(P(config.mesh_shard_axis, None), P(config.mesh_shard_axis, None), P(config.mesh_replica_axis, None)),
+            out_specs=P(config.mesh_replica_axis, None),
             check_vma=False,
         )(indptr_sh, dst_sh, roots)
 
@@ -208,12 +210,12 @@ def bfs_reachability(
     semantics)."""
     mesh = scsr.mesh
     Q = roots.shape[0]
-    reps = mesh.shape["replicas"]
+    reps = mesh.shape[config.mesh_replica_axis]
     q_pad = max(1, math.ceil(Q / reps)) * reps
     fr = np.zeros((q_pad, scsr.padded_vertices), bool)
     fr[:Q, : roots.shape[1]] = roots
     fr_dev = jax.device_put(
-        jnp.asarray(fr), NamedSharding(mesh, P("replicas", None))
+        jnp.asarray(fr), NamedSharding(mesh, P(config.mesh_replica_axis, None))
     )
     step = build_bfs_step(
         mesh, scsr.rows_per_shard, scsr.padded_vertices, max_depth

@@ -334,10 +334,17 @@ def _apply_entry(db: Database, e: Dict) -> None:
     elif op == "drop_index":
         db.indexes.drop_index(e["name"])
     elif op == "create_sequence":
-        db.sequences.create(
-            e["name"], e.get("type", "ORDERED"), e.get("start", 0),
-            e.get("increment", 1), e.get("cache", 20),
-        )
+        if db.sequences.get(e["name"]) is not None:
+            # legacy alter-format entries ({op:'create_sequence',
+            # alter:true}) and idempotent re-creates must not abort replay
+            db.sequences.alter(
+                e["name"], e.get("start"), e.get("increment"), e.get("cache")
+            )
+        else:
+            db.sequences.create(
+                e["name"], e.get("type", "ORDERED"), e.get("start", 0),
+                e.get("increment", 1), e.get("cache", 20),
+            )
     elif op == "alter_sequence":
         if db.sequences.get(e["name"]) is not None:
             db.sequences.alter(

@@ -34,17 +34,19 @@ class GlobalConfiguration:
     # (tpu when a snapshot is attached, oracle otherwise).
     traverse_engine: str = "auto"
 
-    # Expansion output caps are padded to powers of two >= this to bound
-    # recompilation while keeping buffers small.
-    min_expansion_cap: int = 1024
+    # Expansion/compaction buffers are padded to powers of two >= this to
+    # bound recompilation while keeping buffers small (ops/csr.bucket).
+    min_expansion_cap: int = 8
     # Hard ceiling on a single expansion output buffer (rows). Expansions
-    # that would exceed it are chunked over the binding table.
+    # that would exceed it are chunked over the binding table
+    # (tpu_engine._expand_one_dir_chunked).
     max_expansion_cap: int = 1 << 22
 
-    # Default max depth for WHILE-style variable-depth MATCH arms when the
-    # query gives no maxDepth (OrientDB requires WHILE or maxDepth; we keep a
-    # safety ceiling for the compiled path).
-    default_max_depth: int = 32
+    # Byte budget for one variable-depth frontier bitmap chunk
+    # ([rows, bucket(V)] bools): the chunk row count shrinks as the graph
+    # grows so deep-traversal memory stays bounded at SF100-scale vertex
+    # counts (SURVEY.md §5.7).
+    var_depth_bitmap_budget: int = 1 << 26
 
     # Buffer headroom multiplier for recorded size schedules: compiled
     # plans size buffers at bucket(observed * headroom), so
@@ -71,8 +73,10 @@ class GlobalConfiguration:
     # Snapshot build options.
     string_dictionary_max: int = 1 << 24  # max distinct strings per column
 
-    # Sharding.
-    mesh_axis_name: str = "shard"
+    # Sharding: device-mesh axis names (parallel/mesh_graph.py shards the
+    # CSR over the shard axis; replicas carry independent query streams).
+    mesh_shard_axis: str = "shards"
+    mesh_replica_axis: str = "replicas"
 
     # Logging level for get_logger default.
     log_level: str = "WARNING"
