@@ -1,0 +1,3 @@
+#!/bin/sh
+# [E] console.sh — interactive SQL console (SURVEY.md §2 "Console")
+exec python -m orientdb_tpu.tools.console "$@"

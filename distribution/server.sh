@@ -1,0 +1,3 @@
+#!/bin/sh
+# [E] server.sh — start the orientdb-tpu server (SURVEY.md §3.1)
+exec python -m orientdb_tpu.server "$@"

@@ -1,0 +1,2 @@
+from orientdb_tpu.api.graph import Graph  # noqa: F401
+from orientdb_tpu.api.objects import ObjectDatabase  # noqa: F401

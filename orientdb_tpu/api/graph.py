@@ -1,0 +1,158 @@
+"""Blueprints-style property-graph API.
+
+Analog of the reference's TinkerPop compatibility layer ([E] graphdb/
+``OrientGraph``/``OrientVertex``/``OrientEdge``; SURVEY.md §2 "Graph API
+(TinkerPop)"): a thin, idiomatic wrapper over the embedded Database for
+programs that want graph verbs (add_vertex/add_edge/vertices/edges,
+degree, neighbor iteration) rather than SQL. The native graph model
+lives in ``models/`` — this is the compatibility surface, not a second
+engine."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.record import Direction, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+
+
+class GraphVertex:
+    """[E] OrientVertex: property access + incident-edge navigation."""
+
+    __slots__ = ("_g", "_doc")
+
+    def __init__(self, g: "Graph", doc: Vertex) -> None:
+        self._g = g
+        self._doc = doc
+
+    @property
+    def id(self) -> str:
+        return str(self._doc.rid)
+
+    @property
+    def label(self) -> str:
+        return self._doc.class_name
+
+    def value(self, key: str, default=None):
+        return self._doc.get(key, default)
+
+    def keys(self) -> List[str]:
+        return self._doc.field_names()
+
+    def property(self, key: str, value) -> "GraphVertex":
+        self._doc.set(key, value)
+        self._g.db.save(self._doc)
+        return self
+
+    def remove(self) -> None:
+        self._g.db.delete(self._doc)
+
+    def edges(self, direction: str = "both", label: Optional[str] = None) -> Iterator["GraphEdge"]:
+        d = {"out": Direction.OUT, "in": Direction.IN, "both": Direction.BOTH}[direction]
+        for e in self._doc.edges(d, label):
+            yield GraphEdge(self._g, e)
+
+    def vertices(self, direction: str = "both", label: Optional[str] = None) -> Iterator["GraphVertex"]:
+        d = {"out": Direction.OUT, "in": Direction.IN, "both": Direction.BOTH}[direction]
+        for v in self._doc.vertices(d, label):
+            yield GraphVertex(self._g, v)
+
+    def degree(self, direction: str = "both", label: Optional[str] = None) -> int:
+        return sum(1 for _ in self.edges(direction, label))
+
+    def __repr__(self) -> str:
+        return f"v[{self.id}]"
+
+
+class GraphEdge:
+    """[E] OrientEdge."""
+
+    __slots__ = ("_g", "_doc")
+
+    def __init__(self, g: "Graph", doc: Edge) -> None:
+        self._g = g
+        self._doc = doc
+
+    @property
+    def id(self) -> str:
+        return str(self._doc.rid)
+
+    @property
+    def label(self) -> str:
+        return self._doc.class_name
+
+    def value(self, key: str, default=None):
+        return self._doc.get(key, default)
+
+    def property(self, key: str, value) -> "GraphEdge":
+        self._doc.set(key, value)
+        self._g.db.save(self._doc)
+        return self
+
+    def out_vertex(self) -> GraphVertex:
+        return GraphVertex(self._g, self._doc.from_vertex())
+
+    def in_vertex(self) -> GraphVertex:
+        return GraphVertex(self._g, self._doc.to_vertex())
+
+    def remove(self) -> None:
+        self._g.db.delete(self._doc)
+
+    def __repr__(self) -> str:
+        return f"e[{self.id}][{self.label}]"
+
+
+class Graph:
+    """[E] OrientGraph: the Blueprints-style entry point.
+
+    >>> g = Graph()
+    >>> a = g.add_vertex("Person", name="ada")
+    >>> b = g.add_vertex("Person", name="bob")
+    >>> g.add_edge(a, b, "Knows", since=1970)
+    """
+
+    def __init__(self, db: Optional[Database] = None, name: str = "graph") -> None:
+        self.db = db if db is not None else Database(name)
+
+    # -- mutation -----------------------------------------------------------
+
+    def add_vertex(self, label: str = "V", **props) -> GraphVertex:
+        return GraphVertex(self, self.db.new_vertex(label, **props))
+
+    def add_edge(
+        self, src: GraphVertex, dst: GraphVertex, label: str = "E", **props
+    ) -> GraphEdge:
+        return GraphEdge(self, self.db.new_edge(label, src._doc, dst._doc, **props))
+
+    # -- lookup -------------------------------------------------------------
+
+    def vertex(self, vid) -> Optional[GraphVertex]:
+        doc = self.db.load(RID.parse(vid) if isinstance(vid, str) else vid)
+        return GraphVertex(self, doc) if isinstance(doc, Vertex) else None
+
+    def edge(self, eid) -> Optional[GraphEdge]:
+        doc = self.db.load(RID.parse(eid) if isinstance(eid, str) else eid)
+        return GraphEdge(self, doc) if isinstance(doc, Edge) else None
+
+    def vertices(self, label: str = "V", **filters) -> Iterator[GraphVertex]:
+        for doc in self.db.browse_class(label):
+            if isinstance(doc, Vertex) and all(
+                doc.get(k) == v for k, v in filters.items()
+            ):
+                yield GraphVertex(self, doc)
+
+    def edges(self, label: str = "E", **filters) -> Iterator[GraphEdge]:
+        for doc in self.db.browse_class(label):
+            if isinstance(doc, Edge) and all(
+                doc.get(k) == v for k, v in filters.items()
+            ):
+                yield GraphEdge(self, doc)
+
+    # -- SQL passthrough (the TinkerPop layer exposes this too) -------------
+
+    def query(self, sql: str, **kw):
+        return self.db.query(sql, **kw)
+
+    def command(self, sql: str, **kw):
+        return self.db.command(sql, **kw)

@@ -1,0 +1,196 @@
+"""Object mapping over documents.
+
+Analog of the reference's object database ([E] object/
+``OObjectDatabaseTx``/``OObjectEntitySerializer``; SURVEY.md §2 "Object
+API"): maps plain Python classes (dataclasses or attribute classes) onto
+schema classes — the reference's javassist-proxied POJOs become plain
+instances with an attached ``@rid``/``@version``. Link fields (values
+that are themselves mapped instances) persist as RID links and resolve
+back to instances on load."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Type, TypeVar
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.record import Document
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.models.schema import PropertyType
+
+T = TypeVar("T")
+
+_RID_ATTR = "_odb_rid"
+_VER_ATTR = "_odb_version"
+
+
+class ObjectDatabase:
+    """[E] OObjectDatabaseTx: register classes, save/load/query instances."""
+
+    def __init__(self, db: Optional[Database] = None, name: str = "objects") -> None:
+        self.db = db if db is not None else Database(name)
+        self._registered: Dict[str, Type] = {}
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, cls: Type[T], vertex: bool = False) -> Type[T]:
+        """Register an entity class; its name becomes the schema class
+        ([E] ODatabaseObject.getEntityManager().registerEntityClass).
+        Dataclass fields (or __init__-set attributes) become properties."""
+        name = cls.__name__
+        if not self.db.schema.exists_class(name):
+            sc = (
+                self.db.schema.create_vertex_class(name)
+                if vertex
+                else self.db.schema.create_class(name)
+            )
+            if dataclasses.is_dataclass(cls):
+                for f in dataclasses.fields(cls):
+                    pt = _ptype_for(f.type)
+                    if pt is not None:
+                        sc.create_property(f.name, pt)
+        self._registered[name] = cls
+        return cls
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, obj, _saving: Optional[set] = None) -> object:
+        """Persist an instance ([E] OObjectDatabaseTx.save): cycles are
+        handled by creating the record shell BEFORE resolving link fields
+        (so mutually-referential instances see each other's RIDs), and a
+        stale instance version raises ConcurrentModificationError instead
+        of silently clobbering a newer store state."""
+        from orientdb_tpu.models.database import ConcurrentModificationError
+
+        _saving = _saving if _saving is not None else set()
+        if id(obj) in _saving:
+            return obj  # already on the save stack; its shell rid exists
+        _saving.add(id(obj))
+        cls_name = type(obj).__name__
+        if cls_name not in self._registered:
+            raise TypeError(f"class {cls_name!r} is not registered")
+        rid: Optional[RID] = getattr(obj, _RID_ATTR, None)
+        if rid is None:
+            # phase 1: shell record, so link cycles can point at it
+            doc = self.db.new_element(cls_name)
+            object.__setattr__(obj, _RID_ATTR, doc.rid)
+        else:
+            doc = self.db.load(rid)
+            if doc is None:
+                raise LookupError(f"{rid} vanished")
+            stale = getattr(obj, _VER_ATTR, doc.version)
+            if doc.version != stale:
+                raise ConcurrentModificationError(
+                    f"{rid}: stored v{doc.version} != instance v{stale}"
+                )
+        # phase 2: resolve fields (links may recurse; shells break cycles)
+        fields = {}
+        for k, v in _instance_fields(obj).items():
+            if type(v).__name__ in self._registered:
+                if getattr(v, _RID_ATTR, None) is None:
+                    self.save(v, _saving)
+                fields[k] = getattr(v, _RID_ATTR)
+            else:
+                fields[k] = v
+        for k, v in fields.items():
+            doc.set(k, v)
+        self.db.save(doc)
+        object.__setattr__(obj, _RID_ATTR, doc.rid)
+        object.__setattr__(obj, _VER_ATTR, doc.version)
+        return obj
+
+    def load(self, rid, cls: Optional[Type[T]] = None) -> Optional[T]:
+        if isinstance(rid, str):
+            rid = RID.parse(rid)
+        doc = self.db.load(rid)
+        if doc is None:
+            return None
+        return self._materialize(doc, cls)
+
+    def delete(self, obj) -> None:
+        rid = getattr(obj, _RID_ATTR, None)
+        if rid is None:
+            return
+        doc = self.db.load(rid)
+        if doc is not None:
+            self.db.delete(doc)
+        object.__setattr__(obj, _RID_ATTR, None)
+
+    def browse(self, cls: Type[T]) -> Iterator[T]:
+        for doc in self.db.browse_class(cls.__name__):
+            yield self._materialize(doc, cls)
+
+    def query(self, sql: str, params=None, cls: Optional[Type[T]] = None) -> List[T]:
+        """SQL over entities; element rows materialize as instances."""
+        out = []
+        for r in self.db.query(sql, params=params):
+            if r.is_element:
+                out.append(self._materialize(r.element, cls))
+            else:
+                out.append(r.to_dict())
+        return out
+
+    # -- materialization ----------------------------------------------------
+
+    def _materialize(
+        self, doc: Document, cls: Optional[Type] = None, _memo: Optional[Dict] = None
+    ):
+        """Instance for a document; ``_memo`` (rid → instance) makes link
+        cycles materialize as object cycles instead of recursing forever."""
+        _memo = _memo if _memo is not None else {}
+        hit = _memo.get(doc.rid)
+        if hit is not None:
+            return hit
+        cls = cls or self._registered.get(doc.class_name)
+        if cls is None:
+            raise TypeError(f"no registered class for {doc.class_name!r}")
+        # shell first, memoize, THEN resolve links (cycles point at the shell)
+        obj = cls.__new__(cls)
+        _memo[doc.rid] = obj
+        for k, v in doc.fields().items():
+            if isinstance(v, RID):
+                linked = self.db.load(v)
+                v = (
+                    self._materialize(linked, _memo=_memo)
+                    if linked is not None
+                    else None
+                )
+            object.__setattr__(obj, k, v)
+        if dataclasses.is_dataclass(cls):
+            # fill declared fields absent from the document with defaults
+            for f in dataclasses.fields(cls):
+                if not hasattr(obj, f.name):
+                    if f.default is not dataclasses.MISSING:
+                        object.__setattr__(obj, f.name, f.default)
+                    elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+                        object.__setattr__(obj, f.name, f.default_factory())  # type: ignore[misc]
+        object.__setattr__(obj, _RID_ATTR, doc.rid)
+        object.__setattr__(obj, _VER_ATTR, doc.version)
+        return obj
+
+
+def rid_of(obj) -> Optional[RID]:
+    """The persistent identity of a saved instance (None = transient)."""
+    return getattr(obj, _RID_ATTR, None)
+
+
+def _instance_fields(obj) -> Dict[str, object]:
+    if dataclasses.is_dataclass(obj):
+        return {f.name: getattr(obj, f.name) for f in dataclasses.fields(obj)}
+    return {
+        k: v for k, v in vars(obj).items() if not k.startswith("_")
+    }
+
+
+def _ptype_for(annotation) -> Optional[PropertyType]:
+    mapping = {
+        int: PropertyType.LONG,
+        "int": PropertyType.LONG,
+        float: PropertyType.DOUBLE,
+        "float": PropertyType.DOUBLE,
+        str: PropertyType.STRING,
+        "str": PropertyType.STRING,
+        bool: PropertyType.BOOLEAN,
+        "bool": PropertyType.BOOLEAN,
+    }
+    return mapping.get(annotation)

@@ -62,6 +62,14 @@ class Index:
             return None  # null keys are not indexed (OrientDB default)
         return vals[0] if len(vals) == 1 else vals
 
+    # manager-facing hooks (FullTextIndex overrides with multi-key puts)
+
+    def index_doc(self, doc: Document) -> None:
+        self.put(self._key_of(doc), doc.rid)
+
+    def unindex_doc(self, rid: RID) -> None:
+        self.remove(rid)
+
     # -- mutation ----------------------------------------------------------
 
     def put(self, key, rid: RID) -> None:
@@ -140,6 +148,86 @@ class Index:
         return f"Index({self.name} {self.type} on {self.class_name}{self.fields})"
 
 
+class FullTextIndex(Index):
+    """Token inverted index — the fulltext engine analog ([E] lucene/
+    ``OLuceneFullTextIndex``; SURVEY.md §2 "Lucene"): field text is
+    lowercased and split on non-alphanumerics, each token maps to the
+    posting set of RIDs. Query via :meth:`search` (OR) /
+    :meth:`search_all` (AND), ``db.indexes.fulltext_search``, or the SQL
+    ``FROM index:Name WHERE key = 'token'`` target form. Spatial — the
+    reference's other Lucene engine — is out of scope."""
+
+    def __init__(self, name, class_name, fields):
+        # bypass the parent's type whitelist; postings are hash-style
+        self.name = name
+        self.class_name = class_name
+        self.fields = list(fields)
+        self.type = "FULLTEXT"
+        self._map = {}
+        self._reverse = {}
+        self._sorted_keys = []
+
+    @property
+    def unique(self) -> bool:
+        return False
+
+    @property
+    def range_capable(self) -> bool:
+        return False
+
+    @staticmethod
+    def tokenize(text) -> List[str]:
+        if text is None:
+            return []
+        out, cur = [], []
+        for ch in str(text).lower():
+            if ch.isalnum():
+                cur.append(ch)
+            elif cur:
+                out.append("".join(cur))
+                cur = []
+        if cur:
+            out.append("".join(cur))
+        return out
+
+    def index_doc(self, doc: Document) -> None:
+        tokens = set()
+        for f in self.fields:
+            tokens.update(self.tokenize(doc.get(f)))
+        for t in tokens:
+            self._map.setdefault(t, set()).add(doc.rid)
+        if tokens:
+            self._reverse[doc.rid] = frozenset(tokens)
+
+    def unindex_doc(self, rid: RID) -> None:
+        tokens = self._reverse.pop(rid, None)
+        if not tokens:
+            return
+        for t in tokens:
+            bucket = self._map.get(t)
+            if bucket is not None:
+                bucket.discard(rid)
+                if not bucket:
+                    del self._map[t]
+
+    def search(self, query) -> Set[RID]:
+        """RIDs matching ANY query token."""
+        out: Set[RID] = set()
+        for t in self.tokenize(query):
+            out |= self._map.get(t, set())
+        return out
+
+    def search_all(self, query) -> Set[RID]:
+        """RIDs matching EVERY query token."""
+        toks = self.tokenize(query)
+        if not toks:
+            return set()
+        out = set(self._map.get(toks[0], set()))
+        for t in toks[1:]:
+            out &= self._map.get(t, set())
+        return out
+
+
 class IndexManager:
     """[E] OIndexManagerShared: registry + save/delete hooks."""
 
@@ -157,10 +245,13 @@ class IndexManager:
         if name.lower() in self._indexes:
             raise ValueError(f"index '{name}' already exists")
         cls = self._db.schema.get_class_or_raise(class_name)
-        idx = Index(name, cls.name, fields, index_type)
+        if index_type.upper() in ("FULLTEXT", "FULLTEXT_HASH_INDEX"):
+            idx: Index = FullTextIndex(name, cls.name, fields)
+        else:
+            idx = Index(name, cls.name, fields, index_type)
         # Build over existing records (OrientDB rebuilds on creation).
         for doc in self._db.browse_class(cls.name, polymorphic=True):
-            idx.put(idx._key_of(doc), doc.rid)
+            idx.index_doc(doc)
         self._indexes[name.lower()] = idx
         self._db._wal_log(
             {
@@ -203,12 +294,41 @@ class IndexManager:
         ]:
             del self._indexes[name]
 
+    def fulltext_for(self, class_name: str, field: str) -> Optional["FullTextIndex"]:
+        """Single-field fulltext index covering ``class_name.field``."""
+        cls = self._db.schema.get_class(class_name)
+        if cls is None:
+            return None
+        for idx in self._indexes.values():
+            if (
+                isinstance(idx, FullTextIndex)
+                and field in idx.fields
+                and cls.is_subclass_of(idx.class_name)
+            ):
+                return idx
+        return None
+
+    def fulltext_search(self, class_name: str, field: str, query: str, mode: str = "any"):
+        """Documents matching the query tokens through the fulltext index."""
+        idx = self.fulltext_for(class_name, field)
+        if idx is None:
+            raise ValueError(f"no fulltext index on {class_name}.{field}")
+        rids = idx.search_all(query) if mode == "all" else idx.search(query)
+        out = []
+        for rid in sorted(rids):
+            d = self._db.load(rid)
+            if d is not None:
+                out.append(d)
+        return out
+
     def best_for(self, class_name: str, field: str) -> Optional[Index]:
         """Single-field index usable for a lookup on ``class_name.field``."""
         cls = self._db.schema.get_class(class_name)
         if cls is None:
             return None
         for idx in self._indexes.values():
+            if isinstance(idx, FullTextIndex):
+                continue  # token keys — not usable for value lookups
             if idx.fields == [field] and cls.is_subclass_of(idx.class_name):
                 return idx
         return None
@@ -235,12 +355,12 @@ class IndexManager:
 
     def on_save(self, doc: Document) -> None:
         for idx in self._applicable(doc):
-            idx.remove(doc.rid)
-            idx.put(idx._key_of(doc), doc.rid)
+            idx.unindex_doc(doc.rid)
+            idx.index_doc(doc)
 
     def on_delete(self, doc: Document) -> None:
         for idx in self._applicable(doc):
-            idx.remove(doc.rid)
+            idx.unindex_doc(doc.rid)
 
     def _applicable(self, doc: Document) -> List[Index]:
         cls = self._db.schema.get_class(doc.class_name)

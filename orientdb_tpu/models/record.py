@@ -146,6 +146,52 @@ class Document:
     # query layer keys on `doc.rid` explicitly.
 
 
+class RidBag:
+    """Adjacency container ([E] ORidBag): an ordered list of edge RIDs
+    that transparently *promotes* past a threshold — a membership set
+    appears alongside the list, turning the reference's embedded→
+    sbtree-bonsai switch into O(1) ``in``/``remove`` for supernodes while
+    small bags stay a bare list with no set overhead."""
+
+    __slots__ = ("_items", "_set")
+
+    PROMOTE_AT = 64  # [E] RID_BAG_EMBEDDED_TO_SBTREEBONSAI_THRESHOLD analog
+
+    def __init__(self, items: Optional[List[RID]] = None) -> None:
+        self._items: List[RID] = list(items or ())
+        self._set = set(self._items) if len(self._items) > self.PROMOTE_AT else None
+
+    def append(self, rid: RID) -> None:
+        self._items.append(rid)
+        if self._set is not None:
+            self._set.add(rid)
+        elif len(self._items) > self.PROMOTE_AT:
+            self._set = set(self._items)
+
+    def remove(self, rid: RID) -> None:
+        self._items.remove(rid)
+        if self._set is not None:
+            self._set.discard(rid)
+
+    def __contains__(self, rid: RID) -> bool:
+        if self._set is not None:
+            return rid in self._set
+        return rid in self._items
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    @property
+    def promoted(self) -> bool:
+        return self._set is not None
+
+    def __repr__(self) -> str:
+        return f"RidBag({len(self._items)}{'*' if self.promoted else ''})"
+
+
 class Vertex(Document):
     """A vertex record with adjacency bags ([E] OVertexDocument)."""
 
@@ -153,13 +199,19 @@ class Vertex(Document):
 
     def __init__(self, class_name: str, fields: Optional[Dict[str, object]] = None):
         super().__init__(class_name, fields)
-        # edge class name -> ordered list of edge RIDs (the RidBag analog)
-        self._out_edges: Dict[str, List[RID]] = {}
-        self._in_edges: Dict[str, List[RID]] = {}
+        # edge class name -> RidBag of edge RIDs
+        self._out_edges: Dict[str, RidBag] = {}
+        self._in_edges: Dict[str, RidBag] = {}
 
-    def _bag(self, direction: Direction, edge_class: str) -> List[RID]:
+    def _bag(self, direction: Direction, edge_class: str) -> RidBag:
         bags = self._out_edges if direction is Direction.OUT else self._in_edges
-        return bags.setdefault(edge_class, [])
+        bag = bags.get(edge_class)
+        if bag is None:
+            bag = bags[edge_class] = RidBag()
+        elif not isinstance(bag, RidBag):
+            # restore paths may assign plain lists; adopt in place
+            bag = bags[edge_class] = RidBag(bag)
+        return bag
 
     def _edge_classes(self, direction: Direction) -> List[str]:
         if direction is Direction.OUT:

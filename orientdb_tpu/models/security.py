@@ -161,13 +161,21 @@ class SecurityManager:
         return self.users.pop(name.lower(), None) is not None
 
     def authenticate(self, name: str, password: str) -> Optional[User]:
+        audit = getattr(self, "audit", None)
         u = self.users.get(name.lower())
         if u is not None and u.check_password(password):
+            if audit is not None:
+                audit.auth_ok(name)
             return u
+        if audit is not None:
+            audit.auth_fail(name)
         return None
 
     def check(self, user: User, resource: str, op: str) -> None:
         if not user.allows(resource, op):
+            audit = getattr(self, "audit", None)
+            if audit is not None:
+                audit.denied(user.name, resource, op)
             raise SecurityError(
                 f"user '{user.name}' lacks {op} permission on '{resource}'"
             )

@@ -1,0 +1,85 @@
+"""Security auditing.
+
+Analog of the reference's security module's auditing plugin ([E]
+security/ ``OSecurityPlugin`` + the EE auditing component; SURVEY.md §2
+"Security module (Kerberos/LDAP/audit)"): an append-only JSON-lines
+trail of authentication attempts, permission denials, and record
+mutations, attachable to a Server (auth events) and to any Database
+(record events, via the hook pipeline — so transactional events surface
+post-commit only, matching the hook-buffering semantics). Kerberos/LDAP
+authenticators have no offline analog and stay out of scope; the
+pluggable seam is the ``authenticator`` callable on SecurityManager
+consumers."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("audit")
+
+
+class AuditLog:
+    """Append-only audit trail; memory ring + optional JSON-lines file."""
+
+    def __init__(self, path: Optional[str] = None, keep: int = 1000) -> None:
+        self.path = path
+        self.keep = keep
+        self._events: List[Dict] = []
+        self._lock = threading.Lock()
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a")
+
+    def record(self, kind: str, **fields) -> None:
+        ev = {"ts": time.time(), "kind": kind, **fields}
+        with self._lock:
+            self._events.append(ev)
+            del self._events[: -self.keep]
+            if self._fh is not None:
+                self._fh.write(json.dumps(ev, default=str) + "\n")
+                self._fh.flush()
+
+    def events(self, kind: Optional[str] = None) -> List[Dict]:
+        with self._lock:
+            return [e for e in self._events if kind is None or e["kind"] == kind]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- attachments --------------------------------------------------------
+
+    def watch_database(self, db, name: Optional[str] = None) -> None:
+        """Record post-commit record mutations ([E] the auditing hook is an
+        ORecordHook; riding the AFTER pipeline keeps compensated-away tx
+        ops out of the trail)."""
+        dbname = name or db.name
+
+        def hook(event, doc):
+            self.record(
+                "record." + event.split("_", 1)[1],
+                db=dbname,
+                rid=str(doc.rid),
+                cls=doc.class_name,
+            )
+
+        for ev in ("after_create", "after_update", "after_delete"):
+            db.hooks.register(hook, event=ev)
+
+    def auth_ok(self, user: str, origin: str = "") -> None:
+        self.record("auth.ok", user=user, origin=origin)
+
+    def auth_fail(self, user: str, origin: str = "") -> None:
+        self.record("auth.fail", user=user, origin=origin)
+
+    def denied(self, user: str, resource: str, op: str) -> None:
+        self.record("auth.denied", user=user, resource=resource, op=op)

@@ -47,6 +47,12 @@ class Server:
         self.name = name
         self.databases: Dict[str, Database] = {}
         self.security = SecurityManager(admin_password)
+        # audit trail ([E] the security module's auditing plugin): auth
+        # events always; attach databases via audit.watch_database
+        from orientdb_tpu.server.audit import AuditLog
+
+        self.audit = AuditLog()
+        self.security.audit = self.audit
         self.plugins: List[ServerPlugin] = []
         self._lock = threading.Lock()
         self._http = None

@@ -1,0 +1,304 @@
+"""SURVEY §2 breadth components: Graph API (TinkerPop analog), Object
+API, ETL pipelines, fulltext index engine, security auditing, RidBag
+promotion, distribution entry points."""
+
+import dataclasses
+import json
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.schema import PropertyType
+
+
+class TestGraphAPI:
+    def test_crud_and_navigation(self):
+        from orientdb_tpu.api import Graph
+
+        g = Graph()
+        a = g.add_vertex("Person", name="ada")
+        b = g.add_vertex("Person", name="bob")
+        c = g.add_vertex("Person", name="cyd")
+        e = g.add_edge(a, b, "Knows", since=1970)
+        g.add_edge(a, c, "Knows", since=1980)
+        assert e.label == "Knows" and e.value("since") == 1970
+        assert e.out_vertex().value("name") == "ada"
+        assert e.in_vertex().value("name") == "bob"
+        assert sorted(v.value("name") for v in a.vertices("out", "Knows")) == ["bob", "cyd"]
+        assert a.degree("out") == 2 and b.degree("in") == 1
+        assert g.vertex(a.id).value("name") == "ada"
+        # property update + filtered iteration
+        b.property("name", "bobby")
+        assert [v.id for v in g.vertices("Person", name="bobby")] == [b.id]
+        # removal cascades incident edges
+        a.remove()
+        assert b.degree("in") == 0
+        # SQL passthrough over the same store
+        rows = g.query("SELECT count(*) AS n FROM Person").to_dicts()
+        assert rows == [{"n": 2}]
+
+
+class TestObjectAPI:
+    def test_dataclass_round_trip_with_links(self):
+        from orientdb_tpu.api import ObjectDatabase
+        from orientdb_tpu.api.objects import rid_of
+
+        odb = ObjectDatabase()
+
+        @odb.register
+        @dataclasses.dataclass
+        class City:
+            name: str = ""
+
+        @odb.register
+        @dataclasses.dataclass
+        class Person:
+            name: str = ""
+            age: int = 0
+            home: object = None
+
+        rome = City(name="rome")
+        p = Person(name="ada", age=36, home=rome)
+        odb.save(p)
+        assert rid_of(p) is not None and rid_of(rome) is not None
+        back = odb.load(rid_of(p))
+        assert back.name == "ada" and back.home.name == "rome"
+        # schema materialized from annotations
+        assert odb.db.schema.get_class("Person").get_property("age").type is PropertyType.LONG
+        # update path
+        back.age = 37
+        odb.save(back)
+        assert odb.load(rid_of(back)).age == 37
+        # browse + query
+        assert [x.name for x in odb.browse(Person)] == ["ada"]
+        got = odb.query("SELECT FROM Person WHERE age = 37", cls=Person)
+        assert len(got) == 1 and got[0].name == "ada"
+        odb.delete(back)
+        assert list(odb.browse(Person)) == []
+
+
+class TestObjectAPIReviewRegressions:
+    def test_stale_save_raises_mvcc(self):
+        from orientdb_tpu.api import ObjectDatabase
+        from orientdb_tpu.api.objects import rid_of
+        from orientdb_tpu.models.database import ConcurrentModificationError
+
+        odb = ObjectDatabase()
+
+        @odb.register
+        @dataclasses.dataclass
+        class P:
+            n: int = 0
+
+        odb.save(P(n=1))
+        rid = rid_of(next(iter(odb.browse(P))))
+        a = odb.load(rid)
+        b = odb.load(rid)
+        b.n = 2
+        odb.save(b)
+        a.n = 99
+        with pytest.raises(ConcurrentModificationError):
+            odb.save(a)
+        assert odb.load(rid).n == 2  # winner's update intact
+
+    def test_cyclic_links_save_and_load(self):
+        from orientdb_tpu.api import ObjectDatabase
+        from orientdb_tpu.api.objects import rid_of
+
+        odb = ObjectDatabase()
+
+        @odb.register
+        @dataclasses.dataclass
+        class Node:
+            name: str = ""
+            peer: object = None
+
+        a = Node(name="a")
+        b = Node(name="b", peer=a)
+        a.peer = b
+        odb.save(a)
+        back = odb.load(rid_of(a))
+        assert back.name == "a"
+        assert back.peer.name == "b"
+        assert back.peer.peer is back  # the cycle closes on the same object
+
+
+class TestFullTextDelete:
+    def test_delete_cleans_postings(self):
+        db = Database("ftd")
+        db.schema.create_vertex_class("D").create_property("t", PropertyType.STRING)
+        db.command("CREATE INDEX D.t ON D (t) FULLTEXT")
+        v = db.new_vertex("D", t="hello world")
+        db.delete(v)
+        idx = db.indexes.get_index("D.t")
+        assert idx.search("hello") == set()
+        assert idx._map == {}  # no leaked postings
+
+
+class TestETL:
+    def test_csv_to_graph_pipeline(self, tmp_path):
+        from orientdb_tpu.tools.etl import run_etl
+
+        csv_path = tmp_path / "people.csv"
+        csv_path.write_text(
+            "name,age,city\nada,36,rome\nbob,17,paris\ncyd,52,rome\n"
+        )
+        cities = {
+            "source": {"content": {"value": "[]"}},
+            "extractor": {"rows": {"data": [{"name": "rome"}, {"name": "paris"}]}},
+            "transformers": [{"vertex": {"class": "City"}}],
+            "loader": {"odb": {"dbName": "people"}},
+        }
+        db = run_etl(cities)
+        people = {
+            "source": {"file": {"path": str(csv_path)}},
+            "extractor": {"csv": {}},
+            "transformers": [
+                {"filter": {"expression": "age >= 18"}},
+                {"field": {"fieldName": "age", "type": "int"}},
+                {"vertex": {"class": "Person"}},
+                {"edge": {"class": "LivesIn", "joinFieldName": "city",
+                          "lookup": "City.name", "direction": "out"}},
+                {"field": {"fieldName": "city", "operation": "remove"}},
+            ],
+            "loader": {"odb": {}},
+        }
+        proc_db = run_etl(people, db)
+        assert proc_db.count_class("Person", polymorphic=False) == 2  # bob filtered
+        rows = db.query(
+            "MATCH {class:Person, as:p}-LivesIn->{as:c, where:(name='rome')} "
+            "RETURN p.name AS n",
+            engine="oracle",
+        ).to_dicts()
+        assert sorted(r["n"] for r in rows) == ["ada", "cyd"]
+
+    def test_json_extractor_and_merge(self):
+        from orientdb_tpu.tools.etl import run_etl
+
+        cfg = {
+            "source": {"content": {"value": json.dumps(
+                [{"uid": 1, "name": "a"}, {"uid": 1, "name": "a2"}, {"uid": 2, "name": "b"}]
+            )}},
+            "extractor": {"json": {}},
+            "transformers": [{"merge": {"class": "P", "joinFieldName": "uid"}}],
+            "loader": {"odb": {"indexes": [
+                {"class": "P", "fields": ["uid"], "type": "UNIQUE"}
+            ]}},
+        }
+        db = run_etl(cfg)
+        docs = {d["uid"]: d["name"] for d in db.browse_class("P")}
+        assert docs == {1: "a2", 2: "b"}  # second row merged, not duplicated
+
+
+class TestFullText:
+    def test_token_search(self):
+        db = Database("ft")
+        p = db.schema.create_vertex_class("Doc")
+        p.create_property("body", PropertyType.STRING)
+        db.command("CREATE INDEX Doc.body ON Doc (body) FULLTEXT")
+        a = db.new_vertex("Doc", body="The quick brown Fox jumps")
+        b = db.new_vertex("Doc", body="lazy dogs and foxes sleep")
+        c = db.new_vertex("Doc", body="quick silver fox")
+        hits = db.indexes.fulltext_search("Doc", "body", "quick fox")
+        assert {d.rid for d in hits} == {a.rid, b.rid, c.rid} - {b.rid}
+        hits_all = db.indexes.fulltext_search("Doc", "body", "quick fox", mode="all")
+        assert {d.rid for d in hits_all} == {a.rid, c.rid}
+        # updates re-tokenize; deletes drop postings
+        a.set("body", "completely different words")
+        db.save(a)
+        assert {d.rid for d in db.indexes.fulltext_search("Doc", "body", "quick")} == {c.rid}
+        db.delete(c)
+        assert db.indexes.fulltext_search("Doc", "body", "quick") == []
+        # fulltext never serves equality pruning
+        assert db.indexes.best_for("Doc", "body") is None
+
+    def test_index_target_query(self):
+        db = Database("ft2")
+        db.schema.create_vertex_class("Doc").create_property("body", PropertyType.STRING)
+        db.command("CREATE INDEX Doc.body ON Doc (body) FULLTEXT")
+        db.new_vertex("Doc", body="alpha beta")
+        rows = db.query("SELECT FROM index:Doc.body WHERE key = 'alpha'").to_dicts()
+        assert len(rows) == 1
+
+
+class TestAudit:
+    def test_auth_and_record_events(self, tmp_path):
+        from orientdb_tpu.server.audit import AuditLog
+        from orientdb_tpu.server.server import Server
+
+        s = Server(admin_password="pw")
+        s.security.authenticate("admin", "pw")
+        s.security.authenticate("admin", "wrong")
+        kinds = [e["kind"] for e in s.audit.events()]
+        assert kinds == ["auth.ok", "auth.fail"]
+
+        log = AuditLog(path=str(tmp_path / "audit.jsonl"))
+        db = Database("a")
+        log.watch_database(db)
+        db.schema.create_vertex_class("P")
+        v = db.new_vertex("P", n=1)
+        db.delete(v)
+        recs = [e["kind"] for e in log.events()]
+        assert recs == ["record.create", "record.delete"]
+        lines = (tmp_path / "audit.jsonl").read_text().strip().splitlines()
+        assert len(lines) == 2 and json.loads(lines[0])["kind"] == "record.create"
+
+    def test_tx_compensated_ops_not_audited(self):
+        from orientdb_tpu.server.audit import AuditLog
+
+        db = Database("a2")
+        db.schema.create_vertex_class("P")
+        log = AuditLog()
+        log.watch_database(db)
+        tx = db.begin()
+        db.new_vertex("P", n=1)
+        tx.rollback()
+        assert log.events() == []
+
+
+class TestRidBag:
+    def test_promotion_preserves_semantics(self):
+        from orientdb_tpu.models.record import RidBag
+        from orientdb_tpu.models.rid import RID
+
+        bag = RidBag()
+        rids = [RID(1, i) for i in range(200)]
+        for r in rids:
+            bag.append(r)
+        assert bag.promoted  # past the embedded threshold
+        assert len(bag) == 200 and rids[150] in bag
+        bag.remove(rids[150])
+        assert rids[150] not in bag and len(bag) == 199
+        assert list(bag) == rids[:150] + rids[151:]  # order preserved
+        small = RidBag(rids[:3])
+        assert not small.promoted and rids[1] in small
+
+    def test_supernode_edges_still_work(self):
+        db = Database("bag")
+        db.schema.create_vertex_class("P")
+        db.schema.create_edge_class("L")
+        hub = db.new_vertex("P")
+        others = [db.new_vertex("P") for _ in range(100)]
+        for o in others:
+            db.new_edge("L", hub, o)
+        from orientdb_tpu.models.record import Direction
+
+        assert hub._bag(Direction.OUT, "L").promoted
+        assert hub.degree(Direction.OUT) == 100
+        db.delete(hub)  # cascade through the promoted bag
+        assert db.count_class("L") == 0
+
+
+class TestDistribution:
+    def test_entry_points_exist(self):
+        import orientdb_tpu
+        from orientdb_tpu.server.__main__ import main  # noqa: F401
+        from orientdb_tpu.tools.console import main as cmain  # noqa: F401
+
+        assert orientdb_tpu.__version__ == "0.2.0"
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        assert os.path.exists(os.path.join(root, "pyproject.toml"))
+        assert os.path.exists(os.path.join(root, "distribution", "server.sh"))
+        assert os.path.exists(os.path.join(root, "distribution", "console.sh"))
