@@ -83,15 +83,23 @@ class ObjectDatabase:
                 raise ConcurrentModificationError(
                     f"{rid}: stored v{doc.version} != instance v{stale}"
                 )
-        # phase 2: resolve fields (links may recurse; shells break cycles)
+        # phase 2: resolve fields. Linked instances cascade
+        # unconditionally (the _saving guard breaks cycles): a modified,
+        # already-persisted linked object must not be silently skipped.
         fields = {}
         for k, v in _instance_fields(obj).items():
             if type(v).__name__ in self._registered:
-                if getattr(v, _RID_ATTR, None) is None:
-                    self.save(v, _saving)
+                self.save(v, _saving)
                 fields[k] = getattr(v, _RID_ATTR)
             else:
                 fields[k] = v
+        # no-op saves skip the store write (cascades would otherwise bump
+        # versions on every reachable object)
+        if rid is not None and fields == {
+            k: doc.get(k) for k in doc.field_names()
+        }:
+            object.__setattr__(obj, _VER_ATTR, doc.version)
+            return obj
         for k, v in fields.items():
             doc.set(k, v)
         self.db.save(doc)

@@ -148,20 +148,30 @@ class Document:
 
 class RidBag:
     """Adjacency container ([E] ORidBag): an ordered list of edge RIDs
-    that transparently *promotes* past a threshold — a membership set
-    appears alongside the list, turning the reference's embedded→
-    sbtree-bonsai switch into O(1) ``in``/``remove`` for supernodes while
-    small bags stay a bare list with no set overhead."""
+    that transparently *promotes* past a threshold — the reference's
+    embedded→sbtree-bonsai switch. Promoted bags keep a membership set
+    (O(1) ``in``) and remove by TOMBSTONE (O(1) amortized — the list
+    compacts once tombstones pass half the length), so cascade-deleting
+    a supernode's 10^5 edges is linear, not quadratic. Small bags stay a
+    bare list with no set overhead."""
 
-    __slots__ = ("_items", "_set")
+    __slots__ = ("_items", "_set", "_removed")
 
     PROMOTE_AT = 64  # [E] RID_BAG_EMBEDDED_TO_SBTREEBONSAI_THRESHOLD analog
 
     def __init__(self, items: Optional[List[RID]] = None) -> None:
         self._items: List[RID] = list(items or ())
         self._set = set(self._items) if len(self._items) > self.PROMOTE_AT else None
+        self._removed: Optional[set] = None
+
+    def _compact(self) -> None:
+        if self._removed:
+            self._items = [r for r in self._items if r not in self._removed]
+        self._removed = None
 
     def append(self, rid: RID) -> None:
+        if self._removed and rid in self._removed:
+            self._compact()  # rare re-add of a tombstoned rid
         self._items.append(rid)
         if self._set is not None:
             self._set.add(rid)
@@ -169,9 +179,17 @@ class RidBag:
             self._set = set(self._items)
 
     def remove(self, rid: RID) -> None:
-        self._items.remove(rid)
-        if self._set is not None:
-            self._set.discard(rid)
+        if self._set is None:
+            self._items.remove(rid)
+            return
+        if rid not in self._set:
+            raise ValueError(f"{rid} not in bag")
+        self._set.discard(rid)
+        if self._removed is None:
+            self._removed = set()
+        self._removed.add(rid)
+        if len(self._removed) * 2 > len(self._items):
+            self._compact()
 
     def __contains__(self, rid: RID) -> bool:
         if self._set is not None:
@@ -179,17 +197,20 @@ class RidBag:
         return rid in self._items
 
     def __iter__(self):
-        return iter(self._items)
+        if not self._removed:
+            return iter(self._items)
+        removed = self._removed
+        return iter([r for r in self._items if r not in removed])
 
     def __len__(self) -> int:
-        return len(self._items)
+        return len(self._items) - (len(self._removed) if self._removed else 0)
 
     @property
     def promoted(self) -> bool:
         return self._set is not None
 
     def __repr__(self) -> str:
-        return f"RidBag({len(self._items)}{'*' if self.promoted else ''})"
+        return f"RidBag({len(self)}{'*' if self.promoted else ''})"
 
 
 class Vertex(Document):

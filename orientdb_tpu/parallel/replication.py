@@ -1,0 +1,237 @@
+"""Asynchronous primary→replica replication by WAL shipping.
+
+Analog of the reference's distributed module ([E] distributed/
+``OHazelcastPlugin``/``ODistributedServerManager``/``ODistributedDatabaseImpl``
+with task-based op shipping and delta-sync; SURVEY.md §2 "Distributed",
+§5.3/§5.8). Redesign: the durable host store already emits a logical,
+LSN-ordered WAL (storage/durability.py) whose entries are exactly the
+reference's "tasks" — replication is therefore WAL *shipping*:
+
+- a **source** database arms a WAL (a throwaway one if not already
+  durable) so every committed op has an LSN;
+- the HTTP server exposes ``/replication/<db>/<from_lsn>`` (admin-only)
+  returning the entries after that LSN — the [E] delta-sync path; a
+  fresh replica starting from LSN 0 gets the full stream (full-sync);
+- a **ReplicaPuller** thread on the replica side pulls, applies entries
+  through the recovery machinery (``_apply_entry``), and tracks lag.
+  Pulls double as heartbeats: consecutive failures mark the source DOWN
+  ([E] the Hazelcast membership view collapsing to a node-status
+  machine) and fire ``on_source_down`` — the operator's cue to promote
+  (``promote()`` stops pulling; the replica is then an ordinary writable
+  database).
+
+Scope note: the reference is multi-master with write quorums; this v1
+is single-writer primary→N async replicas (read scaling — the DP row of
+SURVEY.md §2's parallelism table). Quorum-acked multi-master is the
+documented delta.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import tempfile
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.storage.durability import WriteAheadLog, _apply_entry
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("replication")
+
+
+class ReplicationGap(Exception):
+    """The source can no longer serve the replica's next LSN and the
+    replica is not fresh — data would be silently missing; resync from a
+    fresh database instead."""
+
+
+def enable_replication_source(db: Database) -> None:
+    """Arm a WAL so the database's committed ops are shippable. Durable
+    databases already have one; in-memory sources get a throwaway log."""
+    if db._wal is None:
+        d = tempfile.mkdtemp(prefix=f"repl-{db.name}-")
+        from orientdb_tpu.storage.durability import enable_durability
+
+        enable_durability(db, d, fsync=False)
+
+
+def entries_after(db: Database, from_lsn: int, limit: int = 10_000) -> Dict:
+    """The shipping payload: WAL entries with lsn > from_lsn.
+
+    When the requested range is no longer available — the source was
+    armed after data already existed, or checkpoints pruned the covering
+    archives — the response carries a full CHECKPOINT payload instead
+    (the [E] full-sync path): the replica restores it and resumes delta
+    pulls from its LSN. Archived segments whose name-encoded max LSN is
+    ≤ from_lsn are skipped without parsing."""
+    if db._wal is None:
+        return {"entries": [], "lsn": 0}
+    import os
+
+    from orientdb_tpu.storage.durability import _wal_segments
+
+    directory = getattr(db, "_durability_dir", None)
+    entries: List[Dict] = []
+    if directory and os.path.isdir(directory):
+        for seg in _wal_segments(directory):
+            base = os.path.basename(seg)
+            if base.startswith("wal-") and base.endswith(".log"):
+                try:
+                    if int(base[4:-4]) <= from_lsn:
+                        continue  # fully below the requested range
+                except ValueError:
+                    pass
+            entries.extend(WriteAheadLog(seg).read_entries())
+        entries.sort(key=lambda e: e["lsn"])
+    else:
+        entries = db._wal.read_entries()
+    # gap detection: (a) a late-armed source holds data its log never saw
+    # (the base marker), (b) archives pruned past the requested range
+    needs_base = (
+        getattr(db, "_wal_has_base", False)
+        and from_lsn <= getattr(db, "_wal_base_lsn", 0)
+    )
+    available_from = entries[0]["lsn"] if entries else db._wal.next_lsn
+    if needs_base or from_lsn + 1 < available_from:
+        from orientdb_tpu.storage.durability import _checkpoint_payload
+
+        with db._lock:
+            upto = db._wal.next_lsn - 1
+            payload = _checkpoint_payload(db)
+        payload["lsn"] = upto
+        return {"checkpoint": payload, "entries": [], "lsn": upto}
+    out = [e for e in entries if e["lsn"] > from_lsn][:limit]
+    last = out[-1]["lsn"] if out else from_lsn
+    return {"entries": out, "lsn": last}
+
+
+class ReplicaPuller:
+    """Replica-side puller: applies the source's WAL stream to a local
+    database and watches source liveness."""
+
+    def __init__(
+        self,
+        source_url: str,
+        dbname: str,
+        local_db: Database,
+        user: str = "admin",
+        password: str = "admin",
+        interval: float = 0.25,
+        down_after: int = 4,
+        on_source_down: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.source_url = source_url.rstrip("/")
+        self.dbname = dbname
+        self.db = local_db
+        self.user = user
+        self.password = password
+        self.interval = interval
+        self.down_after = down_after
+        self.on_source_down = on_source_down
+        self.applied_lsn = 0
+        self.failures = 0
+        self.status = "STARTING"  # STARTING | ONLINE | DOWN | PROMOTED
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ReplicaPuller":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def promote(self) -> Database:
+        """Stop replicating; the local database becomes the writable
+        primary ([E] the reassign-cluster-ownership step of failover)."""
+        self.stop()
+        self.status = "PROMOTED"
+        return self.db
+
+    # -- pulling ------------------------------------------------------------
+
+    def pull_once(self) -> int:
+        """One delta pull; returns the number of applied entries."""
+        cred = base64.b64encode(
+            f"{self.user}:{self.password}".encode()
+        ).decode()
+        req = urllib.request.Request(
+            f"{self.source_url}/replication/{self.dbname}/{self.applied_lsn}",
+            headers={"Authorization": f"Basic {cred}"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            payload = json.loads(r.read())
+        applied = 0
+        with self._lock:
+            if "checkpoint" in payload:
+                # full sync: the delta range is gone (late-armed source or
+                # pruned archives) — restore the shipped checkpoint
+                from orientdb_tpu.storage.durability import restore_payload
+
+                fresh = (
+                    self.db.mutation_epoch == 0
+                    and self.applied_lsn == 0
+                    and len(self.db.schema.classes()) == 2  # just V/E roots
+                )
+                if not fresh:
+                    raise ReplicationGap(
+                        "source lost the delta range past applied_lsn="
+                        f"{self.applied_lsn}; full resync needs a FRESH "
+                        "replica database"
+                    )
+                restore_payload(self.db, payload["checkpoint"])
+                self.applied_lsn = payload["checkpoint"].get("lsn", payload["lsn"])
+                metrics.incr("replication.full_sync")
+                return 1
+            for e in payload["entries"]:
+                if e["lsn"] <= self.applied_lsn:
+                    continue
+                # a failing entry must NOT be skipped: advancing past it
+                # would silently diverge the replica while reporting
+                # ONLINE — raise, count as a failure, retry next pull
+                _apply_entry(self.db, e)
+                self.applied_lsn = e["lsn"]
+                applied += 1
+        if applied:
+            metrics.incr("replication.applied", applied)
+        return applied
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.pull_once()
+                self.failures = 0
+                self.status = "ONLINE"
+            except Exception:
+                self.failures += 1
+                if self.failures >= self.down_after and self.status != "DOWN":
+                    self.status = "DOWN"
+                    metrics.incr("replication.source_down")
+                    log.warning(
+                        "replication source %s marked DOWN after %d failures",
+                        self.source_url,
+                        self.failures,
+                    )
+                    if self.on_source_down is not None:
+                        try:
+                            self.on_source_down()
+                        except Exception:
+                            log.exception("on_source_down callback failed")
+            self._stop.wait(self.interval)
+
+    def lag(self) -> Dict:
+        return {
+            "status": self.status,
+            "applied_lsn": self.applied_lsn,
+            "failures": self.failures,
+        }

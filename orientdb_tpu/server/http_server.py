@@ -114,6 +114,20 @@ class _Handler(BaseHTTPRequestHandler):
                 from orientdb_tpu.utils.metrics import metrics
 
                 return self._send(200, metrics.snapshot())
+            if head == "replication" and len(rest) == 2:
+                # WAL shipping for replicas ([E] the distributed delta-sync
+                # request); admin-only — the stream exposes every record
+                # "server.replication" falls outside reader/writer's
+                # per-resource grants; only admin's '*' covers it
+                self.server.ot_server.security.check(
+                    user, "server.replication", "read"
+                )
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                from orientdb_tpu.parallel.replication import entries_after
+
+                return self._send(200, entries_after(db, int(rest[1])))
             if head == "database" and rest:
                 db = self._db(rest[0])
                 if db is None:

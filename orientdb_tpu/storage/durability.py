@@ -533,6 +533,12 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
 def _load_checkpoint(db: Database, path: str) -> int:
     with open(path, "rb") as f:
         payload = json.loads(f.read())
+    return restore_payload(db, payload)
+
+
+def restore_payload(db: Database, payload: Dict) -> int:
+    """Rebuild a database from a checkpoint payload (recovery and the
+    replication full-sync bootstrap both land here)."""
     schema = db.schema
     # classes: fixpoint loop honors superclass order; cluster ids forced
     # to the checkpointed values (V/E already exist from bootstrap)
@@ -667,6 +673,15 @@ def enable_durability(
             last = max(last, entries[-1]["lsn"])
     wal.next_lsn = last + 1
     db._wal = wal
+    if db.mutation_epoch > 0 and last == 0 and not any(
+        p.startswith(CHECKPOINT_PREFIX) for p in os.listdir(directory)
+    ):
+        # the database already holds data the (empty) log never saw — a
+        # WAL replay or replication delta from LSN 0 cannot reproduce it.
+        # Mark the base so consumers (replication full-sync, and honesty
+        # in general) know deltas start above it.
+        db._wal_base_lsn = 0
+        db._wal_has_base = True
     db.schema.on_ddl = db._wal_log
     return db
 

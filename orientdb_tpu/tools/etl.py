@@ -55,6 +55,7 @@ class ETLProcessor:
         self.db = db
         self.stats = {"extracted": 0, "loaded_vertices": 0, "loaded_edges": 0,
                       "filtered": 0, "merged": 0}
+        self._ast_cache: Dict[str, object] = {}
 
     # -- entry --------------------------------------------------------------
 
@@ -175,9 +176,26 @@ class ETLProcessor:
         expr = cfg.get("expression")
         if expr is None:
             raise ETLError("filter transformer needs 'expression'")
-        ast = Parser(expr).parse_expression()
+        ast = self._ast_cache.get(expr)
+        if ast is None:  # parse once per run, not once per row
+            ast = self._ast_cache[expr] = Parser(expr).parse_expression()
         ectx = EvalContext(db, current=dict(ctx["row"]))
         return truthy(evaluate(ectx, ast))
+
+    @staticmethod
+    def _lookup_one(db: Database, cls: str, field: str, val):
+        """First document of ``cls`` with field == val, via a single-field
+        index when one exists, else a scan (shared by merge/edge)."""
+        idx = db.indexes.best_for(cls, field) if db._indexes else None
+        if idx is not None:
+            rids = idx.get(val)
+            return db.load(next(iter(sorted(rids)))) if rids else None
+        if not db.schema.exists_class(cls):
+            return None
+        for d in db.browse_class(cls):
+            if d.get(field) == val:
+                return d
+        return None
 
     def _t_vertex(self, db: Database, cfg: Dict, ctx: Dict) -> None:
         cls = cfg.get("class", "V")
@@ -194,16 +212,7 @@ class ETLProcessor:
         if not db.schema.exists_class(cls):
             db.schema.create_vertex_class(cls)
         val = ctx["row"].get(key)
-        existing = None
-        idx = db.indexes.best_for(cls, key) if db._indexes else None
-        if idx is not None:
-            rids = idx.get(val)
-            existing = db.load(next(iter(sorted(rids)))) if rids else None
-        else:
-            for d in db.browse_class(cls):
-                if d.get(key) == val:
-                    existing = d
-                    break
+        existing = self._lookup_one(db, cls, key, val)
         if existing is not None:
             for k, v in ctx["row"].items():
                 existing.set(k, v)
@@ -223,17 +232,7 @@ class ETLProcessor:
         join = cfg["joinFieldName"]
         lk_class, lk_field = cfg["lookup"].split(".", 1)
         val = ctx["row"].get(join)
-        target = None
-        idx = db.indexes.best_for(lk_class, lk_field) if db._indexes else None
-        if idx is not None:
-            rids = idx.get(val)
-            target = db.load(next(iter(sorted(rids)))) if rids else None
-        else:
-            if db.schema.exists_class(lk_class):
-                for d in db.browse_class(lk_class):
-                    if d.get(lk_field) == val:
-                        target = d
-                        break
+        target = self._lookup_one(db, lk_class, lk_field, val)
         if target is None:
             if cfg.get("unresolvedLinkAction", "SKIP").upper() == "ERROR":
                 raise ETLError(f"unresolved edge lookup {cfg['lookup']}={val!r}")

@@ -273,6 +273,45 @@ class TestRidBag:
         small = RidBag(rids[:3])
         assert not small.promoted and rids[1] in small
 
+    def test_promoted_remove_is_tombstoned(self):
+        from orientdb_tpu.models.record import RidBag
+        from orientdb_tpu.models.rid import RID
+
+        bag = RidBag([RID(1, i) for i in range(100)])
+        bag.remove(RID(1, 10))
+        assert len(bag) == 99 and RID(1, 10) not in bag
+        assert RID(1, 10) not in list(bag)
+        # re-adding a tombstoned rid compacts first (no duplicates)
+        bag.append(RID(1, 10))
+        assert list(bag).count(RID(1, 10)) == 1 and len(bag) == 100
+        # mass removal compacts and stays consistent
+        for i in range(60):
+            bag.remove(RID(1, i))
+        assert len(bag) == 40
+
+    def test_stale_linked_instance_changes_cascade(self):
+        from orientdb_tpu.api import ObjectDatabase
+        from orientdb_tpu.api.objects import rid_of
+
+        odb = ObjectDatabase()
+
+        @odb.register
+        @dataclasses.dataclass
+        class City:
+            name: str = ""
+
+        @odb.register
+        @dataclasses.dataclass
+        class Person:
+            name: str = ""
+            home: object = None
+
+        rome = City(name="rome")
+        odb.save(rome)
+        rome.name = "milan"
+        odb.save(Person(name="ada", home=rome))
+        assert odb.load(rid_of(rome)).name == "milan"
+
     def test_supernode_edges_still_work(self):
         db = Database("bag")
         db.schema.create_vertex_class("P")

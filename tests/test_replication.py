@@ -1,0 +1,178 @@
+"""Primary→replica replication by WAL shipping + failure detection
+(SURVEY §2 "Distributed" / §5.3: membership status machine, delta/full
+sync; redesigned as LSN-ordered logical WAL shipping)."""
+
+import time
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.parallel.replication import (
+    ReplicaPuller,
+    enable_replication_source,
+    entries_after,
+)
+from orientdb_tpu.server.server import Server
+
+
+@pytest.fixture()
+def primary():
+    srv = Server(admin_password="pw")
+    db = srv.create_database("d")
+    enable_replication_source(db)
+    db.schema.create_vertex_class("P")
+    db.schema.create_edge_class("K")
+    srv.startup()
+    yield srv, db
+    srv.shutdown()
+
+
+def _puller(srv, **kw):
+    local = Database("d")
+    return ReplicaPuller(
+        f"http://127.0.0.1:{srv.http_port}",
+        "d",
+        local,
+        user="admin",
+        password="pw",
+        interval=0.05,
+        **kw,
+    )
+
+
+class TestReplication:
+    def test_full_then_delta_sync(self, primary):
+        srv, db = primary
+        a = db.new_vertex("P", n=1)
+        b = db.new_vertex("P", n=2)
+        db.new_edge("K", a, b)
+        rep = _puller(srv)
+        assert rep.pull_once() > 0  # full sync from lsn 0
+        assert rep.db.count_class("P") == 2
+        assert rep.db.count_class("K") == 1
+        # delta: new write ships incrementally
+        db.new_vertex("P", n=3)
+        assert rep.pull_once() == 1
+        assert rep.db.count_class("P") == 3
+        # idempotent: nothing new → nothing applied
+        assert rep.pull_once() == 0
+        # reads (MATCH) work on the replica — the DP read-scaling row
+        rows = rep.db.query(
+            "MATCH {class:P, as:x, where:(n=1)}-K->{as:y} RETURN y.n AS n",
+            engine="oracle",
+        ).to_dicts()
+        assert rows == [{"n": 2}]
+
+    def test_tx_ships_atomically(self, primary):
+        srv, db = primary
+        rep = _puller(srv)
+        rep.pull_once()
+        tx = db.begin()
+        db.new_vertex("P", n=10)
+        db.new_vertex("P", n=11)
+        tx.commit()
+        tx2 = db.begin()
+        db.new_vertex("P", n=12)
+        tx2.rollback()
+        rep.pull_once()
+        ns = sorted(d["n"] for d in rep.db.browse_class("P"))
+        assert ns == [10, 11]  # committed pair only
+
+    def test_background_puller_and_lag(self, primary):
+        srv, db = primary
+        rep = _puller(srv).start()
+        try:
+            db.new_vertex("P", n=5)
+            deadline = time.time() + 5
+            while time.time() < deadline and (
+                not rep.db.schema.exists_class("P")
+                or rep.db.count_class("P") < 1
+            ):
+                time.sleep(0.05)
+            assert rep.db.count_class("P") == 1
+            assert rep.lag()["status"] == "ONLINE"
+        finally:
+            rep.stop()
+
+    def test_source_down_detection_and_promotion(self, primary):
+        srv, db = primary
+        db.new_vertex("P", n=1)
+        downs = []
+        rep = _puller(srv, down_after=2, on_source_down=lambda: downs.append(1))
+        rep.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and rep.lag()["status"] != "ONLINE":
+            time.sleep(0.05)
+        srv.shutdown()  # kill the primary
+        deadline = time.time() + 8
+        while time.time() < deadline and not downs:
+            time.sleep(0.05)
+        assert downs, "source loss must fire on_source_down"
+        assert rep.lag()["status"] == "DOWN"
+        promoted = rep.promote()
+        assert rep.lag()["status"] == "PROMOTED"
+        # the promoted replica accepts writes like any primary
+        promoted.new_vertex("P", n=99)
+        assert promoted.count_class("P") == 2
+
+    def test_replication_endpoint_is_admin_only(self, primary):
+        import base64
+        import urllib.error
+        import urllib.request
+
+        srv, db = primary
+        cred = base64.b64encode(b"reader:reader").decode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.http_port}/replication/d/0",
+            headers={"Authorization": f"Basic {cred}"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code in (401, 403)
+
+    def test_late_armed_source_ships_checkpoint(self):
+        """Data written BEFORE enable_replication_source must reach the
+        replica via the shipped checkpoint, not be silently missing."""
+        srv = Server(admin_password="pw")
+        db = srv.create_database("d")
+        db.schema.create_vertex_class("P")
+        for i in range(5):
+            db.new_vertex("P", n=i)  # pre-WAL history
+        enable_replication_source(db)
+        db.new_vertex("P", n=99)  # post-WAL delta
+        srv.startup()
+        try:
+            rep = _puller(srv)
+            rep.pull_once()  # checkpoint full-sync
+            while rep.pull_once():
+                pass
+            ns = sorted(d["n"] for d in rep.db.browse_class("P"))
+            assert ns == [0, 1, 2, 3, 4, 99]
+        finally:
+            srv.shutdown()
+
+    def test_gap_on_non_fresh_replica_raises(self):
+        from orientdb_tpu.parallel.replication import ReplicationGap
+
+        srv = Server(admin_password="pw")
+        db = srv.create_database("d")
+        db.schema.create_vertex_class("P")
+        db.new_vertex("P", n=0)  # pre-WAL: forces a checkpoint response
+        enable_replication_source(db)
+        srv.startup()
+        try:
+            rep = _puller(srv)
+            rep.db.schema.create_vertex_class("X")  # replica NOT fresh
+            with pytest.raises(ReplicationGap):
+                rep.pull_once()
+        finally:
+            srv.shutdown()
+
+    def test_entries_after_pagination(self, primary):
+        srv, db = primary
+        for i in range(5):
+            db.new_vertex("P", n=i)
+        page = entries_after(db, 0, limit=2)
+        assert len(page["entries"]) == 2
+        rest = entries_after(db, page["lsn"])
+        assert len(rest["entries"]) >= 3
