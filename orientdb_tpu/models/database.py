@@ -159,12 +159,26 @@ class Database:
         doc._db = self
         return self.save(doc)
 
-    def new_vertex(self, class_name: str = "V", **fields) -> Vertex:
+    def _resolve_vertex_class(self, class_name: str):
+        """Vertex class, auto-created if absent (shared by new_vertex and
+        the bulk loader so the two ingest paths cannot drift)."""
         cls = self.schema.get_class(class_name)
         if cls is None:
             cls = self.schema.create_vertex_class(class_name)
         if not cls.is_vertex_type:
             raise ValueError(f"class '{class_name}' is not a vertex class")
+        return cls
+
+    def _resolve_edge_class(self, class_name: str):
+        cls = self.schema.get_class(class_name)
+        if cls is None:
+            cls = self.schema.create_edge_class(class_name)
+        if not cls.is_edge_type:
+            raise ValueError(f"class '{class_name}' is not an edge class")
+        return cls
+
+    def new_vertex(self, class_name: str = "V", **fields) -> Vertex:
+        cls = self._resolve_vertex_class(class_name)
         v = Vertex(cls.name, fields)
         v._db = self
         self.save(v)
@@ -179,11 +193,7 @@ class Database:
         the source vertex appends to ``out_<cls>``, the target to
         ``in_<cls>``.
         """
-        cls = self.schema.get_class(class_name)
-        if cls is None:
-            cls = self.schema.create_edge_class(class_name)
-        if not cls.is_edge_type:
-            raise ValueError(f"class '{class_name}' is not an edge class")
+        cls = self._resolve_edge_class(class_name)
         tx = self.tx
         if tx is not None and not self._tx_suspended:
             return tx.new_edge(cls.name, src, dst, **fields)

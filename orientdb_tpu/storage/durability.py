@@ -243,7 +243,7 @@ def _place(db: Database, rid: RID, doc: Document) -> None:
 
 def _apply_entry(db: Database, e: Dict) -> None:
     op = e["op"]
-    if op == "tx":
+    if op in ("tx", "bulk"):
         for sub in e["ops"]:
             _apply_entry(db, sub)
         return

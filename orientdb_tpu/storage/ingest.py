@@ -46,7 +46,11 @@ def generate_demodb(
     avg_friends: int = 10,
     seed: int = 7,
 ) -> Database:
-    """Demodb-shaped social network with deterministic content."""
+    """Demodb-shaped social network with deterministic content (loaded
+    through the bulk path — §3.5; identical structure to a
+    record-at-a-time load for a given seed)."""
+    from orientdb_tpu.storage.bulk import BulkLoader
+
     if db is None:
         db = Database("demodb")
     rng = np.random.default_rng(seed)
@@ -59,13 +63,14 @@ def generate_demodb(
     likes = db.schema.create_edge_class("Likes")
     likes.create_property("weight", PropertyType.LONG)
 
+    bl = BulkLoader(db)
     names = rng.integers(0, len(_FIRST), n_profiles)
     surnames = rng.integers(0, len(_LAST), n_profiles)
     ages = rng.integers(18, 80, n_profiles)
     vs: List[Vertex] = []
     for i in range(n_profiles):
         vs.append(
-            db.new_vertex(
+            bl.add_vertex(
                 "Profiles",
                 name=f"{_FIRST[names[i]]}{i}",
                 surname=_LAST[surnames[i]],
@@ -83,7 +88,7 @@ def generate_demodb(
         for t in targets:
             if t == i:
                 continue
-            db.new_edge("HasFriend", vs[i], vs[int(t)])
+            bl.add_edge("HasFriend", vs[i], vs[int(t)])
     # Likes: sparser, weighted
     n_likes = n_profiles // 2
     srcs = rng.integers(0, n_profiles, n_likes)
@@ -91,7 +96,8 @@ def generate_demodb(
     weights = rng.integers(1, 10, n_likes)
     for s, d, w in zip(srcs, dsts, weights):
         if s != d:
-            db.new_edge("Likes", vs[int(s)], vs[int(d)], weight=int(w))
+            bl.add_edge("Likes", vs[int(s)], vs[int(d)], weight=int(w))
+    bl.flush()
     log.info(
         "demodb: %d profiles, %d HasFriend, %d Likes",
         n_profiles,
@@ -118,9 +124,12 @@ def generate_ldbc_snb(
     Message ids share one id space (posts first, then comments) so IS4–IS7
     can address any message by ``id`` the way SNB parameters do.
     """
+    from orientdb_tpu.storage.bulk import BulkLoader
+
     if db is None:
         db = Database("snb")
     rng = np.random.default_rng(seed)
+    bl = BulkLoader(db)
     person = db.schema.create_vertex_class("Person")
     for pname, pt in [
         ("id", PropertyType.LONG),
@@ -143,8 +152,8 @@ def generate_ldbc_snb(
 
     n_cities = max(4, n_persons // 100)
     n_tags = max(8, n_persons // 50)
-    cities = [db.new_vertex("City", name=f"city{i}") for i in range(n_cities)]
-    tags = [db.new_vertex("Tag", name=f"tag{i}") for i in range(n_tags)]
+    cities = [bl.add_vertex("City", name=f"city{i}") for i in range(n_cities)]
+    tags = [bl.add_vertex("Tag", name=f"tag{i}") for i in range(n_tags)]
     browsers = ["Firefox", "Chrome", "Safari"]
     persons: List[Vertex] = []
     first = rng.integers(0, len(_FIRST), n_persons)
@@ -154,7 +163,7 @@ def generate_ldbc_snb(
     browser_pick = rng.integers(0, 3, n_persons)
     for i in range(n_persons):
         persons.append(
-            db.new_vertex(
+            bl.add_vertex(
                 "Person",
                 id=int(i),
                 firstName=_FIRST[first[i]].capitalize(),
@@ -181,7 +190,7 @@ def generate_ldbc_snb(
             pair = (min(i, int(t)), max(i, int(t)))
             if int(t) != i and pair not in known_pairs:
                 known_pairs.add(pair)
-                db.new_edge(
+                bl.add_edge(
                     "knows",
                     persons[i],
                     persons[int(t)],
@@ -189,20 +198,21 @@ def generate_ldbc_snb(
                 )
     city_pick = rng.integers(0, n_cities, n_persons)
     for i in range(n_persons):
-        db.new_edge("isLocatedIn", persons[i], cities[city_pick[i]])
+        bl.add_edge("isLocatedIn", persons[i], cities[city_pick[i]])
     n_interests = rng.integers(1, 5, n_persons)
     for i in range(n_persons):
         for t in rng.choice(n_tags, size=int(n_interests[i]), replace=False):
-            db.new_edge("hasInterest", persons[i], tags[int(t)])
+            bl.add_edge("hasInterest", persons[i], tags[int(t)])
     if with_messages:
-        _generate_snb_messages(db, persons, rng)
+        _generate_snb_messages(db, bl, persons, rng)
+    bl.flush()
     log.info(
         "snb-ish: %d persons, %d knows", n_persons, db.count_class("knows")
     )
     return db
 
 
-def _generate_snb_messages(db: Database, persons: List[Vertex], rng) -> None:
+def _generate_snb_messages(db: Database, bl, persons: List[Vertex], rng) -> None:
     """Forum/Post/Comment layer for the IS1–IS7 short reads."""
     n_persons = len(persons)
     message = db.schema.create_vertex_class("Message", abstract=True)
@@ -231,21 +241,21 @@ def _generate_snb_messages(db: Database, persons: List[Vertex], rng) -> None:
     n_comments = n_posts * 2
     forums: List[Vertex] = []
     for i in range(n_forums):
-        f = db.new_vertex(
+        f = bl.add_vertex(
             "Forum",
             id=int(i),
             title=f"forum{i}",
             creationDate=int(rng.integers(2**28, 2**31 - 1)),
         )
         forums.append(f)
-        db.new_edge("hasModerator", f, persons[int(rng.integers(0, n_persons))])
+        bl.add_edge("hasModerator", f, persons[int(rng.integers(0, n_persons))])
     # posts: ids [0, n_posts); comments continue the same id space —
     # one message-id namespace, as SNB's substitution parameters assume
     messages: List[Vertex] = []
     post_forum = rng.integers(0, n_forums, n_posts)
     post_creator = rng.integers(0, n_persons, n_posts)
     for i in range(n_posts):
-        p = db.new_vertex(
+        p = bl.add_vertex(
             "Post",
             id=int(i),
             content=f"post {i} text",
@@ -254,15 +264,15 @@ def _generate_snb_messages(db: Database, persons: List[Vertex], rng) -> None:
             locationIP=f"10.1.{i % 256}.{(i // 256) % 256}",
         )
         messages.append(p)
-        db.new_edge("containerOf", forums[int(post_forum[i])], p)
-        db.new_edge("hasCreator", p, persons[int(post_creator[i])])
+        bl.add_edge("containerOf", forums[int(post_forum[i])], p)
+        bl.add_edge("hasCreator", p, persons[int(post_creator[i])])
     # comments: each replies to a uniformly random earlier message, giving
     # reply trees of expected logarithmic depth rooted at posts
     comment_creator = rng.integers(0, n_persons, n_comments)
     for j in range(n_comments):
         mid = n_posts + j
         parent = messages[int(rng.integers(0, len(messages)))]
-        c = db.new_vertex(
+        c = bl.add_vertex(
             "Comment",
             id=int(mid),
             content=f"comment {mid} text",
@@ -271,8 +281,8 @@ def _generate_snb_messages(db: Database, persons: List[Vertex], rng) -> None:
             locationIP=f"10.2.{mid % 256}.{(mid // 256) % 256}",
         )
         messages.append(c)
-        db.new_edge("replyOf", c, parent)
-        db.new_edge("hasCreator", c, persons[int(comment_creator[j])])
+        bl.add_edge("replyOf", c, parent)
+        bl.add_edge("hasCreator", c, persons[int(comment_creator[j])])
 
 
 # ---------------------------------------------------------------------------
