@@ -1053,10 +1053,23 @@ class TpuMatchSolver:
         return w
 
     def _root_candidates(self, alias: str):
+        """Candidate scan for a root alias, restricted to the dense-index
+        HULL of its class filters' polymorphic closures — the snapshot
+        lays each concrete class out contiguously, so a `{class:Person}`
+        root scans |Person|-ish slots instead of all V (the device analog
+        of [E] FetchFromClassExecutionStep iterating only the class's
+        clusters). Admission masks still run in full (the hull can
+        contain foreign vertices)."""
         node = self.pattern.nodes[alias]
         V = self.dg.num_vertices
-        idx = jnp.arange(K.bucket(max(V, 1)), dtype=jnp.int32)
-        idx = jnp.where(idx < V, idx, -1)
+        start, end = 0, V
+        for f in node.filters:
+            if f.class_name:
+                lo, hi = self.snap.vertex_hull(f.class_name)
+                start, end = max(start, lo), min(end, hi)
+        size = max(end - start, 0)
+        idx = start + jnp.arange(K.bucket(max(size, 1)), dtype=jnp.int32)
+        idx = jnp.where(idx < end, idx, -1)
         mask = self._node_masks[alias](idx)
         cand, n, n_dev = self._compact(mask)
         cand = K.take_pad(idx, cand, jnp.int32(-1))

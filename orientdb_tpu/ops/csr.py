@@ -89,11 +89,50 @@ def gather_expand(
     return row, edge_pos, nbr
 
 
+_CS_BLOCK = 256
+
+
+def mask_cumsum(mask: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive prefix sum of a boolean mask, MXU-shaped.
+
+    XLA's plain cumsum over 1M elements costs ~14 ms on TPU (log-depth
+    reduce-window passes); a [n/256, 256] reshape turns the intra-block
+    scan into ONE triangular matmul on the systolic array (values ≤ 256
+    are exact in f32), leaving only a tiny 4k-element cumsum for the
+    block offsets — sub-millisecond at graph scale."""
+    n = mask.shape[0]
+    B = _CS_BLOCK
+    if n < 2 * B or n % B:
+        return jnp.cumsum(mask.astype(jnp.int32))
+    rows = mask.reshape(-1, B).astype(jnp.float32)
+    tri = jnp.triu(jnp.ones((B, B), jnp.float32))
+    row_cs = jnp.dot(rows, tri).astype(jnp.int32)  # intra-block inclusive
+    block_tot = row_cs[:, -1]
+    offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(block_tot)[:-1]]
+    )
+    return (row_cs + offs[:, None]).reshape(-1)
+
+
 @partial(jax.jit, static_argnames=("out_size",))
 def compact_indices(mask: jnp.ndarray, out_size: int) -> jnp.ndarray:
-    """Indices of True entries, -1-padded to the static `out_size`."""
-    (idx,) = jnp.nonzero(mask, size=out_size, fill_value=-1)
-    return idx.astype(jnp.int32)
+    """Indices of True entries (ascending), -1-padded to the static
+    `out_size`.
+
+    NOT jnp.nonzero: XLA lowers nonzero to a full-width sort on TPU
+    (~28 ms per 1M elements measured on v5e — it dominated every compiled
+    plan's device time). A blocked prefix sum (see mask_cumsum) + k
+    binary searches does the same job bandwidth-bound: ranks =
+    cumsum(mask), then the j-th survivor is the first position whose
+    rank reaches j."""
+    n = mask.shape[0]
+    if n == 0:
+        return jnp.full(out_size, -1, jnp.int32)
+    ranks = mask_cumsum(mask)
+    wanted = jnp.arange(1, out_size + 1, dtype=jnp.int32)
+    pos = jnp.searchsorted(ranks, wanted, side="left").astype(jnp.int32)
+    ok = (pos < n) & (wanted <= ranks[-1])
+    return jnp.where(ok, pos, -1)
 
 
 @jax.jit

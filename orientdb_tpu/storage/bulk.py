@@ -32,6 +32,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.indexes import DuplicateKeyError
 from orientdb_tpu.models.record import Direction, Edge, Vertex
 from orientdb_tpu.models.rid import NEW_RID, RID
 from orientdb_tpu.utils.logging import get_logger
@@ -136,13 +137,23 @@ class BulkLoader:
             by_class.setdefault(d.class_name, []).append(d)
         for cname, batch in by_class.items():
             cls = db.schema.get_class_or_raise(cname)
+            if not cls.cluster_ids:
+                raise ValueError(f"class '{cname}' is abstract")
             has_constraints = any(
                 p.mandatory or p.not_null or p.min_value is not None
                 or p.max_value is not None
                 for p in cls.effective_properties().values()
             ) or cls.strict_mode
+            # only indexes save() itself would apply: the doc's class at
+            # or below the index's class (IndexManager._applicable rule —
+            # for_class also returns SUBclass indexes, which must not
+            # constrain superclass records)
             uniques = (
-                [i for i in idx_mgr.for_class(cname) if i.unique]
+                [
+                    i
+                    for i in idx_mgr.for_class(cname)
+                    if i.unique and cls.is_subclass_of(i.class_name)
+                ]
                 if idx_mgr is not None
                 else []
             )
@@ -153,8 +164,6 @@ class BulkLoader:
                     key = idx._key_of(d)
                     if key is None:
                         continue
-                    from orientdb_tpu.models.indexes import DuplicateKeyError
-
                     if idx.get(key):
                         raise DuplicateKeyError(
                             f"index '{idx.name}': key {key!r} already mapped"

@@ -159,6 +159,11 @@ class GraphSnapshot:
         #: class name (lower) → sorted np.int32 array of class ids in its
         #: polymorphic closure (vertex classes)
         self.class_closure: Dict[str, np.ndarray] = {}
+        #: CONCRETE vertex class (lower) → (start, end) contiguous dense-
+        #: index range — vertices sort by (cluster, position) and a class's
+        #: clusters are consecutively allocated, so each concrete class is
+        #: one contiguous slab; root scans restrict to it
+        self.class_vertex_range: Dict[str, tuple] = {}
         # property columns (global over the vertex universe)
         self.v_columns: Dict[str, PropertyColumn] = {}
         #: property names observed but not columnar-encodable (lists, links,
@@ -174,6 +179,21 @@ class GraphSnapshot:
         self._mesh = None
 
     # -- lookups -----------------------------------------------------------
+
+    def vertex_hull(self, name: str) -> tuple:
+        """(start, end) dense-index hull of a class's polymorphic closure.
+        The hull may include foreign-class vertices (subclass slabs are
+        not necessarily adjacent), so callers keep their class masks."""
+        lo, hi = None, None
+        for cid in self.class_closure.get(name.lower(), ()):
+            rng = self.class_vertex_range.get(self.class_names[cid].lower())
+            if rng is None or rng[1] <= rng[0]:
+                continue
+            lo = rng[0] if lo is None else min(lo, rng[0])
+            hi = rng[1] if hi is None else max(hi, rng[1])
+        if lo is None:
+            return (0, 0)
+        return (lo, hi)
 
     def rid_of(self, idx: int) -> RID:
         return RID(int(self.v_cluster[idx]), int(self.v_position[idx]))
@@ -338,6 +358,14 @@ def build_snapshot(db: Database) -> GraphSnapshot:
             snap.class_id_of[s.name.lower()] for s in c.subclasses(include_self=True)
         ]
         snap.class_closure[c.name.lower()] = np.array(sorted(closure), np.int32)
+    for cls in vertex_classes:
+        if not cls.cluster_ids:
+            snap.class_vertex_range[cls.name.lower()] = (0, 0)
+            continue
+        lo = int(np.searchsorted(snap.v_cluster, min(cls.cluster_ids), "left"))
+        hi = int(np.searchsorted(snap.v_cluster, max(cls.cluster_ids), "right"))
+        snap.class_vertex_range[cls.name.lower()] = (lo, hi)
+
 
     # ---- vertex property columns ----
     snap.v_columns, snap.v_non_columnar = _build_columns(vertices)

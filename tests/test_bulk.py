@@ -102,6 +102,32 @@ def test_bulk_wal_entry_replays(tmp_path):
     assert rows == [{"c": 10}]
 
 
+def test_subclass_unique_index_does_not_constrain_superclass():
+    db = Database("s")
+    db.schema.create_vertex_class("P").create_property("n", PropertyType.LONG)
+    db.schema.create_class("Q", superclasses=["P"])
+    db.indexes.create_index("Q.n", "Q", ["n"], "UNIQUE")
+    db.new_vertex("Q", n=1)
+    # record-at-a-time allows a P(n=1); bulk must agree
+    db.new_vertex("P", n=1)
+    with BulkLoader(db) as bl:
+        bl.add_vertex("P", n=1)
+    assert db.count_class("P", polymorphic=True) == 3
+
+
+def test_abstract_class_rejected_before_placement():
+    db = _schema(Database("abs"))
+    db.schema.create_vertex_class("Msg", abstract=True)
+    bl = BulkLoader(db)
+    bl.add_vertex("P", n=1)
+    bl._vertices.append(
+        type(bl._vertices[0])("Msg", {})
+    )  # staged abstract-class vertex
+    with pytest.raises(ValueError):
+        bl.flush()
+    assert db.count_class("P") == 0  # nothing placed, nothing tombstoned
+
+
 def test_epoch_bumps_once_per_flush():
     db = _schema(Database("e"))
     e0 = db.mutation_epoch
