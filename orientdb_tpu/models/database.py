@@ -141,10 +141,14 @@ class Database:
             c = self._clusters[cid] = _Cluster(cid)
         return c
 
+    @staticmethod
+    def _require_concrete(cls) -> None:
+        if not cls.cluster_ids:
+            raise ValueError(f"class '{cls.name}' is abstract")
+
     def _select_cluster(self, class_name: str) -> int:
         cls = self.schema.get_class_or_raise(class_name)
-        if not cls.cluster_ids:
-            raise ValueError(f"class '{class_name}' is abstract")
+        self._require_concrete(cls)
         i = self._rr_state.get(cls.name, 0)
         self._rr_state[cls.name] = i + 1
         return cls.cluster_ids[i % len(cls.cluster_ids)]

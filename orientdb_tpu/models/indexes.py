@@ -362,8 +362,14 @@ class IndexManager:
         for idx in self._applicable(doc):
             idx.unindex_doc(doc.rid)
 
-    def _applicable(self, doc: Document) -> List[Index]:
-        cls = self._db.schema.get_class(doc.class_name)
+    def applicable_for_class(self, class_name: str) -> List[Index]:
+        """Indexes that constrain records OF ``class_name`` — those whose
+        defining class is at or above it (the save-path rule; contrast
+        for_class, which also returns subclass indexes for class drops)."""
+        cls = self._db.schema.get_class(class_name)
         if cls is None:
             return []
         return [i for i in self._indexes.values() if cls.is_subclass_of(i.class_name)]
+
+    def _applicable(self, doc: Document) -> List[Index]:
+        return self.applicable_for_class(doc.class_name)

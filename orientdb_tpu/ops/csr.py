@@ -119,15 +119,20 @@ def compact_indices(mask: jnp.ndarray, out_size: int) -> jnp.ndarray:
     """Indices of True entries (ascending), -1-padded to the static
     `out_size`.
 
-    NOT jnp.nonzero: XLA lowers nonzero to a full-width sort on TPU
-    (~28 ms per 1M elements measured on v5e — it dominated every compiled
-    plan's device time). A blocked prefix sum (see mask_cumsum) + k
-    binary searches does the same job bandwidth-bound: ranks =
-    cumsum(mask), then the j-th survivor is the first position whose
-    rank reaches j."""
+    Two regimes, chosen statically by shape:
+    - selective compactions (out_size ≪ n — point-lookup roots, sparse
+      emissions): blocked prefix sum (see mask_cumsum) + out_size binary
+      searches. jnp.nonzero here would pay XLA's full-width TPU sort
+      (~28 ms per 1M elements — it dominated every compiled plan's
+      device time at SF10 scale).
+    - dense compactions (out_size comparable to n): nonzero's single
+      sort beats out_size·log(n) gather-bound searches."""
     n = mask.shape[0]
     if n == 0:
         return jnp.full(out_size, -1, jnp.int32)
+    if out_size * 8 > n:
+        (idx,) = jnp.nonzero(mask, size=out_size, fill_value=-1)
+        return idx.astype(jnp.int32)
     ranks = mask_cumsum(mask)
     wanted = jnp.arange(1, out_size + 1, dtype=jnp.int32)
     pos = jnp.searchsorted(ranks, wanted, side="left").astype(jnp.int32)

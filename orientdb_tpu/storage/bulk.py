@@ -137,23 +137,14 @@ class BulkLoader:
             by_class.setdefault(d.class_name, []).append(d)
         for cname, batch in by_class.items():
             cls = db.schema.get_class_or_raise(cname)
-            if not cls.cluster_ids:
-                raise ValueError(f"class '{cname}' is abstract")
+            db._require_concrete(cls)
             has_constraints = any(
                 p.mandatory or p.not_null or p.min_value is not None
                 or p.max_value is not None
                 for p in cls.effective_properties().values()
             ) or cls.strict_mode
-            # only indexes save() itself would apply: the doc's class at
-            # or below the index's class (IndexManager._applicable rule —
-            # for_class also returns SUBclass indexes, which must not
-            # constrain superclass records)
             uniques = (
-                [
-                    i
-                    for i in idx_mgr.for_class(cname)
-                    if i.unique and cls.is_subclass_of(i.class_name)
-                ]
+                [i for i in idx_mgr.applicable_for_class(cname) if i.unique]
                 if idx_mgr is not None
                 else []
             )
