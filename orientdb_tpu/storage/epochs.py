@@ -1,0 +1,220 @@
+"""On-disk snapshot epochs.
+
+SURVEY.md §5.4's checkpoint design verbatim: "immutable graph **snapshot
+epochs** (columnar CSR + properties on disk, content-addressed);
+'resume' = reload + replay ingest tail". The record-level side of resume
+lives in ``storage/durability.py`` (WAL + checkpoints); this module
+persists the READ-side artifact — the columnar :class:`GraphSnapshot`
+the compiled engine consumes — so a restarted server re-attaches by
+decompressing one npz instead of an O(V+E) rebuild from the record
+store. (Peak load RSS is ~2x the snapshot size — file bytes plus
+decompressed arrays; an uncompressed mmap-able layout is the upgrade
+path if that ever binds.)
+
+Format: ``snapshot-<epoch>-<digest>.npz`` (all arrays, keys namespaced)
+plus the JSON-encodable metadata inside the same npz under ``__meta__``.
+The digest covers the metadata and array bytes, making epochs
+content-addressed: identical stores produce identical filenames, and a
+truncated/corrupt file fails its digest check on load instead of
+attaching silently wrong data.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.storage.snapshot import (
+    EdgeClassCSR,
+    GraphSnapshot,
+    PropertyColumn,
+)
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("epochs")
+
+PREFIX = "snapshot-"
+
+
+def _col_arrays(out, prefix: str, col: PropertyColumn) -> dict:
+    out[f"{prefix}:v"] = col.values
+    out[f"{prefix}:p"] = col.present
+    return {"name": col.name, "kind": col.kind, "dictionary": col.dictionary}
+
+
+def _col_restore(arrays, prefix: str, meta) -> PropertyColumn:
+    return PropertyColumn(
+        meta["name"],
+        meta["kind"],
+        arrays[f"{prefix}:v"],
+        arrays[f"{prefix}:p"],
+        dictionary=meta["dictionary"],
+    )
+
+
+def save_snapshot(snap: GraphSnapshot, directory: str) -> str:
+    """Persist a snapshot epoch; returns its path."""
+    os.makedirs(directory, exist_ok=True)
+    arrays: dict = {
+        "v_cluster": snap.v_cluster,
+        "v_position": snap.v_position,
+        "v_class": snap.v_class,
+    }
+    meta: dict = {
+        "format": 1,
+        "epoch": snap.epoch,
+        "num_vertices": snap.num_vertices,
+        "class_names": snap.class_names,
+        "class_vertex_range": {
+            k: list(v) for k, v in snap.class_vertex_range.items()
+        },
+        "edge_closure": snap.edge_closure,
+        "v_non_columnar": sorted(snap.v_non_columnar),
+        "v_columns": {},
+        "edges": {},
+    }
+    for k, arr in snap.class_closure.items():
+        arrays[f"closure:{k}"] = arr
+    for name, col in snap.v_columns.items():
+        meta["v_columns"][name] = _col_arrays(arrays, f"vc:{name}", col)
+    for cname, csr in snap.edge_classes.items():
+        p = f"e:{cname}"
+        arrays[f"{p}:indptr_out"] = csr.indptr_out
+        arrays[f"{p}:dst"] = csr.dst
+        arrays[f"{p}:indptr_in"] = csr.indptr_in
+        arrays[f"{p}:src"] = csr.src
+        arrays[f"{p}:edge_id_in"] = csr.edge_id_in
+        arrays[f"{p}:erid_c"] = np.array(
+            [r.cluster for r in csr.edge_rids], np.int32
+        )
+        arrays[f"{p}:erid_p"] = np.array(
+            [r.position for r in csr.edge_rids], np.int32
+        )
+        emeta = {
+            "non_columnar": sorted(csr.non_columnar),
+            "out_degree_max": int(csr.out_degree_max),
+            "in_degree_max": int(csr.in_degree_max),
+            "columns": {},
+        }
+        for n, col in csr.edge_columns.items():
+            emeta["columns"][n] = _col_arrays(arrays, f"{p}:c:{n}", col)
+        meta["edges"][cname] = emeta
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays
+    )
+    data = buf.getvalue()
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    name = f"{PREFIX}{snap.epoch:012d}-{digest}.npz"
+    path = os.path.join(directory, name)
+    if os.path.exists(path):
+        return path  # content-addressed: identical epoch already on disk
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    log.info("snapshot epoch %d saved: %s (%d bytes)", snap.epoch, name, len(data))
+    return path
+
+
+def load_snapshot(path: str) -> GraphSnapshot:
+    """Load a persisted epoch, verifying its content digest."""
+    with open(path, "rb") as f:
+        data = f.read()
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    want = os.path.basename(path).rsplit("-", 1)[-1].split(".")[0]
+    if digest != want:
+        raise ValueError(
+            f"snapshot {os.path.basename(path)} fails its content digest "
+            "(truncated or corrupt)"
+        )
+    arrays = np.load(io.BytesIO(data), allow_pickle=False)
+    meta = json.loads(bytes(arrays["__meta__"]))
+    if meta.get("format") != 1:
+        raise ValueError(f"unsupported snapshot format {meta.get('format')!r}")
+    snap = GraphSnapshot()
+    snap.epoch = meta["epoch"]
+    snap.num_vertices = meta["num_vertices"]
+    snap.v_cluster = arrays["v_cluster"]
+    snap.v_position = arrays["v_position"]
+    snap.v_class = arrays["v_class"]
+    snap.rid_to_idx = {
+        RID(int(c), int(p)): i
+        for i, (c, p) in enumerate(zip(snap.v_cluster, snap.v_position))
+    }
+    snap.class_names = meta["class_names"]
+    snap.class_id_of = {n.lower(): i for i, n in enumerate(snap.class_names)}
+    snap.class_vertex_range = {
+        k: tuple(v) for k, v in meta["class_vertex_range"].items()
+    }
+    snap.edge_closure = meta["edge_closure"]
+    snap.v_non_columnar = set(meta["v_non_columnar"])
+    for key in arrays.files:
+        if key.startswith("closure:"):
+            snap.class_closure[key[len("closure:"):]] = arrays[key]
+    for name, cmeta in meta["v_columns"].items():
+        snap.v_columns[name] = _col_restore(arrays, f"vc:{name}", cmeta)
+    for cname, emeta in meta["edges"].items():
+        p = f"e:{cname}"
+        csr = EdgeClassCSR(cname)
+        csr.indptr_out = arrays[f"{p}:indptr_out"]
+        csr.dst = arrays[f"{p}:dst"]
+        csr.indptr_in = arrays[f"{p}:indptr_in"]
+        csr.src = arrays[f"{p}:src"]
+        csr.edge_id_in = arrays[f"{p}:edge_id_in"]
+        csr.edge_rids = [
+            RID(int(c), int(pp))
+            for c, pp in zip(arrays[f"{p}:erid_c"], arrays[f"{p}:erid_p"])
+        ]
+        csr.non_columnar = set(emeta["non_columnar"])
+        csr.out_degree_max = emeta["out_degree_max"]
+        csr.in_degree_max = emeta["in_degree_max"]
+        for n, colmeta in emeta["columns"].items():
+            csr.edge_columns[n] = _col_restore(arrays, f"{p}:c:{n}", colmeta)
+        snap.edge_classes[cname] = csr
+    return snap
+
+
+def list_epochs(directory: str) -> List[str]:
+    """Epoch files, oldest → newest."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        os.path.join(directory, f)
+        for f in os.listdir(directory)
+        if f.startswith(PREFIX) and f.endswith(".npz")
+    )
+
+
+def attach_latest_epoch(db, directory: str, mesh=None) -> Optional[GraphSnapshot]:
+    """Resume the read path: attach the newest persisted epoch whose epoch
+    stamp matches the store's mutation epoch ('reload'); a stale or absent
+    epoch returns None — the caller rebuilds ('replay ingest tail')."""
+    for path in reversed(list_epochs(directory)):
+        try:
+            snap = load_snapshot(path)
+        except Exception:
+            log.exception("epoch %s unreadable; trying older", path)
+            continue
+        if snap.epoch != db.mutation_epoch:
+            continue  # stale for this store; an older epoch may match
+            # (e.g. after recovery fell back to an older checkpoint)
+        db.attach_snapshot(snap, mesh=mesh)
+        return snap
+    return None
+
+
+def save_current_epoch(db, directory: str) -> Optional[str]:
+    """Persist the database's attached snapshot (if fresh)."""
+    snap = db.current_snapshot(require_fresh=True)
+    if snap is None:
+        return None
+    return save_snapshot(snap, directory)
