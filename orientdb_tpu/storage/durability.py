@@ -466,6 +466,16 @@ def _checkpoint_payload(db: Database) -> Dict:
     }
 
 
+def atomic_write(path: str, data: bytes) -> None:
+    """Crash-safe publish: tmp write + flush + fsync + rename."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def _ckpt_lsn_from_name(filename: str) -> int:
     """checkpoint-<epoch>-<lsn>-<digest>.json → lsn (0 if unparsable)."""
     try:
@@ -493,12 +503,7 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
         f"{payload['lsn']:012d}-{digest}.json"
     )
     path = os.path.join(directory, name)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)  # atomic publish
+    atomic_write(path, data)
     if wal is not None:
         upto = payload["lsn"]
         wal.close()

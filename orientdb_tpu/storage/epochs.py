@@ -115,12 +115,15 @@ def save_snapshot(snap: GraphSnapshot, directory: str) -> str:
     path = os.path.join(directory, name)
     if os.path.exists(path):
         return path  # content-addressed: identical epoch already on disk
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    from orientdb_tpu.storage.durability import atomic_write
+
+    atomic_write(path, data)
+    # retention: keep the newest two epochs (mirrors checkpoint())
+    for old in list_epochs(directory)[:-2]:
+        try:
+            os.remove(old)
+        except OSError:
+            pass
     log.info("snapshot epoch %d saved: %s (%d bytes)", snap.epoch, name, len(data))
     return path
 
@@ -199,14 +202,20 @@ def attach_latest_epoch(db, directory: str, mesh=None) -> Optional[GraphSnapshot
     stamp matches the store's mutation epoch ('reload'); a stale or absent
     epoch returns None — the caller rebuilds ('replay ingest tail')."""
     for path in reversed(list_epochs(directory)):
+        # the stamp is in the filename — skip stale epochs without
+        # reading/hashing multi-GB files (e.g. after recovery fell back
+        # to an older checkpoint, only an older epoch matches)
+        try:
+            stamp = int(os.path.basename(path)[len(PREFIX):].split("-")[0])
+        except ValueError:
+            stamp = -1
+        if stamp != db.mutation_epoch:
+            continue
         try:
             snap = load_snapshot(path)
         except Exception:
             log.exception("epoch %s unreadable; trying older", path)
             continue
-        if snap.epoch != db.mutation_epoch:
-            continue  # stale for this store; an older epoch may match
-            # (e.g. after recovery fell back to an older checkpoint)
         db.attach_snapshot(snap, mesh=mesh)
         return snap
     return None
