@@ -107,8 +107,11 @@ def main() -> None:
             )
             sys.exit(1)
 
+    from orientdb_tpu.exec.tpu_engine import drain_warmups
+
     def time_single(q, n=single_iters):
         run("tpu", q)  # warm (compiles the sync-free replay plan)
+        drain_warmups()
         t0 = time.perf_counter()
         for _ in range(n):
             run("tpu", q)
@@ -117,6 +120,7 @@ def main() -> None:
     def time_batched(q, n=iters):
         qs = [q] * batch
         db.query_batch(qs, engine="tpu", strict=True)  # warm
+        drain_warmups()
         t0 = time.perf_counter()
         for _ in range(n):
             rss = db.query_batch(qs, engine="tpu", strict=True)
@@ -173,6 +177,7 @@ def main() -> None:
             qs = [q] * batch
             plist = [is_params(q, i) for i in range(batch)]
             snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)  # warm
+            drain_warmups()
             t0 = time.perf_counter()
             for _ in range(iters):
                 rss = snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)

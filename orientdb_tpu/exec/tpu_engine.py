@@ -1999,7 +1999,82 @@ class TpuTraverseSolver:
         return out
 
 
-class _CompiledTraverse:
+class _AotWarmup:
+    """Background trace+compile of a replay's jitted function.
+
+    A freshly recorded plan returns its rows from the eager recording run —
+    its `jax.jit` replay has never been called, so the FIRST replay dispatch
+    would absorb the whole trace+XLA-compile (~10 s for a deep var-depth
+    plan), landing squarely in what callers think is the steady state.
+    `ensure_compiled` moves that cost to record time on a daemon thread
+    (tracing swaps `dg.arrays` thread-locally, so concurrent queries are
+    unaffected); `dispatch` waits for a pending warm-up instead of
+    duplicating the compile."""
+
+    _aot_ready = None  # threading.Event while a warm-up is in flight
+
+    #: all in-flight warm-up events (drain_warmups waits on these; each
+    #: worker removes its own entry, so the list stays bounded)
+    _inflight: "List" = []
+
+    def _warm_call(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def _is_compiled(self) -> bool:
+        try:
+            return self.jitted._cache_size() > 0
+        except Exception:
+            return False
+
+    def ensure_compiled(self) -> None:
+        if self._aot_ready is not None or self._is_compiled():
+            return
+        import threading
+
+        from orientdb_tpu.utils.metrics import metrics
+
+        ev = threading.Event()
+        self._aot_ready = ev
+        _AotWarmup._inflight.append(ev)
+
+        def work():
+            # the warm-up CALLS the jitted replay (result discarded): JAX's
+            # AOT `lower().compile()` does not seed the jit call cache, so
+            # executing once is the only way to make the next dispatch hit
+            try:
+                jax.block_until_ready(self._warm_call())
+                metrics.incr("plan_cache.aot_compile")
+            except Exception:
+                log.exception("background plan warm-up failed")
+                metrics.incr("plan_cache.aot_compile_error")
+            finally:
+                ev.set()
+                try:
+                    _AotWarmup._inflight.remove(ev)
+                except ValueError:
+                    pass  # a concurrent drain already claimed it
+
+        threading.Thread(target=work, daemon=True, name="plan-aot").start()
+
+    def wait_compiled(self) -> None:
+        ev = self._aot_ready
+        if ev is not None:
+            ev.wait()
+            self._aot_ready = None
+
+
+def drain_warmups() -> None:
+    """Block until every in-flight background plan compile finishes.
+
+    Benchmarks and tests call this between warm-up and measurement so AOT
+    compile threads (which hold the GIL through long trace phases) don't
+    steal host time from the timed section."""
+    pending, _AotWarmup._inflight = _AotWarmup._inflight, []
+    for ev in pending:
+        ev.wait()
+
+
+class _CompiledTraverse(_AotWarmup):
     """Replayable TRAVERSE plan (same dispatch/materialize protocol as
     `_CompiledPlan` so `execute_batch` treats both uniformly)."""
 
@@ -2007,6 +2082,11 @@ class _CompiledTraverse:
         self.solver = solver
         self.count = count
         self.jitted = jax.jit(self._replay)
+
+    def _warm_call(self):
+        # snapshot the canonical dict: the main thread may _put new keys
+        # (lazy class-id/edge uploads) while jit flattens the pytree here
+        return self.jitted(dict(self.solver.dg.arrays))
 
     def _replay(self, arrays):
         dg = self.solver.dg
@@ -2023,6 +2103,7 @@ class _CompiledTraverse:
         # TRAVERSE plans bake parameter values (their full values join the
         # plan-cache key), so `params` is accepted for interface parity
         # with _CompiledPlan and ignored
+        self.wait_compiled()
         return self.jitted(self.solver.dg.arrays)
 
     def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
@@ -2042,7 +2123,7 @@ class ScheduleOverflow(Exception):
     schedule's capacities; the result was discarded. Caller re-records."""
 
 
-class _CompiledPlan:
+class _CompiledPlan(_AotWarmup):
     """A solver whose size schedule is learned: re-executions replay the
     whole solve as one jitted, sync-free device dispatch.
 
@@ -2119,8 +2200,13 @@ class _CompiledPlan:
             dyn[k] = jnp.asarray(int(v) if kind != "float" else v, dtype)
         return dyn
 
+    def _warm_call(self):
+        # dict snapshot for the same flatten-vs-insert reason as traverse
+        return self.jitted(dict(self.solver.dg.arrays), self._dyn_args(None))
+
     def dispatch(self, params: Optional[Dict] = None):
         """Enqueue the replay on device; returns the un-fetched result."""
+        self.wait_compiled()
         return self.jitted(self.solver.dg.arrays, self._dyn_args(params))
 
     def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
@@ -2250,9 +2336,11 @@ def _record(db, stmt, params):
 def _prepare(db, stmt, params):
     """Plan-cache lookup, compiling (and executing) on miss.
 
-    Returns ``(variants, None)`` on a cache hit — `variants` is the
+    Returns ``(variants, None, None)`` on a cache hit — `variants` is the
     MRU-ordered list of schedule variants for this statement — or
-    ``(None, rows)`` when this call WAS the recording first execution."""
+    ``(None, rows, plan)`` when this call WAS the recording first
+    execution (`plan` is the freshly cached plan with its background AOT
+    warm-up started, or None when the statement was uncacheable)."""
     if not isinstance(stmt, (A.MatchStatement, A.TraverseStatement)):
         raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
     params = params or {}
@@ -2268,7 +2356,7 @@ def _prepare(db, stmt, params):
         if variants is not None:
             cache.move_to_end(key)  # LRU: keep hot plans
             metrics.incr("plan_cache.hit")
-            return variants, None
+            return variants, None, None
     metrics.incr("plan_cache.miss")
     plan_obj, rows = _record(db, stmt, params)
     if key is not None and config.plan_cache_size > 0:
@@ -2277,7 +2365,11 @@ def _prepare(db, stmt, params):
         v = PlanVariants(plan_obj)
         v.remember(params, plan_obj)
         cache[key] = v
-    return None, rows
+        # replay-compile off the critical path: rows came from the eager
+        # recording, so the XLA compile would otherwise hit the NEXT caller
+        plan_obj.ensure_compiled()
+        return None, rows, plan_obj
+    return None, rows, None
 
 
 class PlanVariants:
@@ -2325,10 +2417,13 @@ class PlanVariants:
         }
 
 
-def _run_variants(db, stmt, params, variants: PlanVariants, tried=None) -> List[Result]:
+def _run_variants(
+    db, stmt, params, variants: PlanVariants, tried=None, fresh=None
+) -> List[Result]:
     """Walk the remaining variants after a miss; when every one overflows,
     record a NEW variant under these parameters. ``tried`` is the plan the
-    caller already dispatched and saw overflow from."""
+    caller already dispatched and saw overflow from; ``fresh`` (when given)
+    collects newly recorded plans so a batch can block on their warm-ups."""
     for plan in list(variants.plans):
         if plan is tried:
             continue
@@ -2344,11 +2439,14 @@ def _run_variants(db, stmt, params, variants: PlanVariants, tried=None) -> List[
     plan_obj, rows = _record(db, stmt, params)
     variants.add(plan_obj)
     variants.remember(params, plan_obj)
+    plan_obj.ensure_compiled()
+    if fresh is not None:
+        fresh.append(plan_obj)
     return rows
 
 
 def execute(db, stmt, params) -> List[Result]:
-    variants, rows = _prepare(db, stmt, params)
+    variants, rows, _fresh = _prepare(db, stmt, params)
     if variants is None:
         return rows
     plan = variants.pick(params)
@@ -2372,14 +2470,17 @@ def execute_batch(db, items) -> List:
     instance so the engine front door can fall back per statement."""
     out: List = [None] * len(items)
     pending = []
+    fresh = []
     for i, (stmt, params) in enumerate(items):
         try:
-            variants, rows = _prepare(db, stmt, params)
+            variants, rows, plan_obj = _prepare(db, stmt, params)
         except Uncompilable as e:
             out[i] = e
             continue
         if variants is None:
             out[i] = rows
+            if plan_obj is not None:
+                fresh.append(plan_obj)
         else:
             # sticky routing: repeated parameter values dispatch straight
             # to the variant that last served them
@@ -2396,7 +2497,13 @@ def execute_batch(db, items) -> List:
             out[i] = plan.materialize(dev, params or {})
             variants.remember(params, plan)
         except ScheduleOverflow:
-            out[i] = _run_variants(db, stmt, params, variants, tried=plan)
+            out[i] = _run_variants(
+                db, stmt, params, variants, tried=plan, fresh=fresh
+            )
+    # a batch returns replay-ready: block on warm-ups this call started so
+    # plans recorded here don't leak their XLA compile into the next batch
+    for plan in fresh:
+        plan.wait_compiled()
     return out
 
 
@@ -2418,7 +2525,7 @@ def profile_execute(db, stmt, params) -> Tuple[List[Result], Dict]:
         raise Uncompilable("active transaction on this thread")
     phases: Dict[str, object] = {}
     t0 = _time.perf_counter()
-    variants, rows = _prepare(db, stmt, params)
+    variants, rows, _fresh = _prepare(db, stmt, params)
     phases["prepareUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
     if variants is None:
         # recording first execution: eager, one blocking sync per observe

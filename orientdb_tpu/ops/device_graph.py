@@ -16,6 +16,7 @@ constants.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Set
 
 import jax.numpy as jnp
@@ -129,7 +130,8 @@ class DeviceGraph:
             self.mesh_graph = MeshGraph(mesh)
             self._replicated_spec = NamedSharding(mesh, PartitionSpec())
         #: the single flat array store — a jit-arg pytree for compiled plans
-        self.arrays: Dict[str, jnp.ndarray] = {}
+        self._arrays: Dict[str, jnp.ndarray] = {}
+        self._tls = threading.local()
         self._put("v_class", snap.v_class)
         self.columns: Dict[str, DeviceColumn] = {
             n: DeviceColumn(c, self, f"v:{n}") for n, c in snap.v_columns.items()
@@ -147,6 +149,25 @@ class DeviceGraph:
             self.mesh_graph.build(self)
 
     @property
+    def arrays(self) -> Dict[str, jnp.ndarray]:
+        """The array store — per-thread overridable.
+
+        Compiled plans swap in the jit tracer pytree for the duration of a
+        trace (``dg.arrays = tracers``). Replays are AOT-warmed on a
+        background thread (`tpu_engine._CompiledPlan.ensure_compiled`), so
+        that swap MUST be invisible to other threads: the override lives in
+        thread-local storage, and assigning the canonical dict back clears
+        it. Concurrent traces and eager solves on different threads each see
+        their own view; `_put` writes to the canonical store directly so an
+        active override can never swallow an upload."""
+        ov = getattr(self._tls, "override", None)
+        return self._arrays if ov is None else ov
+
+    @arrays.setter
+    def arrays(self, value) -> None:
+        self._tls.override = None if value is self._arrays else value
+
+    @property
     def mesh(self):
         return self.mesh_graph.mesh if self.mesh_graph is not None else None
 
@@ -156,7 +177,7 @@ class DeviceGraph:
             import jax
 
             a = jax.device_put(a, self._replicated_spec)
-        self.arrays[key] = a
+        self._arrays[key] = a
         return key
 
     @property

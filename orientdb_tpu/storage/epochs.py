@@ -118,8 +118,13 @@ def save_snapshot(snap: GraphSnapshot, directory: str) -> str:
     from orientdb_tpu.storage.durability import atomic_write
 
     atomic_write(path, data)
-    # retention: keep the newest two epochs (mirrors checkpoint())
+    # retention: keep the newest two epochs, plus the file just written —
+    # after a recovery that fell back to an older checkpoint, newer-epoch
+    # files may exist on disk and the current epoch would otherwise be
+    # pruned as "old" the moment it was saved
     for old in list_epochs(directory)[:-2]:
+        if old == path:
+            continue
         try:
             os.remove(old)
         except OSError:

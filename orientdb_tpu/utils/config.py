@@ -63,7 +63,7 @@ class GlobalConfiguration:
     # Schedule variants kept per cached statement: parameter values whose
     # live sizes exceed every variant's capacities record a new variant
     # rather than thrash-replacing one plan.
-    plan_variants: int = 3
+    plan_variants: int = 8
 
     # Plan cache entries (analog of OExecutionPlanCache [E]).
     plan_cache_size: int = 256

@@ -97,3 +97,80 @@ class TestQueryBatch:
     def test_params_list_length_mismatch(self, sdb):
         with pytest.raises(ValueError):
             sdb.query_batch([MATCH_COUNT], params_list=[{}, {}])
+
+
+class TestAotWarmup:
+    """Background replay compilation (tpu_engine._AotWarmup): a freshly
+    recorded plan's jitted replay compiles off the critical path, and a
+    batch returns replay-ready."""
+
+    def test_batch_returns_replay_ready(self, sdb):
+        from orientdb_tpu.exec import tpu_engine as te
+        from orientdb_tpu.sql.parser import parse
+
+        q = (
+            "MATCH {class:Profiles, as:p, where:(uid = :u)}"
+            "-HasFriend->{as:f} RETURN f.name AS n"
+        )
+        plist = [{"u": i} for i in range(5)]
+        rss = sdb.query_batch([q] * 5, params_list=plist, engine="tpu", strict=True)
+        oracle = [
+            sdb.query(q, params=p, engine="oracle").to_dicts() for p in plist
+        ]
+        assert [canon(rs.to_dicts()) for rs in rss] == [canon(o) for o in oracle]
+        snap = sdb.current_snapshot(require_fresh=True)
+        key = te._cache_key(parse(q), plist[0])
+        variants = snap._plan_cache[key]
+        te.drain_warmups()
+        for plan in variants.plans:
+            assert plan._is_compiled()
+            # replay (not re-record) serves the next dispatch
+            assert plan._aot_ready is None or plan._aot_ready.is_set()
+
+    def test_single_record_schedules_background_compile(self, sdb):
+        from orientdb_tpu.exec import tpu_engine as te
+        from orientdb_tpu.sql.parser import parse
+
+        q = "MATCH {class:Profiles, as:p, where:(age > :a)} RETURN count(*) AS n"
+        sdb.query(q, params={"a": 20}, engine="tpu", strict=True)
+        te.drain_warmups()
+        snap = sdb.current_snapshot(require_fresh=True)
+        variants = snap._plan_cache[te._cache_key(parse(q), {"a": 20})]
+        assert variants.plans[0]._is_compiled()
+        # and the compiled replay still answers correctly across params
+        for a in (10, 27, 50):
+            got = sdb.query(q, params={"a": a}, engine="tpu", strict=True).to_dicts()
+            want = sdb.query(q, params={"a": a}, engine="oracle").to_dicts()
+            assert got == want
+
+
+class TestDeviceGraphThreadLocalArrays:
+    def test_swap_invisible_to_other_threads(self, sdb):
+        import threading
+
+        from orientdb_tpu.ops.device_graph import device_graph
+
+        dg = device_graph(sdb.current_snapshot(require_fresh=True))
+        canonical = dg.arrays
+        seen = {}
+
+        def swapper(started, release):
+            saved = dg.arrays
+            dg.arrays = {"fake": None}
+            started.set()
+            release.wait(5)
+            seen["inner"] = dg.arrays
+            dg.arrays = saved
+            seen["restored"] = dg.arrays
+
+        started, release = threading.Event(), threading.Event()
+        t = threading.Thread(target=swapper, args=(started, release))
+        t.start()
+        started.wait(5)
+        # the swap is live on the worker thread but invisible here
+        assert dg.arrays is canonical
+        release.set()
+        t.join(5)
+        assert seen["inner"] == {"fake": None}
+        assert seen["restored"] is canonical
+        assert dg.arrays is canonical

@@ -73,3 +73,22 @@ def test_attach_latest_epoch_resume(tmp_path):
     db2._snapshot = None
     assert attach_latest_epoch(db2, str(tmp_path)) is None
     assert len(list_epochs(str(tmp_path))) == 1
+
+
+def test_retention_never_prunes_the_epoch_just_written(tmp_path):
+    """After recovery falls back to an older checkpoint, newer-epoch files
+    can sit in the directory; saving the current (older) epoch must not
+    delete the file it just wrote."""
+    db = generate_demodb(n_profiles=100, avg_friends=4, seed=6)
+    attach_fresh_snapshot(db)
+    snap = db.current_snapshot()
+    # two fabricated newer epochs already on disk
+    for fake_epoch in (snap.epoch + 5, snap.epoch + 9):
+        fake = os.path.join(
+            str(tmp_path), f"snapshot-{fake_epoch:012d}-{'0' * 16}.npz"
+        )
+        with open(fake, "wb") as f:
+            f.write(b"newer")
+    path = save_snapshot(snap, str(tmp_path))
+    assert os.path.exists(path)
+    assert load_snapshot(path).epoch == snap.epoch
