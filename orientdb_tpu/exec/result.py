@@ -101,12 +101,66 @@ def _plain(v):
     return v
 
 
+class ColumnarRows:
+    """Projection rows kept as decoded object columns.
+
+    The TPU engine's columnar fast path (`tpu_engine._fast_rows`) decodes
+    device columns into per-projection object arrays; building a `Result`
+    per row up front costs more host time than the whole device solve for
+    large result sets. This sequence materializes `Result` objects only if
+    a caller actually iterates, and `to_dicts()` goes straight from the
+    columns (the common parity/serialization consumer)."""
+
+    __slots__ = ("names", "cols", "n")
+
+    def __init__(self, names: List[str], cols: List, n: int) -> None:
+        self.names = names
+        self.cols = cols
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i):
+        """List-compatible access (int index or slice), materializing
+        `Result` objects on demand — callers annotated `List[Result]`
+        must not explode just because the fast path produced the rows."""
+        if isinstance(i, slice):
+            idx = range(*i.indices(self.n))
+            return [self._row(j) for j in idx]
+        j = i + self.n if i < 0 else i
+        if not 0 <= j < self.n:
+            raise IndexError(i)
+        return self._row(j)
+
+    def _row(self, j: int) -> Result:
+        return Result(
+            props={n: c[j] for n, c in zip(self.names, self.cols)}
+        )
+
+    def __iter__(self) -> Iterator[Result]:
+        names = self.names
+        if not self.cols:
+            for _ in range(self.n):
+                yield Result(props={})
+            return
+        for row in zip(*self.cols):
+            yield Result(props=dict(zip(names, row)))
+
+    def to_dicts(self) -> List[Dict[str, object]]:
+        names = self.names
+        if not self.cols:
+            return [{} for _ in range(self.n)]
+        return [dict(zip(names, row)) for row in zip(*self.cols)]
+
+
 class ResultSet:
     """Forward-only row stream ([E] OResultSet), with an attached execution
     plan for EXPLAIN/PROFILE."""
 
     def __init__(self, rows: Iterable[Result], plan=None) -> None:
-        self._it = iter(rows)
+        self._rows = rows
+        self._it: Optional[Iterator[Result]] = None
         self._peeked: Optional[Result] = None
         self._exhausted = False
         self.plan = plan
@@ -116,6 +170,8 @@ class ResultSet:
             return True
         if self._exhausted:
             return False
+        if self._it is None:
+            self._it = iter(self._rows)
         try:
             self._peeked = next(self._it)
             return True
@@ -141,6 +197,15 @@ class ResultSet:
         return list(self)
 
     def to_dicts(self) -> List[Dict[str, object]]:
+        # bulk path: untouched columnar rows skip Result materialization
+        # entirely (consumes the stream, like the row-by-row path below)
+        if (
+            self._it is None
+            and not self._exhausted
+            and isinstance(self._rows, ColumnarRows)
+        ):
+            self._exhausted = True
+            return self._rows.to_dicts()
         return [r.to_dict() for r in self]
 
     def close(self) -> None:  # API parity; nothing to release host-side

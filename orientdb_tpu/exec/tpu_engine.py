@@ -48,7 +48,7 @@ from orientdb_tpu.exec.oracle import (
     _skip_limit,
     _REVERSE_DIR,
 )
-from orientdb_tpu.exec.result import Result
+from orientdb_tpu.exec.result import ColumnarRows, Result
 from orientdb_tpu.models.record import Document
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.ops import csr as K
@@ -1833,6 +1833,11 @@ class TpuMatchSolver:
                 o = vals.astype(object)
             o[~pres] = None
             obj_cols.append(o)
+        if not (stmt.distinct or stmt.order_by or stmt.skip or stmt.limit):
+            # (unwind already bailed at the top of this function)
+            # finalize tail is identity → hand the columns over whole; the
+            # ResultSet serializes them in bulk without per-row Results
+            return ColumnarRows(names, [c.tolist() for c in obj_cols], n)
         out = [
             Result(props=dict(zip(names, vals_row)))
             for vals_row in zip(*obj_cols)
@@ -2534,6 +2539,9 @@ def profile_execute(db, stmt, params) -> Tuple[List[Result], Dict]:
     plan = variants.pick(params)
     phases["mode"] = "replay"
     phases["variants"] = len(variants.plans)
+    t0 = _time.perf_counter()
+    plan.wait_compiled()  # keep a pending AOT compile out of dispatchUs
+    phases["compileWaitUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
     t0 = _time.perf_counter()
     dev = plan.dispatch(params or {})
     phases["dispatchUs"] = round((_time.perf_counter() - t0) * 1e6, 1)

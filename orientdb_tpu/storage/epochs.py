@@ -114,23 +114,30 @@ def save_snapshot(snap: GraphSnapshot, directory: str) -> str:
     name = f"{PREFIX}{snap.epoch:012d}-{digest}.npz"
     path = os.path.join(directory, name)
     if os.path.exists(path):
-        return path  # content-addressed: identical epoch already on disk
+        # content-addressed: identical epoch already on disk (retention
+        # still runs — a dedup-hit save must enforce the policy too)
+        _prune_epochs(directory, keep=path)
+        return path
     from orientdb_tpu.storage.durability import atomic_write
 
     atomic_write(path, data)
-    # retention: keep the newest two epochs, plus the file just written —
-    # after a recovery that fell back to an older checkpoint, newer-epoch
-    # files may exist on disk and the current epoch would otherwise be
-    # pruned as "old" the moment it was saved
+    _prune_epochs(directory, keep=path)
+    log.info("snapshot epoch %d saved: %s (%d bytes)", snap.epoch, name, len(data))
+    return path
+
+
+def _prune_epochs(directory: str, keep: str) -> None:
+    """Retention: keep the newest two epochs, plus ``keep`` — after a
+    recovery that fell back to an older checkpoint, newer-epoch files may
+    exist on disk and the current epoch would otherwise be pruned as "old"
+    the moment it was saved."""
     for old in list_epochs(directory)[:-2]:
-        if old == path:
+        if old == keep:
             continue
         try:
             os.remove(old)
         except OSError:
             pass
-    log.info("snapshot epoch %d saved: %s (%d bytes)", snap.epoch, name, len(data))
-    return path
 
 
 def load_snapshot(path: str) -> GraphSnapshot:
