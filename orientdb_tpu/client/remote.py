@@ -21,6 +21,12 @@ class RemoteError(Exception):
     pass
 
 
+class RemoteConnectionError(RemoteError):
+    """The channel itself failed (socket error / connection lost) — the
+    retryable class a multi-address client fails over on, as opposed to a
+    server-reported request error."""
+
+
 class RemoteResultSet:
     """List-backed result mirror of the embedded ResultSet surface."""
 
@@ -63,11 +69,14 @@ class RemoteDatabase:
     def _call(self, req: dict) -> dict:
         with self._lock:
             if self._sock is None:
-                raise RemoteError("connection closed")
-            send_frame(self._sock, req)
-            resp = recv_frame(self._sock)
+                raise RemoteConnectionError("connection closed")
+            try:
+                send_frame(self._sock, req)
+                resp = recv_frame(self._sock)
+            except OSError as e:
+                raise RemoteConnectionError(str(e)) from e
             if resp is None:
-                raise RemoteError("connection lost")
+                raise RemoteConnectionError("connection lost")
             return resp
 
     def _checked(self, req: dict) -> dict:
@@ -120,11 +129,114 @@ class RemoteDatabase:
         self.close()
 
 
-def connect(url: str, user: str, password: str) -> RemoteDatabase:
-    """`remote:<host>:<port>/<database>` ([E] the remote: URL scheme)."""
+class FailoverDatabase:
+    """Multi-address remote client ([E] OStorageRemote's server-list
+    failover: `remote:host1;host2/<db>`).
+
+    Wraps a RemoteDatabase; a channel failure (RemoteConnectionError /
+    OSError) rotates to the next address and retries the call once per
+    address. Server-reported errors (bad SQL, permission denied) are NOT
+    failed over. For a replicated cluster the list is the member servers:
+    after a failover the promoted member serves the reconnect."""
+
+    def __init__(self, addrs, name: str, user: str, password: str) -> None:
+        self._addrs = list(addrs)
+        self._name, self._user, self._password = name, user, password
+        self._db: Optional[RemoteDatabase] = None
+        self._lock = threading.Lock()
+        self._connect_any()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _connect_any(self) -> None:
+        last: Optional[Exception] = None
+        for i, (h, p) in enumerate(self._addrs):
+            try:
+                self._db = RemoteDatabase(
+                    h, p, self._name, self._user, self._password
+                )
+                # rotate: the reachable server becomes the head
+                self._addrs = self._addrs[i:] + self._addrs[:i]
+                return
+            except (OSError, RemoteError) as e:
+                last = e
+        raise RemoteError(f"no reachable server in {self._addrs}: {last}")
+
+    def _retry(self, method: str, *a, idempotent: bool = True):
+        with self._lock:
+            if self._db is None:
+                # a previous total outage left no connection; servers may
+                # be back — reconnect before giving up on the client object
+                self._connect_any()
+            try:
+                return getattr(self._db, method)(*a)
+            except (RemoteConnectionError, OSError) as e:
+                self._db = None
+                # demote the failed head so reconnection scans the OTHER
+                # members first (the dead host may hang, not refuse)
+                self._addrs = self._addrs[1:] + self._addrs[:1]
+                self._connect_any()
+                if not idempotent:
+                    # at-most-once for writes: the dead channel may have
+                    # delivered the op before failing — resending could
+                    # apply it twice, so surface the ambiguity instead
+                    raise RemoteConnectionError(
+                        f"connection failed mid-{method}; reconnected to "
+                        f"{self._addrs[0]} but the op was NOT retried "
+                        "(outcome on the old server unknown)"
+                    ) from e
+                return getattr(self._db, method)(*a)
+
+    def query(self, sql, params=None):
+        return self._retry("query", sql, params)
+
+    def command(self, sql, params=None):
+        return self._retry("command", sql, params, idempotent=False)
+
+    def load(self, rid):
+        return self._retry("load", rid)
+
+    def save(self, record):
+        return self._retry("save", record, idempotent=False)
+
+    def delete(self, rid):
+        return self._retry("delete", rid, idempotent=False)
+
+    def databases(self):
+        return self._retry("databases")
+
+    def create_database(self, name: str):
+        return self._retry("create_database", name, idempotent=False)
+
+    def close(self) -> None:
+        if self._db is not None:
+            self._db.close()
+
+    def __enter__(self) -> "FailoverDatabase":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _parse_addrs(hostports: str):
+    out = []
+    for part in hostports.replace(",", ";").split(";"):
+        host, _, port = part.partition(":")
+        out.append((host or "127.0.0.1", int(port or 2424)))
+    return out
+
+
+def connect(url: str, user: str, password: str):
+    """`remote:<host>:<port>/<database>` ([E] the remote: URL scheme);
+    `remote:h1:p1;h2:p2/<database>` returns a failover client."""
     if not url.startswith("remote:"):
         raise ValueError(f"not a remote: url: {url!r}")
     rest = url[len("remote:") :]
     hostport, _, name = rest.partition("/")
-    host, _, port = hostport.partition(":")
-    return RemoteDatabase(host or "127.0.0.1", int(port or 2424), name, user, password)
+    addrs = _parse_addrs(hostport)
+    if len(addrs) > 1:
+        return FailoverDatabase(addrs, name, user, password)
+    return RemoteDatabase(addrs[0][0], addrs[0][1], name, user, password)

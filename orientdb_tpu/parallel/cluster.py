@@ -1,0 +1,274 @@
+"""Cluster membership, failure detection, and elastic failover.
+
+Analog of the reference's cluster manager ([E] distributed/
+``ODistributedServerManager`` + ``OHazelcastPlugin``: membership views,
+node status machine NOT_AVAILABLE→ONLINE, and the failover step that
+reassigns cluster ownership when the owner drops out of the view;
+SURVEY.md §2 "Distributed", §5.3 "Failure detection / elastic
+recovery"). Redesigned on this package's WAL-shipping replication
+(`parallel/replication.py`) instead of Hazelcast group messaging:
+
+- **membership**: one PRIMARY + N REPLICA members, each an HTTP server
+  fronting a local database; replicas run `ReplicaPuller`s whose pulls
+  double as heartbeats.
+- **failure detection**: `down_after` consecutive failed pulls mark the
+  primary DOWN (the node-status collapse) and notify the coordinator.
+- **election**: the most-caught-up ONLINE replica wins — max applied
+  LSN, ties broken by member name for determinism ([E] the "server with
+  the newest database" rule of the reference's resync, not a vote: the
+  stream is single-writer so the longest prefix is authoritative).
+- **elastic recovery**: the winner promotes (its database becomes the
+  writable source, WAL armed to CONTINUE the primary's LSN sequence);
+  surviving replicas repoint to it. A replica whose delta range no
+  longer exists (it lagged past the new primary's base) is rebuilt
+  fresh and full-syncs — availability over resync cost, the v1 policy.
+
+The coordinator is an in-process controller object: run it anywhere
+with HTTP reach of the members (tests run all members in one process,
+the same multi-server-in-one-JVM strategy the reference's distributed
+tests use per SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.parallel.replication import (
+    ReplicaPuller,
+    ReplicationGap,
+    enable_replication_source,
+)
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("cluster")
+
+
+class ClusterMember:
+    """One node: an HTTP server fronting a local database."""
+
+    __slots__ = ("name", "server", "db", "role", "puller")
+
+    def __init__(self, name: str, server, db: Database) -> None:
+        self.name = name
+        self.server = server
+        self.db = db
+        self.role = "REPLICA"  # PRIMARY | REPLICA | DOWN
+        self.puller: Optional[ReplicaPuller] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.http_port}"
+
+
+def arm_promoted_source(db: Database, applied_lsn: int) -> None:
+    """Make a promoted replica a replication source whose WAL CONTINUES
+    the failed primary's LSN sequence.
+
+    Without continuity a freshly armed WAL restarts at LSN 1 and a
+    surviving replica at applied_lsn=N>1 silently never applies anything
+    again. The base marker records "state as of ``applied_lsn``", and
+    ``_wal_base_exact_ok`` says a replica AT exactly that LSN already
+    holds the base state (unlike the late-armed-source marker, where
+    LSN 0 state is non-empty and a fresh replica needs the checkpoint).
+    """
+    from orientdb_tpu.storage.durability import enable_durability
+
+    if db._wal is None:
+        d = tempfile.mkdtemp(prefix=f"promoted-{db.name}-")
+        enable_durability(db, d, fsync=False)
+    db._wal.next_lsn = max(db._wal.next_lsn, applied_lsn + 1)
+    db._wal_base_lsn = applied_lsn
+    db._wal_has_base = True
+    db._wal_base_exact_ok = True
+
+
+class Cluster:
+    """Coordinator for one replicated database across member servers."""
+
+    def __init__(
+        self,
+        dbname: str,
+        user: str = "admin",
+        password: str = "admin",
+        interval: float = 0.25,
+        down_after: int = 4,
+    ) -> None:
+        self.dbname = dbname
+        self.user = user
+        self.password = password
+        self.interval = interval
+        self.down_after = down_after
+        self.members: Dict[str, ClusterMember] = {}
+        self.primary: Optional[str] = None
+        self._lock = threading.RLock()
+        self.failovers = 0
+
+    # -- membership ---------------------------------------------------------
+
+    def set_primary(self, name: str, server, db: Database) -> ClusterMember:
+        m = ClusterMember(name, server, db)
+        m.role = "PRIMARY"
+        enable_replication_source(db)
+        with self._lock:
+            self.members[name] = m
+            self.primary = name
+        return m
+
+    def add_replica(self, name: str, server) -> ClusterMember:
+        """Register a replica member; its local database lives on (and is
+        served by) its own server so it can become a source later."""
+        db = server.get_database(self.dbname)
+        if db is None:
+            db = server.create_database(self.dbname)
+        m = ClusterMember(name, server, db)
+        with self._lock:
+            self.members[name] = m
+        return m
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Cluster":
+        with self._lock:
+            for m in self.members.values():
+                if m.role == "REPLICA" and m.puller is None:
+                    self._start_puller(m)
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            members = list(self.members.values())
+        for m in members:
+            if m.puller is not None:
+                m.puller.stop()
+
+    def _start_puller(self, m: ClusterMember, applied_lsn: int = 0) -> None:
+        primary = self.members[self.primary]
+        m.puller = ReplicaPuller(
+            primary.url,
+            self.dbname,
+            m.db,
+            user=self.user,
+            password=self.password,
+            interval=self.interval,
+            down_after=self.down_after,
+            on_source_down=lambda name=m.name: self._primary_down(name),
+        )
+        m.puller.applied_lsn = applied_lsn
+        m.puller.start()
+
+    # -- failure handling ---------------------------------------------------
+
+    def _primary_down(self, reporter: str) -> None:
+        """A replica's failure detector collapsed the primary's status.
+
+        First reporter wins the right to run the election; later reports
+        (other replicas noticing the same dead primary, or noise during
+        repoint) find the view already updated and return."""
+        with self._lock:
+            old = self.primary
+            if old is None or self.members[old].role != "PRIMARY":
+                return  # failover already ran
+            live = self.members[old]
+            live.role = "DOWN"
+            metrics.incr("cluster.primary_down")
+            log.warning(
+                "primary %s marked DOWN (reported by %s); electing", old, reporter
+            )
+            winner = self._elect()
+            if winner is None:
+                log.error("no ONLINE replica to promote; cluster is read-only")
+                self.primary = None
+                return
+            self._promote_locked(winner)
+
+    def _elect(self) -> Optional[str]:
+        """Most-caught-up replica: max applied LSN, name-ordered ties."""
+        best: Optional[ClusterMember] = None
+        for m in sorted(self.members.values(), key=lambda m: m.name):
+            if m.role != "REPLICA" or m.puller is None:
+                continue
+            if best is None or m.puller.applied_lsn > best.puller.applied_lsn:
+                best = m
+        return best.name if best is not None else None
+
+    def promote(self, name: str) -> None:
+        """Manual failover entry point (planned maintenance)."""
+        with self._lock:
+            old = self.primary
+            if old is not None and old in self.members:
+                self.members[old].role = "DOWN"
+            self._promote_locked(name)
+
+    def _promote_locked(self, name: str) -> None:
+        m = self.members[name]
+        lsn = m.puller.applied_lsn if m.puller is not None else 0
+        if m.puller is not None:
+            # signal-only stop: sibling puller threads may be blocked on
+            # this cluster's lock to report the same dead primary — a
+            # joining stop() would stall failover 5 s per such thread
+            m.puller.request_stop()
+            m.puller.status = "PROMOTED"
+            m.puller = None
+        arm_promoted_source(m.db, lsn)
+        m.role = "PRIMARY"
+        self.primary = name
+        self.failovers += 1
+        metrics.incr("cluster.failover")
+        log.warning("promoted %s to PRIMARY at lsn %d", name, lsn)
+        for other in self.members.values():
+            if other.name == name or other.role != "REPLICA":
+                continue
+            self._repoint(other)
+
+    def _repoint(self, m: ClusterMember) -> None:
+        """Point a surviving replica at the new primary, preserving its
+        applied LSN; if its delta range is gone (it lagged past the new
+        primary's base), rebuild it fresh and full-sync."""
+        applied = m.puller.applied_lsn if m.puller is not None else 0
+        if m.puller is not None:
+            m.puller.request_stop()  # signal-only: see _promote_locked
+            m.puller = None
+        self._start_puller(m, applied_lsn=applied)
+        try:
+            m.puller.pull_once()  # synchronous probe: surfaces a gap now
+        except ReplicationGap:
+            log.warning(
+                "replica %s lagged past the new primary's base; "
+                "rebuilding fresh for full sync",
+                m.name,
+            )
+            metrics.incr("cluster.replica_rebuild")
+            m.puller.request_stop()
+            m.server.drop_database(self.dbname)
+            m.db = m.server.create_database(self.dbname)
+            self._start_puller(m, applied_lsn=0)
+        except Exception:
+            pass  # transient; the puller thread keeps retrying
+
+    # -- introspection ------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {
+                "dbname": self.dbname,
+                "primary": self.primary,
+                "failovers": self.failovers,
+                "members": {
+                    m.name: {
+                        "role": m.role,
+                        "url": m.url,
+                        **(m.puller.lag() if m.puller is not None else {}),
+                    }
+                    for m in self.members.values()
+                },
+            }
+
+    def primary_db(self) -> Optional[Database]:
+        with self._lock:
+            if self.primary is None:
+                return None
+            return self.members[self.primary].db

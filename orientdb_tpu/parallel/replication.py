@@ -91,9 +91,18 @@ def entries_after(db: Database, from_lsn: int, limit: int = 10_000) -> Dict:
         entries = db._wal.read_entries()
     # gap detection: (a) a late-armed source holds data its log never saw
     # (the base marker), (b) archives pruned past the requested range
-    needs_base = (
-        getattr(db, "_wal_has_base", False)
-        and from_lsn <= getattr(db, "_wal_base_lsn", 0)
+    base_lsn = getattr(db, "_wal_base_lsn", 0)
+    # `_wal_base_exact_ok` (set by cluster promotion) means "state as of
+    # base_lsn" — a replica AT that LSN already holds it and can continue
+    # by delta; the late-armed-source marker (exact_ok unset) means the
+    # LSN-0 state is non-empty, so even a from_lsn==base replica needs
+    # the checkpoint
+    needs_base = getattr(db, "_wal_has_base", False) and (
+        from_lsn < base_lsn
+        or (
+            from_lsn == base_lsn
+            and not getattr(db, "_wal_base_exact_ok", False)
+        )
     )
     available_from = entries[0]["lsn"] if entries else db._wal.next_lsn
     if needs_base or from_lsn + 1 < available_from:
@@ -146,10 +155,19 @@ class ReplicaPuller:
         self._thread.start()
         return self
 
+    def request_stop(self) -> None:
+        """Signal the pull loop to exit without joining it — for callers
+        holding locks the loop itself may be blocked on (cluster failover
+        runs on a puller thread while sibling pullers wait to report)."""
+        self._stop.set()
+
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        t = self._thread
+        # failover runs on a puller thread (on_source_down → promote/
+        # repoint), so stop() must not join the thread it's running on
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
 
     def promote(self) -> Database:
         """Stop replicating; the local database becomes the writable
@@ -172,7 +190,13 @@ class ReplicaPuller:
         with urllib.request.urlopen(req, timeout=5) as r:
             payload = json.loads(r.read())
         applied = 0
-        with self._lock:
+        # the duplicate guard lives on the DATABASE, not the puller: during
+        # failover a signal-stopped predecessor puller (not joinable — the
+        # stopper may hold a lock its loop is blocked on) can race its last
+        # in-flight pull against the replacement puller on the same db, and
+        # per-puller applied_lsn alone would double-apply the overlap
+        dblock = self.db.__dict__.setdefault("_repl_lock", threading.Lock())
+        with self._lock, dblock:
             if "checkpoint" in payload:
                 # full sync: the delta range is gone (late-armed source or
                 # pruned archives) — restore the shipped checkpoint
@@ -191,16 +215,26 @@ class ReplicaPuller:
                     )
                 restore_payload(self.db, payload["checkpoint"])
                 self.applied_lsn = payload["checkpoint"].get("lsn", payload["lsn"])
+                self.db._repl_applied_lsn = self.applied_lsn
                 metrics.incr("replication.full_sync")
                 return 1
+            floor = max(
+                self.applied_lsn, getattr(self.db, "_repl_applied_lsn", 0)
+            )
             for e in payload["entries"]:
-                if e["lsn"] <= self.applied_lsn:
+                lsn = e["lsn"]
+                if lsn <= floor:
+                    # already in the db (possibly via the predecessor);
+                    # advance our cursor so the range isn't refetched
+                    if lsn > self.applied_lsn:
+                        self.applied_lsn = lsn
                     continue
                 # a failing entry must NOT be skipped: advancing past it
                 # would silently diverge the replica while reporting
                 # ONLINE — raise, count as a failure, retry next pull
                 _apply_entry(self.db, e)
-                self.applied_lsn = e["lsn"]
+                self.applied_lsn = floor = lsn
+                self.db._repl_applied_lsn = lsn
                 applied += 1
         if applied:
             metrics.incr("replication.applied", applied)

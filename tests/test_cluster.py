@@ -1,0 +1,165 @@
+"""Cluster failure detection and elastic failover (SURVEY §2
+"Distributed" / §5.3: membership status machine, failover reassigning
+ownership; redesigned as WAL-shipping replication + a coordinator that
+promotes the most-caught-up replica and repoints survivors)."""
+
+import time
+
+import pytest
+
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.server.server import Server
+
+
+def wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def trio():
+    """Primary + two replica servers, one coordinator."""
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("d")
+    cl = Cluster("d", user="admin", password="pw", interval=0.05, down_after=2)
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+def _caught_up(cl, names, lsn=None):
+    def ok():
+        st = cl.status()["members"]
+        for n in names:
+            m = st[n]
+            if m.get("status") != "ONLINE":
+                return False
+            if lsn is not None and m.get("applied_lsn", -1) < lsn:
+                return False
+        return True
+
+    return ok
+
+
+class TestClusterFailover:
+    def test_replicas_catch_up_and_status(self, trio):
+        cl, servers, pdb = trio
+        for i in range(5):
+            pdb.new_vertex("P", n=i)
+        lsn = pdb._wal.next_lsn - 1
+        assert wait_for(_caught_up(cl, ["n1", "n2"], lsn))
+        st = cl.status()
+        assert st["primary"] == "n0"
+        assert st["members"]["n0"]["role"] == "PRIMARY"
+        for n in ("n1", "n2"):
+            assert cl.members[n].db.count_class("P") == 5
+
+    def test_automatic_failover_promotes_and_repoints(self, trio):
+        cl, servers, pdb = trio
+        for i in range(4):
+            pdb.new_vertex("P", n=i)
+        lsn = pdb._wal.next_lsn - 1
+        assert wait_for(_caught_up(cl, ["n1", "n2"], lsn))
+        servers[0].shutdown()  # kill the primary
+        assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
+        st = cl.status()
+        new_primary = st["primary"]
+        assert st["members"]["n0"]["role"] == "DOWN"
+        assert st["failovers"] == 1
+        # the promoted database accepts writes and ships them onward
+        ndb = cl.primary_db()
+        ndb.new_vertex("P", n=99)
+        other = "n2" if new_primary == "n1" else "n1"
+        assert wait_for(
+            lambda: cl.members[other].db.count_class("P") == 5, timeout=10
+        )
+        ns = sorted(d["n"] for d in cl.members[other].db.browse_class("P"))
+        assert ns == [0, 1, 2, 3, 99]
+
+    def test_election_prefers_most_caught_up(self, trio):
+        cl, servers, pdb = trio
+        pdb.new_vertex("P", n=0)
+        assert wait_for(_caught_up(cl, ["n1", "n2"], pdb._wal.next_lsn - 1))
+        # freeze n1's puller so it lags the next writes
+        cl.members["n1"].puller._stop.set()
+        time.sleep(0.1)
+        for i in range(1, 6):
+            pdb.new_vertex("P", n=i)
+        assert wait_for(_caught_up(cl, ["n2"], pdb._wal.next_lsn - 1))
+        servers[0].shutdown()
+        assert wait_for(lambda: cl.status()["primary"] == "n2")
+        # the lagged replica was repointed; it lagged past the promoted
+        # base, so it rebuilt fresh and full-synced to convergence
+        def n1_converged():
+            try:
+                return cl.members["n1"].db.count_class("P") == 6
+            except ValueError:  # fresh rebuild: schema not synced yet
+                return False
+
+        assert wait_for(n1_converged, timeout=10)
+
+    def test_manual_promote(self, trio):
+        cl, servers, pdb = trio
+        pdb.new_vertex("P", n=1)
+        assert wait_for(_caught_up(cl, ["n1", "n2"], pdb._wal.next_lsn - 1))
+        cl.promote("n1")
+        assert cl.status()["primary"] == "n1"
+        ndb = cl.primary_db()
+        ndb.new_vertex("P", n=2)
+        assert wait_for(lambda: cl.members["n2"].db.count_class("P") == 2)
+
+    def test_caught_up_replica_continues_by_delta(self, trio):
+        """A replica exactly at the promoted base LSN must not full-sync
+        (exercises the _wal_base_exact_ok marker)."""
+        cl, servers, pdb = trio
+        for i in range(3):
+            pdb.new_vertex("P", n=i)
+        lsn = pdb._wal.next_lsn - 1
+        assert wait_for(_caught_up(cl, ["n1", "n2"], lsn))
+        from orientdb_tpu.utils.metrics import metrics
+
+        rebuilds = metrics.counter("cluster.replica_rebuild")
+        servers[0].shutdown()
+        assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
+        # both were caught up → the survivor repoints by delta, no rebuild
+        assert metrics.counter("cluster.replica_rebuild") == rebuilds
+        ndb = cl.primary_db()
+        ndb.new_vertex("P", n=50)
+        other = "n2" if cl.status()["primary"] == "n1" else "n1"
+        assert wait_for(
+            lambda: cl.members[other].db.count_class("P") == 4, timeout=10
+        )
+
+
+class TestClientFailover:
+    def test_multi_address_url_survives_primary_death(self, trio):
+        cl, servers, pdb = trio
+        pdb.new_vertex("P", n=7)
+        assert wait_for(_caught_up(cl, ["n1", "n2"], pdb._wal.next_lsn - 1))
+        from orientdb_tpu.client.remote import FailoverDatabase, connect
+
+        addrs = ";".join(f"127.0.0.1:{s.binary_port}" for s in servers)
+        cli = connect(f"remote:{addrs}/d", "admin", "pw")
+        assert isinstance(cli, FailoverDatabase)
+        assert cli.query("SELECT count(*) AS c FROM P").to_dicts() == [{"c": 1}]
+        servers[0].shutdown()
+        assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
+        # the same client object keeps working — channel failure rotates
+        # to a surviving member transparently
+        assert cli.query("SELECT count(*) AS c FROM P").to_dicts() == [{"c": 1}]
+        cli.close()
