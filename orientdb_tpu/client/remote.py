@@ -160,8 +160,11 @@ class FailoverDatabase:
                 # rotate: the reachable server becomes the head
                 self._addrs = self._addrs[i:] + self._addrs[:i]
                 return
-            except (OSError, RemoteError) as e:
-                last = e
+            except (OSError, RemoteConnectionError) as e:
+                last = e  # unreachable → try the next member
+            # a plain RemoteError is SERVER-REPORTED (bad credentials,
+            # unknown database) — trying other members can't fix it and
+            # would misreport an auth failure as a total outage
         raise RemoteError(f"no reachable server in {self._addrs}: {last}")
 
     def _retry(self, method: str, *a, idempotent: bool = True):

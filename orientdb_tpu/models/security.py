@@ -162,10 +162,22 @@ class SecurityManager:
 
     def authenticate(self, name: str, password: str) -> Optional[User]:
         audit = getattr(self, "audit", None)
-        u = self.users.get(name.lower())
-        if u is not None and u.check_password(password):
+        chain = getattr(self, "chain", None)
+        if chain is not None:
+            # pluggable authenticator chain (server/auth.py: password,
+            # token, LDAP import, Kerberos tickets — [E] the
+            # OSecurityAuthenticator chain)
+            u = chain.authenticate(self, name, password)
+        else:
+            u = self.users.get(name.lower())
+            if u is not None and not u.check_password(password):
+                u = None
+        if u is not None:
             if audit is not None:
-                audit.auth_ok(name)
+                # log the AUTHENTICATED identity — token/ticket logins
+                # pass an empty caller name and resolve it from the
+                # credential, and the audit trail needs attribution
+                audit.auth_ok(u.name)
             return u
         if audit is not None:
             audit.auth_fail(name)

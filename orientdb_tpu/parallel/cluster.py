@@ -31,7 +31,6 @@ tests use per SURVEY.md §4).
 
 from __future__ import annotations
 
-import tempfile
 import threading
 from typing import Dict, List, Optional
 
@@ -75,11 +74,7 @@ def arm_promoted_source(db: Database, applied_lsn: int) -> None:
     holds the base state (unlike the late-armed-source marker, where
     LSN 0 state is non-empty and a fresh replica needs the checkpoint).
     """
-    from orientdb_tpu.storage.durability import enable_durability
-
-    if db._wal is None:
-        d = tempfile.mkdtemp(prefix=f"promoted-{db.name}-")
-        enable_durability(db, d, fsync=False)
+    enable_replication_source(db)
     db._wal.next_lsn = max(db._wal.next_lsn, applied_lsn + 1)
     db._wal_base_lsn = applied_lsn
     db._wal_has_base = True
@@ -155,23 +150,31 @@ class Cluster:
             password=self.password,
             interval=self.interval,
             down_after=self.down_after,
-            on_source_down=lambda name=m.name: self._primary_down(name),
+            # the report names WHICH primary this puller was watching so a
+            # late report about an already-replaced primary can't demote
+            # its healthy successor
+            on_source_down=lambda name=m.name, watched=primary.name: (
+                self._primary_down(name, watched)
+            ),
         )
         m.puller.applied_lsn = applied_lsn
         m.puller.start()
 
     # -- failure handling ---------------------------------------------------
 
-    def _primary_down(self, reporter: str) -> None:
+    def _primary_down(self, reporter: str, watched: str) -> None:
         """A replica's failure detector collapsed the primary's status.
 
         First reporter wins the right to run the election; later reports
-        (other replicas noticing the same dead primary, or noise during
-        repoint) find the view already updated and return."""
+        about the SAME dead primary (``watched`` no longer the current
+        primary) find the view already updated and return — a stale
+        report must never demote the freshly promoted successor."""
         with self._lock:
             old = self.primary
-            if old is None or self.members[old].role != "PRIMARY":
-                return  # failover already ran
+            if old is None or old != watched:
+                return  # failover already ran; stale report
+            if self.members[old].role != "PRIMARY":
+                return
             live = self.members[old]
             live.role = "DOWN"
             metrics.incr("cluster.primary_down")

@@ -1,0 +1,263 @@
+"""Pluggable authentication: the authenticator-chain SPI.
+
+Analog of the reference's security module ([E] security/ — the
+``OSecurityAuthenticator`` SPI with its chain in ``ODefaultServerSecurity``,
+``OKerberosAuthenticator``, and the LDAP importer that materializes
+directory users into local accounts; SURVEY.md §2 "Security module
+(Kerberos/LDAP/audit)"). Redesign notes:
+
+- The chain is ordered; the first authenticator returning a user wins,
+  the rest are not consulted ([E] chain-of-responsibility semantics).
+- Real GSSAPI/Kerberos and a live LDAP client are deployment concerns
+  (no such libraries in this image); both authenticators here define the
+  SPI boundary — a *validator* / *directory* callable object — with
+  in-tree HMAC-ticket and in-memory-directory implementations that
+  exercise the full mapping logic (principal→user, group→role import).
+  A production GSSAPI validator or python-ldap directory drops into the
+  same slot.
+- Token auth doubles as the session-token system ([E] OTokenHandler):
+  HMAC-SHA256 over ``user|expiry`` with the server secret, honored by
+  the HTTP layer as ``Authorization: Bearer <token>``.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from orientdb_tpu.models.security import SecurityManager, User
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("auth")
+
+
+class Authenticator:
+    """SPI: return the authenticated User, or None to pass the request
+    down the chain ([E] OSecurityAuthenticator.authenticate)."""
+
+    name = "base"
+
+    def authenticate(
+        self, sec: SecurityManager, user: str, credential: str
+    ) -> Optional[User]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _pack(msg: bytes, sig: bytes) -> str:
+    """``b64url(msg).b64url(sig)`` — the separator lives OUTSIDE the
+    encodings (the b64url alphabet has no '.'), so a signature byte that
+    happens to be 0x2E can never corrupt the split."""
+    return (
+        base64.urlsafe_b64encode(msg).decode()
+        + "."
+        + base64.urlsafe_b64encode(sig).decode()
+    )
+
+
+def _unpack(token: str):
+    m, _, s = token.partition(".")
+    return base64.urlsafe_b64decode(m.encode()), base64.urlsafe_b64decode(
+        s.encode()
+    )
+
+
+class PasswordAuthenticator(Authenticator):
+    """Local user/password accounts — the default chain tail ([E]
+    ODatabaseSecurityAuthenticator)."""
+
+    name = "password"
+
+    def authenticate(self, sec, user, credential):
+        u = sec.users.get(user.lower())
+        if u is not None and u.check_password(credential):
+            return u
+        return None
+
+
+class TokenAuthenticator(Authenticator):
+    """HMAC session tokens ([E] OTokenHandlerImpl): ``issue()`` signs
+    ``user|expiry`` with the server secret; a token authenticates as that
+    user until expiry. Tamper or expiry → pass down the chain."""
+
+    name = "token"
+
+    def __init__(self, secret: Optional[bytes] = None, ttl: float = 3600.0):
+        self.secret = secret or os.urandom(32)
+        self.ttl = ttl
+
+    def issue(self, user: User, ttl: Optional[float] = None) -> str:
+        exp = int(time.time() + (self.ttl if ttl is None else ttl))
+        msg = f"{user.name}|{exp}".encode()
+        sig = hmac.new(self.secret, msg, hashlib.sha256).digest()
+        return _pack(msg, sig)
+
+    def authenticate(self, sec, user, credential):
+        # token carries the identity; `user` may be empty (Bearer header)
+        try:
+            msg, sig = _unpack(credential)
+            name, exp = msg.decode().split("|")
+        except Exception:
+            return None
+        want = hmac.new(self.secret, msg, hashlib.sha256).digest()
+        if not hmac.compare_digest(sig, want):
+            return None
+        if time.time() > int(exp):
+            return None
+        if user and user.lower() != name.lower():
+            return None
+        return sec.users.get(name.lower())
+
+
+class LdapAuthenticator(Authenticator):
+    """LDAP-shaped external authentication with user import.
+
+    ``directory`` is the SPI boundary: an object with
+    ``bind(user, password) -> bool`` and ``groups(user) -> List[str]``.
+    On a successful bind the directory user is IMPORTED: a local account
+    is created (or updated) with the roles mapped from its groups via
+    ``group_role_map`` — the [E] OLDAPImporter behavior, so permissions
+    keep flowing through the normal role machinery after login."""
+
+    name = "ldap"
+
+    def __init__(
+        self,
+        directory,
+        group_role_map: Optional[Dict[str, str]] = None,
+        default_roles: Optional[List[str]] = None,
+    ) -> None:
+        self.directory = directory
+        self.group_role_map = group_role_map or {}
+        self.default_roles = default_roles or ["reader"]
+
+    def _mapped_roles(self, sec: SecurityManager, user: str) -> List[str]:
+        roles = [
+            self.group_role_map[g]
+            for g in self.directory.groups(user)
+            if g in self.group_role_map and sec.get_role(self.group_role_map[g])
+        ]
+        return roles or list(self.default_roles)
+
+    def authenticate(self, sec, user, credential):
+        try:
+            if not self.directory.bind(user, credential):
+                return None
+        except Exception:
+            log.exception("LDAP directory bind failed")
+            return None
+        roles = self._mapped_roles(sec, user)
+        existing = sec.users.get(user.lower())
+        if existing is None:
+            # import: random local password — the directory remains the
+            # only way to authenticate this account
+            u = sec.create_user(
+                user, base64.b64encode(os.urandom(24)).decode(), roles
+            )
+            u.ldap_imported = True
+            log.info("imported LDAP user %s with roles %s", user, roles)
+            return u
+        if not getattr(existing, "ldap_imported", False):
+            # a pre-existing LOCAL account (admin, writer, …) is never
+            # hijacked by a same-named directory entry: the directory
+            # must not control local role assignments — pass down the
+            # chain so the local password remains the only way in
+            return None
+        existing.roles = [r for r in (sec.get_role(n) for n in roles) if r]
+        return existing
+
+
+class InMemoryDirectory:
+    """Directory test double (and smallest useful deployment shim)."""
+
+    def __init__(self, users: Dict[str, str], groups: Dict[str, List[str]]):
+        self._users = users
+        self._groups = groups
+
+    def bind(self, user: str, password: str) -> bool:
+        return self._users.get(user) == password
+
+    def groups(self, user: str) -> List[str]:
+        return self._groups.get(user, [])
+
+
+class KerberosAuthenticator(Authenticator):
+    """Kerberos-shaped ticket authentication ([E] OKerberosAuthenticator).
+
+    ``validator(ticket) -> principal | None`` is the SPI boundary (a
+    production deployment plugs a GSSAPI accept-sec-context there). The
+    principal's name part (``alice@REALM`` → ``alice``) must map to an
+    existing local user — Kerberos proves identity, roles stay local."""
+
+    name = "kerberos"
+
+    def __init__(self, validator: Callable[[str], Optional[str]]) -> None:
+        self.validator = validator
+
+    def authenticate(self, sec, user, credential):
+        try:
+            principal = self.validator(credential)
+        except Exception:
+            log.exception("kerberos validator failed")
+            return None
+        if principal is None:
+            return None
+        name = principal.split("@", 1)[0]
+        if user and user.lower() != name.lower():
+            return None
+        return sec.users.get(name.lower())
+
+
+def hmac_ticket_validator(secret: bytes, realm: str = "EXAMPLE.COM"):
+    """In-tree ticket validator double: ticket = b64(principal|exp|hmac).
+    Exercises the full accept→principal→user mapping without GSSAPI."""
+
+    def validate(ticket: str) -> Optional[str]:
+        try:
+            msg, sig = _unpack(ticket)
+            principal, exp = msg.decode().split("|")
+        except Exception:
+            return None
+        want = hmac.new(secret, msg, hashlib.sha256).digest()
+        if not hmac.compare_digest(sig, want) or time.time() > int(exp):
+            return None
+        if not principal.endswith("@" + realm):
+            return None
+        return principal
+
+    return validate
+
+
+def make_ticket(secret: bytes, principal: str, ttl: float = 300.0) -> str:
+    """Mint a ticket the `hmac_ticket_validator` accepts (test/KDC double)."""
+    msg = f"{principal}|{int(time.time() + ttl)}".encode()
+    sig = hmac.new(secret, msg, hashlib.sha256).digest()
+    return _pack(msg, sig)
+
+
+class AuthenticatorChain:
+    """Ordered chain; first authenticator returning a user wins."""
+
+    def __init__(self, authenticators: Optional[List[Authenticator]] = None):
+        self.authenticators: List[Authenticator] = authenticators or [
+            PasswordAuthenticator()
+        ]
+
+    def add(self, auth: Authenticator, first: bool = False) -> "AuthenticatorChain":
+        if first:
+            self.authenticators.insert(0, auth)
+        else:
+            self.authenticators.append(auth)
+        return self
+
+    def authenticate(
+        self, sec: SecurityManager, user: str, credential: str
+    ) -> Optional[User]:
+        for a in self.authenticators:
+            u = a.authenticate(sec, user, credential)
+            if u is not None:
+                return u
+        return None

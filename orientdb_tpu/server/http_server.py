@@ -75,6 +75,13 @@ class _Handler(BaseHTTPRequestHandler):
             u = self.server.ot_server.security.authenticate(user, pw)
             if u is not None:
                 return u
+        elif hdr.startswith("Bearer "):
+            # session tokens ([E] OTokenHandler): the credential carries
+            # the identity, so the user field is empty — only a chain
+            # with a TokenAuthenticator (server/auth.py) accepts these
+            u = self.server.ot_server.security.authenticate("", hdr[7:])
+            if u is not None:
+                return u
         self.send_response(401)
         self.send_header("WWW-Authenticate", 'Basic realm="orientdb-tpu"')
         self.send_header("Content-Length", "0")
@@ -99,10 +106,23 @@ class _Handler(BaseHTTPRequestHandler):
     # -- verbs --------------------------------------------------------------
 
     def do_GET(self):  # noqa: N802
+        head, rest = self._route()
+        if head in ("studio", ""):
+            # the Studio UI shell is public ([E] the studio webapp is
+            # served pre-login too); every data call it makes carries
+            # credentials and authenticates like any other client
+            from orientdb_tpu.server.studio import STUDIO_HTML
+
+            body = STUDIO_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         user = self._auth()
         if user is None:
             return
-        head, rest = self._route()
         try:
             if head == "listDatabases":
                 return self._send(

@@ -1,0 +1,167 @@
+"""Studio-lite: the embedded web UI.
+
+Analog of OrientDB Studio ([E] the separate studio webapp bundled into
+the server distribution and served under /studio; SURVEY.md §2 "Studio
+(web UI)"). Redesign: instead of a build-step SPA, one self-contained
+HTML page served by the REST listener, speaking the same REST endpoints
+every other client uses (listDatabases, database/<db>, query/<db>/sql,
+command/<db>/sql, metrics) with Basic credentials held client-side.
+Covers Studio's core workflows: connect, browse classes, run SQL/MATCH,
+inspect results, watch server metrics.
+"""
+
+STUDIO_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>orientdb-tpu studio</title>
+<style>
+  :root { --bg:#14161a; --panel:#1d2026; --line:#2c313a; --fg:#e6e8eb;
+          --dim:#9aa3af; --acc:#f0894d; }
+  * { box-sizing:border-box; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:14px/1.45 system-ui, sans-serif; }
+  header { display:flex; gap:8px; align-items:center; padding:10px 16px;
+           background:var(--panel); border-bottom:1px solid var(--line); }
+  header b { color:var(--acc); margin-right:8px; }
+  input, select, textarea, button {
+    background:var(--bg); color:var(--fg); border:1px solid var(--line);
+    border-radius:6px; padding:6px 8px; font:inherit; }
+  button { cursor:pointer; background:var(--acc); color:#14161a;
+           border:none; font-weight:600; }
+  main { display:grid; grid-template-columns: 230px 1fr; gap:0;
+         height:calc(100vh - 53px); }
+  #classes { border-right:1px solid var(--line); overflow:auto;
+             padding:10px; }
+  #classes .cls { padding:5px 8px; border-radius:6px; cursor:pointer;
+                  display:flex; justify-content:space-between; }
+  #classes .cls:hover { background:var(--panel); }
+  #classes .n { color:var(--dim); }
+  #work { display:flex; flex-direction:column; overflow:hidden; }
+  #sql { width:100%; height:90px; resize:vertical; font-family:monospace;
+         border-radius:0; border:none;
+         border-bottom:1px solid var(--line); }
+  #bar { display:flex; gap:8px; padding:8px; align-items:center; }
+  #status { color:var(--dim); }
+  #out { overflow:auto; flex:1; padding:0 8px 8px; }
+  table { border-collapse:collapse; width:100%; font-family:monospace;
+          font-size:13px; }
+  th, td { border:1px solid var(--line); padding:4px 8px; text-align:left;
+           max-width:420px; overflow:hidden; text-overflow:ellipsis;
+           white-space:nowrap; }
+  th { background:var(--panel); position:sticky; top:0; }
+  .err { color:#ef6a6a; padding:8px; font-family:monospace; }
+</style>
+</head>
+<body>
+<header>
+  <b>orientdb-tpu</b>
+  <input id="user" placeholder="user" value="admin" size="8">
+  <input id="pw" type="password" placeholder="password" size="10">
+  <select id="db"></select>
+  <button onclick="connect()">Connect</button>
+  <span id="status">not connected</span>
+  <span style="flex:1"></span>
+  <button onclick="showMetrics()" style="background:var(--panel);color:var(--fg)">Metrics</button>
+</header>
+<main>
+  <div id="classes"></div>
+  <div id="work">
+    <textarea id="sql" placeholder="MATCH {class:V, as:v} RETURN v.name LIMIT 20"></textarea>
+    <div id="bar">
+      <button onclick="run()">Run (Ctrl+Enter)</button>
+      <span id="status2" class="n"></span>
+    </div>
+    <div id="out"></div>
+  </div>
+</main>
+<script>
+let auth = null;
+const $ = id => document.getElementById(id);
+// every server-derived string passes through esc() before innerHTML —
+// stored property values, class/column names, and error text are all
+// user-controlled and must not execute in the operator's session
+const esc = s => String(s).replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+function hdrs() { return auth ? {"Authorization": "Basic " + auth} : {}; }
+async function api(path, opts) {
+  const r = await fetch(path, Object.assign({headers: hdrs()}, opts || {}));
+  if (!r.ok) throw new Error((await r.text()).slice(0, 500));
+  return r.json();
+}
+async function connect() {
+  auth = btoa($("user").value + ":" + $("pw").value);
+  try {
+    const d = await api("/listDatabases");
+    const sel = $("db"), cur = sel.value;
+    sel.innerHTML = d.databases.map(n => `<option>${esc(n)}</option>`).join("");
+    if (d.databases.includes(cur)) sel.value = cur;
+    $("status").textContent = "connected (" + d.databases.length + " dbs)";
+    loadClasses();
+  } catch (e) { $("status").textContent = "auth failed"; auth = null; }
+}
+async function loadClasses() {
+  if (!$("db").value) { $("classes").innerHTML = ""; return; }
+  const d = await api("/database/" + encodeURIComponent($("db").value));
+  // class names ride in a data attribute read back via dataset — no
+  // inline-handler string interpolation to break out of
+  $("classes").innerHTML = d.classes
+    .sort((a, b) => a.name.localeCompare(b.name))
+    .map(c => `<div class="cls" data-cls="${esc(c.name)}">` +
+              `<span>${esc(c.name)}</span>` +
+              `<span class="n">${esc(c.records)}</span></div>`)
+    .join("");
+}
+$("classes").addEventListener("click", e => {
+  const el = e.target.closest(".cls");
+  if (el) browse(el.dataset.cls);
+});
+function browse(cls) {
+  $("sql").value = "SELECT FROM `" + cls + "` LIMIT 30";
+  run();
+}
+function render(rows) {
+  if (!rows.length) { $("out").innerHTML = '<p class="n">0 rows</p>'; return; }
+  const cols = [...new Set(rows.flatMap(r => Object.keys(r)))];
+  $("out").innerHTML = "<table><tr>" +
+    cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c =>
+      `<td>${r[c] === null || r[c] === undefined ? "" : esc(JSON.stringify(r[c]))}</td>`
+    ).join("") + "</tr>").join("") + "</table>";
+}
+async function run() {
+  const sql = $("sql").value.trim(), db = $("db").value;
+  if (!sql || !db) return;
+  const t0 = performance.now();
+  $("status2").textContent = "running…";
+  try {
+    const d = await api(
+      "/command/" + encodeURIComponent(db) + "/sql",
+      {method: "POST", body: JSON.stringify({command: sql})});
+    render(d.result || []);
+    $("status2").textContent = (d.result || []).length + " rows in " +
+      Math.round(performance.now() - t0) + " ms";
+    loadClasses();
+  } catch (e) {
+    $("out").innerHTML = `<div class="err">${esc(e.message)}</div>`;
+    $("status2").textContent = "error";
+  }
+}
+async function showMetrics() {
+  const d = await api("/metrics");
+  const rows = Object.entries(d.counters || {})
+    .map(([k, v]) => ({metric: k, value: v}))
+    .concat(Object.entries(d.durations || {}).map(([k, v]) =>
+      ({metric: k, value: v.count + "x, total " +
+        (v.total_s * 1000).toFixed(1) + " ms"})));
+  render(rows);
+  $("status2").textContent = "server metrics";
+}
+$("sql").addEventListener("keydown", e => {
+  if (e.key === "Enter" && (e.ctrlKey || e.metaKey)) { e.preventDefault(); run(); }
+});
+$("db").addEventListener("change", loadClasses);
+</script>
+</body>
+</html>
+"""

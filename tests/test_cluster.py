@@ -163,3 +163,20 @@ class TestClientFailover:
         # to a surviving member transparently
         assert cli.query("SELECT count(*) AS c FROM P").to_dicts() == [{"c": 1}]
         cli.close()
+
+
+class TestStaleReports:
+    def test_late_report_about_old_primary_cannot_demote_successor(self, trio):
+        cl, servers, pdb = trio
+        pdb.new_vertex("P", n=1)
+        assert wait_for(_caught_up(cl, ["n1", "n2"], pdb._wal.next_lsn - 1))
+        servers[0].shutdown()
+        assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
+        promoted = cl.status()["primary"]
+        # a sibling's detector fires late, still naming the DEAD primary:
+        # the stale report must be ignored, not demote the successor
+        cl._primary_down("n1" if promoted == "n2" else "n2", watched="n0")
+        st = cl.status()
+        assert st["primary"] == promoted
+        assert st["failovers"] == 1
+        assert st["members"][promoted]["role"] == "PRIMARY"

@@ -211,3 +211,16 @@ class TestPlugin:
         srv.startup()
         srv.shutdown()
         assert [c[0] for c in calls] == ["config", "startup", "shutdown"]
+
+
+class TestStudio:
+    def test_studio_shell_public_data_calls_authenticated(self, server):
+        # the UI shell serves without credentials (it carries no data)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.http_port}/studio"
+        ) as resp:
+            assert resp.status == 200
+            assert b"orientdb-tpu studio" in resp.read()
+        # the API it calls still requires auth
+        with pytest.raises(urllib.error.HTTPError):
+            http(server, "GET", "/listDatabases", user="nobody", pw="x")
