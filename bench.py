@@ -86,13 +86,18 @@ def main() -> None:
         "TRAVERSE out('HasFriend') FROM (SELECT FROM Profiles WHERE uid < 50) "
         "WHILE $depth < 2 STRATEGY BREADTH_FIRST"
     )
+    # SELECT compiled via the single-node-MATCH rewrite (BASELINE config
+    # #3's read mix is SELECT-shaped; SURVEY.md §2 "SQL execution planner")
+    sql_select = (
+        "SELECT count(*) AS n FROM Profiles WHERE age > 35 AND age < 55"
+    )
 
     def run(engine, q=sql):
         return db.query(q, engine=engine, strict=(engine == "tpu")).to_dicts()
 
     # parity gates before timing (result-set parity is part of the metric);
     # TRAVERSE rows are records, so canon compares @rid dicts
-    for q in (sql, sql_rows, sql_var, sql_trav):
+    for q in (sql, sql_rows, sql_var, sql_trav, sql_select):
         if canon(run("tpu", q)) != canon(run("oracle", q)):
             print(
                 json.dumps(
@@ -133,6 +138,7 @@ def main() -> None:
     rows_qps = time_batched(sql_rows)
     var_qps = time_batched(sql_var)
     trav_qps = time_batched(sql_trav)
+    select_qps = time_batched(sql_select)
 
     # LDBC SNB interactive short reads (IS1–IS7) on an SF1-shaped graph
     snb_persons = int(os.environ.get("BENCH_SNB_PERSONS", "10000"))
@@ -205,6 +211,7 @@ def main() -> None:
                     "rows_1hop_batched_qps": round(rows_qps, 3),
                     "var_depth_while_batched_qps": round(var_qps, 3),
                     "traverse_bfs_batched_qps": round(trav_qps, 3),
+                    "select_count_batched_qps": round(select_qps, 3),
                     "ldbc_is": ldbc_is,
                     "snb_persons": snb_persons,
                     "oracle_2hop_qps": round(oracle_qps, 4),

@@ -69,7 +69,10 @@ def _choose_engine(db, stmt: A.Statement, engine: Optional[str]) -> str:
         if (
             db.tx is None
             and db.current_snapshot(require_fresh=True) is not None
-            and isinstance(stmt, (A.MatchStatement, A.TraverseStatement))
+            and isinstance(
+                stmt,
+                (A.MatchStatement, A.TraverseStatement, A.SelectStatement),
+            )
         ):
             return "tpu"
         return "oracle"

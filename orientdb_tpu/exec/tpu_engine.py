@@ -408,10 +408,19 @@ def build_bitmap_hops(dg: DeviceGraph, items) -> List:
 
 
 class TpuMatchSolver:
-    def __init__(self, db, stmt: A.MatchStatement, params: Dict) -> None:
+    def __init__(
+        self,
+        db,
+        stmt: A.MatchStatement,
+        params: Dict,
+        element_alias: Optional[str] = None,
+    ) -> None:
         self.db = db
         self.stmt = stmt
         self.params = params
+        #: set for rewritten whole-record SELECTs (select_compile): rows
+        #: unwrap from {alias: doc} props back into element rows
+        self.element_alias = element_alias
         # numeric parameters compile to reads of this box so one cached
         # plan replays for any value (predicates.ParamBox)
         self.param_box = ParamBox(params)
@@ -1721,7 +1730,7 @@ class TpuMatchSolver:
         named = [
             n.alias for n in self.pattern.nodes.values() if not n.anonymous
         ]
-        return match_rows_from_bindings(
+        rows = match_rows_from_bindings(
             self.db,
             self.stmt,
             named,
@@ -1729,6 +1738,14 @@ class TpuMatchSolver:
             params,
             None,
         )
+        if self.element_alias is not None:
+            # rewritten whole-record SELECT: the finalize tail (ORDER/
+            # SKIP/LIMIT) ran on the props rows; unwrap to element rows
+            rows = [
+                Result(element=r.get_property(self.element_alias))
+                for r in rows
+            ]
+        return rows
 
     # -- columnar fast RETURN path -----------------------------------------
 
@@ -2073,10 +2090,17 @@ def drain_warmups() -> None:
 
     Benchmarks and tests call this between warm-up and measurement so AOT
     compile threads (which hold the GIL through long trace phases) don't
-    steal host time from the timed section."""
+    steal host time from the timed section. Also registered atexit:
+    killing a daemon thread inside an XLA compile at interpreter teardown
+    aborts the process ("FATAL: exception not rethrown")."""
     pending, _AotWarmup._inflight = _AotWarmup._inflight, []
     for ev in pending:
         ev.wait()
+
+
+import atexit  # noqa: E402  (registration belongs right next to the drain)
+
+atexit.register(drain_warmups)
 
 
 class _CompiledTraverse(_AotWarmup):
@@ -2309,9 +2333,11 @@ def _all_values_key(params) -> Optional[Tuple]:
 
 
 def _cache_key(stmt, params) -> Optional[Tuple]:
+    # MATCH and (rewritten) SELECT plans are parameter-generic; TRAVERSE
+    # bakes parameter values into the plan
     pk = (
         _params_key(params)
-        if isinstance(stmt, A.MatchStatement)
+        if isinstance(stmt, (A.MatchStatement, A.SelectStatement))
         else _all_values_key(params)
     )
     if pk is None:
@@ -2324,11 +2350,46 @@ def _cache_key(stmt, params) -> Optional[Tuple]:
         return None
 
 
+#: statements whose SELECT→MATCH translation failed; the verdict is
+#: parameter-independent, so auto-routed workloads of permanently
+#: ineligible shapes (rid lookups, SELECT *, LET) fail fast instead of
+#: re-deriving the rejection (plus a plan-cache miss) on every query
+_NEG_TRANSLATE: "OrderedDict" = OrderedDict()
+_NEG_TRANSLATE_MAX = 512
+
+
+def _translate(stmt):
+    """SELECT compiles by rewriting to a single-node MATCH
+    (select_compile); MATCH/TRAVERSE pass through."""
+    if isinstance(stmt, A.SelectStatement):
+        try:
+            hashable = True
+            reason = _NEG_TRANSLATE.get(stmt)
+        except TypeError:  # statement holds an unhashable literal
+            hashable = False
+            reason = None
+        if reason is not None:
+            _NEG_TRANSLATE.move_to_end(stmt)
+            raise Uncompilable(reason)
+        from orientdb_tpu.exec.select_compile import rewrite_select
+
+        try:
+            return rewrite_select(stmt)
+        except Uncompilable as e:
+            if hashable:
+                while len(_NEG_TRANSLATE) >= _NEG_TRANSLATE_MAX:
+                    _NEG_TRANSLATE.popitem(last=False)
+                _NEG_TRANSLATE[stmt] = str(e)
+            raise
+    return stmt, None
+
+
 def _record(db, stmt, params):
     """Recording first execution: eager solve with blocking size observes.
     Returns (plan, rows)."""
+    stmt, element_alias = _translate(stmt)
     if isinstance(stmt, A.MatchStatement):
-        solver = TpuMatchSolver(db, stmt, params)
+        solver = TpuMatchSolver(db, stmt, params, element_alias=element_alias)
         table = solver.solve_table()
         rows = solver.rows_from_table(table)
         return _CompiledPlan(solver, table), rows
@@ -2346,8 +2407,14 @@ def _prepare(db, stmt, params):
     ``(None, rows, plan)`` when this call WAS the recording first
     execution (`plan` is the freshly cached plan with its background AOT
     warm-up started, or None when the statement was uncacheable)."""
-    if not isinstance(stmt, (A.MatchStatement, A.TraverseStatement)):
+    if not isinstance(
+        stmt, (A.MatchStatement, A.TraverseStatement, A.SelectStatement)
+    ):
         raise Uncompilable(f"{type(stmt).__name__} has no TPU compilation")
+    if isinstance(stmt, A.SelectStatement):
+        # fail fast on ineligible SELECT shapes BEFORE the miss metric —
+        # the negative cache makes repeat rejections O(1)
+        _translate(stmt)
     params = params or {}
     snap = db.current_snapshot(require_fresh=True)
     if snap is None:

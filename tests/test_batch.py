@@ -54,17 +54,11 @@ class TestQueryBatch:
         assert "n" in rss[2].to_dicts()[0]
 
     def test_batch_uncompilable_falls_back_to_oracle(self, sdb):
-        # SELECT has no TPU compilation → per-item oracle fallback
-        sqls = [MATCH_COUNT, "SELECT name FROM Profiles ORDER BY name"]
+        # graph functions in SELECT are not compiled → per-item fallback
+        sqls = [MATCH_COUNT, "SELECT out('HasFriend').size() AS d FROM Profiles"]
         rss = sdb.query_batch(sqls)
         assert rss[0].to_dicts()[0]["n"] == 6
-        assert [r["name"] for r in rss[1].to_dicts()] == [
-            "alice",
-            "bob",
-            "carol",
-            "dave",
-            "eve",
-        ]
+        assert sorted(r["d"] for r in rss[1].to_dicts()) == [1, 1, 1, 1, 2]
         assert rss[1].engine == "oracle"
 
     def test_batch_strict_raises_on_uncompilable(self, sdb):
@@ -72,7 +66,7 @@ class TestQueryBatch:
 
         with pytest.raises(Uncompilable):
             sdb.query_batch(
-                ["SELECT FROM Profiles"], engine="tpu", strict=True
+                ["SELECT out('HasFriend') FROM Profiles"], engine="tpu", strict=True
             )
 
     def test_batch_rejects_writes(self, sdb):

@@ -1,0 +1,173 @@
+"""SELECT compilation to the TPU path via the single-node-MATCH rewrite
+(exec/select_compile.py; SURVEY §2 "SQL execution planner" — the [E]
+OSelectExecutionPlanner role, redesigned as statement translation onto
+the compiled MATCH engine)."""
+
+import pytest
+
+from orientdb_tpu.storage.ingest import generate_demodb
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+
+def canon(rows):
+    return sorted(tuple(sorted((k, str(v)) for k, v in r.items())) for r in rows)
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = generate_demodb(n_profiles=400, avg_friends=5, seed=11)
+    attach_fresh_snapshot(d)
+    return d
+
+
+PARITY_QUERIES = [
+    "SELECT name, age FROM Profiles WHERE age > 40",
+    "SELECT count(*) AS n FROM Profiles WHERE age < 30",
+    "SELECT count(*) AS n FROM Profiles",
+    "SELECT name AS nm FROM Profiles WHERE age >= 25 AND age <= 30 "
+    "ORDER BY nm DESC LIMIT 5",
+    "SELECT FROM Profiles WHERE age = 33",
+    "SELECT FROM Profiles WHERE uid < 20 ORDER BY age DESC, uid ASC LIMIT 7",
+    "SELECT FROM Profiles WHERE uid < 30 ORDER BY uid SKIP 5 LIMIT 10",
+    "SELECT max(age) AS m, min(age) AS mi, count(*) AS c "
+    "FROM Profiles WHERE uid < 100",
+    "SELECT age, count(*) AS c FROM Profiles WHERE uid < 200 "
+    "GROUP BY age ORDER BY c DESC, age ASC LIMIT 3",
+    "SELECT name FROM Profiles WHERE name = 'p17'",
+    "SELECT DISTINCT age FROM Profiles WHERE age > 55 ORDER BY age",
+    "SELECT name FROM Profiles WHERE age > 30 AND (uid < 50 OR uid > 350)",
+    "SELECT uid FROM Profiles WHERE NOT (age < 50) ORDER BY uid LIMIT 4",
+]
+
+
+class TestSelectParity:
+    @pytest.mark.parametrize("q", PARITY_QUERIES)
+    def test_parity(self, db, q):
+        want = db.query(q, engine="oracle").to_dicts()
+        got = db.query(q, engine="tpu", strict=True).to_dicts()
+        if "ORDER BY" in q:
+            assert got == want
+        else:
+            assert canon(got) == canon(want)
+
+    def test_whole_record_rows_are_elements(self, db):
+        rs = db.query(
+            "SELECT FROM Profiles WHERE uid = 7", engine="tpu", strict=True
+        )
+        rows = rs.to_list()
+        assert len(rows) == 1 and rows[0].is_element
+        assert rows[0].element["uid"] == 7
+
+    def test_parameterized_plan_reuse(self, db):
+        from orientdb_tpu.utils.metrics import metrics
+
+        q = "SELECT count(*) AS n FROM Profiles WHERE age > :a"
+        db.query(q, params={"a": 30}, engine="tpu", strict=True)
+        misses = metrics.counter("plan_cache.miss")
+        for a in (20, 45, 60):
+            got = db.query(q, params={"a": a}, engine="tpu", strict=True).to_dicts()
+            want = db.query(q, params={"a": a}, engine="oracle").to_dicts()
+            assert got == want
+        # parameter values replay the cached plan, no re-record
+        assert metrics.counter("plan_cache.miss") == misses
+
+    def test_auto_routing_uses_tpu(self, db):
+        rs = db.query("SELECT count(*) AS n FROM Profiles WHERE age > 50")
+        assert rs.engine == "tpu"
+
+    def test_batch_mixes_select_and_match(self, db):
+        qs = [
+            "SELECT count(*) AS n FROM Profiles WHERE age > 40",
+            "MATCH {class:Profiles, as:p}-HasFriend->{as:f} RETURN count(*) AS n",
+            "SELECT name FROM Profiles WHERE uid = 3",
+        ]
+        rss = db.query_batch(qs, engine="tpu", strict=True)
+        for q, rs in zip(qs, rss):
+            assert canon(rs.to_dicts()) == canon(
+                db.query(q, engine="oracle").to_dicts()
+            )
+
+
+class TestSelectFallback:
+    """Ineligible shapes must fall back to the oracle, not misbehave."""
+
+    FALLBACK_QUERIES = [
+        "SELECT out('HasFriend').size() AS d FROM Profiles WHERE uid = 1",
+        "SELECT * FROM Profiles WHERE uid = 1",
+        "SELECT FROM #10:0",
+        "SELECT name FROM Profiles LET $x = age WHERE uid < 5",
+        "SELECT name FROM Profiles WHERE uid < 5 ORDER BY age",
+    ]
+
+    @pytest.mark.parametrize("q", FALLBACK_QUERIES)
+    def test_uncompilable_falls_back(self, db, q):
+        from orientdb_tpu.ops.predicates import Uncompilable
+
+        with pytest.raises(Uncompilable):
+            db.query(q, engine="tpu", strict=True)
+        # non-strict: oracle fallback answers it
+        rs = db.query(q, engine="tpu")
+        assert rs.engine == "oracle"
+        assert canon(rs.to_dicts()) == canon(
+            db.query(q, engine="oracle").to_dicts()
+        )
+
+
+class TestSelectRandomizedParity:
+    def test_random_predicates(self, db):
+        import random
+
+        rng = random.Random(5)
+        fields = ["age", "uid"]
+        ops = [">", "<", ">=", "<=", "=", "<>"]
+        for _ in range(25):
+            f1, f2 = rng.choice(fields), rng.choice(fields)
+            q = (
+                f"SELECT name, {f1} FROM Profiles WHERE "
+                f"{f1} {rng.choice(ops)} {rng.randrange(0, 60)} "
+                f"{'AND' if rng.random() < 0.5 else 'OR'} "
+                f"{f2} {rng.choice(ops)} {rng.randrange(0, 300)}"
+            )
+            want = db.query(q, engine="oracle").to_dicts()
+            got = db.query(q, engine="tpu", strict=True).to_dicts()
+            assert canon(got) == canon(want), q
+
+
+class TestReviewRegressions:
+    def test_order_by_expression_not_silently_dropped(self, db):
+        from orientdb_tpu.ops.predicates import Uncompilable
+
+        q = "SELECT name FROM Profiles WHERE uid < 10 ORDER BY abs(age) DESC LIMIT 5"
+        with pytest.raises(Uncompilable):
+            db.query(q, engine="tpu", strict=True)
+        rs = db.query(q, engine="tpu")  # falls back with correct ordering
+        assert rs.engine == "oracle"
+        assert rs.to_dicts() == db.query(q, engine="oracle").to_dicts()
+
+    def test_order_by_expression_over_projected_column_compiles(self, db):
+        q = (
+            "SELECT name, age FROM Profiles WHERE uid < 10 "
+            "ORDER BY age DESC, name ASC LIMIT 5"
+        )
+        got = db.query(q, engine="tpu", strict=True).to_dicts()
+        assert got == db.query(q, engine="oracle").to_dicts()
+
+    def test_element_mode_group_by_falls_back(self, db):
+        from orientdb_tpu.ops.predicates import Uncompilable
+
+        q = "SELECT FROM Profiles GROUP BY age"
+        with pytest.raises(Uncompilable):
+            db.query(q, engine="tpu", strict=True)
+        rs = db.query(q, engine="tpu")
+        assert rs.engine == "oracle"
+
+    def test_ineligible_shape_negative_cached(self, db):
+        from orientdb_tpu.utils.metrics import metrics
+
+        q = "SELECT FROM #10:0"
+        db.query(q, engine="tpu")  # first attempt records the verdict
+        misses = metrics.counter("plan_cache.miss")
+        for _ in range(5):
+            db.query(q, engine="tpu")
+        # repeat rejections are O(1): no plan-cache misses accrue
+        assert metrics.counter("plan_cache.miss") == misses
