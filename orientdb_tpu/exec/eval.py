@@ -320,6 +320,17 @@ def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
         return vals
     if name == "map":
         return {str(args[i]): args[i + 1] for i in range(0, len(args) - 1, 2)}
+    if name == "distance":
+        # [E] OSQLFunctionDistance: haversine over (lat1, lon1, lat2,
+        # lon2); optional unit 'km'|'mi' (constants in utils/geo.py)
+        from orientdb_tpu.utils.geo import MILE_UNITS, MILES_PER_KM, haversine_km
+
+        if len(args) < 4 or any(not _numeric(a) for a in args[:4]):
+            return None
+        d = haversine_km(*args[:4])
+        if len(args) > 4 and str(args[4]).lower() in MILE_UNITS:
+            d *= MILES_PER_KM
+        return d
     if name in _MATH_FNS:
         return None if args[0] is None else _MATH_FNS[name](args[0])
     if name == "date":

@@ -158,6 +158,57 @@ def _const_operand(expr: A.Expression, ctx: EvalContext):
 _FLIP_OP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
 
 
+def _spatial_probe(db, class_name, fn, rhs, op, ctx):
+    """Candidate RIDs for a ``distance(latF, lngF, <x>, <y>[, unit]) < r``
+    conjunct via a SPATIAL grid index ([E] the lucene-spatial
+    within-distance query; SURVEY.md §2 "Lucene"). Returns a SUPERSET —
+    the caller still row-filters with the full WHERE — or None when the
+    shape/index doesn't apply."""
+    if (
+        fn.name.lower() != "distance"
+        or len(fn.args) < 4
+        or op not in ("<", "<=")
+        or db._indexes is None
+    ):
+        return None
+    a0, a1 = fn.args[0], fn.args[1]
+    if not (isinstance(a0, A.Identifier) and isinstance(a1, A.Identifier)):
+        return None
+    ok_r, r = _const_operand(rhs, ctx)
+    ok_lat, latv = _const_operand(fn.args[2], ctx)
+    ok_lng, lngv = _const_operand(fn.args[3], ctx)
+
+    def num(v):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    if not (ok_r and ok_lat and ok_lng and num(r) and num(latv) and num(lngv)):
+        return None
+    if len(fn.args) > 4:
+        from orientdb_tpu.utils.geo import MILE_UNITS, MILES_PER_KM
+
+        u = fn.args[4]
+        if not isinstance(u, A.Literal):
+            return None
+        unit = str(u.value).lower()
+        if unit in MILE_UNITS:
+            r = float(r) / MILES_PER_KM
+        elif unit != "km":
+            return None
+    from orientdb_tpu.models.indexes import SpatialIndex
+
+    cls = db.schema.get_class(class_name)
+    if cls is None:
+        return None
+    for idx in db._indexes.all():
+        if (
+            isinstance(idx, SpatialIndex)
+            and idx.fields == [a0.name, a1.name]
+            and cls.is_subclass_of(idx.class_name)
+        ):
+            return idx.near(float(latv), float(lngv), float(r))
+    return None
+
+
 def index_lookup_rids(db, class_name: str, where: A.Expression, ctx: EvalContext):
     """RIDs satisfying ONE indexable conjunct of ``where``, or None when no
     single-field index applies. The caller still evaluates the FULL WHERE
@@ -199,6 +250,19 @@ def index_lookup_rids(db, class_name: str, where: A.Expression, ctx: EvalContext
             return None  # mixed-type keys: leave it to the row filter
 
     if isinstance(where, A.Binary) and where.op in _FLIP_OP:
+        if isinstance(where.left, A.FunctionCall):
+            return _spatial_probe(
+                db, class_name, where.left, where.right, where.op, ctx
+            )
+        if isinstance(where.right, A.FunctionCall):
+            return _spatial_probe(
+                db,
+                class_name,
+                where.right,
+                where.left,
+                _FLIP_OP[where.op],
+                ctx,
+            )
         return probe(where.left, where.right, where.op) if isinstance(
             where.left, A.Identifier
         ) else probe(where.right, where.left, _FLIP_OP[where.op])

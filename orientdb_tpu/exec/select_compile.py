@@ -63,16 +63,26 @@ def _rewrite_expr(e: A.Expression) -> A.Expression:
                 nv = _rewrite_expr(v)
                 if nv is not v:
                     changes[f.name] = nv
-            elif isinstance(v, tuple) and any(
-                isinstance(x, A.Expression) for x in v
-            ):
-                changes[f.name] = tuple(
-                    _rewrite_expr(x) if isinstance(x, A.Expression) else x
-                    for x in v
-                )
+            elif isinstance(v, tuple):
+                # recurse through NESTED tuples too — map literals hold
+                # (key, Expression) pairs a flat scan would miss
+                nv = _rewrite_tuple(v)
+                if nv != v:
+                    changes[f.name] = nv
         if changes:
             return dataclasses.replace(e, **changes)
     return e
+
+
+def _rewrite_tuple(v: tuple) -> tuple:
+    return tuple(
+        _rewrite_expr(x)
+        if isinstance(x, A.Expression)
+        else _rewrite_tuple(x)
+        if isinstance(x, tuple)
+        else x
+        for x in v
+    )
 
 
 def rewrite_select(
@@ -170,6 +180,12 @@ def _check_order_resolvable(e: A.Expression, projected) -> None:
             if isinstance(v, A.Expression):
                 _check_order_resolvable(v, projected)
             elif isinstance(v, tuple):
-                for x in v:
-                    if isinstance(x, A.Expression):
-                        _check_order_resolvable(x, projected)
+                _check_order_tuple(v, projected)
+
+
+def _check_order_tuple(v: tuple, projected) -> None:
+    for x in v:
+        if isinstance(x, A.Expression):
+            _check_order_resolvable(x, projected)
+        elif isinstance(x, tuple):
+            _check_order_tuple(x, projected)

@@ -2350,12 +2350,13 @@ def _cache_key(stmt, params) -> Optional[Tuple]:
         return None
 
 
-#: statements whose SELECT→MATCH translation failed; the verdict is
-#: parameter-independent, so auto-routed workloads of permanently
-#: ineligible shapes (rid lookups, SELECT *, LET) fail fast instead of
-#: re-deriving the rejection (plus a plan-cache miss) on every query
-_NEG_TRANSLATE: "OrderedDict" = OrderedDict()
-_NEG_TRANSLATE_MAX = 512
+#: SELECT→MATCH translation verdicts, keyed by statement (translation is
+#: parameter-independent). Positive entries skip re-deriving the rewrite
+#: on every cache-hit replay; negative entries (the Uncompilable reason)
+#: make auto-routed workloads of permanently ineligible shapes (rid
+#: lookups, SELECT *, LET) fail fast instead of re-rejecting per query.
+_TRANSLATE_CACHE: "OrderedDict" = OrderedDict()
+_TRANSLATE_CACHE_MAX = 512
 
 
 def _translate(stmt):
@@ -2364,24 +2365,33 @@ def _translate(stmt):
     if isinstance(stmt, A.SelectStatement):
         try:
             hashable = True
-            reason = _NEG_TRANSLATE.get(stmt)
+            verdict = _TRANSLATE_CACHE.get(stmt)
         except TypeError:  # statement holds an unhashable literal
             hashable = False
-            reason = None
-        if reason is not None:
-            _NEG_TRANSLATE.move_to_end(stmt)
-            raise Uncompilable(reason)
+            verdict = None
+        if verdict is not None:
+            _TRANSLATE_CACHE.move_to_end(stmt)
+            if isinstance(verdict, str):
+                raise Uncompilable(verdict)
+            return verdict
         from orientdb_tpu.exec.select_compile import rewrite_select
 
         try:
-            return rewrite_select(stmt)
+            out = rewrite_select(stmt)
         except Uncompilable as e:
             if hashable:
-                while len(_NEG_TRANSLATE) >= _NEG_TRANSLATE_MAX:
-                    _NEG_TRANSLATE.popitem(last=False)
-                _NEG_TRANSLATE[stmt] = str(e)
+                _translate_remember(stmt, str(e))
             raise
+        if hashable:
+            _translate_remember(stmt, out)
+        return out
     return stmt, None
+
+
+def _translate_remember(stmt, verdict) -> None:
+    while len(_TRANSLATE_CACHE) >= _TRANSLATE_CACHE_MAX:
+        _TRANSLATE_CACHE.popitem(last=False)
+    _TRANSLATE_CACHE[stmt] = verdict
 
 
 def _record(db, stmt, params):

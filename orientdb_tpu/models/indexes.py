@@ -13,6 +13,7 @@ path, uniqueness constraints, and the host executor's index-scan steps.
 from __future__ import annotations
 
 import bisect
+import math
 from typing import Dict, Iterator, List, Optional, Set, Tuple, TYPE_CHECKING
 
 from orientdb_tpu.models.rid import RID
@@ -228,6 +229,110 @@ class FullTextIndex(Index):
         return out
 
 
+class SpatialIndex(Index):
+    """Geo point index over a (latitude, longitude) field pair — the
+    spatial half of the reference's Lucene module ([E] lucene/
+    ``OLuceneSpatialIndex`` over point shapes; SURVEY.md §2 "Lucene").
+
+    Redesign: instead of an embedded Lucene/JTS engine, a 1°×1° hash
+    grid — each record hashes to the cell containing its point, and
+    :meth:`near` returns the union of every cell a great-circle radius
+    can touch (longitude wraps across the antimeridian; a radius
+    reaching past a pole widens to all longitudes). The result is a
+    SUPERSET of the true matches, which is exactly the contract the
+    planner's index pruning needs: rows are still filtered by the full
+    WHERE (``distance(lat, lng, :x, :y) < r``), on device when the
+    query compiles, so the grid only shrinks the scanned set."""
+
+    CELL = 1.0  # degrees per grid cell
+    #: km→degree conversion for the COVERING range: deliberately below
+    #: the smallest real degree of latitude (~110.57 km) so the cell
+    #: range always overcovers — `near` must stay a superset
+    KM_PER_DEG = 110.0
+
+    def __init__(self, name, class_name, fields):
+        if len(fields) != 2:
+            raise ValueError("SPATIAL index needs exactly (lat, lng) fields")
+        self.name = name
+        self.class_name = class_name
+        self.fields = list(fields)
+        self.type = "SPATIAL"
+        self._map = {}
+        self._reverse = {}
+        self._sorted_keys = []
+
+    @property
+    def unique(self) -> bool:
+        return False
+
+    @property
+    def range_capable(self) -> bool:
+        return False
+
+    def _cell(self, lat: float, lng: float) -> Tuple[int, int]:
+        lat = max(-90.0, min(90.0, float(lat)))
+        lng = ((float(lng) + 180.0) % 360.0) - 180.0
+        return (
+            int(math.floor(lat / self.CELL)),
+            int(math.floor(lng / self.CELL)),
+        )
+
+    def index_doc(self, doc: Document) -> None:
+        lat, lng = doc.get(self.fields[0]), doc.get(self.fields[1])
+        if not isinstance(lat, (int, float)) or not isinstance(lng, (int, float)):
+            return
+        cell = self._cell(lat, lng)
+        self._map.setdefault(cell, set()).add(doc.rid)
+        self._reverse[doc.rid] = cell
+
+    def unindex_doc(self, rid: RID) -> None:
+        cell = self._reverse.pop(rid, None)
+        if cell is None:
+            return
+        bucket = self._map.get(cell)
+        if bucket is not None:
+            bucket.discard(rid)
+            if not bucket:
+                del self._map[cell]
+
+    def near(self, lat: float, lng: float, max_km: float) -> Set[RID]:
+        """Candidate RIDs within ``max_km`` of the point (superset)."""
+        lat = max(-90.0, min(90.0, float(lat)))
+        dlat = max_km / self.KM_PER_DEG
+        lat_lo, lat_hi = lat - dlat, lat + dlat
+        n_lng = int(round(360.0 / self.CELL))
+        # the tightest parallel in the band has the largest longitude
+        # span; past a pole every longitude is reachable
+        if lat_lo <= -90.0 or lat_hi >= 90.0:
+            wrap_all = True
+        else:
+            max_abs = max(abs(lat_lo), abs(lat_hi))
+            cosl = math.cos(math.radians(max_abs))
+            if cosl <= 1e-9:
+                wrap_all = True
+            else:
+                dlng = max_km / (self.KM_PER_DEG * cosl)
+                wrap_all = dlng >= 180.0
+        out: Set[RID] = set()
+        c_lat_lo = int(math.floor(max(-90.0, lat_lo) / self.CELL))
+        c_lat_hi = int(math.floor(min(90.0, lat_hi) / self.CELL))
+        if wrap_all:
+            for (clat, clng), rids in self._map.items():
+                if c_lat_lo <= clat <= c_lat_hi:
+                    out |= rids
+            return out
+        lng0 = ((float(lng) + 180.0) % 360.0) - 180.0
+        c_lng_lo = int(math.floor((lng0 - dlng) / self.CELL))
+        c_lng_hi = int(math.floor((lng0 + dlng) / self.CELL))
+        for clat in range(c_lat_lo, c_lat_hi + 1):
+            for clng in range(c_lng_lo, c_lng_hi + 1):
+                wrapped = ((clng + n_lng // 2) % n_lng) - n_lng // 2
+                bucket = self._map.get((clat, wrapped))
+                if bucket:
+                    out |= bucket
+        return out
+
+
 class IndexManager:
     """[E] OIndexManagerShared: registry + save/delete hooks."""
 
@@ -247,6 +352,8 @@ class IndexManager:
         cls = self._db.schema.get_class_or_raise(class_name)
         if index_type.upper() in ("FULLTEXT", "FULLTEXT_HASH_INDEX"):
             idx: Index = FullTextIndex(name, cls.name, fields)
+        elif index_type.upper() == "SPATIAL":
+            idx = SpatialIndex(name, cls.name, fields)
         else:
             idx = Index(name, cls.name, fields, index_type)
         # Build over existing records (OrientDB rebuilds on creation).

@@ -1,0 +1,30 @@
+"""Shared geodesic constants + host haversine.
+
+Oracle evaluation (`exec/eval.py`), the device predicate compiler
+(`ops/predicates.py`), and the spatial index probe (`exec/oracle.py`)
+must agree bit-for-bit on these for engine parity — one definition site
+([E] OSQLFunctionDistance's constants)."""
+
+from __future__ import annotations
+
+import math
+
+#: mean earth radius, km ([E] OSQLFunctionDistance)
+EARTH_RADIUS_KM = 6371.0
+
+#: km → miles scale for the optional unit argument
+MILES_PER_KM = 0.621371192
+
+#: accepted spellings of the miles unit argument
+MILE_UNITS = frozenset(("mi", "mile", "miles"))
+
+
+def haversine_km(lat1, lon1, lat2, lon2) -> float:
+    lat1, lon1, lat2, lon2 = (
+        math.radians(float(v)) for v in (lat1, lon1, lat2, lon2)
+    )
+    h = (
+        math.sin((lat2 - lat1) / 2) ** 2
+        + math.cos(lat1) * math.cos(lat2) * math.sin((lon2 - lon1) / 2) ** 2
+    )
+    return 2.0 * EARTH_RADIUS_KM * math.asin(min(1.0, math.sqrt(h)))

@@ -171,3 +171,18 @@ class TestReviewRegressions:
             db.query(q, engine="tpu")
         # repeat rejections are O(1): no plan-cache misses accrue
         assert metrics.counter("plan_cache.miss") == misses
+
+    def test_map_literal_projection_rewritten(self, db):
+        q = "SELECT {'k': age} AS m FROM Profiles WHERE uid = 1"
+        want = db.query(q, engine="oracle").to_dicts()
+        got = db.query(q, engine="tpu", strict=True).to_dicts()
+        assert got == want and got[0]["m"]["k"] is not None
+
+    def test_positive_translation_cached(self, db):
+        from orientdb_tpu.exec import tpu_engine as te
+        from orientdb_tpu.sql.parser import parse
+
+        q = "SELECT count(*) AS n FROM Profiles WHERE age > 21"
+        db.query(q, engine="tpu", strict=True)
+        stmt = parse(q)
+        assert not isinstance(te._TRANSLATE_CACHE.get(stmt), (str, type(None)))

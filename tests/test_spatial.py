@@ -1,0 +1,201 @@
+"""Spatial support (SURVEY §2 "Lucene" — the spatial half): haversine
+``distance()`` in the oracle, its device compilation over float columns,
+and the SPATIAL grid index with planner pruning."""
+
+import math
+import random
+
+import pytest
+
+from orientdb_tpu import Database, PropertyType
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+
+def haversine_km(lat1, lon1, lat2, lon2):
+    lat1, lon1, lat2, lon2 = map(math.radians, (lat1, lon1, lat2, lon2))
+    h = (
+        math.sin((lat2 - lat1) / 2) ** 2
+        + math.cos(lat1) * math.cos(lat2) * math.sin((lon2 - lon1) / 2) ** 2
+    )
+    return 2 * 6371.0 * math.asin(math.sqrt(h))
+
+
+def canon(rows):
+    return sorted(tuple(sorted((k, str(v)) for k, v in r.items())) for r in rows)
+
+
+@pytest.fixture(scope="module")
+def geo_db():
+    db = Database("geo")
+    place = db.schema.create_vertex_class("Place")
+    place.create_property("lat", PropertyType.DOUBLE)
+    place.create_property("lng", PropertyType.DOUBLE)
+    rng = random.Random(7)
+    for i in range(400):
+        db.new_vertex(
+            "Place",
+            name=f"pl{i}",
+            lat=rng.uniform(-85, 85),
+            lng=rng.uniform(-180, 180),
+            uid=i,
+        )
+    # antimeridian + pole-adjacent edge cases
+    db.new_vertex("Place", name="dateline_w", lat=10.0, lng=179.9, uid=400)
+    db.new_vertex("Place", name="dateline_e", lat=10.0, lng=-179.9, uid=401)
+    db.new_vertex("Place", name="near_pole", lat=89.5, lng=42.0, uid=402)
+    attach_fresh_snapshot(db)
+    return db
+
+
+class TestDistanceFunction:
+    def test_known_distance(self, geo_db):
+        # Milan (45.4642, 9.19) → Rome (41.8902, 12.4923) ≈ 477 km
+        rs = geo_db.query(
+            "SELECT distance(45.4642, 9.19, 41.8902, 12.4923) AS d "
+            "FROM Place WHERE uid = 0",
+            engine="oracle",
+        ).to_dicts()
+        assert abs(rs[0]["d"] - 477.0) < 2.0
+
+    def test_miles_unit(self, geo_db):
+        rs = geo_db.query(
+            "SELECT distance(0, 0, 0, 1, 'mi') AS d FROM Place WHERE uid = 0",
+            engine="oracle",
+        ).to_dicts()
+        assert abs(rs[0]["d"] - 111.19 * 0.621371192) < 0.5
+
+    def test_null_operand_yields_null(self, geo_db):
+        rs = geo_db.query(
+            "SELECT distance(lat, lng, 10, missing) AS d FROM Place WHERE uid = 1",
+            engine="oracle",
+        ).to_dicts()
+        assert rs[0]["d"] is None
+
+
+class TestDeviceParity:
+    @pytest.mark.parametrize(
+        "q",
+        [
+            "SELECT count(*) AS n FROM Place "
+            "WHERE distance(lat, lng, 45.0, 9.0) < 2000",
+            "SELECT name FROM Place WHERE distance(lat, lng, -20.5, 130.25) <= 1500",
+            "SELECT count(*) AS n FROM Place "
+            "WHERE distance(lat, lng, 10.0, 179.9) < 500",
+            "SELECT count(*) AS n FROM Place "
+            "WHERE distance(lat, lng, 0.0, 0.0, 'mi') < 1200",
+        ],
+    )
+    def test_select_parity(self, geo_db, q):
+        want = geo_db.query(q, engine="oracle").to_dicts()
+        got = geo_db.query(q, engine="tpu", strict=True).to_dicts()
+        assert canon(got) == canon(want)
+
+    def test_match_predicate_parity(self, geo_db):
+        q = (
+            "MATCH {class:Place, as:p, "
+            "where:(distance(lat, lng, 48.0, 2.0) < :r)} "
+            "RETURN p.name AS name"
+        )
+        for r in (300, 2500, 8000):
+            want = geo_db.query(q, params={"r": r}, engine="oracle").to_dicts()
+            got = geo_db.query(
+                q, params={"r": r}, engine="tpu", strict=True
+            ).to_dicts()
+            assert canon(got) == canon(want), r
+
+    def test_oracle_matches_reference_haversine(self, geo_db):
+        rows = geo_db.query(
+            "SELECT name, lat, lng FROM Place WHERE uid < 50", engine="oracle"
+        ).to_dicts()
+        inside = {
+            r["name"]
+            for r in rows
+            if haversine_km(r["lat"], r["lng"], 45.0, 9.0) < 3000
+        }
+        got = geo_db.query(
+            "SELECT name FROM Place "
+            "WHERE uid < 50 AND distance(lat, lng, 45.0, 9.0) < 3000",
+            engine="oracle",
+        ).to_dicts()
+        assert {r["name"] for r in got} == inside
+
+
+class TestSpatialIndex:
+    def test_near_is_superset_and_pruning_exact(self, geo_db):
+        q = (
+            "SELECT name FROM Place WHERE distance(lat, lng, 30.0, -60.0) < 1200"
+        )
+        before = canon(geo_db.query(q, engine="oracle").to_dicts())
+        idx = geo_db.indexes.create_index(
+            "Place.geo", "Place", ["lat", "lng"], "SPATIAL"
+        )
+        # index pruning must not change results
+        after = canon(geo_db.query(q, engine="oracle").to_dicts())
+        assert after == before
+        # superset property against brute force, several centers
+        rows = geo_db.query(
+            "SELECT name, lat, lng FROM Place", engine="oracle"
+        ).to_dicts()
+        for lat0, lng0, r in [
+            (30.0, -60.0, 1200),
+            (10.0, 179.9, 800),
+            (89.0, 0.0, 700),
+            (-45.0, 100.0, 3000),
+        ]:
+            cand = idx.near(lat0, lng0, r)
+            names = set()
+            for rid in cand:
+                d = geo_db.load(rid)
+                if d is not None:
+                    names.add(d["name"])
+            true = {
+                x["name"]
+                for x in rows
+                if haversine_km(x["lat"], x["lng"], lat0, lng0) < r
+            }
+            assert true <= names, (lat0, lng0, r)
+        geo_db.indexes.drop_index("Place.geo")
+
+    def test_antimeridian_neighbors_found(self, geo_db):
+        idx = geo_db.indexes.create_index(
+            "Place.geo2", "Place", ["lat", "lng"], "SPATIAL"
+        )
+        try:
+            cand = idx.near(10.0, 179.95, 50)
+            names = {geo_db.load(r)["name"] for r in cand}
+            assert {"dateline_w", "dateline_e"} <= names
+        finally:
+            geo_db.indexes.drop_index("Place.geo2")
+
+    def test_index_maintained_on_save_delete(self):
+        db = Database("geo2")
+        db.schema.create_vertex_class("Place")
+        idx = db.indexes.create_index(
+            "Place.geo", "Place", ["lat", "lng"], "SPATIAL"
+        )
+        v = db.new_vertex("Place", name="x", lat=1.0, lng=2.0)
+        assert v.rid in idx.near(1.0, 2.0, 10)
+        v["lat"] = 50.0
+        db.save(v)
+        assert v.rid not in idx.near(1.0, 2.0, 10)
+        assert v.rid in idx.near(50.0, 2.0, 10)
+        db.delete(v)
+        assert v.rid not in idx.near(50.0, 2.0, 10)
+
+    def test_spatial_index_needs_two_fields(self):
+        db = Database("geo3")
+        db.schema.create_vertex_class("Place")
+        with pytest.raises(ValueError):
+            db.indexes.create_index("bad", "Place", ["lat"], "SPATIAL")
+
+
+class TestBoolOperandParity:
+    def test_bool_latitude_falls_back_not_diverges(self):
+        db = Database("geob")
+        db.schema.create_vertex_class("Place")
+        db.new_vertex("Place", name="a", lat=True, lng=2.0)
+        attach_fresh_snapshot(db)
+        q = "SELECT name FROM Place WHERE distance(lat, lng, 1.0, 2.0) < 500"
+        want = db.query(q, engine="oracle").to_dicts()
+        got = db.query(q, engine="tpu").to_dicts()  # falls back
+        assert got == want == []
