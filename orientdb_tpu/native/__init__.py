@@ -1,0 +1,123 @@
+"""Native (C++) runtime components.
+
+The compute path is JAX/XLA; the runtime around it goes native where
+the reference's does ([E] the storage engine's fsync/IO machinery is
+the hottest non-compute path). Components build on demand with the
+system toolchain and degrade gracefully: a missing compiler or failed
+build falls back to the pure-Python implementation, never an error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_DIR = os.path.dirname(__file__)
+_BUILD_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _build(name: str) -> Optional[str]:
+    """Compile ``<name>.cpp`` → ``lib<name>.so`` next to the source (once
+    per source mtime); returns the .so path or None."""
+    src = os.path.join(_DIR, f"{name}.cpp")
+    so = os.path.join(_DIR, f"lib{name}.so")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O2",
+                "-std=c++17",
+                "-shared",
+                "-fPIC",
+                "-o",
+                so + ".tmp",
+                src,
+                "-lpthread",
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(so + ".tmp", so)
+        return so
+    except Exception as e:  # no g++, compile error, sandboxed fs …
+        log.warning("native build of %s failed (%s); using Python path", name, e)
+        return None
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """The shared library for ``name``, building if needed; None when
+    unavailable (callers use their Python fallback)."""
+    with _BUILD_LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        so = _build(name)
+        lib = None
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(so)
+            except OSError as e:
+                log.warning("loading %s failed: %s", so, e)
+        _CACHE[name] = lib
+        return lib
+
+
+class WalAppender:
+    """ctypes face of the group-commit WAL appender (walappend.cpp)."""
+
+    def __init__(self, lib: ctypes.CDLL, path: str, do_fsync: bool) -> None:
+        lib.wal_open.restype = ctypes.c_void_p
+        lib.wal_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.wal_enqueue.restype = ctypes.c_uint64
+        lib.wal_enqueue.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.wal_wait.restype = ctypes.c_int
+        lib.wal_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.wal_close.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.wal_open(path.encode(), 1 if do_fsync else 0)
+        if not self._h:
+            raise OSError(f"wal_open failed for {path}")
+
+    def enqueue(self, line: bytes) -> int:
+        return self._lib.wal_enqueue(self._h, line, len(line))
+
+    def wait(self, gen: int) -> None:
+        # blocks in native code with the GIL released — concurrent
+        # appenders framing their lines meanwhile is the group commit
+        err = self._lib.wal_wait(self._h, gen)
+        if err:
+            # durability failed (ENOSPC, I/O error): the committing
+            # caller must see it, exactly as the Python write/fsync path
+            # would raise
+            raise OSError(err, os.strerror(err), "wal group-commit flush")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.wal_close(self._h)
+            self._h = None
+
+
+def wal_appender(path: str, do_fsync: bool) -> Optional[WalAppender]:
+    lib = load("walappend")
+    if lib is None:
+        return None
+    try:
+        return WalAppender(lib, path, do_fsync)
+    except OSError:
+        return None

@@ -1,0 +1,125 @@
+// Group-commit WAL appender.
+//
+// The native side of the write-ahead log's append path
+// (orientdb_tpu/storage/durability.py — the [E] OWALPage/OWriteAheadLog
+// fsync path, SURVEY.md §2 "WAL"). Python frames each entry
+// (crc + json + newline) and enqueues it here; a dedicated flusher
+// thread writes and fsyncs whole batches, so N concurrent appenders pay
+// ~one fsync instead of N (classic group commit). The enqueue/wait
+// split lets the Python caller allocate LSNs under its own lock while
+// the durability wait happens outside it with the GIL released.
+//
+// C API (ctypes):
+//   void*    wal_open(const char* path, int do_fsync)
+//   uint64_t wal_enqueue(void* h, const char* data, uint64_t len)
+//   void     wal_wait(void* h, uint64_t gen)   // blocks until durable
+//   void     wal_close(void* h)                // flushes, joins, closes
+
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Wal {
+  int fd = -1;
+  bool do_fsync = true;
+  std::mutex mu;
+  std::condition_variable cv_flush;  // work available (or stopping)
+  std::condition_variable cv_done;   // a batch became durable
+  std::vector<char> pending;
+  uint64_t enq_gen = 0;     // generation of the last enqueued entry
+  uint64_t flushed_gen = 0; // generation durable on disk
+  int err = 0;              // sticky errno from write/fsync failure
+  bool stop = false;
+  std::thread flusher;
+};
+
+void flusher_loop(Wal* w) {
+  std::unique_lock<std::mutex> lk(w->mu);
+  for (;;) {
+    w->cv_flush.wait(lk, [w] { return w->stop || !w->pending.empty(); });
+    if (w->pending.empty()) {
+      if (w->stop) return;
+      continue;
+    }
+    std::vector<char> batch;
+    batch.swap(w->pending);
+    uint64_t gen = w->enq_gen;
+    lk.unlock();
+    int batch_err = 0;
+    size_t off = 0;
+    while (off < batch.size()) {
+      ssize_t n = ::write(w->fd, batch.data() + off, batch.size() - off);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        batch_err = errno ? errno : EIO;
+        break;
+      }
+      off += static_cast<size_t>(n);
+    }
+    if (batch_err == 0 && w->do_fsync && ::fsync(w->fd) != 0) {
+      batch_err = errno ? errno : EIO;
+    }
+    lk.lock();
+    // waiters must always wake, but a failed batch STICKS as an error:
+    // wal_wait reports it and the Python caller raises instead of
+    // acknowledging a commit that never reached disk
+    if (batch_err != 0 && w->err == 0) w->err = batch_err;
+    w->flushed_gen = gen;
+    w->cv_done.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wal_open(const char* path, int do_fsync) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd < 0) return nullptr;
+  Wal* w = new Wal();
+  w->fd = fd;
+  w->do_fsync = do_fsync != 0;
+  w->flusher = std::thread(flusher_loop, w);
+  return w;
+}
+
+uint64_t wal_enqueue(void* h, const char* data, uint64_t len) {
+  Wal* w = static_cast<Wal*>(h);
+  std::lock_guard<std::mutex> lk(w->mu);
+  w->pending.insert(w->pending.end(), data, data + len);
+  w->enq_gen += 1;
+  w->cv_flush.notify_one();
+  return w->enq_gen;
+}
+
+int wal_wait(void* h, uint64_t gen) {
+  // returns 0 when the generation is durable, else the sticky errno
+  Wal* w = static_cast<Wal*>(h);
+  std::unique_lock<std::mutex> lk(w->mu);
+  w->cv_done.wait(lk, [w, gen] { return w->flushed_gen >= gen; });
+  return w->err;
+}
+
+void wal_close(void* h) {
+  Wal* w = static_cast<Wal*>(h);
+  {
+    std::lock_guard<std::mutex> lk(w->mu);
+    w->stop = true;
+    w->cv_flush.notify_one();
+  }
+  w->flusher.join();
+  ::fsync(w->fd);
+  ::close(w->fd);
+  delete w;
+}
+
+}  // extern "C"

@@ -99,12 +99,24 @@ class WriteAheadLog:
         self.next_lsn = 1
         self.replaying = False
         self._fh = None
+        self._native = None  # group-commit appender (native/walappend.cpp)
+        self._native_tried = False
+        self._native_waiters = 0  # appenders inside nat.wait (see close)
+        self._closing = False  # gate: appends hold off while close drains
         # append serialization: record saves run under the database lock,
         # but DDL observers and sequence.next() append from arbitrary
         # threads — LSN allocation and the file write must be atomic
         import threading
 
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        if self.fsync and config.wal_native:
+            # warm the native build OUTSIDE the append lock: first-ever
+            # use compiles the .so (seconds) and must not stall the first
+            # commit plus everyone queued behind it
+            from orientdb_tpu import native
+
+            native.load("walappend")
 
     # -- append ------------------------------------------------------------
 
@@ -113,26 +125,81 @@ class WriteAheadLog:
             self._fh = open(self.path, "ab")
         return self._fh
 
+    def _native_handle(self):
+        """The C++ group-commit appender, when fsync is on and the native
+        build is available ([E] the OWriteAheadLog fsync path). Without
+        fsync the Python buffered write is already cheap; with it, N
+        concurrent appenders share ~one fsync per batch instead of one
+        each. None → the caller uses the Python path."""
+        if not self.fsync or not config.wal_native:
+            return None
+        if self._native is None and not self._native_tried:
+            self._native_tried = True
+            from orientdb_tpu import native
+
+            self._native = native.wal_appender(self.path, do_fsync=True)
+        return self._native
+
     def append(self, entry: Dict) -> int:
+        gen = None
         with self._lock:
+            # a close() in progress is draining the native flusher; new
+            # entries must wait for it or they would hit the file ahead
+            # of lower-LSN batches still pending in the C++ queue
+            while self._closing:
+                self._cond.wait()
             lsn = self.next_lsn
             self.next_lsn += 1
             entry = {"lsn": lsn, **entry}
             data = json.dumps(entry, separators=(",", ":")).encode()
             line = b"%08x %s\n" % (zlib.crc32(data) & 0xFFFFFFFF, data)
-            fh = self._handle()
-            fh.write(line)
-            fh.flush()
-            if self.fsync:
-                os.fsync(fh.fileno())
+            nat = self._native_handle()
+            if nat is not None:
+                # enqueue under the lock (file order must equal LSN order
+                # for torn-tail recovery semantics) …
+                gen = nat.enqueue(line)
+                self._native_waiters += 1
+            else:
+                fh = self._handle()
+                fh.write(line)
+                fh.flush()
+                if self.fsync:
+                    os.fsync(fh.fileno())
+        if gen is not None:
+            # … but wait for durability OUTSIDE it, GIL released: other
+            # threads frame their entries meanwhile and the flusher
+            # batches everything into one write+fsync (group commit)
+            try:
+                nat.wait(gen)
+            finally:
+                with self._lock:
+                    self._native_waiters -= 1
+                    self._cond.notify_all()
         metrics.incr("wal.append")
         return lsn
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
-                self._fh.close()
-                self._fh = None
+            # closing frees the C++ Wal (joins its flusher, deletes the
+            # mutex/condvar) — an appender still blocked in nat.wait would
+            # be a use-after-free. Gate NEW appends out (they would keep
+            # the waiter count from ever draining under load), then drain
+            # the in-flight ones; their batches complete independently,
+            # so this is bounded.
+            self._closing = True
+            try:
+                while self._native_waiters > 0:
+                    self._cond.wait()
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
+                if self._native is not None:
+                    self._native.close()
+                    self._native = None
+                self._native_tried = False
+            finally:
+                self._closing = False
+                self._cond.notify_all()
 
     # -- read --------------------------------------------------------------
 

@@ -89,6 +89,10 @@ class GlobalConfiguration:
     wal_enabled: bool = False
     wal_dir: Optional[str] = None
     wal_fsync: bool = False
+    # fsync'd appends route through the C++ group-commit appender
+    # (native/walappend.cpp) when its build is available; False pins the
+    # pure-Python write+fsync path.
+    wal_native: bool = True
 
     @classmethod
     def from_env(cls) -> "GlobalConfiguration":
