@@ -364,6 +364,18 @@ def build_plan(pattern: Pattern, interp: MatchInterpreter) -> List[PlanStep]:
 # ---------------------------------------------------------------------------
 
 
+def _var_emit_mask(reached, node_mask_vec, bound_chunk, vb: int):
+    """One var-depth level's emission mask: reached ∧ target node mask,
+    restricted to the already-bound endpoint on cyclic (close) arms.
+    Shared by the row-emitting and count-only paths so their semantics
+    cannot drift."""
+    emit = reached & node_mask_vec[None, :]
+    if bound_chunk is not None:
+        vcol = jnp.arange(vb, dtype=jnp.int32)
+        emit = emit & (vcol[None, :] == bound_chunk[:, None])
+    return emit
+
+
 def build_bitmap_hops(dg: DeviceGraph, items) -> List:
     """Frontier-hop closures for ``(class, direction, emask)`` items.
 
@@ -790,7 +802,13 @@ class TpuMatchSolver:
 
     def solve_table(self) -> Table:
         pushdown = self._count_pushdown_steps()
-        steps = self.plan[: len(self.plan) - len(pushdown)] if pushdown else self.plan
+        var_count = None if pushdown else self._var_count_step()
+        if pushdown:
+            steps = self.plan[: len(self.plan) - len(pushdown)]
+        elif var_count is not None:
+            steps = self.plan[:-1]
+        else:
+            steps = self.plan
         table = Table(count=1, width=0)
         for step in steps:
             if table.empty():
@@ -807,6 +825,10 @@ class TpuMatchSolver:
             table = self._apply_not_paths(table)
         if pushdown and not table.empty():
             return self._apply_count_pushdown(table, pushdown)
+        if var_count is not None and not table.empty():
+            return self._expand_var_depth(
+                table, var_count, optional=False, count_only=True
+            )
         return table
 
     # -- COUNT(*) aggregate pushdown ----------------------------------------
@@ -954,6 +976,41 @@ class TpuMatchSolver:
                     break
             suffix.insert(0, step)
         return suffix
+
+    def _var_count_step(self) -> Optional[PlanStep]:
+        """The plan's final step, when it is a terminal var-depth (WHILE /
+        maxDepth) expansion a lone COUNT(*) can aggregate by per-level
+        popcounts (`_expand_var_depth(count_only=True)`) — the var-depth
+        sibling of `_count_pushdown_steps`, which stops at WHILE arms."""
+        if (
+            self.count_only_name() is None
+            or self.stmt.group_by
+            or self._not_compiled
+            or not self.plan
+        ):
+            return None
+        step = self.plan[-1]
+        if step.kind != "expand" or step.close:
+            return None  # optional arms contribute unmatched rows too
+        e = step.edge
+        item = e.item
+        if item.target.while_cond is None and item.target.max_depth is None:
+            return None  # fixed expansion — the weight pushdown covers it
+        f = item.edge_filter
+        if f is not None and f.alias:
+            return None
+        dst_alias = e.from_alias if step.reverse else e.to_alias
+        if getattr(self._node_masks[dst_alias], "uses_bindings", False):
+            return None
+        for e2 in self.pattern.edges:
+            if e2 is e:
+                continue
+            if dst_alias in (e2.from_alias, e2.to_alias):
+                return None  # dst participates elsewhere: rows needed
+            f2 = e2.item.edge_filter
+            if f2 is not None and f2.alias == dst_alias:
+                return None
+        return step
 
     def _apply_count_pushdown(self, table: Table, steps: List[PlanStep]) -> Table:
         first = steps[0]
@@ -1454,13 +1511,25 @@ class TpuMatchSolver:
         budget_rows = max(1, config.var_depth_bitmap_budget // max(vb, 1))
         return max(1, min(TpuMatchSolver._VAR_DEPTH_CHUNK, width, budget_rows))
 
-    def _expand_var_depth(self, table: Table, step: PlanStep, optional: bool) -> Table:
+    def _expand_var_depth(
+        self,
+        table: Table,
+        step: PlanStep,
+        optional: bool,
+        count_only: bool = False,
+    ) -> Table:
         """Breadth-wise frontier iteration with per-row visited bitmaps —
         the SURVEY §5.7 design for the reference's per-record WHILE-DFS
         ([E] OWhileMatchPathItem): emit the origin at depth 0, then one
         bitmap hop per level, gating expansion with the WHILE mask at the
         level's $depth and stopping at maxDepth / frontier exhaustion.
         Depths are minimum-discovery depths (the oracle's BFS semantics).
+
+        ``count_only`` is the var-depth COUNT pushdown (`_var_count_step`):
+        a terminal WHILE arm under a lone COUNT(*) contributes
+        popcount(level emission) per level instead of materialized binding
+        rows — no compactions, no gathers, no per-level size observes, and
+        the result table is just the device scalar.
         """
         e = step.edge
         item = e.item
@@ -1497,6 +1566,8 @@ class TpuMatchSolver:
         counts: List[int] = []
         width = table.width or 1
         matched_chunks = []
+        total_dev = jnp.int32(0)  # count_only accumulators (+ f32 twin
+        totalf_dev = jnp.float32(0.0)  # for the int32 wrap guard)
         C = self._var_chunk_rows(width, vb)
         # chunk over the bucketed WIDTH (not the recorded count): on a
         # parameter-generic replay live rows can occupy any slot under the
@@ -1518,14 +1589,24 @@ class TpuMatchSolver:
                     table.cols[dst_alias], chunk_rows, jnp.int32(-2)
                 )
             matched = jnp.zeros(C, bool)
+
+            def emit_level(reached, depth):
+                nonlocal total_dev, totalf_dev
+                if not count_only:
+                    return self._emit_var_level(
+                        table, reached, node_mask_vec, bound_chunk, cs,
+                        depth, dst_alias, depth_alias, vb, parts, counts,
+                    )
+                emit = _var_emit_mask(reached, node_mask_vec, bound_chunk, vb)
+                total_dev = total_dev + jnp.sum(emit, dtype=jnp.int32)
+                totalf_dev = totalf_dev + jnp.sum(emit, dtype=jnp.float32)
+                return matched  # unused in count mode (never optional)
+
             visited = roots
             frontier = roots
             depth = 0
             # emit the origin at depth 0
-            matched = matched | self._emit_var_level(
-                table, roots, node_mask_vec, bound_chunk, cs, depth,
-                dst_alias, depth_alias, vb, parts, counts,
-            )
+            matched = matched | emit_level(roots, depth)
             # level loop with PADDED trailing levels: recording runs
             # `var_depth_pad_levels` extra (empty) levels past frontier
             # exhaustion and keeps min-capacity emissions at every level,
@@ -1553,10 +1634,7 @@ class TpuMatchSolver:
                 empty_streak = empty_streak + 1 if alive == 0 else 0
                 visited = visited | nxt
                 depth += 1
-                matched = matched | self._emit_var_level(
-                    table, nxt, node_mask_vec, bound_chunk, cs, depth,
-                    dst_alias, depth_alias, vb, parts, counts,
-                )
+                matched = matched | emit_level(nxt, depth)
                 frontier = nxt
                 if empty_streak >= pad:
                     break
@@ -1568,6 +1646,22 @@ class TpuMatchSolver:
                 # levels than recorded+pad → overflow (recorded value is 0)
                 self.sched.observe(K.mask_count(frontier))
             matched_chunks.append(matched)
+        if count_only:
+            if self.sched.recording:
+                approx = float(totalf_dev)
+                exact = int(total_dev)
+                if not (
+                    0 <= approx < 2**31 * 0.99
+                    and abs(approx - exact) <= max(1e-3 * approx, 1.0)
+                ):
+                    raise Uncompilable(
+                        f"var-depth COUNT overflows int32 (≈{approx:.6g})"
+                    )
+            # free observe: the count IS the result (see _apply_count_pushdown)
+            total = self.sched.observe(total_dev, free=True)
+            t = Table(count=int(total), width=0)
+            t.count_dev = total_dev
+            return t
         if optional:
             matched_all = jnp.concatenate(matched_chunks)[:width]
             if matched_all.shape[0] < width:
@@ -1629,10 +1723,7 @@ class TpuMatchSolver:
         min-capacity part: parameter-generic replays can emit up to that
         capacity at any level (incl. the padded post-exhaustion ones)
         without re-recording."""
-        emit = reached & node_mask_vec[None, :]
-        if bound_chunk is not None:
-            vcol = jnp.arange(vb, dtype=jnp.int32)
-            emit = emit & (vcol[None, :] == bound_chunk[:, None])
+        emit = _var_emit_mask(reached, node_mask_vec, bound_chunk, vb)
         matched = emit.any(axis=1)
         flat = emit.reshape(-1)
         keep, kn, kn_dev = _observe_compact(self.sched, flat, min_capacity=K.bucket(0))
@@ -2021,6 +2112,16 @@ class TpuTraverseSolver:
         return out
 
 
+import threading as _threading
+
+#: serializes TRACE-bearing work: a background warm-up tracing one plan
+#: while the main thread eagerly records another shares lazily-populated
+#: device-graph caches; concurrent first-touch of those can leak one
+#: trace's values into the other. Compiled-plan DISPATCHES never trace
+#: and never take this lock.
+_TRACE_LOCK = _threading.RLock()
+
+
 class _AotWarmup:
     """Background trace+compile of a replay's jitted function.
 
@@ -2064,7 +2165,8 @@ class _AotWarmup:
             # AOT `lower().compile()` does not seed the jit call cache, so
             # executing once is the only way to make the next dispatch hit
             try:
-                jax.block_until_ready(self._warm_call())
+                with _TRACE_LOCK:
+                    jax.block_until_ready(self._warm_call())
                 metrics.incr("plan_cache.aot_compile")
             except Exception:
                 log.exception("background plan warm-up failed")
@@ -2396,17 +2498,21 @@ def _translate_remember(stmt, verdict) -> None:
 
 def _record(db, stmt, params):
     """Recording first execution: eager solve with blocking size observes.
-    Returns (plan, rows)."""
+    Returns (plan, rows). Holds the trace lock: an eager solve must not
+    interleave with a background warm-up's trace (see _TRACE_LOCK)."""
     stmt, element_alias = _translate(stmt)
-    if isinstance(stmt, A.MatchStatement):
-        solver = TpuMatchSolver(db, stmt, params, element_alias=element_alias)
-        table = solver.solve_table()
-        rows = solver.rows_from_table(table)
-        return _CompiledPlan(solver, table), rows
-    tsolver = TpuTraverseSolver(db, stmt, params)
-    idx, total = tsolver.solve()
-    rows = tsolver.rows_from(np.asarray(idx), total)
-    return _CompiledTraverse(tsolver, total), rows
+    with _TRACE_LOCK:
+        if isinstance(stmt, A.MatchStatement):
+            solver = TpuMatchSolver(
+                db, stmt, params, element_alias=element_alias
+            )
+            table = solver.solve_table()
+            rows = solver.rows_from_table(table)
+            return _CompiledPlan(solver, table), rows
+        tsolver = TpuTraverseSolver(db, stmt, params)
+        idx, total = tsolver.solve()
+        rows = tsolver.rows_from(np.asarray(idx), total)
+        return _CompiledTraverse(tsolver, total), rows
 
 
 def _prepare(db, stmt, params):

@@ -33,6 +33,8 @@ def _build(name: str) -> Optional[str]:
         return None
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
         return so
+    tmp = f"{so}.{os.getpid()}.tmp"  # pid-unique: concurrent processes
+    # may both rebuild; os.replace keeps the publish atomic either way
     try:
         subprocess.run(
             [
@@ -42,7 +44,7 @@ def _build(name: str) -> Optional[str]:
                 "-shared",
                 "-fPIC",
                 "-o",
-                so + ".tmp",
+                tmp,
                 src,
                 "-lpthread",
             ],
@@ -50,7 +52,7 @@ def _build(name: str) -> Optional[str]:
             capture_output=True,
             timeout=120,
         )
-        os.replace(so + ".tmp", so)
+        os.replace(tmp, so)
         return so
     except Exception as e:  # no g++, compile error, sandboxed fs …
         log.warning("native build of %s failed (%s); using Python path", name, e)

@@ -101,6 +101,10 @@ class WriteAheadLog:
         self._fh = None
         self._native = None  # group-commit appender (native/walappend.cpp)
         self._native_tried = False
+        # latched ONCE: consulting the mutable config per append could
+        # interleave a synchronous Python write ahead of still-queued
+        # native batches, breaking file-order == LSN-order
+        self._use_native = self.fsync and config.wal_native
         self._native_waiters = 0  # appenders inside nat.wait (see close)
         self._closing = False  # gate: appends hold off while close drains
         # append serialization: record saves run under the database lock,
@@ -110,7 +114,7 @@ class WriteAheadLog:
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        if self.fsync and config.wal_native:
+        if self._use_native:
             # warm the native build OUTSIDE the append lock: first-ever
             # use compiles the .so (seconds) and must not stall the first
             # commit plus everyone queued behind it
@@ -131,7 +135,7 @@ class WriteAheadLog:
         fsync the Python buffered write is already cheap; with it, N
         concurrent appenders share ~one fsync per batch instead of one
         each. None → the caller uses the Python path."""
-        if not self.fsync or not config.wal_native:
+        if not self._use_native:
             return None
         if self._native is None and not self._native_tried:
             self._native_tried = True
@@ -246,6 +250,10 @@ class WriteAheadLog:
         """Cut the file back to its valid prefix — recovery MUST do this
         before re-arming appends, or new (acknowledged!) entries land
         after the garbage and every later recovery discards them."""
+        # the native flusher writes OUTSIDE self._lock: drain and close it
+        # first (close gates new appends), or the scan-then-truncate could
+        # chop an acknowledged batch the flusher lands in between
+        self.close()
         with self._lock:
             entries, valid = self._scan()
             if os.path.exists(self.path):

@@ -141,3 +141,64 @@ class TestPushdownEngages:
             strict=True,
         ).to_dicts()
         assert n_tpu == len(rows)
+
+
+class TestVarDepthCountPushdown:
+    """Terminal WHILE arms under a lone COUNT(*) aggregate by per-level
+    popcounts instead of materializing binding rows
+    (tpu_engine._var_count_step / _expand_var_depth(count_only=True))."""
+
+    def test_parity_across_parameters(self, sdb):
+        q = (
+            "MATCH {class:Profiles, as:p, where:(uid < :k)}"
+            "-HasFriend->{as:f, while:($depth < 3), where:(age < 30)} "
+            "RETURN count(*) AS n"
+        )
+        for k in (0, 1, 3, 5):
+            want = sdb.query(q, params={"k": k}, engine="oracle").to_dicts()
+            got = sdb.query(q, params={"k": k}, engine="tpu", strict=True).to_dicts()
+            assert got == want, k
+
+    def test_pushdown_engaged_and_shapes_excluded(self, sdb):
+        from orientdb_tpu.exec.tpu_engine import TpuMatchSolver
+        from orientdb_tpu.sql.parser import parse
+
+        eligible = parse(
+            "MATCH {class:Profiles, as:p}"
+            "-HasFriend->{as:f, while:($depth < 2)} RETURN count(*) AS n"
+        )
+        s = TpuMatchSolver(sdb, eligible, {})
+        assert s._var_count_step() is not None
+
+        # rows needed → no pushdown
+        rows_stmt = parse(
+            "MATCH {class:Profiles, as:p}"
+            "-HasFriend->{as:f, while:($depth < 2)} RETURN f.name"
+        )
+        assert TpuMatchSolver(sdb, rows_stmt, {})._var_count_step() is None
+
+        # dst participates in another arm → no pushdown
+        shared = parse(
+            "MATCH {class:Profiles, as:p}"
+            "-HasFriend->{as:f, while:($depth < 2)}, "
+            "{as:f}-Likes->{as:x} RETURN count(*) AS n"
+        )
+        assert TpuMatchSolver(sdb, shared, {})._var_count_step() is None
+
+    def test_unbounded_while_parity(self, sdb):
+        q = (
+            "MATCH {class:Profiles, as:p, where:(uid = 0)}"
+            "-HasFriend->{as:f, while:(true)} RETURN count(*) AS n"
+        )
+        want = sdb.query(q, engine="oracle").to_dicts()
+        got = sdb.query(q, engine="tpu", strict=True).to_dicts()
+        assert got == want
+
+    def test_maxdepth_parity(self, sdb):
+        q = (
+            "MATCH {class:Profiles, as:p}"
+            "-HasFriend->{as:f, maxDepth:2} RETURN count(*) AS n"
+        )
+        want = sdb.query(q, engine="oracle").to_dicts()
+        got = sdb.query(q, engine="tpu", strict=True).to_dicts()
+        assert got == want
