@@ -1,0 +1,83 @@
+"""Query result (command) cache.
+
+Analog of the reference's command cache ([E] OCommandCache /
+OCommandCacheSoftRefs: caches idempotent query result sets per database,
+invalidated on writes; DISABLED by default upstream and here —
+``config.command_cache_enabled``). Redesign: instead of per-cluster
+invalidation bookkeeping, entries are stamped with the database's
+mutation epoch — any write moves the epoch, so stale entries simply stop
+matching and age out of the LRU. Rows are shared between hits (results
+are read-only by convention; mutating a cached Result would be visible
+to later hits, same trade the reference documents)."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.metrics import metrics
+
+
+class CommandCache:
+    """Per-database LRU of (sql, params, engine, strict) → (rows, engine,
+    epoch); thread-safe (server request threads share one database)."""
+
+    def __init__(self, max_entries: Optional[int] = None) -> None:
+        self.max_entries = max_entries or config.command_cache_size
+        self._map: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def key(
+        sql: str, params, engine: Optional[str], strict: bool = False
+    ) -> Optional[Tuple]:
+        try:
+            pk = (
+                tuple(sorted((str(k), repr(v)) for k, v in params.items()))
+                if params
+                else ()
+            )
+        except Exception:
+            return None  # unhashable/odd params: skip caching
+        return (sql, pk, engine or "", bool(strict))
+
+    def get(self, key: Tuple, epoch: int):
+        with self._lock:
+            hit = self._map.get(key)
+            if hit is None:
+                metrics.incr("command_cache.miss")
+                return None
+            rows, used, at_epoch = hit
+            if at_epoch != epoch:
+                # a write moved the epoch: the entry is stale — drop it
+                self._map.pop(key, None)
+                metrics.incr("command_cache.invalidated")
+                return None
+            self._map.move_to_end(key)
+        metrics.incr("command_cache.hit")
+        return rows, used
+
+    def put(self, key: Tuple, rows: List, used: str, epoch: int) -> None:
+        with self._lock:
+            while len(self._map) >= self.max_entries:
+                self._map.popitem(last=False)
+            self._map[key] = (rows, used, epoch)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+def cache_for(db) -> Optional[CommandCache]:
+    """The database's command cache, or None when the feature is off."""
+    if not config.command_cache_enabled:
+        return None
+    cache = getattr(db, "_command_cache", None)
+    if cache is None:
+        cache = db._command_cache = CommandCache()
+    return cache

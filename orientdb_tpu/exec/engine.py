@@ -134,7 +134,25 @@ def execute_query(
         raise ValueError(
             f"cannot run non-idempotent {type(stmt).__name__} via query(); use command()"
         )
-    rows, used = _run(db, stmt, _normalize_params(params), engine, strict)
+    norm = _normalize_params(params)
+    # result cache ([E] OCommandCache, off by default): idempotent
+    # queries outside a tx, keyed incl. engine AND strict (a cached
+    # fallback result must not mask strict=True's Uncompilable contract)
+    from orientdb_tpu.exec.command_cache import cache_for
+
+    cache = cache_for(db) if db.tx is None else None
+    key = cache.key(sql, norm, engine, strict) if cache is not None else None
+    if key is not None:
+        # capture the epoch BEFORE running: a write landing mid-query
+        # must make the entry stale, not stamp post-write freshness onto
+        # pre-write rows
+        epoch = db.mutation_epoch
+        hit = cache.get(key, epoch)
+        if hit is not None:
+            return _result_set(hit[0], hit[1])
+    rows, used = _run(db, stmt, norm, engine, strict)
+    if key is not None:
+        cache.put(key, rows, used, epoch)
     return _result_set(rows, used)
 
 

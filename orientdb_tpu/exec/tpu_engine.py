@@ -2159,18 +2159,37 @@ class _AotWarmup:
         ev = threading.Event()
         self._aot_ready = ev
         _AotWarmup._inflight.append(ev)
+        # keep the exit-time drain AHEAD of JAX's own teardown handlers:
+        # atexit runs in reverse registration order and JAX registers
+        # teardown lazily at first compile — re-registering on every
+        # warm-up start keeps the drain first, so no trace is in flight
+        # when the compile machinery is dismantled
+        import atexit
+
+        atexit.unregister(drain_warmups)
+        atexit.register(drain_warmups)
 
         def work():
             # the warm-up CALLS the jitted replay (result discarded): JAX's
             # AOT `lower().compile()` does not seed the jit call cache, so
             # executing once is the only way to make the next dispatch hit
             try:
-                with _TRACE_LOCK:
-                    jax.block_until_ready(self._warm_call())
-                metrics.incr("plan_cache.aot_compile")
-            except Exception:
-                log.exception("background plan warm-up failed")
-                metrics.incr("plan_cache.aot_compile_error")
+                for attempt in (0, 1):
+                    try:
+                        with _TRACE_LOCK:
+                            jax.block_until_ready(self._warm_call())
+                        metrics.incr("plan_cache.aot_compile")
+                        break
+                    except Exception:
+                        if attempt:
+                            # give up: the next dispatch compiles inline
+                            # (slower but correct)
+                            log.exception("background plan warm-up failed")
+                            metrics.incr("plan_cache.aot_compile_error")
+                        else:
+                            import time as _t
+
+                            _t.sleep(0.05)
             finally:
                 ev.set()
                 try:

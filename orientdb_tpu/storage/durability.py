@@ -182,25 +182,29 @@ class WriteAheadLog:
         metrics.incr("wal.append")
         return lsn
 
+    def _drain_and_close_locked(self) -> None:
+        """Drain in-flight native waiters and close both handles. Caller
+        holds the lock with ``_closing`` set (appends are gated out —
+        under load they would keep the waiter count from ever draining).
+        Closing frees the C++ Wal (joins its flusher, deletes the
+        mutex/condvar), so an appender still blocked in nat.wait would be
+        a use-after-free; their batches complete independently, so the
+        drain is bounded."""
+        while self._native_waiters > 0:
+            self._cond.wait()
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+        self._native_tried = False
+
     def close(self) -> None:
         with self._lock:
-            # closing frees the C++ Wal (joins its flusher, deletes the
-            # mutex/condvar) — an appender still blocked in nat.wait would
-            # be a use-after-free. Gate NEW appends out (they would keep
-            # the waiter count from ever draining under load), then drain
-            # the in-flight ones; their batches complete independently,
-            # so this is bounded.
             self._closing = True
             try:
-                while self._native_waiters > 0:
-                    self._cond.wait()
-                if self._fh is not None:
-                    self._fh.close()
-                    self._fh = None
-                if self._native is not None:
-                    self._native.close()
-                    self._native = None
-                self._native_tried = False
+                self._drain_and_close_locked()
             finally:
                 self._closing = False
                 self._cond.notify_all()
@@ -250,17 +254,23 @@ class WriteAheadLog:
         """Cut the file back to its valid prefix — recovery MUST do this
         before re-arming appends, or new (acknowledged!) entries land
         after the garbage and every later recovery discards them."""
-        # the native flusher writes OUTSIDE self._lock: drain and close it
-        # first (close gates new appends), or the scan-then-truncate could
-        # chop an acknowledged batch the flusher lands in between
-        self.close()
         with self._lock:
-            entries, valid = self._scan()
-            if os.path.exists(self.path):
-                size = os.path.getsize(self.path)
-                if valid < size:
-                    with open(self.path, "rb+") as f:
-                        f.truncate(valid)
+            # appends stay GATED through the whole drain+scan+truncate:
+            # an append landing between a drain and the truncate would
+            # sit after the torn garbage and be chopped despite having
+            # been acknowledged
+            self._closing = True
+            try:
+                self._drain_and_close_locked()
+                entries, valid = self._scan()
+                if os.path.exists(self.path):
+                    size = os.path.getsize(self.path)
+                    if valid < size:
+                        with open(self.path, "rb+") as f:
+                            f.truncate(valid)
+            finally:
+                self._closing = False
+                self._cond.notify_all()
 
     def reset(self) -> None:
         """Truncate after a checkpoint has made the log redundant."""

@@ -67,6 +67,12 @@ class GlobalConfiguration:
 
     # Plan cache entries (analog of OExecutionPlanCache [E]).
     plan_cache_size: int = 256
+
+    # Query RESULT cache ([E] OCommandCache) — rows of idempotent queries
+    # keyed by (sql, params, engine), invalidated by the mutation epoch.
+    # Disabled by default, matching the reference.
+    command_cache_enabled: bool = False
+    command_cache_size: int = 512
     # Parsed-statement cache entries (analog of OStatementCache [E]).
     statement_cache_size: int = 1024
 

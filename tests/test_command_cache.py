@@ -1,0 +1,89 @@
+"""Query result cache (SURVEY §2 core engine aux — the [E] OCommandCache
+analog): epoch-invalidated, LRU-bounded, disabled by default."""
+
+import pytest
+
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.metrics import metrics
+
+
+@pytest.fixture()
+def cached_db(social_db):
+    attach_fresh_snapshot(social_db)
+    old = config.command_cache_enabled
+    config.command_cache_enabled = True
+    yield social_db
+    config.command_cache_enabled = old
+
+
+Q = "SELECT name FROM Profiles WHERE age > :a ORDER BY name"
+
+
+class TestCommandCache:
+    def test_disabled_by_default(self, social_db):
+        assert config.command_cache_enabled is False
+        social_db.query("SELECT count(*) AS c FROM Profiles")
+        assert getattr(social_db, "_command_cache", None) is None
+
+    def test_hit_returns_same_rows_and_counts(self, cached_db):
+        h0 = metrics.counter("command_cache.hit")
+        r1 = cached_db.query(Q, params={"a": 28}).to_dicts()
+        r2 = cached_db.query(Q, params={"a": 28}).to_dicts()
+        assert r1 == r2
+        assert metrics.counter("command_cache.hit") == h0 + 1
+
+    def test_params_distinguish_entries(self, cached_db):
+        r1 = cached_db.query(Q, params={"a": 28}).to_dicts()
+        r2 = cached_db.query(Q, params={"a": 99}).to_dicts()
+        assert r1 != r2
+        assert len(cached_db._command_cache) == 2
+
+    def test_write_invalidates(self, cached_db):
+        before = cached_db.query(Q, params={"a": 0}).to_dicts()
+        cached_db.new_vertex("Profiles", name="aaa_new", age=50)
+        after = cached_db.query(Q, params={"a": 0}).to_dicts()
+        assert len(after) == len(before) + 1
+        assert {"name": "aaa_new"} in after
+
+    def test_tx_bypasses_cache(self, cached_db):
+        cached_db.query(Q, params={"a": 0}).to_dicts()
+        tx = cached_db.begin()
+        cached_db.new_vertex("Profiles", name="zzz_tx", age=40)
+        # inside the tx the overlay must be visible, not the cached rows
+        rows = cached_db.query(Q, params={"a": 0}).to_dicts()
+        assert {"name": "zzz_tx"} in rows
+        tx.rollback()
+
+    def test_lru_bound(self, cached_db):
+        cached_db._command_cache = None  # fresh
+        from orientdb_tpu.exec.command_cache import CommandCache
+
+        old_size = config.command_cache_size
+        config.command_cache_size = 4
+        try:
+            cached_db._command_cache = CommandCache()
+            for a in range(10):
+                cached_db.query(Q, params={"a": a})
+            assert len(cached_db._command_cache) <= 4
+        finally:
+            config.command_cache_size = old_size
+
+    def test_strict_distinguishes_entries(self, cached_db):
+        # a cached oracle-fallback result must not satisfy strict=True
+        from orientdb_tpu.ops.predicates import Uncompilable
+
+        q = "SELECT out('HasFriend').size() AS d FROM Profiles"
+        cached_db.query(q, engine="tpu")  # fallback cached (non-strict)
+        with pytest.raises(Uncompilable):
+            cached_db.query(q, engine="tpu", strict=True)
+
+    def test_mid_query_write_invalidates_not_masks(self, cached_db):
+        # the entry is stamped with the PRE-run epoch: a write during the
+        # query makes it stale instead of looking fresh
+        cache_like_epoch = cached_db.mutation_epoch
+        cached_db.query(Q, params={"a": 1}).to_dicts()
+        entry = cached_db._command_cache._map[
+            next(iter(cached_db._command_cache._map))
+        ]
+        assert entry[2] == cache_like_epoch
