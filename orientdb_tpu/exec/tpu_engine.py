@@ -262,6 +262,30 @@ class SizeSchedule:
 # ---------------------------------------------------------------------------
 
 
+class _SeedBox:
+    """Index-seeded root candidates as replay inputs.
+
+    ``spec`` (alias → padded capacity) is fixed at record time; ``current``
+    holds the live arrays — concrete during recording, tracers during a
+    replay trace (set by _CompiledPlan._replay from the dyn pytree)."""
+
+    __slots__ = ("spec", "current")
+
+    def __init__(self) -> None:
+        self.spec: Dict[str, int] = {}
+        self.current: Dict[str, object] = {}
+
+
+def _eq_conjuncts(e):
+    """Top-level `lhs = rhs` pairs of an AND tree."""
+    if isinstance(e, A.Binary):
+        if e.op == "AND":
+            yield from _eq_conjuncts(e.left)
+            yield from _eq_conjuncts(e.right)
+        elif e.op == "=":
+            yield e.left, e.right
+
+
 class PlanStep:
     __slots__ = ("kind", "alias", "edge", "reverse", "close")
 
@@ -504,6 +528,59 @@ class TpuMatchSolver:
                 aliases.append(sub.node(it.target).alias)
             masks = [self._compile_node(sub.nodes[a]) for a in aliases]
             self._not_compiled.append((aliases, masks, list(path.items)))
+        # index-seeded roots ([E] the planner's index-vs-scan choice,
+        # SURVEY.md §3.2): a root whose WHERE carries `field = :param` (or
+        # a literal) over an indexed field seeds its candidates from the
+        # host index — O(hits) instead of an O(|class|) hull scan, the
+        # difference between V-independent and V-linear point lookups.
+        # Seeds enter replays as jit inputs (see _SeedBox / _dyn_args).
+        self.seed_box = _SeedBox()
+        self._root_seeds: Dict[str, tuple] = {}
+        if config.index_root_seed and self.db._indexes is not None:
+            for st in self.plan:
+                if st.kind == "root":
+                    probe = self._root_seed_probe(st.alias)
+                    if probe is not None:
+                        self._root_seeds[st.alias] = probe
+
+    def _root_seed_probe(self, alias: str):
+        """(rhs expr, index) when the root's WHERE has an AND-conjunct
+        `field = <param|literal>` over a single-field index covering the
+        node's class; None otherwise."""
+        node = self.pattern.nodes[alias]
+        for f in node.filters:
+            if not f.class_name or f.where is None:
+                continue
+            for lhs, rhs in _eq_conjuncts(f.where):
+                if not isinstance(lhs, A.Identifier):
+                    lhs, rhs = rhs, lhs
+                if not isinstance(lhs, A.Identifier):
+                    continue
+                if not isinstance(rhs, (A.Parameter, A.Literal)):
+                    continue
+                idx = self.db._indexes.best_for(f.class_name, lhs.name)
+                if idx is not None:
+                    return (rhs, idx)
+        return None
+
+    def compute_seed(self, alias: str, params) -> np.ndarray:
+        """Host-side index probe: snapshot vertex indices whose indexed
+        field equals the (current) value — a SUPERSET filter input; the
+        admission mask still applies the full node check."""
+        rhs, index = self._root_seeds[alias]
+        if isinstance(rhs, A.Parameter):
+            key = rhs.name if rhs.name is not None else rhs.index
+            value = (params or {}).get(key)
+        else:
+            value = rhs.value
+        hits: List[int] = []
+        if value is not None:
+            for rid in index.get(value):
+                i = self.snap.idx_of(rid)
+                if i is not None:
+                    hits.append(i)
+        hits.sort()  # deterministic candidate order across replays
+        return np.asarray(hits, np.int32)
 
     # -- compile-time gating ------------------------------------------------
 
@@ -1127,6 +1204,20 @@ class TpuMatchSolver:
         clusters). Admission masks still run in full (the hull can
         contain foreign vertices)."""
         node = self.pattern.nodes[alias]
+        if alias in self._root_seeds:
+            if self.sched.recording:
+                hits = self.compute_seed(alias, self.params)
+                cap = max(_cap_of(len(hits)), K.bucket(1))
+                self.seed_box.spec[alias] = cap
+                arr = np.full(cap, -1, np.int32)
+                arr[: len(hits)] = hits
+                idx = jnp.asarray(arr)
+            else:
+                idx = self.seed_box.current[alias]  # [cap] replay input
+            mask = self._node_masks[alias](idx) & (idx >= 0)
+            cand, n, n_dev = self._compact(mask)
+            cand = K.take_pad(idx, cand, jnp.int32(-1))
+            return cand, n, n_dev
         V = self.dg.num_vertices
         start, end = 0, V
         for f in node.filters:
@@ -2304,6 +2395,8 @@ class _CompiledPlan(_AotWarmup):
         self.count_name = solver.count_only_name()
         #: dynamic parameters the compiled predicates actually read
         self.dyn_spec = dict(solver.param_box.used)
+        #: index-seeded root capacities (alias → padded length)
+        self.seed_spec = dict(solver.seed_box.spec)
         self.jitted = jax.jit(self._replay)
 
     def _replay(self, arrays, dyn):
@@ -2316,12 +2409,16 @@ class _CompiledPlan(_AotWarmup):
         saved = dg.arrays
         dg.arrays = arrays
         solver.param_box.set_current(dyn)
+        solver.seed_box.current = {
+            a: dyn[f"__seed__:{a}"] for a in self.seed_spec
+        }
         try:
             solver.sched.start_replay()
             table = solver.solve_table()
         finally:
             dg.arrays = saved
             solver.param_box.reset()
+            solver.seed_box.current = {}
         overflow = solver.sched.overflow_flag().astype(jnp.int32)
         count_dev = table.count_device.astype(jnp.int32)
         if self.count_name is not None or self.width == 0:
@@ -2348,6 +2445,15 @@ class _CompiledPlan(_AotWarmup):
             v = params[k]
             dtype = jnp.float32 if kind == "float" else jnp.int32
             dyn[k] = jnp.asarray(int(v) if kind != "float" else v, dtype)
+        for alias, cap in self.seed_spec.items():
+            hits = self.solver.compute_seed(alias, params)
+            if hits.shape[0] > cap:
+                # more index hits than the recorded capacity: this
+                # replay's buffers are too small — re-record (variants)
+                raise ScheduleOverflow(f"root seed '{alias}' > {cap}")
+            arr = np.full(cap, -1, np.int32)
+            arr[: hits.shape[0]] = hits
+            dyn[f"__seed__:{alias}"] = jnp.asarray(arr)
         return dyn
 
     def _warm_call(self):
@@ -2692,7 +2798,16 @@ def execute_batch(db, items) -> List:
             # sticky routing: repeated parameter values dispatch straight
             # to the variant that last served them
             plan = variants.pick(params)
-            pending.append((i, variants, plan, plan.dispatch(params or {})))
+            try:
+                dev = plan.dispatch(params or {})
+            except ScheduleOverflow:
+                # seed capacity overflow surfaces at dispatch (host-side
+                # index probe) — walk the variants now
+                out[i] = _run_variants(
+                    db, stmt, params, variants, tried=plan, fresh=fresh
+                )
+                continue
+            pending.append((i, variants, plan, dev))
     for _i, _v, _plan, dev in pending:
         try:
             dev.copy_to_host_async()

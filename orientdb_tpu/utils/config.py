@@ -68,6 +68,11 @@ class GlobalConfiguration:
     # Plan cache entries (analog of OExecutionPlanCache [E]).
     plan_cache_size: int = 256
 
+    # Root candidates seed from a host index when the root WHERE has an
+    # equality over an indexed field ([E] the index-vs-scan choice):
+    # point lookups become V-independent instead of hull scans.
+    index_root_seed: bool = True
+
     # Query RESULT cache ([E] OCommandCache) — rows of idempotent queries
     # keyed by (sql, params, engine), invalidated by the mutation epoch.
     # Disabled by default, matching the reference.
