@@ -169,6 +169,8 @@ class FailoverDatabase:
 
     def _retry(self, method: str, *a, idempotent: bool = True):
         with self._lock:
+            if getattr(self, "_closed", False):
+                raise RemoteError("client is closed")
             if self._db is None:
                 # a previous total outage left no connection; servers may
                 # be back — reconnect before giving up on the client object
@@ -214,8 +216,14 @@ class FailoverDatabase:
         return self._retry("create_database", name, idempotent=False)
 
     def close(self) -> None:
-        if self._db is not None:
-            self._db.close()
+        # under the lock: a concurrent _retry may be mid-reconnect, and
+        # closing the old connection while a new one is created would
+        # leak the replacement, leaving the client open after close()
+        with self._lock:
+            self._closed = True
+            if self._db is not None:
+                self._db.close()
+                self._db = None
 
     def __enter__(self) -> "FailoverDatabase":
         return self

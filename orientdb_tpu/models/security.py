@@ -180,7 +180,19 @@ class SecurityManager:
                 audit.auth_ok(u.name)
             return u
         if audit is not None:
-            audit.auth_fail(name)
+            if name:
+                audit.auth_fail(name)
+            else:
+                # bearer-token logins pass an empty caller name; a failed
+                # token must still leave an attributable trail, so log a
+                # marker plus a short digest of the presented credential
+                # (never the token itself)
+                import hashlib
+
+                digest = hashlib.sha256(
+                    (password or "").encode()
+                ).hexdigest()[:12]
+                audit.auth_fail(f"<bearer>#{digest}")
         return None
 
     def check(self, user: User, resource: str, op: str) -> None:

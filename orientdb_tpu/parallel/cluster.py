@@ -188,14 +188,38 @@ class Cluster:
                 return
             self._promote_locked(winner)
 
+    def _settled_lsn(self, m: ClusterMember) -> int:
+        """Stop m's puller and return its applied LSN with no apply still
+        in flight.
+
+        `request_stop` + acquiring the db's apply lock once is a barrier:
+        `pull_once` re-checks the stop flag under that lock, so after this
+        returns the old puller can never apply another entry. Without the
+        barrier a survivor could finish applying a fetched batch after the
+        coordinator sampled its LSN, end up AHEAD of the elected primary,
+        and silently diverge (its dedup floor skips the new primary's
+        conflicting entries at the same LSNs)."""
+        m.puller.request_stop()
+        lock = m.db.__dict__.setdefault("_repl_lock", threading.Lock())
+        with lock:
+            return max(
+                m.puller.applied_lsn,
+                getattr(m.db, "_repl_applied_lsn", 0),
+            )
+
     def _elect(self) -> Optional[str]:
-        """Most-caught-up replica: max applied LSN, name-ordered ties."""
+        """Most-caught-up replica: max settled applied LSN, name-ordered
+        ties. Stops every candidate's puller (they are all about to be
+        promoted or repointed anyway) so the sampled LSNs are final."""
         best: Optional[ClusterMember] = None
+        best_lsn = -1
         for m in sorted(self.members.values(), key=lambda m: m.name):
             if m.role != "REPLICA" or m.puller is None:
                 continue
-            if best is None or m.puller.applied_lsn > best.puller.applied_lsn:
-                best = m
+            lsn = self._settled_lsn(m)
+            m.puller.applied_lsn = lsn  # promotion/repoint read this
+            if lsn > best_lsn:
+                best, best_lsn = m, lsn
         return best.name if best is not None else None
 
     def promote(self, name: str) -> None:
@@ -208,7 +232,9 @@ class Cluster:
 
     def _promote_locked(self, name: str) -> None:
         m = self.members[name]
-        lsn = m.puller.applied_lsn if m.puller is not None else 0
+        # settle, not just read: the manual promote() path reaches here
+        # without _elect's stop-and-settle pass
+        lsn = self._settled_lsn(m) if m.puller is not None else 0
         if m.puller is not None:
             # signal-only stop: sibling puller threads may be blocked on
             # this cluster's lock to report the same dead primary — a
@@ -230,11 +256,29 @@ class Cluster:
     def _repoint(self, m: ClusterMember) -> None:
         """Point a surviving replica at the new primary, preserving its
         applied LSN; if its delta range is gone (it lagged past the new
-        primary's base), rebuild it fresh and full-sync."""
-        applied = m.puller.applied_lsn if m.puller is not None else 0
+        primary's base) OR it got AHEAD of the new primary (applied more
+        of the dead primary's stream than the winner — divergence the
+        dedup floor would otherwise hide), rebuild it fresh and
+        full-sync."""
+        applied = self._settled_lsn(m) if m.puller is not None else 0
         if m.puller is not None:
             m.puller.request_stop()  # signal-only: see _promote_locked
             m.puller = None
+        new_primary = self.members[self.primary]
+        base = getattr(new_primary.db, "_wal_base_lsn", 0)
+        if applied > base:
+            log.warning(
+                "replica %s applied past the new primary's base "
+                "(%d > %d); rebuilding fresh for full sync",
+                m.name,
+                applied,
+                base,
+            )
+            metrics.incr("cluster.replica_rebuild")
+            m.server.drop_database(self.dbname)
+            m.db = m.server.create_database(self.dbname)
+            self._start_puller(m, applied_lsn=0)
+            return
         self._start_puller(m, applied_lsn=applied)
         try:
             m.puller.pull_once()  # synchronous probe: surfaces a gap now

@@ -197,6 +197,12 @@ class ReplicaPuller:
         # per-puller applied_lsn alone would double-apply the overlap
         dblock = self.db.__dict__.setdefault("_repl_lock", threading.Lock())
         with self._lock, dblock:
+            if self._stop.is_set():
+                # request_stop is an apply BARRIER: once the stopper has
+                # acquired this db's apply lock after setting the flag, no
+                # further entries can land from this puller — the cluster
+                # election relies on that to sample a settled applied LSN
+                return 0
             if "checkpoint" in payload:
                 # full sync: the delta range is gone (late-armed source or
                 # pruned archives) — restore the shipped checkpoint

@@ -724,7 +724,14 @@ def restore_payload(db: Database, payload: Dict) -> int:
             idempotent=f.get("idempotent", True),
         )
     db._rr_state = dict(payload.get("rr_state", {}))
-    db.mutation_epoch = payload["epoch"]
+    # never move the epoch backwards onto a value already stamped into
+    # this db's command cache: a replica full-sync restoring the source's
+    # (smaller) counter could make pre-sync cached rows read as fresh.
+    # Bumping past the local epoch invalidates every cached entry; the
+    # cache itself is also dropped for immediate reclamation.
+    db.mutation_epoch = max(db.mutation_epoch + 1, payload["epoch"])
+    if getattr(db, "_command_cache", None) is not None:
+        db._command_cache = None
     return payload.get("lsn", 0)
 
 

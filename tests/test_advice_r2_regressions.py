@@ -1,0 +1,181 @@
+"""Regressions for the round-2 advisor findings (ADVICE.md):
+
+1. failover election must sample a SETTLED applied LSN (no in-flight
+   apply can land after sampling), and a survivor that got AHEAD of the
+   elected primary must be rebuilt, not silently diverge via the dedup
+   floor;
+2. restoring a checkpoint payload into a live database must never move
+   the mutation epoch backwards onto a value already stamped into the
+   command cache (stale cached rows would read as fresh);
+3. failed bearer-token logins (empty caller name) must leave an
+   attributable audit trail;
+4. FailoverDatabase.close() must be race-safe: after close() the client
+   is closed, never reconnecting behind the caller's back.
+"""
+
+import time
+
+import pytest
+
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.server.server import Server
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.metrics import metrics
+
+
+def wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def trio():
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("d")
+    cl = Cluster("d", user="admin", password="pw", interval=0.05, down_after=2)
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+def _caught_up(cl, names, lsn):
+    def ok():
+        st = cl.status()["members"]
+        return all(
+            st[n].get("status") == "ONLINE"
+            and st[n].get("applied_lsn", -1) >= lsn
+            for n in names
+        )
+
+    return ok
+
+
+class TestElectionSettlement:
+    def test_request_stop_is_an_apply_barrier(self, trio):
+        """After request_stop + acquiring the db's apply lock once, a
+        puller can never apply another entry — pull_once must re-check
+        the stop flag UNDER the lock and bail."""
+        cl, servers, pdb = trio
+        pdb.new_vertex("P", n=0)
+        lsn = pdb._wal.next_lsn - 1
+        assert wait_for(_caught_up(cl, ["n1", "n2"], lsn))
+        m = cl.members["n1"]
+        before = m.puller.applied_lsn
+        m.puller.request_stop()
+        pdb.new_vertex("P", n=1)  # new entries the stopped puller sees
+        # a direct pull (simulating the in-flight race) must apply nothing
+        assert m.puller.pull_once() == 0
+        assert m.puller.applied_lsn == before
+
+    def test_replica_ahead_of_new_primary_is_rebuilt(self, trio):
+        """A survivor whose applied LSN exceeds the elected primary's
+        base has data the new primary never saw at those LSNs — its
+        dedup floor would silently skip the new primary's conflicting
+        entries. It must full-sync from scratch instead."""
+        cl, servers, pdb = trio
+        for i in range(3):
+            pdb.new_vertex("P", n=i)
+        lsn = pdb._wal.next_lsn - 1
+        assert wait_for(_caught_up(cl, ["n1", "n2"], lsn))
+        # simulate n2 winning the race the barrier now prevents: it
+        # "applied" past what the about-to-be-promoted n1 saw
+        n2 = cl.members["n2"]
+        n2.puller.applied_lsn = lsn + 5
+        n2.db._repl_applied_lsn = lsn + 5
+        rebuilds = metrics.counter("cluster.replica_rebuild")
+        cl.promote("n1")
+        assert metrics.counter("cluster.replica_rebuild") == rebuilds + 1
+        # the rebuilt n2 converges on the new primary's stream
+        ndb = cl.primary_db()
+        ndb.new_vertex("P", n=99)
+
+        def converged():
+            try:
+                return cl.members["n2"].db.count_class("P") == 4
+            except ValueError:  # fresh rebuild: schema not synced yet
+                return False
+
+        assert wait_for(converged)
+
+
+class TestRestoreEpochMonotonic:
+    def test_restore_invalidates_command_cache(self):
+        from orientdb_tpu.models.database import Database
+        from orientdb_tpu.storage.durability import (
+            _checkpoint_payload,
+            restore_payload,
+        )
+
+        src = Database("src")
+        src.schema.create_vertex_class("P")
+        src.new_vertex("P", n=1)
+        payload = _checkpoint_payload(src)
+        payload["epoch"] = 0  # adversarial: source counter below target's
+
+        old = config.command_cache_enabled
+        config.command_cache_enabled = True
+        try:
+            dst = Database("dst")
+            assert dst.mutation_epoch == 0
+            # caches [{'c': 0}] stamped with epoch 0
+            assert dst.query("SELECT count(*) AS c FROM V").to_dicts() == [
+                {"c": 0}
+            ]
+            restore_payload(dst, payload)
+            assert dst.mutation_epoch > 0  # never backwards onto a stamp
+            rows = dst.query("SELECT count(*) AS c FROM V").to_dicts()
+            assert rows == [{"c": 1}]  # restored data, not the stale cache
+        finally:
+            config.command_cache_enabled = old
+
+
+class TestBearerAuditAttribution:
+    def test_failed_token_login_is_attributable(self):
+        from orientdb_tpu.server.audit import AuditLog
+
+        srv = Server(admin_password="pw")
+        srv.startup()
+        try:
+            audit = AuditLog()
+            srv.security.audit = audit
+            assert srv.security.authenticate("", "tampered-token") is None
+            fails = [
+                e for e in audit.events() if e["kind"].startswith("auth")
+            ]
+            assert fails, "failed bearer login left no audit event"
+            who = fails[-1].get("user", "")
+            assert who.startswith("<bearer>#") and len(who) > len("<bearer>#")
+            # the raw credential must never appear in the trail
+            assert "tampered-token" not in who
+        finally:
+            srv.shutdown()
+
+
+class TestClientCloseRace:
+    def test_closed_client_stays_closed(self, trio):
+        cl, servers, pdb = trio
+        pdb.new_vertex("P", n=7)
+        from orientdb_tpu.client.remote import RemoteError, connect
+
+        addrs = ";".join(f"127.0.0.1:{s.binary_port}" for s in servers)
+        cli = connect(f"remote:{addrs}/d", "admin", "pw")
+        assert cli.query("SELECT count(*) AS c FROM P").to_dicts() == [{"c": 1}]
+        cli.close()
+        with pytest.raises(RemoteError):
+            cli.query("SELECT count(*) AS c FROM P")
+        cli.close()  # idempotent
