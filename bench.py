@@ -113,32 +113,62 @@ def main() -> None:
             sys.exit(1)
 
     from orientdb_tpu.exec.tpu_engine import drain_warmups
+    from orientdb_tpu.utils.metrics import metrics
 
-    def time_single(q, n=single_iters):
+    splits = {}
+
+    def _phase_split(before, after, n_queries):
+        """Per-query ms decomposition: device sync vs transfer vs host
+        marshalling, plus bytes fetched per query (VERDICT r2 #9 — the
+        MFU-style accounting perf work is aimed by)."""
+
+        def dur(name):
+            b = before["durations"].get(name, {}).get("total_s", 0.0)
+            a = after["durations"].get(name, {}).get("total_s", 0.0)
+            return (a - b) * 1000.0 / n_queries
+
+        b_bytes = before["counters"].get("tpu.bytes_fetched", 0)
+        a_bytes = after["counters"].get("tpu.bytes_fetched", 0)
+        return {
+            "device_ms": round(dur("tpu.device_s"), 3),
+            "transfer_ms": round(dur("tpu.transfer_s"), 3),
+            "host_ms": round(dur("tpu.host_s"), 3),
+            "kb_per_query": round((a_bytes - b_bytes) / n_queries / 1024, 1),
+        }
+
+    def time_single(q, n=single_iters, tag=None):
         run("tpu", q)  # warm (compiles the sync-free replay plan)
         drain_warmups()
+        before = metrics.snapshot()
         t0 = time.perf_counter()
         for _ in range(n):
             run("tpu", q)
-        return n / (time.perf_counter() - t0)
+        qps = n / (time.perf_counter() - t0)
+        if tag:
+            splits[tag] = _phase_split(before, metrics.snapshot(), n)
+        return qps
 
-    def time_batched(q, n=iters):
+    def time_batched(q, n=iters, tag=None):
         qs = [q] * batch
         db.query_batch(qs, engine="tpu", strict=True)  # warm
         drain_warmups()
+        before = metrics.snapshot()
         t0 = time.perf_counter()
         for _ in range(n):
             rss = db.query_batch(qs, engine="tpu", strict=True)
             for rs in rss:
                 rs.to_dicts()
-        return (n * batch) / (time.perf_counter() - t0)
+        qps = (n * batch) / (time.perf_counter() - t0)
+        if tag:
+            splits[tag] = _phase_split(before, metrics.snapshot(), n * batch)
+        return qps
 
-    single_qps = time_single(sql)
-    batched_qps = time_batched(sql)
-    rows_qps = time_batched(sql_rows)
-    var_qps = time_batched(sql_var)
-    trav_qps = time_batched(sql_trav)
-    select_qps = time_batched(sql_select)
+    single_qps = time_single(sql, tag="single_2hop")
+    batched_qps = time_batched(sql, tag="batched_2hop")
+    rows_qps = time_batched(sql_rows, tag="rows_1hop")
+    var_qps = time_batched(sql_var, tag="var_depth")
+    trav_qps = time_batched(sql_trav, tag="traverse")
+    select_qps = time_batched(sql_select, tag="select_count")
 
     # LDBC SNB interactive short reads (IS1–IS7) on an SF1-shaped graph
     snb_persons = int(os.environ.get("BENCH_SNB_PERSONS", "10000"))
@@ -213,6 +243,7 @@ def main() -> None:
                     "traverse_bfs_batched_qps": round(trav_qps, 3),
                     "select_count_batched_qps": round(select_qps, 3),
                     "ldbc_is": ldbc_is,
+                    "phase_split_ms_per_query": splits,
                     "snb_persons": snb_persons,
                     "oracle_2hop_qps": round(oracle_qps, 4),
                     "graph": {

@@ -63,8 +63,42 @@ from orientdb_tpu.ops.predicates import (
 from orientdb_tpu.sql import ast as A
 from orientdb_tpu.utils.config import config
 from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics, timed
 
 log = get_logger("tpu_engine")
+
+
+def _fetch_profiled(devs: List) -> List[np.ndarray]:
+    """Fetch dispatched device results with the 3-way accounting the
+    perf work aims by: device-sync time, transfer time, bytes moved
+    (`tpu.device_s` / `tpu.transfer_s` / `tpu.bytes_fetched`; host
+    marshalling is timed by callers as `tpu.host_s`). Execution is
+    in-order per device, so blocking on the LAST dispatched result
+    covers the whole batch with one sync instead of N."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    if len(devs) > 1:
+        # a lone query must not pay a separate sync round trip (the
+        # tunnel charges ~1 RTT per wave); its device/transfer split is
+        # folded into transfer_s — profile_execute decomposes singles
+        try:
+            devs[-1].block_until_ready()
+        except Exception:
+            pass  # already a host array (CPU backend fast paths)
+    t1 = _time.perf_counter()
+    for d in devs:
+        try:
+            d.copy_to_host_async()
+        except Exception:
+            pass  # CPU backend: already host-resident
+    arrs = [np.asarray(d) for d in devs]
+    t2 = _time.perf_counter()
+    if devs:
+        metrics.observe("tpu.device_s", t1 - t0)
+        metrics.observe("tpu.transfer_s", t2 - t1)
+        metrics.incr("tpu.bytes_fetched", sum(int(a.nbytes) for a in arrs))
+    return arrs
 
 
 
@@ -2351,7 +2385,9 @@ class _CompiledTraverse(_AotWarmup):
         return self.solver.rows_from(np.asarray(dev), self.count)
 
     def rows(self, params: Optional[Dict] = None) -> List[Result]:
-        return self.materialize(self.dispatch())
+        arr = _fetch_profiled([self.dispatch()])[0]
+        with timed("tpu.host_s"):
+            return self.materialize(arr)
 
 
 # ---------------------------------------------------------------------------
@@ -2483,7 +2519,9 @@ class _CompiledPlan(_AotWarmup):
         return self.solver.rows_from_table(self._table_from(arr), params)
 
     def rows(self, params: Optional[Dict] = None) -> List[Result]:
-        return self.materialize(self.dispatch(params), params)
+        arr = _fetch_profiled([self.dispatch(params)])[0]
+        with timed("tpu.host_s"):
+            return self.materialize(arr, params)
 
     def run(self) -> Table:
         arr = np.asarray(self.dispatch())
@@ -2808,20 +2846,17 @@ def execute_batch(db, items) -> List:
                 )
                 continue
             pending.append((i, variants, plan, dev))
-    for _i, _v, _plan, dev in pending:
-        try:
-            dev.copy_to_host_async()
-        except Exception:  # CPU backend: already host-resident
-            pass
-    for i, variants, plan, dev in pending:
-        stmt, params = items[i]
-        try:
-            out[i] = plan.materialize(dev, params or {})
-            variants.remember(params, plan)
-        except ScheduleOverflow:
-            out[i] = _run_variants(
-                db, stmt, params, variants, tried=plan, fresh=fresh
-            )
+    arrs = _fetch_profiled([dev for _i, _v, _plan, dev in pending])
+    with timed("tpu.host_s"):
+        for (i, variants, plan, _dev), arr in zip(pending, arrs):
+            stmt, params = items[i]
+            try:
+                out[i] = plan.materialize(arr, params or {})
+                variants.remember(params, plan)
+            except ScheduleOverflow:
+                out[i] = _run_variants(
+                    db, stmt, params, variants, tried=plan, fresh=fresh
+                )
     # a batch returns replay-ready: block on warm-ups this call started so
     # plans recorded here don't leak their XLA compile into the next batch
     for plan in fresh:
