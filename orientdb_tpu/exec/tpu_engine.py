@@ -68,20 +68,20 @@ from orientdb_tpu.utils.metrics import metrics, timed
 log = get_logger("tpu_engine")
 
 
-def _fetch_profiled(devs: List) -> List[np.ndarray]:
+def _fetch_profiled(devs: List, split_sync: bool = True) -> List[np.ndarray]:
     """Fetch dispatched device results with the 3-way accounting the
     perf work aims by: device-sync time, transfer time, bytes moved
     (`tpu.device_s` / `tpu.transfer_s` / `tpu.bytes_fetched`; host
     marshalling is timed by callers as `tpu.host_s`). Execution is
     in-order per device, so blocking on the LAST dispatched result
-    covers the whole batch with one sync instead of N."""
+    covers the whole batch with one sync instead of N. ``split_sync=
+    False`` skips the separate sync wave — a lone query must not pay an
+    extra round trip just for the split (the tunnel charges ~1 RTT per
+    wave); profile_execute decomposes singles instead."""
     import time as _time
 
     t0 = _time.perf_counter()
-    if len(devs) > 1:
-        # a lone query must not pay a separate sync round trip (the
-        # tunnel charges ~1 RTT per wave); its device/transfer split is
-        # folded into transfer_s — profile_execute decomposes singles
+    if split_sync and len(devs) > 1:
         try:
             devs[-1].block_until_ready()
         except Exception:
@@ -99,6 +99,11 @@ def _fetch_profiled(devs: List) -> List[np.ndarray]:
         metrics.observe("tpu.transfer_s", t2 - t1)
         metrics.incr("tpu.bytes_fetched", sum(int(a.nbytes) for a in arrs))
     return arrs
+
+
+#: smallest page (rows) a batched result fetch transfers; pow2 rounding
+#: up from here bounds the distinct sliced shapes per buffer to log2(W)
+_PAGE_MIN = 1024
 
 
 
@@ -2429,6 +2434,7 @@ class _CompiledPlan(_AotWarmup):
         self.count = table.count
         self.width = table.width
         self.count_name = solver.count_only_name()
+        self.fetch_limit = self._literal_fetch_limit(solver.stmt)
         #: dynamic parameters the compiled predicates actually read
         self.dyn_spec = dict(solver.param_box.used)
         #: index-seeded root capacities (alias → padded length)
@@ -2459,20 +2465,53 @@ class _CompiledPlan(_AotWarmup):
         count_dev = table.count_device.astype(jnp.int32)
         if self.count_name is not None or self.width == 0:
             # COUNT(*) plan (or column-less table): two scalars suffice
-            return jnp.stack([count_dev, overflow])
+            return jnp.stack([count_dev, overflow, jnp.int32(0)]), None, None
         flat: List[jnp.ndarray] = [table.cols[a] for a in self.v_names]
         for a in self.e_names:
             flat.extend(table.edge_cols[a])
         flat.extend(table.depth_cols[a] for a in self.d_names)
         if not flat:  # no columns (e.g. fully-detached optional pattern)
-            return jnp.stack([count_dev, overflow])
+            return jnp.stack([count_dev, overflow, jnp.int32(0)]), None, None
         width = flat[0].shape[0]
-        meta = jnp.zeros(width, jnp.int32).at[0].set(count_dev).at[1].set(overflow)
-        # one stacked buffer → ONE device→host transfer per query (the
-        # tunneled-TPU fetch RTT dominates small-result queries otherwise);
-        # the last two rows are the per-slot valid mask and [count,
-        # overflow] metadata
-        return jnp.stack(flat + [table.valid_device[:width], meta])
+        # front-pack live rows ON DEVICE (stable), so the host needs only
+        # the first `count` slots: the batch fetch path reads meta first
+        # and then transfers just a page-rounded live prefix instead of
+        # the whole capacity-padded buffer (at demodb scale the padded
+        # stack was ~1 MB/query on a ~10 MB/s tunnel — the measured
+        # rows-path bottleneck)
+        perm = K.compact_indices(table.valid_device[:width], width)
+        data = jnp.stack([K.take_pad(c, perm, -1) for c in flat])
+        # runtime bit-width election: when every live value fits int16
+        # (vertex indices on small graphs usually do; edge positions on
+        # big ones don't), the fetch ships the half-size copy — decided
+        # per dispatch by a meta flag, not per plan, so it stays general
+        live = jnp.arange(width, dtype=jnp.int32)[None, :] < count_dev
+        masked = jnp.where(live, data, 0)
+        fits16 = (
+            (jnp.max(masked) < 32767) & (jnp.min(masked) > -32768)
+        ).astype(jnp.int32)
+        meta = jnp.stack([count_dev, overflow, fits16])
+        # pre-materialized pow2 page prefixes (both dtypes): the batch
+        # fetch picks the smallest page covering the live count and reads
+        # an EXISTING device buffer — per-query slice dispatches after the
+        # meta wave measured ~15 ms each on the tunnel, dwarfing the
+        # bytes they saved. The full ladder costs ~3x the plain buffer in
+        # device memory (prefix sums ≈ 2x per dtype), so it is emitted
+        # only under a budget: wide plans (where a 64-deep batch of
+        # tripled result buffers could pressure HBM) fall back to the
+        # single full-width buffer per dtype — their transfers hide
+        # behind device compute in the interleaved fetch anyway.
+        C = int(data.shape[0])
+        pages32, pages16 = [], []
+        if 12 * width * C <= config.result_page_budget_bytes:
+            p = _PAGE_MIN
+            while p < width:
+                pages32.append(data[:, :p])
+                pages16.append(data[:, :p].astype(jnp.int16))
+                p *= 2
+        pages32.append(data)
+        pages16.append(data.astype(jnp.int16))
+        return meta, pages32, pages16
 
     def _dyn_args(self, params: Optional[Dict]) -> Dict:
         params = params if params is not None else self.solver.params
@@ -2501,56 +2540,97 @@ class _CompiledPlan(_AotWarmup):
         self.wait_compiled()
         return self.jitted(self.solver.dg.arrays, self._dyn_args(params))
 
-    def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
-        """Fetch a dispatched result and marshal rows (live count/mask)."""
-        arr = np.asarray(dev)
-        if self.count_name is not None or arr.ndim == 1:
-            count, overflow = int(arr[0]), int(arr[1])
-            if overflow:
-                raise ScheduleOverflow(str(self.solver.stmt))
-            if self.count_name is not None:
-                return self.solver.finalize_count(self.count_name, count, params)
+    def materialize(self, fetched, params: Optional[Dict] = None) -> List[Result]:
+        """Marshal rows from a dispatched `(meta, data)` pair.
+
+        Accepts device results or pre-fetched numpy arrays; `data` may be
+        a page-rounded live prefix of the full buffer (≥ `count` slots) —
+        only the first `count` rows are read — and may arrive int16 when
+        the dispatch's bit-width election shipped the half-size copy."""
+        if isinstance(fetched, tuple) and len(fetched) == 3:
+            meta_dev, data_dev, _p16 = fetched  # raw dispatch triple
+            if isinstance(data_dev, (list, tuple)):
+                data_dev = data_dev[-1] if data_dev else None  # full page
+        else:
+            meta_dev, data_dev = fetched
+        meta = np.asarray(meta_dev)
+        count, overflow = int(meta[0]), int(meta[1])
+        if overflow:
+            raise ScheduleOverflow(str(self.solver.stmt))
+        if self.count_name is not None:
+            return self.solver.finalize_count(self.count_name, count, params)
+        if data_dev is None:
             # column-less non-count table (degenerate): count empty rows
             t = Table(count=count, width=0)
             return self.solver.rows_from_table(t, params)
-        meta = arr[-1]
-        if int(meta[1]):
-            raise ScheduleOverflow(str(self.solver.stmt))
-        return self.solver.rows_from_table(self._table_from(arr), params)
+        data = np.asarray(data_dev)
+        if data.dtype != np.int32:
+            data = data.astype(np.int32)  # bit-width-elected fetch
+        return self.solver.rows_from_table(
+            self._table_from(data, self.fetch_rows_needed(count)), params
+        )
 
     def rows(self, params: Optional[Dict] = None) -> List[Result]:
-        arr = _fetch_profiled([self.dispatch(params)])[0]
+        meta_dev, pages32, _p16 = self.dispatch(params)
+        data_dev = pages32[-1] if pages32 else None
+        devs = [meta_dev] if data_dev is None else [meta_dev, data_dev]
+        arrs = _fetch_profiled(devs, split_sync=False)
+        data = arrs[1] if len(arrs) > 1 else None
         with timed("tpu.host_s"):
-            return self.materialize(arr, params)
+            return self.materialize((arrs[0], data), params)
 
-    def run(self) -> Table:
-        arr = np.asarray(self.dispatch())
-        if arr.ndim == 1:
-            if int(arr[1]):
-                raise ScheduleOverflow(str(self.solver.stmt))
-            return Table(count=int(arr[0]), width=0)
-        if int(arr[-1][1]):
-            raise ScheduleOverflow(str(self.solver.stmt))
-        return self._table_from(arr)
+    def fetch_rows_needed(self, count: int) -> int:
+        """How many live rows the host actually needs to marshal the
+        result: `count`, or `skip+limit` when a literal LIMIT can be
+        pushed into the transfer (no DISTINCT/UNWIND/ORDER/aggregate —
+        those need every row before the cut)."""
+        lim = self.fetch_limit
+        return count if lim is None else min(count, lim)
 
-    def _table_from(self, arr: np.ndarray) -> Table:
-        """Host table from the stacked transfer, compacted to live rows
-        via the valid mask (replay row counts are parameter-dependent)."""
-        valid = arr[-2]
-        sel = np.flatnonzero(valid > 0)
-        count = int(arr[-1][0])
-        # live count and mask population agree by construction; trust the
-        # mask for layout, the scalar for bookkeeping
-        t = Table(count=count, width=int(sel.shape[0]))
+    @staticmethod
+    def _literal_fetch_limit(stmt) -> Optional[int]:
+        """skip+limit as a plain int when LIMIT can cut the TRANSFER:
+        row-per-binding results only — DISTINCT/UNWIND/ORDER/GROUP/
+        aggregates and the $matches/$paths/$elements forms consume every
+        row before the cut, and non-literal expressions would need a ctx."""
+        from orientdb_tpu.exec.eval import contains_aggregate
+
+        if not isinstance(stmt, A.MatchStatement):
+            return None
+        if stmt.distinct or stmt.unwind or stmt.order_by or stmt.group_by:
+            return None
+        if stmt.limit is None:
+            return None
+        if any(contains_aggregate(p.expr) for p in stmt.returns):
+            return None
+        if len(stmt.returns) == 1 and isinstance(stmt.returns[0].expr, A.ContextVar):
+            return None
+        def lit(e):
+            if e is None:
+                return 0
+            if isinstance(e, A.Literal) and isinstance(e.value, int):
+                return e.value
+            return None
+        limit, skip = lit(stmt.limit), lit(stmt.skip)
+        if limit is None or skip is None or limit < 0:
+            return None
+        return skip + limit
+
+    def _table_from(self, data: np.ndarray, count: int) -> Table:
+        """Host table from the transferred live prefix: rows were
+        front-packed (stable) on device, so the first `count` slots of
+        every column are the live rows in expansion order."""
+        n = min(count, data.shape[1])
+        t = Table(count=n, width=n)
         i = 0
         for a in self.v_names:
-            t.cols[a] = arr[i][sel]
+            t.cols[a] = data[i][:n]
             i += 1
         for a in self.e_names:
-            t.edge_cols[a] = (arr[i][sel], arr[i + 1][sel])
+            t.edge_cols[a] = (data[i][:n], data[i + 1][:n])
             i += 2
         for a in self.d_names:
-            t.depth_cols[a] = arr[i][sel]
+            t.depth_cols[a] = data[i][:n]
             i += 1
         return t
 
@@ -2846,17 +2926,82 @@ def execute_batch(db, items) -> List:
                 )
                 continue
             pending.append((i, variants, plan, dev))
-    arrs = _fetch_profiled([dev for _i, _v, _plan, dev in pending])
+    # wave 1: metas (tiny, overlapped) — traverse plans ship their whole
+    # payload here since they have no meta/data split
+    meta_devs, data_devs = [], []
+    for _i, _v, _plan, dev in pending:
+        if isinstance(dev, tuple):
+            meta_devs.append(dev[0])
+            data_devs.append(dev[1:])  # (data32, data16)
+        else:
+            meta_devs.append(dev)
+            data_devs.append(None)
+    # interleaved fetch: the device executes the batch in dispatch order,
+    # so each query's meta is read as IT lands (not after the whole batch
+    # syncs) and its elected result page starts copying immediately —
+    # page transfers overlap the device compute of later queries instead
+    # of waiting behind it. Page choice: smallest pre-materialized pow2
+    # prefix covering the live count (and a literal LIMIT cuts `need`
+    # further); the meta's bit-width flag picks the int16 copy when live
+    # values allow, halving the bytes again.
+    import time as _time
+
+    for d in meta_devs:
+        try:
+            d.copy_to_host_async()
+        except Exception:
+            pass
+    t0 = _time.perf_counter()
+    metas: List = []
+    pages_sel: List = [None] * len(pending)
+    for k, (_i, _v, plan, _dev) in enumerate(pending):
+        meta = np.asarray(meta_devs[k])
+        metas.append(meta)
+        pair = data_devs[k]
+        if pair is None or not pair[0] or meta.ndim != 1 or int(meta[1]):
+            continue  # count-only result, traverse payload, or overflow
+        pages = pair[1] if int(meta[2]) else pair[0]
+        need = plan.fetch_rows_needed(int(meta[0]))
+        d = next(p for p in pages if int(p.shape[1]) >= need)
+        try:
+            d.copy_to_host_async()
+        except Exception:
+            pass
+        pages_sel[k] = d
+    t1 = _time.perf_counter()
+    datas: List = [None] * len(pending)
+    nbytes = sum(int(m.nbytes) for m in metas)
+    for k, d in enumerate(pages_sel):
+        if d is not None:
+            a = np.asarray(d)
+            datas[k] = a
+            nbytes += int(a.nbytes)
+    t2 = _time.perf_counter()
+    if pending:
+        # overlapped phases: the meta drain tracks device compute, the
+        # page drain is the transfer tail that didn't hide behind it
+        metrics.observe("tpu.device_s", t1 - t0)
+        metrics.observe("tpu.transfer_s", t2 - t1)
+        metrics.incr("tpu.bytes_fetched", nbytes)
+    overflowed = []
     with timed("tpu.host_s"):
-        for (i, variants, plan, _dev), arr in zip(pending, arrs):
+        for k, ((i, variants, plan, dev), meta) in enumerate(
+            zip(pending, metas)
+        ):
             stmt, params = items[i]
+            fetched = (meta, datas[k]) if isinstance(dev, tuple) else meta
             try:
-                out[i] = plan.materialize(arr, params or {})
+                out[i] = plan.materialize(fetched, params or {})
                 variants.remember(params, plan)
             except ScheduleOverflow:
-                out[i] = _run_variants(
-                    db, stmt, params, variants, tried=plan, fresh=fresh
-                )
+                overflowed.append((i, variants, plan))
+    # overflow fallbacks re-dispatch (and may re-record) whole plans —
+    # outside the host-marshalling timer so the phase split stays honest
+    for i, variants, plan in overflowed:
+        stmt, params = items[i]
+        out[i] = _run_variants(
+            db, stmt, params, variants, tried=plan, fresh=fresh
+        )
     # a batch returns replay-ready: block on warm-ups this call started so
     # plans recorded here don't leak their XLA compile into the next batch
     for plan in fresh:

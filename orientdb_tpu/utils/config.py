@@ -68,6 +68,12 @@ class GlobalConfiguration:
     # Plan cache entries (analog of OExecutionPlanCache [E]).
     plan_cache_size: int = 256
 
+    # Device-memory budget for a replay's pre-materialized result page
+    # ladder (pow2 prefixes in int32+int16, ~12 bytes/slot total): plans
+    # whose ladder would exceed this emit only the full-width buffers, so
+    # wide plans never triple their result memory under deep batches.
+    result_page_budget_bytes: int = 16 << 20
+
     # Root candidates seed from a host index when the root WHERE has an
     # equality over an indexed field ([E] the index-vs-scan choice):
     # point lookups become V-independent instead of hull scans.
