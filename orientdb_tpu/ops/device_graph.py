@@ -31,17 +31,30 @@ class DeviceColumn:
     `dictionary` (host-side) stays with the column so string predicates can
     be evaluated over the (small) dictionary on host and pushed to device as
     code-set membership masks.
+
+    On a mesh, columns are row-sharded over the ``shards`` axis
+    (``shard_pad`` rows per padded column, R per device) instead of
+    replicated — per-device property memory is O(V/S) (vertices) or
+    O(E/S) (edges), the SURVEY.md §7 SF100 per-chip budget. Predicate
+    gathers read them in jit global view; XLA's SPMD partitioner inserts
+    the cross-shard collectives (all_gather / all_to_all, its choice).
     """
 
     __slots__ = ("name", "kind", "dictionary", "_g", "_kv", "_kp")
 
-    def __init__(self, col: PropertyColumn, g: "DeviceGraph", prefix: str):
+    def __init__(
+        self,
+        col: PropertyColumn,
+        g: "DeviceGraph",
+        prefix: str,
+        shard_pad: Optional[int] = None,
+    ):
         self.name = col.name
         self.kind = col.kind
         self.dictionary = col.dictionary
         self._g = g
-        self._kv = g._put(f"{prefix}:v", col.values)
-        self._kp = g._put(f"{prefix}:p", col.present)
+        self._kv = g._put(f"{prefix}:v", col.values, shard_pad=shard_pad)
+        self._kp = g._put(f"{prefix}:p", col.present, shard_pad=shard_pad)
 
     @property
     def values(self):
@@ -59,8 +72,8 @@ class DeviceEdgeClass:
     mesh execution path reads the ``sh:*`` shard-wise layout instead
     (`orientdb_tpu/parallel/mesh_graph.py`), and uploading both would
     leave per-device HBM at O(E·(1+1/S)) instead of O(E/S). Edge property
-    columns stay replicated either way (predicate gathers run on every
-    device)."""
+    columns are row-sharded by edge range on a mesh (O(E/S) per device);
+    predicate gathers read them through XLA-inserted collectives."""
 
     __slots__ = ("class_name", "columns", "non_columnar", "num_edges", "_g", "_p")
 
@@ -77,8 +90,10 @@ class DeviceEdgeClass:
             g._put(f"{p}:indptr_in", csr.indptr_in)
             g._put(f"{p}:src", csr.src)
             g._put(f"{p}:edge_id_in", csr.edge_id_in)
+        e_pad = g._shard_pad_rows(int(csr.dst.shape[0]))
         self.columns: Dict[str, DeviceColumn] = {
-            n: DeviceColumn(c, g, f"{p}:c:{n}") for n, c in csr.edge_columns.items()
+            n: DeviceColumn(c, g, f"{p}:c:{n}", shard_pad=e_pad)
+            for n, c in csr.edge_columns.items()
         }
         self.non_columnar: Set[str] = set(getattr(csr, "non_columnar", ()))
         self.num_edges = int(csr.dst.shape[0])
@@ -132,9 +147,11 @@ class DeviceGraph:
         #: the single flat array store — a jit-arg pytree for compiled plans
         self._arrays: Dict[str, jnp.ndarray] = {}
         self._tls = threading.local()
-        self._put("v_class", snap.v_class)
+        v_pad = self._shard_pad_rows(self.num_vertices)
+        self._put("v_class", snap.v_class, shard_pad=v_pad, fill=-1)
         self.columns: Dict[str, DeviceColumn] = {
-            n: DeviceColumn(c, self, f"v:{n}") for n, c in snap.v_columns.items()
+            n: DeviceColumn(c, self, f"v:{n}", shard_pad=v_pad)
+            for n, c in snap.v_columns.items()
         }
         self.non_columnar: Set[str] = set(getattr(snap, "v_non_columnar", ()))
         self.edges: Dict[str, DeviceEdgeClass] = {
@@ -147,6 +164,7 @@ class DeviceGraph:
         self._class_ids: Dict[str, jnp.ndarray] = {}
         if self.mesh_graph is not None:
             self.mesh_graph.build(self)
+        self.memory_report()  # publish hbm.* gauges for /metrics
 
     @property
     def arrays(self) -> Dict[str, jnp.ndarray]:
@@ -171,8 +189,43 @@ class DeviceGraph:
     def mesh(self):
         return self.mesh_graph.mesh if self.mesh_graph is not None else None
 
-    def _put(self, key: str, arr) -> str:
+    def _shard_pad_rows(self, n: int) -> Optional[int]:
+        """Padded row count making ``n`` divisible by the shard count
+        (None when unsharded)."""
+        if self.mesh_graph is None:
+            return None
+        S = self.mesh_graph.n_shards
+        return max(1, -(-max(n, 1) // S)) * S
+
+    def _put(
+        self,
+        key: str,
+        arr,
+        shard_pad: Optional[int] = None,
+        fill: int = 0,
+    ) -> str:
         a = jnp.asarray(arr)
+        if (
+            self.mesh_graph is not None
+            and shard_pad is not None
+            and a.ndim == 1
+            and a.shape[0] > 0
+        ):
+            # row-shard over the mesh's shard axis (vertex- or edge-range
+            # ownership); padding rows carry `fill` and a False presence
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from orientdb_tpu.utils.config import config as _cfg
+
+            if shard_pad > a.shape[0]:
+                pad = jnp.full((shard_pad - a.shape[0],), fill, a.dtype)
+                a = jnp.concatenate([a, pad])
+            spec = NamedSharding(
+                self.mesh_graph.mesh, PartitionSpec(_cfg.mesh_shard_axis)
+            )
+            self._arrays[key] = jax.device_put(a, spec)
+            return key
         if self._replicated_spec is not None:
             import jax
 
@@ -183,6 +236,45 @@ class DeviceGraph:
     @property
     def v_class(self):
         return self.arrays["v_class"]
+
+    def memory_report(self) -> Dict[str, Dict[str, int]]:
+        """Per-device graph-memory accounting by category (the SURVEY.md
+        §5.5 HBM-occupancy observable): for each key group, logical bytes
+        and per-device bytes (= the largest addressable shard, so a
+        sharded array counts V/S-ish while a replicated one counts V).
+        Published to the metrics registry as ``hbm.*`` gauges."""
+        cats = {
+            "adjacency": 0,
+            "vertex_columns": 0,
+            "edge_columns": 0,
+            "other": 0,
+        }
+        logical = dict(cats)
+        for key, arr in self._arrays.items():
+            if key.startswith("sh:"):
+                cat = "adjacency"
+            elif key == "v_class" or key.startswith("v:"):
+                cat = "vertex_columns"
+            elif key.startswith("e:") and ":c:" in key:
+                cat = "edge_columns"
+            elif key.startswith("e:"):
+                cat = "adjacency"
+            else:
+                cat = "other"
+            logical[cat] += int(arr.nbytes)
+            try:
+                per_dev = max(
+                    int(s.data.nbytes) for s in arr.addressable_shards
+                )
+            except Exception:
+                per_dev = int(arr.nbytes)
+            cats[cat] += per_dev
+        from orientdb_tpu.utils.metrics import metrics
+
+        for cat, b in cats.items():
+            metrics.gauge(f"hbm.per_device.{cat}_bytes", b)
+        metrics.gauge("hbm.per_device.total_bytes", sum(cats.values()))
+        return {"per_device": cats, "logical": logical}
 
     def class_ids(self, class_name: str) -> jnp.ndarray:
         key = class_name.lower()

@@ -13,12 +13,15 @@ merging:
   into equal ranges for edge-parallel kernels (variable-depth bitmap hops,
   COUNT-pushdown segment sums).
 
-Vertex property columns stay replicated: they are O(V) while adjacency is
-O(E), and predicates gather from them on every device anyway. Binding
-tables are replicated too; each expansion step computes its shard's local
-contribution under ``shard_map`` and the shards merge with ``all_gather``
-(tables) or ``psum`` (bitmaps / weights) over ICI — the SURVEY.md §5.7
-frontier-merge design applied to the *real* engine, not a BFS toy.
+Vertex and edge property columns are row-sharded too (vertex- /
+edge-range ownership, `ops/device_graph.py`): per-device memory is
+O(V/S + E/S), the SURVEY.md §7 SF100 per-chip budget. Property gathers
+run in jit global view and XLA's SPMD partitioner inserts the
+cross-shard collectives. Binding tables stay replicated (they are
+query-sized, not graph-sized); each expansion step computes its shard's
+local contribution under ``shard_map`` and the shards merge with
+``all_gather`` (tables) or ``psum`` (bitmaps / weights) over ICI — the
+SURVEY.md §5.7 frontier-merge design applied to the *real* engine.
 
 All sharded buffers live in the owning ``DeviceGraph.arrays`` dict (keys
 prefixed ``sh:``), placed with a ``NamedSharding`` over the mesh's

@@ -22,10 +22,20 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._durations: Dict[str, Dict[str, float]] = {}
+        self._gauges: Dict[str, float] = {}
 
     def incr(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set an instantaneous value (e.g. per-device HBM bytes)."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_value(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0.0)
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -45,12 +55,14 @@ class MetricsRegistry:
             return {
                 "counters": dict(self._counters),
                 "durations": {k: dict(v) for k, v in self._durations.items()},
+                "gauges": dict(self._gauges),
             }
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._durations.clear()
+            self._gauges.clear()
 
 
 #: the process-wide instance (the reference's OProfiler is a singleton too)

@@ -95,3 +95,59 @@ def test_adjacency_is_actually_sharded(dbs):
     assert arr.sharding.spec[0] == "shards"
     shard_rows = {s.data.shape[0] for s in arr.addressable_shards}
     assert shard_rows == {arr.shape[0] // 4}
+
+
+class TestShardedMemoryScaling:
+    """Per-device graph memory must scale ~O(V/S + E/S): property columns
+    and adjacency are row-sharded, not replicated (VERDICT r2 weak #5 /
+    SURVEY.md §7 per-chip budget)."""
+
+    def test_per_device_bytes_scale_with_shards(self):
+        from orientdb_tpu.ops.device_graph import device_graph
+        from orientdb_tpu.parallel.sharded import make_mesh
+        from orientdb_tpu.storage.ingest import generate_demodb
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+        from orientdb_tpu.utils.metrics import metrics
+
+        def build(mesh):
+            db = generate_demodb(n_profiles=4000, avg_friends=8, seed=3)
+            attach_fresh_snapshot(db, mesh=mesh)
+            return device_graph(db.current_snapshot())
+
+        dg1 = build(None)
+        rep1 = dg1.memory_report()
+        mesh = make_mesh(8, replicas=1)
+        dg8 = build(mesh)
+        rep8 = dg8.memory_report()
+
+        for cat in ("vertex_columns", "edge_columns", "adjacency"):
+            logical = rep8["logical"][cat]
+            per_dev = rep8["per_device"][cat]
+            assert logical > 0, cat
+            # each device holds ~1/8 of the category (padding allows slack)
+            assert per_dev <= logical / 8 * 1.5, (
+                f"{cat}: {per_dev} vs logical {logical}"
+            )
+            # and the unsharded build replicates it in full
+            assert rep1["per_device"][cat] >= rep1["logical"][cat] * 0.99
+
+        # gauges published for /metrics
+        assert metrics.gauge_value("hbm.per_device.total_bytes") > 0
+
+    def test_sharded_columns_still_answer_predicates(self):
+        from orientdb_tpu.parallel.sharded import make_mesh
+        from orientdb_tpu.storage.ingest import generate_demodb
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+        db = generate_demodb(n_profiles=500, avg_friends=5, seed=4)
+        attach_fresh_snapshot(db, mesh=make_mesh(8, replicas=1))
+        q = (
+            "MATCH {class:Profiles, as:p, where:(age > 40)}"
+            "-HasFriend->{as:f, where:(age < p.age)} "
+            "RETURN p.uid AS p, f.uid AS f"
+        )
+        t = db.query(q, engine="tpu", strict=True).to_dicts()
+        o = db.query(q, engine="oracle").to_dicts()
+        assert sorted(map(tuple, (sorted(r.items()) for r in t))) == sorted(
+            map(tuple, (sorted(r.items()) for r in o))
+        )
