@@ -288,7 +288,11 @@ class Transaction:
                 db._tx_local.hook_buffer = None
                 db._tx_local.wal_buffer = None
             if db._wal is not None and wal_ops and not db._wal.replaying:
-                db._wal.append({"op": "tx", "ops": wal_ops})
+                tx_entry = {"op": "tx", "ops": wal_ops}
+                lsn = db._wal.append(tx_entry)
+                # quorum mode: the whole tx ships as ONE atomic entry and
+                # the commit blocks until a majority holds it
+                db._quorum_push(tx_entry, lsn)
             from orientdb_tpu.utils.metrics import metrics
 
             metrics.incr("tx.commit")

@@ -131,7 +131,18 @@ class Database:
             if buf is not None:
                 buf.append(entry)
                 return
-        w.append(entry)
+        lsn = w.append(entry)
+        self._quorum_push(entry, lsn)
+
+    def _quorum_push(self, entry: Dict, lsn: int) -> None:
+        """Synchronous majority replication when this database is a
+        quorum-mode primary (parallel/replication.py QuorumPusher): the
+        write does not return until a majority of the cluster holds the
+        entry. Raises QuorumError with the entry already in the local WAL
+        (in-doubt) when the cluster cannot ack."""
+        q = getattr(self, "_repl_quorum", None)
+        if q is not None:
+            q.replicate({**entry, "lsn": lsn})
 
     # -- cluster plumbing --------------------------------------------------
 

@@ -91,16 +91,58 @@ class Cluster:
         password: str = "admin",
         interval: float = 0.25,
         down_after: int = 4,
+        write_quorum: Optional[str] = None,
+        quorum_timeout: float = 2.0,
     ) -> None:
         self.dbname = dbname
         self.user = user
         self.password = password
         self.interval = interval
         self.down_after = down_after
+        #: None = async replication (v1); "majority" = every write blocks
+        #: until a majority of the cluster holds it ([E] the per-database
+        #: distributed config's writeQuorum:"majority")
+        self.write_quorum = write_quorum
+        self.quorum_timeout = quorum_timeout
         self.members: Dict[str, ClusterMember] = {}
         self.primary: Optional[str] = None
         self._lock = threading.RLock()
         self.failovers = 0
+
+    # -- quorum plumbing ----------------------------------------------------
+
+    def _replica_targets(self):
+        with self._lock:
+            return [
+                (m.name, m.url)
+                for m in self.members.values()
+                if m.role == "REPLICA"
+            ]
+
+    def _cluster_size(self) -> int:
+        with self._lock:
+            # DOWN members still count toward the majority denominator: a
+            # 3-node cluster that lost a node needs 2 acks, not 1-of-1
+            return len(self.members)
+
+    def _arm_quorum(self, db: Database) -> None:
+        if self.write_quorum != "majority":
+            return
+        from orientdb_tpu.parallel.replication import QuorumPusher
+
+        db._repl_quorum = QuorumPusher(
+            self.dbname,
+            self._replica_targets,
+            self._cluster_size,
+            user=self.user,
+            password=self.password,
+            timeout=self.quorum_timeout,
+            # failovers counts completed promotions: the initial primary
+            # writes at term 1, each successor at failovers+1 — replicas
+            # fence any push below their highest seen term
+            term=self.failovers + 1,
+            source_db=db,
+        )
 
     # -- membership ---------------------------------------------------------
 
@@ -111,6 +153,7 @@ class Cluster:
         with self._lock:
             self.members[name] = m
             self.primary = name
+        self._arm_quorum(db)
         return m
 
     def add_replica(self, name: str, server) -> ClusterMember:
@@ -139,6 +182,10 @@ class Cluster:
         for m in members:
             if m.puller is not None:
                 m.puller.stop()
+            q = getattr(m.db, "_repl_quorum", None)
+            if q is not None:
+                m.db._repl_quorum = None
+                q.close()
 
     def _start_puller(self, m: ClusterMember, applied_lsn: int = 0) -> None:
         primary = self.members[self.primary]
@@ -245,7 +292,8 @@ class Cluster:
         arm_promoted_source(m.db, lsn)
         m.role = "PRIMARY"
         self.primary = name
-        self.failovers += 1
+        self.failovers += 1  # before arming: the successor's term must
+        self._arm_quorum(m.db)  # exceed every predecessor's
         metrics.incr("cluster.failover")
         log.warning("promoted %s to PRIMARY at lsn %d", name, lsn)
         for other in self.members.values():
@@ -264,6 +312,13 @@ class Cluster:
         if m.puller is not None:
             m.puller.request_stop()  # signal-only: see _promote_locked
             m.puller = None
+        if self.write_quorum is not None:
+            # fence the dead primary NOW, not at the successor's first
+            # write: a partitioned predecessor pushing at its old term
+            # must never be acked by a repointed survivor
+            m.db._repl_term = max(
+                getattr(m.db, "_repl_term", 0), self.failovers + 1
+            )
         new_primary = self.members[self.primary]
         base = getattr(new_primary.db, "_wal_base_lsn", 0)
         if applied > base:
@@ -277,6 +332,8 @@ class Cluster:
             metrics.incr("cluster.replica_rebuild")
             m.server.drop_database(self.dbname)
             m.db = m.server.create_database(self.dbname)
+            if self.write_quorum is not None:
+                m.db._repl_term = self.failovers + 1
             self._start_puller(m, applied_lsn=0)
             return
         self._start_puller(m, applied_lsn=applied)
@@ -292,6 +349,8 @@ class Cluster:
             m.puller.request_stop()
             m.server.drop_database(self.dbname)
             m.db = m.server.create_database(self.dbname)
+            if self.write_quorum is not None:
+                m.db._repl_term = self.failovers + 1
             self._start_puller(m, applied_lsn=0)
         except Exception:
             pass  # transient; the puller thread keeps retrying

@@ -49,6 +49,170 @@ class ReplicationGap(Exception):
     fresh database instead."""
 
 
+class QuorumError(Exception):
+    """A synchronous write could not reach a majority of the cluster.
+    The entry IS in the primary's local WAL (in-doubt): if the primary
+    survives, pullers eventually replicate it; if the primary dies first,
+    the conflict-safe election may discard it — exactly the ambiguity the
+    error reports to the writer ([E] the reference's distributed tx
+    surfaces ODistributedOperationException the same way)."""
+
+
+def apply_pushed_entries(
+    db: Database, entries: List[Dict], term: Optional[int] = None
+) -> int:
+    """Replica-side apply for quorum-pushed entries; returns the applied
+    LSN floor AFTER the batch.
+
+    Shares the db-level apply lock and LSN floor with `ReplicaPuller`, so
+    pushes and background delta pulls coexist without double-applies.
+    CONTIGUITY is enforced: an entry past floor+1 is refused (applying it
+    would hide a gap under the dedup floor), leaving catch-up to the
+    puller — so a positive ack always means "this replica holds the full
+    prefix through this LSN", the property quorum counting relies on.
+    ``term`` fences stale primaries: pushes carrying a term below the
+    replica's current one are refused outright (a partitioned
+    predecessor keeps "succeeding" locally but can never ack here)."""
+    dblock = db.__dict__.setdefault("_repl_lock", threading.Lock())
+    with dblock:
+        if term is not None:
+            cur = getattr(db, "_repl_term", 0)
+            if term < cur:
+                return -1  # fenced: never an ack
+            db._repl_term = term
+        floor = getattr(db, "_repl_applied_lsn", 0)
+        for e in entries:
+            lsn = e["lsn"]
+            if lsn <= floor:
+                continue  # already here via an earlier push or a pull
+            if lsn > floor + 1:
+                break  # gap: refuse; the puller will close it
+            _apply_entry(db, e)
+            floor = lsn
+            db._repl_applied_lsn = floor
+    return floor
+
+
+class QuorumPusher:
+    """Primary-side synchronous WAL shipping: every appended entry is
+    pushed to all replicas in parallel and the write blocks until a
+    MAJORITY of the cluster (counting the primary) holds it — the [E]
+    writeQuorum:"majority" ack discipline over this package's WAL
+    transport instead of Hazelcast tasks. Single-writer: there is no
+    cross-primary conflict resolution to do, so "2-phase" reduces to
+    (1) durable append + parallel ship, (2) ack after majority — a tx's
+    buffered ops ship as ONE atomic `tx` entry, making multi-op commits
+    all-or-nothing on every member ([E] the distributed tx task batch).
+    """
+
+    def __init__(
+        self,
+        dbname: str,
+        targets,
+        cluster_size,
+        user: str = "admin",
+        password: str = "admin",
+        timeout: float = 2.0,
+        term: int = 1,
+        source_db: Optional[Database] = None,
+    ) -> None:
+        self.dbname = dbname
+        #: fencing term: bumped by every failover, checked by replicas
+        self.term = term
+        #: primary database, for gap backfill from its WAL
+        self.source_db = source_db
+        #: callable -> [(member_name, base_url)] of current replicas
+        self.targets = targets
+        #: callable -> total member count (majority denominator; DOWN
+        #: members still count — a 2-of-3 cluster needs 2 acks, not 1)
+        self.cluster_size = cluster_size
+        self.user = user
+        self.password = password
+        self.timeout = timeout
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=8)
+
+    def _post(self, url: str, entries: List[Dict]) -> int:
+        cred = base64.b64encode(
+            f"{self.user}:{self.password}".encode()
+        ).decode()
+        body = json.dumps({"entries": entries, "term": self.term}).encode()
+        req = urllib.request.Request(
+            f"{url}/replication/{self.dbname}/apply",
+            data=body,
+            headers={
+                "Authorization": f"Basic {cred}",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read()).get("applied_lsn", 0)
+
+    def _push_one(self, url: str, entry: Dict) -> bool:
+        lsn = entry["lsn"]
+        floor = self._post(url, [entry])
+        if floor >= lsn:
+            return True
+        if floor < 0 or self.source_db is None:
+            return False  # fenced, or no backfill source
+        # the replica is mid-catch-up (its puller hasn't closed the gap
+        # below this entry yet): backfill the missing range from the
+        # primary's WAL and retry once — steady-state pushes then ack
+        # without waiting a pull interval
+        payload = entries_after(self.source_db, floor)
+        fill = [e for e in payload.get("entries", ()) if e["lsn"] <= lsn]
+        if not fill:
+            return False  # range gone (checkpoint case): puller territory
+        return self._post(url, fill) >= lsn
+
+    def replicate(self, entry: Dict) -> int:
+        """Block until a majority holds `entry`; returns the ack count
+        (including the primary) or raises QuorumError."""
+        targets = list(self.targets())
+        total = max(int(self.cluster_size()), 1 + len(targets))
+        need = total // 2 + 1  # majority, counting the primary's copy
+        acks = 1
+        if acks >= need:
+            return acks
+        futs = [
+            self._pool.submit(self._push_one, url, entry)
+            for _name, url in targets
+        ]
+        from concurrent.futures import FIRST_COMPLETED, wait
+
+        pending = set(futs)
+        deadline = self.timeout + 0.5
+        import time as _time
+
+        t_end = _time.monotonic() + deadline
+        while pending and acks < need:
+            done, pending = wait(
+                pending,
+                timeout=max(0.0, t_end - _time.monotonic()),
+                return_when=FIRST_COMPLETED,
+            )
+            if not done and _time.monotonic() >= t_end:
+                break
+            for f in done:
+                try:
+                    if f.result():
+                        acks += 1
+                except Exception:
+                    pass  # dead/slow replica: no ack, never a blocker
+        if acks < need:
+            metrics.incr("replication.quorum_failed")
+            raise QuorumError(
+                f"write lsn={entry.get('lsn')} reached {acks}/{need} "
+                f"(cluster of {total})"
+            )
+        metrics.incr("replication.quorum_acked")
+        return acks
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
 def enable_replication_source(db: Database) -> None:
     """Arm a WAL so the database's committed ops are shippable. Durable
     databases already have one; in-memory sources get a throwaway log."""

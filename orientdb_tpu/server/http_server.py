@@ -233,6 +233,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self.server.ot_server.security.check(user, resource, op)
                 rows = db.command(sql).to_dicts()
                 return self._send(200, {"result": rows})
+            if head == "replication" and len(rest) == 2 and rest[1] == "apply":
+                # quorum-push apply ([E] the distributed task execution
+                # endpoint); admin-only like the pull stream
+                self.server.ot_server.security.check(
+                    user, "server.replication", "update"
+                )
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                from orientdb_tpu.parallel.replication import (
+                    apply_pushed_entries,
+                )
+
+                payload = json.loads(self._body() or b"{}")
+                floor = apply_pushed_entries(
+                    db, payload.get("entries", ()), payload.get("term")
+                )
+                return self._send(200, {"applied_lsn": floor})
             if head == "document" and len(rest) == 1:
                 db = self._db(rest[0])
                 if db is None:

@@ -1,0 +1,149 @@
+"""Quorum-acked replication (VERDICT r2 #4 / SURVEY.md §2 "Distributed":
+the [E] writeQuorum:"majority" discipline over WAL-shipping transport).
+
+Contract under test:
+- a write is acknowledged only after a MAJORITY of the cluster holds it
+  (primary's copy counts);
+- a killed replica does not block writes (majority from the rest);
+- a killed primary loses no acked writes (the election's max-settled-LSN
+  winner holds every majority-acked entry);
+- transactions ship as ONE atomic entry (all-or-nothing on replicas);
+- a fenced (stale-term) primary can never be acked by repointed
+  survivors.
+"""
+
+import time
+
+import pytest
+
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.parallel.replication import QuorumError, apply_pushed_entries
+from orientdb_tpu.server.server import Server
+
+
+def wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def qtrio():
+    """Primary + two replicas with write_quorum=majority."""
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("q")
+    cl = Cluster(
+        "q",
+        user="admin",
+        password="pw",
+        interval=0.05,
+        down_after=2,
+        write_quorum="majority",
+        quorum_timeout=2.0,
+    )
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+class TestQuorumAck:
+    def test_write_lands_on_majority_synchronously(self, qtrio):
+        cl, servers, pdb = qtrio
+        pdb.new_vertex("P", n=1)
+        # NO wait: the write returned, so a majority must already hold it
+        holders = sum(
+            1
+            for m in cl.members.values()
+            if m.db.count_class("P") == 1
+        )
+        assert holders >= 2  # primary + at least one replica
+
+    def test_killed_replica_does_not_block_writes(self, qtrio):
+        cl, servers, pdb = qtrio
+        pdb.new_vertex("P", n=1)
+        servers[2].shutdown()  # kill one replica
+        t0 = time.perf_counter()
+        pdb.new_vertex("P", n=2)  # must succeed: 2-of-3 majority
+        assert time.perf_counter() - t0 < cl.quorum_timeout + 2
+        assert pdb.count_class("P") == 2
+        assert wait_for(lambda: cl.members["n1"].db.count_class("P") == 2)
+
+    def test_both_replicas_down_blocks_writes(self, qtrio):
+        cl, servers, pdb = qtrio
+        pdb.new_vertex("P", n=1)
+        servers[1].shutdown()
+        servers[2].shutdown()
+        with pytest.raises(QuorumError):
+            pdb.new_vertex("P", n=2)
+        # the in-doubt entry stayed in the local WAL (documented): the
+        # local store applied it, but the client saw the failure
+        assert pdb.count_class("P") == 2
+
+    def test_killed_primary_loses_no_acked_writes(self, qtrio):
+        cl, servers, pdb = qtrio
+        for i in range(5):
+            pdb.new_vertex("P", n=i)  # each acked by a majority
+        servers[0].shutdown()
+        assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
+        ndb = cl.primary_db()
+        # every acked write survived the failover
+        assert ndb.count_class("P") == 5
+        ns = sorted(d["n"] for d in ndb.browse_class("P"))
+        assert ns == [0, 1, 2, 3, 4]
+        # and the successor accepts quorum writes (its own pusher armed)
+        ndb.new_vertex("P", n=99)
+        other = "n2" if cl.status()["primary"] == "n1" else "n1"
+        assert wait_for(lambda: cl.members[other].db.count_class("P") == 6)
+
+    def test_tx_ships_atomically_under_quorum(self, qtrio):
+        cl, servers, pdb = qtrio
+        pdb.begin()
+        pdb.new_vertex("P", n=10)
+        pdb.new_vertex("P", n=11)
+        pdb.commit()  # one atomic tx entry, majority-acked
+        holders = sum(
+            1 for m in cl.members.values() if m.db.count_class("P") == 2
+        )
+        assert holders >= 2
+
+    def test_stale_term_push_is_fenced(self, qtrio):
+        cl, servers, pdb = qtrio
+        pdb.new_vertex("P", n=1)
+        servers[0].shutdown()
+        assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
+        other = "n2" if cl.status()["primary"] == "n1" else "n1"
+        odb = cl.members[other].db
+        floor_before = getattr(odb, "_repl_applied_lsn", 0)
+        # a partitioned predecessor pushing at its old term (1) must be
+        # refused by the repointed survivor
+        res = apply_pushed_entries(
+            odb,
+            [
+                {
+                    "lsn": floor_before + 1,
+                    "op": "create",
+                    "rid": "#99:0",
+                    "class": "P",
+                    "type": "vertex",
+                    "fields": {"n": {"t": "long", "v": 666}},
+                    "version": 1,
+                }
+            ],
+            term=1,
+        )
+        assert res == -1  # fenced, no ack
+        assert all(d["n"] != 666 for d in odb.browse_class("P"))
