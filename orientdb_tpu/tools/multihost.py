@@ -1,0 +1,110 @@
+"""Multi-host (multi-process) sharded execution — the DCN analog.
+
+The reference scales across servers with Hazelcast over TCP
+(SURVEY.md §2 "Distributed", §5.8); the TPU-native control plane is the
+**jax distributed runtime**: N processes, each owning a slice of the
+device mesh, executing ONE logical SPMD program — collectives ride ICI
+within a host and DCN (here: Gloo over loopback TCP) between hosts
+(SURVEY.md:149, 352 "host/control plane + multi-slice = jax distributed
+runtime / gRPC over DCN").
+
+``main(process_id, coordinator_port, n_procs, local_devices)`` joins the
+process group, builds the SAME demodb-shaped graph in every process
+(deterministic seed — the ingest analog of every host reading the same
+snapshot), attaches it sharded over the GLOBAL mesh, and runs the
+BASELINE-shaped sharded-MATCH corpus (`tools/dryrun.QUERIES`) at oracle
+parity. Each process holds only its addressable shards of adjacency and
+property columns (O(V/S + E/S) per process); replicated results are
+fully addressable everywhere, so materialization needs no extra
+cross-host step.
+
+Run by `tests/test_multihost.py` as 2 real processes on one machine —
+the multi-server-in-one-JVM pattern of the reference's distributed tests
+(SURVEY.md §4), with real inter-process collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(
+    process_id: int,
+    coordinator_port: int,
+    n_procs: int = 2,
+    local_devices: int = 4,
+) -> int:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    kept = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    os.environ["XLA_FLAGS"] = " ".join(
+        kept + [f"--xla_force_host_platform_device_count={local_devices}"]
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{coordinator_port}",
+        num_processes=n_procs,
+        process_id=process_id,
+    )
+    import numpy as np
+
+    from orientdb_tpu.parallel.sharded import make_mesh
+    from orientdb_tpu.storage.ingest import generate_demodb
+    from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+    from orientdb_tpu.tools.dryrun import QUERIES
+
+    devs = jax.devices()
+    assert len(devs) == n_procs * local_devices, (
+        f"expected {n_procs * local_devices} global devices, got {len(devs)}"
+    )
+    n_local = len(jax.local_devices())
+    assert n_local == local_devices
+    # 2 replicas x (n_procs*local/2) shards: the shard axis SPANS hosts,
+    # so expansion all_gathers and bitmap psums cross the process boundary
+    mesh = make_mesh(len(devs), replicas=2, devices=devs)
+    db = generate_demodb(n_profiles=64, avg_friends=4, seed=1)
+    attach_fresh_snapshot(db, mesh=mesh)
+
+    def canon(rows):
+        return sorted(tuple(sorted(r.items())) for r in rows)
+
+    for sql, params in QUERIES:
+        recorded = canon(
+            db.query(sql, params=params, engine="tpu", strict=True).to_dicts()
+        )
+        replayed = canon(
+            db.query(sql, params=params, engine="tpu", strict=True).to_dicts()
+        )
+        oracle = canon(db.query(sql, params=params, engine="oracle").to_dicts())
+        assert recorded == oracle, f"[proc {process_id}] record parity: {sql}"
+        assert replayed == oracle, f"[proc {process_id}] replay parity: {sql}"
+    # per-process memory really is a slice, not a replica
+    from orientdb_tpu.ops.device_graph import device_graph
+
+    rep = device_graph(db.current_snapshot()).memory_report()
+    adj_l, adj_d = rep["logical"]["adjacency"], rep["per_device"]["adjacency"]
+    assert adj_d * 2 < adj_l, f"adjacency not sharded: {adj_d} vs {adj_l}"
+    print(
+        f"multihost ok: proc {process_id}/{n_procs}, mesh "
+        f"{dict(mesh.shape)}, {len(QUERIES)} queries at oracle parity, "
+        f"adjacency {adj_d}B/device of {adj_l}B logical",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(
+        main(
+            int(sys.argv[1]),
+            int(sys.argv[2]),
+            int(sys.argv[3]) if len(sys.argv) > 3 else 2,
+            int(sys.argv[4]) if len(sys.argv) > 4 else 4,
+        )
+    )
