@@ -290,6 +290,7 @@ class Transaction:
             if db._wal is not None and wal_ops and not db._wal.replaying:
                 tx_entry = {"op": "tx", "ops": wal_ops}
                 lsn = db._wal.append(tx_entry)
+                db._mark_ckpt_dirty(tx_entry)
                 # quorum mode: the whole tx ships as ONE atomic entry and
                 # the commit blocks until a majority holds it
                 db._quorum_push(tx_entry, lsn)

@@ -132,7 +132,23 @@ class Database:
                 buf.append(entry)
                 return
         lsn = w.append(entry)
+        self._mark_ckpt_dirty(entry)
         self._quorum_push(entry, lsn)
+
+    def _mark_ckpt_dirty(self, entry: Dict) -> None:
+        """Track which records changed since the last (full or delta)
+        checkpoint, so `storage.durability.delta_checkpoint` serializes
+        O(dirty) records instead of the whole database. Derived from the
+        WAL entry itself, so every append site feeds it."""
+        dirty = self.__dict__.setdefault("_ckpt_dirty", set())
+        stack = [entry]
+        while stack:
+            e = stack.pop()
+            op = e.get("op")
+            if op in ("tx", "bulk"):
+                stack.extend(e.get("ops", ()))
+            elif op in ("create", "update", "delete"):
+                dirty.add(e["rid"])
 
     def _quorum_push(self, entry: Dict, lsn: int) -> None:
         """Synchronous majority replication when this database is a

@@ -113,7 +113,9 @@ class BulkLoader:
                 raise
             db.mutation_epoch += 1
             if wal_entries:
-                db._wal.append({"op": "bulk", "ops": wal_entries})
+                bulk_entry = {"op": "bulk", "ops": wal_entries}
+                db._wal.append(bulk_entry)
+                db._mark_ckpt_dirty(bulk_entry)
         n_v, n_e = len(self._vertices), len(self._edges)
         self._vertices = []
         self._edges = []

@@ -458,7 +458,36 @@ def _apply_entry(db: Database, e: Dict) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _checkpoint_payload(db: Database) -> Dict:
+def _rec_json(doc: Document, pos: int) -> Dict:
+    """One record's checkpoint form (shared by full and delta payloads)."""
+    r: Dict = {
+        "pos": pos,
+        "class": doc.class_name,
+        "type": (
+            "vertex"
+            if isinstance(doc, Vertex)
+            else "edge" if isinstance(doc, Edge) else "document"
+        ),
+        "version": doc.version,
+        "fields": _enc_fields(doc),
+    }
+    if isinstance(doc, Edge):
+        r["out"] = str(doc.out_rid)
+        r["in"] = str(doc.in_rid)
+    if isinstance(doc, Vertex):
+        bags = {}
+        for dname, table in (("out", doc._out_edges), ("in", doc._in_edges)):
+            b = {k: [str(x) for x in v] for k, v in table.items() if v}
+            if b:
+                bags[dname] = b
+        if bags:
+            r["bags"] = bags
+    return r
+
+
+def _meta_payload(db: Database) -> Dict:
+    """Schema/metadata part of a checkpoint (small, O(schema) not O(DB));
+    shared by full checkpoints and delta checkpoints."""
     classes = []
     for cls in db.schema.classes():
         classes.append(
@@ -486,36 +515,6 @@ def _checkpoint_payload(db: Database) -> Dict:
         {"name": i.name, "class": i.class_name, "fields": i.fields, "type": i.type}
         for i in (db._indexes.all() if db._indexes is not None else [])
     ]
-    clusters = {}
-    for cid, c in db._clusters.items():
-        recs = []
-        for pos, doc in enumerate(c.records):
-            if doc is None:
-                continue
-            r: Dict = {
-                "pos": pos,
-                "class": doc.class_name,
-                "type": (
-                    "vertex"
-                    if isinstance(doc, Vertex)
-                    else "edge" if isinstance(doc, Edge) else "document"
-                ),
-                "version": doc.version,
-                "fields": _enc_fields(doc),
-            }
-            if isinstance(doc, Edge):
-                r["out"] = str(doc.out_rid)
-                r["in"] = str(doc.in_rid)
-            if isinstance(doc, Vertex):
-                bags = {}
-                for dname, table in (("out", doc._out_edges), ("in", doc._in_edges)):
-                    b = {k: [str(x) for x in v] for k, v in table.items() if v}
-                    if b:
-                        bags[dname] = b
-                if bags:
-                    r["bags"] = bags
-            recs.append(r)
-        clusters[str(cid)] = {"len": len(c.records), "records": recs}
     sequences = [
         {
             "name": s.name,
@@ -546,9 +545,22 @@ def _checkpoint_payload(db: Database) -> Dict:
         "indexes": indexes,
         "sequences": sequences,
         "functions": functions,
-        "clusters": clusters,
         "rr_state": dict(db._rr_state),
     }
+
+
+def _checkpoint_payload(db: Database) -> Dict:
+    payload = _meta_payload(db)
+    clusters = {}
+    for cid, c in db._clusters.items():
+        recs = []
+        for pos, doc in enumerate(c.records):
+            if doc is None:
+                continue
+            recs.append(_rec_json(doc, pos))
+        clusters[str(cid)] = {"len": len(c.records), "records": recs}
+    payload["clusters"] = clusters
+    return payload
 
 
 def atomic_write(path: str, data: bytes) -> None:
@@ -565,6 +577,14 @@ def _ckpt_lsn_from_name(filename: str) -> int:
     """checkpoint-<epoch>-<lsn>-<digest>.json → lsn (0 if unparsable)."""
     try:
         return int(filename[len(CHECKPOINT_PREFIX):].split("-")[1])
+    except (IndexError, ValueError):
+        return 0
+
+
+def _delta_lsn_from_name(filename: str) -> int:
+    """delta-<epoch>-<lsn>-<digest>.json → lsn (0 if unparsable)."""
+    try:
+        return int(filename[len("delta-"):].split("-")[1])
     except (IndexError, ValueError):
         return 0
 
@@ -590,15 +610,13 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
     path = os.path.join(directory, name)
     atomic_write(path, data)
     if wal is not None:
-        upto = payload["lsn"]
-        wal.close()
-        if upto > 0 and os.path.exists(wal.path):
-            os.replace(
-                wal.path, os.path.join(directory, f"wal-{upto:012d}.log")
-            )
-        wal.next_lsn = upto + 1
-    # retire older checkpoints (keep the newest two for paranoia) and WAL
-    # archives fully covered by the oldest KEPT checkpoint
+        _rotate_wal(db, directory)
+    # a full checkpoint resets the delta-tracking baseline
+    db._ckpt_dirty = set()
+    db._ckpt_base_lsn = payload["lsn"]
+    # retire older checkpoints (keep the newest two for paranoia), deltas
+    # covered by the newest full checkpoint, and WAL archives fully
+    # covered by the oldest KEPT checkpoint
     cps = sorted(
         p for p in os.listdir(directory) if p.startswith(CHECKPOINT_PREFIX)
     )
@@ -607,6 +625,20 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
             os.remove(os.path.join(directory, old))
         except OSError:
             pass
+    newest_lsn = _ckpt_lsn_from_name(cps[-1]) if cps else 0
+    for f2 in os.listdir(directory):
+        covered_delta = (
+            f2.startswith(DELTA_PREFIX)
+            and f2.endswith(".json")
+            and _delta_lsn_from_name(f2) <= newest_lsn
+        )
+        # half-written artifacts from a crash mid-atomic_write
+        stale_tmp = f2.endswith(".json.tmp")
+        if covered_delta or stale_tmp:
+            try:
+                os.remove(os.path.join(directory, f2))
+            except OSError:
+                pass
     kept = cps[-2:]
     if kept:
         oldest_kept_lsn = min(_ckpt_lsn_from_name(c) for c in kept)
@@ -624,6 +656,269 @@ def _load_checkpoint(db: Database, path: str) -> int:
     with open(path, "rb") as f:
         payload = json.loads(f.read())
     return restore_payload(db, payload)
+
+
+# ---------------------------------------------------------------------------
+# delta checkpoints (O(writes-since-last), [E] the fuzzy-checkpoint analog)
+# ---------------------------------------------------------------------------
+
+DELTA_PREFIX = "delta-"
+
+
+def _rotate_wal(db: Database, directory: str) -> int:
+    """Archive the live log as ``wal-<upto>.log``; returns ``upto``."""
+    wal: WriteAheadLog = db._wal
+    upto = wal.next_lsn - 1
+    wal.close()
+    if upto > 0 and os.path.exists(wal.path):
+        os.replace(wal.path, os.path.join(directory, f"wal-{upto:012d}.log"))
+    wal.next_lsn = upto + 1
+    return upto
+
+
+def delta_checkpoint(db: Database, directory: Optional[str] = None) -> str:
+    """Write an incremental checkpoint: current state of the records
+    DIRTY since the last (full or delta) checkpoint, plus the (small)
+    full schema/metadata — cost O(writes-since-last), not O(DB)
+    (VERDICT r2 #6; [E] the WAL fuzzy-checkpoint low-water-mark,
+    SURVEY.md §5.4). Recovery = newest full checkpoint, then every delta
+    above it in LSN order, then the WAL tail; deltas are self-contained
+    state patches (absolute record states + absolute deletions), so
+    applying them over an older base after a corrupt-newest fallback is
+    still correct. Falls back to a FULL checkpoint when none exists yet
+    (the base the deltas build on)."""
+    directory = directory or _dir_of(db)
+    os.makedirs(directory, exist_ok=True)
+    has_full = any(
+        p.startswith(CHECKPOINT_PREFIX) for p in os.listdir(directory)
+    )
+    base_lsn = getattr(db, "_ckpt_base_lsn", None)
+    if not has_full or db._wal is None or base_lsn is None:
+        return checkpoint(db, directory)
+    with db._lock:
+        # snapshot WITHOUT clearing: the set is only trimmed after the
+        # delta file is durably published — an atomic_write failure must
+        # not permanently un-track records whose WAL coverage a later
+        # delta would then rotate away
+        dirty = set(db.__dict__.get("_ckpt_dirty") or ())
+        records = []
+        deleted = []
+        for rid_s in sorted(dirty):
+            rid = RID.parse(rid_s)
+            doc = db._load_raw(rid)
+            if doc is None:
+                deleted.append(rid_s)
+            else:
+                r = _rec_json(doc, rid.position)
+                r["cluster"] = rid.cluster
+                records.append(r)
+        payload = _meta_payload(db)  # O(schema), not O(DB)
+        payload.update(
+            kind="delta",
+            base_lsn=base_lsn,
+            cluster_lens={
+                str(cid): len(c.records) for cid, c in db._clusters.items()
+            },
+            records=records,
+            deleted=deleted,
+            lsn=db._wal.next_lsn - 1,
+        )
+    data = json.dumps(payload, separators=(",", ":")).encode()
+    digest = format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
+    name = (
+        f"{DELTA_PREFIX}{payload['epoch']:012d}-"
+        f"{payload['lsn']:012d}-{digest}.json"
+    )
+    path = os.path.join(directory, name)
+    atomic_write(path, data)
+    with db._lock:
+        cur = db.__dict__.get("_ckpt_dirty")
+        if cur:
+            cur -= dirty
+    db._ckpt_base_lsn = payload["lsn"]
+    _rotate_wal(db, directory)
+    metrics.incr("checkpoint.delta")
+    return path
+
+
+def _apply_delta(db: Database, payload: Dict) -> int:
+    """Apply a delta payload onto a recovered base; returns its LSN."""
+    # schema/metadata: absolute — create what's missing, drop what's gone
+    _sync_schema(db, payload)
+    # deletions first (cascade fixes survivors' adjacency, like WAL replay)
+    for rid_s in payload.get("deleted", ()):
+        doc = db._load_raw(RID.parse(rid_s))
+        if doc is not None:
+            db.delete(doc)
+    # grow clusters to their checkpointed lengths (positions are absolute)
+    for cid_s, ln in payload.get("cluster_lens", {}).items():
+        c = db._cluster(int(cid_s))
+        while len(c.records) < ln:
+            c.records.append(None)
+    # place records: docs/vertices first, edges second (endpoints exist)
+    idx = db._indexes
+    deferred = []
+    placed = []
+    for r in payload.get("records", ()):
+        rid = RID(r["cluster"], r["pos"])
+        if r["type"] == "edge":
+            deferred.append((rid, r))
+            continue
+        placed.append(_place_rec(db, rid, r, idx))
+    for rid, r in deferred:
+        placed.append(_place_rec(db, rid, r, idx))
+        e = db._load_raw(rid)
+        # rewire endpoints that were NOT themselves dirty (dirty ones
+        # carry their full final bags and get them below)
+        for end_rid, dname in ((e.out_rid, "out"), (e.in_rid, "in")):
+            v = db._load_raw(end_rid)
+            if isinstance(v, Vertex):
+                bag = v._bag(
+                    Direction.OUT if dname == "out" else Direction.IN,
+                    e.class_name,
+                )
+                if rid not in bag:
+                    bag.append(rid)
+    # authoritative bags for dirty vertices
+    for r in payload.get("records", ()):
+        if r["type"] != "vertex" or not r.get("bags"):
+            continue
+        doc = db._load_raw(RID(r["cluster"], r["pos"]))
+        if not isinstance(doc, Vertex):
+            continue
+        for dname, table in r["bags"].items():
+            target = doc._out_edges if dname == "out" else doc._in_edges
+            target.clear()
+            for cls_name, rids in table.items():
+                target[cls_name] = [RID.parse(x) for x in rids]
+    db._rr_state = dict(payload.get("rr_state", {}))
+    db.mutation_epoch = max(db.mutation_epoch + 1, payload["epoch"])
+    return payload.get("lsn", 0)
+
+
+def _place_rec(db: Database, rid: RID, r: Dict, idx) -> RID:
+    old = db._load_raw(rid)
+    if old is not None and idx is not None:
+        idx.on_delete(old)
+    fields = {k: _dec(v) for k, v in r["fields"].items()}
+    typ = r["type"]
+    if typ == "vertex":
+        doc: Document = Vertex(r["class"], fields)
+    elif typ == "edge":
+        doc = Edge(r["class"], fields)
+        doc.out_rid = RID.parse(r["out"])
+        doc.in_rid = RID.parse(r["in"])
+    else:
+        doc = Document(r["class"], fields)
+    doc._db = db
+    doc.rid = rid
+    doc.version = r["version"]
+    _place(db, rid, doc)
+    if idx is not None:
+        idx.on_save(doc)
+    return rid
+
+
+def _sync_schema(db: Database, payload: Dict) -> None:
+    """Make the live schema/metadata match a delta's absolute lists."""
+    schema = db.schema
+    pending = [c for c in payload["classes"]]
+    while pending:
+        progressed = False
+        for entry in list(pending):
+            if not all(schema.exists_class(s) for s in entry["superclasses"]):
+                continue
+            cls = schema.get_class(entry["name"])
+            if cls is None:
+                cls = schema.create_class(
+                    entry["name"],
+                    superclasses=entry["superclasses"],
+                    abstract=entry["abstract"],
+                    clusters=0,
+                )
+            # cluster ids are forced for EXISTING classes too: clusters
+            # added after the base checkpoint (add_cluster) must be
+            # re-registered or their records become unreachable
+            for cid in cls.cluster_ids:
+                schema._cluster_to_class.pop(cid, None)
+            cls.cluster_ids = list(entry["cluster_ids"])
+            for cid in cls.cluster_ids:
+                schema._cluster_to_class[cid] = cls.name
+            for p in entry["properties"]:
+                if cls.get_property(p["name"]) is None:
+                    cls.create_property(
+                        p["name"],
+                        PropertyType(p["type"]),
+                        mandatory=p["mandatory"],
+                        not_null=p["notNull"],
+                        read_only=p.get("readOnly", False),
+                        min_value=p.get("min"),
+                        max_value=p.get("max"),
+                        linked_class=p.get("linkedClass"),
+                    )
+            pending.remove(entry)
+            progressed = True
+        if not progressed:
+            log.warning("delta schema: unresolved classes %s", pending)
+            break
+    wanted = {c["name"].lower() for c in payload["classes"]}
+    for cls in list(schema.classes()):
+        if cls.name.lower() not in wanted and cls.name not in ("V", "E"):
+            try:
+                schema.drop_class(cls.name)
+            except Exception:
+                pass  # e.g. still has subclasses listed later
+    db.schema._next_cluster = max(
+        db.schema._next_cluster, payload.get("next_cluster", 0)
+    )
+    have_idx = (
+        {i.name: i for i in db._indexes.all()}
+        if db._indexes is not None
+        else {}
+    )
+    wanted_idx = {i["name"] for i in payload.get("indexes", ())}
+    for i in payload.get("indexes", ()):
+        if i["name"] not in have_idx:
+            db.indexes.create_index(
+                i["name"], i["class"], i["fields"], i["type"]
+            )
+    for name in list(have_idx):
+        if name not in wanted_idx:
+            db.indexes.drop_index(name)
+    have_seq = (
+        {s.name for s in db._sequences.all()}
+        if db._sequences is not None
+        else set()
+    )
+    for s in payload.get("sequences", ()):
+        if s["name"] in have_seq:
+            db.sequences.alter(s["name"], s["start"], s["increment"], s["cache"])
+        else:
+            db.sequences.create(
+                s["name"], s["type"], s["start"], s["increment"], s["cache"]
+            )
+        db.sequences.get(s["name"]).set_value(s["value"])
+    for s in list(have_seq):
+        if s not in {x["name"] for x in payload.get("sequences", ())}:
+            db.sequences.drop(s)
+    have_fn = (
+        {f.name for f in db._functions.all()}
+        if db._functions is not None
+        else set()
+    )
+    wanted_fn = {f["name"] for f in payload.get("functions", ())}
+    for f in payload.get("functions", ()):
+        if f["name"] not in have_fn:
+            db.functions.create(
+                f["name"],
+                f["body"],
+                f.get("parameters", ()),
+                language=f.get("language", "sql"),
+                idempotent=f.get("idempotent", True),
+            )
+    for f in list(have_fn):
+        if f not in wanted_fn:
+            db.functions.drop(f)
 
 
 def restore_payload(db: Database, payload: Dict) -> int:
@@ -816,15 +1111,60 @@ def open_database(directory: str, name: Optional[str] = None) -> Database:
             log.exception("checkpoint %s unreadable; trying older", cp)
             db = Database(name or os.path.basename(os.path.abspath(directory)))
             db._durability_dir = directory
+    # apply delta checkpoints above the base, in LSN order. A delta only
+    # covers records dirty since ITS base, so it is applied only when the
+    # chain is contiguous (base_lsn <= ckpt_lsn); after a corrupt-newest
+    # fallback to an older full checkpoint the chain is broken, and the
+    # uncovered span replays from the kept WAL archives instead — slower
+    # but exact (no acknowledged write can be skipped silently)
+    deltas = sorted(
+        (
+            p
+            for p in os.listdir(directory)
+            if p.startswith(DELTA_PREFIX) and p.endswith(".json")
+        ),
+        key=_delta_lsn_from_name,
+    )
+    for dp in deltas:
+        if _delta_lsn_from_name(dp) <= ckpt_lsn:
+            continue
+        try:
+            with open(os.path.join(directory, dp), "rb") as f:
+                data = f.read()
+            payload = json.loads(data)
+            if payload.get("base_lsn", 0) > ckpt_lsn:
+                log.warning(
+                    "delta %s builds on lsn %s > recovered %s (fallback "
+                    "to an older base?); replaying WAL instead",
+                    dp,
+                    payload.get("base_lsn"),
+                    ckpt_lsn,
+                )
+                break
+            ckpt_lsn = max(ckpt_lsn, _apply_delta(db, payload))
+        except Exception:
+            log.exception("delta %s unreadable/unappliable; stopping at "
+                          "the last good state", dp)
+            break
+    db._ckpt_base_lsn = ckpt_lsn
     wal = WriteAheadLog(os.path.join(directory, WAL_FILE))
     # a torn tail (crash mid-append) must be CUT, not just skipped: the
     # recovered process appends new acknowledged entries to this file, and
     # readers stop at the first corrupt line
     wal.truncate_torn_tail()
     # gather every segment (archives + live log): falling back to an older
-    # checkpoint needs the archived tail between the two checkpoints
+    # checkpoint needs the archived tail between the two checkpoints.
+    # Archives whose name-encoded max LSN is covered are skipped unread,
+    # so replay cost tracks the uncovered tail, not total history.
     entries: List[Dict] = []
     for seg in _wal_segments(directory):
+        base = os.path.basename(seg)
+        if base.startswith("wal-") and base.endswith(".log"):
+            try:
+                if int(base[4:-4]) <= ckpt_lsn:
+                    continue
+            except ValueError:
+                pass
         entries.extend(WriteAheadLog(seg).read_entries())
     entries.sort(key=lambda e: e["lsn"])
     wal.replaying = True
@@ -835,6 +1175,9 @@ def open_database(directory: str, name: Optional[str] = None) -> Database:
                 continue
             try:
                 _apply_entry(db, e)
+                # tail entries are changes SINCE the newest checkpoint:
+                # seed the dirty set so the next delta captures them
+                db._mark_ckpt_dirty(e)
             except Exception:
                 log.exception("wal replay failed at lsn=%s; stopping", e["lsn"])
                 break
