@@ -52,6 +52,12 @@ class RemoteDatabase:
         self._user, self._password = user, password
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        #: live-query demultiplexing (started by the first live_query):
+        #: a reader thread routes {"push": true} frames to subscriber
+        #: callbacks and everything else to the response queue
+        self._reader: Optional[threading.Thread] = None
+        self._resp_q = None
+        self._live_callbacks: Dict[int, object] = {}
         self._connect()
 
     # -- channel ------------------------------------------------------------
@@ -72,12 +78,70 @@ class RemoteDatabase:
                 raise RemoteConnectionError("connection closed")
             try:
                 send_frame(self._sock, req)
-                resp = recv_frame(self._sock)
+                if self._resp_q is not None:
+                    import queue
+
+                    try:
+                        resp = self._resp_q.get(timeout=30)
+                    except queue.Empty:
+                        raise RemoteConnectionError("response timeout")
+                else:
+                    resp = recv_frame(self._sock)
             except OSError as e:
                 raise RemoteConnectionError(str(e)) from e
             if resp is None:
                 raise RemoteConnectionError("connection lost")
             return resp
+
+    def _reader_loop(self) -> None:
+        sock = self._sock
+        while True:
+            try:
+                frame = recv_frame(sock)
+            except OSError:
+                frame = None
+            if frame is None:
+                if self._resp_q is not None:
+                    self._resp_q.put(None)  # unblock a waiting _call
+                return
+            if frame.get("push"):
+                ev = frame.get("event", {})
+                cb = self._live_callbacks.get(ev.get("token"))
+                if cb is not None:
+                    try:
+                        cb(ev)
+                    except Exception:
+                        pass  # subscriber errors must not kill the channel
+            else:
+                self._resp_q.put(frame)
+
+    def _ensure_reader(self) -> None:
+        """Switch the channel to demultiplexed mode (idempotent). Must be
+        called under no outstanding request; _call serializes via _lock."""
+        if self._reader is not None:
+            return
+        import queue
+
+        self._resp_q = queue.Queue()
+        self._reader = threading.Thread(target=self._reader_loop, daemon=True)
+        self._reader.start()
+
+    # -- live queries -------------------------------------------------------
+
+    def live_query(self, sql: str, callback) -> int:
+        """Subscribe to LIVE SELECT events pushed over this channel
+        ([E] the remote live-query monitor); returns the token. The
+        callback runs on the channel reader thread."""
+        with self._lock:
+            self._ensure_reader()
+        r = self._checked({"op": "live_subscribe", "sql": sql})
+        token = r["token"]
+        self._live_callbacks[token] = callback
+        return token
+
+    def live_unsubscribe(self, token: int) -> None:
+        self._live_callbacks.pop(token, None)
+        self._checked({"op": "live_unsubscribe", "token": token})
 
     def _checked(self, req: dict) -> dict:
         resp = self._call(req)
@@ -214,6 +278,15 @@ class FailoverDatabase:
 
     def create_database(self, name: str):
         return self._retry("create_database", name, idempotent=False)
+
+    def live_query(self, sql: str, callback) -> int:
+        """Subscribe on the CURRENT member; subscriptions do not survive
+        a failover (the reference's remote monitors don't either — the
+        client re-subscribes after reconnect)."""
+        return self._retry("live_query", sql, callback, idempotent=False)
+
+    def live_unsubscribe(self, token: int) -> None:
+        self._retry("live_unsubscribe", token, idempotent=False)
 
     def close(self) -> None:
         # under the lock: a concurrent _retry may be mid-reconnect, and

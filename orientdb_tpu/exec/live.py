@@ -131,6 +131,33 @@ def live_unsubscribe(db, token: int) -> bool:
     return _registry(db).remove(token)
 
 
+class BufferedEvents:
+    """Thread-safe event buffer with long-poll semantics: writers `push`,
+    a reader `drain(timeout)` blocks until at least one event (or the
+    timeout) and takes the whole buffer. The HTTP live-query transport
+    ([E] the reference pushes to remote clients; long-poll is the
+    pull-shaped equivalent over plain HTTP)."""
+
+    def __init__(self, keep: int = 1000) -> None:
+        self._events: List[dict] = []
+        self._cv = threading.Condition()
+        self._keep = keep
+
+    def push(self, ev: dict) -> None:
+        with self._cv:
+            self._events.append(ev)
+            del self._events[: -self._keep]
+            self._cv.notify_all()
+
+    def drain(self, timeout: float = 0.0) -> List[dict]:
+        with self._cv:
+            if not self._events and timeout > 0:
+                self._cv.wait(timeout)
+            out = self._events[:]
+            self._events.clear()
+            return out
+
+
 def subscribe(db, stmt: A.LiveSelectStatement, params) -> List[Result]:
     """SQL surface: events buffer on the monitor until consumed (pull style)
     or a callback replaces the buffer."""

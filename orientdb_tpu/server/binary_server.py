@@ -9,8 +9,11 @@ ORecordSerializerNetwork role — one canonical wire encoding — is played by
 `to_dicts` rows).
 
 Requests: {"op": ..., ...}. Ops: connect, db_list, db_create, db_open,
-query, command, load, save, delete, close. All ops after `connect` run
-under the authenticated user's permissions.
+query, command, load, save, delete, live_subscribe, live_unsubscribe,
+close. All ops after `connect` run under the authenticated user's
+permissions. Live-query events are PUSHED as unsolicited frames
+{"push": true, "event": {...}} on the same channel; clients demultiplex
+by the "push" key ([E] the binary protocol's push messages).
 """
 
 from __future__ import annotations
@@ -65,6 +68,16 @@ class _Session:
         self.sock = sock
         self.user = None
         self.db = None
+        #: responses and live-query push frames share the socket: the
+        #: send lock keeps a push from interleaving mid-response ([E] the
+        #: binary protocol's push messages ride the session channel too)
+        self._send_lock = threading.Lock()
+        #: token -> LiveQueryMonitor subscribed over THIS session
+        self._live: dict = {}
+
+    def _send(self, payload: dict) -> None:
+        with self._send_lock:
+            send_frame(self.sock, payload)
 
     def run(self) -> None:
         try:
@@ -73,12 +86,19 @@ class _Session:
                 if req is None:
                     break
                 resp = self._dispatch(req)
-                send_frame(self.sock, resp)
+                self._send(resp)
                 if req.get("op") == "close":
                     break
         except OSError:
             pass
         finally:
+            # a dropped session must not leave dangling subscriptions
+            for m in list(self._live.values()):
+                try:
+                    m.unsubscribe()
+                except Exception:
+                    pass
+            self._live.clear()
             try:
                 self.sock.close()
             except OSError:
@@ -147,6 +167,29 @@ class _Session:
                     else:
                         doc = self.db.new_element(cls, **payload)
                 return {"ok": True, "record": doc.to_dict()}
+            if op == "live_subscribe":
+                # push delivery over the session channel ([E]
+                # OLiveQueryHookV2 pushing to remote clients)
+                self.server.security.check(self.user, RES_RECORD, "read")
+                from orientdb_tpu.exec.live import live_query
+
+                session = self
+
+                def push(ev, session=session):
+                    try:
+                        session._send({"push": True, "event": ev})
+                    except OSError:
+                        pass  # client gone; cleanup happens on recv EOF
+
+                m = live_query(self.db, req["sql"], push)
+                self._live[m.token] = m
+                return {"ok": True, "token": m.token}
+            if op == "live_unsubscribe":
+                m = self._live.pop(req.get("token"), None)
+                if m is None:
+                    return {"ok": False, "error": "unknown live token"}
+                m.unsubscribe()
+                return {"ok": True}
             if op == "delete":
                 self.server.security.check(self.user, RES_RECORD, "delete")
                 doc = self.db.load(RID.parse(req["rid"]))
