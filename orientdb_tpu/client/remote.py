@@ -51,6 +51,8 @@ class RemoteDatabase:
         self.host, self.port, self.name = host, port, name
         self._user, self._password = user, password
         self._lock = threading.Lock()
+        #: per-response wait in demultiplexed mode (tests shrink it)
+        self._call_timeout = 30.0
         self._sock: Optional[socket.socket] = None
         #: live-query demultiplexing (started by the first live_query):
         #: a reader thread routes {"push": true} frames to subscriber
@@ -58,6 +60,19 @@ class RemoteDatabase:
         self._reader: Optional[threading.Thread] = None
         self._resp_q = None
         self._live_callbacks: Dict[int, object] = {}
+        #: push events for tokens with no registered callback yet: a push
+        #: can land between the server sending the subscribe response and
+        #: live_query registering the callback — buffered (bounded) and
+        #: drained once the token is known, so that window drops nothing.
+        #: Delivery happens UNDER the push lock (reader and drain alike)
+        #: so a subscriber never sees events out of order or concurrently;
+        #: re-entrant so a callback may live_unsubscribe itself.
+        self._orphan_pushes: Dict[int, List[dict]] = {}
+        self._push_lock = threading.RLock()
+        #: request/response correlation (echoed by the server): lets a
+        #: timed-out _call's late reply be discarded instead of being
+        #: dequeued as the NEXT op's response (channel desync)
+        self._reqid = 0
         self._connect()
 
     # -- channel ------------------------------------------------------------
@@ -76,15 +91,29 @@ class RemoteDatabase:
         with self._lock:
             if self._sock is None:
                 raise RemoteConnectionError("connection closed")
+            self._reqid += 1
+            req = {**req, "reqid": self._reqid}
             try:
                 send_frame(self._sock, req)
                 if self._resp_q is not None:
                     import queue
+                    import time as _time
 
-                    try:
-                        resp = self._resp_q.get(timeout=30)
-                    except queue.Empty:
-                        raise RemoteConnectionError("response timeout")
+                    deadline = _time.monotonic() + self._call_timeout
+                    while True:
+                        try:
+                            resp = self._resp_q.get(
+                                timeout=max(0.0, deadline - _time.monotonic())
+                            )
+                        except queue.Empty:
+                            raise RemoteConnectionError("response timeout")
+                        if resp is None or resp.get("reqid") in (
+                            None,  # pre-correlation server
+                            self._reqid,
+                        ):
+                            break
+                        # stale reply from an op that timed out earlier:
+                        # drop it so the channel stays in sync
                 else:
                     resp = recv_frame(self._sock)
             except OSError as e:
@@ -106,12 +135,22 @@ class RemoteDatabase:
                 return
             if frame.get("push"):
                 ev = frame.get("event", {})
-                cb = self._live_callbacks.get(ev.get("token"))
-                if cb is not None:
-                    try:
-                        cb(ev)
-                    except Exception:
-                        pass  # subscriber errors must not kill the channel
+                token = ev.get("token")
+                with self._push_lock:
+                    cb = self._live_callbacks.get(token)
+                    if cb is None and token is not None:
+                        # subscribe-response window: buffer (bounded) for
+                        # live_query to drain once it knows the token
+                        buf = self._orphan_pushes.setdefault(token, [])
+                        buf.append(ev)
+                        del buf[:-64]
+                    elif cb is not None:
+                        # deliver under the lock: a concurrent drain in
+                        # live_query must not be overtaken (ordering)
+                        try:
+                            cb(ev)
+                        except Exception:
+                            pass  # subscriber errors must not kill the channel
             else:
                 self._resp_q.put(frame)
 
@@ -136,12 +175,29 @@ class RemoteDatabase:
             self._ensure_reader()
         r = self._checked({"op": "live_subscribe", "sql": sql})
         token = r["token"]
-        self._live_callbacks[token] = callback
+        with self._push_lock:
+            # register and drain pushes that landed before registration
+            # INSIDE the lock: the reader delivers under it too, so no
+            # newer push can overtake the buffered ones
+            self._live_callbacks[token] = callback
+            for ev in self._orphan_pushes.pop(token, []):
+                try:
+                    callback(ev)
+                except Exception:
+                    pass
         return token
 
     def live_unsubscribe(self, token: int) -> None:
-        self._live_callbacks.pop(token, None)
-        self._checked({"op": "live_unsubscribe", "token": token})
+        with self._push_lock:
+            self._live_callbacks.pop(token, None)
+        try:
+            self._checked({"op": "live_unsubscribe", "token": token})
+        finally:
+            # even when the RPC fails: pushes racing the unsubscribe land
+            # in the orphan buffer (no callback) and nobody would ever
+            # drain them — drop, don't park for the connection lifetime
+            with self._push_lock:
+                self._orphan_pushes.pop(token, None)
 
     def _checked(self, req: dict) -> dict:
         resp = self._call(req)

@@ -197,8 +197,13 @@ class Transaction:
             raise TxError("transaction no longer active")
         db = self.db
         try:
-            with db._lock:
-                return self._commit_locked(db)
+            try:
+                with db._lock:
+                    return self._commit_locked(db)
+            finally:
+                # quorum pushes deferred during the locked apply (the
+                # atomic tx entry) ship once the db-wide lock is free
+                db._flush_quorum()
         except Exception:
             # a failed commit invalidates the tx (the reference rolls the
             # whole transaction back on OConcurrentModificationException /

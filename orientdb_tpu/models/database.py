@@ -155,10 +155,54 @@ class Database:
         quorum-mode primary (parallel/replication.py QuorumPusher): the
         write does not return until a majority of the cluster holds the
         entry. Raises QuorumError with the entry already in the local WAL
-        (in-doubt) when the cluster cannot ack."""
+        (in-doubt) when the cluster cannot ack.
+
+        Holding db._lock across the majority wait would serialize every
+        other writer (and reader paths taking the lock) behind network
+        waits — up to quorum_timeout+0.5 s per slow/dead replica. The
+        entry is already durably appended and LSN-ordered, and the
+        replica side enforces prefix contiguity with push-side backfill
+        (replication.apply_pushed_entries / QuorumPusher._push_one), so
+        the push is deferred to the write-section exit when this thread
+        holds the lock: `save`/`delete`/`new_edge`/tx-commit flush via
+        `_flush_quorum()` AFTER releasing it. The writer still blocks
+        until majority ack (same QuorumError surface), just without the
+        db-wide lock held."""
         q = getattr(self, "_repl_quorum", None)
-        if q is not None:
-            q.replicate({**entry, "lsn": lsn})
+        if q is None:
+            return
+        payload = {**entry, "lsn": lsn}
+        if self._lock._is_owned():
+            pending = getattr(self._tx_local, "pending_quorum", None)
+            if pending is None:
+                pending = self._tx_local.pending_quorum = []
+            pending.append(payload)
+            return
+        q.replicate(payload)
+
+    def _flush_quorum(self) -> None:
+        """Ship quorum pushes deferred by `_quorum_push` while db._lock
+        was held. No-op while the lock is still owned (nested write
+        sections — e.g. save() inside new_edge() — flush at the
+        OUTERMOST exit). Raises the first QuorumError after attempting
+        every pending entry, so a failed early push cannot silently
+        swallow later in-doubt entries."""
+        pending = getattr(self._tx_local, "pending_quorum", None)
+        if not pending or self._lock._is_owned():
+            return
+        self._tx_local.pending_quorum = []
+        q = getattr(self, "_repl_quorum", None)
+        if q is None:
+            return
+        first_err = None
+        for payload in pending:
+            try:
+                q.replicate(payload)
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
 
     # -- cluster plumbing --------------------------------------------------
 
@@ -230,22 +274,34 @@ class Database:
             return tx.new_edge(cls.name, src, dst, **fields)
         if not (src.rid.is_persistent and dst.rid.is_persistent):
             raise ValueError("both endpoints must be saved before creating an edge")
-        with self._lock:
-            e = Edge(cls.name, fields)
-            e._db = self
-            e.out_rid = src.rid
-            e.in_rid = dst.rid
-            self.save(e)
-            src._bag(Direction.OUT, cls.name).append(e.rid)
-            dst._bag(Direction.IN, cls.name).append(e.rid)
-            src.version += 1
-            dst.version += 1
+        try:
+            with self._lock:
+                e = Edge(cls.name, fields)
+                e._db = self
+                e.out_rid = src.rid
+                e.in_rid = dst.rid
+                self.save(e)
+                src._bag(Direction.OUT, cls.name).append(e.rid)
+                dst._bag(Direction.IN, cls.name).append(e.rid)
+                src.version += 1
+                dst.version += 1
+        finally:
+            self._flush_quorum()
         return e
 
     def save(self, doc: Document) -> Document:
         tx = self.tx
         if tx is not None and not self._tx_suspended:
             return tx.save(doc)
+        try:
+            return self._save_locked(doc)
+        finally:
+            # deferred quorum pushes ship after the lock is released (see
+            # _quorum_push); also on failure — an entry logged before a
+            # later hook raised is already durable and must still ack
+            self._flush_quorum()
+
+    def _save_locked(self, doc: Document) -> Document:
         with self._lock:
             cls = self.schema.get_class(doc.class_name)
             if cls is None:
@@ -322,6 +378,12 @@ class Database:
         if tx is not None and not self._tx_suspended:
             tx.delete(doc)
             return
+        try:
+            self._delete_locked(doc)
+        finally:
+            self._flush_quorum()
+
+    def _delete_locked(self, doc: Document) -> None:
         with self._lock:
             if self._hooks is not None:
                 self._hooks.fire("before_delete", doc)

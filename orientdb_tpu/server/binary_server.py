@@ -86,6 +86,11 @@ class _Session:
                 if req is None:
                     break
                 resp = self._dispatch(req)
+                # echo the client's correlation id so its channel can
+                # discard stale replies after a response timeout instead
+                # of desynchronizing (client/remote.py _call)
+                if "reqid" in req:
+                    resp["reqid"] = req["reqid"]
                 self._send(resp)
                 if req.get("op") == "close":
                     break

@@ -564,13 +564,29 @@ def _checkpoint_payload(db: Database) -> Dict:
 
 
 def atomic_write(path: str, data: bytes) -> None:
-    """Crash-safe publish: tmp write + flush + fsync + rename."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    """Crash-safe publish: tmp write + flush + fsync + rename. The tmp
+    name is unique per process+thread so concurrent publishers (e.g. a
+    delta checkpoint racing a full one) can never clobber each other's
+    in-flight tmp — which also makes the failure-path unlink below safe.
+    Orphans from crashes are swept by open_database()."""
+    import threading
+
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed publish (ENOSPC/EIO) must not leak its tmp until the
+        # next restart — retried checkpoints on a tight disk would
+        # otherwise accumulate one per attempt
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _ckpt_lsn_from_name(filename: str) -> int:
@@ -598,22 +614,72 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
     [E] full-checkpoint + WAL-segment cut behavior)."""
     directory = directory or _dir_of(db)
     os.makedirs(directory, exist_ok=True)
-    payload = _checkpoint_payload(db)
     wal: Optional[WriteAheadLog] = getattr(db, "_wal", None)
-    payload["lsn"] = (wal.next_lsn - 1) if wal is not None else 0
-    data = json.dumps(payload, separators=(",", ":")).encode()
+    # The covered LSN, the delta-tracking baseline swap, and the state
+    # capture must be ONE atomic step against writers (which mark dirty
+    # under db._lock): a write landing between the capture and a later
+    # reset would lose its dirty mark while being absent from the
+    # payload, and the LSN-keyed archive skip in open_database would
+    # then never replay it — an acknowledged, fsynced write silently
+    # dropped. To avoid an O(DB) stop-the-world, only POINTER copies of
+    # the cluster tables happen under the lock; JSON serialization runs
+    # outside it. A record mutated after the capture may serialize torn,
+    # but its mutation's WAL entry carries lsn > the captured LSN and
+    # recovery replays those ABSOLUTE entries over the restored payload,
+    # so the recovered state is exact.
+    with db._lock:
+        lsn = (wal.next_lsn - 1) if wal is not None else 0
+        dirty_snap = db.__dict__.get("_ckpt_dirty") or set()
+        db._ckpt_dirty = set()  # post-snapshot writes mark the NEW set
+        prev_base = getattr(db, "_ckpt_base_lsn", None)
+        db._ckpt_base_lsn = lsn
+        payload = _meta_payload(db)  # O(schema)
+        cluster_snap = [
+            (cid, list(c.records)) for cid, c in db._clusters.items()
+        ]
+    try:
+        clusters = {}
+        for cid, records in cluster_snap:
+            recs = []
+            for pos, doc in enumerate(records):
+                if doc is None:
+                    continue
+                try:
+                    recs.append(_rec_json(doc, pos))
+                except RuntimeError:
+                    # the doc's dicts mutated mid-iteration: retry
+                    # quiesced (the torn value itself is fine, see above)
+                    with db._lock:
+                        recs.append(_rec_json(doc, pos))
+            clusters[str(cid)] = {"len": len(records), "records": recs}
+        payload["clusters"] = clusters
+        payload["lsn"] = lsn
+        data = json.dumps(payload, separators=(",", ":")).encode()
+    except BaseException:
+        with db._lock:
+            db._ckpt_dirty |= dirty_snap
+            if db.__dict__.get("_ckpt_base_lsn") == lsn:
+                db._ckpt_base_lsn = prev_base
+        raise
     digest = format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
     name = (
         f"{CHECKPOINT_PREFIX}{payload['epoch']:012d}-"
         f"{payload['lsn']:012d}-{digest}.json"
     )
     path = os.path.join(directory, name)
-    atomic_write(path, data)
+    try:
+        atomic_write(path, data)
+    except BaseException:
+        # publish failed: re-track the swapped-out dirty records so the
+        # next delta still covers them; restore the baseline only if no
+        # concurrent checkpoint has advanced it since (CAS discipline)
+        with db._lock:
+            db._ckpt_dirty |= dirty_snap
+            if db.__dict__.get("_ckpt_base_lsn") == lsn:
+                db._ckpt_base_lsn = prev_base
+        raise
     if wal is not None:
         _rotate_wal(db, directory)
-    # a full checkpoint resets the delta-tracking baseline
-    db._ckpt_dirty = set()
-    db._ckpt_base_lsn = payload["lsn"]
     # retire older checkpoints (keep the newest two for paranoia), deltas
     # covered by the newest full checkpoint, and WAL archives fully
     # covered by the oldest KEPT checkpoint
@@ -626,15 +692,17 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
         except OSError:
             pass
     newest_lsn = _ckpt_lsn_from_name(cps[-1]) if cps else 0
+    # NOTE: half-written *.tmp artifacts are swept only during
+    # open_database() recovery — a live process may have a concurrent
+    # atomic_write (e.g. a delta on another thread) mid-flight whose tmp
+    # a sweep here would delete out from under it (os.replace → ENOENT)
     for f2 in os.listdir(directory):
         covered_delta = (
             f2.startswith(DELTA_PREFIX)
             and f2.endswith(".json")
             and _delta_lsn_from_name(f2) <= newest_lsn
         )
-        # half-written artifacts from a crash mid-atomic_write
-        stale_tmp = f2.endswith(".json.tmp")
-        if covered_delta or stale_tmp:
+        if covered_delta:
             try:
                 os.remove(os.path.join(directory, f2))
             except OSError:
@@ -696,11 +764,18 @@ def delta_checkpoint(db: Database, directory: Optional[str] = None) -> str:
     if not has_full or db._wal is None or base_lsn is None:
         return checkpoint(db, directory)
     with db._lock:
-        # snapshot WITHOUT clearing: the set is only trimmed after the
-        # delta file is durably published — an atomic_write failure must
-        # not permanently un-track records whose WAL coverage a later
-        # delta would then rotate away
-        dirty = set(db.__dict__.get("_ckpt_dirty") or ())
+        # re-read the baseline under the lock (authoritative value: a
+        # concurrent full checkpoint may have advanced it since the
+        # fallback check above), and SWAP the dirty set (don't
+        # snapshot-and-subtract later): a record in the snapshot that is
+        # written AGAIN after this lock releases must stay tracked for
+        # the NEXT delta — subtracting the snapshot from the shared set
+        # would clear it even though the newer write is absent from this
+        # delta's payload. A publish failure merges the swapped-out set
+        # back below.
+        base_lsn = getattr(db, "_ckpt_base_lsn", None)
+        dirty = db.__dict__.get("_ckpt_dirty") or set()
+        db._ckpt_dirty = set()
         records = []
         deleted = []
         for rid_s in sorted(dirty):
@@ -730,12 +805,20 @@ def delta_checkpoint(db: Database, directory: Optional[str] = None) -> str:
         f"{payload['lsn']:012d}-{digest}.json"
     )
     path = os.path.join(directory, name)
-    atomic_write(path, data)
+    try:
+        atomic_write(path, data)
+    except BaseException:
+        # baseline was never touched pre-publish; only re-track dirty
+        with db._lock:
+            db._ckpt_dirty |= dirty
+        raise
     with db._lock:
-        cur = db.__dict__.get("_ckpt_dirty")
-        if cur:
-            cur -= dirty
-    db._ckpt_base_lsn = payload["lsn"]
+        # CAS: a concurrent FULL checkpoint that advanced the baseline
+        # past our snapshot must not be regressed — regressing it would
+        # forge delta-chain contiguity over a span only that full
+        # checkpoint (and the WAL archives it retired) covers
+        if db.__dict__.get("_ckpt_base_lsn") == base_lsn:
+            db._ckpt_base_lsn = payload["lsn"]
     _rotate_wal(db, directory)
     metrics.incr("checkpoint.delta")
     return path
@@ -878,7 +961,18 @@ def _sync_schema(db: Database, payload: Dict) -> None:
     )
     wanted_idx = {i["name"] for i in payload.get("indexes", ())}
     for i in payload.get("indexes", ()):
-        if i["name"] not in have_idx:
+        have = have_idx.get(i["name"])
+        if have is not None and (
+            have.class_name != i["class"]
+            or list(have.fields) != list(i["fields"])
+            or have.type != i["type"]
+        ):
+            # same name, different definition: an index dropped and
+            # recreated between the base checkpoint and this delta must
+            # not keep its stale (class, fields, type) after recovery
+            db.indexes.drop_index(i["name"])
+            have = None
+        if have is None:
             db.indexes.create_index(
                 i["name"], i["class"], i["fields"], i["type"]
             )
@@ -1099,6 +1193,15 @@ def open_database(directory: str, name: Optional[str] = None) -> Database:
     db = Database(name or os.path.basename(os.path.abspath(directory)))
     db._durability_dir = directory
     os.makedirs(directory, exist_ok=True)
+    # sweep half-written atomic_write tmps from a crash: recovery is the
+    # only point where no concurrent publisher can exist (checkpoint()
+    # deliberately does NOT sweep — see the note there)
+    for f2 in os.listdir(directory):
+        if f2.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(directory, f2))
+            except OSError:
+                pass
     ckpt_lsn = 0
     cps = sorted(
         p for p in os.listdir(directory) if p.startswith(CHECKPOINT_PREFIX)

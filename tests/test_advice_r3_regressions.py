@@ -1,0 +1,306 @@
+"""Regressions for the round-3 advisor findings (ADVICE.md):
+
+1. (medium) checkpoint() must snapshot the payload, covered LSN, and the
+   delta-tracking dirty set as ONE atomic step against writers, and a
+   record written again after a (full or delta) checkpoint's snapshot
+   must stay dirty-tracked — otherwise the next delta omits it and the
+   LSN-keyed archive skip silently drops an acknowledged write;
+2. (low) checkpoint() must not sweep *.tmp files a concurrent
+   atomic_write may be mid-flight on; orphaned tmps are swept by
+   open_database() recovery instead;
+3. (low) the remote client must correlate requests/responses (a reply
+   arriving after a response timeout is discarded, not dequeued as the
+   next op's reply) and must not drop live-query push frames that land
+   before the subscribe response is processed;
+4. (low) a quorum-mode primary must not hold db._lock across the
+   blocking majority wait (a slow replica would serialize every writer);
+5. (low) delta recovery must not keep a same-named index's stale
+   definition when it was dropped and recreated with different fields.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.storage import durability
+from orientdb_tpu.storage.durability import (
+    checkpoint,
+    delta_checkpoint,
+    enable_durability,
+    open_database,
+)
+
+
+@pytest.fixture()
+def ddb(tmp_path):
+    db = Database("d")
+    db.schema.create_vertex_class("P")
+    enable_durability(db, str(tmp_path))
+    return db
+
+
+# -- 1. checkpoint dirty-set atomicity -------------------------------------
+
+
+def test_rewrite_after_delta_snapshot_stays_dirty(ddb, tmp_path):
+    """A record written again while a delta checkpoint is publishing must
+    remain dirty-tracked for the NEXT delta (swap, not subtract)."""
+    v = ddb.new_vertex("P", n=1)
+    checkpoint(ddb)  # base
+    v.set("n", 2)
+    ddb.save(v)
+    rid = str(v.rid)
+    assert rid in ddb._ckpt_dirty
+
+    in_write = threading.Event()
+    release = threading.Event()
+    real_write = durability.atomic_write
+
+    def slow_write(path, data):
+        in_write.set()
+        assert release.wait(5)
+        real_write(path, data)
+
+    t = None
+    try:
+        durability.atomic_write = slow_write
+        t = threading.Thread(target=delta_checkpoint, args=(ddb,))
+        t.start()
+        assert in_write.wait(5)
+        # concurrent write WHILE the delta file is being published: its
+        # state is not in that delta's payload
+        v.set("n", 3)
+        ddb.save(v)
+    finally:
+        release.set()
+        durability.atomic_write = real_write
+        if t is not None:
+            t.join(5)
+    assert rid in ddb._ckpt_dirty, "post-snapshot write lost its dirty mark"
+    # and the next delta + recovery sees n=3
+    delta_checkpoint(ddb)
+    db2 = open_database(str(tmp_path))
+    row = db2.query("SELECT n FROM P", engine="oracle").to_dicts()
+    assert row == [{"n": 3}]
+
+
+def test_full_checkpoint_publish_failure_restores_tracking(ddb):
+    v = ddb.new_vertex("P", n=1)
+    checkpoint(ddb)
+    v.set("n", 2)
+    ddb.save(v)
+    rid = str(v.rid)
+    base = ddb._ckpt_base_lsn
+    real_write = durability.atomic_write
+
+    def boom(path, data):
+        raise OSError("disk full")
+
+    try:
+        durability.atomic_write = boom
+        with pytest.raises(OSError):
+            checkpoint(ddb)
+    finally:
+        durability.atomic_write = real_write
+    assert rid in ddb._ckpt_dirty
+    assert ddb._ckpt_base_lsn == base
+
+
+def test_delta_publish_failure_restores_tracking(ddb):
+    v = ddb.new_vertex("P", n=1)
+    checkpoint(ddb)
+    v.set("n", 2)
+    ddb.save(v)
+    rid = str(v.rid)
+    base = ddb._ckpt_base_lsn
+    real_write = durability.atomic_write
+    try:
+        durability.atomic_write = lambda p, d: (_ for _ in ()).throw(
+            OSError("disk full")
+        )
+        with pytest.raises(OSError):
+            delta_checkpoint(ddb)
+    finally:
+        durability.atomic_write = real_write
+    assert rid in ddb._ckpt_dirty
+    assert ddb._ckpt_base_lsn == base
+
+
+# -- 2. tmp sweep ----------------------------------------------------------
+
+
+def test_checkpoint_leaves_foreign_tmps_alone(ddb, tmp_path):
+    ddb.new_vertex("P", n=1)
+    inflight = tmp_path / "delta-x.json.1234.5678.tmp"
+    inflight.write_bytes(b"{}")
+    checkpoint(ddb)
+    assert inflight.exists(), "checkpoint swept a concurrent writer's tmp"
+
+
+def test_open_database_sweeps_orphan_tmps(ddb, tmp_path):
+    ddb.new_vertex("P", n=1)
+    checkpoint(ddb)
+    orphan = tmp_path / "checkpoint-dead.json.99.99.tmp"
+    orphan.write_bytes(b"half-written")
+    open_database(str(tmp_path))
+    assert not orphan.exists()
+
+
+# -- 3. client correlation + live push window ------------------------------
+
+
+@pytest.fixture()
+def server():
+    from orientdb_tpu.server.server import Server
+
+    s = Server(admin_password="pw")
+    s.startup()
+    db = s.create_database("d")
+    db.schema.create_vertex_class("P")
+    yield s, db, s.binary_port
+    s.shutdown()
+
+
+def test_stale_reply_discarded_after_timeout(server):
+    from orientdb_tpu.client.remote import (
+        RemoteConnectionError,
+        RemoteDatabase,
+    )
+    from orientdb_tpu.server import binary_server
+
+    s, db, port = server
+    c = RemoteDatabase("127.0.0.1", port, "d", "admin", "pw")
+    try:
+        c.live_query("LIVE SELECT FROM P", lambda ev: None)  # demux mode
+        c._call_timeout = 0.3
+        real = binary_server._Session._dispatch
+        try:
+            # delay ONE response past the client timeout
+            def slow(self, req):
+                resp = real(self, req)
+                if req.get("op") == "query":
+                    time.sleep(0.8)
+                return resp
+
+            binary_server._Session._dispatch = slow
+            with pytest.raises(RemoteConnectionError):
+                c.query("SELECT FROM P")
+        finally:
+            binary_server._Session._dispatch = real
+        c._call_timeout = 30.0
+        # the late reply for the timed-out query must NOT be returned as
+        # this next op's response
+        names = c.databases()
+        assert names == ["d"]
+    finally:
+        c.close()
+
+
+def test_live_push_before_registration_not_dropped(server):
+    """Push frames delivered before live_query registers the callback
+    (the subscribe-response window) are buffered and drained, and the
+    reqid correlation keeps them out of the response queue."""
+    from orientdb_tpu.client.remote import RemoteDatabase
+
+    s, db, port = server
+    c = RemoteDatabase("127.0.0.1", port, "d", "admin", "pw")
+    got = []
+    try:
+        token = c.live_query("LIVE SELECT FROM P", got.append)
+        # simulate the window: a push for an unknown token arrives, then
+        # the subscription for it lands
+        with c._push_lock:
+            c._orphan_pushes.setdefault(token + 1, []).append(
+                {"token": token + 1, "kind": "create"}
+            )
+        late = []
+        with c._push_lock:
+            cb_missing = (token + 1) not in c._live_callbacks
+        assert cb_missing
+        # registering drains the buffer in order
+        with c._push_lock:
+            c._live_callbacks[token + 1] = late.append
+            for ev in c._orphan_pushes.pop(token + 1, []):
+                late.append(ev)
+        assert late == [{"token": token + 1, "kind": "create"}]
+        # end-to-end: a real event still reaches the callback
+        db.new_vertex("P", n=1)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        assert got and got[0]["operation"] == "CREATE"
+    finally:
+        c.close()
+
+
+# -- 4. quorum wait must not hold db._lock ---------------------------------
+
+
+def test_quorum_push_releases_db_lock(ddb):
+    observed = {}
+
+    class SlowQuorum:
+        def replicate(self, payload):
+            # the db-wide lock must be FREE while the majority wait runs
+            acquired = ddb._lock.acquire(timeout=1.0)
+            observed["lock_free"] = acquired
+            if acquired:
+                ddb._lock.release()
+            observed["payload"] = payload
+            return 1
+
+    ddb._repl_quorum = SlowQuorum()
+    ddb.new_vertex("P", n=1)
+    assert observed.get("lock_free") is True
+    assert observed["payload"]["lsn"] > 0
+
+
+def test_quorum_failure_still_raises_from_save(ddb):
+    from orientdb_tpu.parallel.replication import QuorumError
+
+    class FailingQuorum:
+        def replicate(self, payload):
+            raise QuorumError("no majority")
+
+    ddb._repl_quorum = FailingQuorum()
+    with pytest.raises(QuorumError):
+        ddb.new_vertex("P", n=1)
+    ddb._repl_quorum = None
+    # the write is locally durable despite the failed quorum (in-doubt)
+    assert ddb.query("SELECT n FROM P", engine="oracle").to_dicts() == [{"n": 1}]
+
+
+def test_tx_commit_quorum_deferred_and_delivered(ddb):
+    payloads = []
+
+    class Q:
+        def replicate(self, payload):
+            assert ddb._lock.acquire(timeout=1.0)
+            ddb._lock.release()
+            payloads.append(payload)
+            return 1
+
+    ddb._repl_quorum = Q()
+    tx = ddb.begin()
+    ddb.new_vertex("P", n=5)
+    tx.commit()
+    assert len(payloads) == 1 and payloads[0]["op"] == "tx"
+
+
+# -- 5. index redefinition across a delta ----------------------------------
+
+
+def test_delta_recovery_recreates_redefined_index(ddb, tmp_path):
+    ddb.schema.get_class("P").create_property
+    ddb.new_vertex("P", a=1, b=2)
+    ddb.indexes.create_index("P.idx", "P", ["a"], "NOTUNIQUE")
+    checkpoint(ddb)
+    ddb.indexes.drop_index("P.idx")
+    ddb.indexes.create_index("P.idx", "P", ["b"], "NOTUNIQUE")
+    delta_checkpoint(ddb)
+    db2 = open_database(str(tmp_path))
+    idx = {i.name: i for i in db2.indexes.all()}["P.idx"]
+    assert list(idx.fields) == ["b"], "stale index definition survived delta"
