@@ -47,6 +47,38 @@ def canon(rows):
     return sorted(tuple(sorted(r.items())) for r in rows)
 
 
+def gate_regressions(cur: dict, prev: dict, tolerance: float = 0.85):
+    """Throughput-regression gate (VERDICT r3 #1): compare every q/s
+    metric of this run against a previous round's recorded JSON; any
+    workload below ``tolerance`` × its previous value is a regression.
+
+    ``prev`` is a BENCH_r*.json as the driver records it (either the
+    raw printed line or the wrapper with a "parsed" key). Returns
+    [(metric_name, prev_qps, cur_qps), ...]."""
+    prev = prev.get("parsed", prev)
+    regs = []
+
+    def qps_leaves(d, prefix=""):
+        for k, v in (d or {}).items():
+            if isinstance(v, dict):
+                yield from qps_leaves(v, f"{prefix}{k}.")
+            elif isinstance(v, (int, float)) and (
+                k.endswith("qps") or prefix.startswith("ldbc_is")
+                or prefix.endswith("ldbc_is.")
+            ):
+                yield prefix + k, float(v)
+
+    cur_leaves = dict(qps_leaves(cur.get("extras", {})))
+    cur_leaves["headline"] = float(cur.get("value", 0.0))
+    prev_leaves = dict(qps_leaves(prev.get("extras", {})))
+    prev_leaves["headline"] = float(prev.get("value", 0.0))
+    for name, pv in sorted(prev_leaves.items()):
+        cv = cur_leaves.get(name)
+        if cv is not None and pv > 0 and cv < pv * tolerance:
+            regs.append((name, pv, cv))
+    return regs
+
+
 def main() -> None:
     n_profiles = int(os.environ.get("BENCH_PROFILES", "20000"))
     avg_friends = int(os.environ.get("BENCH_AVG_FRIENDS", "10"))
@@ -228,32 +260,59 @@ def main() -> None:
         run("oracle")
     oracle_qps = oracle_iters / (time.perf_counter() - t0)
 
-    print(
-        json.dumps(
-            {
-                "metric": "demodb_match_2hop_count_qps",
-                "value": round(batched_qps, 3),
-                "unit": "queries/sec",
-                "vs_baseline": round(batched_qps / oracle_qps, 2),
-                "extras": {
-                    "batch_size": batch,
-                    "single_query_qps": round(single_qps, 3),
-                    "rows_1hop_batched_qps": round(rows_qps, 3),
-                    "var_depth_while_batched_qps": round(var_qps, 3),
-                    "traverse_bfs_batched_qps": round(trav_qps, 3),
-                    "select_count_batched_qps": round(select_qps, 3),
-                    "ldbc_is": ldbc_is,
-                    "phase_split_ms_per_query": splits,
-                    "snb_persons": snb_persons,
-                    "oracle_2hop_qps": round(oracle_qps, 4),
-                    "graph": {
-                        "profiles": n_profiles,
-                        "avg_friends": avg_friends,
-                    },
-                },
-            }
-        )
-    )
+    out = {
+        "metric": "demodb_match_2hop_count_qps",
+        "value": round(batched_qps, 3),
+        "unit": "queries/sec",
+        "vs_baseline": round(batched_qps / oracle_qps, 2),
+        "extras": {
+            "batch_size": batch,
+            "single_query_qps": round(single_qps, 3),
+            "rows_1hop_batched_qps": round(rows_qps, 3),
+            "var_depth_while_batched_qps": round(var_qps, 3),
+            "traverse_bfs_batched_qps": round(trav_qps, 3),
+            "select_count_batched_qps": round(select_qps, 3),
+            "ldbc_is": ldbc_is,
+            "phase_split_ms_per_query": splits,
+            "snb_persons": snb_persons,
+            "oracle_2hop_qps": round(oracle_qps, 4),
+            "graph": {
+                "profiles": n_profiles,
+                "avg_friends": avg_friends,
+            },
+        },
+    }
+    print(json.dumps(out))
+
+    # regression gate: `python bench.py --gate BENCH_r03.json` (or env
+    # BENCH_GATE=...) fails the run when any workload drops >15% vs the
+    # recorded round — so a silent IS3-IS7-style regression (VERDICT r3
+    # #1) can never ship again. Diagnostics on stderr; the driver's one
+    # stdout JSON line stays intact.
+    gate_path = os.environ.get("BENCH_GATE")
+    if "--gate" in sys.argv:
+        i = sys.argv.index("--gate") + 1
+        if i >= len(sys.argv):
+            print("usage: bench.py --gate BENCH_rNN.json", file=sys.stderr)
+            sys.exit(2)
+        gate_path = sys.argv[i]
+    if gate_path:
+        with open(gate_path) as f:
+            prev = json.load(f)
+        # default tolerance reflects the measured tunnel noise: identical
+        # back-to-back IS runs vary ±40% on this link, so the gate flags
+        # only drops beyond that envelope (override: BENCH_GATE_TOL)
+        tol = float(os.environ.get("BENCH_GATE_TOL", "0.55"))
+        regs = gate_regressions(out, prev, tolerance=tol)
+        for name, pv, cv in regs:
+            print(
+                f"GATE REGRESSION {name}: {pv:.1f} -> {cv:.1f} q/s "
+                f"({cv / pv:.0%})",
+                file=sys.stderr,
+            )
+        if regs:
+            sys.exit(2)
+        print(f"gate vs {gate_path}: OK", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -74,6 +74,15 @@ class GlobalConfiguration:
     # wide plans never triple their result memory under deep batches.
     result_page_budget_bytes: int = 16 << 20
 
+    # Full result buffers at or below this many bytes skip the
+    # meta-gated page election entirely: the replay returns ONE fused
+    # buffer (data + meta row) whose copy starts in the batch's first
+    # transfer wave. On the tunneled link every buffer fetch carries a
+    # fixed cost, so for few-KB results one fused copy beats the
+    # meta-then-elected-page protocol (the round-3 LDBC IS3–IS7
+    # regression); above the threshold the election's byte savings win.
+    result_direct_bytes: int = 64 << 10
+
     # Root candidates seed from a host index when the root WHERE has an
     # equality over an indexed field ([E] the index-vs-scan choice):
     # point lookups become V-independent instead of hull scans.

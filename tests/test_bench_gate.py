@@ -1,0 +1,59 @@
+"""The bench regression gate (VERDICT r3 #1): any benched workload
+dropping >15% vs a recorded round's JSON must fail the run — the round-3
+LDBC IS3–IS7 45–65% regression shipped silently because nothing compared
+rounds."""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+)
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+def _run(value=100.0, is3=200.0, rows=20.0):
+    return {
+        "metric": "demodb_match_2hop_count_qps",
+        "value": value,
+        "extras": {
+            "rows_1hop_batched_qps": rows,
+            "ldbc_is": {"IS1": 100.0, "IS3": is3},
+            "batch_size": 64,  # non-qps numbers are not gated
+            "phase_split_ms_per_query": {"rows_1hop": {"device_ms": 20.0}},
+        },
+    }
+
+
+def test_gate_passes_on_parity_and_improvement():
+    assert bench.gate_regressions(_run(), _run()) == []
+    assert bench.gate_regressions(_run(value=150, is3=500), _run()) == []
+
+
+def test_gate_catches_is_style_regression():
+    regs = bench.gate_regressions(_run(is3=98.0), _run(is3=268.0))
+    assert regs == [("ldbc_is.IS3", 268.0, 98.0)]
+
+
+def test_gate_catches_headline_regression():
+    regs = bench.gate_regressions(_run(value=70.0), _run(value=100.0))
+    assert ("headline", 100.0, 70.0) in regs
+
+
+def test_gate_tolerates_within_15pct():
+    assert bench.gate_regressions(_run(value=86.0), _run(value=100.0)) == []
+
+
+def test_gate_reads_driver_wrapper_format():
+    """BENCH_r*.json wraps the printed line under a "parsed" key."""
+    prev = {"n": 3, "rc": 0, "parsed": _run(is3=268.0)}
+    regs = bench.gate_regressions(_run(is3=98.0), prev)
+    assert regs == [("ldbc_is.IS3", 268.0, 98.0)]
+
+
+def test_gate_ignores_non_qps_and_missing_metrics():
+    cur = _run()
+    cur["extras"]["batch_size"] = 1  # changed but not a qps metric
+    del cur["extras"]["rows_1hop_batched_qps"]  # missing in current: skip
+    assert bench.gate_regressions(cur, _run()) == []
