@@ -189,6 +189,10 @@ def expand_totals(mesh: Mesh, R: int, ind_sh, srcs) -> jnp.ndarray:
         mesh=mesh,
         in_specs=(P(config.mesh_shard_axis, None), P(None)),
         out_specs=P(None),
+        # the output IS replicated (it is an all_gather over the shard
+        # axis), but VMA's static inference marks all_gather results as
+        # varying — unlike psum — so the check cannot hold here; the
+        # psum-output kernels below run with the check ON
         check_vma=False,
     )(ind_sh, srcs)
 
@@ -242,6 +246,8 @@ def expand_gather(
             P(None),
         ),
         out_specs=(P(None), P(None), P(None)),
+        # all_gather-merged outputs: replicated in fact, not provably so
+        # under VMA inference (see expand_totals)
         check_vma=False,
     )(ind_sh, nbr_sh, extra_sh, srcs)
 
@@ -270,7 +276,7 @@ def sharded_bitmap_hop(
             P(None, None),
         ),
         out_specs=P(None, None),
-        check_vma=False,
+        check_vma=True,
     )(act_sh, emit_sh, eid_sh, emask_global, frontier)
 
 
@@ -307,5 +313,5 @@ def sharded_weight_pass(
             P(None),
         ),
         out_specs=P(None),
-        check_vma=False,
+        check_vma=True,
     )(seg_sh, emit_sh, eid_sh, emask_global, dst_ok_global, w)

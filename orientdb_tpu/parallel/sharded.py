@@ -196,7 +196,7 @@ def build_bfs_step(
             mesh=mesh,
             in_specs=(P(config.mesh_shard_axis, None), P(config.mesh_shard_axis, None), P(config.mesh_replica_axis, None)),
             out_specs=P(config.mesh_replica_axis, None),
-            check_vma=False,
+            check_vma=True,
         )(indptr_sh, dst_sh, roots)
 
     return jax.jit(step)
