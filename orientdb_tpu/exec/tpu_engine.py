@@ -1220,17 +1220,22 @@ class TpuMatchSolver:
                             vb,
                         )
                         continue
+                    # both CSR orders exist in HBM, so either direction
+                    # sums via cumsum+boundary-gather (indptr_segment_sum)
+                    # instead of the ~7x-costlier TPU scatter-add; the
+                    # in-direction reorders the out-order edge mask
+                    # through the in-CSR's edge-id map first
                     if d == "out":
-                        seg, emit = dec.edge_src, dec.dst
+                        emit, ip = dec.dst, dec.indptr_out
+                        em = emask
                     else:
-                        seg, emit = dec.dst, dec.edge_src
-                    contrib = emask & node_mask(emit)
+                        emit, ip = dec.src, dec.indptr_in
+                        em = jnp.take(emask, dec.edge_id_in)
+                    contrib = em & node_mask(emit)
                     vals = contrib.astype(dtype)
                     if w is not None:
                         vals = vals * K.take_pad(w, emit, dtype(0))
-                    new_w = new_w + jax.ops.segment_sum(
-                        vals, jnp.clip(seg, 0, vb - 1), num_segments=vb
-                    )
+                    new_w = new_w + K.indptr_segment_sum(vals, ip, vb)
             w = new_w
         return w
 
@@ -2539,12 +2544,14 @@ class _CompiledPlan(_AotWarmup):
         return meta, pages32, pages16
 
     def _dyn_args(self, params: Optional[Dict]) -> Dict:
+        # host-side (numpy) values: the jit call transfers them, and
+        # dispatch_many can stack B of them into ONE transfer per key
         params = params if params is not None else self.solver.params
         dyn = {}
         for k, kind in self.dyn_spec.items():
             v = params[k]
-            dtype = jnp.float32 if kind == "float" else jnp.int32
-            dyn[k] = jnp.asarray(int(v) if kind != "float" else v, dtype)
+            dtype = np.float32 if kind == "float" else np.int32
+            dyn[k] = np.asarray(int(v) if kind != "float" else v, dtype)
         for alias, cap in self.seed_spec.items():
             hits = self.solver.compute_seed(alias, params)
             if hits.shape[0] > cap:
@@ -2553,7 +2560,7 @@ class _CompiledPlan(_AotWarmup):
                 raise ScheduleOverflow(f"root seed '{alias}' > {cap}")
             arr = np.full(cap, -1, np.int32)
             arr[: hits.shape[0]] = hits
-            dyn[f"__seed__:{alias}"] = jnp.asarray(arr)
+            dyn[f"__seed__:{alias}"] = arr
         return dyn
 
     def _warm_call(self):
@@ -2564,6 +2571,98 @@ class _CompiledPlan(_AotWarmup):
         """Enqueue the replay on device; returns the un-fetched result."""
         self.wait_compiled()
         return self.jitted(self.solver.dg.arrays, self._dyn_args(params))
+
+    def batchable(self) -> bool:
+        """Eligible for the vmapped one-Execute group dispatch: count-only
+        or direct-fetch plans (one small output buffer per lane) on an
+        unsharded graph. Big-buffer plans keep per-query dispatch so the
+        page election can cut their transfer; mesh plans keep it because
+        vmap-over-shard_map is not exercised anywhere."""
+        return self.solver.dg.mesh_graph is None and (
+            self.count_name is not None or self.width == 0 or self.direct_fetch
+        )
+
+    def dispatch_many(self, dyns: List[Dict]):
+        """ONE Execute for B same-plan replays: the replay vmapped over
+        stacked dynamic args, padded to a pow2 lane bucket so the jit
+        cache stays O(log B) per plan.
+
+        The tunneled runtime charges a fixed ~1.4 ms per Execute
+        (measured: a trivial 8-element program and a 200k-row gather
+        both cost ~1.4 ms/call), which floors per-query dispatch at
+        ~700 q/s no matter how small the program; B stacked replays
+        amortize it to ~1.4/B ms and fetch as ONE buffer.
+
+        Returns None when this (plan, lane-bucket)'s vmapped executable
+        is still compiling — compilation runs on a BACKGROUND thread
+        (like the plan's own AOT warm-up) and the caller dispatches
+        per-lane meanwhile, so a 10s+ vmapped XLA compile never lands in
+        a serving batch. `drain_warmups()` blocks on these too."""
+        self.wait_compiled()
+        B = len(dyns)
+        Bb = 1 << (B - 1).bit_length()
+        all_dyns = dyns + [dyns[-1]] * (Bb - B)
+        stacked = {
+            k: np.stack([np.asarray(d[k]) for d in all_dyns])
+            for k in dyns[0]
+        }
+        cache = self.__dict__.setdefault("_jitted_many", {})
+        fn = cache.get(Bb)
+        if fn is False:
+            return None  # compile failed permanently: per-lane forever
+        if fn is None:
+            self._compile_group_async(Bb, stacked)
+            return None
+        return fn(self.solver.dg.arrays, stacked)
+
+    def _compile_group_async(self, Bb: int, stacked: Dict) -> None:
+        import atexit
+        import threading
+
+        flags = self.__dict__.setdefault("_many_compiling", set())
+        if Bb in flags:
+            return
+        flags.add(Bb)
+        ev = threading.Event()
+        _AotWarmup._inflight.append(ev)
+        atexit.unregister(drain_warmups)
+        atexit.register(drain_warmups)
+
+        def work():
+            # one retry for transient failures (runtime hiccup, resource
+            # pressure) — the same discipline as ensure_compiled; only a
+            # repeated failure writes the permanent per-lane sentinel so
+            # a doomed compile isn't re-launched on every batch
+            try:
+                for attempt in (0, 1):
+                    try:
+                        fn = jax.jit(
+                            jax.vmap(self._replay, in_axes=(None, 0))
+                        )
+                        with _TRACE_LOCK:
+                            jax.block_until_ready(
+                                fn(dict(self.solver.dg.arrays), stacked)
+                            )
+                        self._jitted_many[Bb] = fn
+                        metrics.incr("plan_cache.group_compile")
+                        break
+                    except Exception:
+                        if attempt:
+                            log.exception(
+                                "vmapped group compile failed twice "
+                                "(plan stays per-lane)"
+                            )
+                            self._jitted_many[Bb] = False
+                            metrics.incr("plan_cache.group_compile_error")
+            finally:
+                flags.discard(Bb)
+                ev.set()
+                try:
+                    _AotWarmup._inflight.remove(ev)
+                except ValueError:
+                    pass
+
+        threading.Thread(target=work, daemon=True).start()
 
     def materialize(self, fetched, params: Optional[Dict] = None) -> List[Result]:
         """Marshal rows from a dispatched `(meta, data)` pair.
@@ -2925,18 +3024,58 @@ def execute(db, stmt, params) -> List[Result]:
         return _run_variants(db, stmt, params, variants, tried=plan)
 
 
+#: minimum same-plan items in a batch before the vmapped group dispatch
+#: pays for its extra compile (per plan per pow2 lane bucket)
+_GROUP_MIN = 4
+
+
+class _Group:
+    """Stacked device result of a vmapped group dispatch; fetched to
+    host ONCE and sliced per lane."""
+
+    __slots__ = ("dev", "_np")
+
+    def __init__(self, dev) -> None:
+        self.dev = dev
+        self._np = None
+
+    def arr(self) -> np.ndarray:
+        if self._np is None:
+            self._np = np.asarray(self.dev)
+        return self._np
+
+
+class _Lane:
+    """One lane of a group: `grp.arr()[k]` is this query's meta
+    (count-only) or fused buffer slice (direct-fetch). ``k=None`` marks
+    a shared single dispatch (no dynamic args — all lanes identical)."""
+
+    __slots__ = ("grp", "k")
+
+    def __init__(self, grp: "_Group", k: Optional[int]) -> None:
+        self.grp = grp
+        self.k = k
+
+    def meta(self) -> np.ndarray:
+        a = self.grp.arr()
+        return a if self.k is None else a[self.k]
+
+
 def execute_batch(db, items) -> List:
     """Execute ``[(stmt, params), ...]`` with one overlapped transfer phase.
 
     The single-chip DP axis (SURVEY.md §5 "replicas = independent query
-    streams"): every cached plan dispatches back-to-back (~40 µs each),
-    async host copies start for all results, and only then does
-    materialization block — so N queries cost ~one tunnel RTT instead of N.
+    streams"): every cached plan dispatches back-to-back, async host
+    copies start for all results, and only then does materialization
+    block — so N queries cost ~one tunnel RTT instead of N. Runs of the
+    SAME plan (≥ _GROUP_MIN) collapse further into ONE vmapped Execute
+    (`dispatch_many`), amortizing the ~1.4 ms fixed per-Execute cost of
+    the tunneled runtime across the whole group.
 
     Per-item failures (Uncompilable) are returned in-place as the exception
     instance so the engine front door can fall back per statement."""
     out: List = [None] * len(items)
-    pending = []
+    prepared = []  # (i, variants, plan, params)
     fresh = []
     for i, (stmt, params) in enumerate(items):
         try:
@@ -2951,17 +3090,75 @@ def execute_batch(db, items) -> List:
         else:
             # sticky routing: repeated parameter values dispatch straight
             # to the variant that last served them
-            plan = variants.pick(params)
+            prepared.append((i, variants, variants.pick(params), params))
+    groups: Dict[int, List[int]] = {}
+    for j, (_i, _v, plan, _params) in enumerate(prepared):
+        if getattr(plan, "batchable", None) is not None and plan.batchable():
+            groups.setdefault(id(plan), []).append(j)
+    grouped = {
+        j for idxs in groups.values() if len(idxs) >= _GROUP_MIN for j in idxs
+    }
+    pending = []
+    for j, (i, variants, plan, params) in enumerate(prepared):
+        if j in grouped:
+            continue  # dispatched below as a vmapped group
+        stmt, _ = items[i]
+        try:
+            dev = plan.dispatch(params or {})
+        except ScheduleOverflow:
+            # seed capacity overflow surfaces at dispatch (host-side
+            # index probe) — walk the variants now
+            out[i] = _run_variants(
+                db, stmt, params, variants, tried=plan, fresh=fresh
+            )
+            continue
+        pending.append((i, variants, plan, dev))
+    for idxs in groups.values():
+        if len(idxs) < _GROUP_MIN:
+            continue
+        plan = prepared[idxs[0]][2]
+        dyns, lanes = [], []
+        for j in idxs:
+            i, variants, _p, params = prepared[j]
             try:
-                dev = plan.dispatch(params or {})
+                dyns.append(plan._dyn_args(params or {}))
+                lanes.append(j)
             except ScheduleOverflow:
-                # seed capacity overflow surfaces at dispatch (host-side
-                # index probe) — walk the variants now
                 out[i] = _run_variants(
-                    db, stmt, params, variants, tried=plan, fresh=fresh
+                    db, items[i][0], params, variants, tried=plan, fresh=fresh
                 )
+        if not lanes:
+            continue
+        if not dyns[0]:
+            # no dynamic args: every lane is the SAME program on the same
+            # inputs — one plain dispatch serves the whole group
+            dev = plan.dispatch({})
+            grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
+            ks = [None] * len(lanes)
+        else:
+            dev = plan.dispatch_many(dyns)
+            if dev is None:
+                # vmapped executable still compiling in the background
+                # (or permanently unavailable): serve per-lane, with the
+                # same overflow walk as the singles path — a seed grown
+                # since the group's _dyn_args probe must not fail the batch
+                for j in lanes:
+                    i, variants, _p, params = prepared[j]
+                    try:
+                        pending.append(
+                            (i, variants, plan, plan.dispatch(params or {}))
+                        )
+                    except ScheduleOverflow:
+                        out[i] = _run_variants(
+                            db, items[i][0], params, variants,
+                            tried=plan, fresh=fresh,
+                        )
                 continue
-            pending.append((i, variants, plan, dev))
+            grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
+            ks = list(range(len(lanes)))
+        for k, j in zip(ks, lanes):
+            i, variants, _p, _params = prepared[j]
+            pending.append((i, variants, plan, _Lane(grp, k)))
     # wave 1: metas (tiny, overlapped) — traverse plans ship their whole
     # payload here since they have no meta/data split
     meta_devs, data_devs = [], []
@@ -2970,7 +3167,7 @@ def execute_batch(db, items) -> List:
             meta_devs.append(dev[0])
             data_devs.append(dev[1:])  # (data32, data16)
         else:
-            meta_devs.append(dev)
+            meta_devs.append(dev)  # bare array, or a group _Lane
             data_devs.append(None)
     # interleaved fetch: the device executes the batch in dispatch order,
     # so each query's meta is read as IT lands (not after the whole batch
@@ -2983,9 +3180,16 @@ def execute_batch(db, items) -> List:
     import time as _time
 
     pages_sel: List = [None] * len(pending)
+    seen_groups = set()
     for d in meta_devs:
         # direct-fetch plans ride this same wave: their dev IS the fused
-        # single buffer (data + meta row), so one copy covers the query
+        # single buffer (data + meta row), so one copy covers the query;
+        # a group's stacked buffer starts ONE copy for all its lanes
+        if isinstance(d, _Lane):
+            if id(d.grp) in seen_groups:
+                continue
+            seen_groups.add(id(d.grp))
+            d = d.grp.dev
         try:
             d.copy_to_host_async()
         except Exception:
@@ -2993,7 +3197,8 @@ def execute_batch(db, items) -> List:
     t0 = _time.perf_counter()
     metas: List = []
     for k, (_i, _v, plan, _dev) in enumerate(pending):
-        meta = np.asarray(meta_devs[k])
+        md = meta_devs[k]
+        meta = md.meta() if isinstance(md, _Lane) else np.asarray(md)
         metas.append(meta)
         pair = data_devs[k]
         if pair is None or not pair[0] or meta.ndim != 1 or int(meta[1]):

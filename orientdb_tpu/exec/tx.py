@@ -197,13 +197,11 @@ class Transaction:
             raise TxError("transaction no longer active")
         db = self.db
         try:
-            try:
+            # quorum pushes deferred during the locked apply (the
+            # atomic tx entry) ship once the db-wide lock is free
+            with db._quorum_deferral():
                 with db._lock:
                     return self._commit_locked(db)
-            finally:
-                # quorum pushes deferred during the locked apply (the
-                # atomic tx entry) ship once the db-wide lock is free
-                db._flush_quorum()
         except Exception:
             # a failed commit invalidates the tx (the reference rolls the
             # whole transaction back on OConcurrentModificationException /

@@ -172,23 +172,46 @@ class Database:
         if q is None:
             return
         payload = {**entry, "lsn": lsn}
-        if self._lock._is_owned():
+        if getattr(self._tx_local, "defer_quorum", 0) > 0:
             pending = getattr(self._tx_local, "pending_quorum", None)
             if pending is None:
                 pending = self._tx_local.pending_quorum = []
             pending.append(payload)
             return
+        # outside a deferral section (e.g. DDL through _wal_log): push
+        # inline — possibly under db._lock, the pre-deferral behavior
         q.replicate(payload)
 
+    def _quorum_deferral(self):
+        """Context manager wrapped around each locked write section
+        (save/delete/new_edge/tx-commit): quorum pushes inside it queue
+        and flush at the OUTERMOST section exit — after that section has
+        released db._lock — via `_flush_quorum`. Counter-based so nested
+        sections (save() inside new_edge()) flush once, and so _wal_log
+        sites NOT wrapped (DDL) keep pushing inline instead of
+        stranding entries on the thread-local queue."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def section():
+            tl = self._tx_local
+            tl.defer_quorum = getattr(tl, "defer_quorum", 0) + 1
+            try:
+                yield
+            finally:
+                tl.defer_quorum -= 1
+                if tl.defer_quorum == 0:
+                    self._flush_quorum()
+
+        return section()
+
     def _flush_quorum(self) -> None:
-        """Ship quorum pushes deferred by `_quorum_push` while db._lock
-        was held. No-op while the lock is still owned (nested write
-        sections — e.g. save() inside new_edge() — flush at the
-        OUTERMOST exit). Raises the first QuorumError after attempting
+        """Ship quorum pushes deferred by `_quorum_push` inside a
+        deferral section. Raises the first QuorumError after attempting
         every pending entry, so a failed early push cannot silently
         swallow later in-doubt entries."""
         pending = getattr(self._tx_local, "pending_quorum", None)
-        if not pending or self._lock._is_owned():
+        if not pending:
             return
         self._tx_local.pending_quorum = []
         q = getattr(self, "_repl_quorum", None)
@@ -274,7 +297,7 @@ class Database:
             return tx.new_edge(cls.name, src, dst, **fields)
         if not (src.rid.is_persistent and dst.rid.is_persistent):
             raise ValueError("both endpoints must be saved before creating an edge")
-        try:
+        with self._quorum_deferral():
             with self._lock:
                 e = Edge(cls.name, fields)
                 e._db = self
@@ -285,21 +308,17 @@ class Database:
                 dst._bag(Direction.IN, cls.name).append(e.rid)
                 src.version += 1
                 dst.version += 1
-        finally:
-            self._flush_quorum()
         return e
 
     def save(self, doc: Document) -> Document:
         tx = self.tx
         if tx is not None and not self._tx_suspended:
             return tx.save(doc)
-        try:
+        # deferred quorum pushes ship after the lock is released (see
+        # _quorum_push); also on failure — an entry logged before a
+        # later hook raised is already durable and must still ack
+        with self._quorum_deferral():
             return self._save_locked(doc)
-        finally:
-            # deferred quorum pushes ship after the lock is released (see
-            # _quorum_push); also on failure — an entry logged before a
-            # later hook raised is already durable and must still ack
-            self._flush_quorum()
 
     def _save_locked(self, doc: Document) -> Document:
         with self._lock:
@@ -378,10 +397,8 @@ class Database:
         if tx is not None and not self._tx_suspended:
             tx.delete(doc)
             return
-        try:
+        with self._quorum_deferral():
             self._delete_locked(doc)
-        finally:
-            self._flush_quorum()
 
     def _delete_locked(self, doc: Document) -> None:
         with self._lock:
