@@ -1,0 +1,153 @@
+"""Array-native snapshot builder for SF100-scale benchmarking.
+
+The SF100 north star (BASELINE.md row 5; SURVEY.md §6 row 5 and §7 step
+7) needs graphs of 10^8 edges in HBM. The record-store ingest path
+(`storage/ingest.generate_*` → Documents → `build_snapshot`) tops out
+around 10^6 edges per minute because it materializes every vertex/edge
+as a host object; this builder constructs the columnar `GraphSnapshot`
+DIRECTLY as numpy arrays — the same CSR + property-column layout
+`build_snapshot` emits (snapshot.py:327) without the object detour —
+so a 10^8-edge Person–knows graph builds in under a minute and uploads
+as int32 CSR (the §7 "int32 compaction" memory plan).
+
+Degree skew (SURVEY.md §5.7 "supernode degree skew", VERDICT r3 #7):
+``supernodes``/``supernode_degree`` plant celebrity vertices with 10^4+
+out-degrees on top of the Poisson base, so kernels see the frontier
+shapes a power-law graph produces.
+
+The Python oracle cannot run here (there are no host records), so
+parity for the benched COUNT shapes comes from `numpy_2hop_count` /
+`numpy_1hop_count` — exact int64 reference computations over the same
+arrays (the role the Java executor plays in BASELINE.json, at array
+level)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.storage.snapshot import (
+    EdgeClassCSR,
+    GraphSnapshot,
+    PropertyColumn,
+)
+
+
+def build_person_knows(
+    n_persons: int,
+    avg_knows: int = 10,
+    seed: int = 0,
+    supernodes: int = 0,
+    supernode_degree: int = 0,
+    name: str = "bigshape",
+) -> Tuple[Database, GraphSnapshot]:
+    """A Person–knows graph as (schema-only Database, attached snapshot).
+
+    Properties: ``uid`` (dense id) and ``age`` (18–79) on Person. The
+    returned database holds SCHEMA ONLY — queries must run on the
+    compiled engine (engine="tpu"); parity uses the numpy references
+    below."""
+    rng = np.random.default_rng(seed)
+    db = Database(name)
+    db.schema.create_vertex_class("Person")
+    db.schema.create_edge_class("knows")
+
+    V = int(n_persons)
+    degrees = rng.poisson(avg_knows, V).astype(np.int64)
+    if supernodes > 0:
+        # celebrity vertices: a few sources with 10^4-10^5 out-degree —
+        # scattered through the id space so they land in different
+        # expansion chunks
+        hubs = np.linspace(0, V - 1, supernodes, dtype=np.int64)
+        degrees[hubs] = supernode_degree
+    E = int(degrees.sum())
+    indptr_out = np.concatenate([[0], np.cumsum(degrees)]).astype(np.int32)
+    dst = rng.integers(0, V, E, dtype=np.int64)
+
+    csr = EdgeClassCSR("knows")
+    csr.indptr_out = indptr_out
+    csr.dst = dst.astype(np.int32)
+    csr.out_degree_max = int(degrees.max()) if V else 0
+    order_in = np.argsort(dst, kind="stable")
+    edge_src = np.repeat(np.arange(V, dtype=np.int32), degrees)
+    csr._edge_src = edge_src  # pre-seed the cached property
+    csr.src = edge_src[order_in].astype(np.int32)
+    csr.edge_id_in = order_in.astype(np.int32)
+    counts_in = np.bincount(dst, minlength=V)
+    csr.indptr_in = np.concatenate([[0], np.cumsum(counts_in)]).astype(
+        np.int32
+    )
+    csr.in_degree_max = int(counts_in.max()) if V else 0
+    csr.edge_rids = []  # COUNT-only benches never marshal edge RIDs
+
+    snap = GraphSnapshot()
+    snap.num_vertices = V
+    person_cluster = db.schema.get_class("Person").cluster_ids[0]
+    snap.v_cluster = np.full(V, person_cluster, np.int32)
+    snap.v_position = np.arange(V, dtype=np.int32)
+    snap.rid_to_idx = {}  # no host records: index seeds are N/A
+
+    all_classes = sorted(db.schema.classes(), key=lambda c: c.name)
+    snap.class_names = [c.name for c in all_classes]
+    snap.class_id_of = {c.name.lower(): i for i, c in enumerate(all_classes)}
+    snap.v_class = np.full(V, snap.class_id_of["person"], np.int32)
+    for c in all_classes:
+        closure = [
+            snap.class_id_of[s.name.lower()]
+            for s in c.subclasses(include_self=True)
+        ]
+        snap.class_closure[c.name.lower()] = np.array(sorted(closure), np.int32)
+    for c in all_classes:
+        if c.is_vertex_type and not c.abstract:
+            snap.class_vertex_range[c.name.lower()] = (
+                (0, V) if c.name == "Person" else (0, 0)
+            )
+
+    ones = np.ones(V, bool)
+    snap.v_columns = {
+        "uid": PropertyColumn(
+            "uid", "int", np.arange(V, dtype=np.int32), ones
+        ),
+        "age": PropertyColumn(
+            "age", "int", rng.integers(18, 80, V, dtype=np.int32), ones
+        ),
+    }
+    snap.edge_classes["knows"] = csr
+    for c in all_classes:
+        if c.is_edge_type:
+            snap.edge_closure[c.name.lower()] = sorted(
+                s.name
+                for s in c.subclasses(include_self=True)
+                if s.name in snap.edge_classes
+            )
+    snap.epoch = db.mutation_epoch
+    db.attach_snapshot(snap)
+    return db, snap
+
+
+# ---------------------------------------------------------------------------
+# exact numpy references for the benched COUNT shapes (the parity oracle
+# at array level — int64 throughout, no device involved)
+# ---------------------------------------------------------------------------
+
+
+def _seg_sum(vals: np.ndarray, indptr: np.ndarray) -> np.ndarray:
+    tot = np.concatenate([[0], np.cumsum(vals, dtype=np.int64)])
+    return tot[indptr[1:].astype(np.int64)] - tot[indptr[:-1].astype(np.int64)]
+
+
+def numpy_1hop_count(snap: GraphSnapshot, src_mask, dst_mask) -> int:
+    """count of (p, f) pairs with src_mask[p] and dst_mask[f]."""
+    csr = snap.edge_classes["knows"]
+    w1 = _seg_sum(dst_mask[csr.dst].astype(np.int64), csr.indptr_out)
+    return int((w1 * src_mask.astype(np.int64)).sum())
+
+
+def numpy_2hop_count(snap: GraphSnapshot, src_mask, mid_mask, dst_mask) -> int:
+    """count of (p, f, g) paths with the three masks applied."""
+    csr = snap.edge_classes["knows"]
+    w2 = _seg_sum(dst_mask[csr.dst].astype(np.int64), csr.indptr_out)
+    w1 = _seg_sum((mid_mask[csr.dst] * w2[csr.dst]).astype(np.int64), csr.indptr_out)
+    return int((w1 * src_mask.astype(np.int64)).sum())

@@ -1,0 +1,75 @@
+"""Array-native SF-scale snapshot builder (VERDICT r3 #2/#7): the
+compiled engine over a snapshot built directly from numpy arrays must
+match the exact int64 numpy references, including under supernode
+degree skew."""
+
+import numpy as np
+import pytest
+
+from orientdb_tpu.storage.bigshape import (
+    build_person_knows,
+    numpy_1hop_count,
+    numpy_2hop_count,
+)
+
+SQL_1HOP = (
+    "MATCH {class:Person, as:p, where:(age > 40)}"
+    "-knows->{as:f, where:(age < 30)} "
+    "RETURN count(*) AS n"
+)
+SQL_2HOP = (
+    "MATCH {class:Person, as:p, where:(age > 40)}"
+    "-knows->{as:f}"
+    "-knows->{as:g, where:(age < 30)} "
+    "RETURN count(*) AS n"
+)
+
+
+def _masks(snap):
+    age = snap.v_columns["age"].values
+    return age > 40, np.ones(age.shape[0], bool), age < 30
+
+
+@pytest.mark.parametrize("skew", [0, 50])
+def test_counts_match_numpy_reference(skew):
+    db, snap = build_person_knows(
+        50_000,
+        avg_knows=8,
+        seed=3,
+        supernodes=skew,
+        supernode_degree=2_000 if skew else 0,
+    )
+    src, mid, dst = _masks(snap)
+    got1 = db.query(SQL_1HOP, engine="tpu", strict=True).to_dicts()
+    assert got1 == [{"n": numpy_1hop_count(snap, src, dst)}]
+    got2 = db.query(SQL_2HOP, engine="tpu", strict=True).to_dicts()
+    assert got2 == [{"n": numpy_2hop_count(snap, src, mid, dst)}]
+
+
+def test_skewed_csr_wellformed():
+    _db, snap = build_person_knows(
+        10_000, avg_knows=5, seed=1, supernodes=10, supernode_degree=3_000
+    )
+    csr = snap.edge_classes["knows"]
+    assert csr.out_degree_max == 3_000
+    E = csr.num_edges
+    assert E == csr.indptr_out[-1] == csr.indptr_in[-1]
+    # in-CSR is a permutation of out order
+    assert np.array_equal(np.sort(csr.edge_id_in), np.arange(E))
+    # every in-edge's (src, dst) agrees with the out-edge it maps to
+    k = min(E, 1000)
+    sel = np.random.default_rng(0).integers(0, E, k)
+    out_src = csr.edge_src_np()
+    assert np.array_equal(csr.src[sel], out_src[csr.edge_id_in[sel]])
+
+
+def test_batched_counts_on_bigshape():
+    db, snap = build_person_knows(30_000, avg_knows=6, seed=7)
+    src, _mid, dst = _masks(snap)
+    want = {"n": numpy_1hop_count(snap, src, dst)}
+    from orientdb_tpu.exec.tpu_engine import drain_warmups
+
+    db.query_batch([SQL_1HOP] * 8, engine="tpu", strict=True)
+    drain_warmups()
+    rss = db.query_batch([SQL_1HOP] * 8, engine="tpu", strict=True)
+    assert all(rs.to_dicts() == [want] for rs in rss)

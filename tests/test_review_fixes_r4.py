@@ -1,0 +1,97 @@
+"""Round-4 review regressions:
+
+1. DDL through _wal_log (no write-section wrapper) must quorum-push
+   INLINE, not strand entries on the thread-local deferral queue;
+2. EXPORT/IMPORT DATABASE must round-trip a Lucene-grade fulltext
+   index's engine+analyzer, not downgrade it to the token index;
+3. SEARCH_INDEX on an existing non-fulltext index raises ValueError,
+   and its per-row memoized match set is invalidated by writes.
+"""
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.fulltext import LuceneFullTextIndex
+from orientdb_tpu.storage.durability import enable_durability
+
+
+class RecordingQuorum:
+    def __init__(self):
+        self.payloads = []
+
+    def replicate(self, payload):
+        self.payloads.append(payload)
+        return 1
+
+
+@pytest.fixture()
+def ddb(tmp_path):
+    db = Database("d")
+    db.schema.create_vertex_class("P")
+    enable_durability(db, str(tmp_path))
+    return db
+
+
+def test_ddl_quorum_pushes_are_not_stranded(ddb):
+    q = RecordingQuorum()
+    ddb._repl_quorum = q
+    ddb.schema.create_class("Tmp")
+    ddb.schema.drop_class("Tmp")
+    ops = [p["op"] for p in q.payloads]
+    assert "create_class" in ops and "drop_class" in ops, (
+        "DDL entries must replicate at DDL time, not ride a later write"
+    )
+    # and nothing is left pending on the thread-local queue
+    assert not getattr(ddb._tx_local, "pending_quorum", None)
+
+
+def test_index_ddl_quorum_pushes_inline(ddb):
+    q = RecordingQuorum()
+    ddb._repl_quorum = q
+    ddb.indexes.create_index("P.x", "P", ["x"], "NOTUNIQUE")
+    assert [p["op"] for p in q.payloads] == ["create_index"]
+
+
+def test_export_import_keeps_lucene_engine(tmp_path):
+    from orientdb_tpu.storage.ingest import export_database, import_database
+
+    db = Database("src")
+    db.schema.create_class("Article")
+    db.new_element("Article", title="Caching", body="cache stores data")
+    db.indexes.create_index(
+        "Article.ft", "Article", ["title", "body"], "FULLTEXT",
+        engine="LUCENE", metadata={"analyzer": "english"},
+    )
+    path = str(tmp_path / "export.json.gz")
+    export_database(db, path)
+    db2 = import_database(path, name="dst")
+    idx = db2.indexes.get_index("Article.ft")
+    assert isinstance(idx, LuceneFullTextIndex)
+    assert idx.analyzer_name == "english"
+    assert len(idx.match("cach*")) == 1
+
+
+def test_search_index_on_value_index_raises(db=None):
+    db = Database("d")
+    db.schema.create_class("P")
+    db.new_element("P", n=1)
+    db.indexes.create_index("P.n", "P", ["n"], "NOTUNIQUE")
+    with pytest.raises(Exception) as ei:
+        db.query("SELECT FROM P WHERE search_index('P.n', 'x')").to_dicts()
+    assert "fulltext" in str(ei.value).lower()
+
+
+def test_search_memo_invalidated_by_writes():
+    db = Database("d")
+    db.schema.create_class("Article")
+    a = db.new_element("Article", body="alpha beta")
+    db.indexes.create_index(
+        "Article.ft", "Article", ["body"], "FULLTEXT", engine="LUCENE"
+    )
+    q = "SELECT FROM Article WHERE search_class('alpha')"
+    assert len(db.query(q).to_dicts()) == 1
+    a.set("body", "gamma only")
+    db.save(a)
+    assert db.query(q).to_dicts() == []
+    b = db.new_element("Article", body="alpha again")
+    assert len(db.query(q).to_dicts()) == 1
