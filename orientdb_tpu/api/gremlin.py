@@ -1,0 +1,648 @@
+"""Gremlin-style step-chain traversal DSL.
+
+Analog of the reference's TinkerPop/Gremlin surface ([E] the
+``orientdb-gremlin`` companion repo's ``OrientGraphTraversalSource``;
+SURVEY.md §2 "Graph API (TinkerPop)" marks Gremlin as the missing
+traversal language over the Blueprints layer). Idiomatic-Python
+redesign of the core step set:
+
+    g = traversal(db)
+    g.V().hasLabel("Person").has("age", P.gt(30)) \
+         .out("knows").values("name").toList()
+    g.V().repeat(__.out("knows")).times(2).dedup().count().next()
+
+Traversals are LAZY step chains over the embedded database (one Python
+generator per step — the pull-based iterator shape of the reference's
+step executor); terminal steps (`toList`, `next`, `iterate`, `count`…)
+drain them. Traverser state carries the path (for `path()`/
+`simplePath()`) and `as_`-labels (for `select`)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from orientdb_tpu.models.record import Direction, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+
+_DIRS = {"out": Direction.OUT, "in": Direction.IN, "both": Direction.BOTH}
+
+
+class P:
+    """Gremlin-style predicates for `has(key, P.xxx(...))`."""
+
+    def __init__(self, fn: Callable[[object], bool], desc: str) -> None:
+        self.fn = fn
+        self.desc = desc
+
+    def __call__(self, v) -> bool:
+        try:
+            return bool(self.fn(v))
+        except TypeError:
+            return False  # e.g. None < int
+
+    def __repr__(self) -> str:
+        return f"P.{self.desc}"
+
+    @staticmethod
+    def eq(x):
+        return P(lambda v: v == x, f"eq({x!r})")
+
+    @staticmethod
+    def neq(x):
+        return P(lambda v: v != x, f"neq({x!r})")
+
+    @staticmethod
+    def gt(x):
+        return P(lambda v: v is not None and v > x, f"gt({x!r})")
+
+    @staticmethod
+    def gte(x):
+        return P(lambda v: v is not None and v >= x, f"gte({x!r})")
+
+    @staticmethod
+    def lt(x):
+        return P(lambda v: v is not None and v < x, f"lt({x!r})")
+
+    @staticmethod
+    def lte(x):
+        return P(lambda v: v is not None and v <= x, f"lte({x!r})")
+
+    @staticmethod
+    def within(*xs):
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple, set)):
+            xs = tuple(xs[0])
+        return P(lambda v: v in xs, f"within{xs!r}")
+
+    @staticmethod
+    def without(*xs):
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple, set)):
+            xs = tuple(xs[0])
+        return P(lambda v: v not in xs, f"without{xs!r}")
+
+    @staticmethod
+    def between(lo, hi):
+        return P(lambda v: v is not None and lo <= v < hi, f"between({lo!r},{hi!r})")
+
+    @staticmethod
+    def containing(sub):
+        return P(lambda v: isinstance(v, str) and sub in v, f"containing({sub!r})")
+
+
+class _Traverser:
+    __slots__ = ("obj", "path", "labels")
+
+    def __init__(self, obj, path: Tuple, labels: Dict[str, object]) -> None:
+        self.obj = obj
+        self.path = path
+        self.labels = labels
+
+    def step(self, obj) -> "_Traverser":
+        return _Traverser(obj, self.path + (obj,), self.labels)
+
+    def tag(self, name: str) -> "_Traverser":
+        labels = dict(self.labels)
+        labels[name] = self.obj
+        t = _Traverser(self.obj, self.path, labels)
+        return t
+
+
+def _obj_key(obj):
+    if isinstance(obj, (Vertex, Edge)):
+        return ("r", str(obj.rid))
+    if isinstance(obj, dict):
+        return ("d", tuple(sorted((k, repr(v)) for k, v in obj.items())))
+    try:
+        hash(obj)
+        return ("v", obj)
+    except TypeError:
+        return ("s", repr(obj))
+
+
+class Traversal:
+    """A lazy step chain; every step method returns a NEW traversal with
+    one more stage. Anonymous sub-traversals (``__``) start without a
+    source and are bound per-traverser by `where`/`repeat`/`coalesce`."""
+
+    def __init__(self, db=None, source=None, stages=None) -> None:
+        self.db = db
+        self._source = source  # callable -> iterator of seed objects
+        self._stages: List[Callable] = stages or []
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _with(self, stage: Callable) -> "Traversal":
+        return Traversal(self.db, self._source, self._stages + [stage])
+
+    def _run(self, seeds: Iterator[_Traverser]) -> Iterator[_Traverser]:
+        it = seeds
+        for stage in self._stages:
+            it = stage(it)
+        return it
+
+    def _traversers(self) -> Iterator[_Traverser]:
+        if self._source is None:
+            raise ValueError("anonymous traversal needs a bound source")
+        seeds = (_Traverser(o, (o,), {}) for o in self._source())
+        return self._run(seeds)
+
+    def __iter__(self):
+        return (t.obj for t in self._traversers())
+
+    # -- navigation steps ---------------------------------------------------
+
+    def _nav(self, dname: str, labels, to_edges: bool) -> "Traversal":
+        d = _DIRS[dname]
+        labs = list(labels) or [None]
+
+        def stage(it):
+            for t in it:
+                v = t.obj
+                if not isinstance(v, Vertex):
+                    continue
+                for lab in labs:
+                    if to_edges:
+                        for e in v.edges(d, lab):
+                            yield t.step(e)
+                    else:
+                        for n in v.vertices(d, lab):
+                            yield t.step(n)
+
+        return self._with(stage)
+
+    def out(self, *labels) -> "Traversal":
+        return self._nav("out", labels, to_edges=False)
+
+    def in_(self, *labels) -> "Traversal":
+        return self._nav("in", labels, to_edges=False)
+
+    def both(self, *labels) -> "Traversal":
+        return self._nav("both", labels, to_edges=False)
+
+    def outE(self, *labels) -> "Traversal":
+        return self._nav("out", labels, to_edges=True)
+
+    def inE(self, *labels) -> "Traversal":
+        return self._nav("in", labels, to_edges=True)
+
+    def bothE(self, *labels) -> "Traversal":
+        return self._nav("both", labels, to_edges=True)
+
+    def _edge_end(self, which: str) -> "Traversal":
+        def stage(it):
+            for t in it:
+                e = t.obj
+                if not isinstance(e, Edge):
+                    continue
+                if which == "out":
+                    yield t.step(e.from_vertex())
+                elif which == "in":
+                    yield t.step(e.to_vertex())
+                else:  # other: the endpoint we did NOT come from
+                    prev = next(
+                        (p for p in reversed(t.path[:-1]) if isinstance(p, Vertex)),
+                        None,
+                    )
+                    o, i = e.from_vertex(), e.to_vertex()
+                    if prev is not None and o.rid == prev.rid:
+                        yield t.step(i)
+                    else:
+                        yield t.step(o)
+
+        return self._with(stage)
+
+    def outV(self) -> "Traversal":
+        return self._edge_end("out")
+
+    def inV(self) -> "Traversal":
+        return self._edge_end("in")
+
+    def otherV(self) -> "Traversal":
+        return self._edge_end("other")
+
+    # -- filter steps -------------------------------------------------------
+
+    def hasLabel(self, *labels) -> "Traversal":
+        labs = set(labels)
+
+        def stage(it):
+            db = self.db
+            for t in it:
+                cls = getattr(t.obj, "class_name", None)
+                if cls is None:
+                    continue
+                if cls in labs:
+                    yield t
+                elif db is not None:
+                    c = db.schema.get_class(cls)
+                    if c is not None and any(c.is_subclass_of(x) for x in labs):
+                        yield t
+
+        return self._with(stage)
+
+    def has(self, key: str, value=None) -> "Traversal":
+        def stage(it):
+            for t in it:
+                getter = getattr(t.obj, "get", None)
+                if getter is None:
+                    continue
+                v = getter(key)
+                if value is None:
+                    ok = v is not None
+                elif isinstance(value, P):
+                    ok = value(v)
+                else:
+                    ok = v == value
+                if ok:
+                    yield t
+
+        return self._with(stage)
+
+    def hasNot(self, key: str) -> "Traversal":
+        def stage(it):
+            for t in it:
+                getter = getattr(t.obj, "get", None)
+                if getter is not None and getter(key) is None:
+                    yield t
+
+        return self._with(stage)
+
+    def hasId(self, *ids) -> "Traversal":
+        want = {str(i) for i in ids}
+
+        def stage(it):
+            for t in it:
+                rid = getattr(t.obj, "rid", None)
+                if rid is not None and str(rid) in want:
+                    yield t
+
+        return self._with(stage)
+
+    def where(self, sub: "Traversal") -> "Traversal":
+        def stage(it):
+            for t in it:
+                seeded = sub._run(iter([_Traverser(t.obj, (t.obj,), t.labels)]))
+                if next(seeded, None) is not None:
+                    yield t
+
+        return self._with(stage)
+
+    def not_(self, sub: "Traversal") -> "Traversal":
+        def stage(it):
+            for t in it:
+                seeded = sub._run(iter([_Traverser(t.obj, (t.obj,), t.labels)]))
+                if next(seeded, None) is None:
+                    yield t
+
+        return self._with(stage)
+
+    def dedup(self) -> "Traversal":
+        def stage(it):
+            seen = set()
+            for t in it:
+                k = _obj_key(t.obj)
+                if k not in seen:
+                    seen.add(k)
+                    yield t
+
+        return self._with(stage)
+
+    def simplePath(self) -> "Traversal":
+        def stage(it):
+            for t in it:
+                keys = [_obj_key(p) for p in t.path]
+                if len(keys) == len(set(keys)):
+                    yield t
+
+        return self._with(stage)
+
+    def limit(self, n: int) -> "Traversal":
+        return self._with(lambda it: itertools.islice(it, n))
+
+    def skip(self, n: int) -> "Traversal":
+        return self._with(lambda it: itertools.islice(it, n, None))
+
+    def range_(self, lo: int, hi: int) -> "Traversal":
+        return self._with(lambda it: itertools.islice(it, lo, hi))
+
+    # -- repeat -------------------------------------------------------------
+
+    def repeat(self, sub: "Traversal") -> "_RepeatBuilder":
+        return _RepeatBuilder(self, sub)
+
+    # -- labels / projection ------------------------------------------------
+
+    def as_(self, name: str) -> "Traversal":
+        return self._with(lambda it: (t.tag(name) for t in it))
+
+    def select(self, *names) -> "Traversal":
+        def stage(it):
+            for t in it:
+                if len(names) == 1:
+                    if names[0] in t.labels:
+                        yield t.step(t.labels[names[0]])
+                else:
+                    if all(n in t.labels for n in names):
+                        yield t.step({n: t.labels[n] for n in names})
+
+        return self._with(stage)
+
+    def values(self, *keys) -> "Traversal":
+        def stage(it):
+            for t in it:
+                getter = getattr(t.obj, "get", None)
+                if getter is None:
+                    continue
+                ks = keys or getattr(t.obj, "field_names", lambda: [])()
+                for k in ks:
+                    v = getter(k)
+                    if v is not None:
+                        yield t.step(v)
+
+        return self._with(stage)
+
+    def valueMap(self, *keys) -> "Traversal":
+        def stage(it):
+            for t in it:
+                getter = getattr(t.obj, "get", None)
+                if getter is None:
+                    continue
+                ks = keys or getattr(t.obj, "field_names", lambda: [])()
+                yield t.step({k: getter(k) for k in ks})
+
+        return self._with(stage)
+
+    def id_(self) -> "Traversal":
+        return self._with(
+            lambda it: (t.step(str(t.obj.rid)) for t in it if hasattr(t.obj, "rid"))
+        )
+
+    def label(self) -> "Traversal":
+        return self._with(
+            lambda it: (
+                t.step(t.obj.class_name)
+                for t in it
+                if hasattr(t.obj, "class_name")
+            )
+        )
+
+    def path(self) -> "Traversal":
+        return self._with(lambda it: (t.step(list(t.path)) for t in it))
+
+    # -- ordering / aggregation ---------------------------------------------
+
+    def order(self) -> "_OrderBuilder":
+        return _OrderBuilder(self)
+
+    def count(self) -> "Traversal":
+        def stage(it):
+            n = sum(1 for _ in it)
+            yield _Traverser(n, (n,), {})
+
+        return self._with(stage)
+
+    def fold(self) -> "Traversal":
+        def stage(it):
+            objs = [t.obj for t in it]
+            yield _Traverser(objs, (objs,), {})
+
+        return self._with(stage)
+
+    def unfold(self) -> "Traversal":
+        def stage(it):
+            for t in it:
+                for o in t.obj if isinstance(t.obj, (list, tuple, set)) else [t.obj]:
+                    yield t.step(o)
+
+        return self._with(stage)
+
+    def _agg(self, fn, name) -> "Traversal":
+        def stage(it):
+            vals = [t.obj for t in it if t.obj is not None]
+            out = fn(vals) if vals else None
+            yield _Traverser(out, (out,), {})
+
+        return self._with(stage)
+
+    def sum_(self) -> "Traversal":
+        return self._agg(sum, "sum")
+
+    def max_(self) -> "Traversal":
+        return self._agg(max, "max")
+
+    def min_(self) -> "Traversal":
+        return self._agg(min, "min")
+
+    def mean(self) -> "Traversal":
+        return self._agg(lambda v: sum(v) / len(v), "mean")
+
+    def groupCount(self) -> "_GroupCountBuilder":
+        return _GroupCountBuilder(self)
+
+    def coalesce(self, *subs: "Traversal") -> "Traversal":
+        def stage(it):
+            for t in it:
+                for sub in subs:
+                    seeded = list(
+                        sub._run(iter([_Traverser(t.obj, (t.obj,), t.labels)]))
+                    )
+                    if seeded:
+                        for s in seeded:
+                            yield t.step(s.obj)
+                        break
+
+        return self._with(stage)
+
+    def constant(self, v) -> "Traversal":
+        return self._with(lambda it: (t.step(v) for t in it))
+
+    # -- terminals ----------------------------------------------------------
+
+    def toList(self) -> List:
+        return list(self)
+
+    def toSet(self) -> set:
+        return set(self)
+
+    def next(self):
+        it = iter(self)
+        try:
+            return next(it)
+        except StopIteration:
+            raise StopIteration("traversal is empty") from None
+
+    def hasNext(self) -> bool:
+        return next(iter(self), _SENTINEL) is not _SENTINEL
+
+    def iterate(self) -> None:
+        for _ in self:
+            pass
+
+
+_SENTINEL = object()
+
+
+class _RepeatBuilder:
+    """`repeat(sub)` awaiting its modulator: `.times(n)`, `.until(sub)`,
+    optionally `.emit()` (emit every intermediate traverser too)."""
+
+    def __init__(self, base: Traversal, sub: Traversal) -> None:
+        self._base = base
+        self._sub = sub
+        self._emit = False
+
+    def emit(self) -> "_RepeatBuilder":
+        self._emit = True
+        return self
+
+    def times(self, n: int) -> Traversal:
+        sub, emit = self._sub, self._emit
+
+        def stage(it):
+            # `repeat(X).emit()` = emit-AFTER each iteration (TinkerPop:
+            # emit-before only when emit() precedes repeat())
+            cur = list(it)
+            for depth in range(n):
+                nxt = []
+                for t in cur:
+                    nxt.extend(
+                        sub._run(iter([_Traverser(t.obj, t.path, t.labels)]))
+                    )
+                cur = nxt
+                if not cur:
+                    return
+                if emit and depth < n - 1:
+                    yield from cur
+            yield from cur
+
+        return self._base._with(stage)
+
+    def until(self, cond: Traversal, max_depth: int = 64) -> Traversal:
+        sub, emit = self._sub, self._emit
+
+        def done(t):
+            seeded = cond._run(iter([_Traverser(t.obj, (t.obj,), t.labels)]))
+            return next(seeded, None) is not None
+
+        def stage(it):
+            cur = list(it)
+            for _depth in range(max_depth):
+                still = []
+                for t in cur:
+                    if done(t):
+                        yield t
+                    else:
+                        if emit:
+                            yield t
+                        still.append(t)
+                if not still:
+                    return
+                nxt = []
+                for t in still:
+                    nxt.extend(
+                        sub._run(iter([_Traverser(t.obj, t.path, t.labels)]))
+                    )
+                cur = nxt
+
+        return self._base._with(stage)
+
+
+class _OrderBuilder:
+    def __init__(self, base: Traversal) -> None:
+        self._base = base
+
+    def by(self, key=None, desc: bool = False) -> Traversal:
+        def keyfn(t):
+            if key is None:
+                return t.obj
+            getter = getattr(t.obj, "get", None)
+            v = getter(key) if getter else None
+            return (v is None, v)  # nulls last, deterministic
+
+        def stage(it):
+            yield from sorted(it, key=keyfn, reverse=desc)
+
+        return self._base._with(stage)
+
+
+class _GroupCountBuilder:
+    def __init__(self, base: Traversal) -> None:
+        self._base = base
+
+    def by(self, key=None) -> Traversal:
+        def stage(it):
+            counts: Dict = {}
+            for t in it:
+                if key is None:
+                    k = t.obj
+                else:
+                    getter = getattr(t.obj, "get", None)
+                    k = getter(key) if getter else None
+                k = k if isinstance(k, (str, int, float, bool, type(None))) else str(k)
+                counts[k] = counts.get(k, 0) + 1
+            yield _Traverser(counts, (counts,), {})
+
+        return self._base._with(stage)
+
+    def __iter__(self):  # bare groupCount() groups by the object itself
+        return iter(self.by())
+
+    def toList(self):
+        return self.by().toList()
+
+    def next(self):
+        return self.by().next()
+
+
+class GraphTraversalSource:
+    """`g = traversal(db)`: the V()/E() entry points."""
+
+    def __init__(self, db) -> None:
+        self.db = db
+
+    def V(self, *ids) -> Traversal:
+        db = self.db
+
+        def source():
+            if ids:
+                for i in ids:
+                    d = db.load(RID.parse(str(i)) if not isinstance(i, RID) else i)
+                    if isinstance(d, Vertex):
+                        yield d
+            else:
+                yield from db.browse_class("V", polymorphic=True)
+
+        return Traversal(db, source)
+
+    def E(self, *ids) -> Traversal:
+        db = self.db
+
+        def source():
+            if ids:
+                for i in ids:
+                    d = db.load(RID.parse(str(i)) if not isinstance(i, RID) else i)
+                    if isinstance(d, Edge):
+                        yield d
+            else:
+                yield from db.browse_class("E", polymorphic=True)
+
+        return Traversal(db, source)
+
+
+def traversal(db_or_graph) -> GraphTraversalSource:
+    db = getattr(db_or_graph, "db", db_or_graph)
+    return GraphTraversalSource(db)
+
+
+class _Anonymous:
+    """`__.out("knows")`-style anonymous traversal factory."""
+
+    def __getattr__(self, name: str):
+        def start(*args, **kw):
+            t = Traversal(None, None)
+            return getattr(t, name)(*args, **kw)
+
+        return start
+
+
+__ = _Anonymous()

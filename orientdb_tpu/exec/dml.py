@@ -351,7 +351,17 @@ def _create_property(db, stmt: A.CreatePropertyStatement) -> List[Result]:
 def _create_index(db, stmt: A.CreateIndexStatement) -> List[Result]:
     if stmt.class_name is None:
         raise CommandError("CREATE INDEX needs a class (use name ON class (fields) or Class.field)")
-    db.indexes.create_index(stmt.name, stmt.class_name, list(stmt.fields), stmt.index_type)
+    metadata = None
+    if stmt.metadata is not None:
+        from orientdb_tpu.exec.eval import EvalContext, evaluate
+
+        metadata = evaluate(EvalContext(db), stmt.metadata)
+        if not isinstance(metadata, dict):
+            raise CommandError("CREATE INDEX METADATA must be a map literal")
+    db.indexes.create_index(
+        stmt.name, stmt.class_name, list(stmt.fields), stmt.index_type,
+        engine=stmt.engine, metadata=metadata,
+    )
     return [Result(props={"operation": "create index", "name": stmt.name})]
 
 

@@ -276,6 +276,47 @@ def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
         return lst[-1] if lst else None
     if name == "size":
         return len(as_list(args[0]))
+    if name in ("search_index", "search_class"):
+        # [E] the Lucene module's SEARCH_INDEX('Name', 'q') /
+        # SEARCH_CLASS('q') WHERE functions: true when the current
+        # record is in the fulltext query's match set (boolean/phrase/
+        # prefix syntax handled by models/fulltext for Lucene-grade
+        # indexes; plain token AND-match on the legacy index)
+        cur = ctx.current
+        if not isinstance(cur, Document):
+            return False
+        if name == "search_index":
+            idx = ctx.db.indexes.get_index(str(args[0]))
+            q = args[1]
+        else:
+            idx = next(
+                (
+                    i
+                    for i in ctx.db.indexes.for_class(cur.class_name)
+                    if getattr(i, "type", "").upper() == "FULLTEXT"
+                ),
+                None,
+            )
+            q = args[0]
+        if idx is None or getattr(idx, "type", "").upper() != "FULLTEXT":
+            raise ValueError(
+                f"{name}: no fulltext index "
+                f"({args[0] if name == 'search_index' else cur.class_name})"
+            )
+        # the WHERE evaluator calls this once PER ROW: memoize the match
+        # set per (index, query) so the boolean query runs once per
+        # statement, not once per candidate record. The cache lives on
+        # the index object and is dropped on any (un)index mutation.
+        cache = idx.__dict__.setdefault("_search_memo", {})
+        key = str(q)
+        rids = cache.get(key)
+        if rids is None:
+            matcher = getattr(idx, "match", None) or idx.search_all
+            rids = frozenset(matcher(key))
+            if len(cache) >= 64:
+                cache.clear()
+            cache[key] = rids
+        return cur.rid in rids
     if name == "distinct":
         seen, out = set(), []
         for v in as_list(args[0]):

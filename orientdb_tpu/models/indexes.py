@@ -199,8 +199,10 @@ class FullTextIndex(Index):
             self._map.setdefault(t, set()).add(doc.rid)
         if tokens:
             self._reverse[doc.rid] = frozenset(tokens)
+        self.__dict__.pop("_search_memo", None)  # eval.py per-query memo
 
     def unindex_doc(self, rid: RID) -> None:
+        self.__dict__.pop("_search_memo", None)  # eval.py per-query memo
         tokens = self._reverse.pop(rid, None)
         if not tokens:
             return
@@ -346,13 +348,30 @@ class IndexManager:
         class_name: str,
         fields: List[str],
         index_type: str = "NOTUNIQUE",
+        engine: Optional[str] = None,
+        metadata: Optional[Dict] = None,
     ) -> Index:
+        """``engine="LUCENE"`` (or an ``analyzer`` key in ``metadata``)
+        selects the scored positional fulltext engine
+        (models/fulltext.LuceneFullTextIndex — analyzers, BM25, boolean/
+        phrase queries); plain FULLTEXT keeps the legacy token index."""
         if name.lower() in self._indexes:
             raise ValueError(f"index '{name}' already exists")
         cls = self._db.schema.get_class_or_raise(class_name)
-        if index_type.upper() in ("FULLTEXT", "FULLTEXT_HASH_INDEX"):
-            idx: Index = FullTextIndex(name, cls.name, fields)
-        elif index_type.upper() == "SPATIAL":
+        up = index_type.upper()
+        lucene = (engine or "").upper() == "LUCENE" or bool(
+            (metadata or {}).get("analyzer")
+        )
+        if up in ("FULLTEXT", "FULLTEXT_HASH_INDEX") and lucene:
+            from orientdb_tpu.models.fulltext import LuceneFullTextIndex
+
+            idx: Index = LuceneFullTextIndex(
+                name, cls.name, fields,
+                analyzer=(metadata or {}).get("analyzer", "standard"),
+            )
+        elif up in ("FULLTEXT", "FULLTEXT_HASH_INDEX"):
+            idx = FullTextIndex(name, cls.name, fields)
+        elif up == "SPATIAL":
             idx = SpatialIndex(name, cls.name, fields)
         else:
             idx = Index(name, cls.name, fields, index_type)
@@ -360,15 +379,18 @@ class IndexManager:
         for doc in self._db.browse_class(cls.name, polymorphic=True):
             idx.index_doc(doc)
         self._indexes[name.lower()] = idx
-        self._db._wal_log(
-            {
-                "op": "create_index",
-                "name": name,
-                "class": cls.name,
-                "fields": list(fields),
-                "type": index_type,
-            }
-        )
+        entry = {
+            "op": "create_index",
+            "name": name,
+            "class": cls.name,
+            "fields": list(fields),
+            "type": index_type,
+        }
+        if engine:
+            entry["engine"] = engine
+        if metadata:
+            entry["metadata"] = dict(metadata)
+        self._db._wal_log(entry)
         return idx
 
     def drop_index(self, name: str) -> None:
@@ -401,6 +423,11 @@ class IndexManager:
         ]:
             del self._indexes[name]
 
+    @staticmethod
+    def _is_fulltext(idx) -> bool:
+        # covers both the legacy token index and the Lucene-grade engine
+        return getattr(idx, "type", "").upper() == "FULLTEXT"
+
     def fulltext_for(self, class_name: str, field: str) -> Optional["FullTextIndex"]:
         """Single-field fulltext index covering ``class_name.field``."""
         cls = self._db.schema.get_class(class_name)
@@ -408,7 +435,7 @@ class IndexManager:
             return None
         for idx in self._indexes.values():
             if (
-                isinstance(idx, FullTextIndex)
+                self._is_fulltext(idx)
                 and field in idx.fields
                 and cls.is_subclass_of(idx.class_name)
             ):
@@ -428,13 +455,31 @@ class IndexManager:
                 out.append(d)
         return out
 
+    def fulltext_ranked(
+        self, index_name: str, query: str, limit: Optional[int] = None
+    ):
+        """BM25-ranked fulltext search through a Lucene-grade index:
+        [(document, score)] best-first ([E] the Lucene engine's scored
+        result cursor)."""
+        idx = self.get_index(index_name)
+        if idx is None or not hasattr(idx, "ranked"):
+            raise ValueError(
+                f"'{index_name}' is not a Lucene-grade fulltext index"
+            )
+        out = []
+        for rid, score in idx.ranked(query, limit=limit):
+            d = self._db.load(rid)
+            if d is not None:
+                out.append((d, score))
+        return out
+
     def best_for(self, class_name: str, field: str) -> Optional[Index]:
         """Single-field index usable for a lookup on ``class_name.field``."""
         cls = self._db.schema.get_class(class_name)
         if cls is None:
             return None
         for idx in self._indexes.values():
-            if isinstance(idx, FullTextIndex):
+            if self._is_fulltext(idx):
                 continue  # token keys — not usable for value lookups
             if idx.fields == [field] and cls.is_subclass_of(idx.class_name):
                 return idx

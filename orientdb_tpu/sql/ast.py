@@ -398,6 +398,10 @@ class CreateIndexStatement(Statement):
     class_name: Optional[str]
     fields: Tuple[str, ...]
     index_type: str
+    #: [E] the Lucene module's CREATE INDEX ... ENGINE LUCENE form
+    engine: Optional[str] = None
+    #: METADATA {...} literal (e.g. {"analyzer": "english"})
+    metadata: Optional["Expression"] = None
 
 
 @dataclasses.dataclass(frozen=True)

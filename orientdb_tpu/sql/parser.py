@@ -774,7 +774,18 @@ class Parser:
                 "INDEX",
             ):
                 itype += "_" + self.eat_ident().upper()
-            return A.CreateIndexStatement(name, cls, fields, itype)
+            # [E] Lucene module's forms: ENGINE LUCENE and METADATA {...}
+            engine = None
+            metadata = None
+            if self.peek().kind == "IDENT" and self.peek().text.upper() == "ENGINE":
+                self.next()
+                engine = self.eat_ident().upper()
+            if self.peek().kind == "IDENT" and self.peek().text.upper() == "METADATA":
+                self.next()
+                metadata = self.parse_expression()
+            return A.CreateIndexStatement(
+                name, cls, fields, itype, engine=engine, metadata=metadata
+            )
         if self.try_kw("VERTEX"):
             cls = self.eat_ident() if self.peek().kind == "IDENT" and not (
                 self.at_kw("SET") or self.at_kw("CONTENT")

@@ -415,7 +415,10 @@ def _apply_entry(db: Database, e: Dict) -> None:
     elif op == "add_cluster":
         db.schema.add_cluster(e["class"])
     elif op == "create_index":
-        db.indexes.create_index(e["name"], e["class"], e["fields"], e["type"])
+        db.indexes.create_index(
+            e["name"], e["class"], e["fields"], e["type"],
+            engine=e.get("engine"), metadata=e.get("metadata"),
+        )
     elif op == "drop_index":
         db.indexes.drop_index(e["name"])
     elif op == "create_sequence":
@@ -511,10 +514,19 @@ def _meta_payload(db: Database) -> Dict:
                 ],
             }
         )
-    indexes = [
-        {"name": i.name, "class": i.class_name, "fields": i.fields, "type": i.type}
-        for i in (db._indexes.all() if db._indexes is not None else [])
-    ]
+    indexes = []
+    for i in db._indexes.all() if db._indexes is not None else []:
+        entry = {
+            "name": i.name,
+            "class": i.class_name,
+            "fields": i.fields,
+            "type": i.type,
+        }
+        analyzer = getattr(i, "analyzer_name", None)
+        if analyzer is not None:  # Lucene-grade fulltext engine
+            entry["engine"] = "LUCENE"
+            entry["metadata"] = {"analyzer": analyzer}
+        indexes.append(entry)
     sequences = [
         {
             "name": s.name,
@@ -966,6 +978,8 @@ def _sync_schema(db: Database, payload: Dict) -> None:
             have.class_name != i["class"]
             or list(have.fields) != list(i["fields"])
             or have.type != i["type"]
+            or getattr(have, "analyzer_name", None)
+            != (i.get("metadata") or {}).get("analyzer")
         ):
             # same name, different definition: an index dropped and
             # recreated between the base checkpoint and this delta must
@@ -974,7 +988,8 @@ def _sync_schema(db: Database, payload: Dict) -> None:
             have = None
         if have is None:
             db.indexes.create_index(
-                i["name"], i["class"], i["fields"], i["type"]
+                i["name"], i["class"], i["fields"], i["type"],
+                engine=i.get("engine"), metadata=i.get("metadata"),
             )
     for name in list(have_idx):
         if name not in wanted_idx:
@@ -1100,7 +1115,10 @@ def restore_payload(db: Database, payload: Dict) -> int:
                 target[cls_name] = [RID.parse(x) for x in rids]
     # indexes last: definitions re-created, contents rebuilt from records
     for idx in payload["indexes"]:
-        db.indexes.create_index(idx["name"], idx["class"], idx["fields"], idx["type"])
+        db.indexes.create_index(
+            idx["name"], idx["class"], idx["fields"], idx["type"],
+            engine=idx.get("engine"), metadata=idx.get("metadata"),
+        )
     for s in payload.get("sequences", ()):
         seq = db.sequences.create(
             s["name"], s["type"], s["start"], s["increment"], s["cache"]

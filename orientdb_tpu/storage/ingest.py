@@ -336,15 +336,19 @@ def export_database(db: Database, path: str) -> None:
                 ],
             }
         )
-    indexes = [
-        {
+    indexes = []
+    for i in db._indexes.all() if db._indexes is not None else []:
+        entry = {
             "name": i.name,
             "class": i.class_name,
             "fields": i.fields,
             "type": i.type,
         }
-        for i in (db._indexes.all() if db._indexes is not None else [])
-    ]
+        analyzer = getattr(i, "analyzer_name", None)
+        if analyzer is not None:  # Lucene-grade fulltext engine survives
+            entry["engine"] = "LUCENE"
+            entry["metadata"] = {"analyzer": analyzer}
+        indexes.append(entry)
     records = []
     for cls in db.schema.classes():
         if cls.is_edge_type:
@@ -467,5 +471,8 @@ def import_database(path: str, name: Optional[str] = None) -> Database:
             doc.set(field, remap[old])
             db.save(doc)
     for idx in payload["indexes"]:
-        db.indexes.create_index(idx["name"], idx["class"], idx["fields"], idx["type"])
+        db.indexes.create_index(
+            idx["name"], idx["class"], idx["fields"], idx["type"],
+            engine=idx.get("engine"), metadata=idx.get("metadata"),
+        )
     return db

@@ -1,0 +1,206 @@
+"""Lucene-grade fulltext (VERDICT r3 missing #6): analyzers, BM25
+scoring, phrase/boolean/prefix query syntax — the reference's Lucene
+index engine surface ([E] lucene/OLuceneFullTextIndex) over the
+positional inverted index in models/fulltext.py."""
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.fulltext import (
+    EnglishAnalyzer,
+    KeywordAnalyzer,
+    LuceneFullTextIndex,
+    StandardAnalyzer,
+    get_analyzer,
+    parse_query,
+)
+
+
+@pytest.fixture()
+def db():
+    d = Database("ft")
+    d.schema.create_class("Article")
+    return d
+
+
+def _seed(db):
+    docs = {
+        "jvm": db.new_element(
+            "Article",
+            title="Tuning the JVM garbage collector",
+            body="The garbage collector pauses can be reduced by tuning "
+            "heap sizes. Garbage collection tuning is an art.",
+        ),
+        "gc_cars": db.new_element(
+            "Article",
+            title="Garbage trucks of the city",
+            body="City garbage is collected by trucks every morning.",
+        ),
+        "oom": db.new_element(
+            "Article",
+            title="Debugging out of memory errors",
+            body="An out of memory error means the heap filled up.",
+        ),
+        "cache": db.new_element(
+            "Article",
+            title="Caches and caching strategies",
+            body="A cache stores hot data. Caching reduces latency.",
+        ),
+    }
+    idx = db.indexes.create_index(
+        "Article.ft", "Article", ["title", "body"], "FULLTEXT",
+        engine="LUCENE", metadata={"analyzer": "english"},
+    )
+    return docs, idx
+
+
+# -- analyzers --------------------------------------------------------------
+
+
+def test_standard_analyzer_stopwords_keep_positions():
+    a = StandardAnalyzer()
+    assert a.tokens("Out of the memory") == ["out", "", "", "memory"]
+
+
+def test_english_analyzer_stems():
+    a = EnglishAnalyzer()
+    assert a.tokens("caches caching collected")[0] == "cache"
+    assert "cach" in a.tokens("caching")  # ing stripped
+    assert a.tokens("collected") == ["collect"]
+
+
+def test_keyword_analyzer_single_token():
+    assert KeywordAnalyzer().tokens("New York") == ["New York"]
+
+
+def test_unknown_analyzer_rejected():
+    with pytest.raises(ValueError):
+        get_analyzer("nope")
+
+
+# -- boolean / phrase / prefix queries --------------------------------------
+
+
+def test_boolean_and_or_not(db):
+    docs, idx = _seed(db)
+    assert idx.match("garbage AND heap") == {docs["jvm"].rid}
+    assert idx.match("garbage trucks") == {
+        docs["jvm"].rid, docs["gc_cars"].rid,  # OR: either term
+    }
+    assert idx.match("garbage -trucks") == {docs["jvm"].rid}
+    assert idx.match("garbage NOT trucks") == {docs["jvm"].rid}
+    assert idx.match("+garbage +collector") == {docs["jvm"].rid}
+
+
+def test_phrase_exact_and_stopword_gap(db):
+    docs, idx = _seed(db)
+    # 'of' is a stopword but holds its position: the phrase still binds
+    assert idx.match('"out of memory"') == {docs["oom"].rid}
+    assert idx.match('"memory out"') == set()
+
+
+def test_phrase_slop(db):
+    docs, idx = _seed(db)
+    # "garbage ... tuning" are not adjacent in the jvm body ("garbage
+    # collection tuning"): slop 1 lets one extra token in
+    assert idx.match('"garbage tuning"') == set()
+    assert idx.match('"garbage tuning"~1') == {docs["jvm"].rid}
+
+
+def test_prefix_query(db):
+    docs, idx = _seed(db)
+    assert idx.match("collec*") >= {docs["jvm"].rid, docs["gc_cars"].rid}
+    assert idx.match("latenc*") == {docs["cache"].rid}
+
+
+def test_parens_grouping(db):
+    docs, idx = _seed(db)
+    assert idx.match("(heap OR latency) AND cache") == {docs["cache"].rid}
+
+
+def test_query_parse_errors():
+    with pytest.raises(ValueError):
+        parse_query('"unterminated')
+    with pytest.raises(ValueError):
+        parse_query("(unbalanced")
+
+
+# -- scoring ----------------------------------------------------------------
+
+
+def test_bm25_ranks_denser_doc_first(db):
+    docs, idx = _seed(db)
+    ranked = idx.ranked("garbage")
+    assert [r for r, _s in ranked[:1]] == [docs["jvm"].rid]
+    assert all(s > 0 for _r, s in ranked)
+    # manager surface returns documents
+    top = db.indexes.fulltext_ranked("Article.ft", "garbage", limit=1)
+    assert top[0][0].rid == docs["jvm"].rid
+
+
+def test_rare_term_outscores_common(db):
+    docs, idx = _seed(db)
+    # 'latency' is rarer than 'garbage' → higher idf for same tf
+    lat = idx.ranked("latency")[0][1]
+    gc0 = idx.ranked("garbage")
+    assert lat > gc0[-1][1]
+
+
+# -- SQL surface ------------------------------------------------------------
+
+
+def test_create_index_engine_lucene_sql(db):
+    _ = db.command(
+        "CREATE INDEX Article.ft ON Article (title, body) FULLTEXT "
+        "ENGINE LUCENE METADATA {'analyzer': 'english'}"
+    )
+    idx = db.indexes.get_index("Article.ft")
+    assert isinstance(idx, LuceneFullTextIndex)
+    assert idx.analyzer_name == "english"
+    db.new_element("Article", title="Caching", body="cache stores data")
+    rows = db.query(
+        "SELECT title FROM Article WHERE search_class('cach*')"
+    ).to_dicts()
+    assert rows == [{"title": "Caching"}]
+    rows = db.query(
+        "SELECT title FROM Article WHERE search_index('Article.ft', "
+        "'+cache -garbage')"
+    ).to_dicts()
+    assert rows == [{"title": "Caching"}]
+
+
+def test_updates_and_deletes_reindex(db):
+    docs, idx = _seed(db)
+    d = docs["cache"]
+    d.set("body", "now about databases only")
+    db.save(d)
+    assert idx.match("latency") == set()
+    assert idx.match("databases") == {d.rid}
+    db.delete(d)
+    assert idx.match("databases") == set()
+
+
+# -- durability round-trip ---------------------------------------------------
+
+
+def test_lucene_index_survives_recovery(tmp_path):
+    from orientdb_tpu.storage.durability import (
+        checkpoint,
+        enable_durability,
+        open_database,
+    )
+
+    db = Database("ft")
+    db.schema.create_class("Article")
+    enable_durability(db, str(tmp_path))
+    db.new_element("Article", title="Caching", body="cache stores data")
+    db.indexes.create_index(
+        "Article.ft", "Article", ["title", "body"], "FULLTEXT",
+        engine="LUCENE", metadata={"analyzer": "english"},
+    )
+    checkpoint(db)
+    db2 = open_database(str(tmp_path))
+    idx = db2.indexes.get_index("Article.ft")
+    assert isinstance(idx, LuceneFullTextIndex)
+    assert idx.analyzer_name == "english"
+    assert len(idx.match("cach*")) == 1
