@@ -294,6 +294,14 @@ class Cluster:
         self.primary = name
         self.failovers += 1  # before arming: the successor's term must
         self._arm_quorum(m.db)  # exceed every predecessor's
+        if self.write_quorum is not None:
+            # fence the successor's OWN apply endpoint too: a deposed
+            # primary pushing a CONTIGUOUS entry at its stale term would
+            # otherwise be applied here (replicas are fenced in _repoint,
+            # but nothing raised the new primary's term)
+            m.db._repl_term = max(
+                getattr(m.db, "_repl_term", 0), self.failovers + 1
+            )
         metrics.incr("cluster.failover")
         log.warning("promoted %s to PRIMARY at lsn %d", name, lsn)
         for other in self.members.values():

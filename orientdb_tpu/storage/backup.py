@@ -1,0 +1,82 @@
+"""Online BACKUP / RESTORE DATABASE.
+
+Analog of the reference's online backup ([E] ``BACKUP DATABASE`` console
+command: a zip of the storage files made consistent by a frozen
+atomic-operations window; SURVEY.md §5.4). Redesign over this engine's
+logical state capture: the backup takes the SAME atomic snapshot a full
+checkpoint takes — payload, covered LSN, and epoch captured as one step
+against writers under ``db._lock`` (pointer copies only; JSON
+serialization runs outside the lock, torn captures corrected exactly as
+in ``storage/durability.checkpoint``) — and zips it with a manifest.
+Writers are blocked only for the pointer-copy window (the frozen-window
+analog), not for the serialization or the disk write.
+
+Restore builds a fresh Database from the archive via the same
+``restore_payload`` machinery recovery uses. Surfaces: console
+``BACKUP DATABASE <path>`` / ``RESTORE DATABASE <path>``, and this
+module's functions."""
+
+from __future__ import annotations
+
+import json
+import zipfile
+from typing import Optional
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.storage.durability import (
+    _meta_payload,
+    _rec_json,
+    restore_payload,
+)
+
+MANIFEST = "manifest.json"
+PAYLOAD = "database.json"
+
+
+def backup_database(db: Database, path: str) -> str:
+    """Write a consistent zip backup of ``db`` while writes continue.
+
+    The consistency point is the instant the lock-held pointer capture
+    completes: every write acknowledged before it is in the backup,
+    every later write is not (its WAL entry carries a higher LSN)."""
+    wal = getattr(db, "_wal", None)
+    with db._lock:
+        lsn = (wal.next_lsn - 1) if wal is not None else 0
+        payload = _meta_payload(db)
+        cluster_snap = [
+            (cid, list(c.records)) for cid, c in db._clusters.items()
+        ]
+    clusters = {}
+    for cid, records in cluster_snap:
+        recs = []
+        for pos, doc in enumerate(records):
+            if doc is None:
+                continue
+            try:
+                recs.append(_rec_json(doc, pos))
+            except RuntimeError:
+                with db._lock:  # doc mutated mid-serialization: quiesce
+                    recs.append(_rec_json(doc, pos))
+        clusters[str(cid)] = {"len": len(records), "records": recs}
+    payload["clusters"] = clusters
+    payload["lsn"] = lsn
+    manifest = {
+        "format": 1,
+        "name": db.name,
+        "epoch": payload["epoch"],
+        "lsn": lsn,
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr(MANIFEST, json.dumps(manifest))
+        z.writestr(PAYLOAD, json.dumps(payload, separators=(",", ":")))
+    return path
+
+
+def restore_database(path: str, name: Optional[str] = None) -> Database:
+    """Rebuild a database from a backup zip."""
+    with zipfile.ZipFile(path) as z:
+        manifest = json.loads(z.read(MANIFEST))
+        payload = json.loads(z.read(PAYLOAD))
+    db = Database(name or manifest.get("name", "restored"))
+    restore_payload(db, payload)
+    return db

@@ -186,6 +186,33 @@ class Console(cmd.Cmd):
             return
         self.default(f"import {arg}")
 
+    def do_backup(self, arg: str) -> None:
+        """BACKUP DATABASE <path> — online zip backup (frozen-window
+        consistency; [E] the reference's BACKUP DATABASE)."""
+        parts = shlex.split(arg)
+        if len(parts) == 2 and parts[0].lower() == "database":
+            if not self._need_db() or self.db is None:
+                return
+            from orientdb_tpu.storage.backup import backup_database
+
+            backup_database(self.db, parts[1])
+            self._p(f"backup written to {parts[1]}")
+            return
+        self.default(f"backup {arg}")
+
+    def do_restore(self, arg: str) -> None:
+        """RESTORE DATABASE <path>"""
+        parts = shlex.split(arg)
+        if len(parts) == 2 and parts[0].lower() == "database":
+            from orientdb_tpu.storage.backup import restore_database
+
+            self.db = restore_database(parts[1])
+            self._embedded[self.db.name] = self.db
+            self.remote = None
+            self._p(f"restored database '{self.db.name}'")
+            return
+        self.default(f"restore {arg}")
+
     def do_quit(self, _arg: str) -> bool:
         return True
 

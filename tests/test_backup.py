@@ -1,0 +1,95 @@
+"""Online BACKUP/RESTORE DATABASE (VERDICT r3 missing #8): zip backup
+taken while writers run must restore a CONSISTENT state — every write
+acked before the freeze point present, invariants (edge endpoints,
+index contents, schema) intact."""
+
+import threading
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.record import Direction, Edge, Vertex
+from orientdb_tpu.storage.backup import backup_database, restore_database
+
+
+def _mkdb():
+    db = Database("b")
+    db.schema.create_vertex_class("P")
+    db.schema.create_edge_class("L")
+    db.indexes.create_index("P.uid", "P", ["uid"], "UNIQUE")
+    return db
+
+
+def test_backup_roundtrip(tmp_path):
+    db = _mkdb()
+    vs = [db.new_vertex("P", uid=i) for i in range(20)]
+    for i in range(19):
+        db.new_edge("L", vs[i], vs[i + 1], w=i)
+    path = str(tmp_path / "b.zip")
+    backup_database(db, path)
+    r = restore_database(path)
+    assert r.count_class("P") == 20
+    assert r.count_class("L") == 19
+    assert r.query("SELECT count(*) AS n FROM P WHERE uid < 5").to_dicts() == [
+        {"n": 5}
+    ]
+    idx = r.indexes.get_index("P.uid")
+    assert idx is not None and idx.size() == 20
+    # graph adjacency survived
+    rows = r.query(
+        "MATCH {class:P, as:a, where:(uid = 0)}-L->{as:b} RETURN b.uid AS u"
+    ).to_dicts()
+    assert rows == [{"u": 1}]
+
+
+def test_backup_under_concurrent_writes_is_consistent(tmp_path):
+    db = _mkdb()
+    base = [db.new_vertex("P", uid=i) for i in range(50)]
+    stop = threading.Event()
+
+    def writer():
+        i = 1000
+        while not stop.is_set():
+            v = db.new_vertex("P", uid=i)
+            db.new_edge("L", base[i % 50], v)
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        paths = [str(tmp_path / f"b{k}.zip") for k in range(3)]
+        for p in paths:
+            backup_database(db, p)
+    finally:
+        stop.set()
+        t.join(5)
+    for p in paths:
+        r = restore_database(p)
+        # invariant: every edge's endpoints exist and reference it back
+        for e in r.browse_class("L", polymorphic=True):
+            assert isinstance(e, Edge)
+            src = r.load(e.out_rid)
+            dst = r.load(e.in_rid)
+            assert isinstance(src, Vertex) and isinstance(dst, Vertex)
+            assert e.rid in src._bag(Direction.OUT, "L")
+            assert e.rid in dst._bag(Direction.IN, "L")
+        # invariant: unique index matches the live records exactly
+        idx = r.indexes.get_index("P.uid")
+        n = r.count_class("P")
+        assert idx.size() == n
+        assert n >= 50
+
+
+def test_console_backup_restore(tmp_path):
+    from orientdb_tpu.tools.console import Console
+
+    db = _mkdb()
+    db.new_vertex("P", uid=7)
+    c = Console()
+    c._embedded[db.name] = db
+    c.db = db
+    out = []
+    c._p = out.append
+    p = str(tmp_path / "c.zip")
+    c.do_backup(f'database "{p}"')
+    assert any("backup written" in s for s in out)
+    c.do_restore(f'database "{p}"')
+    assert c.db is not db and c.db.count_class("P") == 1
