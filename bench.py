@@ -407,6 +407,36 @@ def main() -> None:
             )
             del sdb, ssnap
 
+    # ---- shard-count scaling of the ring-compacted merge (VERDICT r3
+    # #6): per-S subprocesses on virtual CPU meshes; merge_rows must stay
+    # ~flat while the old all_gather design's row count grows with S ----
+    mesh_scaling = []
+    if os.environ.get("BENCH_MESH_SCALING", "1") != "0":
+        import subprocess
+
+        for S in (2, 4, 8):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"{os.environ.get('XLA_FLAGS', '')} "
+                f"--xla_force_host_platform_device_count={S}"
+            ).strip()
+            try:
+                out_s = subprocess.run(
+                    [sys.executable, "-m", "orientdb_tpu.tools.mesh_scaling",
+                     str(S)],
+                    env=env, capture_output=True, text=True, timeout=600,
+                )
+                lines = out_s.stdout.strip().splitlines()
+                if out_s.returncode != 0 or not lines:
+                    mesh_scaling.append(
+                        {"shards": S, "error": out_s.stderr[-160:]}
+                    )
+                else:
+                    mesh_scaling.append(json.loads(lines[-1]))
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                mesh_scaling.append({"shards": S, "error": str(e)[:160]})
+
     t0 = time.perf_counter()
     for _ in range(oracle_iters):
         run("oracle")
@@ -428,6 +458,7 @@ def main() -> None:
             "sf10": sf10,
             "sf100_shape": sf100,
             "degree_skew": skew,
+            "mesh_scaling": mesh_scaling,
             "phase_split_ms_per_query": splits,
             "snb_persons": snb_persons,
             "oracle_2hop_qps": round(oracle_qps, 4),

@@ -904,6 +904,19 @@ class TpuMatchSolver:
         total = self.sched.observe(tots.sum())
         max_local = self.sched.observe(tots.max())
         cap = _cap_of(max(max_local, 1))
+        # merged segment sized by the GLOBAL total, not S x local max:
+        # the ring-compacted merge in expand_gather keeps skewed shards
+        # (supernodes) from inflating every shard's block
+        cap_total = _cap_of(max(total, 1))
+        if self.sched.recording:
+            # merge-traffic observability (tools/mesh_scaling.py plots
+            # the S-curve): rows actually merged vs what the old
+            # all_gather-of-blocks design would have shipped
+            S = mg.mesh.devices.size // (
+                mg.mesh.shape.get(config.mesh_replica_axis, 1)
+            )
+            metrics.incr("mesh.merge_rows", cap_total)
+            metrics.incr("mesh.allgather_rows", S * cap)
         row, eid, nbr = expand_gather(
             mg.mesh,
             mg.rows_per_shard,
@@ -912,6 +925,7 @@ class TpuMatchSolver:
             extra_sh,
             srcs,
             cap,
+            cap_total,
             is_out=(d == "out"),
         )
         return row, eid, nbr, total
@@ -1478,8 +1492,9 @@ class TpuMatchSolver:
         item = e.item
         if step.reverse:
             raise Uncompilable("reverse edge-binding arm")
-        if self.dg.mesh_graph is not None:
-            raise Uncompilable("method arms not sharded yet")
+        # mesh path: _expand_one_dir shards the expansion transparently
+        # (global edge ids out), edge-property WHERE reads row-sharded
+        # columns in jit global view — nothing here is single-chip-only
         src_alias, dst_alias = e.from_alias, e.to_alias
         srcs = table.cols.get(src_alias)
         if srcs is None:
@@ -1573,8 +1588,6 @@ class TpuMatchSolver:
         item = e.item
         if step.reverse:
             raise Uncompilable("reverse endpoint arm")
-        if self.dg.mesh_graph is not None:
-            raise Uncompilable("method arms not sharded yet")
         src_alias, dst_alias = e.from_alias, e.to_alias
         ecols = table.edge_cols.get(src_alias)
         if ecols is None:
@@ -1592,13 +1605,24 @@ class TpuMatchSolver:
         parts: List[Table] = []
         counts: List[int] = []
         matched_any = jnp.zeros(width, bool)
+        mg = self.dg.mesh_graph
         for kind in kinds:
             cand = jnp.full(width, -1, jnp.int32)
             for k, cname in enumerate(self.edge_class_list):
                 dec = self.dg.edges[cname]
                 if dec.num_edges == 0:
                     continue
-                arr = dec.edge_src if kind == "src" else dec.dst
+                if mg is None:
+                    arr = dec.edge_src if kind == "src" else dec.dst
+                else:
+                    # mesh: the flat per-edge endpoint arrays are not
+                    # uploaded; the shard-blocked edge list IS the flat
+                    # array row-blocked by shard (mesh_graph upload), so
+                    # a global-view reshape recovers endpoint-by-global-
+                    # eid gathers (XLA inserts the collectives)
+                    p = mg.edge[cname].prefix
+                    key = f"{p}:el:src" if kind == "src" else f"{p}:el:dst"
+                    arr = self.dg.arrays[key].reshape(-1)
                 g = K.take_pad(arr, jnp.where(ci == k, eid, -1), jnp.int32(-1))
                 cand = jnp.where(ci == k, g, cand)
             mask = live & (cand >= 0) & node_mask(cand, env)

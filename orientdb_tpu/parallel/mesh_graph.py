@@ -205,12 +205,23 @@ def expand_gather(
     extra_sh,
     srcs,
     cap: int,
+    cap_total: int,
     is_out: bool,
 ):
-    """Sharded CSR expansion: every shard expands its owned sources into a
-    static ``cap``-row block, then the blocks ``all_gather`` into one
-    replicated ``[S·cap]`` table segment — the binding-table analog of the
-    §5.7 psum frontier merge, carrying (row, global edge id, neighbor).
+    """Sharded CSR expansion with a RING-compacted merge: every shard
+    expands its owned sources into a static ``cap``-row local block,
+    front-packs the live rows, scatters them at its global offset into a
+    ``[cap_total]`` zero buffer, and the buffers merge with a ``psum``
+    over the shard axis — XLA lowers it to the bandwidth-optimal ring
+    reduce over ICI (SURVEY.md §5.7's ring exchange for binding-carrying
+    expansions).
+
+    vs the previous ``all_gather`` of whole ``cap`` blocks, the merged
+    segment is ``O(pow2(global total))`` instead of ``O(S·pow2(max
+    local))``: under supernode skew (one shard's cap ≫ total/S) that is
+    an up-to-S× saving in merge bytes and merged-table size, and the
+    merged row order (shard-major, local expansion order within) is the
+    old order minus the interleaved padding.
 
     ``extra_sh`` is the per-shard global-edge-offset column (out-CSR:
     ``eid = local edge pos + base``) or the sharded ``edge_id_in`` map
@@ -230,11 +241,22 @@ def expand_gather(
             eid = jnp.where(epos >= 0, epos + extra_l[0], -1)
         else:
             eid = K.take_pad(extra_l, epos, jnp.int32(-1))
+        # gather_expand front-packs: rows [0, tot) are live. Scatter them
+        # at this shard's exclusive offset in the global segment; psum
+        # merges the disjoint writes (values shifted +1 so the zero
+        # identity becomes the -1 padding after the merge).
+        all_tot = jax.lax.all_gather(tot, config.mesh_shard_axis)
+        my_off = jnp.cumsum(all_tot)[sid] - tot
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        dest = jnp.where(pos < tot, pos + my_off, cap_total)  # drop pads
 
-        def ga(x):
-            return jax.lax.all_gather(x, config.mesh_shard_axis).reshape(-1)
+        def merge(x):
+            seg = jnp.zeros(cap_total, jnp.int32).at[dest].add(
+                x + 1, mode="drop"
+            )
+            return jax.lax.psum(seg, config.mesh_shard_axis) - 1
 
-        return ga(row), ga(eid), ga(nbr)
+        return merge(row), merge(eid), merge(nbr)
 
     return shard_map(
         local,
@@ -246,9 +268,7 @@ def expand_gather(
             P(None),
         ),
         out_specs=(P(None), P(None), P(None)),
-        # all_gather-merged outputs: replicated in fact, not provably so
-        # under VMA inference (see expand_totals)
-        check_vma=False,
+        check_vma=True,  # psum-merged outputs are provably replicated
     )(ind_sh, nbr_sh, extra_sh, srcs)
 
 

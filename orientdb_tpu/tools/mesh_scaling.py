@@ -1,0 +1,69 @@
+"""Shard-count scaling probe for the ring-compacted expansion merge.
+
+Run as a subprocess per shard count (the CPU device count is fixed at
+process start):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=S \
+        python -m orientdb_tpu.tools.mesh_scaling S
+
+Builds a demodb-shaped graph with one planted SUPERNODE (the §5.7 skew
+case the merge design is judged on), runs a row-returning 1-hop MATCH
+through the supernode on an S-shard mesh, and prints one JSON line:
+
+    {"shards": S, "merge_rows": N, "allgather_rows": M, "wall_s": T}
+
+``merge_rows`` is what the ring-compacted merge shipped per recording
+(O(pow2 global total)); ``allgather_rows`` is what the previous
+all_gather-of-cap-blocks design would have shipped (O(S·pow2 local
+max)) — the bench records the pair per S so the curve shows merge bytes
+sublinear in S under skew (VERDICT r3 #6)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main(shards: int) -> None:
+    from orientdb_tpu.parallel.sharded import make_mesh
+    from orientdb_tpu.storage.ingest import generate_demodb
+    from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+    from orientdb_tpu.utils.metrics import metrics
+
+    db = generate_demodb(n_profiles=2000, avg_friends=5, seed=11)
+    # plant a supernode: profile 0 follows 1500 others — one shard's
+    # local expansion max is ~1500 while the balanced share is ~10
+    docs = {d["uid"]: d for d in db.browse_class("Profiles")}
+    hub, n = docs[0], len(docs)
+    for k in range(1, 1501):
+        db.new_edge("HasFriend", hub, docs[k % (n - 1) + 1])
+    mesh = make_mesh(shards, replicas=1)
+    attach_fresh_snapshot(db, mesh=mesh)
+    sql = (
+        "MATCH {class:Profiles, as:p, where:(uid < 40)}"
+        "-HasFriend->{as:f} RETURN p.uid AS p, f.uid AS f"
+    )
+    before = metrics.snapshot()["counters"]
+    t0 = time.perf_counter()
+    rows = db.query(sql, engine="tpu", strict=True).to_dicts()
+    wall = time.perf_counter() - t0
+    after = metrics.snapshot()["counters"]
+    assert rows, "probe query returned nothing"
+    print(
+        json.dumps(
+            {
+                "shards": shards,
+                "merge_rows": after.get("mesh.merge_rows", 0)
+                - before.get("mesh.merge_rows", 0),
+                "allgather_rows": after.get("mesh.allgather_rows", 0)
+                - before.get("mesh.allgather_rows", 0),
+                "wall_s": round(wall, 2),
+                "result_rows": len(rows),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8)
