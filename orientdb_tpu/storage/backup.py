@@ -3,18 +3,23 @@
 Analog of the reference's online backup ([E] ``BACKUP DATABASE`` console
 command: a zip of the storage files made consistent by a frozen
 atomic-operations window; SURVEY.md §5.4). Redesign over this engine's
-logical state capture: the backup takes the SAME atomic snapshot a full
-checkpoint takes — payload, covered LSN, and epoch captured as one step
-against writers under ``db._lock`` (pointer copies only; JSON
-serialization runs outside the lock, torn captures corrected exactly as
-in ``storage/durability.checkpoint``) — and zips it with a manifest.
-Writers are blocked only for the pointer-copy window (the frozen-window
-analog), not for the serialization or the disk write.
+logical state capture: the backup takes the SAME atomic capture a full
+checkpoint takes (`storage.durability.capture_payload` — covered LSN +
+pointer copies under ``db._lock``, serialization outside it), so writers
+are blocked only for the pointer-copy window.
 
-Restore builds a fresh Database from the archive via the same
-``restore_payload`` machinery recovery uses. Surfaces: console
-``BACKUP DATABASE <path>`` / ``RESTORE DATABASE <path>``, and this
-module's functions."""
+Serialization races writers, so a captured record can be TORN (newer
+state than the captured LSN). Recovery corrects that by replaying WAL
+entries above the LSN from disk; a backup archive must be
+self-contained, so it BUNDLES that tail: every WAL entry logged between
+the capture point and the end of serialization ships in the zip, and
+restore replays it over the payload — the archive is consistent as of
+the LAST bundled entry. Databases without a WAL serialize entirely
+under the lock instead (a stop-the-world freeze — the no-journal
+fallback, documented here).
+
+Surfaces: console ``BACKUP DATABASE <path>`` / ``RESTORE DATABASE
+<path>``, and this module's functions."""
 
 from __future__ import annotations
 
@@ -24,59 +29,99 @@ from typing import Optional
 
 from orientdb_tpu.models.database import Database
 from orientdb_tpu.storage.durability import (
+    _apply_entry,
     _meta_payload,
     _rec_json,
+    _wal_segments,
+    WriteAheadLog,
+    capture_payload,
     restore_payload,
 )
 
 MANIFEST = "manifest.json"
 PAYLOAD = "database.json"
+TAIL = "wal_tail.json"
+
+
+def _locked_payload(db: Database):
+    """No-WAL fallback: serialize entirely under db._lock (no journal
+    exists to correct torn captures, so the capture must be frozen)."""
+    with db._lock:
+        payload = _meta_payload(db)
+        clusters = {}
+        for cid, c in db._clusters.items():
+            recs = []
+            for pos, doc in enumerate(c.records):
+                if doc is not None:
+                    recs.append(_rec_json(doc, pos))
+            clusters[str(cid)] = {"len": len(c.records), "records": recs}
+        payload["clusters"] = clusters
+        payload["lsn"] = 0
+    return payload
+
+
+def _wal_tail(db: Database, after_lsn: int, upto_lsn: int):
+    """WAL entries with lsn in (after_lsn, upto_lsn], across the live
+    segment and any archives a concurrent checkpoint may have rotated."""
+    import os
+
+    entries = []
+    directory = getattr(db, "_durability_dir", None)
+    if directory and os.path.isdir(directory):
+        for seg in _wal_segments(directory):
+            base = os.path.basename(seg)
+            if base.startswith("wal-") and base.endswith(".log"):
+                try:
+                    if int(base[4:-4]) <= after_lsn:
+                        continue
+                except ValueError:
+                    pass
+            entries.extend(WriteAheadLog(seg).read_entries())
+    else:
+        entries = db._wal.read_entries()
+    out = [e for e in entries if after_lsn < e["lsn"] <= upto_lsn]
+    out.sort(key=lambda e: e["lsn"])
+    return out
 
 
 def backup_database(db: Database, path: str) -> str:
     """Write a consistent zip backup of ``db`` while writes continue.
 
-    The consistency point is the instant the lock-held pointer capture
-    completes: every write acknowledged before it is in the backup,
-    every later write is not (its WAL entry carries a higher LSN)."""
+    The archive restores to the database state as of its LAST bundled
+    WAL entry (manifest ``upto_lsn``): every write acknowledged before
+    serialization finished is included."""
     wal = getattr(db, "_wal", None)
-    with db._lock:
-        lsn = (wal.next_lsn - 1) if wal is not None else 0
-        payload = _meta_payload(db)
-        cluster_snap = [
-            (cid, list(c.records)) for cid, c in db._clusters.items()
-        ]
-    clusters = {}
-    for cid, records in cluster_snap:
-        recs = []
-        for pos, doc in enumerate(records):
-            if doc is None:
-                continue
-            try:
-                recs.append(_rec_json(doc, pos))
-            except RuntimeError:
-                with db._lock:  # doc mutated mid-serialization: quiesce
-                    recs.append(_rec_json(doc, pos))
-        clusters[str(cid)] = {"len": len(records), "records": recs}
-    payload["clusters"] = clusters
-    payload["lsn"] = lsn
+    if wal is None:
+        payload, lsn, upto = _locked_payload(db), 0, 0
+        tail = []
+    else:
+        payload, lsn, _ = capture_payload(db)
+        with db._lock:
+            upto = db._wal.next_lsn - 1
+        tail = _wal_tail(db, lsn, upto)
     manifest = {
-        "format": 1,
+        "format": 2,
         "name": db.name,
         "epoch": payload["epoch"],
         "lsn": lsn,
+        "upto_lsn": upto,
     }
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(MANIFEST, json.dumps(manifest))
         z.writestr(PAYLOAD, json.dumps(payload, separators=(",", ":")))
+        z.writestr(TAIL, json.dumps(tail, separators=(",", ":")))
     return path
 
 
 def restore_database(path: str, name: Optional[str] = None) -> Database:
-    """Rebuild a database from a backup zip."""
+    """Rebuild a database from a backup zip: payload, then the bundled
+    WAL tail replayed over it (exactly recovery's discipline)."""
     with zipfile.ZipFile(path) as z:
         manifest = json.loads(z.read(MANIFEST))
         payload = json.loads(z.read(PAYLOAD))
+        tail = json.loads(z.read(TAIL)) if TAIL in z.namelist() else []
     db = Database(name or manifest.get("name", "restored"))
     restore_payload(db, payload)
+    for e in tail:
+        _apply_entry(db, e)
     return db

@@ -617,6 +617,46 @@ def _delta_lsn_from_name(filename: str) -> int:
         return 0
 
 
+def capture_payload(db: Database, under_lock=None):
+    """Shared full-state capture for checkpoint() and online backup:
+    covered LSN, metadata, and POINTER copies of the cluster tables
+    captured as one atomic step against writers under ``db._lock``
+    (``under_lock()``, when given, runs inside that same critical
+    section — checkpoint's dirty-set swap); JSON serialization runs
+    OUTSIDE the lock, so writers stall only for the pointer copy.
+
+    A record mutated after the capture may serialize torn; every such
+    mutation's WAL entry carries lsn > the returned LSN, so callers must
+    arrange for those entries to be replayed over the restored payload
+    (recovery replays them from disk; backup bundles them in the
+    archive). Returns (payload, lsn)."""
+    wal: Optional[WriteAheadLog] = getattr(db, "_wal", None)
+    with db._lock:
+        lsn = (wal.next_lsn - 1) if wal is not None else 0
+        payload = _meta_payload(db)
+        cluster_snap = [
+            (cid, list(c.records)) for cid, c in db._clusters.items()
+        ]
+        extra = under_lock(lsn) if under_lock is not None else None
+    clusters = {}
+    for cid, records in cluster_snap:
+        recs = []
+        for pos, doc in enumerate(records):
+            if doc is None:
+                continue
+            try:
+                recs.append(_rec_json(doc, pos))
+            except RuntimeError:
+                # the doc's dicts mutated mid-iteration: retry quiesced
+                # (the torn value itself is fine, see above)
+                with db._lock:
+                    recs.append(_rec_json(doc, pos))
+        clusters[str(cid)] = {"len": len(records), "records": recs}
+    payload["clusters"] = clusters
+    payload["lsn"] = lsn
+    return payload, lsn, extra
+
+
 def checkpoint(db: Database, directory: Optional[str] = None) -> str:
     """Write a full checkpoint; returns its path. With an attached WAL the
     checkpoint records the last covered LSN and ARCHIVES the log segment
@@ -627,45 +667,26 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
     directory = directory or _dir_of(db)
     os.makedirs(directory, exist_ok=True)
     wal: Optional[WriteAheadLog] = getattr(db, "_wal", None)
+
     # The covered LSN, the delta-tracking baseline swap, and the state
     # capture must be ONE atomic step against writers (which mark dirty
     # under db._lock): a write landing between the capture and a later
     # reset would lose its dirty mark while being absent from the
     # payload, and the LSN-keyed archive skip in open_database would
     # then never replay it — an acknowledged, fsynced write silently
-    # dropped. To avoid an O(DB) stop-the-world, only POINTER copies of
-    # the cluster tables happen under the lock; JSON serialization runs
-    # outside it. A record mutated after the capture may serialize torn,
-    # but its mutation's WAL entry carries lsn > the captured LSN and
-    # recovery replays those ABSOLUTE entries over the restored payload,
-    # so the recovered state is exact.
-    with db._lock:
-        lsn = (wal.next_lsn - 1) if wal is not None else 0
+    # dropped. Recovery replays the WAL entries above the captured LSN,
+    # which is what corrects capture_payload's torn serializations.
+    def swap_dirty(lsn_in_lock):
         dirty_snap = db.__dict__.get("_ckpt_dirty") or set()
         db._ckpt_dirty = set()  # post-snapshot writes mark the NEW set
         prev_base = getattr(db, "_ckpt_base_lsn", None)
-        db._ckpt_base_lsn = lsn
-        payload = _meta_payload(db)  # O(schema)
-        cluster_snap = [
-            (cid, list(c.records)) for cid, c in db._clusters.items()
-        ]
+        db._ckpt_base_lsn = lsn_in_lock  # same critical section: a
+        # concurrent delta must never see the NEW empty dirty set with
+        # the OLD baseline
+        return dirty_snap, prev_base
+
+    payload, lsn, (dirty_snap, prev_base) = capture_payload(db, swap_dirty)
     try:
-        clusters = {}
-        for cid, records in cluster_snap:
-            recs = []
-            for pos, doc in enumerate(records):
-                if doc is None:
-                    continue
-                try:
-                    recs.append(_rec_json(doc, pos))
-                except RuntimeError:
-                    # the doc's dicts mutated mid-iteration: retry
-                    # quiesced (the torn value itself is fine, see above)
-                    with db._lock:
-                        recs.append(_rec_json(doc, pos))
-            clusters[str(cid)] = {"len": len(records), "records": recs}
-        payload["clusters"] = clusters
-        payload["lsn"] = lsn
         data = json.dumps(payload, separators=(",", ":")).encode()
     except BaseException:
         with db._lock:

@@ -5,6 +5,8 @@ index contents, schema) intact."""
 
 import threading
 
+import pytest
+
 from orientdb_tpu.models.database import Database
 from orientdb_tpu.models.record import Direction, Edge, Vertex
 from orientdb_tpu.storage.backup import backup_database, restore_database
@@ -40,8 +42,15 @@ def test_backup_roundtrip(tmp_path):
     assert rows == [{"u": 1}]
 
 
-def test_backup_under_concurrent_writes_is_consistent(tmp_path):
+@pytest.mark.parametrize("durable", [True, False])
+def test_backup_under_concurrent_writes_is_consistent(tmp_path, durable):
+    """Both capture modes: the WAL-tail bundle (durable db — writers keep
+    running through the capture) and the no-journal frozen fallback."""
     db = _mkdb()
+    if durable:
+        from orientdb_tpu.storage.durability import enable_durability
+
+        enable_durability(db, str(tmp_path / "wal"))
     base = [db.new_vertex("P", uid=i) for i in range(50)]
     stop = threading.Event()
 
@@ -64,13 +73,30 @@ def test_backup_under_concurrent_writes_is_consistent(tmp_path):
     for p in paths:
         r = restore_database(p)
         # invariant: every edge's endpoints exist and reference it back
+        n_edges = 0
         for e in r.browse_class("L", polymorphic=True):
+            n_edges += 1
             assert isinstance(e, Edge)
             src = r.load(e.out_rid)
             dst = r.load(e.in_rid)
             assert isinstance(src, Vertex) and isinstance(dst, Vertex)
             assert e.rid in src._bag(Direction.OUT, "L")
             assert e.rid in dst._bag(Direction.IN, "L")
+        # invariant (the torn-capture case): every rid in every vertex
+        # BAG resolves to a live edge — a bag referencing an edge the
+        # capture missed means the WAL-tail correction failed
+        n_bag_refs = 0
+        for v in r.browse_class("P", polymorphic=True):
+            for d in (Direction.OUT, Direction.IN):
+                for cls_name, rids in (
+                    v._out_edges if d == Direction.OUT else v._in_edges
+                ).items():
+                    for rid in rids:
+                        n_bag_refs += 1
+                        assert isinstance(r.load(rid), Edge), (
+                            f"dangling bag ref {rid} in {v.rid}"
+                        )
+        assert n_bag_refs == 2 * n_edges
         # invariant: unique index matches the live records exactly
         idx = r.indexes.get_index("P.uid")
         n = r.count_class("P")
