@@ -196,6 +196,12 @@ class Transaction:
         if not self.active:
             raise TxError("transaction no longer active")
         db = self.db
+        if getattr(db, "_write_owner", None) is not None:
+            raise TxError(
+                "transactions commit on the cluster's write owner; run "
+                "the tx against the primary (per-record forwarding is "
+                "not atomic)"
+            )
         try:
             # quorum pushes deferred during the locked apply (the
             # atomic tx entry) ship once the db-wide lock is free
@@ -344,6 +350,8 @@ class Transaction:
                     db._cluster(pre.rid.cluster).records[pre.rid.position] = pre
                     if idx is not None:
                         idx.on_save(pre)
+                    if db._cold_tier is not None:
+                        db._cold_tier.on_save(pre)  # compensations bypass save()
                 elif kind == "update_pre":
                     rid, (fields, version) = payload
                     live = db._load_raw(rid)
@@ -354,6 +362,8 @@ class Transaction:
                         live.version = version
                         if idx is not None:
                             idx.on_save(live)
+                        if db._cold_tier is not None:
+                            db._cold_tier.on_save(live)
                 elif kind == "delete":
                     doc, edges = payload
                     self._restore_deleted(doc)
@@ -371,6 +381,8 @@ class Transaction:
         doc._deleted = False
         if db._indexes is not None:
             db._indexes.on_save(doc)
+        if db._cold_tier is not None:
+            db._cold_tier.on_save(doc)  # compensations bypass save()
         if isinstance(doc, Edge):
             src = db._load_raw(doc.out_rid)
             dst = db._load_raw(doc.in_rid)

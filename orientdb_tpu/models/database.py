@@ -47,20 +47,33 @@ class _Cluster:
     positions as tombstones — RIDs are never reused within a cluster).
     """
 
-    __slots__ = ("cluster_id", "records")
+    __slots__ = ("cluster_id", "records", "cold")
 
     def __init__(self, cluster_id: int) -> None:
         self.cluster_id = cluster_id
         self.records: List[Optional[Document]] = []
+        #: optional capacity tier (storage/coldstore.ColdTier): slots may
+        #: then hold ColdRef markers that fault back on access
+        self.cold = None
 
     def append(self, doc: Document) -> int:
         self.records.append(doc)
         return len(self.records) - 1
 
-    def get(self, position: int) -> Optional[Document]:
+    def get_slot(self, position: int):
+        """Raw slot value: Document, ColdRef marker, or None."""
         if 0 <= position < len(self.records):
             return self.records[position]
         return None
+
+    def get(self, position: int) -> Optional[Document]:
+        doc = self.get_slot(position)
+        if doc is not None and self.cold is not None and not isinstance(
+            doc, Document
+        ):
+            # point read of an evicted record: fault it back hot
+            return self.cold.fault(doc)
+        return doc
 
     def tombstone(self, position: int) -> None:
         if 0 <= position < len(self.records):
@@ -68,7 +81,14 @@ class _Cluster:
 
     def __iter__(self) -> Iterator[Document]:
         for doc in self.records:
-            if doc is not None:
+            if doc is None:
+                continue
+            if self.cold is not None and not isinstance(doc, Document):
+                # scans materialize TRANSIENTLY (no hot-set admission):
+                # a full class scan must not thrash the cache — the 2Q
+                # scan-resistance property of the reference's page cache
+                yield self.cold.materialize(doc)
+            else:
                 yield doc
 
     def live_count(self) -> int:
@@ -115,6 +135,15 @@ class Database:
         # pure in-memory engine; armed via enable_durability/open_database.
         self._wal = None
         self._durability_dir = None
+        # Cold-data capacity tier (storage/coldstore.enable_cold_tier):
+        # bounds the RAM-resident hot set; None = all records stay hot.
+        self._cold_tier = None
+        self._on_new_cluster = None
+        # Write-ownership forwarding (parallel/forwarding.WriteOwner):
+        # set on non-owner cluster members — their writes forward to the
+        # owning member instead of diverging locally ([E] the reference's
+        # per-cluster server-owner routing). None = this node owns writes.
+        self._write_owner = None
 
     # -- WAL ---------------------------------------------------------------
 
@@ -233,6 +262,8 @@ class Database:
         c = self._clusters.get(cid)
         if c is None:
             c = self._clusters[cid] = _Cluster(cid)
+            if self._on_new_cluster is not None:
+                self._on_new_cluster(c)
         return c
 
     @staticmethod
@@ -249,8 +280,28 @@ class Database:
 
     # -- record lifecycle --------------------------------------------------
 
+    def _reject_non_owner_tx(self) -> None:
+        """Writes buffered in a tx on a NON-OWNER member are rejected at
+        buffering time, not commit time: the local path would mutate the
+        replica's schema (class auto-creation is not tx-buffered) before
+        the commit-time TxError could stop it."""
+        if self._write_owner is not None and self.tx is not None:
+            from orientdb_tpu.exec.tx import TxError
+
+            raise TxError(
+                "transactions must run against the cluster's write owner "
+                "(this member forwards writes per-record)"
+            )
+
     def new_element(self, class_name: str = "O", **fields) -> Document:
         """Create (and save) a plain document."""
+        self._reject_non_owner_tx()
+        if self._write_owner is not None and self.tx is None:
+            # non-owner member: forward BEFORE any local schema mutation
+            # (auto-creating the class here would diverge this replica)
+            doc = Document(class_name, fields)
+            doc._db = self
+            return self.save(doc)
         if not self.schema.exists_class(class_name):
             self.schema.create_class(class_name)
         doc = Document(class_name, fields)
@@ -276,6 +327,14 @@ class Database:
         return cls
 
     def new_vertex(self, class_name: str = "V", **fields) -> Vertex:
+        self._reject_non_owner_tx()
+        if self._write_owner is not None and self.tx is None:
+            # non-owner: forward before local class auto-creation (see
+            # new_element) — the owner resolves/creates the class
+            v = Vertex(class_name, fields)
+            v._db = self
+            self.save(v)
+            return v
         cls = self._resolve_vertex_class(class_name)
         v = Vertex(cls.name, fields)
         v._db = self
@@ -291,6 +350,25 @@ class Database:
         the source vertex appends to ``out_<cls>``, the target to
         ``in_<cls>``.
         """
+        self._reject_non_owner_tx()
+        if self._write_owner is not None and self.tx is None:
+            # non-owner: forward BEFORE local edge-class auto-creation
+            # (the owner resolves/creates the class; see new_element)
+            if not (src.rid.is_persistent and dst.rid.is_persistent):
+                raise ValueError(
+                    "both endpoints must be saved before creating an edge"
+                )
+            resp = self._write_owner.create_edge(
+                class_name, src.rid, dst.rid, dict(fields)
+            )
+            e = Edge(class_name, fields)
+            e._db = self
+            e.out_rid = src.rid
+            e.in_rid = dst.rid
+            if resp.get("@rid"):
+                e.rid = RID.parse(resp["@rid"])
+                e.version = resp.get("@version", 1)
+            return e
         cls = self._resolve_edge_class(class_name)
         tx = self.tx
         if tx is not None and not self._tx_suspended:
@@ -308,17 +386,46 @@ class Database:
                 dst._bag(Direction.IN, cls.name).append(e.rid)
                 src.version += 1
                 dst.version += 1
+                if self._cold_tier is not None:
+                    # bag mutations bypass save(): re-spill the endpoints
+                    # or an eviction would fault back stale adjacency
+                    self._cold_tier.on_save(src)
+                    self._cold_tier.on_save(dst)
         return e
 
     def save(self, doc: Document) -> Document:
         tx = self.tx
         if tx is not None and not self._tx_suspended:
             return tx.save(doc)
+        if self._write_owner is not None:
+            return self._forward_save(doc)
         # deferred quorum pushes ship after the lock is released (see
         # _quorum_push); also on failure — an entry logged before a
         # later hook raised is already durable and must still ack
         with self._quorum_deferral():
             return self._save_locked(doc)
+
+    def _forward_save(self, doc: Document) -> Document:
+        """Non-owner member: route the write to the cluster owner; the
+        committed record comes back via replication. The returned doc
+        carries the owner-assigned RID/version."""
+        if isinstance(doc, Edge):
+            raise ValueError("edges are created via new_edge (forwarded)")
+        is_new = doc.rid is NEW_RID or not doc.rid.is_persistent
+        if is_new:
+            resp = self._write_owner.create(
+                doc.class_name,
+                doc.fields(),
+                kind="vertex" if isinstance(doc, Vertex) else "document",
+            )
+            doc.rid = RID.parse(resp["@rid"])
+        else:
+            resp = self._write_owner.update(
+                doc.rid, doc.fields(), base_version=doc.version
+            )
+        doc.version = resp.get("@version", doc.version)
+        doc._db = self
+        return doc
 
     def _save_locked(self, doc: Document) -> Document:
         with self._lock:
@@ -370,6 +477,9 @@ class Database:
                 from orientdb_tpu.storage.durability import entry_for_save
 
                 self._wal_log(entry_for_save(doc, is_new))
+            if self._cold_tier is not None:
+                # save-through to the capacity tier (spill + keep hot)
+                self._cold_tier.on_save(doc)
             if self._hooks is not None:
                 self._hooks.fire("after_create" if is_new else "after_update", doc)
         return doc
@@ -397,6 +507,10 @@ class Database:
         if tx is not None and not self._tx_suspended:
             tx.delete(doc)
             return
+        if self._write_owner is not None:
+            self._write_owner.delete(doc.rid)
+            doc._deleted = True
+            return
         with self._quorum_deferral():
             self._delete_locked(doc)
 
@@ -417,6 +531,8 @@ class Database:
                     self._indexes.on_delete(doc)
                 self._cluster(doc.rid.cluster).tombstone(doc.rid.position)
             doc._deleted = True
+            if self._cold_tier is not None:
+                self._cold_tier.on_delete(doc)
             self.mutation_epoch += 1
             if was_persistent and self._wal is not None:
                 from orientdb_tpu.storage.durability import entry_for_delete
@@ -440,6 +556,13 @@ class Database:
             if edge.rid in bag:
                 bag.remove(edge.rid)
                 dst.version += 1
+        if self._cold_tier is not None:
+            # bag mutations bypass save(): re-spill the endpoints (see
+            # new_edge) so eviction cannot fault back stale adjacency
+            if isinstance(src, Vertex):
+                self._cold_tier.on_save(src)
+            if isinstance(dst, Vertex):
+                self._cold_tier.on_save(dst)
         if edge.rid.is_persistent:
             if self._indexes is not None:
                 self._indexes.on_delete(edge)

@@ -1,0 +1,217 @@
+"""Cold-data capacity tier: disk-resident records with an in-RAM hot set.
+
+The reference's biggest module is a paginated disk store with a page
+cache — records live on disk, hot pages in RAM ([E] plocal
+``OLocalPaginatedStorage`` + ``O2QCache``; SURVEY.md §2 rows "plocal
+storage"/"Page cache", ~75k LoC). This engine's host store is
+RAM-resident, so a database larger than host memory could not exist.
+This module closes that capability gap the logical way this engine
+stores things: records spill to an append-only SEGMENT FILE in their
+checkpoint JSON form (storage/durability._rec_json — the format
+recovery, deltas, and backups already speak), an offset index maps
+RID → (segment offset, length), and an LRU hot set of materialized
+Documents is bounded by a byte budget.
+
+Mechanics:
+- **save-through**: every committed save appends the record's current
+  state to the spill segment and admits the document to the hot set;
+  eviction therefore never loses acknowledged state (unsaved in-place
+  mutations follow the store's existing contract: not durable until
+  save()).
+- **eviction**: over budget, the LRU document's cluster slot is
+  replaced by a :class:`ColdRef` marker and the object is dropped.
+- **fault-in**: `_Cluster.get` (the `load`/`_load_raw` path) rebuilds
+  the Document from the spill and re-admits it hot; class scans
+  (`browse_class`) materialize markers TRANSIENTLY without touching
+  the hot set, so an analytic full scan cannot thrash the cache —
+  the 2Q-style scan resistance of the reference's page cache.
+- **checkpoints/backups**: `_rec_json` serializes a ColdRef by reading
+  its spilled bytes directly (no fault-in), so full checkpoints of a
+  mostly-cold database stay O(hot) in memory.
+
+Compaction of the spill segment (dead versions accumulate as records
+are rewritten) is deliberately out of scope for v1 — the file is
+truncated on the next full checkpoint + reopen cycle."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.models.record import Direction, Document, Edge, Vertex
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.storage.durability import _dec, _rec_json
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("coldstore")
+
+
+class ColdRef:
+    """Cluster-slot marker for an evicted record. Duck-typed by
+    ``durability._rec_json`` via :meth:`rec_json`."""
+
+    __slots__ = ("rid", "tier")
+
+    def __init__(self, rid: RID, tier: "ColdTier") -> None:
+        self.rid = rid
+        self.tier = tier
+
+    def rec_json(self, pos: int) -> Dict:
+        r = self.tier.raw(self.rid)
+        r["pos"] = pos
+        return r
+
+    def __repr__(self) -> str:
+        return f"ColdRef({self.rid})"
+
+
+class ColdTier:
+    """The spill segment + offset index + LRU hot set for one database."""
+
+    def __init__(
+        self, db: Database, directory: str, budget_bytes: int
+    ) -> None:
+        os.makedirs(directory, exist_ok=True)
+        self.db = db
+        self.path = os.path.join(directory, "cold-segment.jsonl")
+        self._f = open(self.path, "a+b")
+        self.budget = int(budget_bytes)
+        self._index: Dict[RID, Tuple[int, int]] = {}
+        #: rid → (doc, approx bytes); insertion order = LRU order
+        self._hot: "OrderedDict[RID, Tuple[Document, int]]" = OrderedDict()
+        self._hot_bytes = 0
+        self._lock = threading.RLock()
+
+    # -- spill segment ------------------------------------------------------
+
+    def _append(self, rid: RID, rec: Dict) -> int:
+        data = json.dumps(rec, separators=(",", ":")).encode() + b"\n"
+        with self._lock:
+            self._f.seek(0, os.SEEK_END)
+            off = self._f.tell()
+            self._f.write(data)
+            self._f.flush()
+            self._index[rid] = (off, len(data) - 1)
+        return len(data)
+
+    def raw(self, rid: RID) -> Dict:
+        with self._lock:
+            off, ln = self._index[rid]
+            self._f.seek(off)
+            return json.loads(self._f.read(ln))
+
+    # -- hot set ------------------------------------------------------------
+
+    def on_save(self, doc: Document) -> None:
+        """Save-through: spill the committed state, keep the doc hot."""
+        nbytes = self._append(doc.rid, _rec_json(doc, doc.rid.position))
+        self._admit(doc, nbytes)
+
+    def on_delete(self, doc: Document) -> None:
+        with self._lock:
+            # the index entry is KEPT (the segment is append-only, the
+            # offset stays valid): a checkpoint/backup capture holding a
+            # pointer-copied ColdRef of this record may still serialize
+            # it after the delete — the delete's WAL entry (higher LSN)
+            # removes it at replay, exactly like a torn live capture.
+            entry = self._hot.pop(doc.rid, None)
+            if entry is not None:
+                self._hot_bytes -= entry[1]
+
+    def _admit(self, doc: Document, nbytes: int) -> None:
+        with self._lock:
+            old = self._hot.pop(doc.rid, None)
+            if old is not None:
+                self._hot_bytes -= old[1]
+            self._hot[doc.rid] = (doc, nbytes)
+            self._hot_bytes += nbytes
+            while self._hot_bytes > self.budget and len(self._hot) > 1:
+                rid, (victim, vb) = self._hot.popitem(last=False)
+                self._hot_bytes -= vb
+                c = self.db._clusters.get(rid.cluster)
+                if c is not None and c.get_slot(rid.position) is victim:
+                    c.records[rid.position] = ColdRef(rid, self)
+                    metrics.incr("coldstore.evict")
+
+    # -- fault-in -----------------------------------------------------------
+
+    def _build(self, rid: RID, r: Dict) -> Document:
+        fields = {k: _dec(v) for k, v in r["fields"].items()}
+        typ = r["type"]
+        if typ == "vertex":
+            doc: Document = Vertex(r["class"], fields)
+            for dname, table in r.get("bags", {}).items():
+                target = (
+                    doc._out_edges if dname == "out" else doc._in_edges
+                )
+                for cls_name, rids in table.items():
+                    target[cls_name] = [RID.parse(x) for x in rids]
+        elif typ == "edge":
+            doc = Edge(r["class"], fields)
+            doc.out_rid = RID.parse(r["out"])
+            doc.in_rid = RID.parse(r["in"])
+        else:
+            doc = Document(r["class"], fields)
+        doc._db = self.db
+        doc.rid = rid
+        doc.version = r["version"]
+        return doc
+
+    def materialize(self, ref: ColdRef) -> Document:
+        """Transient rebuild (scans): does NOT enter the hot set."""
+        metrics.incr("coldstore.fault_transient")
+        return self._build(ref.rid, self.raw(ref.rid))
+
+    def fault(self, ref: ColdRef) -> Optional[Document]:
+        """Point-read rebuild: re-admitted hot and placed in the slot.
+        Returns None when the record was deleted since the marker was
+        observed (the reader's race, same answer a pre-delete tombstone
+        read would give)."""
+        with self._lock:
+            rid = ref.rid
+            entry = self._index.get(rid)
+            if entry is None:
+                return None
+            off, ln = entry
+            doc = self._build(rid, self.raw(rid))
+            c = self.db._clusters.get(rid.cluster)
+            if c is not None and isinstance(
+                c.get_slot(rid.position), ColdRef
+            ):
+                c.records[rid.position] = doc
+            metrics.incr("coldstore.fault")
+            self._admit(doc, ln)
+            return doc
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "hot_records": len(self._hot),
+                "hot_bytes": self._hot_bytes,
+                "spilled_records": len(self._index),
+                "segment_bytes": os.path.getsize(self.path),
+                "budget_bytes": self.budget,
+            }
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def enable_cold_tier(
+    db: Database, directory: str, budget_bytes: int = 64 << 20
+) -> ColdTier:
+    """Arm the capacity tier on ``db``: committed saves spill through,
+    the hot set is bounded by ``budget_bytes``, and cold records fault
+    back on access. Existing records are adopted (spilled) lazily on
+    their next save."""
+    tier = ColdTier(db, directory, budget_bytes)
+    db._cold_tier = tier
+    for c in db._clusters.values():
+        c.cold = tier
+    db._on_new_cluster = lambda c: setattr(c, "cold", tier)
+    return tier

@@ -463,6 +463,11 @@ def _apply_entry(db: Database, e: Dict) -> None:
 
 def _rec_json(doc: Document, pos: int) -> Dict:
     """One record's checkpoint form (shared by full and delta payloads)."""
+    if hasattr(doc, "rec_json"):
+        # cold-tier marker (storage/coldstore.ColdRef): serialize from
+        # its spilled bytes directly — checkpoints of a mostly-cold
+        # database stay O(hot set) in memory, no fault-in
+        return doc.rec_json(pos)
     r: Dict = {
         "pos": pos,
         "class": doc.class_name,
@@ -617,7 +622,48 @@ def _delta_lsn_from_name(filename: str) -> int:
         return 0
 
 
-def capture_payload(db: Database, under_lock=None):
+def _serialize_clusters(db: Database, cluster_snap, quiesce: bool) -> Dict:
+    """cluster pointer-snapshot → checkpoint JSON form. ``quiesce``
+    retries a mid-mutation RuntimeError under db._lock (only meaningful
+    when serializing OUTSIDE the lock)."""
+    clusters: Dict = {}
+    for cid, records in cluster_snap:
+        recs = []
+        for pos, doc in enumerate(records):
+            if doc is None:
+                continue
+            try:
+                recs.append(_rec_json(doc, pos))
+            except RuntimeError:
+                if not quiesce:
+                    raise
+                # the doc's dicts mutated mid-iteration: retry quiesced
+                with db._lock:
+                    recs.append(_rec_json(doc, pos))
+        clusters[str(cid)] = {"len": len(records), "records": recs}
+    return clusters
+
+
+def wal_entries_above(directory: str, lsn: int) -> List[Dict]:
+    """Every WAL entry with lsn > ``lsn`` across archives + the live
+    segment, LSN-sorted. Archives whose name-encoded max LSN is covered
+    are skipped unread (shared by recovery and online backup)."""
+    entries: List[Dict] = []
+    for seg in _wal_segments(directory):
+        base = os.path.basename(seg)
+        if base.startswith("wal-") and base.endswith(".log"):
+            try:
+                if int(base[4:-4]) <= lsn:
+                    continue  # fully below the requested range
+            except ValueError:
+                pass
+        entries.extend(WriteAheadLog(seg).read_entries())
+    entries = [e for e in entries if e["lsn"] > lsn]
+    entries.sort(key=lambda e: e["lsn"])
+    return entries
+
+
+def capture_payload(db: Database, under_lock=None, serialize_in_lock=False):
     """Shared full-state capture for checkpoint() and online backup:
     covered LSN, metadata, and POINTER copies of the cluster tables
     captured as one atomic step against writers under ``db._lock``
@@ -629,7 +675,10 @@ def capture_payload(db: Database, under_lock=None):
     mutation's WAL entry carries lsn > the returned LSN, so callers must
     arrange for those entries to be replayed over the restored payload
     (recovery replays them from disk; backup bundles them in the
-    archive). Returns (payload, lsn)."""
+    archive) — or pass ``serialize_in_lock=True`` to freeze writers for
+    the whole serialization (the no-journal backup fallback, where no
+    tail exists to correct a torn capture). Returns (payload, lsn,
+    under_lock's result)."""
     wal: Optional[WriteAheadLog] = getattr(db, "_wal", None)
     with db._lock:
         lsn = (wal.next_lsn - 1) if wal is not None else 0
@@ -638,21 +687,12 @@ def capture_payload(db: Database, under_lock=None):
             (cid, list(c.records)) for cid, c in db._clusters.items()
         ]
         extra = under_lock(lsn) if under_lock is not None else None
-    clusters = {}
-    for cid, records in cluster_snap:
-        recs = []
-        for pos, doc in enumerate(records):
-            if doc is None:
-                continue
-            try:
-                recs.append(_rec_json(doc, pos))
-            except RuntimeError:
-                # the doc's dicts mutated mid-iteration: retry quiesced
-                # (the torn value itself is fine, see above)
-                with db._lock:
-                    recs.append(_rec_json(doc, pos))
-        clusters[str(cid)] = {"len": len(records), "records": recs}
-    payload["clusters"] = clusters
+        if serialize_in_lock:
+            payload["clusters"] = _serialize_clusters(
+                db, cluster_snap, quiesce=False
+            )
+    if not serialize_in_lock:
+        payload["clusters"] = _serialize_clusters(db, cluster_snap, quiesce=True)
     payload["lsn"] = lsn
     return payload, lsn, extra
 
