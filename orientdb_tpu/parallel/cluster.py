@@ -189,6 +189,14 @@ class Cluster:
 
     def _start_puller(self, m: ClusterMember, applied_lsn: int = 0) -> None:
         primary = self.members[self.primary]
+        # write-ownership routing ([E] per-cluster server-owner lists;
+        # v1: the primary owns every cluster): writes arriving at this
+        # non-owner member forward to the owner instead of diverging
+        from orientdb_tpu.parallel.forwarding import WriteOwner
+
+        m.db._write_owner = WriteOwner(
+            primary.url, self.dbname, self.user, self.password
+        )
         m.puller = ReplicaPuller(
             primary.url,
             self.dbname,
@@ -290,6 +298,7 @@ class Cluster:
             m.puller.status = "PROMOTED"
             m.puller = None
         arm_promoted_source(m.db, lsn)
+        m.db._write_owner = None  # the successor OWNS writes now
         m.role = "PRIMARY"
         self.primary = name
         self.failovers += 1  # before arming: the successor's term must
@@ -305,7 +314,18 @@ class Cluster:
         metrics.incr("cluster.failover")
         log.warning("promoted %s to PRIMARY at lsn %d", name, lsn)
         for other in self.members.values():
-            if other.name == name or other.role != "REPLICA":
+            if other.name == name:
+                continue
+            # EVERY other member — including the deposed/DOWN old primary
+            # — forwards writes to the successor from now on: a falsely-
+            # declared-down primary that resumes must not keep accepting
+            # local writes with _write_owner=None (silent divergence)
+            from orientdb_tpu.parallel.forwarding import WriteOwner
+
+            other.db._write_owner = WriteOwner(
+                m.url, self.dbname, self.user, self.password
+            )
+            if other.role != "REPLICA":
                 continue
             self._repoint(other)
 
@@ -364,6 +384,21 @@ class Cluster:
             pass  # transient; the puller thread keeps retrying
 
     # -- introspection ------------------------------------------------------
+
+    def ownership(self) -> Dict[str, str]:
+        """Per-class write-owner map ([E] ODistributedConfiguration's
+        server-owner lists). v1 policy: the primary owns every class's
+        clusters; the map is the routing surface non-owner members'
+        forwarding follows."""
+        with self._lock:
+            if self.primary is None:
+                return {}
+            pdb = self.members[self.primary].db
+            return {
+                c.name: self.primary
+                for c in pdb.schema.classes()
+                if not c.abstract
+            }
 
     def status(self) -> Dict:
         with self._lock:

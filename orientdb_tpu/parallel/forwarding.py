@@ -1,0 +1,120 @@
+"""Write-ownership forwarding: any cluster member accepts writes.
+
+Analog of the reference's cluster-ownership write routing ([E]
+``ODistributedConfiguration`` per-cluster server-owner lists: a write
+arriving at a server that does not own the record's cluster is
+forwarded to the owner; SURVEY.md §2 "Distributed"). v1 ownership: the
+PRIMARY owns every cluster — so concurrent writers on different NODES
+all succeed (serialized at the owner, replicated back), which is the
+client-visible multi-master property; per-class ownership with multiple
+concurrent owner streams is the documented delta (it needs per-owner
+WAL streams, not this engine's single LSN sequence).
+
+Wire shape: the owner's existing REST write surface (POST/PUT/DELETE
+/document, POST /command for edges) with the cluster's credentials.
+Replication then carries the committed write back to every member,
+including the forwarding one."""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional
+
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("forwarding")
+
+
+class WriteOwner:
+    """Forwarding target attached to a non-owner member's database
+    (``db._write_owner``). Cleared on promotion."""
+
+    __slots__ = ("base_url", "dbname", "user", "password", "timeout")
+
+    def __init__(self, base_url, dbname, user, password, timeout=10.0):
+        self.base_url = base_url
+        self.dbname = dbname
+        self.user = user
+        self.password = password
+        self.timeout = timeout
+
+    def _req(self, method: str, path: str, payload: Optional[Dict] = None):
+        cred = base64.b64encode(
+            f"{self.user}:{self.password}".encode()
+        ).decode()
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers={
+                "Authorization": f"Basic {cred}",
+                "Content-Type": "application/json",
+            },
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            body = r.read()
+            return json.loads(body) if body else {}
+
+    # -- the forwarded record operations ------------------------------------
+
+    def create(
+        self, class_name: str, fields: Dict, kind: str = "document"
+    ) -> Dict:
+        metrics.incr("forwarding.create")
+        return self._req(
+            "POST",
+            f"/document/{self.dbname}",
+            {"@class": class_name, "@type": kind, **fields},
+        )
+
+    def update(self, rid: RID, fields: Dict, base_version: int) -> Dict:
+        """MVCC travels with the forward: the owner rejects (409) when
+        its stored version differs from the caller's base version —
+        the same ConcurrentModificationError a local save raises."""
+        metrics.incr("forwarding.update")
+        # the '#' in a RID would otherwise parse as a URL fragment
+        q = urllib.parse.quote(str(rid), safe="")
+        try:
+            return self._req(
+                "PUT",
+                f"/document/{self.dbname}/{q}",
+                {"@base_version": base_version, **fields},
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                from orientdb_tpu.models.database import (
+                    ConcurrentModificationError,
+                )
+
+                raise ConcurrentModificationError(
+                    e.read().decode(errors="replace")
+                ) from None
+            raise
+
+    def delete(self, rid: RID) -> None:
+        metrics.incr("forwarding.delete")
+        q = urllib.parse.quote(str(rid), safe="")
+        self._req("DELETE", f"/document/{self.dbname}/{q}")
+
+    def create_edge(
+        self, class_name: str, src: RID, dst: RID, fields: Dict
+    ) -> Dict:
+        # a typed REST route, not SQL text: field values (unicode,
+        # nested maps) must round-trip exactly
+        metrics.incr("forwarding.edge")
+        return self._req(
+            "POST",
+            f"/edge/{self.dbname}",
+            {
+                "@class": class_name,
+                "from": str(src),
+                "to": str(dst),
+                "fields": fields,
+            },
+        )

@@ -258,12 +258,34 @@ class _Handler(BaseHTTPRequestHandler):
                 self.server.ot_server.security.check(user, RES_RECORD, "create")
                 payload = json.loads(self._body() or b"{}")
                 cls = payload.pop("@class", "O")
+                # forwarded creates carry the record kind so an unknown
+                # class is auto-created with the RIGHT type (a replica's
+                # Vertex must not become a plain document class here)
+                kind = payload.pop("@type", None)
                 payload = {k: v for k, v in payload.items() if not k.startswith("@")}
                 c = db.schema.get_class(cls)
-                if c is not None and c.is_vertex_type:
+                if (c is not None and c.is_vertex_type) or (
+                    c is None and kind == "vertex"
+                ):
                     doc = db.new_vertex(cls, **payload)
                 else:
                     doc = db.new_element(cls, **payload)
+                return self._send(201, _doc_json(doc))
+            if head == "edge" and len(rest) == 1:
+                # forwarded edge create (parallel/forwarding): a typed
+                # route instead of SQL so field values round-trip exactly
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, RES_RECORD, "create")
+                payload = json.loads(self._body() or b"{}")
+                src = db.load(RID.parse(payload["from"]))
+                dst = db.load(RID.parse(payload["to"]))
+                if not isinstance(src, Vertex) or not isinstance(dst, Vertex):
+                    return self._error(404, "edge endpoint not found")
+                doc = db.new_edge(
+                    payload["@class"], src, dst, **payload.get("fields", {})
+                )
                 return self._send(201, _doc_json(doc))
             return self._error(404, f"no route for POST /{head}")
         except SecurityError as e:
@@ -286,6 +308,14 @@ class _Handler(BaseHTTPRequestHandler):
                 if doc is None:
                     return self._error(404, f"record {rest[1]} not found")
                 payload = json.loads(self._body() or b"{}")
+                base = payload.get("@base_version")
+                if base is not None and int(base) != doc.version:
+                    # forwarded saves carry their base version: MVCC must
+                    # hold across the forward exactly as it does locally
+                    return self._error(
+                        409,
+                        f"{doc.rid}: stored v{doc.version} != base v{base}",
+                    )
                 for k, v in payload.items():
                     if not k.startswith("@"):
                         doc.set(k, v)

@@ -30,12 +30,9 @@ from typing import Optional
 from orientdb_tpu.models.database import Database
 from orientdb_tpu.storage.durability import (
     _apply_entry,
-    _meta_payload,
-    _rec_json,
-    _wal_segments,
-    WriteAheadLog,
     capture_payload,
     restore_payload,
+    wal_entries_above,
 )
 
 MANIFEST = "manifest.json"
@@ -43,45 +40,19 @@ PAYLOAD = "database.json"
 TAIL = "wal_tail.json"
 
 
-def _locked_payload(db: Database):
-    """No-WAL fallback: serialize entirely under db._lock (no journal
-    exists to correct torn captures, so the capture must be frozen)."""
-    with db._lock:
-        payload = _meta_payload(db)
-        clusters = {}
-        for cid, c in db._clusters.items():
-            recs = []
-            for pos, doc in enumerate(c.records):
-                if doc is not None:
-                    recs.append(_rec_json(doc, pos))
-            clusters[str(cid)] = {"len": len(c.records), "records": recs}
-        payload["clusters"] = clusters
-        payload["lsn"] = 0
-    return payload
-
-
 def _wal_tail(db: Database, after_lsn: int, upto_lsn: int):
     """WAL entries with lsn in (after_lsn, upto_lsn], across the live
     segment and any archives a concurrent checkpoint may have rotated."""
     import os
 
-    entries = []
     directory = getattr(db, "_durability_dir", None)
     if directory and os.path.isdir(directory):
-        for seg in _wal_segments(directory):
-            base = os.path.basename(seg)
-            if base.startswith("wal-") and base.endswith(".log"):
-                try:
-                    if int(base[4:-4]) <= after_lsn:
-                        continue
-                except ValueError:
-                    pass
-            entries.extend(WriteAheadLog(seg).read_entries())
+        entries = wal_entries_above(directory, after_lsn)
     else:
-        entries = db._wal.read_entries()
-    out = [e for e in entries if after_lsn < e["lsn"] <= upto_lsn]
-    out.sort(key=lambda e: e["lsn"])
-    return out
+        entries = [
+            e for e in db._wal.read_entries() if e["lsn"] > after_lsn
+        ]
+    return [e for e in entries if e["lsn"] <= upto_lsn]
 
 
 def backup_database(db: Database, path: str) -> str:
@@ -92,8 +63,10 @@ def backup_database(db: Database, path: str) -> str:
     serialization finished is included."""
     wal = getattr(db, "_wal", None)
     if wal is None:
-        payload, lsn, upto = _locked_payload(db), 0, 0
-        tail = []
+        # no journal exists to correct torn captures: freeze writers for
+        # the whole serialization instead
+        payload, lsn, _ = capture_payload(db, serialize_in_lock=True)
+        upto, tail = 0, []
     else:
         payload, lsn, _ = capture_payload(db)
         with db._lock:

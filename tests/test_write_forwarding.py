@@ -1,0 +1,193 @@
+"""Cluster-ownership write forwarding (VERDICT r3 missing #3): any
+member accepts writes — non-owners forward to the owning member
+(v1: the primary owns every cluster), so concurrent writers on
+DIFFERENT NODES succeed and converge instead of silently diverging
+the replica they hit."""
+
+import threading
+import time
+
+import pytest
+
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.server.server import Server
+
+
+def wait_for(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def trio():
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("f")
+    cl = Cluster("f", user="admin", password="pw", interval=0.05, down_after=2)
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    pdb.schema.create_edge_class("L")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+def test_replica_writes_forward_to_owner(trio):
+    cl, servers, pdb = trio
+    rdb = cl.members["n1"].db
+    assert rdb._write_owner is not None
+    v = rdb.new_vertex("P", uid=1)  # write issued ON THE REPLICA
+    assert v.rid.is_persistent
+    # landed on the owner, not the replica's local store
+    assert pdb.count_class("P") == 1
+    # and replicates back to every member, including the writer
+    assert wait_for(
+        lambda: all(m.db.count_class("P") == 1 for m in cl.members.values())
+    )
+
+
+def test_concurrent_writers_on_different_nodes_converge(trio):
+    cl, servers, pdb = trio
+    dbs = [pdb, cl.members["n1"].db, cl.members["n2"].db]
+    errs = []
+
+    def writer(db, base):
+        try:
+            for i in range(8):
+                db.new_vertex("P", uid=base + i)
+        except Exception as e:  # pragma: no cover - diagnostic
+            errs.append(repr(e))
+
+    threads = [
+        threading.Thread(target=writer, args=(db, k * 100))
+        for k, db in enumerate(dbs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    assert pdb.count_class("P") == 24
+    assert wait_for(
+        lambda: all(m.db.count_class("P") == 24 for m in cl.members.values())
+    )
+    want = sorted(k * 100 + i for k in range(3) for i in range(8))
+    for m in cl.members.values():
+        assert sorted(d["uid"] for d in m.db.browse_class("P")) == want
+
+
+def test_forwarded_update_delete_and_edge(trio):
+    cl, servers, pdb = trio
+    rdb = cl.members["n2"].db
+    a = rdb.new_vertex("P", uid=1, n=0)
+    b = rdb.new_vertex("P", uid=2)
+    e = rdb.new_edge("L", a, b, w=5)
+    assert e.rid.is_persistent
+    assert pdb.count_class("L") == 1
+    # update THROUGH the replica: reload first — the edge create bumped
+    # the source vertex's version on the owner (adjacency MVCC), so the
+    # stale in-hand object would (correctly) be rejected
+    assert wait_for(lambda: rdb.count_class("P") == 2)
+    a2 = rdb.load(a.rid)
+    assert wait_for(
+        lambda: (rdb.load(a.rid)).version >= 2
+    )
+    a2 = rdb.load(a.rid)
+    a2.set("n", 9)
+    rdb.save(a2)
+    assert pdb.query("SELECT n FROM P WHERE uid = 1").to_dicts() == [{"n": 9}]
+    # adjacency queryable on the owner
+    rows = pdb.query(
+        "MATCH {class:P, as:x, where:(uid = 1)}-L->{as:y} RETURN y.uid AS u"
+    ).to_dicts()
+    assert rows == [{"u": 2}]
+    # forwarded delete
+    doc = pdb.load(b.rid)
+    rdb.delete(rdb.load(b.rid) or doc)
+    assert pdb.query("SELECT FROM P WHERE uid = 2").to_dicts() == []
+
+
+def test_ownership_map_and_promotion_clears_forwarding(trio):
+    cl, servers, pdb = trio
+    own = cl.ownership()
+    assert own.get("P") == "n0" and own.get("L") == "n0"
+    servers[0].shutdown()
+    assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
+    new_name = cl.status()["primary"]
+    ndb = cl.primary_db()
+    assert ndb._write_owner is None, "the promoted owner must not forward"
+    assert cl.ownership().get("P") == new_name
+    # the surviving replica forwards to the NEW owner
+    other = "n2" if new_name == "n1" else "n1"
+    v = cl.members[other].db.new_vertex("P", uid=77)
+    assert v.rid.is_persistent
+    assert ndb.count_class("P") == 1
+
+
+def test_tx_on_non_owner_is_rejected_at_buffering(trio):
+    """Rejected when the write is BUFFERED, not at commit: the local tx
+    path would auto-create schema classes on the replica (DDL is not
+    tx-buffered) before a commit-time error could stop it."""
+    cl, servers, pdb = trio
+    rdb = cl.members["n1"].db
+    from orientdb_tpu.exec.tx import TxError
+
+    tx = rdb.begin()
+    try:
+        with pytest.raises(TxError):
+            rdb.new_vertex("P", uid=5)
+        # no local schema divergence happened
+        assert not rdb.schema.exists_class("NewCls")
+        with pytest.raises(TxError):
+            rdb.new_element("NewCls", x=1)
+        assert not rdb.schema.exists_class("NewCls")
+    finally:
+        tx.rollback()
+
+
+def test_forwarded_update_respects_mvcc(trio):
+    cl, servers, pdb = trio
+    rdb = cl.members["n1"].db
+    v = rdb.new_vertex("P", uid=1, n=0)
+
+    def wait_local():
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if rdb.count_class("P") == 1:
+                return True
+            time.sleep(0.02)
+        return False
+
+    assert wait_local()
+    # stale base: owner advances the record first
+    owner_doc = pdb.load(v.rid)
+    owner_doc.set("n", 5)
+    pdb.save(owner_doc)
+    from orientdb_tpu.models.database import ConcurrentModificationError
+
+    v.set("n", 9)  # still carries the pre-update version
+    with pytest.raises(ConcurrentModificationError):
+        rdb.save(v)
+    assert pdb.query("SELECT n FROM P WHERE uid = 1").to_dicts() == [{"n": 5}]
+
+
+def test_forwarded_edge_unicode_fields(trio):
+    cl, servers, pdb = trio
+    rdb = cl.members["n2"].db
+    a = rdb.new_vertex("P", uid=1)
+    b = rdb.new_vertex("P", uid=2)
+    rdb.new_edge("L", a, b, label="café—δ")
+    rows = pdb.query("SELECT label FROM L").to_dicts()
+    assert rows == [{"label": "café—δ"}]
