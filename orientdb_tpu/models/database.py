@@ -326,6 +326,22 @@ class Database:
             raise ValueError(f"class '{class_name}' is not an edge class")
         return cls
 
+    def new_blob(self, data: bytes) -> "Blob":
+        """Create (and save) a raw-bytes record ([E] ORecordBytes —
+        ``db.save(new ORecordBytes(bytes))``)."""
+        from orientdb_tpu.models.record import Blob
+
+        self._reject_non_owner_tx()
+        if self._write_owner is None and not self.schema.exists_class(
+            "OBlob"
+        ):
+            # non-owners skip local schema mutation: the owner creates
+            # OBlob when the forwarded save arrives (see new_element)
+            self.schema.create_class("OBlob")
+        b = Blob(data)
+        b._db = self
+        return self.save(b)
+
     def new_vertex(self, class_name: str = "V", **fields) -> Vertex:
         self._reject_non_owner_tx()
         if self._write_owner is not None and self.tx is None:
@@ -411,12 +427,16 @@ class Database:
         carries the owner-assigned RID/version."""
         if isinstance(doc, Edge):
             raise ValueError("edges are created via new_edge (forwarded)")
+        from orientdb_tpu.models.record import Blob
+
         is_new = doc.rid is NEW_RID or not doc.rid.is_persistent
         if is_new:
             resp = self._write_owner.create(
                 doc.class_name,
                 doc.fields(),
-                kind="vertex" if isinstance(doc, Vertex) else "document",
+                kind="vertex"
+                if isinstance(doc, Vertex)
+                else "blob" if isinstance(doc, Blob) else "document",
             )
             doc.rid = RID.parse(resp["@rid"])
         else:

@@ -146,6 +146,37 @@ class Document:
     # query layer keys on `doc.rid` explicitly.
 
 
+class Blob(Document):
+    """A raw-bytes record ([E] ORecordBytes / OBlob, SURVEY.md §2
+    "Record types"): payload bytes with no schema fields. Stored in the
+    reserved class ``OBlob`` and addressed by RID like any record; the
+    bytes ride the checkpoint/WAL/export codecs base64-framed."""
+
+    __slots__ = ()
+
+    def __init__(self, data: bytes = b"") -> None:
+        super().__init__("OBlob", {"data": bytes(data)})
+
+    @classmethod
+    def from_fields(cls, fields: Dict[str, object]) -> "Blob":
+        """Rebuild from a persisted field map, keeping EVERY field (a
+        blob may carry metadata like a mime type alongside `data`)."""
+        b = cls(fields.get("data", b"") or b"")
+        b._fields = dict(fields)
+        return b
+
+    @property
+    def data(self) -> bytes:
+        return self._fields.get("data", b"")
+
+    @data.setter
+    def data(self, value: bytes) -> None:
+        self.set("data", bytes(value))
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
 class RidBag:
     """Adjacency container ([E] ORidBag): an ordered list of edge RIDs
     that transparently *promotes* past a threshold — the reference's

@@ -44,13 +44,21 @@ class WriteOwner:
         self.password = password
         self.timeout = timeout
 
+    @staticmethod
+    def _json_enc(v):
+        if isinstance(v, (bytes, bytearray)):  # blob payloads
+            return {"@bytes": base64.b64encode(bytes(v)).decode()}
+        raise TypeError(f"not JSON-forwardable: {type(v).__name__}")
+
     def _req(self, method: str, path: str, payload: Optional[Dict] = None):
         cred = base64.b64encode(
             f"{self.user}:{self.password}".encode()
         ).decode()
         req = urllib.request.Request(
             f"{self.base_url}{path}",
-            data=None if payload is None else json.dumps(payload).encode(),
+            data=None
+            if payload is None
+            else json.dumps(payload, default=self._json_enc).encode(),
             headers={
                 "Authorization": f"Basic {cred}",
                 "Content-Type": "application/json",

@@ -55,7 +55,16 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("http: " + fmt, *args)
 
     def _send(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode()
+        def enc(v):
+            if isinstance(v, (bytes, bytearray)):  # blob payloads
+                import base64
+
+                return {"@bytes": base64.b64encode(bytes(v)).decode()}
+            # anything else non-serializable stays a TypeError (a visible
+            # 500), not silently stringified response data
+            raise TypeError(f"not JSON-serializable: {type(v).__name__}")
+
+        body = json.dumps(payload, default=enc).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -256,15 +265,27 @@ class _Handler(BaseHTTPRequestHandler):
                 if db is None:
                     return
                 self.server.ot_server.security.check(user, RES_RECORD, "create")
+                from orientdb_tpu.storage.durability import _dec
+
                 payload = json.loads(self._body() or b"{}")
                 cls = payload.pop("@class", "O")
                 # forwarded creates carry the record kind so an unknown
                 # class is auto-created with the RIGHT type (a replica's
                 # Vertex must not become a plain document class here)
                 kind = payload.pop("@type", None)
-                payload = {k: v for k, v in payload.items() if not k.startswith("@")}
+                payload = {
+                    k: _dec(v)
+                    for k, v in payload.items()
+                    if not k.startswith("@")
+                }
                 c = db.schema.get_class(cls)
-                if (c is not None and c.is_vertex_type) or (
+                if kind == "blob" or cls == "OBlob":
+                    doc = db.new_blob(payload.pop("data", b"") or b"")
+                    if payload:
+                        for k, v in payload.items():
+                            doc.set(k, v)
+                        db.save(doc)
+                elif (c is not None and c.is_vertex_type) or (
                     c is None and kind == "vertex"
                 ):
                     doc = db.new_vertex(cls, **payload)
@@ -316,9 +337,11 @@ class _Handler(BaseHTTPRequestHandler):
                         409,
                         f"{doc.rid}: stored v{doc.version} != base v{base}",
                     )
+                from orientdb_tpu.storage.durability import _dec
+
                 for k, v in payload.items():
                     if not k.startswith("@"):
-                        doc.set(k, v)
+                        doc.set(k, _dec(v))
                 db.save(doc)
                 return self._send(200, _doc_json(doc))
             return self._error(404, f"no route for PUT /{head}")

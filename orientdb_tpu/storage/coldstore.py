@@ -42,7 +42,7 @@ from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
 from orientdb_tpu.models.database import Database
-from orientdb_tpu.models.record import Direction, Document, Edge, Vertex
+from orientdb_tpu.models.record import Blob, Direction, Document, Edge, Vertex
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.storage.durability import _dec, _rec_json
 from orientdb_tpu.utils.logging import get_logger
@@ -155,6 +155,8 @@ class ColdTier:
             doc = Edge(r["class"], fields)
             doc.out_rid = RID.parse(r["out"])
             doc.in_rid = RID.parse(r["in"])
+        elif typ == "blob":
+            doc = Blob.from_fields(fields)
         else:
             doc = Document(r["class"], fields)
         doc._db = self.db

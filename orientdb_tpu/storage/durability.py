@@ -31,13 +31,14 @@ reference records by RID, so the checkpoint beneath them must too.
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import zlib
 from typing import Dict, List, Optional, Tuple
 
 from orientdb_tpu.models.database import Database
-from orientdb_tpu.models.record import Direction, Document, Edge, Vertex
+from orientdb_tpu.models.record import Blob, Direction, Document, Edge, Vertex
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.models.schema import PropertyType
 from orientdb_tpu.utils.config import config
@@ -60,6 +61,8 @@ def _enc(v):
         return {"@link": str(v)}
     if isinstance(v, Document):
         return {"@link": str(v.rid)}
+    if isinstance(v, (bytes, bytearray)):
+        return {"@bytes": base64.b64encode(bytes(v)).decode()}
     if isinstance(v, (list, tuple)):
         return [_enc(x) for x in v]
     if isinstance(v, dict):
@@ -71,6 +74,8 @@ def _dec(v):
     if isinstance(v, dict):
         if "@link" in v and len(v) == 1:
             return RID.parse(v["@link"])
+        if "@bytes" in v and len(v) == 1:
+            return base64.b64decode(v["@bytes"])
         return {k: _dec(x) for k, x in v.items()}
     if isinstance(v, list):
         return [_dec(x) for x in v]
@@ -293,7 +298,9 @@ def entry_for_save(doc: Document, is_new: bool) -> Dict:
             "type": (
                 "vertex"
                 if isinstance(doc, Vertex)
-                else "edge" if isinstance(doc, Edge) else "document"
+                else "edge"
+                if isinstance(doc, Edge)
+                else "blob" if isinstance(doc, Blob) else "document"
             ),
             "version": doc.version,
             "fields": _enc_fields(doc),
@@ -342,6 +349,8 @@ def _apply_entry(db: Database, e: Dict) -> None:
             doc = Edge(e["class"], fields)
             doc.out_rid = RID.parse(e["out"])
             doc.in_rid = RID.parse(e["in"])
+        elif typ == "blob":
+            doc = Blob.from_fields(fields)
         else:
             doc = Document(e["class"], fields)
         doc._db = db
@@ -474,7 +483,9 @@ def _rec_json(doc: Document, pos: int) -> Dict:
         "type": (
             "vertex"
             if isinstance(doc, Vertex)
-            else "edge" if isinstance(doc, Edge) else "document"
+            else "edge"
+            if isinstance(doc, Edge)
+            else "blob" if isinstance(doc, Blob) else "document"
         ),
         "version": doc.version,
         "fields": _enc_fields(doc),
@@ -964,6 +975,8 @@ def _place_rec(db: Database, rid: RID, r: Dict, idx) -> RID:
         doc = Edge(r["class"], fields)
         doc.out_rid = RID.parse(r["out"])
         doc.in_rid = RID.parse(r["in"])
+    elif typ == "blob":
+        doc = Blob.from_fields(fields)
     else:
         doc = Document(r["class"], fields)
     doc._db = db
@@ -1148,9 +1161,12 @@ def restore_payload(db: Database, payload: Dict) -> int:
                 deferred_edges.append((rid, r))
                 continue
             fields = {k: _dec(v) for k, v in r["fields"].items()}
-            doc = Vertex(r["class"], fields) if r["type"] == "vertex" else Document(
-                r["class"], fields
-            )
+            if r["type"] == "vertex":
+                doc: Document = Vertex(r["class"], fields)
+            elif r["type"] == "blob":
+                doc = Blob.from_fields(fields)
+            else:
+                doc = Document(r["class"], fields)
             doc._db = db
             doc.rid = rid
             doc.version = r["version"]

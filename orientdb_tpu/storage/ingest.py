@@ -15,6 +15,7 @@
 
 from __future__ import annotations
 
+import base64
 import gzip
 import json
 from typing import Dict, List, Optional
@@ -22,7 +23,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from orientdb_tpu.models.database import Database
-from orientdb_tpu.models.record import Document, Edge, Vertex
+from orientdb_tpu.models.record import Blob, Document, Edge, Vertex
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.models.schema import PropertyType
 from orientdb_tpu.utils.logging import get_logger
@@ -305,6 +306,8 @@ def _value_to_json(v):
         return {"@link": str(v)}
     if isinstance(v, Document):
         return {"@link": str(v.rid)}
+    if isinstance(v, (bytes, bytearray)):
+        return {"@bytes": base64.b64encode(bytes(v)).decode()}
     if isinstance(v, (list, tuple)):
         return [_value_to_json(x) for x in v]
     if isinstance(v, dict):
@@ -357,7 +360,11 @@ def export_database(db: Database, path: str) -> None:
             rec = {
                 "@rid": str(doc.rid),
                 "@class": doc.class_name,
-                "@type": "vertex" if isinstance(doc, Vertex) else "document",
+                "@type": (
+                    "vertex"
+                    if isinstance(doc, Vertex)
+                    else "blob" if isinstance(doc, Blob) else "document"
+                ),
                 "fields": _value_to_json(doc.fields()),
             }
             records.append(rec)
@@ -433,6 +440,8 @@ def import_database(path: str, name: Optional[str] = None) -> Database:
         if isinstance(v, dict):
             if "@link" in v:
                 return ("@deferred", v["@link"])
+            if "@bytes" in v and len(v) == 1:
+                return base64.b64decode(v["@bytes"])
             return {k: _value_from_json(x) for k, x in v.items()}
         if isinstance(v, list):
             return [_value_from_json(x) for x in v]
@@ -447,6 +456,13 @@ def import_database(path: str, name: Optional[str] = None) -> Database:
         }
         if rec["@type"] == "vertex":
             doc: Document = db.new_vertex(rec["@class"], **clean)
+        elif rec["@type"] == "blob":
+            doc = db.new_blob(clean.get("data", b""))
+            for k, v in clean.items():
+                if k != "data":
+                    doc.set(k, v)
+            if len(clean) > 1:
+                db.save(doc)
         else:
             doc = db.new_element(rec["@class"], **clean)
         remap[rec["@rid"]] = doc.rid
