@@ -46,10 +46,19 @@ class RemoteResultSet:
 
 class RemoteDatabase:
     def __init__(
-        self, host: str, port: int, name: str, user: str, password: str
+        self,
+        host: str,
+        port: int,
+        name: str,
+        user: str,
+        password: str,
+        serialization: str = "json",
     ) -> None:
         self.host, self.port, self.name = host, port, name
         self._user, self._password = user, password
+        #: record-payload wire encoding: "json" or "binary" (the
+        #: schema-aware binary record format, server/binser.py)
+        self.serialization = serialization
         self._lock = threading.Lock()
         #: per-response wait in demultiplexed mode (tests shrink it)
         self._call_timeout = 30.0
@@ -83,7 +92,13 @@ class RemoteDatabase:
         if not resp.get("ok"):
             raise RemoteError(resp.get("error", "connect failed"))
         if self.name:
-            resp = self._call({"op": "db_open", "name": self.name})
+            resp = self._call(
+                {
+                    "op": "db_open",
+                    "name": self.name,
+                    "serialization": self.serialization,
+                }
+            )
             if not resp.get("ok"):
                 raise RemoteError(resp.get("error", "open failed"))
 
@@ -215,11 +230,34 @@ class RemoteDatabase:
         r = self._checked({"op": "command", "sql": sql, "params": params})
         return RemoteResultSet(r["result"], r.get("engine"))
 
+    @staticmethod
+    def _record_from(resp: dict) -> Optional[dict]:
+        if "record_b85" in resp:  # binary-serialization session
+            import base64
+
+            from orientdb_tpu.server.binser import decode_records
+
+            recs = decode_records(base64.b85decode(resp["record_b85"]))
+            return recs[0] if recs else None
+        rec = resp.get("record")
+        if rec is None:
+            return None
+        # JSON sessions frame blob payloads as {"@bytes": b64}: decode
+        from orientdb_tpu.storage.durability import _dec
+
+        return {
+            k: (v if k.startswith("@") else _dec(v)) for k, v in rec.items()
+        }
+
     def load(self, rid) -> Optional[dict]:
-        return self._checked({"op": "load", "rid": str(rid)})["record"]
+        return self._record_from(
+            self._checked({"op": "load", "rid": str(rid)})
+        )
 
     def save(self, record: dict) -> dict:
-        return self._checked({"op": "save", "record": record})["record"]
+        return self._record_from(
+            self._checked({"op": "save", "record": record})
+        )
 
     def delete(self, rid) -> None:
         self._checked({"op": "delete", "rid": str(rid)})
@@ -259,9 +297,17 @@ class FailoverDatabase:
     failed over. For a replicated cluster the list is the member servers:
     after a failover the promoted member serves the reconnect."""
 
-    def __init__(self, addrs, name: str, user: str, password: str) -> None:
+    def __init__(
+        self,
+        addrs,
+        name: str,
+        user: str,
+        password: str,
+        serialization: str = "json",
+    ) -> None:
         self._addrs = list(addrs)
         self._name, self._user, self._password = name, user, password
+        self._serialization = serialization
         self._db: Optional[RemoteDatabase] = None
         self._lock = threading.Lock()
         self._connect_any()
@@ -275,7 +321,8 @@ class FailoverDatabase:
         for i, (h, p) in enumerate(self._addrs):
             try:
                 self._db = RemoteDatabase(
-                    h, p, self._name, self._user, self._password
+                    h, p, self._name, self._user, self._password,
+                    serialization=self._serialization,
                 )
                 # rotate: the reachable server becomes the head
                 self._addrs = self._addrs[i:] + self._addrs[:i]
@@ -369,14 +416,21 @@ def _parse_addrs(hostports: str):
     return out
 
 
-def connect(url: str, user: str, password: str):
+def connect(url: str, user: str, password: str, serialization: str = "json"):
     """`remote:<host>:<port>/<database>` ([E] the remote: URL scheme);
-    `remote:h1:p1;h2:p2/<database>` returns a failover client."""
+    `remote:h1:p1;h2:p2/<database>` returns a failover client.
+    ``serialization="binary"`` negotiates the schema-aware binary record
+    format for record payloads (server/binser.py)."""
     if not url.startswith("remote:"):
         raise ValueError(f"not a remote: url: {url!r}")
     rest = url[len("remote:") :]
     hostport, _, name = rest.partition("/")
     addrs = _parse_addrs(hostport)
     if len(addrs) > 1:
-        return FailoverDatabase(addrs, name, user, password)
-    return RemoteDatabase(addrs[0][0], addrs[0][1], name, user, password)
+        return FailoverDatabase(
+            addrs, name, user, password, serialization=serialization
+        )
+    return RemoteDatabase(
+        addrs[0][0], addrs[0][1], name, user, password,
+        serialization=serialization,
+    )

@@ -2415,6 +2415,16 @@ class _CompiledTraverse(_AotWarmup):
         self.wait_compiled()
         return self.jitted(self.solver.dg.arrays)
 
+    def batchable(self) -> bool:
+        """TRAVERSE plans bake their parameters, so every batch item
+        sharing this plan is the IDENTICAL program on identical inputs:
+        the group path serves them all with ONE dispatch (the no-dyn
+        shared-dispatch case of execute_batch's grouping)."""
+        return self.solver.dg.mesh_graph is None
+
+    def _dyn_args(self, params: Optional[Dict]) -> Dict:
+        return {}  # no dynamic args: grouping uses the shared dispatch
+
     def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
         return self.solver.rows_from(np.asarray(dev), self.count)
 

@@ -47,7 +47,9 @@ class WriteOwner:
     @staticmethod
     def _json_enc(v):
         if isinstance(v, (bytes, bytearray)):  # blob payloads
-            return {"@bytes": base64.b64encode(bytes(v)).decode()}
+            from orientdb_tpu.storage.durability import bytes_to_wire
+
+            return bytes_to_wire(v)
         raise TypeError(f"not JSON-forwardable: {type(v).__name__}")
 
     def _req(self, method: str, path: str, payload: Optional[Dict] = None):

@@ -37,7 +37,11 @@ log = get_logger("binary")
 
 
 def send_frame(sock: socket.socket, payload: dict) -> None:
-    data = json.dumps(payload, default=str).encode()
+    # bytes values (blob payloads) get the shared @bytes framing; other
+    # non-JSON values keep the channel's historical stringification
+    from orientdb_tpu.storage.durability import json_channel_default
+
+    data = json.dumps(payload, default=json_channel_default).encode()
     sock.sendall(struct.pack(">I", len(data)) + data)
 
 
@@ -78,6 +82,23 @@ class _Session:
     def _send(self, payload: dict) -> None:
         with self._send_lock:
             send_frame(self.sock, payload)
+
+    def _record_payload(self, doc) -> dict:
+        """One record for the wire: schema-aware binary bytes
+        (base85-framed, self-contained batch envelope carrying the
+        class's property dictionary) for sessions that negotiated
+        ``serialization: "binary"`` at db_open; plain JSON otherwise."""
+        if getattr(self, "binser", False):
+            import base64
+
+            from orientdb_tpu.server.binser import encode_records
+
+            return {
+                "record_b85": base64.b85encode(
+                    encode_records([doc])
+                ).decode()
+            }
+        return {"record": doc.to_dict()}
 
     def run(self) -> None:
         try:
@@ -134,7 +155,14 @@ class _Session:
                 if db is None:
                     return {"ok": False, "error": f"no database '{req['name']}'"}
                 self.db = db
-                return {"ok": True}
+                # record payload encoding for THIS session ([E] the
+                # serialization-impl negotiation of the reference's
+                # OPEN op): "binary" routes load/save record payloads
+                # through the schema-aware binary format (binser.py)
+                self.binser = req.get("serialization") == "binary"
+                return {"ok": True, "serialization": (
+                    "binary" if self.binser else "json"
+                )}
             if self.db is None and op != "close":
                 return {"ok": False, "error": "no database open"}
             if op == "query":
@@ -151,13 +179,19 @@ class _Session:
                 doc = self.db.load(RID.parse(req["rid"]))
                 if doc is None:
                     return {"ok": True, "record": None}
-                return {"ok": True, "record": doc.to_dict()}
+                return {"ok": True, **self._record_payload(doc)}
             if op == "save":
                 self.server.security.check(self.user, RES_RECORD, "update")
+                from orientdb_tpu.storage.durability import _dec
+
                 payload = dict(req.get("record") or {})
                 cls = payload.pop("@class", "O")
                 rid = payload.pop("@rid", None)
-                payload = {k: v for k, v in payload.items() if not k.startswith("@")}
+                payload = {
+                    k: _dec(v)
+                    for k, v in payload.items()
+                    if not k.startswith("@")
+                }
                 if rid:
                     doc = self.db.load(RID.parse(rid))
                     if doc is None:
@@ -167,11 +201,17 @@ class _Session:
                     self.db.save(doc)
                 else:
                     c = self.db.schema.get_class(cls)
-                    if c is not None and c.is_vertex_type:
+                    if cls == "OBlob":
+                        doc = self.db.new_blob(payload.pop("data", b"") or b"")
+                        if payload:
+                            for k, v in payload.items():
+                                doc.set(k, v)
+                            self.db.save(doc)
+                    elif c is not None and c.is_vertex_type:
                         doc = self.db.new_vertex(cls, **payload)
                     else:
                         doc = self.db.new_element(cls, **payload)
-                return {"ok": True, "record": doc.to_dict()}
+                return {"ok": True, **self._record_payload(doc)}
             if op == "live_subscribe":
                 # push delivery over the session channel ([E]
                 # OLiveQueryHookV2 pushing to remote clients)

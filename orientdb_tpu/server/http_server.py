@@ -57,9 +57,9 @@ class _Handler(BaseHTTPRequestHandler):
     def _send(self, code: int, payload) -> None:
         def enc(v):
             if isinstance(v, (bytes, bytearray)):  # blob payloads
-                import base64
+                from orientdb_tpu.storage.durability import bytes_to_wire
 
-                return {"@bytes": base64.b64encode(bytes(v)).decode()}
+                return bytes_to_wire(v)
             # anything else non-serializable stays a TypeError (a visible
             # 500), not silently stringified response data
             raise TypeError(f"not JSON-serializable: {type(v).__name__}")
@@ -299,13 +299,16 @@ class _Handler(BaseHTTPRequestHandler):
                 if db is None:
                     return
                 self.server.ot_server.security.check(user, RES_RECORD, "create")
+                from orientdb_tpu.storage.durability import _dec
+
                 payload = json.loads(self._body() or b"{}")
                 src = db.load(RID.parse(payload["from"]))
                 dst = db.load(RID.parse(payload["to"]))
                 if not isinstance(src, Vertex) or not isinstance(dst, Vertex):
                     return self._error(404, "edge endpoint not found")
                 doc = db.new_edge(
-                    payload["@class"], src, dst, **payload.get("fields", {})
+                    payload["@class"], src, dst,
+                    **{k: _dec(v) for k, v in payload.get("fields", {}).items()},
                 )
                 return self._send(201, _doc_json(doc))
             return self._error(404, f"no route for POST /{head}")

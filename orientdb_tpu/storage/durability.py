@@ -56,13 +56,29 @@ CHECKPOINT_PREFIX = "checkpoint-"
 # ---------------------------------------------------------------------------
 
 
+def bytes_to_wire(v) -> Dict:
+    """The ONE definition of the ``{"@bytes": base64}`` wire framing for
+    raw byte values — shared by the durability/export codecs, the HTTP
+    and binary channels, and write forwarding (decoder: ``_dec``)."""
+    return {"@bytes": base64.b64encode(bytes(v)).decode()}
+
+
+def json_channel_default(v):
+    """``json.dumps`` default for the lenient wire channels: bytes get
+    the @bytes framing, everything else the channel's historical
+    stringification."""
+    if isinstance(v, (bytes, bytearray)):
+        return bytes_to_wire(v)
+    return str(v)
+
+
 def _enc(v):
     if isinstance(v, RID):
         return {"@link": str(v)}
     if isinstance(v, Document):
         return {"@link": str(v.rid)}
     if isinstance(v, (bytes, bytearray)):
-        return {"@bytes": base64.b64encode(bytes(v)).decode()}
+        return bytes_to_wire(v)
     if isinstance(v, (list, tuple)):
         return [_enc(x) for x in v]
     if isinstance(v, dict):
