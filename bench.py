@@ -208,6 +208,46 @@ def main() -> None:
     trav_qps = time_batched(sql_trav, tag="traverse")
     select_qps = time_batched(sql_select, tag="select_count")
 
+    # shared by the IS / IC / sf10 sections -------------------------------
+    def parity_or_die(dbx, q, p, label):
+        """Oracle-vs-compiled gate (exact compare under ORDER BY, canon
+        otherwise); a mismatch fails the whole run with the parameters
+        that reproduce it."""
+        o = dbx.query(q, params=p, engine="oracle").to_dicts()
+        t = dbx.query(q, params=p, engine="tpu", strict=True).to_dicts()
+        ok = (o == t) if "ORDER BY" in q else (canon(o) == canon(t))
+        if not ok:
+            print(
+                json.dumps(
+                    {
+                        "metric": "demodb_match_2hop_count_qps",
+                        "value": 0.0,
+                        "unit": "queries/sec",
+                        "vs_baseline": 0.0,
+                        "error": f"{label} parity mismatch: {p}",
+                    }
+                )
+            )
+            sys.exit(1)
+
+    def time_param_batch(dbx, q, plist, n=None):
+        """Two warm rounds with drains (group executables and
+        overflow-driven variant re-records settle — see time_batched),
+        then the timed batched loop; returns q/s."""
+        n = iters if n is None else n
+        qs = [q] * len(plist)
+        dbx.query_batch(qs, params_list=plist, engine="tpu", strict=True)
+        drain_warmups()
+        dbx.query_batch(qs, params_list=plist, engine="tpu", strict=True)
+        drain_warmups()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            for rs in dbx.query_batch(
+                qs, params_list=plist, engine="tpu", strict=True
+            ):
+                rs.to_dicts()
+        return round((n * len(plist)) / (time.perf_counter() - t0), 3)
+
     # LDBC SNB interactive short reads (IS1–IS7) on an SF1-shaped graph
     snb_persons = int(os.environ.get("BENCH_SNB_PERSONS", "10000"))
     ldbc_is = {}
@@ -230,39 +270,35 @@ def main() -> None:
             # parity gate on a few parameter values (broad coverage lives
             # in tests/test_ldbc_is.py)
             for i in (0, 5, 9):
-                p = is_params(q, i)
-                o = snb.query(q, params=p, engine="oracle").to_dicts()
-                t = snb.query(q, params=p, engine="tpu", strict=True).to_dicts()
-                if ("ORDER BY" in q and o != t) or (
-                    "ORDER BY" not in q and canon(o) != canon(t)
-                ):
-                    print(
-                        json.dumps(
-                            {
-                                "metric": "demodb_match_2hop_count_qps",
-                                "value": 0.0,
-                                "unit": "queries/sec",
-                                "vs_baseline": 0.0,
-                                "error": f"IS parity mismatch: {name} {p}",
-                            }
-                        )
-                    )
-                    sys.exit(1)
-            qs = [q] * batch
-            plist = [is_params(q, i) for i in range(batch)]
-            # two warm rounds (see time_batched): group executables and
-            # overflow-driven variant re-records settle before timing
-            snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)
-            drain_warmups()
-            snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)
-            drain_warmups()
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                rss = snb.query_batch(qs, params_list=plist, engine="tpu", strict=True)
-                for rs in rss:
-                    rs.to_dicts()
-            ldbc_is[name] = round(
-                (iters * batch) / (time.perf_counter() - t0), 3
+                parity_or_die(snb, q, is_params(q, i), f"IS {name}")
+            ldbc_is[name] = time_param_batch(
+                snb, q, [is_params(q, i) for i in range(batch)]
+            )
+
+    # ---- LDBC interactive COMPLEX reads (IC1/IC2 + 3-hop aggregate):
+    # the multi-pattern half of BASELINE configs[4], on the same
+    # SF1-shaped graph as the IS section ----
+    ldbc_ic = {}
+    if snb_persons > 0:
+        from orientdb_tpu.workloads.ldbc import IC_QUERIES
+
+        someone = next(snb.browse_class("Person"))
+        first_name = someone.get("firstName")
+
+        def ic_params(name, i):
+            p = {"personId": (i * 37) % snb_persons}
+            if name == "IC1":
+                p["firstName"] = first_name
+            elif name == "IC2":
+                p["maxDate"] = 2**30 + i * 1000
+            return p
+
+        for name in sorted(IC_QUERIES):
+            q = IC_QUERIES[name]
+            for i in (0, 5, 9):
+                parity_or_die(snb, q, ic_params(name, i), f"IC {name}")
+            ldbc_ic[name + "_qps"] = time_param_batch(
+                snb, q, [ic_params(name, i) for i in range(batch)]
             )
 
     # ---- SF10 every round (VERDICT r3 #2): the IS spot check at 10x ----
@@ -276,30 +312,13 @@ def main() -> None:
         attach_fresh_snapshot(snb10)
         for name in ("IS1", "IS3"):
             q = IS_QUERIES[name]
-            p0 = {"personId": 37 % sf10_persons}
-            o = snb10.query(q, params=p0, engine="oracle").to_dicts()
-            t = snb10.query(q, params=p0, engine="tpu", strict=True).to_dicts()
-            ok = (o == t) if "ORDER BY" in q else (canon(o) == canon(t))
-            if not ok:
-                print(json.dumps({"metric": "demodb_match_2hop_count_qps",
-                                  "value": 0.0, "unit": "queries/sec",
-                                  "vs_baseline": 0.0,
-                                  "error": f"sf10 parity mismatch: {name}"}))
-                sys.exit(1)
-            qs = [q] * batch
-            plist = [{"personId": (i * 37) % sf10_persons} for i in range(batch)]
-            snb10.query_batch(qs, params_list=plist, engine="tpu", strict=True)
-            drain_warmups()
-            snb10.query_batch(qs, params_list=plist, engine="tpu", strict=True)
-            drain_warmups()
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                for rs in snb10.query_batch(
-                    qs, params_list=plist, engine="tpu", strict=True
-                ):
-                    rs.to_dicts()
-            sf10[name + "_qps"] = round(
-                (iters * batch) / (time.perf_counter() - t0), 3
+            parity_or_die(
+                snb10, q, {"personId": 37 % sf10_persons}, f"sf10 {name}"
+            )
+            sf10[name + "_qps"] = time_param_batch(
+                snb10,
+                q,
+                [{"personId": (i * 37) % sf10_persons} for i in range(batch)],
             )
         sf10["persons"] = sf10_persons
         del snb10
@@ -455,6 +474,7 @@ def main() -> None:
             "traverse_bfs_batched_qps": round(trav_qps, 3),
             "select_count_batched_qps": round(select_qps, 3),
             "ldbc_is": ldbc_is,
+            "ldbc_ic": ldbc_ic,
             "sf10": sf10,
             "sf100_shape": sf100,
             "degree_skew": skew,

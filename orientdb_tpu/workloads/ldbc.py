@@ -122,3 +122,59 @@ IS_QUERIES: Dict[str, str] = {
 
 def is_query(name: str) -> str:
     return IS_QUERIES[name.upper()]
+
+
+# ---------------------------------------------------------------------------
+# Interactive COMPLEX reads (IC) — the multi-hop half of the SNB
+# interactive workload (BASELINE configs[4]'s "multi-pattern MATCH"
+# shape). Translated to this dialect for the entities the offline
+# generator covers; each stays a single MATCH so the whole workload
+# rides the compiled path.
+# ---------------------------------------------------------------------------
+
+# IC1 (transitive friends by name): friends within 3 knows-hops whose
+# first name matches, nearest first. The var-depth arm emits each
+# reachable person once at its minimum depth.
+IC1 = (
+    "MATCH {class:Person, as:p, where:(id = :personId)}"
+    "-knows-{as:f, while:($depth < 3), "
+    "where:(firstName = :firstName AND id <> :personId), "
+    "depthAlias: dist} "
+    "RETURN f.id AS friendId, f.lastName AS friendLastName, "
+    "dist AS distanceFromPerson "
+    "ORDER BY distanceFromPerson ASC, friendLastName ASC, friendId ASC "
+    "LIMIT 20"
+)
+
+# IC2 (recent messages of friends): a friend's messages before a date,
+# newest first.
+IC2 = (
+    "MATCH {class:Person, as:p, where:(id = :personId)}"
+    "-knows-{as:f}"
+    "<-hasCreator-{as:m, where:(creationDate < :maxDate)} "
+    "RETURN f.id AS personId, f.firstName AS personFirstName, "
+    "f.lastName AS personLastName, m.id AS messageId, "
+    "m.content AS messageContent, m.creationDate AS messageCreationDate "
+    "ORDER BY messageCreationDate DESC, messageId ASC LIMIT 20"
+)
+
+# IC-shaped aggregate: message volume over the friend-of-friend hull —
+# the 3-hop join whose binding table the reference's per-record DFS
+# walks row by row, collapsed here into COUNT pushdown weight passes.
+ICA = (
+    "MATCH {class:Person, as:p, where:(id = :personId)}"
+    "-knows-{as:f}"
+    "-knows-{as:ff, where:(id <> :personId)}"
+    "<-hasCreator-{as:m} "
+    "RETURN count(*) AS messageCount"
+)
+
+IC_QUERIES: Dict[str, str] = {
+    "IC1": IC1,
+    "IC2": IC2,
+    "ICA": ICA,
+}
+
+
+def ic_query(name: str) -> str:
+    return IC_QUERIES[name.upper()]
