@@ -33,8 +33,13 @@ parameter values, not compilation.
 Env knobs: BENCH_PROFILES (default 20000), BENCH_AVG_FRIENDS (10),
 BENCH_BATCH (64), BENCH_ITERS (3 batched iterations), BENCH_SINGLE_ITERS
 (10), BENCH_ORACLE_ITERS (1 — the oracle takes ~13 s per 2-hop query at
-the default size), BENCH_SNB_PERSONS (default 10000; 0 skips the IS
-section).
+the default size), BENCH_SNB_PERSONS (default 10000; 0 skips the IS and
+IC sections), BENCH_SF10_PERSONS (100000; 0 skips), BENCH_SF100_PERSONS
+(8000000 — the array-native SF100-shaped graph; 0 skips),
+BENCH_SKEW_PERSONS (1000000; 0 skips), BENCH_MESH_SCALING (1; 0 skips
+the per-shard-count subprocess probes), BENCH_GATE / --gate <json>
+(regression gate vs a recorded round; tolerance BENCH_GATE_TOL,
+default 0.55 = the measured ±40% tunnel-noise envelope).
 """
 
 import json

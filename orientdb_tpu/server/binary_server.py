@@ -2,11 +2,12 @@
 
 Analog of [E] ONetworkProtocolBinary / OChannelBinaryServer (port 2424,
 SURVEY.md §2 "Binary protocol"): a persistent, session-oriented channel —
-each frame is a 4-byte big-endian length followed by a MessagePack-ish
-compact JSON payload (JSON chosen over a bespoke binary record format: the
-wire cost is dominated by the result rows, and the reference's
-ORecordSerializerNetwork role — one canonical wire encoding — is played by
-`to_dicts` rows).
+each frame is a 4-byte big-endian length followed by a compact JSON
+envelope. Record payloads travel either as JSON dicts (default; blob
+bytes framed as {"@bytes": base64}) or, when the session negotiates
+``serialization: "binary"`` at db_open, as the schema-aware binary
+record format (`server/binser.py` — the ORecordSerializerNetwork
+analog) base85-framed inside the envelope.
 
 Requests: {"op": ..., ...}. Ops: connect, db_list, db_create, db_open,
 query, command, load, save, delete, live_subscribe, live_unsubscribe,
