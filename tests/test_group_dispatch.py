@@ -1,0 +1,147 @@
+"""The vmapped group dispatch (execute_batch collapsing same-plan batch
+items into ONE device Execute): compile lifecycle, per-lane fallback
+while compiling, parity between the fallback and grouped paths, and the
+permanent per-lane sentinel after a doomed compile."""
+
+import pytest
+
+from orientdb_tpu.exec import tpu_engine
+from orientdb_tpu.exec.tpu_engine import drain_warmups
+from orientdb_tpu.storage.ingest import generate_demodb
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+from orientdb_tpu.utils.metrics import metrics
+
+
+SQL = (
+    "MATCH {class:Profiles, as:p, where:(uid = :u)}"
+    "-HasFriend->{as:f} RETURN count(*) AS n"
+)
+
+
+@pytest.fixture()
+def db():
+    d = generate_demodb(n_profiles=800, avg_friends=5, seed=31)
+    attach_fresh_snapshot(d)
+    return d
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+def test_group_compiles_and_matches_per_lane_results(db):
+    plist = [{"u": i * 3} for i in range(12)]
+    want = [
+        db.query(SQL, params=p, engine="oracle").to_dicts() for p in plist
+    ]
+    before = _counter("plan_cache.group_compile")
+    # first batch: plans record; second: per-lane + kicks the group
+    # compile; drain; third: the vmapped executable serves the group
+    for _ in range(2):
+        got = [
+            rs.to_dicts()
+            for rs in db.query_batch(
+                [SQL] * 12, params_list=plist, engine="tpu", strict=True
+            )
+        ]
+        assert got == want
+        drain_warmups()
+    assert _counter("plan_cache.group_compile") > before
+    got = [
+        rs.to_dicts()
+        for rs in db.query_batch(
+            [SQL] * 12, params_list=plist, engine="tpu", strict=True
+        )
+    ]
+    assert got == want, "grouped execution must match the per-lane results"
+
+
+def test_small_groups_stay_per_lane(db):
+    """Below _GROUP_MIN same-plan items, no group executable is built."""
+    sql2 = (
+        "MATCH {class:Profiles, as:p, where:(uid = :u)}"
+        "-Likes->{as:t} RETURN count(*) AS n"
+    )
+    plist = [{"u": i} for i in range(tpu_engine._GROUP_MIN - 1)]
+    db.query_batch(
+        [sql2] * len(plist), params_list=plist, engine="tpu", strict=True
+    )
+    drain_warmups()
+    before = _counter("plan_cache.group_compile")
+    db.query_batch(
+        [sql2] * len(plist), params_list=plist, engine="tpu", strict=True
+    )
+    drain_warmups()
+    assert _counter("plan_cache.group_compile") == before
+
+
+def test_doomed_group_compile_pins_per_lane(db, monkeypatch):
+    """A compile that fails twice writes the permanent False sentinel:
+    no compile retries on later batches, results still correct."""
+    import jax
+
+    def boom(*a, **k):
+        raise RuntimeError("injected vmap failure")
+
+    monkeypatch.setattr(jax, "vmap", boom)
+    plist = [{"u": i * 5} for i in range(8)]
+    want = [
+        db.query(SQL, params=p, engine="oracle").to_dicts() for p in plist
+    ]
+    errs_before = _counter("plan_cache.group_compile_error")
+    for _ in range(3):
+        got = [
+            rs.to_dicts()
+            for rs in db.query_batch(
+                [SQL] * 8, params_list=plist, engine="tpu", strict=True
+            )
+        ]
+        assert got == want
+        drain_warmups()
+    assert _counter("plan_cache.group_compile_error") == errs_before + 1
+    # the sentinel is recorded on the plan: False, not a retry loop
+    snap = db.current_snapshot()
+    plans = [
+        p
+        for v in snap._plan_cache.values()
+        for p in getattr(v, "plans", [])
+        if getattr(p, "_jitted_many", None)
+    ]
+    assert any(
+        fn is False for p in plans for fn in p._jitted_many.values()
+    ), "doomed compile must pin the (plan, bucket) per-lane"
+
+
+def test_no_dyn_plans_share_one_dispatch(db):
+    """Identical no-parameter queries in a batch share a single device
+    dispatch (the k=None lane path) and still all answer."""
+    sql = (
+        "MATCH {class:Profiles, as:p, where:(age > 40)}"
+        "-HasFriend->{as:f, where:(age < 30)} RETURN count(*) AS n"
+    )
+    want = db.query(sql, engine="oracle").to_dicts()
+    db.query_batch([sql] * 8, engine="tpu", strict=True)
+    drain_warmups()
+    # count device dispatches: the whole batch must share ONE
+    dispatches = []
+    snap = db.current_snapshot()
+    plans = [
+        p for v in snap._plan_cache.values() for p in getattr(v, "plans", [])
+    ]
+    originals = [(p, p.dispatch) for p in plans]
+    try:
+        for p, orig in originals:
+            def spy(params=None, _orig=orig, _p=p):
+                dispatches.append(_p)
+                return _orig(params)
+
+            p.dispatch = spy
+        rss = db.query_batch([sql] * 8, engine="tpu", strict=True)
+    finally:
+        for p, orig in originals:
+            p.dispatch = orig
+    assert all(rs.to_dicts() == want for rs in rss)
+    assert len(dispatches) == 1, (
+        f"8 identical no-param queries took {len(dispatches)} dispatches; "
+        "the shared-dispatch (k=None) group path must serve them with one"
+    )
