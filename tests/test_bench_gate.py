@@ -52,6 +52,46 @@ def test_gate_reads_driver_wrapper_format():
     assert regs == [("ldbc_is.IS3", 268.0, 98.0)]
 
 
+def test_gate_covers_round4_metric_families():
+    """The sf10/sf100/skew/IC blocks' *_qps leaves are gated; byte and
+    edge-count companions are not."""
+    def run(ic=300.0, sf10=400.0, sf100=20.0, skew=100.0):
+        return {
+            "value": 500.0,
+            "extras": {
+                "ldbc_ic": {"IC1_qps": ic},
+                "sf10": {"IS3_qps": sf10, "persons": 100000},
+                "sf100_shape": {
+                    "two_hop_count_qps": sf100,
+                    "hbm_bytes": {"per_device_total": 10**9},
+                    "edges": 8 * 10**7,
+                },
+                "degree_skew": {
+                    "supernode_qps": skew,
+                    "supernode_edges": 10**7,
+                },
+            },
+        }
+
+    assert bench.gate_regressions(run(), run()) == []
+    regs = bench.gate_regressions(
+        run(ic=90.0, sf10=100.0, sf100=5.0, skew=20.0), run()
+    )
+    assert {r[0] for r in regs} == {
+        "ldbc_ic.IC1_qps",
+        "sf10.IS3_qps",
+        "sf100_shape.two_hop_count_qps",
+        "degree_skew.supernode_qps",
+    }
+    # shrinking edge counts / byte gauges never gate
+    prev = run()
+    cur = run()
+    cur["extras"]["sf100_shape"]["edges"] = 1
+    cur["extras"]["sf100_shape"]["hbm_bytes"]["per_device_total"] = 1
+    cur["extras"]["degree_skew"]["supernode_edges"] = 1
+    assert bench.gate_regressions(cur, prev) == []
+
+
 def test_gate_ignores_non_qps_and_missing_metrics():
     cur = _run()
     cur["extras"]["batch_size"] = 1  # changed but not a qps metric
