@@ -83,19 +83,33 @@ class WriteOwner:
             {"@class": class_name, "@type": kind, **fields},
         )
 
-    def update(self, rid: RID, fields: Dict, base_version: int) -> Dict:
+    def update(
+        self,
+        rid: RID,
+        fields: Dict,
+        base_version: Optional[int],
+        replace: bool = True,
+    ) -> Dict:
         """MVCC travels with the forward: the owner rejects (409) when
         its stored version differs from the caller's base version —
-        the same ConcurrentModificationError a local save raises."""
+        the same ConcurrentModificationError a local save raises.
+
+        ``replace`` marks the payload as the record's FULL field set
+        (the ``_forward_save`` case): the owner clears fields absent
+        from it, so ``remove_field()`` + ``save()`` on a non-owner
+        propagates the removal instead of silently resurrecting the
+        field (local save semantics). Chain-forwards of partial REST
+        updates pass ``replace=False``."""
         metrics.incr("forwarding.update")
         # the '#' in a RID would otherwise parse as a URL fragment
         q = urllib.parse.quote(str(rid), safe="")
+        body = dict(fields)
+        if base_version is not None:
+            body["@base_version"] = base_version
+        if replace:
+            body["@replace"] = True
         try:
-            return self._req(
-                "PUT",
-                f"/document/{self.dbname}/{q}",
-                {"@base_version": base_version, **fields},
-            )
+            return self._req("PUT", f"/document/{self.dbname}/{q}", body)
         except urllib.error.HTTPError as e:
             if e.code == 409:
                 from orientdb_tpu.models.database import (

@@ -58,8 +58,21 @@ class QuorumError(Exception):
     surfaces ODistributedOperationException the same way)."""
 
 
+def _replica_is_fresh(db: Database, floor: int) -> bool:
+    """True when the replica database has never applied anything — the
+    only state a full-sync checkpoint restore is safe to land on."""
+    return (
+        db.mutation_epoch == 0
+        and floor == 0
+        and len(db.schema.classes()) == 2  # just the V/E roots
+    )
+
+
 def apply_pushed_entries(
-    db: Database, entries: List[Dict], term: Optional[int] = None
+    db: Database,
+    entries: List[Dict],
+    term: Optional[int] = None,
+    checkpoint: Optional[Dict] = None,
 ) -> int:
     """Replica-side apply for quorum-pushed entries; returns the applied
     LSN floor AFTER the batch.
@@ -72,7 +85,15 @@ def apply_pushed_entries(
     prefix through this LSN", the property quorum counting relies on.
     ``term`` fences stale primaries: pushes carrying a term below the
     replica's current one are refused outright (a partitioned
-    predecessor keeps "succeeding" locally but can never ack here)."""
+    predecessor keeps "succeeding" locally but can never ack here).
+
+    ``checkpoint`` is the push-side full-sync path ([E] the reference's
+    full database sync shipped as a distributed task): when the primary's
+    delta range below the pushed entry is gone (late-armed source), a
+    FRESH replica restores the checkpoint — so a quorum push can bring a
+    still-empty replica fully up to date synchronously instead of
+    waiting a pull interval. A non-fresh replica refuses it (restoring
+    over applied state would lose writes) and stays puller territory."""
     dblock = db.__dict__.setdefault("_repl_lock", threading.Lock())
     with dblock:
         if term is not None:
@@ -81,6 +102,18 @@ def apply_pushed_entries(
                 return -1  # fenced: never an ack
             db._repl_term = term
         floor = getattr(db, "_repl_applied_lsn", 0)
+        if checkpoint is not None and _replica_is_fresh(db, floor):
+            from orientdb_tpu.storage.durability import restore_payload
+
+            restore_payload(db, checkpoint)
+            floor = checkpoint.get("lsn", 0)
+            db._repl_applied_lsn = floor
+            # lineage marker: drives the puller's exact=1 pull param —
+            # the source then serves deltas from this LSN instead of
+            # re-offering the base checkpoint (restore_payload is
+            # additive, so restoring twice is never safe)
+            db._repl_restored_ckpt_lsn = floor
+            metrics.incr("replication.full_sync")
         for e in entries:
             lsn = e["lsn"]
             if lsn <= floor:
@@ -132,12 +165,18 @@ class QuorumPusher:
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=8)
+        #: url -> monotonic time of the last REFUSED checkpoint ship:
+        #: a non-fresh replica refuses restores, so don't serialize and
+        #: ship a full database at it on every subsequent write
+        self._ckpt_refused: Dict[str, float] = {}
 
-    def _post(self, url: str, entries: List[Dict]) -> int:
+    def _post(self, url: str, entries: List[Dict], **extra) -> int:
         cred = base64.b64encode(
             f"{self.user}:{self.password}".encode()
         ).decode()
-        body = json.dumps({"entries": entries, "term": self.term}).encode()
+        body = json.dumps(
+            {"entries": entries, "term": self.term, **extra}
+        ).encode()
         req = urllib.request.Request(
             f"{url}/replication/{self.dbname}/apply",
             data=body,
@@ -159,11 +198,33 @@ class QuorumPusher:
         # the replica is mid-catch-up (its puller hasn't closed the gap
         # below this entry yet): backfill the missing range from the
         # primary's WAL and retry once — steady-state pushes then ack
-        # without waiting a pull interval
-        payload = entries_after(self.source_db, floor)
+        # without waiting a pull interval. A checkpoint (full sync) is
+        # offered only to a replica that could restore it — floor == 0
+        # and not recently refusing — decided BEFORE entries_after so the
+        # O(database) checkpoint serialization under the primary's
+        # db._lock never runs just to be discarded.
+        import time as _time
+
+        t = self._ckpt_refused.get(url)
+        want_ckpt = floor == 0 and (
+            t is None or _time.monotonic() - t >= 2.0
+        )
+        payload = entries_after(self.source_db, floor, checkpoint_ok=want_ckpt)
+        if payload.get("checkpoint_needed"):
+            return False  # replica can't take a checkpoint: puller territory
+        if "checkpoint" in payload:
+            # delta range gone (late-armed source): ship it — a FRESH
+            # replica restores synchronously and the push acks without
+            # waiting a pull interval; a refusal starts the cool-down
+            ok = self._post(url, [], checkpoint=payload["checkpoint"]) >= lsn
+            if ok:
+                self._ckpt_refused.pop(url, None)
+            else:
+                self._ckpt_refused[url] = _time.monotonic()
+            return ok
         fill = [e for e in payload.get("entries", ()) if e["lsn"] <= lsn]
         if not fill:
-            return False  # range gone (checkpoint case): puller territory
+            return False  # range gone: puller territory
         return self._post(url, fill) >= lsn
 
     def replicate(self, entry: Dict) -> int:
@@ -223,7 +284,13 @@ def enable_replication_source(db: Database) -> None:
         enable_durability(db, d, fsync=False)
 
 
-def entries_after(db: Database, from_lsn: int, limit: int = 10_000) -> Dict:
+def entries_after(
+    db: Database,
+    from_lsn: int,
+    limit: int = 10_000,
+    exact_ok: bool = False,
+    checkpoint_ok: bool = True,
+) -> Dict:
     """The shipping payload: WAL entries with lsn > from_lsn.
 
     When the requested range is no longer available — the source was
@@ -231,7 +298,12 @@ def entries_after(db: Database, from_lsn: int, limit: int = 10_000) -> Dict:
     archives — the response carries a full CHECKPOINT payload instead
     (the [E] full-sync path): the replica restores it and resumes delta
     pulls from its LSN. Archived segments whose name-encoded max LSN is
-    ≤ from_lsn are skipped without parsing."""
+    ≤ from_lsn are skipped without parsing.
+
+    ``exact_ok=True`` is the replica's assertion that it holds the
+    source's state as of ``from_lsn`` EXACTLY (it restored this source's
+    checkpoint at that LSN) — so the non-empty-base marker must not
+    force a second checkpoint; deltas continue from there."""
     if db._wal is None:
         return {"entries": [], "lsn": 0}
     import os
@@ -265,11 +337,17 @@ def entries_after(db: Database, from_lsn: int, limit: int = 10_000) -> Dict:
         from_lsn < base_lsn
         or (
             from_lsn == base_lsn
+            and not exact_ok
             and not getattr(db, "_wal_base_exact_ok", False)
         )
     )
     available_from = entries[0]["lsn"] if entries else db._wal.next_lsn
     if needs_base or from_lsn + 1 < available_from:
+        if not checkpoint_ok:
+            # the caller would discard a checkpoint (push backfill to a
+            # replica that can't restore one): answer WITHOUT paying the
+            # O(database) serialization under db._lock
+            return {"entries": [], "lsn": from_lsn, "checkpoint_needed": True}
         from orientdb_tpu.storage.durability import _checkpoint_payload
 
         with db._lock:
@@ -344,11 +422,28 @@ class ReplicaPuller:
 
     def pull_once(self) -> int:
         """One delta pull; returns the number of applied entries."""
+        # sync the cursor with the db-level floor first: a quorum push
+        # (possibly a push-side full sync) may have advanced the database
+        # past this puller's last pull — requesting from the stale cursor
+        # would refetch the range, or worse demand a second checkpoint a
+        # no-longer-fresh replica must refuse (ReplicationGap)
+        self.applied_lsn = max(
+            self.applied_lsn, getattr(self.db, "_repl_applied_lsn", 0)
+        )
         cred = base64.b64encode(
             f"{self.user}:{self.password}".encode()
         ).decode()
+        # exact=1: we restored this source's checkpoint, so our cursor
+        # LSN denotes exactly-held state — the source must serve deltas,
+        # never a second base checkpoint
+        exact = (
+            "?exact=1"
+            if getattr(self.db, "_repl_restored_ckpt_lsn", None) is not None
+            else ""
+        )
         req = urllib.request.Request(
-            f"{self.source_url}/replication/{self.dbname}/{self.applied_lsn}",
+            f"{self.source_url}/replication/{self.dbname}/"
+            f"{self.applied_lsn}{exact}",
             headers={"Authorization": f"Basic {cred}"},
         )
         with urllib.request.urlopen(req, timeout=5) as r:
@@ -372,20 +467,39 @@ class ReplicaPuller:
                 # pruned archives) — restore the shipped checkpoint
                 from orientdb_tpu.storage.durability import restore_payload
 
-                fresh = (
-                    self.db.mutation_epoch == 0
-                    and self.applied_lsn == 0
-                    and len(self.db.schema.classes()) == 2  # just V/E roots
+                floor = max(
+                    self.applied_lsn,
+                    getattr(self.db, "_repl_applied_lsn", 0),
                 )
-                if not fresh:
+                ckpt_lsn = payload["checkpoint"].get("lsn", payload["lsn"])
+                restored = getattr(self.db, "_repl_restored_ckpt_lsn", None)
+                if 0 < ckpt_lsn <= floor or (ckpt_lsn == 0 and floor > 0):
+                    # a quorum push (possibly a push-side full sync)
+                    # overtook this pull between fetch and apply — the
+                    # replica already holds the range. (floor == 0 with a
+                    # lsn-0 checkpoint means the OPPOSITE: a late-armed
+                    # source whose base content we don't hold.)
+                    self.applied_lsn = floor
+                    return 0
+                if restored is not None and ckpt_lsn <= restored:
+                    # raced base state we already restored (the exact=1
+                    # request and this response crossed): in sync
+                    return 0
+                if not _replica_is_fresh(self.db, floor):
+                    # restore_payload is additive (indexes crash on
+                    # re-create, deletions would survive): restoring
+                    # over applied state is never safe — gaps on a
+                    # non-fresh replica need a fresh resync
                     raise ReplicationGap(
                         "source lost the delta range past applied_lsn="
                         f"{self.applied_lsn}; full resync needs a FRESH "
                         "replica database"
                     )
                 restore_payload(self.db, payload["checkpoint"])
-                self.applied_lsn = payload["checkpoint"].get("lsn", payload["lsn"])
+                self.applied_lsn = ckpt_lsn
                 self.db._repl_applied_lsn = self.applied_lsn
+                # lineage marker: drives the exact=1 pull param above
+                self.db._repl_restored_ckpt_lsn = ckpt_lsn
                 metrics.incr("replication.full_sync")
                 return 1
             floor = max(

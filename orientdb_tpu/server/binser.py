@@ -82,7 +82,9 @@ def read_varint(data: bytes, pos: int) -> Tuple[int, int]:
 
 
 def zigzag(n: int) -> int:
-    return (n << 1) ^ (n >> 63) if n >= 0 else ((-n) << 1) - 1
+    # Python ints are arbitrary-precision: the fixed-width (n >> 63)
+    # trick would corrupt values >= 2**63, so map sign explicitly.
+    return (n << 1) if n >= 0 else ((-n) << 1) - 1
 
 
 def unzigzag(n: int) -> int:

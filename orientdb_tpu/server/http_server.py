@@ -23,6 +23,7 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import urllib.error
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -156,7 +157,18 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 from orientdb_tpu.parallel.replication import entries_after
 
-                return self._send(200, entries_after(db, int(rest[1])))
+                # exact=1: the replica asserts it holds state-as-of the
+                # requested LSN exactly (it restored our checkpoint), so
+                # a base-state checkpoint must not be re-served
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                return self._send(
+                    200,
+                    entries_after(
+                        db, int(rest[1]), exact_ok="exact" in q
+                    ),
+                )
             if head == "database" and rest:
                 db = self._db(rest[0])
                 if db is None:
@@ -257,7 +269,10 @@ class _Handler(BaseHTTPRequestHandler):
 
                 payload = json.loads(self._body() or b"{}")
                 floor = apply_pushed_entries(
-                    db, payload.get("entries", ()), payload.get("term")
+                    db,
+                    payload.get("entries", ()),
+                    payload.get("term"),
+                    checkpoint=payload.get("checkpoint"),
                 )
                 return self._send(200, {"applied_lsn": floor})
             if head == "document" and len(rest) == 1:
@@ -328,29 +343,113 @@ class _Handler(BaseHTTPRequestHandler):
                 if db is None:
                     return
                 self.server.ot_server.security.check(user, RES_RECORD, "update")
-                doc = db.load(RID.parse(rest[1]))
-                if doc is None:
-                    return self._error(404, f"record {rest[1]} not found")
                 payload = json.loads(self._body() or b"{}")
                 base = payload.get("@base_version")
-                if base is not None and int(base) != doc.version:
-                    # forwarded saves carry their base version: MVCC must
-                    # hold across the forward exactly as it does locally
-                    return self._error(
-                        409,
-                        f"{doc.rid}: stored v{doc.version} != base v{base}",
-                    )
                 from orientdb_tpu.storage.durability import _dec
 
-                for k, v in payload.items():
-                    if not k.startswith("@"):
-                        doc.set(k, _dec(v))
-                db.save(doc)
-                return self._send(200, _doc_json(doc))
+                if db._write_owner is not None:
+                    # this node was demoted after the forwarder read its
+                    # (now stale) ownership map: chain-forward to the
+                    # real owner WITHOUT touching the local store and
+                    # without holding db._lock across the network call
+                    fields = {
+                        k: _dec(v)
+                        for k, v in payload.items()
+                        if not k.startswith("@")
+                    }
+                    resp = db._write_owner.update(
+                        RID.parse(rest[1]),
+                        fields,
+                        base_version=int(base) if base is not None else None,
+                        replace=bool(payload.get("@replace")),
+                    )
+                    return self._send(200, resp)
+                # Version check, field mutation, and save form ONE MVCC
+                # critical section: two racing forwarded updates with the
+                # same base version must resolve exactly like two racing
+                # local saves (one wins, one 409s). _quorum_deferral sits
+                # OUTSIDE the lock so replica pushes still flush after it
+                # is released.
+                with db._quorum_deferral():
+                    with db._lock:
+                        doc = db.load(RID.parse(rest[1]))
+                        if doc is None:
+                            return self._error(
+                                404, f"record {rest[1]} not found"
+                            )
+                        if base is not None and int(base) != doc.version:
+                            # forwarded saves carry their base version:
+                            # MVCC must hold across the forward exactly
+                            # as it does locally
+                            return self._error(
+                                409,
+                                f"{doc.rid}: stored v{doc.version}"
+                                f" != base v{base}",
+                            )
+                        # mutate the LIVE stored object only with a way
+                        # back: a failed save (mandatory/unique/hook
+                        # violation) must not leave the owner's record
+                        # torn with no version bump or WAL entry. The
+                        # undo applies ONLY when the save did not take
+                        # effect (mutation_epoch unmoved — it bumps
+                        # right before the WAL append): after the WAL
+                        # has the entry, reverting the live record
+                        # would diverge it from its own durable log,
+                        # so the error propagates over the new state
+                        # exactly like a local save whose after-hook
+                        # raised.
+                        undo_fields = doc.fields()
+                        undo_version = doc.version
+                        epoch0 = db.mutation_epoch
+                        try:
+                            if payload.get("@replace"):
+                                # forwarded full save: fields absent from
+                                # the payload were removed at the
+                                # forwarder — clear them so
+                                # remove_field() propagates
+                                sent = {
+                                    k
+                                    for k in payload
+                                    if not k.startswith("@")
+                                }
+                                for k in list(doc.fields()):
+                                    if k not in sent:
+                                        doc.remove_field(k)
+                            for k, v in payload.items():
+                                if not k.startswith("@"):
+                                    doc.set(k, _dec(v))
+                            db.save(doc)
+                        except Exception:
+                            if db.mutation_epoch == epoch0:
+                                doc._fields = undo_fields
+                                doc.version = undo_version
+                            raise
+                        # serialize INSIDE the critical section: after
+                        # the lock drops a later writer could bump the
+                        # shared object and the forwarder would adopt
+                        # that version number over ITS OWN field values
+                        body = _doc_json(doc)
+                return self._send(200, body)
             return self._error(404, f"no route for PUT /{head}")
         except SecurityError as e:
             return self._error(403, str(e))
         except Exception as e:
+            # MVCC conflicts keep their status across a chain-forward:
+            # the originating forwarder translates 409 back into
+            # ConcurrentModificationError for its caller — a generic 500
+            # would break retry-with-fresh-version loops during the
+            # demotion window. Other owner-side HTTP errors (e.g. 404)
+            # pass their code through for the same reason.
+            from orientdb_tpu.models.database import (
+                ConcurrentModificationError,
+            )
+
+            if isinstance(e, ConcurrentModificationError):
+                return self._error(409, str(e))
+            if isinstance(e, urllib.error.HTTPError):
+                return self._error(
+                    e.code, e.read().decode(errors="replace") or str(e)
+                )
             return self._error(500, f"{type(e).__name__}: {e}")
 
     def do_DELETE(self):  # noqa: N802

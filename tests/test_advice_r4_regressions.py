@@ -1,0 +1,118 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4).
+
+1. binser zigzag corrupted ints >= 2**63 (fixed-width trick on
+   arbitrary-precision Python ints).
+2. forwarded remove_field()+save() silently resurrected the field on
+   the owner (PUT only set present keys).
+3. the HTTP PUT @base_version MVCC check was not atomic with the save:
+   two racing forwarded updates with the same base version could both
+   commit instead of one getting the 409.
+(The fourth finding — the test_write_forwarding shutdown barrier — is
+fixed in tests/test_write_forwarding.py itself.)
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from orientdb_tpu.server.server import Server
+
+
+# -- 1. zigzag on arbitrary-precision ints ----------------------------------
+
+
+class TestZigzagBigInts:
+    def test_round_trip_beyond_64_bits(self):
+        from orientdb_tpu.server.binser import unzigzag, zigzag
+
+        for n in (
+            0,
+            1,
+            -1,
+            2**62,
+            2**63 - 1,
+            2**63,  # the advisor's corrupting case
+            2**63 + 1,
+            2**100,
+            -(2**63),
+            -(2**100),
+        ):
+            assert unzigzag(zigzag(n)) == n, n
+
+    def test_record_round_trip_with_huge_int(self):
+        from orientdb_tpu.models.record import Document
+        from orientdb_tpu.server.binser import decode_record, encode_record
+
+        doc = Document("O", {"big": 2**63, "neg": -(2**70)})
+        fields = decode_record(encode_record(doc))
+        assert fields["big"] == 2**63
+        assert fields["neg"] == -(2**70)
+
+
+# -- 2 & 3. forwarded-PUT semantics over the real HTTP surface ---------------
+
+
+@pytest.fixture()
+def owner_server():
+    srv = Server(admin_password="pw")
+    srv.startup()
+    db = srv.create_database("adv")
+    db.schema.create_vertex_class("P")
+    yield srv, db
+    srv.shutdown()
+
+
+def _owner(srv):
+    from orientdb_tpu.parallel.forwarding import WriteOwner
+
+    return WriteOwner(
+        f"http://127.0.0.1:{srv.http_port}", "adv", "admin", "pw"
+    )
+
+
+class TestForwardedFieldRemoval:
+    def test_forwarded_update_propagates_field_removal(self, owner_server):
+        srv, db = owner_server
+        v = db.new_vertex("P", uid=1, stale="drop-me", keep="ok")
+        fwd = _owner(srv)
+        # simulate the non-owner's save after remove_field("stale"):
+        # the forwarded payload is the FULL remaining field set
+        fields = v.fields()
+        fields.pop("stale")
+        fwd.update(v.rid, fields, base_version=v.version)
+        cur = db.load(v.rid)
+        assert not cur.has("stale"), "removed field resurrected on owner"
+        assert cur["keep"] == "ok" and cur["uid"] == 1
+
+
+class TestForwardedMvccAtomicity:
+    def test_racing_same_base_version_updates_one_409s(self, owner_server):
+        srv, db = owner_server
+        v = db.new_vertex("P", uid=1, n=0)
+        base = v.version
+        fwd = _owner(srv)
+        from orientdb_tpu.models.database import ConcurrentModificationError
+
+        results = []
+        barrier = threading.Barrier(2)
+
+        def racer(val):
+            barrier.wait()
+            try:
+                fwd.update(v.rid, {"uid": 1, "n": val}, base_version=base)
+                results.append(("ok", val))
+            except ConcurrentModificationError:
+                results.append(("409", val))
+
+        ts = [threading.Thread(target=racer, args=(i,)) for i in (1, 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        assert sorted(r[0] for r in results) == ["409", "ok"], results
+        winner = next(val for tag, val in results if tag == "ok")
+        assert db.load(v.rid)["n"] == winner

@@ -176,3 +176,29 @@ class TestReplication:
         assert len(page["entries"]) == 2
         rest = entries_after(db, page["lsn"])
         assert len(rest["entries"]) >= 3
+
+    def test_quiet_late_armed_source_does_not_gap_after_restore(self):
+        """Review-fix regression (r5): a fresh replica that restored a
+        QUIET late-armed source's lsn-0 checkpoint is in sync — further
+        pulls must be no-ops, not ReplicationGap; and once the source
+        writes, the replica converges via a newer-checkpoint restore
+        (same lineage), never gapping."""
+        srv = Server(admin_password="pw")
+        db = srv.create_database("d")
+        db.schema.create_vertex_class("P")
+        db.new_vertex("P", n=0)  # pre-WAL: forces checkpoint responses
+        enable_replication_source(db)
+        srv.startup()
+        try:
+            rep = _puller(srv)
+            assert rep.pull_once() == 1  # base restore (ckpt lsn 0)
+            assert rep.db.count_class("P") == 1
+            # quiet source: no new LSNs — pulls are clean no-ops
+            for _ in range(3):
+                assert rep.pull_once() == 0
+            # source writes: the once-restored replica converges
+            db.new_vertex("P", n=1)
+            assert rep.pull_once() >= 1
+            assert rep.db.count_class("P") == 2
+        finally:
+            srv.shutdown()

@@ -123,6 +123,16 @@ def test_ownership_map_and_promotion_clears_forwarding(trio):
     cl, servers, pdb = trio
     own = cl.ownership()
     assert own.get("P") == "n0" and own.get("L") == "n0"
+    # barrier: the fixture's P/L DDL predates the replicas joining, so it
+    # was never quorum-gated — wait for every member to have pulled it
+    # before killing the primary, else the successor is legitimately
+    # promoted at an LSN below the DDL and owns neither class
+    assert wait_for(
+        lambda: all(
+            {"P", "L"} <= {c.name for c in m.db.schema.classes()}
+            for m in cl.members.values()
+        )
+    )
     servers[0].shutdown()
     assert wait_for(lambda: cl.status()["primary"] in ("n1", "n2"))
     new_name = cl.status()["primary"]
