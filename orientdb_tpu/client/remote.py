@@ -53,12 +53,17 @@ class RemoteDatabase:
         user: str,
         password: str,
         serialization: str = "json",
+        pipeline: bool = False,
     ) -> None:
         self.host, self.port, self.name = host, port, name
         self._user, self._password = user, password
         #: record-payload wire encoding: "json" or "binary" (the
         #: schema-aware binary record format, server/binser.py)
         self.serialization = serialization
+        #: pipeline mode: the server dispatches this session's query ops
+        #: on a worker pool and responds out-of-order by reqid, so
+        #: query_pipeline() keeps many singles in flight at once
+        self.pipeline = pipeline
         self._lock = threading.Lock()
         #: per-response wait in demultiplexed mode (tests shrink it)
         self._call_timeout = 30.0
@@ -97,6 +102,7 @@ class RemoteDatabase:
                     "op": "db_open",
                     "name": self.name,
                     "serialization": self.serialization,
+                    "pipeline": self.pipeline,
                 }
             )
             if not resp.get("ok"):
@@ -230,6 +236,140 @@ class RemoteDatabase:
         r = self._checked({"op": "command", "sql": sql, "params": params})
         return RemoteResultSet(r["result"], r.get("engine"))
 
+    def query_batch(
+        self, sqls: List[str], params_list: Optional[List] = None
+    ) -> List[RemoteResultSet]:
+        """N idempotent statements in ONE wire frame, executed through
+        the server's group dispatch — the remote mirror of the embedded
+        ``db.query_batch``. Raises RemoteError if any member failed."""
+        r = self._checked(
+            {"op": "query_batch", "sqls": sqls, "params_list": params_list}
+        )
+        out = []
+        errors = []
+        for i, item in enumerate(r["results"]):
+            if "error" in item:
+                errors.append(f"[{i}] {item['error']}")
+                out.append(None)
+            else:
+                out.append(
+                    RemoteResultSet(item["result"], item.get("engine"))
+                )
+        if errors:
+            raise RemoteError(
+                f"{len(errors)} of {len(sqls)} batch member(s) failed: "
+                + "; ".join(errors[:3])
+            )
+        return out
+
+    def _recv_with_deadline(self, deadline: float) -> dict:
+        """One response frame within the overall deadline, from either
+        the demux queue or the raw socket; raises RemoteConnectionError
+        on timeout or loss. Shared by query_pipeline's drain loop."""
+        import time as _time
+
+        left = deadline - _time.monotonic()
+        if left <= 0:
+            raise RemoteConnectionError("response timeout")
+        if self._resp_q is not None:
+            import queue
+
+            try:
+                resp = self._resp_q.get(timeout=left)
+            except queue.Empty:
+                raise RemoteConnectionError("response timeout")
+        else:
+            # the overall deadline bounds EACH recv too — without this
+            # the socket's own 30s timeout applies per frame (N x 30s
+            # worst case for a pipeline of N)
+            self._sock.settimeout(left)
+            try:
+                resp = recv_frame(self._sock)
+            except socket.timeout:
+                raise RemoteConnectionError("response timeout")
+            finally:
+                try:
+                    self._sock.settimeout(30)
+                except OSError:
+                    pass
+        if resp is None:
+            raise RemoteConnectionError("connection lost")
+        return resp
+
+    def query_pipeline(
+        self, sqls: List[str], params_list: Optional[List] = None
+    ) -> List[RemoteResultSet]:
+        """Send every query before reading any response (requires
+        ``pipeline=True`` at connect for out-of-order server dispatch —
+        in-flight singles then coalesce server-side). Responses are
+        matched by reqid and returned in request order."""
+        if params_list is None:
+            params_list = [None] * len(sqls)
+        if len(params_list) != len(sqls):
+            raise ValueError("params_list length must match sqls length")
+        with self._lock:
+            if self._sock is None:
+                raise RemoteConnectionError("connection closed")
+            want: Dict[int, int] = {}  # reqid -> position
+            try:
+                for i, (sql, p) in enumerate(zip(sqls, params_list)):
+                    self._reqid += 1
+                    want[self._reqid] = i
+                    send_frame(
+                        self._sock,
+                        {
+                            "op": "query",
+                            "sql": sql,
+                            "params": p,
+                            "reqid": self._reqid,
+                        },
+                    )
+                out: List[Optional[RemoteResultSet]] = [None] * len(sqls)
+                errors: List[str] = []
+                got = 0
+                import time as _time
+
+                deadline = _time.monotonic() + self._call_timeout
+                # EVERY in-flight reply is drained before a server error
+                # is raised: leaving unread frames on the socket would
+                # desynchronize the channel for the next plain _call
+                # (which would dequeue a stale pipeline reply as its
+                # own response)
+                while got < len(sqls):
+                    resp = self._recv_with_deadline(deadline)
+                    pos = want.pop(resp.get("reqid"), None)
+                    if pos is None:
+                        continue  # stale reply from an earlier timeout
+                    got += 1
+                    if not resp.get("ok"):
+                        errors.append(
+                            f"[{pos}] {resp.get('error', 'request failed')}"
+                        )
+                    else:
+                        out[pos] = RemoteResultSet(
+                            resp["result"], resp.get("engine")
+                        )
+                if errors:
+                    raise RemoteError(
+                        f"{len(errors)} of {len(sqls)} pipelined "
+                        "quer(ies) failed: " + "; ".join(errors[:3])
+                    )
+                return out  # type: ignore[return-value]
+            except OSError as e:
+                raise RemoteConnectionError(str(e)) from e
+            except RemoteConnectionError:
+                # a timeout/loss mid-drain leaves unknown frames in
+                # flight: the channel cannot be trusted for the next
+                # call (a bare recv would return a stale reply as its
+                # own response) — invalidate it; FailoverDatabase or
+                # the caller reconnects
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise
+
     @staticmethod
     def _record_from(resp: dict) -> Optional[dict]:
         if "record_b85" in resp:  # binary-serialization session
@@ -304,10 +444,12 @@ class FailoverDatabase:
         user: str,
         password: str,
         serialization: str = "json",
+        pipeline: bool = False,
     ) -> None:
         self._addrs = list(addrs)
         self._name, self._user, self._password = name, user, password
         self._serialization = serialization
+        self._pipeline = pipeline
         self._db: Optional[RemoteDatabase] = None
         self._lock = threading.Lock()
         self._connect_any()
@@ -323,6 +465,7 @@ class FailoverDatabase:
                 self._db = RemoteDatabase(
                     h, p, self._name, self._user, self._password,
                     serialization=self._serialization,
+                    pipeline=self._pipeline,
                 )
                 # rotate: the reachable server becomes the head
                 self._addrs = self._addrs[i:] + self._addrs[:i]
@@ -363,6 +506,12 @@ class FailoverDatabase:
 
     def query(self, sql, params=None):
         return self._retry("query", sql, params)
+
+    def query_batch(self, sqls, params_list=None):
+        return self._retry("query_batch", sqls, params_list)
+
+    def query_pipeline(self, sqls, params_list=None):
+        return self._retry("query_pipeline", sqls, params_list)
 
     def command(self, sql, params=None):
         return self._retry("command", sql, params, idempotent=False)
@@ -416,11 +565,20 @@ def _parse_addrs(hostports: str):
     return out
 
 
-def connect(url: str, user: str, password: str, serialization: str = "json"):
+def connect(
+    url: str,
+    user: str,
+    password: str,
+    serialization: str = "json",
+    pipeline: bool = False,
+):
     """`remote:<host>:<port>/<database>` ([E] the remote: URL scheme);
     `remote:h1:p1;h2:p2/<database>` returns a failover client.
     ``serialization="binary"`` negotiates the schema-aware binary record
-    format for record payloads (server/binser.py)."""
+    format for record payloads (server/binser.py).
+    ``pipeline=True`` enables out-of-order server dispatch so
+    ``query_pipeline()`` keeps many singles in flight (they coalesce
+    into batched device dispatches server-side)."""
     if not url.startswith("remote:"):
         raise ValueError(f"not a remote: url: {url!r}")
     rest = url[len("remote:") :]
@@ -428,9 +586,10 @@ def connect(url: str, user: str, password: str, serialization: str = "json"):
     addrs = _parse_addrs(hostport)
     if len(addrs) > 1:
         return FailoverDatabase(
-            addrs, name, user, password, serialization=serialization
+            addrs, name, user, password, serialization=serialization,
+            pipeline=pipeline,
         )
     return RemoteDatabase(
         addrs[0][0], addrs[0][1], name, user, password,
-        serialization=serialization,
+        serialization=serialization, pipeline=pipeline,
     )

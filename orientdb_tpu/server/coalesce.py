@@ -1,0 +1,221 @@
+"""Cross-session query coalescing — the server-side group path.
+
+The round-4 measurement story: the compiled engine's batched dispatch
+(`exec/engine.execute_query_batch` → `tpu_engine.dispatch_many`) runs
+~60× faster per query than lone dispatches, but only the embedded
+Python API could reach it — every remote session's query paid a full
+device round trip alone ([E] the reference has no such gap because its
+server IS its wire path, SURVEY.md §3.2 ``ONetworkProtocolBinary``).
+
+This module closes it with a **group-commit scheduler** per database:
+
+- sessions submit single queries and block on a per-item event;
+- one worker thread per database drains EVERYTHING queued and executes
+  it as one `execute_query_batch` call — so while a batch is on the
+  device, the next batch forms behind it (the WAL group-commit shape,
+  `native/walappend.cpp`, applied to reads);
+- a lone client therefore pays ~zero extra latency (its item is
+  drained immediately), while N concurrent sessions' singles ride ONE
+  device dispatch — throughput scales with offered load instead of
+  serializing on the tunnel RTT.
+
+An optional collection window (``OTPU_COALESCE_WINDOW_MS``, default 0)
+adds a fixed wait before each drain for workloads where arrivals are
+sparser than device time; the default relies on natural batching.
+
+Per-item isolation: statements that cannot ride a batch (non-idempotent,
+EXPLAIN, parse errors) execute directly on the submitting thread, and a
+batch-level failure falls back to per-item execution so one bad query
+cannot poison its cohort's results.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("coalesce")
+
+
+class _Item:
+    __slots__ = ("sql", "params", "event", "rows", "engine", "error")
+
+    def __init__(self, sql: str, params) -> None:
+        self.sql = sql
+        self.params = params
+        self.event = threading.Event()
+        self.rows: Optional[List[dict]] = None
+        self.engine: Optional[str] = None
+        self.error: Optional[Exception] = None
+
+
+class _DbWorker:
+    """One group-commit loop per database."""
+
+    def __init__(self, db, window_s: float) -> None:
+        self.db = db
+        self.window_s = window_s
+        self._cond = threading.Condition()
+        self._pending: List[_Item] = []
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"coalesce-{db.name}", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, item: _Item) -> bool:
+        """False when the worker is stopping — the item was NOT queued
+        (callers fall back to direct execution): an append after the
+        final drain would park the session until its timeout."""
+        with self._cond:
+            if self._stop:
+                return False
+            self._pending.append(item)
+            self._cond.notify()
+            return True
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait()
+                if self._stop:
+                    batch, self._pending = self._pending, []
+                else:
+                    if self.window_s > 0.0:
+                        # optional fixed collection window (arrivals
+                        # sparser than device time): release the lock so
+                        # followers can queue during the wait
+                        self._cond.wait(self.window_s)
+                    batch, self._pending = self._pending, []
+            if batch:
+                self._execute(batch)
+            if self._stop:
+                return
+
+    def _execute(self, batch: List[_Item]) -> None:
+        from orientdb_tpu.exec.engine import execute_query_batch
+
+        metrics.incr("coalesce.batches")
+        metrics.incr("coalesce.items", len(batch))
+        if len(batch) > 1:
+            metrics.incr("coalesce.grouped", len(batch))
+        try:
+            results = execute_query_batch(
+                self.db,
+                [i.sql for i in batch],
+                [i.params for i in batch],
+            )
+            for item, rs in zip(batch, results):
+                item.rows = rs.to_dicts()
+                item.engine = rs.engine
+        except Exception:
+            # batch-level failure (one member's error classes the whole
+            # call): re-run per item so each session gets ITS error and
+            # the innocent members still get results
+            metrics.incr("coalesce.batch_fallback")
+            for item in batch:
+                try:
+                    rs = self.db.query(item.sql, item.params)
+                    item.rows = rs.to_dicts()
+                    item.engine = rs.engine
+                except Exception as e:
+                    item.error = e
+        finally:
+            for item in batch:
+                item.event.set()
+
+
+class QueryCoalescer:
+    """Server-wide registry of per-database group-commit workers."""
+
+    def __init__(self, window_ms: Optional[float] = None) -> None:
+        if window_ms is None:
+            window_ms = float(os.environ.get("OTPU_COALESCE_WINDOW_MS", "0"))
+        self.window_s = window_ms / 1000.0
+        self._workers: Dict[int, _DbWorker] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+        # evicted databases, held WEAKLY: a submit racing evict() must
+        # not resurrect a worker for a dropped db (which would pin it
+        # forever), and weak refs mean an id() reused after GC cannot
+        # false-positive — the tombstone dies with the object
+        import weakref
+
+        self._evicted = weakref.WeakSet()
+
+    def _worker(self, db) -> Optional[_DbWorker]:
+        key = id(db)
+        w = self._workers.get(key)
+        if w is None:
+            with self._lock:
+                if self._stopped or db in self._evicted:
+                    return None  # shutdown/evict raced this: go direct
+                w = self._workers.get(key)
+                if w is None:
+                    w = self._workers[key] = _DbWorker(db, self.window_s)
+        return w
+
+    def evict(self, db) -> None:
+        """Stop and drop the database's worker (drop_database /
+        attach-replace): the worker thread and its strong db reference
+        must not outlive the database's registration."""
+        with self._lock:
+            self._evicted.add(db)
+            w = self._workers.pop(id(db), None)
+        if w is not None:
+            w.stop()
+
+    @staticmethod
+    def _coalescable(db, sql: str) -> bool:
+        """Only idempotent, non-EXPLAIN statements outside a tx ride the
+        batch; everything else executes directly on the caller."""
+        if db.tx is not None:
+            return False
+        try:
+            from orientdb_tpu.exec.engine import parse_cached
+            from orientdb_tpu.sql import ast as A
+
+            stmt = parse_cached(sql)
+            return stmt.is_idempotent and not isinstance(
+                stmt, A.ExplainStatement
+            )
+        except Exception:
+            return False  # parse errors surface on the direct path
+
+    def submit(
+        self, db, sql: str, params, timeout: float = 120.0
+    ) -> Tuple[List[dict], Optional[str]]:
+        """Execute `sql` through the database's group path; blocks until
+        the result is ready. Returns (rows, engine)."""
+        if not self._coalescable(db, sql):
+            rs = db.query(sql, params)
+            return rs.to_dicts(), rs.engine
+        item = _Item(sql, params)
+        w = self._worker(db)
+        if w is None or not w.submit(item):
+            # shutdown raced the submit: serve the query directly rather
+            # than park the session until its timeout
+            rs = db.query(sql, params)
+            return rs.to_dicts(), rs.engine
+        if not item.event.wait(timeout):
+            raise TimeoutError(f"coalesced query timed out: {sql[:80]}")
+        if item.error is not None:
+            raise item.error
+        return item.rows or [], item.engine
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            workers, self._workers = list(self._workers.values()), {}
+        for w in workers:
+            w.stop()

@@ -54,6 +54,11 @@ class Server:
         self.audit = AuditLog()
         self.security.audit = self.audit
         self.plugins: List[ServerPlugin] = []
+        # cross-session query coalescing (server/coalesce.py): concurrent
+        # sessions' single queries ride one batched device dispatch
+        from orientdb_tpu.server.coalesce import QueryCoalescer
+
+        self.coalescer = QueryCoalescer()
         self._lock = threading.Lock()
         self._http = None
         self._binary = None
@@ -107,12 +112,20 @@ class Server:
 
     def drop_database(self, name: str) -> bool:
         with self._lock:
-            return self.databases.pop(name, None) is not None
+            db = self.databases.pop(name, None)
+        if db is not None:
+            # the coalescer's worker thread must not outlive (and pin)
+            # the dropped database
+            self.coalescer.evict(db)
+        return db is not None
 
     def attach_database(self, db: Database) -> Database:
         with self._lock:
+            old = self.databases.get(db.name)
             self.databases[db.name] = db
-            return db
+        if old is not None and old is not db:
+            self.coalescer.evict(old)
+        return db
 
     # -- plugins ------------------------------------------------------------
 
@@ -127,8 +140,13 @@ class Server:
 
     def startup(self) -> "Server":
         from orientdb_tpu.server.binary_server import BinaryListener
+        from orientdb_tpu.server.coalesce import QueryCoalescer
         from orientdb_tpu.server.http_server import HttpListener
 
+        if self.coalescer._stopped:
+            # shutdown() stops the coalescer permanently; a restarted
+            # server must not silently lose the cross-session group path
+            self.coalescer = QueryCoalescer()
         for p in self.plugins:
             p.startup()
         self._http = HttpListener(self, self._http_port)
@@ -155,6 +173,7 @@ class Server:
             self._http.stop()
         if self._binary is not None:
             self._binary.stop()
+        self.coalescer.stop()
 
     @property
     def http_port(self) -> int:

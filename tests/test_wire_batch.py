@@ -1,0 +1,270 @@
+"""The wire-level batch path (VERDICT r4 #1): the engine's batched
+dispatch must be reachable by remote clients — a ``query_batch`` op
+(N statements, one frame, one group dispatch), pipelined singles with
+out-of-order server dispatch, and cross-session coalescing that merges
+concurrent sessions' single queries into one batched device dispatch.
+[E] the reference's remote surface IS its perf surface
+(ONetworkProtocolBinary, SURVEY.md §3.2); it has no batch op — this is
+the TPU-first addition the engine's group path demands."""
+
+import threading
+
+import pytest
+
+from orientdb_tpu.client.remote import RemoteError, connect
+from orientdb_tpu.server import Server
+from orientdb_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = Server(admin_password="pw")
+    db = srv.create_database("demo")
+    db.schema.create_vertex_class("Profiles")
+    db.schema.create_edge_class("HasFriend")
+    people = [
+        db.new_vertex("Profiles", name=f"p{i}", n=i) for i in range(20)
+    ]
+    for i in range(19):
+        db.new_edge("HasFriend", people[i], people[i + 1])
+    srv.startup()
+    yield srv
+    srv.shutdown()
+
+
+def _url(server):
+    return f"remote:127.0.0.1:{server.binary_port}/demo"
+
+
+class TestQueryBatchOp:
+    def test_batch_returns_per_statement_results_in_order(self, server):
+        with connect(_url(server), "admin", "pw") as db:
+            res = db.query_batch(
+                [
+                    "SELECT count(*) AS c FROM Profiles",
+                    "SELECT name FROM Profiles WHERE n = 3",
+                    "MATCH {class:Profiles, as:p, where:(n=0)}-HasFriend->"
+                    "{as:f} RETURN f.name AS fn",
+                ]
+            )
+            assert res[0].to_dicts() == [{"c": 20}]
+            assert res[1].to_dicts() == [{"name": "p3"}]
+            assert res[2].to_dicts() == [{"fn": "p1"}]
+
+    def test_batch_with_params_list(self, server):
+        with connect(_url(server), "admin", "pw") as db:
+            res = db.query_batch(
+                ["SELECT name FROM Profiles WHERE n = :k"] * 3,
+                [{"k": 1}, {"k": 5}, {"k": 7}],
+            )
+            assert [r.to_dicts()[0]["name"] for r in res] == [
+                "p1",
+                "p5",
+                "p7",
+            ]
+
+    def test_batch_member_error_is_isolated_and_reported(self, server):
+        with connect(_url(server), "admin", "pw") as db:
+            with pytest.raises(RemoteError) as e:
+                db.query_batch(
+                    [
+                        "SELECT count(*) AS c FROM Profiles",
+                        "SELECT FROM NoSuchClassAnywhere",
+                    ]
+                )
+            assert "1 of 2" in str(e.value)
+
+    def test_batch_rejects_writes(self, server):
+        with connect(_url(server), "admin", "pw") as db:
+            with pytest.raises(RemoteError):
+                db.query_batch(["INSERT INTO Profiles SET name='x'"])
+            # and the write did NOT land
+            c = db.query("SELECT count(*) AS c FROM Profiles").to_dicts()
+            assert c == [{"c": 20}]
+
+
+class TestPipelinedSingles:
+    def test_pipeline_results_match_request_order(self, server):
+        with connect(_url(server), "admin", "pw", pipeline=True) as db:
+            res = db.query_pipeline(
+                ["SELECT name FROM Profiles WHERE n = :k"] * 8,
+                [{"k": i} for i in range(8)],
+            )
+            assert [r.to_dicts()[0]["name"] for r in res] == [
+                f"p{i}" for i in range(8)
+            ]
+
+    def test_pipeline_interleaves_with_plain_calls(self, server):
+        with connect(_url(server), "admin", "pw", pipeline=True) as db:
+            assert db.query("SELECT count(*) AS c FROM Profiles").to_dicts() == [
+                {"c": 20}
+            ]
+            res = db.query_pipeline(["SELECT count(*) AS c FROM Profiles"] * 3)
+            assert all(r.to_dicts() == [{"c": 20}] for r in res)
+            assert db.query("SELECT count(*) AS c FROM Profiles").to_dicts() == [
+                {"c": 20}
+            ]
+
+
+class TestCrossSessionCoalescing:
+    def test_concurrent_sessions_singles_coalesce(self, server):
+        """N sessions firing the same-shape query concurrently must (a)
+        all get correct rows and (b) actually share batched dispatches
+        (the coalesce.grouped counter moves)."""
+        # deterministic grouping on a loaded single-core runner: give
+        # the demo db's worker a real collection window (the default 0
+        # relies on natural batching, which needs arrival overlap)
+        db0 = server.get_database("demo")
+        server.coalescer.evict(db0)
+        server.coalescer._evicted.discard(db0)  # re-admit with new window
+        server.coalescer.window_s = 0.02
+        before = metrics.snapshot().get("counters", {}).get(
+            "coalesce.grouped", 0
+        )
+        n_sessions, per_session = 4, 6
+        errors = []
+        start = threading.Barrier(n_sessions)
+
+        def client(k):
+            try:
+                with connect(_url(server), "admin", "pw") as db:
+                    start.wait()
+                    for i in range(per_session):
+                        rows = db.query(
+                            "SELECT name FROM Profiles WHERE n = :k",
+                            {"k": (k * per_session + i) % 20},
+                        ).to_dicts()
+                        assert rows == [
+                            {"name": f"p{(k * per_session + i) % 20}"}
+                        ]
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        ts = [
+            threading.Thread(target=client, args=(k,))
+            for k in range(n_sessions)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errors, errors
+        after = metrics.snapshot().get("counters", {}).get(
+            "coalesce.grouped", 0
+        )
+        assert after > before, "no cross-session grouping happened"
+
+    def test_non_idempotent_statement_takes_direct_path(self, server):
+        """command (write) ops bypass the coalescer entirely; a single
+        session's write works and is visible to a subsequent query."""
+        with connect(_url(server), "admin", "pw") as db:
+            db.command("INSERT INTO Profiles SET name='tmp', n=999")
+            rows = db.query(
+                "SELECT name FROM Profiles WHERE n = 999"
+            ).to_dicts()
+            assert rows == [{"name": "tmp"}]
+            db.command("DELETE FROM Profiles WHERE n = 999")
+
+
+class TestCoalescerUnit:
+    def test_batch_level_failure_falls_back_per_item(self):
+        """One poison member must not void its cohort: batch failure
+        re-runs per item so innocents still get rows."""
+        from orientdb_tpu.models.database import Database
+        from orientdb_tpu.server.coalesce import QueryCoalescer
+
+        db = Database("u")
+        db.schema.create_vertex_class("P")
+        db.new_vertex("P", n=1)
+        co = QueryCoalescer(window_ms=20)  # force a collection window
+
+        results = {}
+        errors = {}
+
+        def worker(i, sql):
+            try:
+                results[i] = co.submit(db, sql, None)
+            except Exception as e:
+                errors[i] = e
+
+        ts = [
+            threading.Thread(
+                target=worker, args=(i, "SELECT count(*) AS c FROM P")
+            )
+            for i in range(3)
+        ]
+        ts.append(
+            threading.Thread(
+                target=worker, args=(3, "SELECT FROM MissingClassXYZ")
+            )
+        )
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        co.stop()
+        for i in range(3):
+            rows, _engine = results[i]
+            assert rows == [{"c": 1}]
+        assert 3 in errors or 3 in results  # the poison member surfaced
+
+
+class TestReviewFixesR5Wire:
+    def test_pipeline_error_drains_channel_and_stays_usable(self, server):
+        """A failed pipelined query must not desynchronize the channel:
+        all in-flight replies are drained before the error is raised,
+        and the next plain query returns ITS OWN rows."""
+        with connect(_url(server), "admin", "pw", pipeline=True) as db:
+            with pytest.raises(RemoteError) as e:
+                db.query_pipeline(
+                    [
+                        "SELECT count(*) AS c FROM Profiles",
+                        "SELECT FROM NoSuchClassHere",
+                        "SELECT count(*) AS c FROM Profiles",
+                    ]
+                )
+            assert "1 of 3" in str(e.value)
+            # channel still in sync: a fresh call gets the right answer
+            assert db.query(
+                "SELECT name FROM Profiles WHERE n = 2"
+            ).to_dicts() == [{"name": "p2"}]
+
+    def test_batch_length_mismatch_is_an_error_not_truncation(self, server):
+        with connect(_url(server), "admin", "pw") as db:
+            with pytest.raises(RemoteError) as e:
+                db.query_batch(
+                    ["SELECT count(*) AS c FROM Profiles"] * 3,
+                    [{"k": 1}],
+                )
+            assert "length" in str(e.value)
+
+    def test_drop_database_evicts_coalescer_worker(self):
+        import threading as _t
+
+        from orientdb_tpu.server import Server
+
+        srv = Server(admin_password="pw")
+        srv.startup()
+        try:
+            db = srv.create_database("tmp")
+            db.schema.create_vertex_class("P")
+            db.new_vertex("P", n=1)
+            rows, _ = srv.coalescer.submit(db, "SELECT count(*) AS c FROM P", None)
+            assert rows == [{"c": 1}]
+            names_before = {t.name for t in _t.enumerate()}
+            assert any("coalesce-tmp" in n for n in names_before)
+            srv.drop_database("tmp")
+            deadline = __import__("time").time() + 5
+            while __import__("time").time() < deadline and any(
+                "coalesce-tmp" in t.name for t in _t.enumerate()
+            ):
+                __import__("time").sleep(0.05)
+            assert not any(
+                "coalesce-tmp" in t.name for t in _t.enumerate()
+            ), "worker thread survived drop_database"
+            # a submit after shutdown/evict still answers (direct path)
+            srv.coalescer.stop()
+            rows, _ = srv.coalescer.submit(db, "SELECT count(*) AS c FROM P", None)
+            assert rows == [{"c": 1}]
+        finally:
+            srv.shutdown()
