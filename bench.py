@@ -185,20 +185,20 @@ def main() -> None:
             splits[tag] = _phase_split(before, metrics.snapshot(), n)
         return qps
 
-    def time_batched(q, n=iters, tag=None):
+    def time_batched(q, n=iters, tag=None, params_list=None):
         qs = [q] * batch
         # two warm rounds: the first records plans and kicks background
         # compiles (incl. the vmapped group executables), the second runs
         # after drain so variant routing and group membership settle —
         # otherwise a straggler compile steals host time from the timing
-        db.query_batch(qs, engine="tpu", strict=True)  # warm
+        db.query_batch(qs, params_list, engine="tpu", strict=True)  # warm
         drain_warmups()
-        db.query_batch(qs, engine="tpu", strict=True)
+        db.query_batch(qs, params_list, engine="tpu", strict=True)
         drain_warmups()
         before = metrics.snapshot()
         t0 = time.perf_counter()
         for _ in range(n):
-            rss = db.query_batch(qs, engine="tpu", strict=True)
+            rss = db.query_batch(qs, params_list, engine="tpu", strict=True)
             for rs in rss:
                 rs.to_dicts()
         qps = (n * batch) / (time.perf_counter() - t0)
@@ -209,6 +209,38 @@ def main() -> None:
     single_qps = time_single(sql, tag="single_2hop")
     batched_qps = time_batched(sql, tag="batched_2hop")
     rows_qps = time_batched(sql_rows, tag="rows_1hop")
+    # varied-parameter row-returning batch: parameters differ per lane,
+    # so this exercises the vmapped rows-group dispatch (one Execute +
+    # one compact group page for B distinct result sets) — the honest
+    # rows number a parameter-sweeping client sees
+    sql_rows_param = (
+        "MATCH {class:Profiles, as:p, where:(age > :a)}"
+        "-HasFriend->{as:f, where:(age < 30)} "
+        "RETURN p.uid AS p, f.uid AS f"
+    )
+    rows_param_plist = [{"a": 40 + (i % 15)} for i in range(batch)]
+    for pv in ({"a": 40}, {"a": 47}):
+        o = db.query(sql_rows_param, params=pv, engine="oracle").to_dicts()
+        t = db.query(
+            sql_rows_param, params=pv, engine="tpu", strict=True
+        ).to_dicts()
+        if canon(o) != canon(t):
+            print(
+                json.dumps(
+                    {
+                        "metric": "demodb_match_2hop_count_qps",
+                        "value": 0.0,
+                        "unit": "queries/sec",
+                        "vs_baseline": 0.0,
+                        "error": f"rows_param parity mismatch: {pv}",
+                    }
+                )
+            )
+            sys.exit(1)
+
+    rows_param_qps = time_batched(
+        sql_rows_param, tag="rows_1hop_param", params_list=rows_param_plist
+    )
     var_qps = time_batched(sql_var, tag="var_depth")
     trav_qps = time_batched(sql_trav, tag="traverse")
     select_qps = time_batched(sql_select, tag="select_count")
@@ -581,6 +613,7 @@ def main() -> None:
             "batch_size": batch,
             "single_query_qps": round(single_qps, 3),
             "rows_1hop_batched_qps": round(rows_qps, 3),
+            "rows_1hop_param_batched_qps": round(rows_param_qps, 3),
             "var_depth_while_batched_qps": round(var_qps, 3),
             "traverse_bfs_batched_qps": round(trav_qps, 3),
             "select_count_batched_qps": round(select_qps, 3),

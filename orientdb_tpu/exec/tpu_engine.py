@@ -104,6 +104,10 @@ def _fetch_profiled(devs: List, split_sync: bool = True) -> List[np.ndarray]:
 #: smallest page (rows) a batched result fetch transfers; pow2 rounding
 #: up from here bounds the distinct sliced shapes per buffer to log2(W)
 _PAGE_MIN = 1024
+#: rows-group page sizes round up to this so `group_page`'s jit cache
+#: stays small (width/2048 distinct shapes at most) while waste stays
+#: ≤ 2048 rows per group
+_GROUP_PAGE_ROUND = 2048
 
 
 
@@ -2474,16 +2478,18 @@ class _CompiledPlan(_AotWarmup):
         self.width = table.width
         self.count_name = solver.count_only_name()
         self.fetch_limit = self._literal_fetch_limit(solver.stmt)
-        #: small full buffers ship whole in the batch's first transfer
-        #: wave — no meta-gated page election (see _replay's direct path)
-        ncols = (
+        #: result columns in the packed data stack (vertex + 2-per-edge
+        #: + depth) — shared by direct_fetch and the group-lane budget
+        self.ncols = (
             len(self.v_names) + 2 * len(self.e_names) + len(self.d_names)
         )
+        #: small full buffers ship whole in the batch's first transfer
+        #: wave — no meta-gated page election (see _replay's direct path)
         self.direct_fetch = (
             self.count_name is None
-            and ncols > 0
+            and self.ncols > 0
             and self.width >= 2  # meta row needs [count, overflow] slots
-            and 4 * self.width * ncols <= config.result_direct_bytes
+            and 4 * self.width * self.ncols <= config.result_direct_bytes
         )
         #: dynamic parameters the compiled predicates actually read
         self.dyn_spec = dict(solver.param_box.used)
@@ -2491,7 +2497,11 @@ class _CompiledPlan(_AotWarmup):
         self.seed_spec = dict(solver.seed_box.spec)
         self.jitted = jax.jit(self._replay)
 
-    def _replay(self, arrays, dyn):
+    def _replay_core(self, arrays, dyn):
+        """Shared replay body: run the recorded solve and front-pack the
+        result columns. Returns ``(count_dev, overflow, data)`` where
+        ``data`` is the [C, width] int32 column stack (None for
+        count-only / column-less plans)."""
         # swap the tracer pytree into the device graph for the trace so the
         # graph buffers become jit ARGUMENTS (shared across every cached
         # plan) rather than per-executable HLO constants; same for the
@@ -2514,14 +2524,13 @@ class _CompiledPlan(_AotWarmup):
         overflow = solver.sched.overflow_flag().astype(jnp.int32)
         count_dev = table.count_device.astype(jnp.int32)
         if self.count_name is not None or self.width == 0:
-            # COUNT(*) plan (or column-less table): two scalars suffice
-            return jnp.stack([count_dev, overflow, jnp.int32(0)]), None, None
+            return count_dev, overflow, None
         flat: List[jnp.ndarray] = [table.cols[a] for a in self.v_names]
         for a in self.e_names:
             flat.extend(table.edge_cols[a])
         flat.extend(table.depth_cols[a] for a in self.d_names)
         if not flat:  # no columns (e.g. fully-detached optional pattern)
-            return jnp.stack([count_dev, overflow, jnp.int32(0)]), None, None
+            return count_dev, overflow, None
         width = flat[0].shape[0]
         # front-pack live rows ON DEVICE (stable), so the host needs only
         # the first `count` slots: the batch fetch path reads meta first
@@ -2531,6 +2540,119 @@ class _CompiledPlan(_AotWarmup):
         # rows-path bottleneck)
         perm = K.compact_indices(table.valid_device[:width], width)
         data = jnp.stack([K.take_pad(c, perm, -1) for c in flat])
+        return count_dev, overflow, data
+
+    @staticmethod
+    def _fits16_flag(data, count_dev, width):
+        """Runtime bit-width election flag: 1 when every live value fits
+        int16 — decided per dispatch by a meta flag, not per plan."""
+        live = jnp.arange(width, dtype=jnp.int32)[None, :] < count_dev
+        masked = jnp.where(live, data, 0)
+        return (
+            (jnp.max(masked) < 32767) & (jnp.min(masked) > -32768)
+        ).astype(jnp.int32)
+
+    def _replay_group(self, arrays, dyn):
+        """Group-mode replay for row-returning plans: ``(meta, data)``
+        with the FULL int32 column stack and no page ladder — the group
+        fetch elects ONE page for the whole lane stack after the meta
+        wave (`group_page`), so the ladder's per-dispatch
+        materialization cost is not paid B times."""
+        count_dev, overflow, data = self._replay_core(arrays, dyn)
+        if data is None:
+            return jnp.stack([count_dev, overflow, jnp.int32(0)]), None
+        width = data.shape[1]
+        meta = jnp.stack(
+            [count_dev, overflow, self._fits16_flag(data, count_dev, width)]
+        )
+        return meta, data
+
+    @staticmethod
+    def _page_fn(B: int, n: int, fits16: bool):
+        if fits16:
+            return jax.jit(lambda d: d[:B, :, :n].astype(jnp.int16))
+        return jax.jit(lambda d: d[:B, :, :n])
+
+    def _compile_page_async(self, key, data_dev) -> None:
+        """Background trace+compile of one (B, n, fits16) page fn —
+        serving batches must never absorb an XLA compile."""
+        import threading
+
+        flags = self.__dict__.setdefault("_page_compiling", set())
+        if key in flags:
+            return
+        flags.add(key)
+        cache = self.__dict__.setdefault("_group_page_fns", {})
+
+        def work():
+            try:
+                B, n, f16 = key
+                fn = self._page_fn(B, n, f16)
+                jax.block_until_ready(fn(data_dev))
+                cache[key] = fn
+            except Exception:
+                log.exception("group page compile failed: %s", key)
+            finally:
+                flags.discard(key)
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def precompile_group_pages(self, data_dev) -> None:
+        """Compile the pow2 page-fn ladder for a group's stacked data
+        shape — called from the background group-compile thread so the
+        first grouped serving batch finds its page fn ready."""
+        Bb, _C, W = (int(s) for s in data_dev.shape)
+        cache = self.__dict__.setdefault("_group_page_fns", {})
+        n = _GROUP_PAGE_ROUND
+        sizes = []
+        while n < W:
+            sizes.append(n)
+            n *= 2
+        sizes.append(W)
+        for n in sizes:
+            for f16 in (False, True):
+                key = (Bb, n, f16)
+                if key not in cache:
+                    try:
+                        fn = self._page_fn(Bb, n, f16)
+                        jax.block_until_ready(fn(data_dev))
+                        cache[key] = fn
+                    except Exception:
+                        log.exception(
+                            "group page precompile failed: %s", key
+                        )
+                        return
+
+    def group_page(self, data_dev, B: int, need: int, fits16: bool):
+        """Elect the compact page for a whole group's stacked data:
+        [Bb, C, width] → [B, C, n] (int16 when every lane's live values
+        fit), as ONE Execute. NEVER compiles synchronously: an exact
+        (B, n, fits16) hit serves directly; a miss kicks a background
+        compile and serves this batch from the smallest precompiled
+        fallback (the pow2 ladder built by `precompile_group_pages`),
+        or the raw full int32 stack when nothing is ready yet."""
+        W = int(data_dev.shape[2])
+        n = min(W, -(-max(need, 1) // _GROUP_PAGE_ROUND) * _GROUP_PAGE_ROUND)
+        cache = self.__dict__.setdefault("_group_page_fns", {})
+        fn = cache.get((B, n, fits16))
+        if fn is not None:
+            return fn(data_dev)
+        self._compile_page_async((B, n, fits16), data_dev)
+        best = None
+        for (b2, n2, f2), fn2 in cache.items():
+            if b2 >= B and n2 >= n and f2 == fits16:
+                if best is None or (n2, b2) < best[0]:
+                    best = ((n2, b2), fn2)
+        if best is not None:
+            return best[1](data_dev)
+        return data_dev  # nothing compiled yet: ship the raw stack once
+
+    def _replay(self, arrays, dyn):
+        count_dev, overflow, data = self._replay_core(arrays, dyn)
+        if data is None:
+            # COUNT(*) plan (or column-less table): two scalars suffice
+            return jnp.stack([count_dev, overflow, jnp.int32(0)]), None, None
+        width = data.shape[1]
         if self.direct_fetch:
             # small buffer: ONE fused [C+1, width] array (data rows + a
             # trailing [count, overflow, ...] meta row) = ONE device
@@ -2549,12 +2671,9 @@ class _CompiledPlan(_AotWarmup):
         # (vertex indices on small graphs usually do; edge positions on
         # big ones don't), the fetch ships the half-size copy — decided
         # per dispatch by a meta flag, not per plan, so it stays general
-        live = jnp.arange(width, dtype=jnp.int32)[None, :] < count_dev
-        masked = jnp.where(live, data, 0)
-        fits16 = (
-            (jnp.max(masked) < 32767) & (jnp.min(masked) > -32768)
-        ).astype(jnp.int32)
-        meta = jnp.stack([count_dev, overflow, fits16])
+        meta = jnp.stack(
+            [count_dev, overflow, self._fits16_flag(data, count_dev, width)]
+        )
         # pre-materialized pow2 page prefixes (both dtypes): the batch
         # fetch picks the smallest page covering the live count and reads
         # an EXISTING device buffer — per-query slice dispatches after the
@@ -2608,11 +2727,22 @@ class _CompiledPlan(_AotWarmup):
 
     def batchable(self) -> bool:
         """Eligible for the vmapped one-Execute group dispatch: count-only
-        or direct-fetch plans (one small output buffer per lane) on an
-        unsharded graph. Big-buffer plans keep per-query dispatch so the
-        page election can cut their transfer; mesh plans keep it because
+        and direct-fetch plans (one small output buffer per lane), plus
+        row-returning plans whose full int32 stack fits the per-lane
+        budget (the group replays with NO page ladder and elects one
+        compact page for the whole stack after the meta wave —
+        `group_page`). Mesh plans keep per-query dispatch because
         vmap-over-shard_map is not exercised anywhere."""
-        return self.solver.dg.mesh_graph is None and (
+        if self.solver.dg.mesh_graph is not None:
+            return False
+        if self.count_name is not None or self.width == 0 or self.direct_fetch:
+            return True
+        return 4 * self.width * self.ncols <= config.result_group_lane_bytes
+
+    def _rows_grouped(self) -> bool:
+        """True when group dispatch uses the (meta, data) rows-group
+        replay rather than the single-buffer count/direct replay."""
+        return not (
             self.count_name is not None or self.width == 0 or self.direct_fetch
         )
 
@@ -2662,6 +2792,10 @@ class _CompiledPlan(_AotWarmup):
         atexit.unregister(drain_warmups)
         atexit.register(drain_warmups)
 
+        replay = (
+            self._replay_group if self._rows_grouped() else self._replay
+        )
+
         def work():
             # one retry for transient failures (runtime hiccup, resource
             # pressure) — the same discipline as ensure_compiled; only a
@@ -2671,12 +2805,20 @@ class _CompiledPlan(_AotWarmup):
                 for attempt in (0, 1):
                     try:
                         fn = jax.jit(
-                            jax.vmap(self._replay, in_axes=(None, 0))
+                            jax.vmap(replay, in_axes=(None, 0))
                         )
                         with _TRACE_LOCK:
-                            jax.block_until_ready(
-                                fn(dict(self.solver.dg.arrays), stacked)
-                            )
+                            res = fn(dict(self.solver.dg.arrays), stacked)
+                            jax.block_until_ready(res)
+                        if (
+                            isinstance(res, tuple)
+                            and len(res) == 2
+                            and res[1] is not None
+                        ):
+                            # rows group: build the pow2 page-fn ladder
+                            # NOW (still on this background thread) so
+                            # serving batches never absorb a page compile
+                            self.precompile_group_pages(res[1])
                         self._jitted_many[Bb] = fn
                         metrics.incr("plan_cache.group_compile")
                         break
@@ -3065,13 +3207,22 @@ _GROUP_MIN = 4
 
 class _Group:
     """Stacked device result of a vmapped group dispatch; fetched to
-    host ONCE and sliced per lane."""
+    host ONCE and sliced per lane.
 
-    __slots__ = ("dev", "_np")
+    Row-returning groups additionally carry the stacked [B, C, width]
+    data buffer (``data_dev``, from the rows-group replay) or — for the
+    no-dyn shared-dispatch case — the single dispatch's page ladder
+    (``shared_pages``); the batch fetch elects ONE compact page for the
+    whole group after the meta wave."""
 
-    def __init__(self, dev) -> None:
+    __slots__ = ("dev", "_np", "data_dev", "shared_pages", "data_np")
+
+    def __init__(self, dev, data_dev=None, shared_pages=None) -> None:
         self.dev = dev
         self._np = None
+        self.data_dev = data_dev
+        self.shared_pages = shared_pages
+        self.data_np = None  # host copy of the elected group page
 
     def arr(self) -> np.ndarray:
         if self._np is None:
@@ -3093,6 +3244,12 @@ class _Lane:
     def meta(self) -> np.ndarray:
         a = self.grp.arr()
         return a if self.k is None else a[self.k]
+
+    def data(self) -> Optional[np.ndarray]:
+        d = self.grp.data_np
+        if d is None:
+            return None
+        return d if self.k is None or d.ndim == 2 else d[self.k]
 
 
 def execute_batch(db, items) -> List:
@@ -3167,7 +3324,14 @@ def execute_batch(db, items) -> List:
             # no dynamic args: every lane is the SAME program on the same
             # inputs — one plain dispatch serves the whole group
             dev = plan.dispatch({})
-            grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
+            if isinstance(dev, tuple) and len(dev) == 3 and dev[1]:
+                # rows plan: keep the single dispatch's page ladder so
+                # the group elects one shared page after the meta wave
+                grp = _Group(
+                    dev[0], shared_pages=(dev[1], dev[2])
+                )
+            else:
+                grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
             ks = [None] * len(lanes)
         else:
             dev = plan.dispatch_many(dyns)
@@ -3188,7 +3352,15 @@ def execute_batch(db, items) -> List:
                             tried=plan, fresh=fresh,
                         )
                 continue
-            grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
+            if (
+                isinstance(dev, tuple)
+                and len(dev) == 2
+                and dev[1] is not None
+            ):
+                # rows-group replay: (meta stack, data stack)
+                grp = _Group(dev[0], data_dev=dev[1])
+            else:
+                grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
             ks = list(range(len(lanes)))
         for k, j in zip(ks, lanes):
             i, variants, _p, _params = prepared[j]
@@ -3245,6 +3417,44 @@ def execute_batch(db, items) -> List:
         except Exception:
             pass
         pages_sel[k] = d
+    # rows groups: elect ONE compact page for each group's whole lane
+    # stack — a single slice(+int16 cast) Execute and a single host
+    # copy replace B per-query ladders (the measured rows-path floor
+    # was per-query dispatch+meta overhead, ~20 ms/query on the tunnel)
+    grp_lane_metas: Dict[int, List[np.ndarray]] = {}
+    grp_objs: Dict[int, Tuple[_Group, object]] = {}
+    for k, (_i, _v, plan, dev) in enumerate(pending):
+        if isinstance(dev, _Lane) and (
+            dev.grp.data_dev is not None
+            or dev.grp.shared_pages is not None
+        ):
+            grp_lane_metas.setdefault(id(dev.grp), []).append(metas[k])
+            grp_objs[id(dev.grp)] = (dev.grp, plan)
+    grp_fetch: List[Tuple[_Group, object]] = []
+    for gid, lane_metas in grp_lane_metas.items():
+        grp, plan = grp_objs[gid]
+        needs, fits16 = [], True
+        for m in lane_metas:
+            if int(m[1]):
+                continue  # overflow lane: re-dispatched later anyway
+            needs.append(plan.fetch_rows_needed(int(m[0])))
+            fits16 = fits16 and bool(int(m[2]))
+        if not needs:
+            continue
+        need = max(max(needs), 1)
+        if grp.shared_pages is not None:
+            p32, p16 = grp.shared_pages
+            pages = p16 if fits16 else p32
+            d = next(p for p in pages if int(p.shape[1]) >= need)
+        else:
+            d = plan.group_page(
+                grp.data_dev, len(lane_metas), need, fits16
+            )
+        try:
+            d.copy_to_host_async()
+        except Exception:
+            pass
+        grp_fetch.append((grp, d))
     t1 = _time.perf_counter()
     datas: List = [None] * len(pending)
     nbytes = sum(int(m.nbytes) for m in metas)
@@ -3253,6 +3463,12 @@ def execute_batch(db, items) -> List:
             a = np.asarray(d)
             datas[k] = a
             nbytes += int(a.nbytes)
+    for grp, d in grp_fetch:
+        a = np.asarray(d)
+        if a.dtype != np.int32:
+            a = a.astype(np.int32)
+        grp.data_np = a
+        nbytes += int(d.nbytes)
     t2 = _time.perf_counter()
     if pending:
         # overlapped phases: the meta drain tracks device compute, the
@@ -3266,7 +3482,12 @@ def execute_batch(db, items) -> List:
             zip(pending, metas)
         ):
             stmt, params = items[i]
-            fetched = (meta, datas[k]) if isinstance(dev, tuple) else meta
+            if isinstance(dev, _Lane) and dev.grp.data_np is not None:
+                fetched = (meta, dev.data())  # rows-group lane
+            elif isinstance(dev, tuple):
+                fetched = (meta, datas[k])
+            else:
+                fetched = meta
             try:
                 out[i] = plan.materialize(fetched, params or {})
                 variants.remember(params, plan)

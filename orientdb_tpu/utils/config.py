@@ -88,6 +88,12 @@ class GlobalConfiguration:
     # point lookups become V-independent instead of hull scans.
     index_root_seed: bool = True
 
+    # Row-returning plans join the vmapped group dispatch when one
+    # lane's full int32 result stack fits this budget (the group stacks
+    # B of them on device); bigger plans keep per-lane dispatch + page
+    # election.
+    result_group_lane_bytes: int = 4 << 20
+
     # Query RESULT cache ([E] OCommandCache) — rows of idempotent queries
     # keyed by (sql, params, engine), invalidated by the mutation epoch.
     # Disabled by default, matching the reference.
