@@ -37,7 +37,11 @@ the default size), BENCH_SNB_PERSONS (default 10000; 0 skips the IS and
 IC sections), BENCH_SF10_PERSONS (100000; 0 skips), BENCH_SF100_PERSONS
 (8000000 — the array-native SF100-shaped graph; 0 skips),
 BENCH_SKEW_PERSONS (1000000; 0 skips), BENCH_MESH_SCALING (1; 0 skips
-the per-shard-count subprocess probes), BENCH_GATE / --gate <json>
+the per-shard-count subprocess probes), BENCH_SF100_SHARDED_PERSONS
+(1000000; 0 skips the 8-virtual-device sharded config-5 sub-block — one
+CPU core executes all 8 devices, so the default adds several minutes),
+BENCH_REMOTE (1; 0 skips the wire-throughput block),
+BENCH_REMOTE_CLIENTS (4), BENCH_GATE / --gate <json>
 (regression gate vs a recorded round; tolerance BENCH_GATE_TOL,
 default 0.55 = the measured ±40% tunnel-noise envelope).
 """
@@ -82,6 +86,35 @@ def gate_regressions(cur: dict, prev: dict, tolerance: float = 0.85):
         if cv is not None and pv > 0 and cv < pv * tolerance:
             regs.append((name, pv, cv))
     return regs
+
+
+def run_virtual_mesh_subprocess(module: str, argv, timeout: int, n_devices: int = 8):
+    """Run a bench probe module in a subprocess pinned to an n-device
+    virtual CPU mesh; returns the parsed last stdout JSON line, or an
+    {"error": ...} dict carrying the best diagnostic (probes print their
+    failure JSON to STDOUT before exiting nonzero)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"{os.environ.get('XLA_FLAGS', '')} "
+        f"--xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    try:
+        out_s = subprocess.run(
+            [sys.executable, "-m", module, *[str(a) for a in argv]],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+        lines = out_s.stdout.strip().splitlines()
+        if out_s.returncode != 0 or not lines:
+            return {
+                "error": (lines[-1] if lines else "")[-200:]
+                or out_s.stderr[-200:]
+            }
+        return json.loads(lines[-1])
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": str(e)[:200]}
 
 
 def main() -> None:
@@ -522,6 +555,72 @@ def main() -> None:
         sf100["persons"] = sf100_persons
         del big, bsnap
 
+        # ---- config 5 REAL (VERDICT r4 #2): the SNB interactive shape —
+        # multi-class (Person + Message), a creationDate EDGE property
+        # column, and the multi-pattern MATCH with the fused
+        # edge-property WHERE (SURVEY.md:52-54, configs[4]) — parity
+        # against the exact numpy reference, parameters varying across
+        # the batch ----
+        from orientdb_tpu.storage.bigshape import (
+            build_snb_shape,
+            numpy_config5_count,
+        )
+
+        big5, bsnap5 = build_snb_shape(
+            sf100_persons, msgs_per_person=2, avg_knows=10, seed=7
+        )
+        q5 = (
+            "MATCH {class:Person, as:p, where:(age > 40)}"
+            ".outE('knows'){where:(creationDate > :d)}"
+            ".inV(){as:f, where:(age < 30)}, "
+            "{class:Message, as:m}-hasCreator->{as:f} "
+            "RETURN count(*) AS n"
+        )
+        for d in (12_000, 15_000, 18_500):
+            want = numpy_config5_count(bsnap5, d)
+            got = big5.query(
+                q5, params={"d": d}, engine="tpu", strict=True
+            ).to_dicts()
+            if got != [{"n": want}]:
+                print(json.dumps({"metric": "demodb_match_2hop_count_qps",
+                                  "value": 0.0, "unit": "queries/sec",
+                                  "vs_baseline": 0.0,
+                                  "error": f"config5 parity mismatch d={d}"}))
+                sys.exit(1)
+        sf100["config5_qps"] = time_param_batch(
+            big5,
+            q5,
+            [{"d": 12_000 + (i * 211) % 8000} for i in range(batch)],
+        )
+        rep5 = bsnap5._device_cache.memory_report()
+        sf100["config5_hbm_bytes"] = {
+            "per_device_total": sum(rep5["per_device"].values()),
+            **{f"per_device_{k}": v for k, v in rep5["per_device"].items()},
+        }
+        sf100["config5_knows_edges"] = int(
+            bsnap5.edge_classes["knows"].num_edges
+        )
+        sf100["config5_messages"] = int(
+            bsnap5.edge_classes["hasCreator"].num_edges
+        )
+        del big5, bsnap5
+
+        # sharded sub-block: the same SNB shape row-sharded over an
+        # 8-device virtual mesh in a subprocess (adjacency + columns at
+        # O(E/S) per device), parity-gated, with per-device hbm and
+        # sharded q/s recorded. Scale via BENCH_SF100_SHARDED_PERSONS
+        # (one CPU core executes all 8 virtual devices, so the full 8M
+        # would take hours — the layout is identical at any scale).
+        sharded_persons = int(
+            os.environ.get("BENCH_SF100_SHARDED_PERSONS", "1000000")
+        )
+        if sharded_persons > 0:
+            sf100["sharded"] = run_virtual_mesh_subprocess(
+                "orientdb_tpu.tools.sharded_sf",
+                [8, sharded_persons],
+                timeout=1800,
+            )
+
     # ---- degree skew (VERDICT r3 #7): supernode graph vs uniform at
     # ~equal edge count; within ~2x is the bar ----
     skew = {}
@@ -574,30 +673,13 @@ def main() -> None:
     # ~flat while the old all_gather design's row count grows with S ----
     mesh_scaling = []
     if os.environ.get("BENCH_MESH_SCALING", "1") != "0":
-        import subprocess
-
         for S in (2, 4, 8):
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                f"{os.environ.get('XLA_FLAGS', '')} "
-                f"--xla_force_host_platform_device_count={S}"
-            ).strip()
-            try:
-                out_s = subprocess.run(
-                    [sys.executable, "-m", "orientdb_tpu.tools.mesh_scaling",
-                     str(S)],
-                    env=env, capture_output=True, text=True, timeout=600,
-                )
-                lines = out_s.stdout.strip().splitlines()
-                if out_s.returncode != 0 or not lines:
-                    mesh_scaling.append(
-                        {"shards": S, "error": out_s.stderr[-160:]}
-                    )
-                else:
-                    mesh_scaling.append(json.loads(lines[-1]))
-            except Exception as e:  # noqa: BLE001 - diagnostics only
-                mesh_scaling.append({"shards": S, "error": str(e)[:160]})
+            res = run_virtual_mesh_subprocess(
+                "orientdb_tpu.tools.mesh_scaling", [S],
+                timeout=600, n_devices=S,
+            )
+            res.setdefault("shards", S)
+            mesh_scaling.append(res)
 
     t0 = time.perf_counter()
     for _ in range(oracle_iters):

@@ -35,6 +35,34 @@ from orientdb_tpu.storage.snapshot import (
 )
 
 
+def _csr_from_degrees(
+    name: str, degrees: np.ndarray, dst: np.ndarray
+) -> EdgeClassCSR:
+    """Both-direction CSR from per-vertex out-degrees and the dst array
+    in out-CSR order (the shared assembly of every array-native builder:
+    indptr from cumsum, stable in-direction sort, edge ids into out
+    order, degree maxima)."""
+    V = degrees.shape[0]
+    csr = EdgeClassCSR(name)
+    csr.indptr_out = np.concatenate([[0], np.cumsum(degrees)]).astype(
+        np.int32
+    )
+    csr.dst = dst.astype(np.int32)
+    csr.out_degree_max = int(degrees.max()) if V else 0
+    edge_src = np.repeat(np.arange(V, dtype=np.int32), degrees)
+    csr._edge_src = edge_src  # pre-seed the cached property
+    order_in = np.argsort(dst, kind="stable")
+    csr.src = edge_src[order_in].astype(np.int32)
+    csr.edge_id_in = order_in.astype(np.int32)
+    counts_in = np.bincount(dst, minlength=V)
+    csr.indptr_in = np.concatenate([[0], np.cumsum(counts_in)]).astype(
+        np.int32
+    )
+    csr.in_degree_max = int(counts_in.max()) if V else 0
+    csr.edge_rids = []  # array-native benches never marshal edge RIDs
+    return csr
+
+
 def build_person_knows(
     n_persons: int,
     avg_knows: int = 10,
@@ -63,24 +91,8 @@ def build_person_knows(
         hubs = np.linspace(0, V - 1, supernodes, dtype=np.int64)
         degrees[hubs] = supernode_degree
     E = int(degrees.sum())
-    indptr_out = np.concatenate([[0], np.cumsum(degrees)]).astype(np.int32)
     dst = rng.integers(0, V, E, dtype=np.int64)
-
-    csr = EdgeClassCSR("knows")
-    csr.indptr_out = indptr_out
-    csr.dst = dst.astype(np.int32)
-    csr.out_degree_max = int(degrees.max()) if V else 0
-    order_in = np.argsort(dst, kind="stable")
-    edge_src = np.repeat(np.arange(V, dtype=np.int32), degrees)
-    csr._edge_src = edge_src  # pre-seed the cached property
-    csr.src = edge_src[order_in].astype(np.int32)
-    csr.edge_id_in = order_in.astype(np.int32)
-    counts_in = np.bincount(dst, minlength=V)
-    csr.indptr_in = np.concatenate([[0], np.cumsum(counts_in)]).astype(
-        np.int32
-    )
-    csr.in_degree_max = int(counts_in.max()) if V else 0
-    csr.edge_rids = []  # COUNT-only benches never marshal edge RIDs
+    csr = _csr_from_degrees("knows", degrees, dst)
 
     snap = GraphSnapshot()
     snap.num_vertices = V
@@ -127,6 +139,126 @@ def build_person_knows(
     return db, snap
 
 
+def build_snb_shape(
+    n_persons: int,
+    msgs_per_person: int = 2,
+    avg_knows: int = 10,
+    seed: int = 0,
+    name: str = "snbshape",
+) -> Tuple[Database, GraphSnapshot]:
+    """The LDBC SNB *interactive* shape at array scale — BASELINE
+    config 5's actual workload ingredients (SURVEY.md §6 row 5, §7 step
+    7; VERDICT r4 #2):
+
+    - **Person**–knows–Person with a ``creationDate`` EDGE property
+      column (the fused edge-property WHERE the north star names,
+      SURVEY.md:52-54),
+    - **Message**–hasCreator–Person (multi-class: messages share the
+      vertex index space after persons),
+    - per-class property columns with honest presence masks (``age``
+      on persons only, ``length`` on messages only) — the property
+      breadth the per-query column pruning is judged on.
+
+    Parity for the benched COUNT shapes comes from
+    `numpy_config5_count` (exact int64, same arrays)."""
+    rng = np.random.default_rng(seed)
+    db = Database(name)
+    db.schema.create_vertex_class("Person")
+    db.schema.create_vertex_class("Message")
+    db.schema.create_edge_class("knows")
+    db.schema.create_edge_class("hasCreator")
+
+    P = int(n_persons)
+    M = P * int(msgs_per_person)
+    V = P + M  # persons [0, P), messages [P, V)
+
+    # ---- knows: Person -> Person, creationDate edge column ----
+    deg = np.zeros(V, np.int64)
+    deg[:P] = rng.poisson(avg_knows, P)
+    E = int(deg.sum())
+    dst = rng.integers(0, P, E, dtype=np.int64)  # always a Person
+    knows = _csr_from_degrees("knows", deg, dst)
+    e_ones = np.ones(E, bool)
+    knows.edge_columns = {
+        # SNB knows.creationDate: days-since-epoch ints — the fused
+        # edge-predicate column (indexed by edge id = out-CSR order)
+        "creationDate": PropertyColumn(
+            "creationDate",
+            "int",
+            rng.integers(10_000, 20_000, E, dtype=np.int32),
+            e_ones,
+        ),
+    }
+
+    # ---- hasCreator: Message -> Person (exactly one per message) ----
+    hc_deg = np.zeros(V, np.int64)
+    hc_deg[P:] = 1
+    creators = rng.integers(0, P, M, dtype=np.int64)
+    hc = _csr_from_degrees("hasCreator", hc_deg, creators)
+
+    # ---- snapshot assembly ----
+    snap = GraphSnapshot()
+    snap.num_vertices = V
+    pc = db.schema.get_class("Person").cluster_ids[0]
+    mc = db.schema.get_class("Message").cluster_ids[0]
+    snap.v_cluster = np.concatenate(
+        [np.full(P, pc, np.int32), np.full(M, mc, np.int32)]
+    )
+    snap.v_position = np.concatenate(
+        [np.arange(P, dtype=np.int32), np.arange(M, dtype=np.int32)]
+    )
+    snap.rid_to_idx = {}
+
+    all_classes = sorted(db.schema.classes(), key=lambda c: c.name)
+    snap.class_names = [c.name for c in all_classes]
+    snap.class_id_of = {c.name.lower(): i for i, c in enumerate(all_classes)}
+    snap.v_class = np.concatenate(
+        [
+            np.full(P, snap.class_id_of["person"], np.int32),
+            np.full(M, snap.class_id_of["message"], np.int32),
+        ]
+    )
+    for c in all_classes:
+        closure = [
+            snap.class_id_of[s.name.lower()]
+            for s in c.subclasses(include_self=True)
+        ]
+        snap.class_closure[c.name.lower()] = np.array(sorted(closure), np.int32)
+    ranges = {"person": (0, P), "message": (P, V)}
+    for c in all_classes:
+        if c.is_vertex_type and not c.abstract:
+            snap.class_vertex_range[c.name.lower()] = ranges.get(
+                c.name.lower(), (0, 0)
+            )
+
+    person_pres = np.zeros(V, bool)
+    person_pres[:P] = True
+    msg_pres = ~person_pres
+    age = np.zeros(V, np.int32)
+    age[:P] = rng.integers(18, 80, P, dtype=np.int32)
+    length = np.zeros(V, np.int32)
+    length[P:] = rng.integers(1, 2000, M, dtype=np.int32)
+    snap.v_columns = {
+        "uid": PropertyColumn(
+            "uid", "int", np.arange(V, dtype=np.int32), np.ones(V, bool)
+        ),
+        "age": PropertyColumn("age", "int", age, person_pres),
+        "length": PropertyColumn("length", "int", length, msg_pres),
+    }
+    snap.edge_classes["knows"] = knows
+    snap.edge_classes["hasCreator"] = hc
+    for c in all_classes:
+        if c.is_edge_type:
+            snap.edge_closure[c.name.lower()] = sorted(
+                s.name
+                for s in c.subclasses(include_self=True)
+                if s.name in snap.edge_classes
+            )
+    snap.epoch = db.mutation_epoch
+    db.attach_snapshot(snap)
+    return db, snap
+
+
 # ---------------------------------------------------------------------------
 # exact numpy references for the benched COUNT shapes (the parity oracle
 # at array level — int64 throughout, no device involved)
@@ -151,3 +283,31 @@ def numpy_2hop_count(snap: GraphSnapshot, src_mask, mid_mask, dst_mask) -> int:
     w2 = _seg_sum(dst_mask[csr.dst].astype(np.int64), csr.indptr_out)
     w1 = _seg_sum((mid_mask[csr.dst] * w2[csr.dst]).astype(np.int64), csr.indptr_out)
     return int((w1 * src_mask.astype(np.int64)).sum())
+
+
+def numpy_config5_count(snap: GraphSnapshot, d_cut: int) -> int:
+    """Exact reference for the config-5 multi-pattern MATCH:
+
+        MATCH {class:Person, as:p, where:(age > 40)}
+              .outE('knows'){where:(creationDate > d_cut)}
+              .inV(){as:f, where:(age < 30)},
+              {class:Message, as:m}-hasCreator->{as:f}
+        RETURN count(*)
+
+    = Σ over knows edges (p→f) passing the vertex+edge predicates of
+    the number of messages whose creator is f."""
+    knows = snap.edge_classes["knows"]
+    hc = snap.edge_classes["hasCreator"]
+    age_col = snap.v_columns["age"]
+    age, pres = age_col.values, age_col.present
+    cdate = knows.edge_columns["creationDate"].values
+    msg_cnt = np.diff(hc.indptr_in).astype(np.int64)  # messages per person
+    dst = knows.dst
+    w = (
+        (age[dst] < 30)
+        & pres[dst]
+        & (cdate > d_cut)
+    ).astype(np.int64) * msg_cnt[dst]
+    per_src = _seg_sum(w, knows.indptr_out)
+    src_mask = ((age > 40) & pres).astype(np.int64)
+    return int((per_src * src_mask).sum())

@@ -139,10 +139,35 @@ def run_body(n_devices: int) -> None:
         oracle = canon(db.query(sql, params=params, engine="oracle").to_dicts())
         assert recorded == oracle, f"record-run parity broke: {sql}"
         assert replayed == oracle, f"sharded replay parity broke: {sql}"
+
+    # config-5 shape (BASELINE configs[4]): multi-class + EDGE property
+    # column + multi-pattern edge-property WHERE, sharded on the same
+    # mesh, against the exact numpy reference (array-native graph)
+    from orientdb_tpu.storage.bigshape import (
+        build_snb_shape,
+        numpy_config5_count,
+    )
+
+    db5, snap5 = build_snb_shape(400, msgs_per_person=1, avg_knows=4, seed=7)
+    snap5._mesh = mesh
+    q5 = (
+        "MATCH {class:Person, as:p, where:(age > 40)}"
+        ".outE('knows'){where:(creationDate > :d)}"
+        ".inV(){as:f, where:(age < 30)}, "
+        "{class:Message, as:m}-hasCreator->{as:f} "
+        "RETURN count(*) AS n"
+    )
+    for d in (12_000, 17_000):
+        want = numpy_config5_count(snap5, d)
+        got = db5.query(
+            q5, params={"d": d}, engine="tpu", strict=True
+        ).to_dicts()
+        assert got == [{"n": want}], f"sharded config5 parity broke: d={d}"
     print(
         f"dryrun_multichip ok: mesh {dict(mesh.shape)}, "
-        f"{len(QUERIES)} MATCH/SELECT queries sharded-executed at oracle "
-        f"parity (platform=cpu, hermetic)"
+        f"{len(QUERIES)} MATCH/SELECT queries + config5 edge-property-"
+        "WHERE multi-pattern sharded-executed at oracle/numpy parity "
+        "(platform=cpu, hermetic)"
     )
 
 
