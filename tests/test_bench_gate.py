@@ -97,3 +97,63 @@ def test_gate_ignores_non_qps_and_missing_metrics():
     cur["extras"]["batch_size"] = 1  # changed but not a qps metric
     del cur["extras"]["rows_1hop_batched_qps"]  # missing in current: skip
     assert bench.gate_regressions(cur, _run()) == []
+
+
+class TestDeviceMsGate:
+    """The stable-signal gate (VERDICT r4 #6): device/host ms medians
+    compare at ~0.85 — a regression q/s noise would hide must fail."""
+
+    @staticmethod
+    def _run(device=20.0, host=2.0, tiny=0.002):
+        return {
+            "value": 100.0,
+            "extras": {
+                "rows_1hop_batched_qps": 50.0,
+                "phase_split_ms_per_query": {
+                    "rows_1hop": {
+                        "device_ms": device,
+                        "host_ms": host,
+                        "transfer_ms": 10.0,  # not gated (tunnel noise)
+                        "kb_per_query": 128.0,
+                    },
+                    "batched_2hop": {"device_ms": tiny, "host_ms": tiny},
+                },
+            },
+        }
+
+    def test_device_ms_growth_gates(self):
+        regs = bench.gate_regressions(self._run(device=30.0), self._run())
+        assert ("rows_1hop.device_ms", 20.0, 30.0) in regs
+
+    def test_host_ms_growth_gates(self):
+        regs = bench.gate_regressions(self._run(host=4.0), self._run())
+        assert ("rows_1hop.host_ms", 2.0, 4.0) in regs
+
+    def test_within_ms_tolerance_passes(self):
+        # 20 -> 23 ms is within prev/0.85 = 23.5
+        assert bench.gate_regressions(self._run(device=23.0), self._run()) == []
+
+    def test_improvement_passes(self):
+        assert bench.gate_regressions(self._run(device=5.0), self._run()) == []
+
+    def test_sub_floor_values_never_gate(self):
+        """Micro-ms COUNT workloads are pure jitter: 0.002 -> 0.2 must
+        not gate (prev below the 0.5 ms floor)."""
+        assert (
+            bench.gate_regressions(self._run(tiny=0.2), self._run()) == []
+        )
+
+    def test_transfer_ms_is_not_gated(self):
+        cur = self._run()
+        cur["extras"]["phase_split_ms_per_query"]["rows_1hop"][
+            "transfer_ms"
+        ] = 99.0
+        assert bench.gate_regressions(cur, self._run()) == []
+
+    def test_a_44pct_qps_drop_now_caught_via_ms(self):
+        """The r4 weakness: a 44% q/s drop passes the 0.55 q/s gate —
+        but its device_ms growth fails the ms gate."""
+        cur = self._run(device=36.0)
+        cur["extras"]["rows_1hop_batched_qps"] = 28.0  # -44%: passes 0.55
+        regs = bench.gate_regressions(cur, self._run(), tolerance=0.55)
+        assert regs == [("rows_1hop.device_ms", 20.0, 36.0)]
