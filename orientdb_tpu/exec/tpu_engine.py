@@ -2094,7 +2094,7 @@ class TpuMatchSolver:
             if col is None:  # depth alias: plain ints
                 o = vals.astype(object)
             elif col.kind == "str":
-                d = np.asarray(col.dictionary if col.dictionary else [""], object)
+                d = col.dict_array()
                 o = d[np.clip(vals, 0, len(d) - 1)]
             elif col.kind == "bool":
                 o = (vals != 0).astype(object)
@@ -2306,6 +2306,17 @@ class _AotWarmup:
     def _warm_call(self):  # pragma: no cover - overridden
         raise NotImplementedError
 
+    def _arg_subset(self):
+        """The plan's jit-arg pytree: only the graph arrays its
+        recording touched (`_record`'s touch log). Keeps every cached
+        plan's pytree structure stable while pruned columns upload
+        lazily, and ships executables only what they read."""
+        arrays = self.solver.dg.arrays
+        keys = getattr(self, "arg_keys", None)
+        if keys is None:
+            return arrays if isinstance(arrays, dict) else dict(arrays)
+        return {k: arrays[k] for k in keys}
+
     def _is_compiled(self) -> bool:
         try:
             return self.jitted._cache_size() > 0
@@ -2399,7 +2410,7 @@ class _CompiledTraverse(_AotWarmup):
     def _warm_call(self):
         # snapshot the canonical dict: the main thread may _put new keys
         # (lazy class-id/edge uploads) while jit flattens the pytree here
-        return self.jitted(dict(self.solver.dg.arrays))
+        return self.jitted(self._arg_subset())
 
     def _replay(self, arrays):
         dg = self.solver.dg
@@ -2417,7 +2428,7 @@ class _CompiledTraverse(_AotWarmup):
         # plan-cache key), so `params` is accepted for interface parity
         # with _CompiledPlan and ignored
         self.wait_compiled()
-        return self.jitted(self.solver.dg.arrays)
+        return self.jitted(self._arg_subset())
 
     def batchable(self) -> bool:
         """TRAVERSE plans bake their parameters, so every batch item
@@ -2639,7 +2650,8 @@ class _CompiledPlan(_AotWarmup):
             return fn(data_dev)
         self._compile_page_async((B, n, fits16), data_dev)
         best = None
-        for (b2, n2, f2), fn2 in cache.items():
+        # snapshot: background compile threads insert into this dict
+        for (b2, n2, f2), fn2 in list(cache.items()):
             if b2 >= B and n2 >= n and f2 == fits16:
                 if best is None or (n2, b2) < best[0]:
                     best = ((n2, b2), fn2)
@@ -2718,12 +2730,12 @@ class _CompiledPlan(_AotWarmup):
 
     def _warm_call(self):
         # dict snapshot for the same flatten-vs-insert reason as traverse
-        return self.jitted(dict(self.solver.dg.arrays), self._dyn_args(None))
+        return self.jitted(self._arg_subset(), self._dyn_args(None))
 
     def dispatch(self, params: Optional[Dict] = None):
         """Enqueue the replay on device; returns the un-fetched result."""
         self.wait_compiled()
-        return self.jitted(self.solver.dg.arrays, self._dyn_args(params))
+        return self.jitted(self._arg_subset(), self._dyn_args(params))
 
     def batchable(self) -> bool:
         """Eligible for the vmapped one-Execute group dispatch: count-only
@@ -2777,7 +2789,7 @@ class _CompiledPlan(_AotWarmup):
         if fn is None:
             self._compile_group_async(Bb, stacked)
             return None
-        return fn(self.solver.dg.arrays, stacked)
+        return fn(self._arg_subset(), stacked)
 
     def _compile_group_async(self, Bb: int, stacked: Dict) -> None:
         import atexit
@@ -2808,7 +2820,7 @@ class _CompiledPlan(_AotWarmup):
                             jax.vmap(replay, in_axes=(None, 0))
                         )
                         with _TRACE_LOCK:
-                            res = fn(dict(self.solver.dg.arrays), stacked)
+                            res = fn(self._arg_subset(), stacked)
                             jax.block_until_ready(res)
                         if (
                             isinstance(res, tuple)
@@ -3053,20 +3065,45 @@ def _translate_remember(stmt, verdict) -> None:
 def _record(db, stmt, params):
     """Recording first execution: eager solve with blocking size observes.
     Returns (plan, rows). Holds the trace lock: an eager solve must not
-    interleave with a background warm-up's trace (see _TRACE_LOCK)."""
+    interleave with a background warm-up's trace (see _TRACE_LOCK).
+
+    The recording runs under the device graph's TOUCH LOG: every array
+    key the solve reads becomes the plan's jit-arg subset
+    (``arg_keys``), so lazily pruned columns uploading later never
+    change a cached plan's pytree structure — and a plan ships only the
+    graph arrays it actually uses to its executable."""
     stmt, element_alias = _translate(stmt)
+    snap = db.current_snapshot(require_fresh=True)
+    dg = device_graph(snap)
     with _TRACE_LOCK:
-        if isinstance(stmt, A.MatchStatement):
-            solver = TpuMatchSolver(
-                db, stmt, params, element_alias=element_alias
-            )
-            table = solver.solve_table()
-            rows = solver.rows_from_table(table)
-            return _CompiledPlan(solver, table), rows
-        tsolver = TpuTraverseSolver(db, stmt, params)
-        idx, total = tsolver.solve()
-        rows = tsolver.rows_from(np.asarray(idx), total)
-        return _CompiledTraverse(tsolver, total), rows
+        dg.start_touch_log()
+        try:
+            if isinstance(stmt, A.MatchStatement):
+                solver = TpuMatchSolver(
+                    db, stmt, params, element_alias=element_alias
+                )
+                table = solver.solve_table()
+                rows = solver.rows_from_table(table)
+                plan: object = _CompiledPlan(solver, table)
+            else:
+                tsolver = TpuTraverseSolver(db, stmt, params)
+                idx, total = tsolver.solve()
+                rows = tsolver.rows_from(np.asarray(idx), total)
+                plan = _CompiledTraverse(tsolver, total)
+        finally:
+            keys = dg.stop_touch_log()
+        if plan.solver.dg is not dg:
+            # a mutation re-attached the snapshot between our device_graph
+            # fetch and the solver's own: the reads landed on a DIFFERENT
+            # graph than the log watched — fall back to full-dict args
+            # (correct, just unpruned) instead of poisoning the plan with
+            # an empty subset
+            plan.arg_keys = None
+        else:
+            # an empty log can only mean the reads bypassed this tracker
+            # (unexpected): full-dict args are the safe fallback
+            plan.arg_keys = keys if keys else None
+        return plan, rows
 
 
 def _prepare(db, stmt, params):

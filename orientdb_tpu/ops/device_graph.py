@@ -53,15 +53,23 @@ class DeviceColumn:
         self.kind = col.kind
         self.dictionary = col.dictionary
         self._g = g
-        self._kv = g._put(f"{prefix}:v", col.values, shard_pad=shard_pad)
-        self._kp = g._put(f"{prefix}:p", col.present, shard_pad=shard_pad)
+        # LAZY upload (per-query property pruning, SURVEY.md §7's SF100
+        # memory plan): the host arrays are registered but reach HBM only
+        # when a compiled plan first reads the column — columns no query
+        # references never cost device memory
+        self._kv = g._put_lazy(f"{prefix}:v", col.values, shard_pad=shard_pad)
+        self._kp = g._put_lazy(
+            f"{prefix}:p", col.present, shard_pad=shard_pad
+        )
 
     @property
     def values(self):
+        self._g.ensure_key(self._kv)
         return self._g.arrays[self._kv]
 
     @property
     def present(self):
+        self._g.ensure_key(self._kp)
         return self._g.arrays[self._kp]
 
 
@@ -123,6 +131,47 @@ class DeviceEdgeClass:
         return self._g.arrays[f"{self._p}:edge_id_in"]
 
 
+class _TouchTracker:
+    """Recording-time view of the array store: logs every key read (the
+    plan's future jit-arg subset) and faults lazy columns in on first
+    read. Never reaches jax — dispatches always pass a plain dict."""
+
+    __slots__ = ("_g", "log")
+
+    def __init__(self, g: "DeviceGraph") -> None:
+        self._g = g
+        self.log: Set[str] = set()
+
+    def __getitem__(self, key: str):
+        self.log.add(key)
+        g = self._g
+        if key not in g._arrays:
+            with g._pending_lock:
+                spec = g._pending.pop(key, None)
+            if spec is not None:
+                arr, shard_pad, fill = spec
+                g._put(key, arr, shard_pad=shard_pad, fill=fill)
+        return g._arrays[key]
+
+    def get(self, key: str, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._g._arrays or key in self._g._pending
+
+    def __iter__(self):
+        return iter(self._g._arrays)
+
+    def keys(self):
+        return self._g._arrays.keys()
+
+    def __len__(self) -> int:
+        return len(self._g._arrays)
+
+
 class DeviceGraph:
     """The full snapshot in HBM plus host metadata for planning/marshal.
 
@@ -144,8 +193,15 @@ class DeviceGraph:
 
             self.mesh_graph = MeshGraph(mesh)
             self._replicated_spec = NamedSharding(mesh, PartitionSpec())
-        #: the single flat array store — a jit-arg pytree for compiled plans
+        #: the single flat array store — compiled plans pass a per-plan
+        #: KEY SUBSET of it as their jit-arg pytree (plans record the
+        #: keys they touch, so lazily uploaded columns growing this dict
+        #: never change any cached plan's pytree structure)
         self._arrays: Dict[str, jnp.ndarray] = {}
+        #: host arrays registered but not yet uploaded (lazy columns):
+        #: key -> (host_array, shard_pad, fill)
+        self._pending: Dict[str, tuple] = {}
+        self._pending_lock = threading.Lock()
         self._tls = threading.local()
         v_pad = self._shard_pad_rows(self.num_vertices)
         self._put("v_class", snap.v_class, shard_pad=v_pad, fill=-1)
@@ -167,7 +223,7 @@ class DeviceGraph:
         self.memory_report()  # publish hbm.* gauges for /metrics
 
     @property
-    def arrays(self) -> Dict[str, jnp.ndarray]:
+    def arrays(self):
         """The array store — per-thread overridable.
 
         Compiled plans swap in the jit tracer pytree for the duration of a
@@ -177,13 +233,31 @@ class DeviceGraph:
         thread-local storage, and assigning the canonical dict back clears
         it. Concurrent traces and eager solves on different threads each see
         their own view; `_put` writes to the canonical store directly so an
-        active override can never swallow an upload."""
+        active override can never swallow an upload.
+
+        During a RECORDING (``start_touch_log``) this thread instead sees
+        a tracking view that logs every key read — the recorded set
+        becomes the plan's jit-arg subset — and faults lazy columns in
+        on first read."""
         ov = getattr(self._tls, "override", None)
-        return self._arrays if ov is None else ov
+        if ov is not None:
+            return ov
+        trk = getattr(self._tls, "tracker", None)
+        return self._arrays if trk is None else trk
 
     @arrays.setter
     def arrays(self, value) -> None:
         self._tls.override = None if value is self._arrays else value
+
+    # -- recording touch log (per-plan jit-arg subsets) ---------------------
+
+    def start_touch_log(self) -> None:
+        self._tls.tracker = _TouchTracker(self)
+
+    def stop_touch_log(self) -> frozenset:
+        trk = getattr(self._tls, "tracker", None)
+        self._tls.tracker = None
+        return frozenset(trk.log) if trk is not None else frozenset()
 
     @property
     def mesh(self):
@@ -196,6 +270,36 @@ class DeviceGraph:
             return None
         S = self.mesh_graph.n_shards
         return max(1, -(-max(n, 1) // S)) * S
+
+    def _put_lazy(
+        self,
+        key: str,
+        arr,
+        shard_pad: Optional[int] = None,
+        fill: int = 0,
+    ) -> str:
+        """Register a host array for on-demand upload (`ensure_key`) —
+        the per-query property-pruning path. ``column_prune=False``
+        restores eager uploads."""
+        from orientdb_tpu.utils.config import config as _cfg
+
+        if not _cfg.column_prune:
+            return self._put(key, arr, shard_pad=shard_pad, fill=fill)
+        self._pending[key] = (arr, shard_pad, fill)
+        return key
+
+    def ensure_key(self, key: str) -> None:
+        """Upload a lazily registered array if it has not reached the
+        device yet; logs the touch when a recording is active."""
+        trk = getattr(self._tls, "tracker", None)
+        if trk is not None:
+            trk.log.add(key)
+        if key in self._pending:
+            with self._pending_lock:
+                spec = self._pending.pop(key, None)
+            if spec is not None:
+                arr, shard_pad, fill = spec
+                self._put(key, arr, shard_pad=shard_pad, fill=fill)
 
     def _put(
         self,
@@ -274,7 +378,19 @@ class DeviceGraph:
         for cat, b in cats.items():
             metrics.gauge(f"hbm.per_device.{cat}_bytes", b)
         metrics.gauge("hbm.per_device.total_bytes", sum(cats.values()))
-        return {"per_device": cats, "logical": logical}
+        # property pruning observables: columns registered but never
+        # referenced by any compiled plan stay host-side
+        pruned_bytes = sum(
+            int(np.asarray(a).nbytes) for a, _sp, _f in self._pending.values()
+        )
+        metrics.gauge("hbm.pruned_column_bytes", pruned_bytes)
+        metrics.gauge("hbm.pruned_column_arrays", len(self._pending))
+        return {
+            "per_device": cats,
+            "logical": logical,
+            "pruned_bytes": pruned_bytes,
+            "pruned_arrays": len(self._pending),
+        }
 
     def class_ids(self, class_name: str) -> jnp.ndarray:
         key = class_name.lower()
@@ -286,11 +402,22 @@ class DeviceGraph:
         return ids
 
 
+_DG_BUILD_LOCK = threading.Lock()
+
+
 def device_graph(snap: GraphSnapshot) -> DeviceGraph:
-    """Build (or fetch the cached) device form of a snapshot."""
+    """Build (or fetch the cached) device form of a snapshot.
+
+    Construction is locked: a concurrent first-touch stampede would
+    otherwise build SEVERAL DeviceGraphs for one snapshot (last writer
+    wins) — wasted uploads, and threads left holding different
+    instances, which breaks anything keyed on instance identity (the
+    recording touch log that feeds per-plan jit-arg subsets)."""
     cached: Optional[DeviceGraph] = getattr(snap, "_device_cache", None)
     if cached is not None:
         return cached
-    dg = DeviceGraph(snap)
-    snap._device_cache = dg
-    return dg
+    with _DG_BUILD_LOCK:
+        cached = getattr(snap, "_device_cache", None)
+        if cached is None:
+            cached = snap._device_cache = DeviceGraph(snap)
+    return cached

@@ -94,6 +94,12 @@ class GlobalConfiguration:
     # election.
     result_group_lane_bytes: int = 4 << 20
 
+    # Per-query property-column pruning (SURVEY.md §7's SF100 memory
+    # plan): property columns upload to HBM on a plan's first reference
+    # instead of eagerly at snapshot attach — columns no query touches
+    # never cost device memory. False restores eager uploads.
+    column_prune: bool = True
+
     # Query RESULT cache ([E] OCommandCache) — rows of idempotent queries
     # keyed by (sql, params, engine), invalidated by the mutation epoch.
     # Disabled by default, matching the reference.

@@ -1,0 +1,114 @@
+"""Per-query property-column pruning (VERDICT r4 #8; SURVEY.md §7's
+SF100 memory plan): property columns reach HBM only when a compiled
+plan first references them, and each plan's jit-arg pytree is the key
+subset its recording touched — so later uploads never retrace cached
+plans."""
+
+import numpy as np
+import pytest
+
+from orientdb_tpu.exec.tpu_engine import drain_warmups
+from orientdb_tpu.ops.device_graph import device_graph
+from orientdb_tpu.storage.ingest import generate_demodb
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+
+@pytest.fixture()
+def db():
+    d = generate_demodb(n_profiles=600, avg_friends=4, seed=21)
+    attach_fresh_snapshot(d)
+    return d
+
+
+class TestColumnPruning:
+    def test_unreferenced_columns_stay_host_side(self, db):
+        dg = device_graph(db.current_snapshot())
+        rep0 = dg.memory_report()
+        assert rep0["pruned_arrays"] > 0, "columns must start host-side"
+        # a COUNT over ages touches ONLY the age column
+        db.query(
+            "MATCH {class:Profiles, as:p, where:(age > 40)}"
+            "-HasFriend->{as:f} RETURN count(*) AS n",
+            engine="tpu",
+            strict=True,
+        )
+        rep1 = dg.memory_report()
+        uploaded = {
+            k for k in dg._arrays if k.startswith("v:") and ":v" in k
+        }
+        assert "v:age:v" in uploaded
+        assert "v:name:v" not in uploaded, "untouched column uploaded"
+        assert rep1["pruned_bytes"] < rep0["pruned_bytes"]
+        assert rep1["pruned_arrays"] > 0  # others still pruned
+
+    def test_later_upload_does_not_break_cached_plans(self, db):
+        q_age = (
+            "MATCH {class:Profiles, as:p, where:(age > 40)}"
+            "-HasFriend->{as:f} RETURN count(*) AS n"
+        )
+        want = db.query(q_age, engine="oracle").to_dicts()
+        assert db.query(q_age, engine="tpu", strict=True).to_dicts() == want
+        drain_warmups()
+        # a second query faults in MORE columns (name), growing the
+        # global store — the cached age plan must keep answering through
+        # its stable arg subset
+        q_name = (
+            "MATCH {class:Profiles, as:p, where:(name = 'p1')}"
+            "-HasFriend->{as:f} RETURN count(*) AS n"
+        )
+        db.query(q_name, engine="tpu", strict=True)
+        for _ in range(3):
+            assert (
+                db.query(q_age, engine="tpu", strict=True).to_dicts()
+                == want
+            )
+
+    def test_plans_carry_their_touched_key_subset(self, db):
+        from orientdb_tpu.exec.engine import parse_cached
+        from orientdb_tpu.exec.tpu_engine import _prepare
+
+        q = (
+            "MATCH {class:Profiles, as:p, where:(age > 40)}"
+            "-HasFriend->{as:f} RETURN count(*) AS n"
+        )
+        db.query(q, engine="tpu", strict=True)
+        v, _, _ = _prepare(db, parse_cached(q), {})
+        plan = v.plans[0]
+        keys = plan.arg_keys
+        assert keys, "recording must log touched keys"
+        assert "v:age:v" in keys
+        assert all(k in device_graph(db.current_snapshot())._arrays for k in keys)
+        assert not any("v:name" in k for k in keys)
+
+    def test_prune_disabled_uploads_eagerly(self, monkeypatch):
+        from orientdb_tpu.utils.config import config
+
+        monkeypatch.setattr(config, "column_prune", False)
+        d = generate_demodb(n_profiles=200, avg_friends=3, seed=22)
+        attach_fresh_snapshot(d)
+        rep = device_graph(d.current_snapshot()).memory_report()
+        assert rep["pruned_arrays"] == 0
+        assert rep["per_device"]["vertex_columns"] > 0
+
+    def test_batch_and_rows_paths_still_parity(self, db):
+        qs = [
+            "MATCH {class:Profiles, as:p, where:(age > :a)}"
+            "-HasFriend->{as:f} RETURN p.uid AS p, f.uid AS f"
+        ] * 6
+        plist = [{"a": 25 + i * 5} for i in range(6)]
+        canon = lambda rows: sorted(  # noqa: E731
+            tuple(sorted(r.items())) for r in rows
+        )
+        want = [
+            canon(db.query(q, params=p, engine="oracle").to_dicts())
+            for q, p in zip(qs, plist)
+        ]
+        for _ in range(3):
+            got = [
+                canon(rs.to_dicts())
+                for rs in db.query_batch(
+                    qs, params_list=plist, engine="tpu", strict=True
+                )
+            ]
+            assert got == want
+            drain_warmups()

@@ -138,7 +138,15 @@ class TestShardedMemoryScaling:
         def build(mesh):
             db = generate_demodb(n_profiles=4000, avg_friends=8, seed=3)
             attach_fresh_snapshot(db, mesh=mesh)
-            return device_graph(db.current_snapshot())
+            dg = device_graph(db.current_snapshot())
+            # property pruning keeps columns host-side until referenced;
+            # this test audits the sharded LAYOUT, so fault them all in
+            for col in dg.columns.values():
+                col.values, col.present
+            for ec in dg.edges.values():
+                for col in ec.columns.values():
+                    col.values, col.present
+            return dg
 
         dg1 = build(None)
         rep1 = dg1.memory_report()
