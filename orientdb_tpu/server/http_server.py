@@ -46,6 +46,17 @@ def _doc_json(doc: Document) -> dict:
     return out
 
 
+class _DeferredHttpError(Exception):
+    """An HTTP error decided inside a db-lock critical section but SENT
+    after the lock releases (a stalled client socket must never block
+    the database's write path)."""
+
+    def __init__(self, code: int, msg: str) -> None:
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "orientdb-tpu/0.1"
     protocol_version = "HTTP/1.1"
@@ -370,22 +381,26 @@ class _Handler(BaseHTTPRequestHandler):
                 # local saves (one wins, one 409s). _quorum_deferral sits
                 # OUTSIDE the lock so replica pushes still flush after it
                 # is released.
+                err = None  # (code, message) — SENT OUTSIDE the lock: a
+                # stalled client's socket must never block the database's
+                # write path (the success path serializes inside and
+                # sends outside for the same reason)
                 with db._quorum_deferral():
                     with db._lock:
                         doc = db.load(RID.parse(rest[1]))
                         if doc is None:
-                            return self._error(
-                                404, f"record {rest[1]} not found"
-                            )
-                        if base is not None and int(base) != doc.version:
+                            err = (404, f"record {rest[1]} not found")
+                        elif base is not None and int(base) != doc.version:
                             # forwarded saves carry their base version:
                             # MVCC must hold across the forward exactly
                             # as it does locally
-                            return self._error(
+                            err = (
                                 409,
                                 f"{doc.rid}: stored v{doc.version}"
                                 f" != base v{base}",
                             )
+                        if err is not None:
+                            raise _DeferredHttpError(*err)
                         # mutate the LIVE stored object only with a way
                         # back: a failed save (mandatory/unique/hook
                         # violation) must not leave the owner's record
@@ -444,6 +459,8 @@ class _Handler(BaseHTTPRequestHandler):
                 ConcurrentModificationError,
             )
 
+            if isinstance(e, _DeferredHttpError):
+                return self._error(e.code, e.msg)
             if isinstance(e, ConcurrentModificationError):
                 return self._error(409, str(e))
             if isinstance(e, urllib.error.HTTPError):

@@ -817,6 +817,11 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
                         os.remove(os.path.join(directory, f2))
                 except (ValueError, OSError):
                     pass
+    if db._cold_tier is not None:
+        # refresh the cold restart metadata: WAL archives below the
+        # checkpoint may now be pruned, so the meta must advance too or
+        # the cold reopen would need a range that no longer exists
+        db._cold_tier.write_meta()
     return path
 
 
@@ -921,6 +926,8 @@ def delta_checkpoint(db: Database, directory: Optional[str] = None) -> str:
             db._ckpt_base_lsn = payload["lsn"]
     _rotate_wal(db, directory)
     metrics.incr("checkpoint.delta")
+    if db._cold_tier is not None:
+        db._cold_tier.write_meta()  # keep the cold restart meta current
     return path
 
 
