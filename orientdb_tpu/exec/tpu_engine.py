@@ -2314,7 +2314,10 @@ class _AotWarmup:
         arrays = self.solver.dg.arrays
         keys = getattr(self, "arg_keys", None)
         if keys is None:
-            return arrays if isinstance(arrays, dict) else dict(arrays)
+            # SNAPSHOT the dict: another thread may fault a pruned
+            # column in (ensure_key -> _put) while jax flattens the
+            # pytree on this one
+            return dict(arrays)
         return {k: arrays[k] for k in keys}
 
     def _is_compiled(self) -> bool:

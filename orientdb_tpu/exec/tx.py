@@ -73,7 +73,20 @@ class Transaction:
     def _temp_rid(self) -> RID:
         return RID(-1, -next(self._temp_seq))
 
+    def _check_ownership(self, class_name: str) -> None:
+        """A LOCAL transaction must not buffer writes to a class another
+        member owns (per-class owner streams): committing them here
+        would fork the class's stream — rid collisions and divergence.
+        Cross-owner transactions need 2PC (documented delta); run the tx
+        against the owning member instead."""
+        if self.db._owner_for(class_name) is not None:
+            raise TxError(
+                f"class '{class_name}' is owned by another member; run "
+                "this transaction there (cross-owner tx needs 2PC)"
+            )
+
     def save(self, doc: Document) -> Document:
+        self._check_ownership(doc.class_name)
         if doc.rid in self.deleted:
             raise TxError(f"{doc.rid} deleted in this transaction")
         if not doc.rid.is_persistent:
@@ -150,6 +163,7 @@ class Transaction:
         self.workspace.pop(rid, None)
 
     def new_edge(self, class_name: str, src: Vertex, dst: Vertex, **fields) -> Edge:
+        self._check_ownership(class_name)
         cls = self.db.schema.get_class(class_name)
         if cls is None:
             cls = self.db.schema.create_edge_class(class_name)

@@ -144,6 +144,13 @@ class Database:
         # owning member instead of diverging locally ([E] the reference's
         # per-cluster server-owner routing). None = this node owns writes.
         self._write_owner = None
+        # Per-class ownership overrides ([E] ODistributedConfiguration's
+        # per-cluster server-owner lists): class (lower) -> WriteOwner to
+        # forward to, or None meaning THIS member owns the class locally
+        # even when _write_owner is set — two members then accept local
+        # writes for their classes CONCURRENTLY, each replicating its own
+        # stream (parallel/cluster.Cluster.assign_class_owner).
+        self._class_owners: Dict[str, object] = {}
 
     # -- WAL ---------------------------------------------------------------
 
@@ -154,6 +161,11 @@ class Database:
         no WAL trace (see exec/tx.py)."""
         w = self._wal
         if w is None or w.replaying:
+            return
+        if getattr(self._tx_local, "suppress_wal", False):
+            # applying a FOREIGN owner's replication stream (multi-owner
+            # mode): those entries belong to the other owner's WAL — re-
+            # logging them here would interleave streams and double-ship
             return
         if self._tx_suspended:
             buf = getattr(self._tx_local, "wal_buffer", None)
@@ -280,28 +292,41 @@ class Database:
 
     # -- record lifecycle --------------------------------------------------
 
-    def _reject_non_owner_tx(self) -> None:
-        """Writes buffered in a tx on a NON-OWNER member are rejected at
-        buffering time, not commit time: the local path would mutate the
-        replica's schema (class auto-creation is not tx-buffered) before
-        the commit-time TxError could stop it."""
-        if self._write_owner is not None and self.tx is not None:
-            from orientdb_tpu.exec.tx import TxError
+    def _owner_for(self, class_name: str):
+        """The WriteOwner this class's writes forward to, or None when
+        this member commits them locally (it owns the class — either as
+        the primary default or via a per-class assignment)."""
+        key = class_name.lower()
+        if key in self._class_owners:
+            return self._class_owners[key]
+        return self._write_owner
 
-            raise TxError(
-                "transactions must run against the cluster's write owner "
-                "(this member forwards writes per-record)"
-            )
+    def _forwarded_tx(self):
+        """The active ForwardedTransaction, or None. A tx on a NON-OWNER
+        member buffers with no local schema/store mutation and executes
+        at the owner on commit (parallel/forwarding.ForwardedTransaction
+        — [E] the reference's distributed tx task batch)."""
+        tx = self.tx
+        if tx is None:
+            return None
+        from orientdb_tpu.parallel.forwarding import ForwardedTransaction
+
+        return tx if isinstance(tx, ForwardedTransaction) else None
 
     def new_element(self, class_name: str = "O", **fields) -> Document:
         """Create (and save) a plain document."""
-        self._reject_non_owner_tx()
-        if self._write_owner is not None and self.tx is None:
+        if self._owner_for(class_name) is not None and self.tx is None:
             # non-owner member: forward BEFORE any local schema mutation
             # (auto-creating the class here would diverge this replica)
             doc = Document(class_name, fields)
             doc._db = self
             return self.save(doc)
+        ftx = self._forwarded_tx()
+        if ftx is not None:
+            # buffered for the owner: NO local schema mutation
+            doc = Document(class_name, fields)
+            doc._db = self
+            return ftx.save(doc)
         if not self.schema.exists_class(class_name):
             self.schema.create_class(class_name)
         doc = Document(class_name, fields)
@@ -331,7 +356,6 @@ class Database:
         ``db.save(new ORecordBytes(bytes))``)."""
         from orientdb_tpu.models.record import Blob
 
-        self._reject_non_owner_tx()
         if self._write_owner is None and not self.schema.exists_class(
             "OBlob"
         ):
@@ -343,13 +367,18 @@ class Database:
         return self.save(b)
 
     def new_vertex(self, class_name: str = "V", **fields) -> Vertex:
-        self._reject_non_owner_tx()
-        if self._write_owner is not None and self.tx is None:
+        if self._owner_for(class_name) is not None and self.tx is None:
             # non-owner: forward before local class auto-creation (see
             # new_element) — the owner resolves/creates the class
             v = Vertex(class_name, fields)
             v._db = self
             self.save(v)
+            return v
+        ftx = self._forwarded_tx()
+        if ftx is not None:
+            v = Vertex(class_name, fields)
+            v._db = self
+            ftx.save(v)
             return v
         cls = self._resolve_vertex_class(class_name)
         v = Vertex(cls.name, fields)
@@ -366,15 +395,18 @@ class Database:
         the source vertex appends to ``out_<cls>``, the target to
         ``in_<cls>``.
         """
-        self._reject_non_owner_tx()
-        if self._write_owner is not None and self.tx is None:
+        ftx = self._forwarded_tx()
+        if ftx is not None:
+            # buffered for the owner; endpoints may be tx-temps
+            return ftx.new_edge(class_name, src, dst, **fields)
+        if self._owner_for(class_name) is not None and self.tx is None:
             # non-owner: forward BEFORE local edge-class auto-creation
             # (the owner resolves/creates the class; see new_element)
             if not (src.rid.is_persistent and dst.rid.is_persistent):
                 raise ValueError(
                     "both endpoints must be saved before creating an edge"
                 )
-            resp = self._write_owner.create_edge(
+            resp = self._owner_for(class_name).create_edge(
                 class_name, src.rid, dst.rid, dict(fields)
             )
             e = Edge(class_name, fields)
@@ -413,7 +445,7 @@ class Database:
         tx = self.tx
         if tx is not None and not self._tx_suspended:
             return tx.save(doc)
-        if self._write_owner is not None:
+        if self._owner_for(doc.class_name) is not None:
             return self._forward_save(doc)
         # deferred quorum pushes ship after the lock is released (see
         # _quorum_push); also on failure — an entry logged before a
@@ -429,9 +461,10 @@ class Database:
             raise ValueError("edges are created via new_edge (forwarded)")
         from orientdb_tpu.models.record import Blob
 
+        owner = self._owner_for(doc.class_name)
         is_new = doc.rid is NEW_RID or not doc.rid.is_persistent
         if is_new:
-            resp = self._write_owner.create(
+            resp = owner.create(
                 doc.class_name,
                 doc.fields(),
                 kind="vertex"
@@ -440,7 +473,7 @@ class Database:
             )
             doc.rid = RID.parse(resp["@rid"])
         else:
-            resp = self._write_owner.update(
+            resp = owner.update(
                 doc.rid, doc.fields(), base_version=doc.version
             )
         doc.version = resp.get("@version", doc.version)
@@ -527,8 +560,8 @@ class Database:
         if tx is not None and not self._tx_suspended:
             tx.delete(doc)
             return
-        if self._write_owner is not None:
-            self._write_owner.delete(doc.rid)
+        if self._owner_for(doc.class_name) is not None:
+            self._owner_for(doc.class_name).delete(doc.rid)
             doc._deleted = True
             return
         with self._quorum_deferral():
@@ -710,9 +743,20 @@ class Database:
         self._tx_local.suspended = value
 
     def begin(self):
-        """Start an optimistic transaction ([E] ODatabaseSession.begin)."""
+        """Start an optimistic transaction ([E] ODatabaseSession.begin).
+        On a non-owner cluster member the transaction buffers locally
+        and EXECUTES AT THE OWNER on commit as one atomic batch ([E]
+        the distributed tx task, SURVEY.md:126)."""
         if self.tx is not None:
             raise RuntimeError("transaction already active on this thread")
+        if self._write_owner is not None:
+            from orientdb_tpu.parallel.forwarding import (
+                ForwardedTransaction,
+            )
+
+            t = ForwardedTransaction(self)
+            self._tx_local.tx = t
+            return t
         from orientdb_tpu.exec.tx import Transaction
 
         t = Transaction(self)

@@ -49,7 +49,7 @@ log = get_logger("cluster")
 class ClusterMember:
     """One node: an HTTP server fronting a local database."""
 
-    __slots__ = ("name", "server", "db", "role", "puller")
+    __slots__ = ("name", "server", "db", "role", "puller", "stream_pullers")
 
     def __init__(self, name: str, server, db: Database) -> None:
         self.name = name
@@ -57,6 +57,8 @@ class ClusterMember:
         self.db = db
         self.role = "REPLICA"  # PRIMARY | REPLICA | DOWN
         self.puller: Optional[ReplicaPuller] = None
+        #: owner-name -> named-stream puller (multi-owner mode)
+        self.stream_pullers: Dict[str, ReplicaPuller] = {}
 
     @property
     def url(self) -> str:
@@ -182,6 +184,8 @@ class Cluster:
         for m in members:
             if m.puller is not None:
                 m.puller.stop()
+            for p in m.stream_pullers.values():
+                p.stop()  # named-stream pullers (multi-owner mode)
             q = getattr(m.db, "_repl_quorum", None)
             if q is not None:
                 m.db._repl_quorum = None
@@ -383,22 +387,99 @@ class Cluster:
         except Exception:
             pass  # transient; the puller thread keeps retrying
 
+    # -- per-class owner streams (multi-owner writes) -----------------------
+
+    def assign_class_owner(self, class_name: str, member_name: str) -> None:
+        """Give ``member_name`` WRITE OWNERSHIP of one class ([E] the
+        reference's per-cluster server-owner lists,
+        ``ODistributedConfiguration``, SURVEY.md:126): that member then
+        accepts local writes for the class CONCURRENTLY with the
+        primary's writes to everything else — two owner streams instead
+        of one write-serialization point.
+
+        Mechanics: the owner's database arms as a second replication
+        source (its WAL carries ONLY its own locally-committed ops —
+        foreign-stream applies suppress re-logging); every other member
+        starts a NAMED-stream puller on it (delta-only, per-stream
+        floor) and forwards writes of this class to the new owner.
+
+        Scope (documented v2 limits): async replication mode only (no
+        quorum interplay); conflict semantics for two streams touching
+        one record are last-writer-wins by arrival; a dead SECONDARY
+        owner is not auto-detected — reassign its classes to a live
+        member by calling this again (routes and pullers update in
+        place); and a transaction's ops must all resolve to ONE owner
+        (cross-owner tx needs 2PC — both tx paths enforce this)."""
+        if self.write_quorum is not None:
+            raise ValueError(
+                "per-class owner streams need async mode (write_quorum "
+                "None): quorum counting is single-stream"
+            )
+        from orientdb_tpu.parallel.forwarding import WriteOwner
+
+        with self._lock:
+            owner = self.members[member_name]
+            key = class_name.lower()
+            # arm the owner as a delta-only replication source: members
+            # already hold its base state via the primary stream
+            enable_replication_source(owner.db)
+            owner.db._wal_base_exact_ok = True
+            # the owner commits this class locally even though it
+            # forwards everything else
+            owner.db._class_owners[key] = None
+            if not owner.db.schema.exists_class(class_name):
+                # DDL on the owner logs to ITS stream and replicates out
+                owner.db.schema.create_vertex_class(class_name)
+            route = WriteOwner(
+                owner.url, self.dbname, self.user, self.password
+            )
+            for m in self.members.values():
+                if m.name == member_name:
+                    continue
+                m.db._class_owners[key] = route
+                # one named-stream puller per (consumer, owner) pair
+                streams = m.stream_pullers
+                if member_name not in streams:
+                    p = ReplicaPuller(
+                        owner.url,
+                        self.dbname,
+                        m.db,
+                        user=self.user,
+                        password=self.password,
+                        interval=self.interval,
+                        down_after=self.down_after,
+                        stream=member_name,
+                    )
+                    streams[member_name] = p
+                    p.start()
+            metrics.incr("cluster.class_owner_assigned")
+
     # -- introspection ------------------------------------------------------
 
     def ownership(self) -> Dict[str, str]:
         """Per-class write-owner map ([E] ODistributedConfiguration's
-        server-owner lists). v1 policy: the primary owns every class's
-        clusters; the map is the routing surface non-owner members'
-        forwarding follows."""
+        server-owner lists). Default policy: the primary owns every
+        class's clusters; `assign_class_owner` overrides per class."""
         with self._lock:
             if self.primary is None:
                 return {}
             pdb = self.members[self.primary].db
-            return {
-                c.name: self.primary
+            assigned = {}  # lower -> (display name, owner member)
+            for m in self.members.values():
+                for cls, owner in m.db._class_owners.items():
+                    if owner is None:
+                        c = m.db.schema.get_class(cls)
+                        assigned[cls] = (c.name if c else cls, m.name)
+            out = {
+                c.name: assigned.get(c.name.lower(), (None, self.primary))[1]
                 for c in pdb.schema.classes()
                 if not c.abstract
             }
+            for _key, (disp, owner) in assigned.items():
+                # an assigned class may not have replicated into the
+                # primary's schema yet — it is still owned
+                out.setdefault(disp, owner)
+            return out
 
     def status(self) -> Dict:
         with self._lock:

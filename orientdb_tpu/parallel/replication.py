@@ -374,6 +374,7 @@ class ReplicaPuller:
         interval: float = 0.25,
         down_after: int = 4,
         on_source_down: Optional[Callable[[], None]] = None,
+        stream: Optional[str] = None,
     ) -> None:
         self.source_url = source_url.rstrip("/")
         self.dbname = dbname
@@ -383,6 +384,12 @@ class ReplicaPuller:
         self.interval = interval
         self.down_after = down_after
         self.on_source_down = on_source_down
+        #: multi-owner mode ([E] per-cluster owner lists): a NAMED stream
+        #: pulls a secondary owner's WAL — its floor lives in the db's
+        #: per-stream dict (not the primary floor), and applies suppress
+        #: local WAL logging (the entries belong to the OTHER owner's
+        #: stream; re-logging would interleave and double-ship them)
+        self.stream = stream
         self.applied_lsn = 0
         self.failures = 0
         self.status = "STARTING"  # STARTING | ONLINE | DOWN | PROMOTED
@@ -427,9 +434,7 @@ class ReplicaPuller:
         # past this puller's last pull — requesting from the stale cursor
         # would refetch the range, or worse demand a second checkpoint a
         # no-longer-fresh replica must refuse (ReplicationGap)
-        self.applied_lsn = max(
-            self.applied_lsn, getattr(self.db, "_repl_applied_lsn", 0)
-        )
+        self.applied_lsn = max(self.applied_lsn, self._db_floor())
         cred = base64.b64encode(
             f"{self.user}:{self.password}".encode()
         ).decode()
@@ -462,6 +467,16 @@ class ReplicaPuller:
                 # further entries can land from this puller — the cluster
                 # election relies on that to sample a settled applied LSN
                 return 0
+            if "checkpoint" in payload and self.stream is not None:
+                # a NAMED stream consumer already holds the base state
+                # (it arrived via the primary stream): restoring the
+                # secondary owner's full checkpoint would wipe this
+                # member — the secondary source must be armed with
+                # _wal_base_exact_ok (assign_class_owner does)
+                raise ReplicationGap(
+                    f"stream '{self.stream}' source offered a checkpoint; "
+                    "multi-owner streams are delta-only"
+                )
             if "checkpoint" in payload:
                 # full sync: the delta range is gone (late-armed source or
                 # pruned archives) — restore the shipped checkpoint
@@ -502,27 +517,47 @@ class ReplicaPuller:
                 self.db._repl_restored_ckpt_lsn = ckpt_lsn
                 metrics.incr("replication.full_sync")
                 return 1
-            floor = max(
-                self.applied_lsn, getattr(self.db, "_repl_applied_lsn", 0)
-            )
-            for e in payload["entries"]:
-                lsn = e["lsn"]
-                if lsn <= floor:
-                    # already in the db (possibly via the predecessor);
-                    # advance our cursor so the range isn't refetched
-                    if lsn > self.applied_lsn:
-                        self.applied_lsn = lsn
-                    continue
-                # a failing entry must NOT be skipped: advancing past it
-                # would silently diverge the replica while reporting
-                # ONLINE — raise, count as a failure, retry next pull
-                _apply_entry(self.db, e)
-                self.applied_lsn = floor = lsn
-                self.db._repl_applied_lsn = lsn
-                applied += 1
+            floor = max(self.applied_lsn, self._db_floor())
+            suppress = self.stream is not None
+            if suppress:
+                self.db._tx_local.suppress_wal = True
+            try:
+                for e in payload["entries"]:
+                    lsn = e["lsn"]
+                    if lsn <= floor:
+                        # already in the db (possibly via the
+                        # predecessor); advance our cursor so the range
+                        # isn't refetched
+                        if lsn > self.applied_lsn:
+                            self.applied_lsn = lsn
+                        continue
+                    # a failing entry must NOT be skipped: advancing past
+                    # it would silently diverge the replica while
+                    # reporting ONLINE — raise, count as a failure, retry
+                    _apply_entry(self.db, e)
+                    self.applied_lsn = floor = lsn
+                    self._set_db_floor(lsn)
+                    applied += 1
+            finally:
+                if suppress:
+                    self.db._tx_local.suppress_wal = False
         if applied:
             metrics.incr("replication.applied", applied)
         return applied
+
+    def _db_floor(self) -> int:
+        if self.stream is None:
+            return getattr(self.db, "_repl_applied_lsn", 0)
+        return getattr(self.db, "_repl_stream_floors", {}).get(
+            self.stream, 0
+        )
+
+    def _set_db_floor(self, lsn: int) -> None:
+        if self.stream is None:
+            self.db._repl_applied_lsn = lsn
+        else:
+            floors = self.db.__dict__.setdefault("_repl_stream_floors", {})
+            floors[self.stream] = lsn
 
     def _run(self) -> None:
         while not self._stop.is_set():

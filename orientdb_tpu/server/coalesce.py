@@ -94,8 +94,18 @@ class _DbWorker:
                     if self.window_s > 0.0:
                         # optional fixed collection window (arrivals
                         # sparser than device time): release the lock so
-                        # followers can queue during the wait
-                        self._cond.wait(self.window_s)
+                        # followers can queue during the wait. Followers'
+                        # notify() wakes the wait early, so loop until
+                        # the DEADLINE — otherwise the window degrades
+                        # to wait-for-one-follower
+                        import time as _time
+
+                        deadline = _time.monotonic() + self.window_s
+                        while not self._stop:
+                            left = deadline - _time.monotonic()
+                            if left <= 0:
+                                break
+                            self._cond.wait(left)
                     batch, self._pending = self._pending, []
             if batch:
                 self._execute(batch)

@@ -318,6 +318,128 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     doc = db.new_element(cls, **payload)
                 return self._send(201, _doc_json(doc))
+            if head == "tx" and len(rest) == 1:
+                # forwarded-transaction execution ([E] the distributed tx
+                # task batch, SURVEY.md:126): the non-owner's buffered ops
+                # run here inside ONE local transaction — all-or-nothing,
+                # MVCC-checked against the forwarder's base versions
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                from orientdb_tpu.storage.durability import _dec
+
+                payload = json.loads(self._body() or b"{}")
+                ops = payload.get("ops", [])
+                # authorize PER OP KIND, matching the single-op routes:
+                # a delete inside a tx needs the delete grant, etc.
+                _actions = {
+                    "create": "create",
+                    "edge": "create",
+                    "update": "update",
+                    "delete": "delete",
+                }
+                for action in sorted(
+                    {_actions.get(op.get("kind"), "update") for op in ops}
+                ):
+                    self.server.ot_server.security.check(
+                        user, RES_RECORD, action
+                    )
+                results = []
+                temp_map = {}
+                db.begin()
+                try:
+                    for op in ops:
+                        kind = op["kind"]
+                        fields = {
+                            k: _dec(v)
+                            for k, v in op.get("fields", {}).items()
+                        }
+                        if kind == "create":
+                            if op.get("type") == "vertex":
+                                doc = db.new_vertex(op["class"], **fields)
+                            elif op.get("type") == "blob":
+                                doc = db.new_blob(
+                                    fields.pop("data", b"") or b""
+                                )
+                                for k, v in fields.items():
+                                    doc.set(k, v)
+                                db.save(doc)
+                            else:
+                                doc = db.new_element(op["class"], **fields)
+                            temp_map[op["temp"]] = doc
+                            results.append(doc)
+                        elif kind == "edge":
+                            src = temp_map.get(op["from"]) or db.load(
+                                RID.parse(op["from"])
+                            )
+                            dst = temp_map.get(op["to"]) or db.load(
+                                RID.parse(op["to"])
+                            )
+                            if src is None or dst is None:
+                                raise _DeferredHttpError(
+                                    404, "edge endpoint not found"
+                                )
+                            e = db.new_edge(op["class"], src, dst, **fields)
+                            temp_map[op["temp"]] = e
+                            results.append(e)
+                        elif kind == "update":
+                            cur = db.load(RID.parse(op["rid"]))
+                            if cur is None:
+                                raise _DeferredHttpError(
+                                    404, f"record {op['rid']} not found"
+                                )
+                            base = op.get("base_version")
+                            if base is not None and cur.version != base:
+                                raise _DeferredHttpError(
+                                    409,
+                                    f"{op['rid']}: stored v{cur.version}"
+                                    f" != base v{base}",
+                                )
+                            sent = set(fields)
+                            for k in list(cur.fields()):
+                                if k not in sent:
+                                    cur.remove_field(k)
+                            for k, v in fields.items():
+                                cur.set(k, v)
+                            db.save(cur)
+                            results.append(cur)
+                        elif kind == "delete":
+                            cur = db.load(RID.parse(op["rid"]))
+                            if cur is not None:
+                                db.delete(cur)
+                            results.append(None)
+                        else:
+                            raise _DeferredHttpError(
+                                400, f"unknown tx op {kind!r}"
+                            )
+                    mapping = db.commit()
+                    # the local tx remaps vertex rids in place but a
+                    # buffered edge object may keep its temp rid — the
+                    # commit mapping carries the real one
+                    for d in results:
+                        if d is not None and not d.rid.is_persistent:
+                            d.rid = mapping.get(d.rid, d.rid)
+                except BaseException:
+                    try:
+                        if db.tx is not None:
+                            db.tx.rollback()
+                    except Exception:
+                        pass
+                    raise
+                return self._send(
+                    200,
+                    {
+                        "results": [
+                            {}
+                            if d is None
+                            else {
+                                "@rid": str(d.rid),
+                                "@version": d.version,
+                            }
+                            for d in results
+                        ]
+                    },
+                )
             if head == "edge" and len(rest) == 1:
                 # forwarded edge create (parallel/forwarding): a typed
                 # route instead of SQL so field values round-trip exactly
@@ -341,6 +463,16 @@ class _Handler(BaseHTTPRequestHandler):
         except SecurityError as e:
             return self._error(403, str(e))
         except Exception as e:
+            from orientdb_tpu.models.database import (
+                ConcurrentModificationError,
+            )
+
+            if isinstance(e, _DeferredHttpError):
+                return self._error(e.code, e.msg)
+            if isinstance(e, ConcurrentModificationError):
+                # a forwarded tx losing an MVCC race maps back to the
+                # forwarder's ConcurrentModificationError, not a 500
+                return self._error(409, str(e))
             return self._error(500, f"{type(e).__name__}: {e}")
 
     def do_PUT(self):  # noqa: N802

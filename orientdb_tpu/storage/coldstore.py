@@ -409,7 +409,7 @@ def open_database_cold(
         WriteAheadLog,
         _apply_entry,
         _sync_schema,
-        _wal_segments,
+        wal_entries_above,
     )
 
     meta_path = os.path.join(directory, META_FILE)
@@ -447,17 +447,7 @@ def open_database_cold(
     # WAL tail: entries past the meta, minus DML the segment already has
     wal = WriteAheadLog(os.path.join(directory, WAL_FILE))
     wal.truncate_torn_tail()
-    entries = []
-    for seg in _wal_segments(directory):
-        base = os.path.basename(seg)
-        if base.startswith("wal-") and base.endswith(".log"):
-            try:
-                if int(base[4:-4]) <= meta_lsn:
-                    continue
-            except ValueError:
-                pass
-        entries.extend(WriteAheadLog(seg).read_entries())
-    entries.sort(key=lambda e: e["lsn"])
+    entries = wal_entries_above(directory, meta_lsn)
 
     def replay(e: Dict) -> None:
         op = e.get("op")
@@ -486,8 +476,6 @@ def open_database_cold(
     db._wal = wal
     try:
         for e in entries:
-            if e["lsn"] <= meta_lsn:
-                continue
             try:
                 replay(e)
             except Exception:

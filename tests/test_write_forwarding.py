@@ -22,6 +22,15 @@ def wait_for(cond, timeout=20.0, interval=0.02):
     return False
 
 
+def count_or_zero(db, cls):
+    """A member that has not applied the CREATE CLASS DDL yet holds 0
+    records of it — not an error (replication is async)."""
+    try:
+        return db.count_class(cls)
+    except ValueError:
+        return 0
+
+
 @pytest.fixture()
 def trio():
     servers = [Server(admin_password="pw") for _ in range(3)]
@@ -54,7 +63,7 @@ def test_replica_writes_forward_to_owner(trio):
     assert pdb.count_class("P") == 1
     # and replicates back to every member, including the writer
     assert wait_for(
-        lambda: all(m.db.count_class("P") == 1 for m in cl.members.values())
+        lambda: all(count_or_zero(m.db, "P") == 1 for m in cl.members.values())
     )
 
 
@@ -146,25 +155,70 @@ def test_ownership_map_and_promotion_clears_forwarding(trio):
     assert ndb.count_class("P") == 1
 
 
-def test_tx_on_non_owner_is_rejected_at_buffering(trio):
-    """Rejected when the write is BUFFERED, not at commit: the local tx
-    path would auto-create schema classes on the replica (DDL is not
-    tx-buffered) before a commit-time error could stop it."""
+def test_tx_on_non_owner_executes_at_owner(trio):
+    """VERDICT r4 #9: a transaction on a non-owner member EXECUTES at
+    the owner as one atomic batch instead of being rejected — with no
+    local schema divergence while buffering."""
     cl, servers, pdb = trio
     rdb = cl.members["n1"].db
-    from orientdb_tpu.exec.tx import TxError
-
+    # the fixture's P/L DDL replicates async: wait before browsing
+    assert wait_for(lambda: rdb.schema.exists_class("P"))
     tx = rdb.begin()
-    try:
-        with pytest.raises(TxError):
-            rdb.new_vertex("P", uid=5)
-        # no local schema divergence happened
-        assert not rdb.schema.exists_class("NewCls")
-        with pytest.raises(TxError):
-            rdb.new_element("NewCls", x=1)
-        assert not rdb.schema.exists_class("NewCls")
-    finally:
-        tx.rollback()
+    a = rdb.new_vertex("P", uid=50)
+    b = rdb.new_vertex("P", uid=51)
+    e = rdb.new_edge("L", a, b, w=9)
+    rdb.new_element("NewCls", x=1)
+    # buffering caused NO local schema mutation
+    assert not rdb.schema.exists_class("NewCls")
+    # read-your-writes inside the buffer
+    assert sorted(d["uid"] for d in rdb.browse_class("P")) == [50, 51]
+    tx.commit()
+    # owner holds the whole batch, with real rids adopted locally
+    assert a.rid.is_persistent and b.rid.is_persistent
+    assert pdb.count_class("P") == 2
+    assert pdb.count_class("NewCls") == 1
+    row = pdb.query(
+        "MATCH {class:P, as:x, where:(uid=50)}-L->{as:y} "
+        "RETURN y.uid AS y"
+    ).to_dicts()
+    assert row == [{"y": 51}]
+    assert e.rid.is_persistent
+    # and replication carries it back to the buffering member
+    assert wait_for(lambda: rdb.count_class("P") == 2)
+
+
+def test_forwarded_tx_rollback_ships_nothing(trio):
+    cl, servers, pdb = trio
+    rdb = cl.members["n1"].db
+    tx = rdb.begin()
+    rdb.new_vertex("P", uid=60)
+    tx.rollback()
+    assert pdb.count_class("P") == 0
+    assert rdb.tx is None
+
+
+def test_forwarded_tx_mvcc_conflict_aborts_whole_batch(trio):
+    from orientdb_tpu.models.database import ConcurrentModificationError
+
+    cl, servers, pdb = trio
+    rdb = cl.members["n1"].db
+    v = rdb.new_vertex("P", uid=70, n=0)  # per-record forward (no tx)
+    assert wait_for(lambda: rdb.load(v.rid) is not None)
+    stale = rdb.load(v.rid)
+    base_doc_fields = dict(stale.fields())
+    tx = rdb.begin()
+    rdb.new_vertex("P", uid=71)  # first op would succeed alone
+    stale.set("n", 1)
+    rdb.save(stale)
+    # concurrent writer bumps the version at the owner
+    owner_doc = pdb.load(v.rid)
+    owner_doc.set("n", 99)
+    pdb.save(owner_doc)
+    with pytest.raises(ConcurrentModificationError):
+        tx.commit()
+    # ATOMIC: the independent first op must not have leaked
+    assert pdb.query("SELECT FROM P WHERE uid = 71").to_dicts() == []
+    assert pdb.load(v.rid)["n"] == 99
 
 
 def test_forwarded_update_respects_mvcc(trio):
@@ -201,3 +255,95 @@ def test_forwarded_edge_unicode_fields(trio):
     rdb.new_edge("L", a, b, label="café—δ")
     rows = pdb.query("SELECT label FROM L").to_dicts()
     assert rows == [{"label": "café—δ"}]
+
+
+def test_two_owner_concurrent_local_writes(trio):
+    """VERDICT r4 #9: per-class owner streams — two members accept
+    LOCAL writes for their owned classes concurrently (no single
+    write-serialization point), each replicating its own stream; every
+    member converges on both classes."""
+    cl, servers, pdb = trio
+    n1db = cl.members["n1"].db
+    assert wait_for(lambda: n1db.schema.exists_class("P"))
+    cl.assign_class_owner("Q", "n1")
+    assert cl.ownership().get("Q") == "n1"
+    assert cl.ownership().get("P") == "n0"
+
+    # n1's Q write commits LOCALLY (object identity proves no forward)
+    q1 = n1db.new_vertex("Q", uid=1)
+    assert n1db.load(q1.rid) is q1, "owned-class write must be local"
+    # the primary's P write commits locally as always
+    p1 = pdb.new_vertex("P", uid=1)
+    assert pdb.load(p1.rid) is p1
+    # cross-class forwards: Q from the primary routes to n1; P from n1
+    # routes to the primary
+    q2 = pdb.new_vertex("Q", uid=2)
+    assert n1db.load(q2.rid) is not None, "Q write must land at n1"
+    p2 = n1db.new_vertex("P", uid=2)
+    assert pdb.load(p2.rid) is not None, "P write must land at n0"
+
+    # CONCURRENT writers on both owners, each to its own class: no
+    # serialization point, no errors
+    errs = []
+
+    def w(db, cls, base):
+        try:
+            for i in range(6):
+                db.new_vertex(cls, uid=base + i)
+        except Exception as e:  # pragma: no cover
+            errs.append(repr(e))
+
+    ts = [
+        threading.Thread(target=w, args=(pdb, "P", 100)),
+        threading.Thread(target=w, args=(n1db, "Q", 200)),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+
+    # every member converges on BOTH streams
+    def converged():
+        return all(
+            count_or_zero(m.db, "P") == 8 and count_or_zero(m.db, "Q") == 8
+            for m in cl.members.values()
+        )
+
+    assert wait_for(converged), {
+        m.name: (count_or_zero(m.db, "P"), count_or_zero(m.db, "Q"))
+        for m in cl.members.values()
+    }
+    want_q = sorted([1, 2] + list(range(200, 206)))
+    for m in cl.members.values():
+        assert sorted(d["uid"] for d in m.db.browse_class("Q")) == want_q
+
+
+def test_cross_owner_tx_is_rejected(trio):
+    """A transaction's ops must all resolve to ONE owner: mixing a
+    per-class-assigned class into a tx targeting another owner needs
+    2PC, which is a documented delta — both tx paths refuse."""
+    from orientdb_tpu.exec.tx import TxError
+
+    cl, servers, pdb = trio
+    cl.assign_class_owner("Q", "n1")
+    # local tx on the primary must not buffer a write to n1's class
+    pdb.begin()
+    try:
+        with pytest.raises(TxError):
+            pdb.new_vertex("Q", uid=1)
+    finally:
+        pdb.tx.rollback()
+    # forwarded tx on n1 (targets the primary) must not carry n1's OWN
+    # class either
+    n1db = cl.members["n1"].db
+    tx = n1db.begin()
+    try:
+        with pytest.raises(RuntimeError):
+            n1db.new_vertex("Q", uid=2)
+    finally:
+        tx.rollback()
+    # and nothing leaked anywhere
+    assert all(
+        count_or_zero(m.db, "Q") == 0 for m in cl.members.values()
+    )
