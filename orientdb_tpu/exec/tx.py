@@ -66,6 +66,12 @@ class Transaction:
         self.deleted: Dict[RID, Document] = {}
         #: (edge_doc, src_rid, dst_rid) — rids may be temporary
         self.edge_ops: List[Tuple[Edge, RID, RID]] = []
+        #: cross-owner sub-batches (parallel/twophase 2PC): owner-id →
+        #: {"owner", "ops", "created" {temp: (doc, op)}, "updated"
+        #: {ridstr: doc}} — ops for classes OTHER members own buffer
+        #: here and 2-phase-commit at their owners
+        self._foreign: Dict[int, Dict] = {}
+        self._foreign_deleted: set = set()
         self.active = True
 
     # -- tx-local operations ------------------------------------------------
@@ -73,20 +79,79 @@ class Transaction:
     def _temp_rid(self) -> RID:
         return RID(-1, -next(self._temp_seq))
 
-    def _check_ownership(self, class_name: str) -> None:
-        """A LOCAL transaction must not buffer writes to a class another
-        member owns (per-class owner streams): committing them here
-        would fork the class's stream — rid collisions and divergence.
-        Cross-owner transactions need 2PC (documented delta); run the tx
-        against the owning member instead."""
-        if self.db._owner_for(class_name) is not None:
-            raise TxError(
-                f"class '{class_name}' is owned by another member; run "
-                "this transaction there (cross-owner tx needs 2PC)"
-            )
+    def _foreign_batch(self, class_name: str):
+        """The cross-owner sub-batch this class's ops buffer into, or
+        None when this member owns the class (the op commits locally).
+        A transaction spanning both commits via 2PC at commit time
+        ([E] the reference's distributed tx, SURVEY.md:126)."""
+        owner = self.db._owner_for(class_name)
+        if owner is None:
+            return None
+        batch = self._foreign.get(id(owner))
+        if batch is None:
+            batch = self._foreign[id(owner)] = {
+                "owner": owner,
+                "ops": [],
+                "created": {},
+                "updated": {},
+            }
+        return batch
+
+    @staticmethod
+    def _enc_fields(doc: Document) -> Dict:
+        from orientdb_tpu.storage.durability import _enc_fields
+
+        return _enc_fields(doc)
+
+    def _foreign_save(self, batch, doc: Document) -> Document:
+        from orientdb_tpu.models.record import Blob
+
+        if not doc.rid.is_persistent and str(doc.rid) not in batch["created"]:
+            doc.rid = self._temp_rid()
+            doc.version = 0
+            doc._db = self.db
+            op = {
+                "kind": "create",
+                "type": "vertex"
+                if isinstance(doc, Vertex)
+                else "blob" if isinstance(doc, Blob) else "document",
+                "class": doc.class_name,
+                "temp": str(doc.rid),
+                "fields": self._enc_fields(doc),
+            }
+            batch["ops"].append(op)
+            batch["created"][str(doc.rid)] = (doc, op)
+            self.workspace[doc.rid] = doc
+            return doc
+        key = str(doc.rid)
+        if key in batch["created"]:
+            batch["created"][key][1]["fields"] = self._enc_fields(doc)
+            return doc
+        if key in batch["updated"]:
+            for o in batch["ops"]:
+                if o.get("kind") == "update" and o["rid"] == key:
+                    o["fields"] = self._enc_fields(doc)
+                    break
+            batch["updated"][key] = doc
+            return doc
+        batch["ops"].append(
+            {
+                "kind": "update",
+                "rid": key,
+                "base_version": doc.version,
+                "fields": self._enc_fields(doc),
+            }
+        )
+        batch["updated"][key] = doc
+        self.workspace[doc.rid] = doc
+        return doc
 
     def save(self, doc: Document) -> Document:
-        self._check_ownership(doc.class_name)
+        fb = self._foreign_batch(doc.class_name)
+        if fb is not None:
+            if doc.rid in self.deleted or doc.rid in self._foreign_deleted:
+                raise TxError(f"{doc.rid} deleted in this transaction")
+            return self._foreign_save(fb, doc)
         if doc.rid in self.deleted:
             raise TxError(f"{doc.rid} deleted in this transaction")
         if not doc.rid.is_persistent:
@@ -129,7 +194,7 @@ class Transaction:
             self._preimages[rid] = (dict(stored.fields()), stored.version)
 
     def load(self, rid: RID) -> Optional[Document]:
-        if rid in self.deleted:
+        if rid in self.deleted or rid in self._foreign_deleted:
             return None
         hit = self.workspace.get(rid)
         if hit is not None:
@@ -143,6 +208,19 @@ class Transaction:
 
     def delete(self, doc: Document) -> None:
         rid = doc.rid
+        fb = self._foreign_batch(doc.class_name)
+        if fb is not None:
+            key = str(rid)
+            if key in fb["created"]:
+                # deleting an uncommitted foreign record: drop its op
+                _d, op = fb["created"].pop(key)
+                fb["ops"] = [o for o in fb["ops"] if o is not op]
+                self.workspace.pop(rid, None)
+                return
+            fb["ops"].append({"kind": "delete", "rid": key})
+            self._foreign_deleted.add(rid)
+            self.workspace.pop(rid, None)
+            return
         if not rid.is_persistent:
             # deleting an uncommitted record: drop it from the tx, and (for
             # a vertex) cascade-drop uncommitted edges touching it — the
@@ -163,7 +241,25 @@ class Transaction:
         self.workspace.pop(rid, None)
 
     def new_edge(self, class_name: str, src: Vertex, dst: Vertex, **fields) -> Edge:
-        self._check_ownership(class_name)
+        fb = self._foreign_batch(class_name)
+        if fb is not None:
+            e = Edge(class_name, fields)
+            e._db = self.db
+            e.rid = self._temp_rid()
+            e.out_rid = src.rid
+            e.in_rid = dst.rid
+            op = {
+                "kind": "edge",
+                "class": class_name,
+                "temp": str(e.rid),
+                "from": str(src.rid),
+                "to": str(dst.rid),
+                "fields": self._enc_fields(e),
+            }
+            fb["ops"].append(op)
+            fb["created"][str(e.rid)] = (e, op)
+            self.workspace[e.rid] = e
+            return e
         cls = self.db.schema.get_class(class_name)
         if cls is None:
             cls = self.db.schema.create_edge_class(class_name)
@@ -185,7 +281,9 @@ class Transaction:
         def _member(doc):
             cls = self.db.schema.get_class(doc.class_name)
             if cls is None:
-                return False
+                # foreign-owned class unknown locally (the owner creates
+                # it at 2PC commit): exact name match only
+                return doc.class_name.lower() == class_name.lower()
             if cls.name.lower() == class_name.lower():
                 return True
             return polymorphic and cls.is_subclass_of(class_name)
@@ -196,10 +294,14 @@ class Transaction:
         for e, _s, _d in self.edge_ops:
             if _member(e):
                 yield e
+        for batch in self._foreign.values():
+            for doc, _op in batch["created"].values():
+                if _member(doc):
+                    yield doc
 
     def overlay(self, doc: Document) -> Optional[Document]:
         """Committed doc → tx view (updated copy, or None if tx-deleted)."""
-        if doc.rid in self.deleted:
+        if doc.rid in self.deleted or doc.rid in self._foreign_deleted:
             return None
         return self.workspace.get(doc.rid, doc)
 
@@ -211,11 +313,21 @@ class Transaction:
             raise TxError("transaction no longer active")
         db = self.db
         if getattr(db, "_write_owner", None) is not None:
-            raise TxError(
-                "transactions commit on the cluster's write owner; run "
-                "the tx against the primary (per-record forwarding is "
-                "not atomic)"
-            )
+            # a forwarding member still commits locally when every
+            # locally-buffered op's class is one it OWNS (per-class
+            # owner streams; twophase.execute_tx_ops drives this path) —
+            # foreign classes were routed to 2PC sub-batches at buffer
+            # time, so anything local here must resolve to None
+            for doc in list(self.created) + [
+                e for e, _s, _d in self.edge_ops
+            ]:
+                if db._owner_for(doc.class_name) is not None:
+                    raise TxError(
+                        f"class '{doc.class_name}' is owned by another "
+                        "member; buffered locally by mistake"
+                    )
+        if self._foreign:
+            return self._commit_distributed(db)
         try:
             # quorum pushes deferred during the locked apply (the
             # atomic tx entry) ship once the db-wide lock is free
@@ -229,9 +341,163 @@ class Transaction:
             self.rollback()
             raise
 
+    def _commit_distributed(self, db) -> Dict[RID, RID]:
+        """Cross-owner 2PC ([E] the reference's 2-phase distributed tx,
+        SURVEY.md:126), driven by twophase.run_coordinator: the LOCAL
+        write set participates via validate+lock at prepare and the
+        ordinary ``_commit_locked`` at phase 2; each foreign sub-batch
+        is a RemoteParticipant at its owner."""
+        import uuid
+
+        from orientdb_tpu.parallel import twophase as tp
+
+        txid = uuid.uuid4().hex
+        LOCAL = "local"
+        local_creates = {str(d.rid) for d in self.created} | {
+            str(e.rid) for e, _s, _d in self.edge_ops
+        }
+        local_refs = set()
+        for _e, s, d in self.edge_ops:
+            for r in (s, d):
+                rs = str(r)
+                if tp._is_temp(rs) and rs not in local_creates:
+                    local_refs.add(rs)
+        rows = [(LOCAL, local_creates, local_refs)]
+        mapping: Dict[RID, RID] = {}
+        outer = self
+
+        class _LocalTx(tp.Participant):
+            """The coordinator's own buffered ops as a participant."""
+
+            def __init__(self) -> None:
+                self.locked: List[RID] = []
+
+            def prepare(self, txid: str) -> None:
+                import time as _t
+
+                deadline = _t.time() + tp.DEFAULT_TTL
+                with db._lock:
+                    for rid, base in outer.dirty.items():
+                        db._check_2pc_lock(rid)
+                        stored = db._load_raw(rid)
+                        if rid in outer.deleted:
+                            if (
+                                stored is not None
+                                and stored.version != base
+                            ):
+                                outer._fail_conflict(
+                                    rid, stored.version, base
+                                )
+                        elif stored is None:
+                            raise TxError(f"{rid} vanished before commit")
+                        elif stored.version != base:
+                            outer._fail_conflict(rid, stored.version, base)
+                    for rid in set(outer.dirty) | set(outer.deleted):
+                        db._tx2pc_locks[rid] = (txid, deadline)
+                        self.locked.append(rid)
+
+            def _unlock(self, txid: str) -> None:
+                with db._lock:
+                    for rid in self.locked:
+                        held = db._tx2pc_locks.get(rid)
+                        if held is not None and held[0] == txid:
+                            del db._tx2pc_locks[rid]
+                    self.locked = []
+
+            def commit(self, txid: str, rid_map: Dict[str, str]) -> None:
+                db._tx_local.tx2pc_commit = txid
+                try:
+                    outer._substitute_local_edges(db, rid_map)
+                    with db._quorum_deferral():
+                        with db._lock:
+                            local_map = outer._commit_locked(db)
+                finally:
+                    db._tx_local.tx2pc_commit = None
+                    self._unlock(txid)
+                mapping.update(local_map)
+                rid_map.update(
+                    {str(k): str(v) for k, v in local_map.items()}
+                )
+
+            def abort(self, txid: str) -> None:
+                self._unlock(txid)
+
+        parts: Dict[object, tp.Participant] = {LOCAL: _LocalTx()}
+        for key, batch in self._foreign.items():
+            c, r = tp.batch_temp_sets(batch["ops"])
+            rows.append((key, c, r))
+
+            def _adopt(ops, results, batch=batch):
+                for op, res in zip(ops, results):
+                    if op["kind"] in ("create", "edge") and res:
+                        doc, _ = batch["created"].get(
+                            op["temp"], (None, None)
+                        )
+                        if doc is None:
+                            continue
+                        old = doc.rid
+                        doc.rid = RID.parse(res["@rid"])
+                        doc.version = res.get("@version", 1)
+                        mapping[old] = doc.rid
+                    elif op["kind"] == "update" and res:
+                        d = batch["updated"].get(op["rid"])
+                        if d is not None:
+                            d.version = res.get("@version", d.version)
+
+            parts[key] = tp.RemoteParticipant(
+                batch["owner"], batch["ops"], _adopt
+            )
+        try:
+            tp.run_coordinator(txid, parts, rows)
+        except tp.TxInDoubtError:
+            # some participants applied: the tx is spent either way
+            if self.active:
+                self.active = False
+                db._end_tx(self)
+            raise
+        except Exception:
+            # clean abort: nothing applied anywhere
+            self.rollback()
+            raise
+        if self.active:
+            self.active = False
+            db._end_tx(self)
+        return mapping
+
+    def _substitute_local_edges(self, db, rid_map_str: Dict[str, str]) -> None:
+        """Rewrite local edge endpoints through rids other participants
+        assigned; a record committed at another owner arrives HERE via
+        async replication — poll briefly for it."""
+        import time as _time
+
+        if not rid_map_str:
+            return
+        deadline = _time.time() + 10.0
+        new_ops: List[Tuple[Edge, RID, RID]] = []
+        for e, s, d in self.edge_ops:
+            for end in ("out", "in"):
+                rid = s if end == "out" else d
+                real = rid_map_str.get(str(rid))
+                if real is not None:
+                    r = RID.parse(real)
+                    while (
+                        db._load_raw(r) is None
+                        and _time.time() < deadline
+                    ):
+                        _time.sleep(0.02)
+                    if end == "out":
+                        s = r
+                        e.out_rid = r
+                    else:
+                        d = r
+                        e.in_rid = r
+            new_ops.append((e, s, d))
+        self.edge_ops = new_ops
+
     def _commit_locked(self, db) -> Dict[RID, RID]:
             # phase 1: MVCC checks before any mutation (atomic fail-fast)
             for rid, base in self.dirty.items():
+                db._check_2pc_lock(rid)
                 stored = db._load_raw(rid)
                 if rid in self.deleted:
                     if stored is not None and stored.version != base:
@@ -317,6 +583,14 @@ class Transaction:
                 # quorum mode: the whole tx ships as ONE atomic entry and
                 # the commit blocks until a majority holds it
                 db._quorum_push(tx_entry, lsn)
+            # adopt real rids onto buffered edge objects (created docs
+            # were saved in place; edges are re-created, so the caller's
+            # handle would otherwise keep its temp rid forever)
+            for e, _s, _d in self.edge_ops:
+                if not e.rid.is_persistent:
+                    e.rid = rid_map.get(e.rid, e.rid)
+                e.out_rid = rid_map.get(e.out_rid, e.out_rid)
+                e.in_rid = rid_map.get(e.in_rid, e.in_rid)
             from orientdb_tpu.utils.metrics import metrics
 
             metrics.incr("tx.commit")

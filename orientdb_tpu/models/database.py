@@ -151,6 +151,11 @@ class Database:
         # writes for their classes CONCURRENTLY, each replicating its own
         # stream (parallel/cluster.Cluster.assign_class_owner).
         self._class_owners: Dict[str, object] = {}
+        # Cross-owner distributed transactions (parallel/twophase): rids
+        # locked by an in-flight prepared 2PC batch — every local write
+        # path refuses them until the batch commits/aborts/expires.
+        self._tx2pc_locks: Dict[RID, str] = {}
+        self._tx2pc_registry = None
 
     # -- WAL ---------------------------------------------------------------
 
@@ -301,6 +306,30 @@ class Database:
             return self._class_owners[key]
         return self._write_owner
 
+    def _check_2pc_lock(self, rid) -> None:
+        """Refuse a write to a rid locked by an in-flight prepared
+        distributed tx (parallel/twophase) — unless THIS thread is that
+        tx's own phase-2 commit, or the lock's deadline passed (presumed
+        abort: a vanished coordinator must not wedge the record; the
+        registry refuses a late commit of the expired txid). Callers
+        hold self._lock."""
+        if not self._tx2pc_locks:
+            return
+        held = self._tx2pc_locks.get(rid)
+        if held is None:
+            return
+        txid, deadline = held
+        if getattr(self._tx_local, "tx2pc_commit", None) == txid:
+            return
+        import time as _t
+
+        if _t.time() >= deadline:
+            del self._tx2pc_locks[rid]
+            return
+        raise ConcurrentModificationError(
+            f"{rid} is locked by in-flight distributed tx {txid}"
+        )
+
     def _forwarded_tx(self):
         """The active ForwardedTransaction, or None. A tx on a NON-OWNER
         member buffers with no local schema/store mutation and executes
@@ -327,6 +356,13 @@ class Database:
             doc = Document(class_name, fields)
             doc._db = self
             return ftx.save(doc)
+        tx = self.tx
+        if tx is not None and self._owner_for(class_name) is not None:
+            # foreign-owned class inside a local tx: NO local schema
+            # mutation (the 2PC sub-batch creates it at the owner)
+            doc = Document(class_name, fields)
+            doc._db = self
+            return tx.save(doc)
         if not self.schema.exists_class(class_name):
             self.schema.create_class(class_name)
         doc = Document(class_name, fields)
@@ -380,6 +416,15 @@ class Database:
             v._db = self
             ftx.save(v)
             return v
+        tx = self.tx
+        if tx is not None and self._owner_for(class_name) is not None:
+            # foreign-owned class inside a local tx: NO local schema
+            # mutation (the 2PC sub-batch creates it at the owner;
+            # auto-creating here would fork the owner's DDL stream)
+            v = Vertex(class_name, fields)
+            v._db = self
+            tx.save(v)
+            return v
         cls = self._resolve_vertex_class(class_name)
         v = Vertex(cls.name, fields)
         v._db = self
@@ -417,8 +462,17 @@ class Database:
                 e.rid = RID.parse(resp["@rid"])
                 e.version = resp.get("@version", 1)
             return e
-        cls = self._resolve_edge_class(class_name)
         tx = self.tx
+        if (
+            tx is not None
+            and not self._tx_suspended
+            and self._owner_for(class_name) is not None
+        ):
+            # foreign-owned edge class inside a local tx: NO local
+            # schema mutation (the 2PC sub-batch resolves it at the
+            # owner)
+            return tx.new_edge(class_name, src, dst, **fields)
+        cls = self._resolve_edge_class(class_name)
         if tx is not None and not self._tx_suspended:
             return tx.new_edge(cls.name, src, dst, **fields)
         if not (src.rid.is_persistent and dst.rid.is_persistent):
@@ -493,6 +547,8 @@ class Database:
                 # ORecordDuplicatedException).
                 self._indexes.validate_save(doc)
             is_new = doc.rid is NEW_RID or not doc.rid.is_persistent
+            if not is_new:
+                self._check_2pc_lock(doc.rid)
             if self._hooks is not None:
                 self._hooks.fire(
                     "before_create" if is_new else "before_update", doc
@@ -569,6 +625,8 @@ class Database:
 
     def _delete_locked(self, doc: Document) -> None:
         with self._lock:
+            if doc.rid.is_persistent:
+                self._check_2pc_lock(doc.rid)
             if self._hooks is not None:
                 self._hooks.fire("before_delete", doc)
             if isinstance(doc, Vertex):

@@ -32,6 +32,7 @@ tests use per SURVEY.md §4).
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, List, Optional
 
 from orientdb_tpu.models.database import Database
@@ -408,8 +409,8 @@ class Cluster:
         one record are last-writer-wins by arrival; a dead SECONDARY
         owner is not auto-detected — reassign its classes to a live
         member by calling this again (routes and pullers update in
-        place); and a transaction's ops must all resolve to ONE owner
-        (cross-owner tx needs 2PC — both tx paths enforce this)."""
+        place). Transactions MAY span owners: both tx paths commit
+        cross-owner batches through 2PC (parallel/twophase)."""
         if self.write_quorum is not None:
             raise ValueError(
                 "per-class owner streams need async mode (write_quorum "
@@ -417,19 +418,38 @@ class Cluster:
             )
         from orientdb_tpu.parallel.forwarding import WriteOwner
 
+        # DDL flows through the PRIMARY stream, never the owner's:
+        # record entries carry explicit rids, so cluster-id allocation
+        # must be identical on every member — two streams allocating
+        # clusters independently would silently collide rid spaces.
+        # The owner must HOLD the class before it accepts local writes.
+        owner = self.members[member_name]
+        pdb = self.members[self.primary].db
+        if not pdb.schema.exists_class(class_name):
+            pdb.schema.create_vertex_class(class_name)
+        deadline = _time.time() + 15.0
+        while (
+            not owner.db.schema.exists_class(class_name)
+            and _time.time() < deadline
+        ):
+            _time.sleep(0.02)
+        if not owner.db.schema.exists_class(class_name):
+            raise RuntimeError(
+                f"owner '{member_name}' did not replicate class "
+                f"'{class_name}' in time; cannot assign ownership"
+            )
         with self._lock:
-            owner = self.members[member_name]
             key = class_name.lower()
             # arm the owner as a delta-only replication source: members
-            # already hold its base state via the primary stream
+            # already hold its base state via the primary stream. Its
+            # WAL carries ONLY locally-committed ops — applies of the
+            # primary (or any foreign) stream suppress re-logging
             enable_replication_source(owner.db)
             owner.db._wal_base_exact_ok = True
+            owner.db._wal_foreign_suppress = True
             # the owner commits this class locally even though it
             # forwards everything else
             owner.db._class_owners[key] = None
-            if not owner.db.schema.exists_class(class_name):
-                # DDL on the owner logs to ITS stream and replicates out
-                owner.db.schema.create_vertex_class(class_name)
             route = WriteOwner(
                 owner.url, self.dbname, self.user, self.password
             )

@@ -146,6 +146,39 @@ class WriteOwner:
                 ) from None
             raise
 
+    def tx2pc(
+        self,
+        phase: str,
+        txid: str,
+        ops=None,
+        rid_map: Optional[Dict] = None,
+        ttl: Optional[float] = None,
+    ) -> Dict:
+        """One 2PC phase at this owner (parallel/twophase; [E] the
+        reference's 2-phase distributed tx, SURVEY.md:126). A version
+        conflict or a lock held by another in-flight distributed tx
+        surfaces as ConcurrentModificationError."""
+        metrics.incr(f"forwarding.tx2pc_{phase}")
+        payload: Dict = {"phase": phase, "txid": txid}
+        if ops is not None:
+            payload["ops"] = ops
+        if rid_map:
+            payload["rid_map"] = rid_map
+        if ttl is not None:
+            payload["ttl"] = ttl
+        try:
+            return self._req("POST", f"/tx2pc/{self.dbname}", payload)
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                from orientdb_tpu.models.database import (
+                    ConcurrentModificationError,
+                )
+
+                raise ConcurrentModificationError(
+                    e.read().decode(errors="replace")
+                ) from None
+            raise
+
     def create_edge(
         self, class_name: str, src: RID, dst: RID, fields: Dict
     ) -> Dict:
@@ -193,6 +226,9 @@ class ForwardedTransaction:
         #: rid -> buffered updated doc (read-your-writes)
         self._updated: Dict[RID, Document] = {}
         self._deleted: set = set()
+        #: owner-key -> WriteOwner for ops tagged "@owner" (per-class
+        #: owner streams: one tx may span owners → 2PC at commit)
+        self._owners: Dict[str, WriteOwner] = {}
 
     # -- buffering (the Database tx protocol) -------------------------------
 
@@ -203,25 +239,25 @@ class ForwardedTransaction:
 
     @staticmethod
     def _enc_fields(doc: Document) -> Dict:
-        from orientdb_tpu.storage.durability import _enc
+        from orientdb_tpu.storage.durability import _enc_fields
 
-        return {k: _enc(v) for k, v in doc.fields().items()}
+        return _enc_fields(doc)
 
-    def _check_ownership(self, class_name: str) -> None:
-        """This batch commits at db._write_owner; an op on a class with
-        a DIFFERENT resolved owner (locally owned here, or a per-class
-        assignment elsewhere) cannot ride it — cross-owner transactions
-        need 2PC (documented delta)."""
+    def _owner_key(self, class_name: str) -> str:
+        """Tag value routing this op to its owner's sub-batch at commit:
+        'local' = THIS member owns the class (per-class owner streams)
+        and the sub-batch commits here; otherwise a key into
+        ``self._owners``. One owner → the one-shot forwarded batch;
+        several → 2PC (parallel/twophase)."""
         owner = self.db._owner_for(class_name)
-        if owner is not self.db._write_owner:
-            raise RuntimeError(
-                f"class '{class_name}' resolves to a different owner than "
-                "this transaction's target; cross-owner tx needs 2PC"
-            )
+        if owner is None:
+            return "local"
+        key = f"o{id(owner)}"
+        self._owners[key] = owner
+        return key
 
     def save(self, doc: Document) -> Document:
         self._check_active()
-        self._check_ownership(doc.class_name)
         from orientdb_tpu.models.record import Blob, Vertex
 
         if not doc.rid.is_persistent and str(doc.rid) not in self._created:
@@ -236,6 +272,7 @@ class ForwardedTransaction:
                 "class": doc.class_name,
                 "temp": str(doc.rid),
                 "fields": self._enc_fields(doc),
+                "@owner": self._owner_key(doc.class_name),
             }
             self.ops.append(op)
             self._created[str(doc.rid)] = (doc, op)
@@ -259,6 +296,7 @@ class ForwardedTransaction:
             "rid": str(doc.rid),
             "base_version": doc.version,
             "fields": self._enc_fields(doc),
+            "@owner": self._owner_key(doc.class_name),
         }
         self.ops.append(op)
         self._updated[doc.rid] = doc
@@ -266,7 +304,6 @@ class ForwardedTransaction:
 
     def new_edge(self, class_name: str, src, dst, **fields):
         self._check_active()
-        self._check_ownership(class_name)
         from orientdb_tpu.models.record import Edge
 
         e = Edge(class_name, fields)
@@ -281,6 +318,7 @@ class ForwardedTransaction:
             "from": str(src.rid),
             "to": str(dst.rid),
             "fields": self._enc_fields(e),
+            "@owner": self._owner_key(class_name),
         }
         self.ops.append(op)
         self._created[str(e.rid)] = (e, op)
@@ -294,7 +332,13 @@ class ForwardedTransaction:
             _d, op = self._created.pop(key)
             self.ops = [o for o in self.ops if o is not op]
             return
-        self.ops.append({"kind": "delete", "rid": str(doc.rid)})
+        self.ops.append(
+            {
+                "kind": "delete",
+                "rid": str(doc.rid),
+                "@owner": self._owner_key(doc.class_name),
+            }
+        )
         self._deleted.add(doc.rid)
         doc._deleted = True
 
@@ -345,32 +389,85 @@ class ForwardedTransaction:
         if self.db.tx is self:
             self.db._tx_local.tx = None
 
+    def _adopt(self, ops, results, mapping: Optional[Dict] = None) -> Dict:
+        """Fold owner-assigned rids/versions back onto buffered docs."""
+        mapping = {} if mapping is None else mapping
+        for op, res in zip(ops, results):
+            if op["kind"] in ("create", "edge") and res:
+                doc, _ = self._created.get(op["temp"], (None, None))
+                if doc is None:
+                    continue
+                old = doc.rid
+                doc.rid = RID.parse(res["@rid"])
+                doc.version = res.get("@version", 1)
+                mapping[old] = doc.rid
+            elif op["kind"] == "update" and res:
+                d = self._updated.get(RID.parse(op["rid"]))
+                if d is not None:
+                    d.version = res.get("@version", d.version)
+        return mapping
+
     def commit(self) -> Dict:
-        """Ship the buffer to the owner; adopt assigned rids/versions.
-        Returns {temp_rid: real_rid} like the local tx commit."""
+        """Ship the buffer; adopt assigned rids/versions. Returns
+        {temp_rid: real_rid} like the local tx commit. One owner → one
+        atomic forwarded batch; a LOCAL-owned group commits here; ops
+        spanning owners run 2PC (parallel/twophase)."""
         self._check_active()
-        owner = self.db._write_owner
-        if owner is None:
-            raise TxErrorProxy("no write owner to forward to")
-        try:
-            if not self.ops:
-                return {}
-            resp = owner.transaction(self.ops)
-            mapping: Dict[RID, RID] = {}
-            for op, res in zip(self.ops, resp["results"]):
-                if op["kind"] in ("create", "edge"):
-                    doc, _ = self._created[op["temp"]]
-                    old = doc.rid
-                    doc.rid = RID.parse(res["@rid"])
-                    doc.version = res.get("@version", 1)
-                    mapping[old] = doc.rid
-                elif op["kind"] == "update":
-                    d = self._updated.get(RID.parse(op["rid"]))
-                    if d is not None:
-                        d.version = res.get("@version", d.version)
-            return mapping
-        finally:
-            self._finish()
+        # unbind first: a local sub-commit opens its own exec.tx
+        # Transaction on this thread
+        self._finish()
+        if not self.ops:
+            return {}
+        groups: Dict[str, list] = {}
+        for op in self.ops:
+            key = op.pop("@owner", None)
+            if key is None:  # pre-tag op (defensive): default owner
+                key = "o%d" % id(self.db._write_owner)
+                self._owners[key] = self.db._write_owner
+            groups.setdefault(key, []).append(op)
+        if len(groups) == 1:
+            key, ops = next(iter(groups.items()))
+            if key == "local":
+                from orientdb_tpu.parallel.twophase import execute_tx_ops
+
+                results, _tm = execute_tx_ops(self.db, ops)
+                return self._adopt(ops, results)
+            owner = self._owners.get(key) or self.db._write_owner
+            if owner is None:
+                raise TxErrorProxy("no write owner to forward to")
+            resp = owner.transaction(ops)
+            return self._adopt(ops, resp["results"])
+        return self._commit_two_phase(groups)
+
+    def _commit_two_phase(self, groups: Dict[str, list]) -> Dict:
+        """Coordinator for a forwarded tx spanning write owners ([E]
+        the reference's 2-phase distributed tx, SURVEY.md:126), driven
+        by twophase.run_coordinator. The LOCAL group (classes THIS
+        member owns) participates through the same registry/lock
+        machinery a remote owner uses."""
+        import uuid
+
+        from orientdb_tpu.parallel import twophase as tp
+
+        txid = uuid.uuid4().hex
+        rows = [(k, *tp.batch_temp_sets(ops)) for k, ops in groups.items()]
+        mapping: Dict = {}
+
+        def _adopt(ops, results):
+            self._adopt(ops, results, mapping)
+
+        parts: Dict[object, tp.Participant] = {}
+        for key, ops in groups.items():
+            if key == "local":
+                parts[key] = tp.LocalRegistryParticipant(
+                    self.db, ops, _adopt
+                )
+            else:
+                parts[key] = tp.RemoteParticipant(
+                    self._owners[key], ops, _adopt
+                )
+        tp.run_coordinator(txid, parts, rows)
+        return mapping
 
     def rollback(self) -> None:
         """Nothing shipped, nothing to undo locally: drop the buffer."""

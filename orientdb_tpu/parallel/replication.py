@@ -518,7 +518,15 @@ class ReplicaPuller:
                 metrics.incr("replication.full_sync")
                 return 1
             floor = max(self.applied_lsn, self._db_floor())
-            suppress = self.stream is not None
+            # named-stream consumers always suppress; a member armed as
+            # a secondary OWNER SOURCE (per-class owner streams) must
+            # suppress on EVERY puller — re-logging the primary's
+            # applied entries into its own WAL would double-ship them
+            # to every consumer of its stream (create_class crashes,
+            # interleaved rid spaces)
+            suppress = self.stream is not None or getattr(
+                self.db, "_wal_foreign_suppress", False
+            )
             if suppress:
                 self.db._tx_local.suppress_wal = True
             try:

@@ -1,0 +1,523 @@
+"""Two-phase commit for cross-owner distributed transactions.
+
+Analog of the reference's distributed transaction protocol ([E]
+``ONewDistributedTxContextImpl`` / 2-phase task batches shipped to each
+involved cluster-owner server, SURVEY.md:126): a transaction whose
+operations resolve to MORE THAN ONE write owner (per-class owner
+streams, ``Cluster.assign_class_owner``) executes as coordinator-driven
+2PC instead of being rejected.
+
+Protocol:
+
+- **Phase 1 (prepare)** — the coordinator partitions the buffered ops
+  by resolved owner and ships each remote sub-batch to its owner
+  (``POST /tx2pc/<db>`` ``phase=prepare``). The owner validates MVCC
+  base versions, acquires record locks on every updated/deleted rid
+  (``db._tx2pc_locks``), and stages the batch with a deadline. Locks
+  are honored by every local write path: a concurrent save/delete (or
+  local tx commit) touching a locked rid raises
+  ``ConcurrentModificationError`` until the stage resolves.
+- **Phase 2 (commit/abort)** — once every participant has prepared,
+  the coordinator commits participants in temp-reference dependency
+  order (a participant creating records referenced by another's edge
+  ops commits first), threading the accumulated ``{temp rid → real
+  rid}`` map through each commit. An abort (any prepare failing)
+  releases locks with nothing applied anywhere.
+- **Expiry (presumed abort)** — a staged batch whose coordinator
+  vanishes self-aborts after its TTL, releasing locks; a late commit
+  for an expired txid raises and the coordinator surfaces
+  ``TxInDoubtError``.
+
+The owner-side commit executes the sub-batch through one ordinary
+LOCAL transaction (``execute_tx_ops``), so it hits the WAL as a single
+atomic entry and replicates through the owner's own stream exactly
+like a directly-forwarded transaction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from orientdb_tpu.models.rid import RID
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("twophase")
+
+#: default seconds a prepared (locked) batch survives without a
+#: coordinator decision before presumed-abort releases its locks
+DEFAULT_TTL = 60.0
+
+
+class TwoPhaseError(Exception):
+    """Protocol error: unknown/expired txid, double prepare, etc."""
+
+
+class TxInDoubtError(Exception):
+    """A participant failed AFTER the commit decision: some
+    participants applied, this one did not. The coordinator surfaces
+    the partial state instead of pretending either outcome."""
+
+
+class TxOpError(Exception):
+    """An op inside a batch is malformed or references a missing
+    record; carries the HTTP status the wire route should answer."""
+
+    def __init__(self, code: int, msg: str) -> None:
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+def _is_temp(rid_str: str) -> bool:
+    """Temp rids are '#-1:-N' — cluster -1, negative position."""
+    return rid_str.startswith("#-1:")
+
+
+def substitute_rids(ops: List[Dict], rid_map: Dict[str, str]) -> None:
+    """Rewrite edge endpoints through the accumulated temp→real map
+    (in place). Only edge from/to carry cross-participant temps; link
+    FIELD values holding temps are a documented v1 non-feature."""
+    if not rid_map:
+        return
+    for op in ops:
+        if op.get("kind") == "edge":
+            op["from"] = rid_map.get(op["from"], op["from"])
+            op["to"] = rid_map.get(op["to"], op["to"])
+
+
+def _load_with_wait(db, rid: RID, deadline: float):
+    """Load a record, polling until ``deadline`` — a cross-owner edge
+    endpoint committed at another participant arrives here via async
+    replication moments after that participant's phase-2."""
+    doc = db.load(rid)
+    while doc is None and time.time() < deadline:
+        time.sleep(0.02)
+        doc = db.load(rid)
+    return doc
+
+
+def execute_tx_ops(
+    db, ops: List[Dict], endpoint_wait: float = 0.0
+) -> Tuple[List[Dict], Dict[str, str]]:
+    """Run a JSON op batch as ONE local transaction — all-or-nothing,
+    MVCC-checked against the shipped base versions. Shared by the
+    forwarded-tx route (``POST /tx``) and the 2PC commit phase.
+
+    Forces a LOCAL ``exec.tx.Transaction`` even on a member whose
+    ``db.begin()`` would hand back a ForwardedTransaction: by
+    construction every op in the batch is for a class THIS member owns.
+
+    Returns ``(results, temp_map)`` — ``results`` aligned with ``ops``
+    as ``{"@rid": ..., "@version": ...}`` dicts (``{}`` for deletes),
+    ``temp_map`` mapping shipped temp rid strings to real rid strings.
+    """
+    from orientdb_tpu.exec.tx import Transaction
+    from orientdb_tpu.storage.durability import _dec
+
+    if db.tx is not None:
+        raise TwoPhaseError("transaction already active on this thread")
+    deadline = time.time() + endpoint_wait
+    results: List[Optional[object]] = []
+    temp_map: Dict[str, object] = {}
+    t = Transaction(db)
+    db._tx_local.tx = t
+    try:
+        for op in ops:
+            kind = op["kind"]
+            fields = {k: _dec(v) for k, v in op.get("fields", {}).items()}
+            if kind == "create":
+                if op.get("type") == "vertex":
+                    doc = db.new_vertex(op["class"], **fields)
+                elif op.get("type") == "blob":
+                    doc = db.new_blob(fields.pop("data", b"") or b"")
+                    for k, v in fields.items():
+                        doc.set(k, v)
+                    db.save(doc)
+                else:
+                    doc = db.new_element(op["class"], **fields)
+                temp_map[op["temp"]] = doc
+                results.append(doc)
+            elif kind == "edge":
+                src = temp_map.get(op["from"]) or _load_with_wait(
+                    db, RID.parse(op["from"]), deadline
+                )
+                dst = temp_map.get(op["to"]) or _load_with_wait(
+                    db, RID.parse(op["to"]), deadline
+                )
+                if src is None or dst is None:
+                    raise TxOpError(404, "edge endpoint not found")
+                e = db.new_edge(op["class"], src, dst, **fields)
+                temp_map[op["temp"]] = e
+                results.append(e)
+            elif kind == "update":
+                cur = db.load(RID.parse(op["rid"]))
+                if cur is None:
+                    raise TxOpError(404, f"record {op['rid']} not found")
+                base = op.get("base_version")
+                if base is not None and cur.version != base:
+                    from orientdb_tpu.models.database import (
+                        ConcurrentModificationError,
+                    )
+
+                    raise ConcurrentModificationError(
+                        f"{op['rid']}: stored v{cur.version} != base v{base}"
+                    )
+                sent = set(fields)
+                for k in list(cur.fields()):
+                    if k not in sent:
+                        cur.remove_field(k)
+                for k, v in fields.items():
+                    cur.set(k, v)
+                db.save(cur)
+                results.append(cur)
+            elif kind == "delete":
+                cur = db.load(RID.parse(op["rid"]))
+                if cur is not None:
+                    db.delete(cur)
+                results.append(None)
+            else:
+                raise TxOpError(400, f"unknown tx op {kind!r}")
+        mapping = db.commit()
+        # the local tx remaps created rids in place, but a buffered
+        # edge object may keep its temp rid — the mapping carries it
+        for d in results:
+            if d is not None and not d.rid.is_persistent:
+                d.rid = mapping.get(d.rid, d.rid)
+    except BaseException:
+        try:
+            if db.tx is t:
+                t.rollback()
+        except Exception:
+            pass
+        raise
+    return (
+        [
+            {}
+            if d is None
+            else {"@rid": str(d.rid), "@version": d.version}
+            for d in results
+        ],
+        {
+            temp: str(doc.rid)
+            for temp, doc in temp_map.items()
+            if doc is not None
+        },
+    )
+
+
+class _Staged:
+    __slots__ = ("txid", "ops", "locks", "deadline")
+
+    def __init__(self, txid, ops, locks, deadline):
+        self.txid = txid
+        self.ops = ops
+        self.locks = locks
+        self.deadline = deadline
+
+
+class TwoPhaseRegistry:
+    """Participant-side staging: one per Database, created lazily by
+    :func:`get_registry`. Thread-safe and thread-AGNOSTIC — prepare and
+    commit arrive on different server threads."""
+
+    def __init__(self, db) -> None:
+        self.db = db
+        self._mu = threading.Lock()
+        self._staged: Dict[str, _Staged] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def prepare(self, txid: str, ops: List[Dict], ttl: float = DEFAULT_TTL):
+        """Validate MVCC bases and lock every written rid. Raises
+        ConcurrentModificationError on a version mismatch or a live
+        lock held by another in-flight distributed tx. Locks carry the
+        stage's deadline so writers treat an expired lock as free even
+        if no registry call ever sweeps it (presumed abort needs no
+        timer thread)."""
+        from orientdb_tpu.models.database import ConcurrentModificationError
+
+        self.sweep()
+        deadline = time.time() + ttl
+        lock_rids = []
+        for op in ops:
+            if op.get("kind") in ("update", "delete") and "rid" in op:
+                lock_rids.append(RID.parse(op["rid"]))
+        db = self.db
+        with self._mu:
+            if txid in self._staged:
+                raise TwoPhaseError(f"tx {txid} already prepared here")
+            with db._lock:
+                for op in ops:
+                    if op.get("kind") != "update":
+                        continue
+                    rid = RID.parse(op["rid"])
+                    cur = db._load_raw(rid)
+                    if cur is None:
+                        raise TxOpError(
+                            404, f"record {op['rid']} not found"
+                        )
+                    base = op.get("base_version")
+                    if base is not None and cur.version != base:
+                        metrics.incr("tx2pc.conflict")
+                        raise ConcurrentModificationError(
+                            f"{op['rid']}: stored v{cur.version} != "
+                            f"base v{base}"
+                        )
+                locks = db._tx2pc_locks
+                now = time.time()
+                for rid in lock_rids:
+                    held = locks.get(rid)
+                    if (
+                        held is not None
+                        and held[0] != txid
+                        and held[1] > now
+                    ):
+                        metrics.incr("tx2pc.conflict")
+                        raise ConcurrentModificationError(
+                            f"{rid} is locked by distributed tx {held[0]}"
+                        )
+                for rid in lock_rids:
+                    locks[rid] = (txid, deadline)
+            self._staged[txid] = _Staged(txid, ops, lock_rids, deadline)
+        metrics.incr("tx2pc.prepare")
+
+    def commit(
+        self, txid: str, rid_map: Optional[Dict[str, str]] = None
+    ) -> Tuple[List[Dict], Dict[str, str]]:
+        """Execute the staged batch as one local tx; release locks.
+        Raises TwoPhaseError when the txid is unknown (never prepared,
+        aborted, or expired — the coordinator maps that to in-doubt)."""
+        with self._mu:
+            self._sweep_locked()
+            st = self._staged.pop(txid, None)
+        if st is None:
+            raise TwoPhaseError(
+                f"tx {txid} not prepared here (expired or aborted)"
+            )
+        db = self.db
+        ops = st.ops
+        if rid_map:
+            substitute_rids(ops, rid_map)
+        tl = db._tx_local
+        tl.tx2pc_commit = txid
+        try:
+            out = execute_tx_ops(db, ops, endpoint_wait=10.0)
+        finally:
+            tl.tx2pc_commit = None
+            self._release(st)
+        metrics.incr("tx2pc.commit")
+        return out
+
+    def abort(self, txid: str) -> None:
+        with self._mu:
+            st = self._staged.pop(txid, None)
+        if st is not None:
+            self._release(st)
+            metrics.incr("tx2pc.abort")
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _release(self, st: _Staged) -> None:
+        db = self.db
+        with db._lock:
+            for rid in st.locks:
+                held = db._tx2pc_locks.get(rid)
+                if held is not None and held[0] == st.txid:
+                    del db._tx2pc_locks[rid]
+
+    def sweep(self) -> None:
+        """Presumed abort: drop staged batches past their deadline."""
+        with self._mu:
+            self._sweep_locked()
+
+    def _sweep_locked(self) -> None:
+        now = time.time()
+        for txid in [
+            t for t, s in self._staged.items() if s.deadline < now
+        ]:
+            st = self._staged.pop(txid)
+            self._release(st)
+            metrics.incr("tx2pc.expired")
+            log.warning(
+                "2pc tx %s expired after %.0fs without a coordinator "
+                "decision; locks released (presumed abort)",
+                txid,
+                DEFAULT_TTL,
+            )
+
+
+def get_registry(db) -> TwoPhaseRegistry:
+    reg = getattr(db, "_tx2pc_registry", None)
+    if reg is None:
+        with db._lock:
+            reg = getattr(db, "_tx2pc_registry", None)
+            if reg is None:
+                reg = db._tx2pc_registry = TwoPhaseRegistry(db)
+    return reg
+
+
+# -- coordinator ------------------------------------------------------------
+
+
+class Participant:
+    """One coordinated party: ``prepare``/``commit``/``abort`` keyed by
+    the coordinator's txid. ``commit`` receives (and extends) the
+    accumulated temp→real rid map."""
+
+    def prepare(self, txid: str) -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+    def commit(self, txid: str, rid_map: Dict[str, str]) -> None:
+        raise NotImplementedError  # pragma: no cover - protocol
+
+    def abort(self, txid: str) -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+class RemoteParticipant(Participant):
+    """A WriteOwner reached over the wire (``POST /tx2pc``)."""
+
+    def __init__(self, owner, ops: List[Dict], adopt) -> None:
+        self.owner = owner
+        self.ops = ops
+        self.adopt = adopt  # (ops, results) -> None
+
+    def prepare(self, txid: str) -> None:
+        self.owner.tx2pc("prepare", txid, ops=self.ops)
+
+    def commit(self, txid: str, rid_map: Dict[str, str]) -> None:
+        resp = self.owner.tx2pc("commit", txid, rid_map=rid_map)
+        self.adopt(self.ops, resp["results"])
+        for op, res in zip(self.ops, resp["results"]):
+            if "temp" in op and res:
+                rid_map[op["temp"]] = res["@rid"]
+
+    def abort(self, txid: str) -> None:
+        self.owner.tx2pc("abort", txid)
+
+
+class LocalRegistryParticipant(Participant):
+    """The coordinator's own database as a participant, driven through
+    the same registry/lock machinery a remote owner uses."""
+
+    def __init__(self, db, ops: List[Dict], adopt) -> None:
+        self.db = db
+        self.ops = ops
+        self.adopt = adopt
+
+    def prepare(self, txid: str) -> None:
+        get_registry(self.db).prepare(txid, self.ops)
+
+    def commit(self, txid: str, rid_map: Dict[str, str]) -> None:
+        results, temp_map = get_registry(self.db).commit(
+            txid, rid_map=rid_map
+        )
+        self.adopt(self.ops, results)
+        rid_map.update(temp_map)
+
+    def abort(self, txid: str) -> None:
+        get_registry(self.db).abort(txid)
+
+
+def run_coordinator(
+    txid: str,
+    parts: Dict[object, Participant],
+    rows: List[Tuple[object, set, set]],
+) -> Dict[str, str]:
+    """Drive one 2PC round over ``parts`` (key → participant; ``rows``
+    as for :func:`order_participants`). Phase 1 prepares everyone —
+    any failure aborts every prepared participant and re-raises (clean
+    abort, nothing applied). Phase 2 commits in temp-reference
+    dependency order, threading the accumulated rid map; a failure
+    BEFORE any commit is still a clean abort, a failure after one is
+    in-doubt (TxInDoubtError) but the remaining decided commits still
+    run. Returns the final temp→real rid map."""
+    order = order_participants(rows)
+    prepared: List[Participant] = []
+    try:
+        for p in parts.values():
+            p.prepare(txid)
+            prepared.append(p)
+    except Exception:
+        for p in prepared:
+            try:
+                p.abort(txid)
+            except Exception:  # pragma: no cover - best effort
+                pass
+        raise
+    rid_map: Dict[str, str] = {}
+    committed: List[object] = []
+    failures: List[str] = []
+    pending = list(order)
+    while pending:
+        key = pending.pop(0)
+        try:
+            parts[key].commit(txid, rid_map)
+            committed.append(key)
+        except Exception as e:
+            if not committed:
+                # nothing applied anywhere yet: clean abort
+                for k2 in pending:
+                    try:
+                        parts[k2].abort(txid)
+                    except Exception:  # pragma: no cover - best effort
+                        pass
+                raise
+            failures.append(f"{type(e).__name__}: {e}")
+    if failures:
+        metrics.incr("tx2pc.indoubt")
+        raise TxInDoubtError(
+            "distributed tx partially applied: " + "; ".join(failures)
+        )
+    metrics.incr("tx2pc.coordinated")
+    return rid_map
+
+
+def order_participants(
+    batches: List[Tuple[object, set, set]]
+) -> List[object]:
+    """Topologically order participants so that a participant creating
+    a temp rid commits BEFORE any participant whose ops reference it.
+    ``batches`` rows are ``(key, creates_temps, refs_temps)``. Raises
+    TwoPhaseError on a reference cycle (split the transaction)."""
+    owner_of = {}
+    for key, creates, _refs in batches:
+        for t in creates:
+            owner_of[t] = key
+    deps: Dict[object, set] = {key: set() for key, _c, _r in batches}
+    for key, _creates, refs in batches:
+        for t in refs:
+            src = owner_of.get(t)
+            if src is not None and src != key:
+                deps[key].add(src)
+    out: List[object] = []
+    ready = [k for k, d in deps.items() if not d]
+    while ready:
+        k = ready.pop()
+        out.append(k)
+        for k2, d in deps.items():
+            if k in d:
+                d.discard(k)
+                if not d and k2 not in out and k2 not in ready:
+                    ready.append(k2)
+    if len(out) != len(deps):
+        raise TwoPhaseError(
+            "cyclic cross-owner temp references in distributed tx; "
+            "split the transaction"
+        )
+    return out
+
+
+def batch_temp_sets(ops: List[Dict]) -> Tuple[set, set]:
+    """(creates_temps, refs_temps) for a JSON op batch."""
+    creates = {op["temp"] for op in ops if "temp" in op}
+    refs = set()
+    for op in ops:
+        if op.get("kind") == "edge":
+            for end in (op["from"], op["to"]):
+                if _is_temp(end) and end not in creates:
+                    refs.add(end)
+    return creates, refs

@@ -124,6 +124,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"database '{name}' not found")
         return db
 
+    def _check_tx_ops(self, user, ops) -> None:
+        """Authorize a tx op batch PER OP KIND, matching the single-op
+        routes: a delete inside a tx needs the delete grant, etc."""
+        _actions = {
+            "create": "create",
+            "edge": "create",
+            "update": "update",
+            "delete": "delete",
+        }
+        for action in sorted(
+            {_actions.get(op.get("kind"), "update") for op in ops}
+        ):
+            self.server.ot_server.security.check(user, RES_RECORD, action)
+
     # -- verbs --------------------------------------------------------------
 
     def do_GET(self):  # noqa: N802
@@ -326,120 +340,61 @@ class _Handler(BaseHTTPRequestHandler):
                 db = self._db(rest[0])
                 if db is None:
                     return
-                from orientdb_tpu.storage.durability import _dec
+                from orientdb_tpu.parallel.twophase import execute_tx_ops
 
                 payload = json.loads(self._body() or b"{}")
                 ops = payload.get("ops", [])
-                # authorize PER OP KIND, matching the single-op routes:
-                # a delete inside a tx needs the delete grant, etc.
-                _actions = {
-                    "create": "create",
-                    "edge": "create",
-                    "update": "update",
-                    "delete": "delete",
-                }
-                for action in sorted(
-                    {_actions.get(op.get("kind"), "update") for op in ops}
-                ):
-                    self.server.ot_server.security.check(
-                        user, RES_RECORD, action
-                    )
-                results = []
-                temp_map = {}
-                db.begin()
-                try:
-                    for op in ops:
-                        kind = op["kind"]
-                        fields = {
-                            k: _dec(v)
-                            for k, v in op.get("fields", {}).items()
-                        }
-                        if kind == "create":
-                            if op.get("type") == "vertex":
-                                doc = db.new_vertex(op["class"], **fields)
-                            elif op.get("type") == "blob":
-                                doc = db.new_blob(
-                                    fields.pop("data", b"") or b""
-                                )
-                                for k, v in fields.items():
-                                    doc.set(k, v)
-                                db.save(doc)
-                            else:
-                                doc = db.new_element(op["class"], **fields)
-                            temp_map[op["temp"]] = doc
-                            results.append(doc)
-                        elif kind == "edge":
-                            src = temp_map.get(op["from"]) or db.load(
-                                RID.parse(op["from"])
-                            )
-                            dst = temp_map.get(op["to"]) or db.load(
-                                RID.parse(op["to"])
-                            )
-                            if src is None or dst is None:
-                                raise _DeferredHttpError(
-                                    404, "edge endpoint not found"
-                                )
-                            e = db.new_edge(op["class"], src, dst, **fields)
-                            temp_map[op["temp"]] = e
-                            results.append(e)
-                        elif kind == "update":
-                            cur = db.load(RID.parse(op["rid"]))
-                            if cur is None:
-                                raise _DeferredHttpError(
-                                    404, f"record {op['rid']} not found"
-                                )
-                            base = op.get("base_version")
-                            if base is not None and cur.version != base:
-                                raise _DeferredHttpError(
-                                    409,
-                                    f"{op['rid']}: stored v{cur.version}"
-                                    f" != base v{base}",
-                                )
-                            sent = set(fields)
-                            for k in list(cur.fields()):
-                                if k not in sent:
-                                    cur.remove_field(k)
-                            for k, v in fields.items():
-                                cur.set(k, v)
-                            db.save(cur)
-                            results.append(cur)
-                        elif kind == "delete":
-                            cur = db.load(RID.parse(op["rid"]))
-                            if cur is not None:
-                                db.delete(cur)
-                            results.append(None)
-                        else:
-                            raise _DeferredHttpError(
-                                400, f"unknown tx op {kind!r}"
-                            )
-                    mapping = db.commit()
-                    # the local tx remaps vertex rids in place but a
-                    # buffered edge object may keep its temp rid — the
-                    # commit mapping carries the real one
-                    for d in results:
-                        if d is not None and not d.rid.is_persistent:
-                            d.rid = mapping.get(d.rid, d.rid)
-                except BaseException:
-                    try:
-                        if db.tx is not None:
-                            db.tx.rollback()
-                    except Exception:
-                        pass
-                    raise
-                return self._send(
-                    200,
-                    {
-                        "results": [
-                            {}
-                            if d is None
-                            else {
-                                "@rid": str(d.rid),
-                                "@version": d.version,
-                            }
-                            for d in results
-                        ]
-                    },
+                self._check_tx_ops(user, ops)
+                results, _tm = execute_tx_ops(db, ops)
+                return self._send(200, {"results": results})
+            if head == "tx2pc" and len(rest) == 1:
+                # 2-phase distributed tx participant ([E] SURVEY.md:126):
+                # prepare validates + locks, commit executes the staged
+                # batch as one local tx, abort releases — all keyed by
+                # the coordinator's txid (parallel/twophase)
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                from orientdb_tpu.parallel.twophase import (
+                    TwoPhaseError,
+                    get_registry,
                 )
+
+                payload = json.loads(self._body() or b"{}")
+                phase = payload.get("phase")
+                txid = payload.get("txid")
+                if not txid:
+                    return self._error(400, "txid required")
+                reg = get_registry(db)
+                if phase == "prepare":
+                    ops = payload.get("ops", [])
+                    self._check_tx_ops(user, ops)
+                    reg.prepare(
+                        txid, ops, ttl=float(payload.get("ttl", 60.0))
+                    )
+                    return self._send(200, {"prepared": txid})
+                if phase == "commit":
+                    self.server.ot_server.security.check(
+                        user, RES_RECORD, "update"
+                    )
+                    try:
+                        results, temp_map = reg.commit(
+                            txid, rid_map=payload.get("rid_map")
+                        )
+                    except TwoPhaseError as e:
+                        # expired/unknown: the coordinator maps 410 to
+                        # in-doubt (participant presumed abort)
+                        return self._error(410, str(e))
+                    return self._send(
+                        200, {"results": results, "temp_map": temp_map}
+                    )
+                if phase == "abort":
+                    self.server.ot_server.security.check(
+                        user, RES_RECORD, "update"
+                    )
+                    reg.abort(txid)
+                    return self._send(200, {"aborted": txid})
+                return self._error(400, f"unknown 2pc phase {phase!r}")
             if head == "edge" and len(rest) == 1:
                 # forwarded edge create (parallel/forwarding): a typed
                 # route instead of SQL so field values round-trip exactly
@@ -467,7 +422,11 @@ class _Handler(BaseHTTPRequestHandler):
                 ConcurrentModificationError,
             )
 
+            from orientdb_tpu.parallel.twophase import TxOpError
+
             if isinstance(e, _DeferredHttpError):
+                return self._error(e.code, e.msg)
+            if isinstance(e, TxOpError):
                 return self._error(e.code, e.msg)
             if isinstance(e, ConcurrentModificationError):
                 # a forwarded tx losing an MVCC race maps back to the

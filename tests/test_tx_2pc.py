@@ -1,0 +1,309 @@
+"""Cross-owner distributed transactions — 2PC (parallel/twophase).
+
+[E] the reference's 2-phase distributed tx (SURVEY.md:126,
+ONewDistributedTxContextImpl): a transaction whose ops resolve to more
+than one write owner prepares (validate + lock) at every participant,
+then commits in temp-reference dependency order — all-or-nothing
+across owners, with presumed-abort lock expiry."""
+
+import time
+
+import pytest
+
+from orientdb_tpu.models.database import (
+    ConcurrentModificationError,
+    Database,
+)
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.parallel.twophase import (
+    TwoPhaseError,
+    get_registry,
+)
+from orientdb_tpu.server.server import Server
+
+
+def wait_for(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def count_or_zero(db, cls):
+    try:
+        return db.count_class(cls)
+    except ValueError:
+        return 0
+
+
+@pytest.fixture()
+def duo():
+    """Async trio cluster with TWO write owners: n0 (primary) owns P
+    and L, n1 owns Q."""
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("f")
+    cl = Cluster("f", user="admin", password="pw", interval=0.05, down_after=2)
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    pdb.schema.create_edge_class("L")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    n1db = cl.members["n1"].db
+    assert wait_for(lambda: n1db.schema.exists_class("P"))
+    cl.assign_class_owner("Q", "n1")
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+class TestCrossOwnerCommit:
+    def test_tx_from_primary_commits_atomically(self, duo):
+        """A local tx on the primary carrying an op for n1's class no
+        longer rejects: the P op commits locally, the Q op 2-phase
+        commits at n1, and every member converges on both."""
+        cl, servers, pdb = duo
+        n1db = cl.members["n1"].db
+        pdb.begin()
+        p = pdb.new_vertex("P", uid=1)
+        q = pdb.new_vertex("Q", uid=2)
+        assert not p.rid.is_persistent and not q.rid.is_persistent
+        mapping = pdb.commit()
+        assert p.rid.is_persistent and q.rid.is_persistent
+        assert len(mapping) == 2
+        # P applied locally (object identity), Q landed at ITS owner
+        assert pdb.load(p.rid) is p
+        assert n1db.load(q.rid) is not None
+        assert wait_for(
+            lambda: all(
+                count_or_zero(m.db, "P") == 1
+                and count_or_zero(m.db, "Q") == 1
+                for m in cl.members.values()
+            )
+        ), {
+            m.name: (count_or_zero(m.db, "P"), count_or_zero(m.db, "Q"))
+            for m in cl.members.values()
+        }
+
+    def test_tx_from_secondary_owner(self, duo):
+        """On n1 (which owns Q but forwards P) one tx spanning both
+        classes commits Q locally and P at the primary."""
+        cl, servers, pdb = duo
+        n1db = cl.members["n1"].db
+        n1db.begin()
+        q = n1db.new_vertex("Q", uid=1)
+        p = n1db.new_vertex("P", uid=2)
+        n1db.commit()
+        assert q.rid.is_persistent and p.rid.is_persistent
+        # Q committed AT n1, P at the primary
+        assert n1db.load(q.rid) is not None
+        assert pdb.load(p.rid) is not None
+        assert wait_for(
+            lambda: all(
+                count_or_zero(m.db, "P") == 1
+                and count_or_zero(m.db, "Q") == 1
+                for m in cl.members.values()
+            )
+        )
+
+    def test_read_your_writes_inside_cross_owner_tx(self, duo):
+        cl, servers, pdb = duo
+        pdb.begin()
+        q = pdb.new_vertex("Q", uid=7)
+        # buffered foreign create visible to tx reads
+        assert pdb.load(q.rid) is q
+        rows = pdb.query("SELECT uid FROM Q").to_dicts()
+        assert {"uid": 7} in rows
+        pdb.rollback()
+        assert count_or_zero(pdb, "Q") == 0
+
+    def test_local_edge_to_foreign_created_vertex(self, duo):
+        """An edge in the primary-owned class L between a local P and a
+        Q created AT n1 in the same tx: n1's sub-batch commits first
+        (dependency order), the edge then links the owner-assigned rid
+        after replication delivers the vertex."""
+        cl, servers, pdb = duo
+        pdb.begin()
+        p = pdb.new_vertex("P", uid=1)
+        q = pdb.new_vertex("Q", uid=2)
+        e = pdb.new_edge("L", p, q)
+        pdb.commit()
+        assert e.rid.is_persistent
+        stored = pdb.load(e.rid)
+        assert stored is not None
+        assert stored.out_rid == p.rid and stored.in_rid == q.rid
+        # the graph is traversable across the cross-owner edge
+        rows = pdb.query(
+            "MATCH {class:P, as:a}-L->{as:b} RETURN a.uid, b.uid"
+        ).to_dicts()
+        assert rows == [{"a.uid": 1, "b.uid": 2}]
+
+    def test_rollback_ships_nothing(self, duo):
+        cl, servers, pdb = duo
+        pdb.begin()
+        pdb.new_vertex("P", uid=1)
+        pdb.new_vertex("Q", uid=2)
+        pdb.rollback()
+        time.sleep(0.3)
+        assert all(
+            count_or_zero(m.db, "P") == 0
+            and count_or_zero(m.db, "Q") == 0
+            for m in cl.members.values()
+        )
+
+
+class TestCrossOwnerAbort:
+    def test_prepare_conflict_aborts_everything(self, duo):
+        """A version conflict at ONE participant aborts the whole tx:
+        the local P create never lands either."""
+        cl, servers, pdb = duo
+        n1db = cl.members["n1"].db
+        q = n1db.new_vertex("Q", uid=1)
+        # wait for the primary's replica copy of q
+        assert wait_for(lambda: pdb.load(q.rid) is not None)
+        pdb.begin()
+        qc = pdb.load(q.rid)
+        qc.set("n", 1)
+        pdb.save(qc)  # foreign update, base = replicated version
+        pdb.new_vertex("P", uid=9)
+        # owner-side write bumps the version AFTER the tx read it
+        q2 = n1db.load(q.rid)
+        q2.set("x", 5)
+        n1db.save(q2)
+        with pytest.raises(ConcurrentModificationError):
+            pdb.commit()
+        # atomic abort: no P anywhere, and q keeps the OWNER's value
+        time.sleep(0.3)
+        assert all(
+            count_or_zero(m.db, "P") == 0 for m in cl.members.values()
+        )
+        assert n1db.load(q.rid).get("x") == 5
+        assert n1db.load(q.rid).get("n") is None
+
+
+class TestRegistryLocks:
+    def test_prepared_lock_blocks_writes_until_commit(self):
+        db = Database("x")
+        db.schema.create_class("C")
+        d = db.new_element("C", a=1)
+        reg = get_registry(db)
+        reg.prepare(
+            "t1",
+            [
+                {
+                    "kind": "update",
+                    "rid": str(d.rid),
+                    "base_version": d.version,
+                    "fields": {"a": 2},
+                }
+            ],
+        )
+        # a concurrent delete/save of the locked rid refuses
+        with pytest.raises(ConcurrentModificationError):
+            db.delete(d)
+        results, temp_map = reg.commit("t1")
+        assert results[0]["@rid"] == str(d.rid)
+        assert db.load(d.rid).get("a") == 2
+        # lock released: the write goes through now
+        cur = db.load(d.rid)
+        cur.set("a", 3)
+        db.save(cur)
+        assert db.load(d.rid).get("a") == 3
+
+    def test_abort_releases_locks(self):
+        db = Database("x")
+        db.schema.create_class("C")
+        d = db.new_element("C", a=1)
+        reg = get_registry(db)
+        ops = [
+            {
+                "kind": "update",
+                "rid": str(d.rid),
+                "base_version": d.version,
+                "fields": {"a": 2},
+            }
+        ]
+        reg.prepare("t2", ops)
+        reg.abort("t2")
+        assert db._tx2pc_locks == {}
+        assert db.load(d.rid).get("a") == 1
+        # an aborted txid cannot commit
+        with pytest.raises(TwoPhaseError):
+            reg.commit("t2")
+
+    def test_stale_base_version_refuses_prepare(self):
+        db = Database("x")
+        db.schema.create_class("C")
+        d = db.new_element("C", a=1)
+        v0 = d.version
+        d.set("a", 2)
+        db.save(d)  # version moves past v0
+        reg = get_registry(db)
+        with pytest.raises(ConcurrentModificationError):
+            reg.prepare(
+                "t3",
+                [
+                    {
+                        "kind": "update",
+                        "rid": str(d.rid),
+                        "base_version": v0,
+                        "fields": {"a": 9},
+                    }
+                ],
+            )
+        assert db._tx2pc_locks == {}
+
+    def test_conflicting_prepare_refuses(self):
+        db = Database("x")
+        db.schema.create_class("C")
+        d = db.new_element("C", a=1)
+        reg = get_registry(db)
+        op = {
+            "kind": "update",
+            "rid": str(d.rid),
+            "base_version": d.version,
+            "fields": {"a": 2},
+        }
+        reg.prepare("t4", [op])
+        with pytest.raises(ConcurrentModificationError):
+            reg.prepare("t5", [dict(op)])
+        reg.abort("t4")
+
+    def test_expired_prepare_releases_locks(self):
+        """Presumed abort: a coordinator that vanishes after prepare
+        does not wedge the participant forever."""
+        db = Database("x")
+        db.schema.create_class("C")
+        d = db.new_element("C", a=1)
+        reg = get_registry(db)
+        reg.prepare(
+            "t6",
+            [
+                {
+                    "kind": "update",
+                    "rid": str(d.rid),
+                    "base_version": d.version,
+                    "fields": {"a": 2},
+                }
+            ],
+            ttl=0.05,
+        )
+        time.sleep(0.1)
+        # NO sweep call: the lock itself carries the deadline, so a
+        # plain write proceeds even if no registry call ever runs again
+        # (a vanished coordinator must not wedge the record)
+        cur = db.load(d.rid)
+        cur.set("a", 7)
+        db.save(cur)
+        assert db.load(d.rid).get("a") == 7
+        assert db._tx2pc_locks == {}
+        with pytest.raises(TwoPhaseError):
+            reg.commit("t6")

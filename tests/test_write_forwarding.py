@@ -319,31 +319,29 @@ def test_two_owner_concurrent_local_writes(trio):
         assert sorted(d["uid"] for d in m.db.browse_class("Q")) == want_q
 
 
-def test_cross_owner_tx_is_rejected(trio):
-    """A transaction's ops must all resolve to ONE owner: mixing a
-    per-class-assigned class into a tx targeting another owner needs
-    2PC, which is a documented delta — both tx paths refuse."""
-    from orientdb_tpu.exec.tx import TxError
-
+def test_cross_owner_tx_commits_via_2pc(trio):
+    """A transaction's ops may span owners: both tx paths now commit
+    cross-owner batches through 2PC (parallel/twophase) instead of
+    rejecting — deep coverage lives in tests/test_tx_2pc.py."""
     cl, servers, pdb = trio
     cl.assign_class_owner("Q", "n1")
-    # local tx on the primary must not buffer a write to n1's class
-    pdb.begin()
-    try:
-        with pytest.raises(TxError):
-            pdb.new_vertex("Q", uid=1)
-    finally:
-        pdb.tx.rollback()
-    # forwarded tx on n1 (targets the primary) must not carry n1's OWN
-    # class either
     n1db = cl.members["n1"].db
-    tx = n1db.begin()
-    try:
-        with pytest.raises(RuntimeError):
-            n1db.new_vertex("Q", uid=2)
-    finally:
-        tx.rollback()
-    # and nothing leaked anywhere
-    assert all(
-        count_or_zero(m.db, "Q") == 0 for m in cl.members.values()
-    )
+    # local tx on the primary carries a write to n1's class
+    pdb.begin()
+    pdb.new_vertex("P", uid=1)
+    pdb.new_vertex("Q", uid=1)
+    pdb.commit()
+    # forwarded tx on n1 carries n1's OWN class alongside the primary's
+    n1db.begin()
+    n1db.new_vertex("Q", uid=2)
+    n1db.new_vertex("P", uid=2)
+    n1db.commit()
+    assert wait_for(
+        lambda: all(
+            count_or_zero(m.db, "P") == 2 and count_or_zero(m.db, "Q") == 2
+            for m in cl.members.values()
+        )
+    ), {
+        m.name: (count_or_zero(m.db, "P"), count_or_zero(m.db, "Q"))
+        for m in cl.members.values()
+    }
