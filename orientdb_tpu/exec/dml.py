@@ -75,6 +75,38 @@ def execute(db, stmt: A.Statement, params, parent_ctx=None) -> List[Result]:
     if isinstance(stmt, A.DropFunctionStatement):
         db.functions.drop(stmt.name)
         return [Result(props={"operation": "drop function"})]
+    if isinstance(stmt, A.TruncateClassStatement):
+        return _truncate_class(db, stmt)
+    if isinstance(stmt, A.TruncateRecordStatement):
+        n = 0
+        for rid_s in stmt.rids:
+            doc = db.load(RID.parse(rid_s))
+            if doc is not None:
+                db.delete(doc)
+                n += 1
+        return [Result(props={"operation": "truncate record", "count": n})]
+    if isinstance(stmt, A.AlterClassStatement):
+        return _alter_class(db, stmt)
+    if isinstance(stmt, A.MoveVertexStatement):
+        return _move_vertex(db, stmt, params)
+    if isinstance(stmt, A.RebuildIndexStatement):
+        return _rebuild_index(db, stmt)
+    if isinstance(stmt, (A.GrantStatement, A.RevokeStatement)):
+        return _grant_revoke(db, stmt)
+    if isinstance(stmt, A.CreateUserStatement):
+        sec = _security_of(db)
+        roles = list(stmt.roles) or ["reader"]
+        for r in roles:
+            if sec.get_role(r) is None:
+                raise CommandError(f"role '{r}' not found")
+        sec.create_user(stmt.name, stmt.password, roles)
+        return [Result(props={"operation": "create user", "name": stmt.name})]
+    if isinstance(stmt, A.DropUserStatement):
+        if not _security_of(db).drop_user(stmt.name):
+            raise CommandError(f"user '{stmt.name}' not found")
+        return [Result(props={"operation": "drop user", "name": stmt.name})]
+    if isinstance(stmt, A.FindReferencesStatement):
+        return _find_references(db, stmt)
     if isinstance(stmt, (A.BeginStatement, A.CommitStatement, A.RollbackStatement)):
         from orientdb_tpu.exec import tx as _tx
 
@@ -84,6 +116,236 @@ def execute(db, stmt: A.Statement, params, parent_ctx=None) -> List[Result]:
 
         return live.subscribe(db, stmt, params)
     raise CommandError(f"unsupported statement {type(stmt).__name__}")
+
+
+# -- DDL / admin ------------------------------------------------------------
+
+
+def _security_of(db):
+    """The security manager SQL GRANT/REVOKE/CREATE USER mutate: a
+    server-hosted database shares its server's manager (wired by
+    Server.create_database); an embedded database gets its own on first
+    use ([E] OSecurityShared lives inside the database)."""
+    sec = getattr(db, "_security", None)
+    if sec is None:
+        from orientdb_tpu.models.security import SecurityManager
+
+        sec = db._security = SecurityManager()
+    return sec
+
+
+def _truncate_class(db, stmt: A.TruncateClassStatement) -> List[Result]:
+    """[E] OTruncateClassStatement: delete every record of the class.
+    Deletes route through Database.delete so vertices cascade their
+    incident edges and indexes/WAL/hooks stay consistent (the
+    reference's UNSAFE skips the graph checks; here the graph-safe
+    path is the only one, so UNSAFE only waives the vertex/edge-class
+    warning)."""
+    cls = db.schema.get_class(stmt.class_name)
+    if cls is None:
+        raise CommandError(f"class '{stmt.class_name}' not found")
+    n = 0
+    names = (
+        [c.name for c in cls.subclasses(include_self=True)]
+        if stmt.polymorphic
+        else [cls.name]
+    )
+    for name in names:
+        for doc in list(db.browse_class(name, polymorphic=False)):
+            if not doc._deleted:
+                db.delete(doc)
+                n += 1
+    return [Result(props={"operation": "truncate class", "count": n})]
+
+
+def _alter_class(db, stmt: A.AlterClassStatement) -> List[Result]:
+    attr = stmt.attribute.upper()
+    if attr == "NAME":
+        db.rename_class(stmt.class_name, str(stmt.value))
+        return [
+            Result(
+                props={"operation": "alter class", "name": str(stmt.value)}
+            )
+        ]
+    if attr == "ABSTRACT" and stmt.value:
+        cls = db.schema.get_class_or_raise(stmt.class_name)
+        if any(True for _ in db.browse_class(cls.name, polymorphic=False)):
+            raise CommandError(
+                f"cannot make class '{cls.name}' abstract: it has records"
+            )
+    try:
+        cls = db.schema.alter_class(stmt.class_name, attr, stmt.value)
+    except ValueError as e:
+        raise CommandError(str(e)) from None
+    db.mutation_epoch += 1
+    return [Result(props={"operation": "alter class", "name": cls.name})]
+
+
+def _move_vertex(db, stmt: A.MoveVertexStatement, params) -> List[Result]:
+    """[E] OMoveVertexStatement: re-create each source vertex in the
+    target class and rewire every incident edge to the new rid; the
+    old record is deleted. Returns one row per move with old/new rids
+    (the reference's result shape)."""
+    from orientdb_tpu.models.record import Direction, Vertex
+
+    cls = db.schema.get_class(stmt.target_class)
+    if cls is None:
+        cls = db.schema.create_vertex_class(stmt.target_class)
+    if not cls.is_vertex_type:
+        raise CommandError(
+            f"MOVE VERTEX target '{stmt.target_class}' is not a vertex class"
+        )
+    sources: List[Vertex] = []
+    if isinstance(stmt.source, str):
+        doc = db.load(RID.parse(stmt.source))
+        if doc is None:
+            raise CommandError(f"record {stmt.source} not found")
+        sources.append(doc)
+    else:  # subquery
+        from orientdb_tpu.exec.oracle import execute_select
+
+        for r in execute_select(db, stmt.source, params or {}):
+            if r.is_element:
+                sources.append(r.element)
+    rows = []
+    for src in sources:
+        if not isinstance(src, Vertex):
+            raise CommandError(f"{src.rid} is not a vertex")
+        old_rid = src.rid
+        moved = db.new_vertex(cls.name, **dict(src.fields()))
+        # rewire: every incident edge re-created against the new rid,
+        # preserving direction, class, and fields; endpoints equal to
+        # the moving vertex map to `moved` (a self-loop re-created
+        # against old_rid would be cascaded away by the delete below)
+        for e in list(src.edges(Direction.OUT)):
+            dst = (
+                moved if e.in_rid == old_rid else db.load(e.in_rid)
+            )
+            if dst is not None:
+                db.new_edge(e.class_name, moved, dst, **dict(e.fields()))
+        for e in list(src.edges(Direction.IN)):
+            if e.out_rid == old_rid:
+                continue  # self-loop: already re-created in the OUT pass
+            s2 = db.load(e.out_rid)
+            if s2 is not None:
+                db.new_edge(e.class_name, s2, moved, **dict(e.fields()))
+        db.delete(src)  # cascades the old edges
+        rows.append(
+            Result(
+                props={"old": str(old_rid), "new": str(moved.rid)},
+                element=moved,
+            )
+        )
+    return rows
+
+
+def _rebuild_index(db, stmt: A.RebuildIndexStatement) -> List[Result]:
+    """[E] ORebuildIndexStatement: clear and re-populate from a full
+    class scan — the recovery tool for an index that drifted."""
+    if db._indexes is None:
+        # the manager is created lazily with the first index
+        if stmt.name == "*":
+            return [
+                Result(
+                    props={
+                        "operation": "rebuild index",
+                        "indexes": 0,
+                        "records": 0,
+                    }
+                )
+            ]
+        raise CommandError(f"index '{stmt.name}' not found")
+    if stmt.name == "*":
+        targets = db._indexes.all()  # may be empty: rebuild nothing
+    else:
+        ix = db._indexes.get_index(stmt.name)
+        if ix is None:
+            raise CommandError(f"index '{stmt.name}' not found")
+        targets = [ix]
+    total = 0
+    for ix in targets:
+        ix.clear()
+        # re-populate through the index's own per-doc path so every
+        # index type (unique/fulltext/spatial) rebuilds identically
+        seen = 0
+        for doc in db.browse_class(ix.class_name, polymorphic=True):
+            ix.index_doc(doc)
+            seen += 1
+        total += seen
+    return [
+        Result(
+            props={
+                "operation": "rebuild index",
+                "indexes": len(targets),
+                "records": total,
+            }
+        )
+    ]
+
+
+def _grant_revoke(db, stmt) -> List[Result]:
+    from orientdb_tpu.models.security import ALL
+
+    sec = _security_of(db)
+    role = sec.get_role(stmt.role)
+    if role is None:
+        raise CommandError(f"role '{stmt.role}' not found")
+    op = stmt.permission.lower()
+    # ALL expands to the four CRUD ops — Role stores op names, so the
+    # literal 'all' would never match a permission check
+    ops = ALL if op == "all" else (op,)
+    if isinstance(stmt, A.GrantStatement):
+        role.grant(stmt.resource, *ops)
+        return [
+            Result(
+                props={
+                    "operation": "grant",
+                    "role": role.name,
+                    "resource": stmt.resource,
+                }
+            )
+        ]
+    role.revoke(stmt.resource, *ops)
+    return [
+        Result(
+            props={
+                "operation": "revoke",
+                "role": role.name,
+                "resource": stmt.resource,
+            }
+        )
+    ]
+
+
+def _find_references(db, stmt: A.FindReferencesStatement) -> List[Result]:
+    """[E] OFindReferencesStatement: scan link-bearing fields (and edge
+    endpoints) for records pointing at the rid."""
+    target = RID.parse(stmt.rid)
+    classes = {c.lower() for c in stmt.classes}
+    referers = []
+    for cls in db.schema.classes():
+        if cls.abstract:
+            continue
+        if classes and cls.name.lower() not in classes:
+            continue
+        for doc in db.browse_class(cls.name, polymorphic=False):
+            found = False
+            if isinstance(doc, Edge) and (
+                doc.out_rid == target or doc.in_rid == target
+            ):
+                found = True
+            if not found:
+                for v in doc.fields().values():
+                    if v == target or (
+                        isinstance(v, (list, tuple, set)) and target in v
+                    ):
+                        found = True
+                        break
+            if found:
+                referers.append(doc.rid)
+    return [
+        Result(props={"rid": stmt.rid, "referredBy": [str(r) for r in referers]})
+    ]
 
 
 # -- INSERT / CREATE --------------------------------------------------------

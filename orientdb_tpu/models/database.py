@@ -306,6 +306,38 @@ class Database:
             return self._class_owners[key]
         return self._write_owner
 
+    def rename_class(self, old: str, new: str) -> None:
+        """ALTER CLASS <old> NAME <new> ([E] OAlterClassStatement):
+        schema rename plus the record/index rewrite the schema layer
+        cannot do — every record of the class points at the new name,
+        indexes follow, spilled cold records re-spill."""
+        with self._lock:
+            cls = self.schema.get_class_or_raise(old)
+            docs = list(self.browse_class(cls.name, polymorphic=False))
+            # only indexes DEFINED ON this class follow the rename —
+            # for_class() also returns super/subclass indexes, which
+            # must keep their own class names
+            idxs = [
+                ix
+                for ix in (
+                    self._indexes.all() if self._indexes is not None else []
+                )
+                if ix.class_name.lower() == cls.name.lower()
+            ]
+            self.schema.rename_class(cls.name, new)
+            for d in docs:
+                d.class_name = new
+                if self._cold_tier is not None:
+                    self._cold_tier.on_save(d)
+            for ix in idxs:
+                ix.class_name = new
+            key = old.lower()
+            if key in self._class_owners:
+                self._class_owners[new.lower()] = self._class_owners.pop(
+                    key
+                )
+            self.mutation_epoch += 1
+
     def _check_2pc_lock(self, rid) -> None:
         """Refuse a write to a rid locked by an in-flight prepared
         distributed tx (parallel/twophase) — unless THIS thread is that
@@ -357,7 +389,11 @@ class Database:
             doc._db = self
             return ftx.save(doc)
         tx = self.tx
-        if tx is not None and self._owner_for(class_name) is not None:
+        if (
+            tx is not None
+            and not self._tx_suspended
+            and self._owner_for(class_name) is not None
+        ):
             # foreign-owned class inside a local tx: NO local schema
             # mutation (the 2PC sub-batch creates it at the owner)
             doc = Document(class_name, fields)
@@ -417,7 +453,11 @@ class Database:
             ftx.save(v)
             return v
         tx = self.tx
-        if tx is not None and self._owner_for(class_name) is not None:
+        if (
+            tx is not None
+            and not self._tx_suspended
+            and self._owner_for(class_name) is not None
+        ):
             # foreign-owned class inside a local tx: NO local schema
             # mutation (the 2PC sub-batch creates it at the owner;
             # auto-creating here would fork the owner's DDL stream)

@@ -327,6 +327,13 @@ class LuceneFullTextIndex:
 
     # -- IndexManager SPI ---------------------------------------------------
 
+    def clear(self) -> None:
+        """Drop every posting (REBUILD INDEX re-populates from a scan)."""
+        self._post = {}
+        self._docs = {}
+        self._total_len = 0
+        self._sorted = None
+
     @property
     def unique(self) -> bool:
         return False

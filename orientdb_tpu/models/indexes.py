@@ -49,6 +49,13 @@ class Index:
         self._reverse: Dict[RID, object] = {}
         self._sorted_keys: List[object] = []  # maintained for range types
 
+    def clear(self) -> None:
+        """Drop every entry (REBUILD INDEX re-populates from a scan);
+        subclasses share the same storage attributes."""
+        self._map = {}
+        self._reverse = {}
+        self._sorted_keys = []
+
     @property
     def unique(self) -> bool:
         return self.type.startswith("UNIQUE")

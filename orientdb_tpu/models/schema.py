@@ -285,6 +285,70 @@ class Schema:
     def create_edge_class(self, name: str, **kw) -> SchemaClass:
         return self.create_class(name, superclasses=("E",), **kw)
 
+    def alter_class(self, name: str, attribute: str, value) -> SchemaClass:
+        """[E] OAlterClassStatement attribute mutation: SUPERCLASS
+        (+Name/-Name), STRICTMODE, ABSTRACT. Emits one replicable DDL
+        op; rename has its own entry point (:meth:`rename_class`)."""
+        cls = self.get_class_or_raise(name)
+        attr = attribute.upper()
+        if attr == "SUPERCLASS":
+            sign, sup = value
+            if sign == "+":
+                cls.add_superclass(sup)
+            else:
+                cls.superclass_names = [
+                    s
+                    for s in cls.superclass_names
+                    if s.lower() != sup.lower()
+                ]
+        elif attr == "STRICTMODE":
+            cls.strict_mode = bool(value)
+        elif attr == "ABSTRACT":
+            cls.abstract = bool(value)
+            if not cls.abstract and not cls.cluster_ids:
+                cid = self._allocate_cluster()
+                cls.cluster_ids.append(cid)
+                self._cluster_to_class[cid] = cls.name
+        else:
+            raise ValueError(f"unsupported ALTER CLASS attribute {attr!r}")
+        if self.on_ddl is not None:
+            self.on_ddl(
+                {
+                    "op": "alter_class",
+                    "name": cls.name,
+                    "attribute": attr,
+                    "value": list(value)
+                    if isinstance(value, tuple)
+                    else value,
+                }
+            )
+        return cls
+
+    def rename_class(self, old: str, new: str) -> SchemaClass:
+        """Rename a class, rewiring cluster→class mapping and every
+        subclass's superclass reference. Record/index rewrites are the
+        Database's job (Database.rename_class drives both)."""
+        cls = self.get_class_or_raise(old)
+        if self.get_class(new) is not None:
+            raise ValueError(f"class '{new}' already exists")
+        old_name = cls.name
+        del self._classes[old_name.lower()]
+        cls.name = new
+        self._classes[new.lower()] = cls
+        for cid in cls.cluster_ids:
+            self._cluster_to_class[cid] = new
+        for c in self._classes.values():
+            if any(s.lower() == old_name.lower() for s in c.superclass_names):
+                c.superclass_names = [
+                    new if s.lower() == old_name.lower() else s
+                    for s in c.superclass_names
+                ]
+        if self.on_ddl is not None:
+            self.on_ddl(
+                {"op": "rename_class", "old": old_name, "new": new}
+            )
+        return cls
+
     def get_class(self, name: str) -> Optional[SchemaClass]:
         return self._classes.get(name.lower())
 

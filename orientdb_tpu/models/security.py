@@ -27,6 +27,9 @@ ALL = (READ, CREATE, UPDATE, DELETE)
 RES_RECORD = "record"
 RES_SCHEMA = "schema"
 RES_DATABASE = "database"
+#: users/roles/grants themselves ([E] database.security in the ORule
+#: tree): only admin-grade roles may mutate them
+RES_SECURITY = "security"
 
 _SCHEMA_DDL_HEADS = ("class", "property", "index", "sequence", "function")
 
@@ -46,10 +49,20 @@ def classify_sql(sql: str):
         return RES_RECORD, CREATE
     if head == "delete":
         return RES_RECORD, DELETE
+    if head in ("grant", "revoke"):
+        return RES_SECURITY, UPDATE
+    if head == "find":  # FIND REFERENCES is read-only
+        return RES_RECORD, READ
+    if head == "move":  # MOVE VERTEX deletes the source record
+        return RES_RECORD, DELETE
     if head in ("create", "drop", "alter", "truncate", "rebuild"):
         target = toks[1].lower() if len(toks) > 1 else ""
+        if head == "truncate" and target == "record":
+            return RES_RECORD, DELETE
         if target in _SCHEMA_DDL_HEADS:
             return RES_SCHEMA, UPDATE
+        if target == "user":
+            return RES_SECURITY, UPDATE
         if head == "create" and target in ("vertex", "edge"):
             return RES_RECORD, CREATE
     return RES_RECORD, UPDATE

@@ -459,8 +459,11 @@ def run_coordinator(
             committed.append(key)
         except Exception as e:
             if not committed:
-                # nothing applied anywhere yet: clean abort
-                for k2 in pending:
+                # nothing applied anywhere yet: clean abort — including
+                # the participant whose commit call failed (abort of an
+                # already-resolved stage is a no-op; leaving it staged
+                # would hold its locks until TTL expiry)
+                for k2 in [key] + pending:
                     try:
                         parts[k2].abort(txid)
                     except Exception:  # pragma: no cover - best effort

@@ -104,6 +104,9 @@ class Server:
                 db = open_database(os.path.join(config.wal_dir, name), name)
             else:
                 db = Database(name)
+            # SQL GRANT/REVOKE/CREATE USER on this database mutate the
+            # SERVER's security manager (exec/dml._security_of)
+            db._security = self.security
             self.databases[name] = db
             return db
 

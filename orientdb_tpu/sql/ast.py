@@ -469,6 +469,97 @@ class DropFunctionStatement(Statement):
     name: str
 
 
+@dataclasses.dataclass(frozen=True)
+class TruncateClassStatement(Statement):
+    """[E] OTruncateClassStatement: TRUNCATE CLASS <name> [POLYMORPHIC]
+    [UNSAFE] — delete every record of the class (vertices cascade their
+    incident edges unless UNSAFE skips graph consistency)."""
+
+    class_name: str
+    polymorphic: bool = False
+    unsafe: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TruncateRecordStatement(Statement):
+    """[E] OTruncateRecordStatement: TRUNCATE RECORD <rid>[, <rid>…]."""
+
+    rids: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class AlterClassStatement(Statement):
+    """[E] OAlterClassStatement: ALTER CLASS <name> <attribute> <value>.
+    Supported attributes: NAME (rename), SUPERCLASS (+Name / -Name),
+    STRICTMODE, ABSTRACT."""
+
+    class_name: str
+    attribute: str
+    value: object  # str | bool | ("+"|"-", name)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoveVertexStatement(Statement):
+    """[E] OMoveVertexStatement: MOVE VERTEX <rid|(subquery)> TO
+    CLASS:<name> — re-home vertices into another class, rewiring every
+    incident edge to the new rid."""
+
+    source: object  # rid string or SelectStatement
+    target_class: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RebuildIndexStatement(Statement):
+    """[E] ORebuildIndexStatement: REBUILD INDEX <name|*> — drop the
+    entries and re-index from a full class scan."""
+
+    name: str  # "*" rebuilds every index
+
+
+@dataclasses.dataclass(frozen=True)
+class GrantStatement(Statement):
+    """[E] OGrantStatement: GRANT <permission> ON <resource> TO <role>."""
+
+    permission: str
+    resource: str
+    role: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RevokeStatement(Statement):
+    """[E] ORevokeStatement: REVOKE <permission> ON <resource> FROM <role>."""
+
+    permission: str
+    resource: str
+    role: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateUserStatement(Statement):
+    """[E] OCreateUserStatement (3.x): CREATE USER u IDENTIFIED BY pw
+    [ROLE [r1,r2]]."""
+
+    name: str
+    password: str
+    roles: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DropUserStatement(Statement):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class FindReferencesStatement(Statement):
+    """[E] OFindReferencesStatement: FIND REFERENCES <rid> [[Class,…]] —
+    every record whose link/linklist fields point at the rid."""
+
+    rid: str
+    classes: Tuple[str, ...] = ()
+
+    is_idempotent = True
+
+
 # -- misc -------------------------------------------------------------------
 
 

@@ -153,7 +153,104 @@ class Parser:
             sel = self.parse_select()
             assert isinstance(sel, A.SelectStatement)
             return A.LiveSelectStatement(sel)
+        if kw == "TRUNCATE":
+            return self.parse_truncate()
+        if kw == "MOVE":
+            return self.parse_move_vertex()
+        if kw == "REBUILD":
+            self.next()
+            self.eat_kw("INDEX")
+            if self.at_op("*"):
+                self.next()
+                return A.RebuildIndexStatement("*")
+            name = self.eat_ident()
+            while self.at_op("."):
+                self.next()
+                name += "." + self.eat_ident()
+            return A.RebuildIndexStatement(name)
+        if kw in ("GRANT", "REVOKE"):
+            self.next()
+            permission = self.eat_ident().upper()
+            self.eat_kw("ON")
+            resource = self.parse_resource_path()
+            self.eat_kw("TO" if kw == "GRANT" else "FROM")
+            role = self.eat_ident()
+            if kw == "GRANT":
+                return A.GrantStatement(permission, resource, role)
+            return A.RevokeStatement(permission, resource, role)
+        if kw == "FIND":
+            self.next()
+            self.eat_kw("REFERENCES")
+            rt = self.next()
+            if rt.kind != "RID":
+                raise ParseError("expected RID after FIND REFERENCES", rt)
+            classes: List[str] = []
+            if self.at_op("["):
+                self.next()
+                classes = self.parse_name_list()
+                self.eat_op("]")
+            return A.FindReferencesStatement(rt.text, tuple(classes))
         raise ParseError(f"unsupported statement '{t.text}'", t)
+
+    def parse_resource_path(self) -> str:
+        """A dotted security resource name (database.class.P, server.*)."""
+        parts = [self.eat_ident() if not self.at_op("*") else self._star()]
+        while self.at_op("."):
+            self.next()
+            parts.append(
+                self._star() if self.at_op("*") else self.eat_ident()
+            )
+        return ".".join(parts)
+
+    def _star(self) -> str:
+        self.eat_op("*")
+        return "*"
+
+    def parse_truncate(self) -> A.Statement:
+        self.eat_kw("TRUNCATE")
+        if self.try_kw("CLASS"):
+            name = self.eat_ident()
+            polymorphic = self.try_kw("POLYMORPHIC")
+            unsafe = self.try_kw("UNSAFE")
+            return A.TruncateClassStatement(name, polymorphic, unsafe)
+        if self.try_kw("RECORD"):
+            rids = []
+            if self.at_op("["):
+                self.next()
+                while not self.at_op("]"):
+                    rt = self.next()
+                    if rt.kind != "RID":
+                        raise ParseError("expected RID", rt)
+                    rids.append(rt.text)
+                    if self.at_op(","):
+                        self.next()
+                self.eat_op("]")
+            else:
+                rt = self.next()
+                if rt.kind != "RID":
+                    raise ParseError("expected RID", rt)
+                rids.append(rt.text)
+            return A.TruncateRecordStatement(tuple(rids))
+        raise ParseError("unsupported TRUNCATE", self.peek())
+
+    def parse_move_vertex(self) -> A.Statement:
+        self.eat_kw("MOVE")
+        self.eat_kw("VERTEX")
+        t = self.peek()
+        source: object
+        if t.kind == "RID":
+            self.next()
+            source = t.text
+        elif self.at_op("("):
+            self.next()
+            source = self.parse_select()
+            self.eat_op(")")
+        else:
+            raise ParseError("expected RID or (subquery) in MOVE VERTEX", t)
+        self.eat_kw("TO")
+        self.eat_kw("CLASS")
+        self.eat_op(":")
+        return A.MoveVertexStatement(source, self.eat_ident())
 
     # -- SELECT ------------------------------------------------------------
 
@@ -847,6 +944,23 @@ class Parser:
                 else:
                     break
             return A.CreateFunctionStatement(name, body, parameters, idempotent, language)
+        if self.try_kw("USER"):
+            name = self.eat_ident()
+            self.eat_kw("IDENTIFIED")
+            self.eat_kw("BY")
+            t = self.next()
+            if t.kind not in ("STRING", "IDENT"):
+                raise ParseError("expected password", t)
+            password = str(t.value)
+            roles: List[str] = []
+            if self.try_kw("ROLE"):
+                if self.at_op("["):
+                    self.next()
+                    roles = self.parse_name_list()
+                    self.eat_op("]")
+                else:
+                    roles = [self.eat_ident()]
+            return A.CreateUserStatement(name, password, tuple(roles))
         raise ParseError("unsupported CREATE", self.peek())
 
     def _int_value(self) -> int:
@@ -898,6 +1012,8 @@ class Parser:
             return A.DropSequenceStatement(self.eat_ident())
         if self.try_kw("FUNCTION"):
             return A.DropFunctionStatement(self.eat_ident())
+        if self.try_kw("USER"):
+            return A.DropUserStatement(self.eat_ident())
         raise ParseError("unsupported DROP", self.peek())
 
     def parse_alter(self) -> A.Statement:
@@ -915,6 +1031,29 @@ class Parser:
                 else:
                     break
             return A.AlterSequenceStatement(name, start, increment, cache)
+        if self.try_kw("CLASS"):
+            cls = self.eat_ident()
+            attr = self.eat_ident().upper()
+            if attr == "SUPERCLASS":
+                sign = "+"
+                if self.at_op("+") or self.at_op("-"):
+                    sign = self.next().text
+                return A.AlterClassStatement(
+                    cls, attr, (sign, self.eat_ident())
+                )
+            if attr in ("STRICTMODE", "ABSTRACT"):
+                v = self.eat_ident().upper()
+                if v not in ("TRUE", "FALSE"):
+                    raise ParseError(
+                        f"expected TRUE/FALSE for {attr}", self.peek()
+                    )
+                return A.AlterClassStatement(cls, attr, v == "TRUE")
+            if attr == "NAME":
+                t = self.next()
+                if t.kind not in ("IDENT", "STRING"):
+                    raise ParseError("expected new class name", t)
+                return A.AlterClassStatement(cls, attr, t.value)
+            raise ParseError(f"unsupported ALTER CLASS attribute {attr}")
         self.eat_kw("PROPERTY")
         cls = self.eat_ident()
         self.eat_op(".")

@@ -437,6 +437,15 @@ def _apply_entry(db: Database, e: Dict) -> None:
                 prop.max_value = v
     elif op == "drop_class":
         db.schema.drop_class(e["name"])
+    elif op == "alter_class":
+        v = e["value"]
+        db.schema.alter_class(
+            e["name"],
+            e["attribute"],
+            tuple(v) if isinstance(v, list) else v,
+        )
+    elif op == "rename_class":
+        db.rename_class(e["old"], e["new"])
     elif op == "add_cluster":
         db.schema.add_cluster(e["class"])
     elif op == "create_index":

@@ -1,0 +1,311 @@
+"""Admin/DDL SQL statements added for reference parity: TRUNCATE
+CLASS/RECORD, ALTER CLASS, MOVE VERTEX, REBUILD INDEX, GRANT/REVOKE,
+CREATE/DROP USER, FIND REFERENCES ([E] the one-class-per-statement
+OrientSql productions: OTruncateClassStatement, OAlterClassStatement,
+OMoveVertexStatement, ORebuildIndexStatement, OGrantStatement…)."""
+
+import pytest
+
+from orientdb_tpu import Database
+from orientdb_tpu.exec.dml import CommandError
+from orientdb_tpu.sql import ast as A
+from orientdb_tpu.sql.parser import parse
+
+
+class TestParsing:
+    def test_truncate_class(self):
+        s = parse("TRUNCATE CLASS Person POLYMORPHIC UNSAFE")
+        assert isinstance(s, A.TruncateClassStatement)
+        assert s.class_name == "Person" and s.polymorphic and s.unsafe
+
+    def test_truncate_record(self):
+        s = parse("TRUNCATE RECORD [#12:0, #12:1]")
+        assert isinstance(s, A.TruncateRecordStatement)
+        assert s.rids == ("#12:0", "#12:1")
+
+    def test_alter_class_variants(self):
+        s = parse("ALTER CLASS P SUPERCLASS +V")
+        assert s.attribute == "SUPERCLASS" and s.value == ("+", "V")
+        s = parse("ALTER CLASS P STRICTMODE TRUE")
+        assert s.attribute == "STRICTMODE" and s.value is True
+        s = parse("ALTER CLASS P NAME Q")
+        assert s.attribute == "NAME" and s.value == "Q"
+
+    def test_move_vertex(self):
+        s = parse("MOVE VERTEX #9:3 TO CLASS:Archived")
+        assert isinstance(s, A.MoveVertexStatement)
+        assert s.source == "#9:3" and s.target_class == "Archived"
+        s = parse("MOVE VERTEX (SELECT FROM P WHERE x = 1) TO CLASS:Q")
+        assert isinstance(s.source, A.SelectStatement)
+
+    def test_rebuild_index(self):
+        assert parse("REBUILD INDEX *").name == "*"
+        assert parse("REBUILD INDEX P.uid").name == "P.uid"
+
+    def test_grant_revoke(self):
+        g = parse("GRANT UPDATE ON database.class.P TO writer")
+        assert isinstance(g, A.GrantStatement)
+        assert (g.permission, g.resource, g.role) == (
+            "UPDATE",
+            "database.class.P",
+            "writer",
+        )
+        r = parse("REVOKE READ ON record FROM reader")
+        assert isinstance(r, A.RevokeStatement)
+        assert r.resource == "record"
+
+    def test_create_drop_user(self):
+        s = parse("CREATE USER jane IDENTIFIED BY 'pw1' ROLE [writer, reader]")
+        assert isinstance(s, A.CreateUserStatement)
+        assert s.name == "jane" and s.password == "pw1"
+        assert s.roles == ("writer", "reader")
+        assert isinstance(parse("DROP USER jane"), A.DropUserStatement)
+
+    def test_find_references(self):
+        s = parse("FIND REFERENCES #3:1 [Person, Car]")
+        assert isinstance(s, A.FindReferencesStatement)
+        assert s.rid == "#3:1" and s.classes == ("Person", "Car")
+
+
+@pytest.fixture()
+def gdb():
+    db = Database("g")
+    db.schema.create_vertex_class("P")
+    db.schema.create_edge_class("L")
+    return db
+
+
+class TestTruncate:
+    def test_truncate_class_removes_records_and_edges(self, gdb):
+        a = gdb.new_vertex("P", uid=1)
+        b = gdb.new_vertex("P", uid=2)
+        gdb.new_edge("L", a, b)
+        out = gdb.command("TRUNCATE CLASS P").to_dicts()
+        assert out[0]["count"] == 2
+        assert gdb.count_class("P") == 0
+        assert gdb.count_class("L") == 0  # cascade with the vertices
+
+    def test_truncate_polymorphic(self, gdb):
+        gdb.command("CREATE CLASS Child EXTENDS P")
+        gdb.new_vertex("P", uid=1)
+        gdb.new_vertex("Child", uid=2)
+        out = gdb.command("TRUNCATE CLASS P POLYMORPHIC").to_dicts()
+        assert out[0]["count"] == 2
+        assert gdb.count_class("P", polymorphic=True) == 0
+
+    def test_truncate_record(self, gdb):
+        v = gdb.new_vertex("P", uid=1)
+        out = gdb.command(f"TRUNCATE RECORD {v.rid}").to_dicts()
+        assert out[0]["count"] == 1
+        assert gdb.load(v.rid) is None
+
+
+class TestAlterClass:
+    def test_strictmode(self, gdb):
+        gdb.command("CREATE PROPERTY P.uid INTEGER")
+        gdb.command("ALTER CLASS P STRICTMODE TRUE")
+        gdb.new_vertex("P", uid=1)
+        with pytest.raises(Exception):
+            gdb.new_vertex("P", uid=2, undeclared="x")
+        gdb.command("ALTER CLASS P STRICTMODE FALSE")
+        gdb.new_vertex("P", uid=3, undeclared="ok")
+
+    def test_superclass_add_remove(self, gdb):
+        gdb.command("CREATE CLASS Tag")
+        gdb.command("ALTER CLASS P SUPERCLASS +Tag")
+        assert gdb.schema.get_class("P").is_subclass_of("Tag")
+        gdb.command("ALTER CLASS P SUPERCLASS -Tag")
+        assert not gdb.schema.get_class("P").is_subclass_of("Tag")
+
+    def test_abstract_guard(self, gdb):
+        gdb.new_vertex("P", uid=1)
+        with pytest.raises(CommandError):
+            gdb.command("ALTER CLASS P ABSTRACT TRUE")
+
+    def test_rename_class_follows_records_and_indexes(self, gdb):
+        gdb.command("CREATE PROPERTY P.uid INTEGER")
+        gdb.command("CREATE INDEX P.uid UNIQUE")
+        v = gdb.new_vertex("P", uid=7)
+        gdb.command("ALTER CLASS P NAME Person")
+        assert gdb.schema.get_class("P") is None
+        assert gdb.schema.get_class("Person") is not None
+        # record follows the rename
+        assert gdb.load(v.rid).class_name == "Person"
+        rows = gdb.query("SELECT uid FROM Person WHERE uid = 7").to_dicts()
+        assert rows == [{"uid": 7}]
+        # the index still serves the class under its new name
+        ix = gdb.indexes.get_index("P.uid")
+        assert ix is not None and ix.class_name == "Person"
+
+
+class TestMoveVertex:
+    def test_move_rewires_edges(self, gdb):
+        gdb.command("CREATE CLASS Archived EXTENDS V")
+        a = gdb.new_vertex("P", uid=1)
+        b = gdb.new_vertex("P", uid=2)
+        c = gdb.new_vertex("P", uid=3)
+        gdb.new_edge("L", a, b)  # a -> b
+        gdb.new_edge("L", c, b)  # c -> b
+        out = gdb.command(f"MOVE VERTEX {b.rid} TO CLASS:Archived").to_dicts()
+        assert out[0]["old"] == str(b.rid)
+        assert gdb.load(b.rid) is None
+        rows = gdb.query(
+            "MATCH {class:P, as:s}-L->{class:Archived, as:d} "
+            "RETURN s.uid, d.uid"
+        ).to_dicts()
+        assert sorted(r["s.uid"] for r in rows) == [1, 3]
+        assert all(r["d.uid"] == 2 for r in rows)
+
+    def test_move_subquery(self, gdb):
+        gdb.command("CREATE CLASS Cold EXTENDS V")
+        for i in range(3):
+            gdb.new_vertex("P", uid=i)
+        out = gdb.command(
+            "MOVE VERTEX (SELECT FROM P WHERE uid > 0) TO CLASS:Cold"
+        ).to_dicts()
+        assert len(out) == 2
+        assert gdb.count_class("P") == 1
+        assert gdb.count_class("Cold") == 2
+
+
+class TestRebuildIndex:
+    def test_rebuild_recovers_drifted_index(self, gdb):
+        gdb.command("CREATE PROPERTY P.uid INTEGER")
+        gdb.command("CREATE INDEX P.uid NOTUNIQUE")
+        for i in range(4):
+            gdb.new_vertex("P", uid=i)
+        ix = gdb.indexes.get_index("P.uid")
+        ix.clear()  # simulate drift
+        assert ix.get(2) == set()
+        out = gdb.command("REBUILD INDEX P.uid").to_dicts()
+        assert out[0]["records"] == 4
+        assert len(ix.get(2)) == 1
+        # and the planner uses it again
+        rows = gdb.query("SELECT uid FROM P WHERE uid = 2").to_dicts()
+        assert rows == [{"uid": 2}]
+
+    def test_rebuild_star(self, gdb):
+        gdb.command("CREATE PROPERTY P.uid INTEGER")
+        gdb.command("CREATE INDEX P.uid NOTUNIQUE")
+        gdb.new_vertex("P", uid=1)
+        out = gdb.command("REBUILD INDEX *").to_dicts()
+        assert out[0]["indexes"] >= 1
+
+
+class TestSecuritySql:
+    def test_grant_revoke_roundtrip(self, gdb):
+        from orientdb_tpu.exec.dml import _security_of
+
+        gdb.command("GRANT UPDATE ON schema TO writer")
+        sec = _security_of(gdb)
+        assert sec.get_role("writer").allows("schema", "update")
+        gdb.command("REVOKE UPDATE ON schema FROM writer")
+        assert not sec.get_role("writer").allows("schema", "update")
+
+    def test_create_and_drop_user(self, gdb):
+        from orientdb_tpu.exec.dml import _security_of
+
+        gdb.command("CREATE USER jane IDENTIFIED BY 'pw1' ROLE writer")
+        sec = _security_of(gdb)
+        assert sec.authenticate("jane", "pw1") is not None
+        assert sec.authenticate("jane", "wrong") is None
+        gdb.command("DROP USER jane")
+        assert sec.authenticate("jane", "pw1") is None
+
+    def test_create_user_unknown_role_refuses(self, gdb):
+        with pytest.raises(CommandError):
+            gdb.command("CREATE USER x IDENTIFIED BY 'p' ROLE nosuch")
+
+    def test_classify_routes_security_statements(self):
+        from orientdb_tpu.models.security import RES_SECURITY, classify_sql
+
+        assert classify_sql("GRANT UPDATE ON schema TO writer") == (
+            RES_SECURITY,
+            "update",
+        )
+        assert classify_sql("CREATE USER x IDENTIFIED BY 'p'") == (
+            RES_SECURITY,
+            "update",
+        )
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the round-5 code review of this feature."""
+
+    def test_grant_all_expands_to_crud(self, gdb):
+        from orientdb_tpu.exec.dml import _security_of
+
+        gdb.command("GRANT ALL ON schema TO writer")
+        role = _security_of(gdb).get_role("writer")
+        assert all(
+            role.allows("schema", op)
+            for op in ("read", "create", "update", "delete")
+        )
+        gdb.command("REVOKE ALL ON schema FROM writer")
+        assert not role.allows("schema", "delete")
+
+    def test_classify_truncate_record_is_delete(self):
+        from orientdb_tpu.models.security import RES_RECORD, classify_sql
+
+        assert classify_sql("TRUNCATE RECORD #12:0") == (RES_RECORD, "delete")
+        assert classify_sql("FIND REFERENCES #12:0") == (RES_RECORD, "read")
+        assert classify_sql("MOVE VERTEX #12:0 TO CLASS:X") == (
+            RES_RECORD,
+            "delete",
+        )
+
+    def test_rebuild_star_with_no_indexes(self, gdb):
+        out = gdb.command("REBUILD INDEX *").to_dicts()
+        assert out[0]["indexes"] == 0
+
+    def test_rebuild_lucene_index(self, gdb):
+        gdb.command("CREATE PROPERTY P.bio STRING")
+        gdb.command(
+            "CREATE INDEX P.bio FULLTEXT ENGINE LUCENE"
+        )
+        gdb.new_vertex("P", bio="graph databases on accelerators")
+        out = gdb.command("REBUILD INDEX *").to_dicts()
+        assert out[0]["indexes"] >= 1
+        rows = gdb.query(
+            "SELECT FROM P WHERE SEARCH_CLASS('graph') = true"
+        ).to_dicts()
+        assert len(rows) == 1
+
+    def test_move_vertex_preserves_self_loop(self, gdb):
+        gdb.command("CREATE CLASS Arch EXTENDS V")
+        v = gdb.new_vertex("P", uid=1)
+        gdb.new_edge("L", v, v)  # self-loop
+        gdb.command(f"MOVE VERTEX {v.rid} TO CLASS:Arch")
+        rows = gdb.query(
+            "MATCH {class:Arch, as:a}-L->{as:b} RETURN a.uid, b.uid"
+        ).to_dicts()
+        assert rows == [{"a.uid": 1, "b.uid": 1}]
+        assert gdb.count_class("L") == 1
+
+    def test_rename_leaves_superclass_index_alone(self, gdb):
+        gdb.command("CREATE PROPERTY P.uid INTEGER")
+        gdb.command("CREATE INDEX P.uid NOTUNIQUE")
+        gdb.command("CREATE CLASS Child EXTENDS P")
+        gdb.command("ALTER CLASS Child NAME Child2")
+        # the index defined ON P must keep claiming P
+        assert gdb.indexes.get_index("P.uid").class_name == "P"
+
+
+class TestFindReferences:
+    def test_link_fields_and_edges(self, gdb):
+        a = gdb.new_vertex("P", uid=1)
+        b = gdb.new_vertex("P", uid=2)
+        gdb.new_edge("L", a, b)
+        gdb.schema.create_class("Note")
+        gdb.new_element("Note", about=a.rid)
+        rows = gdb.query(f"FIND REFERENCES {a.rid}").to_dicts()
+        refs = rows[0]["referredBy"]
+        # the Note's link field and the L edge both point at a
+        assert len(refs) == 2
+
+    def test_class_filter(self, gdb):
+        a = gdb.new_vertex("P", uid=1)
+        gdb.schema.create_class("Note")
+        gdb.new_element("Note", about=a.rid)
+        rows = gdb.query(f"FIND REFERENCES {a.rid} [Note]").to_dicts()
+        assert len(rows[0]["referredBy"]) == 1
