@@ -1,0 +1,272 @@
+"""SQL batch scripts.
+
+Analog of the reference's script executor ([E] OCommandScript /
+OSqlScriptExecutor behind ``ODatabaseSession.execute("sql", script)``
+and the REST ``/batch`` command): a semicolon/newline-separated
+sequence of statements running in ONE session context, with
+
+- ``LET $name = <statement or expression>`` binding the result set (or
+  scalar) into the script context — later statements reference ``$name``
+- ``IF (<expr>) { <statements> }`` conditional blocks
+- ``RETURN <expr> | $var | [list]`` ending the script with a value
+- ``BEGIN / COMMIT / ROLLBACK`` spanning statements (the per-thread tx
+  the statements already share)
+- ``SLEEP <ms>`` (the reference's script-only sleep statement)
+
+The splitter is quote-aware and brace-aware, so ``;`` inside string
+literals and MATCH pattern braces do not split statements.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from orientdb_tpu.exec.eval import EvalContext, evaluate, truthy
+from orientdb_tpu.exec.result import Result
+
+
+class ScriptError(Exception):
+    pass
+
+
+#: script-level directives (not parser statements) — a newline after a
+#: line starting with one of these always separates
+_DIRECTIVE_HEADS = ("LET", "RETURN", "SLEEP")
+
+
+def _complete_statement(buf: str) -> bool:
+    """Newline-separation test: the buffer is a finished statement.
+    Script directives (LET/RETURN/SLEEP) are line-oriented; anything
+    else must parse as a complete SQL statement."""
+    s = buf.strip()
+    if not s:
+        return False
+    head = s.split(None, 1)[0].upper()
+    if head in _DIRECTIVE_HEADS:
+        return True
+    from orientdb_tpu.sql.parser import parse
+
+    try:
+        parse(s)
+        return True
+    except Exception:  # ParseError or lexer errors: keep accumulating
+        return False
+
+
+def split_script(text: str) -> List[str]:
+    """Split on ``;`` and statement-separating newlines, respecting
+    string literals and brace/bracket/paren nesting (MATCH patterns,
+    IF blocks, embedded collections). A newline separates only when
+    the accumulated text already forms a complete statement — so a
+    statement may span lines, and one-statement-per-line scripts (the
+    reference's console/Studio batch form) split correctly."""
+    out: List[str] = []
+    buf: List[str] = []
+    depth = 0
+    quote: Optional[str] = None
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if quote is not None:
+            buf.append(ch)
+            if ch == "\\" and i + 1 < n:
+                buf.append(text[i + 1])
+                i += 2
+                continue
+            if ch == quote:
+                quote = None
+            i += 1
+            continue
+        if ch in "'\"":
+            quote = ch
+            buf.append(ch)
+        elif ch in "{[(":
+            depth += 1
+            buf.append(ch)
+        elif ch in "}])":
+            depth -= 1
+            buf.append(ch)
+        elif ch == ";" and depth == 0:
+            out.append("".join(buf))
+            buf = []
+        elif ch == "\n" and depth == 0 and _complete_statement("".join(buf)):
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    if buf:
+        out.append("".join(buf))
+    return [s.strip() for s in out if s.strip()]
+
+
+def script_permissions(text: str) -> set:
+    """Every (resource, op) pair the script needs, for callers that
+    authorize before executing ([E] the per-command checks the server
+    applies to single statements): walks top-level statements, LET
+    right-hand sides, and IF bodies recursively."""
+    from orientdb_tpu.models.security import classify_sql
+
+    needed: set = set()
+    for raw in split_script(text):
+        head = raw.split(None, 1)[0].upper() if raw.split() else ""
+        if head == "LET":
+            eq = raw.find("=")
+            if eq > 0:
+                needed |= script_permissions(raw[eq + 1 :])
+        elif head == "IF":
+            brace = raw.find("{")
+            if brace > 0 and raw.rstrip().endswith("}"):
+                needed |= script_permissions(
+                    raw[brace + 1 : raw.rstrip().rfind("}")]
+                )
+        elif head in ("RETURN", "SLEEP", ""):
+            continue
+        else:
+            needed.add(classify_sql(raw))
+    return needed
+
+
+def _parse_expr_via_select(expr_text: str):
+    """The parser has no public expression entry point; wrap the text
+    as a single-projection SELECT (the StoredFunction trick)."""
+    from orientdb_tpu.sql.parser import parse
+
+    sel = parse(f"SELECT {expr_text} AS __v")
+    return sel.projections[0].expr
+
+
+def _let_value(rows: List[Result]):
+    """LET binding shape: a statement's full row list; a 1-row
+    single-projection result collapses to the scalar (so
+    ``LET $n = SELECT count(*) as c FROM V`` then ``IF ($n.c > 0)``
+    and plain ``$n`` both behave)."""
+    if len(rows) == 1 and not rows[0].is_element:
+        props = rows[0].to_dict()
+        if len(props) == 1:
+            return next(iter(props.values()))
+    return [r.element if r.is_element else r.to_dict() for r in rows]
+
+
+class _ScriptRunner:
+    def __init__(self, db, params: Optional[Dict]) -> None:
+        self.db = db
+        self.params = params or {}
+        self.ctx = EvalContext(db, params=self.params)
+
+    def run(self, text: str) -> List[Result]:
+        done, rows = self._run_block(split_script(text))
+        return rows
+
+    # -- execution -----------------------------------------------------------
+
+    def _run_block(self, statements: List[str]) -> Tuple[bool, List[Result]]:
+        """Returns (returned, rows): ``returned`` True when a RETURN
+        ended the script (propagates out of nested IF blocks)."""
+        from orientdb_tpu.exec.oracle import execute_statement
+        from orientdb_tpu.sql.parser import parse
+
+        last: List[Result] = []
+        for raw in statements:
+            head = raw.split(None, 1)[0].upper() if raw.split() else ""
+            if head == "LET":
+                self._let(raw)
+            elif head == "IF":
+                done, rows = self._if(raw)
+                if done:
+                    return True, rows
+            elif head == "RETURN":
+                return True, self._return(raw)
+            elif head == "SLEEP":
+                ms = int(raw.split(None, 1)[1])
+                time.sleep(ms / 1000.0)
+            else:
+                last = execute_statement(
+                    self.db, parse(raw), self.params, parent_ctx=self.ctx
+                )
+        return False, last
+
+    def _let(self, raw: str) -> None:
+        body = raw[3:].strip()
+        eq = body.find("=")
+        if eq < 0:
+            raise ScriptError(f"malformed LET: {raw!r}")
+        name = body[:eq].strip()
+        if name.startswith("$"):
+            name = name[1:]
+        rhs = body[eq + 1 :].strip()
+        from orientdb_tpu.exec.oracle import execute_statement
+        from orientdb_tpu.sql.parser import ParseError, parse
+
+        try:
+            stmt = parse(rhs)
+            rows = execute_statement(
+                self.db, stmt, self.params, parent_ctx=self.ctx
+            )
+            self.ctx.variables[name] = _let_value(rows)
+        except ParseError:
+            # expression RHS: LET $x = $y.size() + 1
+            expr = _parse_expr_via_select(rhs)
+            self.ctx.variables[name] = evaluate(self.ctx, expr)
+
+    def _if(self, raw: str) -> Tuple[bool, List[Result]]:
+        # IF (<expr>) { <statements> }
+        open_paren = raw.find("(")
+        if open_paren < 0:
+            raise ScriptError(f"malformed IF: {raw!r}")
+        depth = 0
+        close = -1
+        quote = None
+        for i in range(open_paren, len(raw)):
+            ch = raw[i]
+            if quote is not None:
+                if ch == quote:
+                    quote = None
+                continue
+            if ch in "'\"":
+                quote = ch
+            elif ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    close = i
+                    break
+        if close < 0:
+            raise ScriptError(f"unbalanced IF condition: {raw!r}")
+        cond_text = raw[open_paren + 1 : close]
+        body = raw[close + 1 :].strip()
+        if not (body.startswith("{") and body.endswith("}")):
+            raise ScriptError("IF body must be a { … } block")
+        cond = evaluate(self.ctx, _parse_expr_via_select(cond_text))
+        if not truthy(cond):
+            return False, []
+        return self._run_block(split_script(body[1:-1]))
+
+    def _return(self, raw: str) -> List[Result]:
+        rest = raw[6:].strip()
+        if not rest:
+            return []
+        if rest.startswith("$"):
+            val = self.ctx.variables.get(rest[1:])
+            if isinstance(val, list):
+                return [
+                    r
+                    if isinstance(r, Result)
+                    else Result(
+                        props=r if isinstance(r, dict) else {"value": r}
+                    )
+                    if not hasattr(r, "rid")
+                    else Result(element=r)
+                    for r in val
+                ]
+            return [Result(props={"value": val})]
+        val = evaluate(self.ctx, _parse_expr_via_select(rest))
+        return [Result(props={"value": val})]
+
+
+def execute_script(db, text: str, params: Optional[Dict] = None) -> List[Result]:
+    """Run a SQL batch script; returns the RETURN value's rows, or the
+    last statement's rows ([E] ODatabaseSession.execute contract)."""
+    return _ScriptRunner(db, params).run(text)

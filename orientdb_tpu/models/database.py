@@ -900,6 +900,25 @@ class Database:
 
         return execute_command(self, sql, params or {}, **kw)
 
+    def execute(
+        self,
+        language: str,
+        script: str,
+        params: Optional[Dict[str, object]] = None,
+        **kw,
+    ):
+        """Run a SQL batch script ([E] ODatabaseSession.execute /
+        OCommandScript): multiple statements, LET/IF/RETURN/SLEEP, one
+        session context. Returns a ResultSet like query/command."""
+        if language.lower() != "sql":
+            raise ValueError(
+                f"script language {language!r} not supported (sql only)"
+            )
+        from orientdb_tpu.exec.result import ResultSet
+        from orientdb_tpu.exec.script import execute_script
+
+        return ResultSet(execute_script(self, script, params or kw or {}))
+
     def explain(self, sql: str, params: Optional[Dict[str, object]] = None):
         from orientdb_tpu.exec.engine import explain
 

@@ -124,6 +124,59 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"database '{name}' not found")
         return db
 
+    def _batch_op(self, db, op):
+        """One authorized /batch operation; runs inside the batch tx
+        unless the payload opted out."""
+        from orientdb_tpu.storage.durability import _dec
+
+        typ = op.get("type")
+        if typ == "script":
+            rows = db.execute(
+                op.get("language", "sql"),
+                op["script"],
+                op.get("parameters") or {},
+            )
+            return [r.to_dict() for r in rows]
+        if typ == "cmd":
+            return db.command(
+                op.get("command", ""), op.get("parameters") or {}
+            ).to_dicts()
+        if typ == "c":
+            rec = dict(op.get("record", {}))
+            cls = rec.pop("@class", "O")
+            kind = rec.pop("@type", None)
+            fields = {
+                k: _dec(v) for k, v in rec.items() if not k.startswith("@")
+            }
+            c = db.schema.get_class(cls)
+            # kind dispatch mirrors the /document route: a record in a
+            # vertex class must BE a Vertex or edges against it crash
+            if (c is not None and c.is_vertex_type) or (
+                c is None and kind == "vertex"
+            ):
+                doc = db.new_vertex(cls, **fields)
+            else:
+                doc = db.new_element(cls, **fields)
+            return doc  # rendered post-commit (real rid)
+        if typ == "u":
+            rec = dict(op.get("record", {}))
+            rid = RID.parse(rec.pop("@rid"))
+            cur = db.load(rid)
+            if cur is None:
+                raise _DeferredHttpError(404, f"{rid} not found")
+            for k, v in rec.items():
+                if not k.startswith("@"):
+                    cur.set(k, _dec(v))
+            db.save(cur)
+            return cur  # rendered post-commit
+        # typ == "d" (validated upstream)
+        rec = op.get("record", {})
+        rid = RID.parse(rec.get("@rid") if isinstance(rec, dict) else rec)
+        cur = db.load(rid)
+        if cur is not None:
+            db.delete(cur)
+        return {"deleted": str(rid)}
+
     def _check_tx_ops(self, user, ops) -> None:
         """Authorize a tx op batch PER OP KIND, matching the single-op
         routes: a delete inside a tx needs the delete grant, etc."""
@@ -332,6 +385,83 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     doc = db.new_element(cls, **payload)
                 return self._send(201, _doc_json(doc))
+            if head == "batch" and len(rest) == 1:
+                # [E] the REST /batch command: operations = script /
+                # cmd / c(reate) / u(pdate) / d(elete), one session.
+                # Transactional by default like the reference —
+                # "transaction": false opts out (scripts managing their
+                # OWN tx must opt out; BEGIN inside the wrapped tx
+                # raises).
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                payload = json.loads(self._body() or b"{}")
+                ops = payload.get("operations", ())
+                # authorize EVERYTHING up front: batch scripts carry
+                # arbitrary statements, so each one classifies like a
+                # single command would (DDL needs schema, GRANT needs
+                # security, …) — no escalation through /batch
+                from orientdb_tpu.exec.script import script_permissions
+
+                for op in ops:
+                    typ = op.get("type")
+                    if typ == "script":
+                        script = op.get("script", "")
+                        if isinstance(script, list):
+                            script = ";\n".join(script)
+                        op["script"] = script
+                        for resource, action in sorted(
+                            script_permissions(script)
+                        ):
+                            self.server.ot_server.security.check(
+                                user, resource, action
+                            )
+                    elif typ == "cmd":
+                        resource, action = classify_sql(
+                            op.get("command", "")
+                        )
+                        self.server.ot_server.security.check(
+                            user, resource, action
+                        )
+                    elif typ in ("c", "u", "d"):
+                        self.server.ot_server.security.check(
+                            user,
+                            RES_RECORD,
+                            {"c": "create", "u": "update", "d": "delete"}[
+                                typ
+                            ],
+                        )
+                        if typ in ("u", "d"):
+                            rec = op.get("record", {})
+                            if not (
+                                isinstance(rec, str)
+                                or (isinstance(rec, dict) and "@rid" in rec)
+                            ):
+                                return self._error(
+                                    400, f"batch '{typ}' op needs @rid"
+                                )
+                    else:
+                        return self._error(
+                            400, f"unknown batch op type {typ!r}"
+                        )
+                transactional = payload.get("transaction", True)
+                if transactional:
+                    db.begin()
+                try:
+                    results = [self._batch_op(db, op) for op in ops]
+                    if transactional:
+                        db.commit()
+                except BaseException:
+                    if transactional and db.tx is not None:
+                        db.tx.rollback()
+                    raise
+                # created/updated docs render AFTER commit so their
+                # rids are the adopted real ones, not tx temps
+                rendered = [
+                    _doc_json(r) if isinstance(r, Document) else r
+                    for r in results
+                ]
+                return self._send(200, {"result": rendered})
             if head == "tx" and len(rest) == 1:
                 # forwarded-transaction execution ([E] the distributed tx
                 # task batch, SURVEY.md:126): the non-owner's buffered ops

@@ -1,0 +1,304 @@
+"""SQL batch scripts ([E] OCommandScript / ODatabaseSession.execute):
+multi-statement scripts with LET / IF / RETURN / SLEEP and transaction
+statements sharing one session context, plus the REST /batch command."""
+
+import json
+import urllib.request
+
+import pytest
+
+from orientdb_tpu import Database
+from orientdb_tpu.exec.script import ScriptError, split_script
+
+
+@pytest.fixture()
+def db():
+    d = Database("s")
+    d.schema.create_vertex_class("P")
+    d.schema.create_edge_class("L")
+    return d
+
+
+class TestSplit:
+    def test_semicolons_and_strings(self):
+        parts = split_script(
+            "INSERT INTO P SET name = 'a;b'; SELECT FROM P"
+        )
+        assert len(parts) == 2
+        assert parts[0].endswith("'a;b'")
+
+    def test_braces_protect_match(self):
+        parts = split_script(
+            "LET $m = MATCH {class:P, as:p} RETURN p; RETURN $m"
+        )
+        assert len(parts) == 2
+
+    def test_if_block_stays_whole(self):
+        parts = split_script(
+            "IF ($x > 0) { INSERT INTO P SET a = 1; INSERT INTO P SET a = 2 }"
+        )
+        assert len(parts) == 1
+
+    def test_newline_separates_complete_statements(self):
+        parts = split_script(
+            "INSERT INTO P SET a = 1\nINSERT INTO P SET a = 2\nLET $x = SELECT FROM P\nRETURN $x"
+        )
+        assert len(parts) == 4
+
+    def test_newline_joins_incomplete_statement(self):
+        # a statement may span lines: the newline after an incomplete
+        # prefix does not split
+        parts = split_script("INSERT INTO P\nSET a = 1; SELECT FROM P")
+        assert len(parts) == 2
+        assert parts[0] == "INSERT INTO P\nSET a = 1"
+
+    def test_permission_walk(self):
+        from orientdb_tpu.exec.script import script_permissions
+
+        need = script_permissions(
+            "LET $x = SELECT FROM P;"
+            "IF ($x.size() > 0) { DROP CLASS P };"
+            "GRANT ALL ON record TO writer"
+        )
+        assert ("schema", "update") in need  # the DROP inside IF
+        assert ("security", "update") in need  # the GRANT
+        assert ("record", "read") in need  # the LET's SELECT
+
+
+class TestScripts:
+    def test_last_statement_rows(self, db):
+        rows = db.execute(
+            "sql",
+            "INSERT INTO P SET uid = 1; INSERT INTO P SET uid = 2;"
+            "SELECT uid FROM P ORDER BY uid",
+        ).to_dicts()
+        assert rows == [{"uid": 1}, {"uid": 2}]
+
+    def test_let_feeds_later_statement(self, db):
+        db.new_vertex("P", uid=7)
+        rows = db.execute(
+            "sql",
+            "LET $n = SELECT uid FROM P WHERE uid = 7;"
+            "RETURN $n",
+        ).to_dicts()
+        assert rows == [{"value": 7}]  # single-row single-col collapses
+
+    def test_if_true_and_false(self, db):
+        db.execute(
+            "sql",
+            "LET $c = SELECT count(*) AS c FROM P;"
+            "IF ($c = 0) { INSERT INTO P SET uid = 1 }"
+            ";IF ($c > 99) { INSERT INTO P SET uid = 2 }",
+        )
+        assert db.count_class("P") == 1
+
+    def test_return_expression(self, db):
+        rows = db.execute("sql", "RETURN 2 + 3").to_dicts()
+        assert rows == [{"value": 5}]
+
+    def test_return_inside_if_ends_script(self, db):
+        rows = db.execute(
+            "sql",
+            "IF (1 = 1) { RETURN 'early' };"
+            "INSERT INTO P SET uid = 9;"
+            "RETURN 'late'",
+        ).to_dicts()
+        assert rows == [{"value": "early"}]
+        assert db.count_class("P") == 0
+
+    def test_transaction_spans_statements(self, db):
+        db.execute(
+            "sql",
+            "BEGIN;"
+            "INSERT INTO P SET uid = 1;"
+            "INSERT INTO P SET uid = 2;"
+            "COMMIT",
+        )
+        assert db.count_class("P") == 2
+
+    def test_rollback_drops_script_writes(self, db):
+        db.execute(
+            "sql",
+            "BEGIN; INSERT INTO P SET uid = 1; ROLLBACK",
+        )
+        assert db.count_class("P") == 0
+
+    def test_create_edge_between_let_vertices(self, db):
+        rows = db.execute(
+            "sql",
+            "BEGIN;"
+            "LET $a = CREATE VERTEX P SET uid = 1;"
+            "LET $b = CREATE VERTEX P SET uid = 2;"
+            "CREATE EDGE L FROM $a TO $b;"
+            "COMMIT;"
+            "SELECT count(*) AS c FROM L",
+        ).to_dicts()
+        assert rows == [{"c": 1}]
+
+    def test_malformed_if_raises(self, db):
+        with pytest.raises(ScriptError):
+            db.execute("sql", "IF 1 = 1 { RETURN 1 }")
+
+    def test_non_sql_language_refused(self, db):
+        with pytest.raises(ValueError):
+            db.execute("js", "return 1")
+
+
+class TestHttpBatch:
+    @pytest.fixture()
+    def served(self):
+        from orientdb_tpu.server.server import Server
+
+        s = Server(admin_password="pw")
+        s.startup()
+        db = s.create_database("b")
+        db.schema.create_vertex_class("P")
+        yield s, db
+        s.shutdown()
+
+    def _post(self, url, payload):
+        import base64
+
+        cred = base64.b64encode(b"admin:pw").decode()
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={
+                "Authorization": f"Basic {cred}",
+                "Content-Type": "application/json",
+            },
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def test_batch_script_cmd_and_record_ops(self, served):
+        s, db = served
+        url = f"http://127.0.0.1:{s.http_port}/batch/b"
+        out = self._post(
+            url,
+            {
+                "operations": [
+                    {
+                        "type": "script",
+                        "language": "sql",
+                        "script": [
+                            "INSERT INTO P SET uid = 1",
+                            "SELECT count(*) AS c FROM P",
+                        ],
+                    },
+                    {"type": "cmd", "command": "SELECT uid FROM P"},
+                    {"type": "c", "record": {"@class": "P", "uid": 2}},
+                ]
+            },
+        )
+        r = out["result"]
+        assert r[0] == [{"c": 1}]
+        assert r[1] == [{"uid": 1}]
+        assert r[2]["uid"] == 2
+        assert db.count_class("P") == 2
+
+    def test_batch_script_cannot_escalate(self, served):
+        """A writer (no schema/security grants) must not smuggle DDL or
+        GRANT through a batch script — each statement classifies like a
+        single command."""
+        import base64
+        import urllib.error
+
+        s, db = served
+        url = f"http://127.0.0.1:{s.http_port}/batch/b"
+        cred = base64.b64encode(b"writer:writer").decode()
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(
+                {
+                    "operations": [
+                        {
+                            "type": "script",
+                            "script": "DROP CLASS P; CREATE USER x IDENTIFIED BY 'y' ROLE admin",
+                        }
+                    ]
+                }
+            ).encode(),
+            headers={
+                "Authorization": f"Basic {cred}",
+                "Content-Type": "application/json",
+            },
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 403
+        assert db.schema.exists_class("P")  # nothing executed
+
+    def test_batch_is_transactional_by_default(self, served):
+        """A mid-batch failure rolls the whole batch back (the
+        reference's /batch 'transaction': true default)."""
+        import urllib.error
+
+        s, db = served
+        url = f"http://127.0.0.1:{s.http_port}/batch/b"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(
+                url,
+                {
+                    "operations": [
+                        {"type": "c", "record": {"@class": "P", "uid": 1}},
+                        {
+                            "type": "u",
+                            "record": {"@rid": "#99:999", "uid": 2},
+                        },
+                    ]
+                },
+            )
+        assert ei.value.code == 404
+        assert db.count_class("P") == 0  # the create rolled back
+
+    def test_batch_create_in_vertex_class_is_vertex(self, served):
+        from orientdb_tpu.models.record import Vertex
+
+        s, db = served
+        url = f"http://127.0.0.1:{s.http_port}/batch/b"
+        out = self._post(
+            url,
+            {
+                "operations": [
+                    {"type": "c", "record": {"@class": "P", "uid": 9}}
+                ]
+            },
+        )
+        rid = out["result"][0]["@rid"]
+        assert not rid.startswith("#-")  # real rid, not a tx temp
+        from orientdb_tpu.models.rid import RID
+
+        assert isinstance(db.load(RID.parse(rid)), Vertex)
+
+    def test_batch_update_missing_rid_is_400(self, served):
+        import urllib.error
+
+        s, db = served
+        url = f"http://127.0.0.1:{s.http_port}/batch/b"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(
+                url, {"operations": [{"type": "u", "record": {"uid": 5}}]}
+            )
+        assert ei.value.code == 400
+
+    def test_batch_update_delete(self, served):
+        s, db = served
+        v = db.new_vertex("P", uid=1)
+        url = f"http://127.0.0.1:{s.http_port}/batch/b"
+        out = self._post(
+            url,
+            {
+                "operations": [
+                    {
+                        "type": "u",
+                        "record": {"@rid": str(v.rid), "uid": 5},
+                    },
+                    {"type": "d", "record": {"@rid": str(v.rid)}},
+                ]
+            },
+        )
+        assert out["result"][0]["uid"] == 5
+        assert db.load(v.rid) is None
