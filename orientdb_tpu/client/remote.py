@@ -236,6 +236,22 @@ class RemoteDatabase:
         r = self._checked({"op": "command", "sql": sql, "params": params})
         return RemoteResultSet(r["result"], r.get("engine"))
 
+    def execute(
+        self, language: str, script: str, params: Optional[Dict] = None
+    ) -> RemoteResultSet:
+        """Run a SQL batch script server-side ([E] the remote
+        OCommandScript request): LET/IF/RETURN and transactions span
+        statements in ONE server session round trip."""
+        r = self._checked(
+            {
+                "op": "script",
+                "language": language,
+                "script": script,
+                "params": params,
+            }
+        )
+        return RemoteResultSet(r["result"], r.get("engine"))
+
     def query_batch(
         self, sqls: List[str], params_list: Optional[List] = None
     ) -> List[RemoteResultSet]:

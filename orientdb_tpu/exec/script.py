@@ -101,12 +101,60 @@ def split_script(text: str) -> List[str]:
     return [s.strip() for s in out if s.strip()]
 
 
+def _split_if(raw: str) -> Tuple[str, str]:
+    """``IF (<cond>) { <body> }`` → (cond_text, body_text). Raises
+    ScriptError on malformed shapes (shared by runner + authorizer)."""
+    open_paren = raw.find("(")
+    if open_paren < 0:
+        raise ScriptError(f"malformed IF: {raw!r}")
+    depth = 0
+    close = -1
+    quote: Optional[str] = None
+    for i in range(open_paren, len(raw)):
+        ch = raw[i]
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "'\"":
+            quote = ch
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                close = i
+                break
+    if close < 0:
+        raise ScriptError(f"unbalanced IF condition: {raw!r}")
+    body = raw[close + 1 :].strip()
+    if not (body.startswith("{") and body.endswith("}")):
+        raise ScriptError("IF body must be a { … } block")
+    return raw[open_paren + 1 : close], body[1:-1]
+
+
+def _expr_permissions(expr_text: str) -> set:
+    """Permissions an EXPRESSION needs: expressions read data only via
+    embedded subqueries (SELECT/MATCH/TRAVERSE), so their presence
+    requires the read grant; pure arithmetic needs nothing. Keyword
+    scan is deliberately conservative — a string literal containing
+    'select' over-requires, never under-requires."""
+    from orientdb_tpu.models.security import READ, RES_RECORD
+
+    t = expr_text.lower()
+    if "select" in t or "match" in t or "traverse" in t:
+        return {(RES_RECORD, READ)}
+    return set()
+
+
 def script_permissions(text: str) -> set:
     """Every (resource, op) pair the script needs, for callers that
     authorize before executing ([E] the per-command checks the server
     applies to single statements): walks top-level statements, LET
-    right-hand sides, and IF bodies recursively."""
+    right-hand sides, IF conditions AND bodies, and RETURN expressions
+    recursively — a subquery anywhere still needs the read grant."""
     from orientdb_tpu.models.security import classify_sql
+    from orientdb_tpu.sql.parser import parse
 
     needed: set = set()
     for raw in split_script(text):
@@ -114,14 +162,23 @@ def script_permissions(text: str) -> set:
         if head == "LET":
             eq = raw.find("=")
             if eq > 0:
-                needed |= script_permissions(raw[eq + 1 :])
+                rhs = raw[eq + 1 :].strip()
+                try:
+                    parse(rhs)
+                    needed.add(classify_sql(rhs))
+                except Exception:
+                    # expression RHS: subqueries inside still read
+                    needed |= _expr_permissions(rhs)
         elif head == "IF":
-            brace = raw.find("{")
-            if brace > 0 and raw.rstrip().endswith("}"):
-                needed |= script_permissions(
-                    raw[brace + 1 : raw.rstrip().rfind("}")]
-                )
-        elif head in ("RETURN", "SLEEP", ""):
+            try:
+                cond, body = _split_if(raw)
+            except ScriptError:
+                continue  # the runner raises the real error
+            needed |= _expr_permissions(cond)
+            needed |= script_permissions(body)
+        elif head == "RETURN":
+            needed |= _expr_permissions(raw[6:])
+        elif head in ("SLEEP", ""):
             continue
         else:
             needed.add(classify_sql(raw))
@@ -212,37 +269,11 @@ class _ScriptRunner:
 
     def _if(self, raw: str) -> Tuple[bool, List[Result]]:
         # IF (<expr>) { <statements> }
-        open_paren = raw.find("(")
-        if open_paren < 0:
-            raise ScriptError(f"malformed IF: {raw!r}")
-        depth = 0
-        close = -1
-        quote = None
-        for i in range(open_paren, len(raw)):
-            ch = raw[i]
-            if quote is not None:
-                if ch == quote:
-                    quote = None
-                continue
-            if ch in "'\"":
-                quote = ch
-            elif ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth -= 1
-                if depth == 0:
-                    close = i
-                    break
-        if close < 0:
-            raise ScriptError(f"unbalanced IF condition: {raw!r}")
-        cond_text = raw[open_paren + 1 : close]
-        body = raw[close + 1 :].strip()
-        if not (body.startswith("{") and body.endswith("}")):
-            raise ScriptError("IF body must be a { … } block")
+        cond_text, body = _split_if(raw)
         cond = evaluate(self.ctx, _parse_expr_via_select(cond_text))
         if not truthy(cond):
             return False, []
-        return self._run_block(split_script(body[1:-1]))
+        return self._run_block(split_script(body))
 
     def _return(self, raw: str) -> List[Result]:
         rest = raw[6:].strip()

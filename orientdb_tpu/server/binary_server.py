@@ -262,6 +262,26 @@ class _Session:
                 self.server.security.check(self.user, resource, cop)
                 rs = self.db.command(req["sql"], req.get("params"))
                 return {"ok": True, "result": rs.to_dicts(), "engine": rs.engine}
+            if op == "script":
+                # SQL batch script ([E] the REQUEST_COMMAND script
+                # payload): every embedded statement authorizes like a
+                # single command — no escalation through scripts
+                from orientdb_tpu.exec.script import script_permissions
+
+                for resource, action in sorted(
+                    script_permissions(req["script"])
+                ):
+                    self.server.security.check(self.user, resource, action)
+                rs = self.db.execute(
+                    req.get("language", "sql"),
+                    req["script"],
+                    req.get("params"),
+                )
+                return {
+                    "ok": True,
+                    "result": rs.to_dicts(),
+                    "engine": getattr(rs, "engine", None),
+                }
             if op == "load":
                 self.server.security.check(self.user, RES_RECORD, "read")
                 doc = self.db.load(RID.parse(req["rid"]))

@@ -213,6 +213,20 @@ class Console(cmd.Cmd):
             return
         self.default(f"restore {arg}")
 
+    def do_script(self, arg: str) -> None:
+        """SCRIPT <sql batch>  — LET/IF/RETURN and ';'-separated
+        statements in one session ([E] the console's script command)."""
+        if not self._need_db():
+            return
+        try:
+            target = self.remote if self.remote is not None else self.db
+            rows = target.execute("sql", arg).to_dicts()
+            for i, r in enumerate(rows):
+                self._p(f"# {i}: {r}")
+            self._p(f"({len(rows)} rows)")
+        except Exception as e:
+            self._p(f"!! {type(e).__name__}: {e}")
+
     def do_quit(self, _arg: str) -> bool:
         return True
 

@@ -64,6 +64,24 @@ class TestSplit:
         assert ("security", "update") in need  # the GRANT
         assert ("record", "read") in need  # the LET's SELECT
 
+    def test_permission_walk_sees_embedded_subqueries(self):
+        """Review regression: subqueries inside RETURN expressions and
+        IF conditions still require the read grant — a script cannot
+        smuggle reads past authorization through expression position."""
+        from orientdb_tpu.exec.script import script_permissions
+
+        assert ("record", "read") in script_permissions(
+            "RETURN (SELECT secret FROM P)"
+        )
+        assert ("record", "read") in script_permissions(
+            "IF ((SELECT count(*) AS c FROM P) > 0) { RETURN 1 }"
+        )
+        assert ("record", "read") in script_permissions(
+            "LET $x = (SELECT secret FROM P).size(); RETURN $x"
+        )
+        # pure arithmetic needs nothing — a reader-less role may run it
+        assert script_permissions("LET $x = 1 + 1; RETURN $x") == set()
+
 
 class TestScripts:
     def test_last_statement_rows(self, db):
@@ -142,6 +160,63 @@ class TestScripts:
     def test_non_sql_language_refused(self, db):
         with pytest.raises(ValueError):
             db.execute("js", "return 1")
+
+
+class TestRemoteScript:
+    def test_binary_protocol_script_roundtrip(self):
+        from orientdb_tpu.client.remote import RemoteDatabase
+        from orientdb_tpu.server.server import Server
+
+        s = Server(admin_password="pw")
+        s.startup()
+        db = s.create_database("r")
+        db.schema.create_vertex_class("P")
+        try:
+            with RemoteDatabase(
+                "127.0.0.1", s.binary_port, "r", "admin", "pw"
+            ) as rdb:
+                rows = rdb.execute(
+                    "sql",
+                    "BEGIN; INSERT INTO P SET uid = 1; COMMIT;"
+                    "SELECT count(*) AS c FROM P",
+                ).to_dicts()
+                assert rows == [{"c": 1}]
+        finally:
+            s.shutdown()
+
+    def test_binary_script_authorizes_per_statement(self):
+        from orientdb_tpu.client.remote import RemoteDatabase, RemoteError
+        from orientdb_tpu.server.server import Server
+
+        s = Server(admin_password="pw")
+        s.startup()
+        db = s.create_database("r2")
+        db.schema.create_vertex_class("P")
+        try:
+            with RemoteDatabase(
+                "127.0.0.1", s.binary_port, "r2", "writer", "writer"
+            ) as rdb:
+                with pytest.raises(Exception) as ei:
+                    rdb.execute("sql", "DROP CLASS P")
+                assert "permission" in str(ei.value).lower()
+                assert db.schema.exists_class("P")
+        finally:
+            s.shutdown()
+
+
+class TestConsoleScript:
+    def test_console_script_command(self, capsys):
+        from orientdb_tpu.tools.console import Console
+
+        c = Console()
+        c.onecmd("CONNECT embedded:t")
+        c.onecmd("CREATE CLASS P EXTENDS V")
+        c.onecmd(
+            "script LET $a = CREATE VERTEX P SET uid = 1; "
+            "RETURN $a"
+        )
+        out = capsys.readouterr().out
+        assert "1 rows" in out or "(1 rows)" in out
 
 
 class TestHttpBatch:
