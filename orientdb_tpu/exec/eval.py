@@ -375,6 +375,12 @@ def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
     if name in _MATH_FNS:
         return None if args[0] is None else _MATH_FNS[name](args[0])
     if name == "date":
+        # [E] OSQLFunctionDate: no args → now; 1 arg → parse/passthrough
+        # (format args beyond that are passthrough too)
+        if not args:
+            import datetime
+
+            return datetime.datetime.now().isoformat()
         return args[0]
     if name == "sysdate":
         import datetime

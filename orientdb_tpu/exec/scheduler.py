@@ -1,0 +1,303 @@
+"""Scheduled events.
+
+Analog of the reference's scheduler ([E] core/.../schedule/OScheduler +
+OScheduledEvent: events are ``OSchedule`` RECORDS — name, a Quartz-like
+cron ``rule``, the stored ``function`` to invoke, ``arguments`` — and a
+scheduler thread fires each event when its rule matches). Events here
+live as documents of the ``OSchedule`` class, so they replicate,
+survive restarts with the WAL, and are managed with plain SQL
+(``INSERT INTO OSchedule SET name='x', rule='0/5 * * * * ?',
+function='f'``) exactly like the reference.
+
+Rules are 6-field seconds-resolution cron (sec min hour dom mon dow),
+with ``*``, ``?``, lists ``a,b``, ranges ``a-b``, and steps ``*/n`` /
+``a/n``. The scheduler thread ticks once per second; a tick runs every
+enabled event whose rule matches that second (at-most-once per second,
+the reference's semantics). Execution = invoking the named stored
+function (models/metadata.StoredFunction) with the event's arguments.
+
+Divergence, documented: the thread is started explicitly
+(``db.scheduler.start()``) rather than with database open — tests and
+embedded uses stay thread-free by default.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("scheduler")
+
+SCHEDULE_CLASS = "OSchedule"
+
+
+class CronError(Exception):
+    pass
+
+
+def _parse_field(spec: str, lo: int, hi: int) -> Optional[frozenset]:
+    """One cron field → matching set, or None for the wildcard."""
+    if spec in ("*", "?"):
+        return None
+    out = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronError(f"bad step {step_s!r}") from None
+            if step <= 0:
+                raise CronError(f"bad step {step}")
+        if part in ("*", "?", ""):
+            lo2, hi2 = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            try:
+                lo2, hi2 = int(a), int(b)
+            except ValueError:
+                raise CronError(f"bad range {part!r}") from None
+        else:
+            try:
+                lo2 = hi2 = int(part)
+            except ValueError:
+                raise CronError(f"bad value {part!r}") from None
+            if step != 1:
+                hi2 = hi  # Quartz 'a/n': from a to max, every n
+        if lo2 < lo or hi2 > hi:
+            raise CronError(f"{part!r} outside [{lo}, {hi}]")
+        if lo2 > hi2:
+            # a reversed range matches nothing: the event would
+            # validate eagerly yet sit latent forever
+            raise CronError(f"reversed range {part!r}")
+        out.update(range(lo2, hi2 + 1, step))
+    return frozenset(out)
+
+
+class CronRule:
+    """Six-field seconds cron: sec min hour day-of-month month
+    day-of-week (0=Sunday, like the reference's Quartz 1=SUN shifted
+    to the Python convention; both 0 and 7 mean Sunday)."""
+
+    __slots__ = ("text", "_fields")
+
+    _BOUNDS = [(0, 59), (0, 59), (0, 23), (1, 31), (1, 12), (0, 7)]
+
+    def __init__(self, text: str) -> None:
+        parts = text.split()
+        if len(parts) == 5:
+            # classic 5-field cron: implicit seconds-0
+            parts = ["0"] + parts
+        if len(parts) != 6:
+            raise CronError(
+                f"rule {text!r}: expected 5 or 6 cron fields"
+            )
+        self.text = text
+        self._fields = [
+            _parse_field(p, lo, hi)
+            for p, (lo, hi) in zip(parts, self._BOUNDS)
+        ]
+
+    def matches(self, t: Optional[float] = None) -> bool:
+        lt = time.localtime(t if t is not None else time.time())
+        dow = (lt.tm_wday + 1) % 7  # Python Mon=0 → cron Sun=0
+        vals = [lt.tm_sec, lt.tm_min, lt.tm_hour, lt.tm_mday, lt.tm_mon]
+        for field, v in zip(self._fields[:5], vals):
+            if field is not None and v not in field:
+                return False
+        f_dow = self._fields[5]
+        if f_dow is not None and dow not in f_dow and not (
+            dow == 0 and 7 in f_dow
+        ):
+            return False
+        return True
+
+
+class Scheduler:
+    """Per-database event scheduler reading ``OSchedule`` documents.
+
+    Fields per event record ([E] OScheduledEvent's properties): ``name``
+    (unique), ``rule`` (cron), ``function`` (stored function name),
+    ``arguments`` (list, optional), ``enabled`` (default true). Runtime
+    state (last fire second, run counter) stays off-record so the
+    documents replicate cleanly.
+    """
+
+    TICK = 0.25  # seconds between wakeups; fires are per-second exact
+
+    def __init__(self, db) -> None:
+        self.db = db
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        #: event name → last fired epoch second (at-most-once/second)
+        self._last_fired: Dict[str, int] = {}
+        #: event name → executions (introspection + tests)
+        self.run_counts: Dict[str, int] = {}
+        self._rules: Dict[str, CronRule] = {}
+        #: rule texts already reported as unparseable (log once)
+        self._bad_rules: set = set()
+        #: last epoch second the event set was evaluated for — ticks
+        #: within one second return early, and a tick arriving LATE
+        #: evaluates every second it slept through (a slow function or
+        #: GC pause must not silently skip a sparse rule's one second)
+        self._last_scan_sec: Optional[int] = None
+
+    # -- management ----------------------------------------------------------
+
+    def _ensure_class(self) -> None:
+        if not self.db.schema.exists_class(SCHEDULE_CLASS):
+            self.db.schema.create_class(SCHEDULE_CLASS)
+
+    def schedule(
+        self,
+        name: str,
+        rule: str,
+        function: str,
+        arguments: Optional[List] = None,
+    ):
+        """Create (or replace) an event record; the rule validates
+        eagerly so a bad cron never sits latent in the store."""
+        CronRule(rule)
+        self._ensure_class()
+        for doc in list(self.db.browse_class(SCHEDULE_CLASS)):
+            if doc.get("name") == name:
+                self.db.delete(doc)
+        return self.db.new_element(
+            SCHEDULE_CLASS,
+            name=name,
+            rule=rule,
+            function=function,
+            arguments=list(arguments or []),
+            enabled=True,
+        )
+
+    def unschedule(self, name: str) -> bool:
+        if not self.db.schema.exists_class(SCHEDULE_CLASS):
+            return False
+        for doc in list(self.db.browse_class(SCHEDULE_CLASS)):
+            if doc.get("name") == name:
+                self.db.delete(doc)
+                return True
+        return False
+
+    def events(self) -> List[dict]:
+        if not self.db.schema.exists_class(SCHEDULE_CLASS):
+            return []
+        return [
+            {
+                "name": d.get("name"),
+                "rule": d.get("rule"),
+                "function": d.get("function"),
+                "enabled": d.get("enabled", True),
+                "runs": self.run_counts.get(d.get("name"), 0),
+            }
+            for d in self.db.browse_class(SCHEDULE_CLASS)
+        ]
+
+    # -- the loop ------------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="ot-scheduler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _rule_for(self, text: str) -> Optional[CronRule]:
+        r = self._rules.get(text)
+        if r is None:
+            try:
+                r = self._rules[text] = CronRule(text)
+            except CronError as e:
+                # SQL-inserted events bypass schedule()'s eager
+                # validation: a bad rule must be visible, once
+                if text not in self._bad_rules:
+                    self._bad_rules.add(text)
+                    log.warning("unparseable cron rule %r: %s", text, e)
+                return None
+        return r
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.TICK):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - keep the loop alive
+                log.exception("scheduler tick failed")
+
+    #: longest catch-up window after a stall; a longer gap logs and
+    #: skips (a laptop resume must not replay a day of minutely fires)
+    MAX_CATCHUP_S = 300
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Evaluate every second since the previous tick (catch-up: a
+        slow function or pause spanning a rule's one matching second
+        still fires it) and fire matching events. Split from the
+        thread loop so tests drive time explicitly."""
+        now = time.time() if now is None else now
+        cur = int(now)
+        last = self._last_scan_sec
+        if last is not None and cur <= last:
+            return 0  # this second was already evaluated
+        start = cur if last is None else last + 1
+        if cur - start > self.MAX_CATCHUP_S:
+            log.warning(
+                "scheduler stalled %ds; skipping to now (misses are "
+                "not replayed past %ds)",
+                cur - start,
+                self.MAX_CATCHUP_S,
+            )
+            start = cur - self.MAX_CATCHUP_S
+        self._last_scan_sec = cur
+        if not self.db.schema.exists_class(SCHEDULE_CLASS):
+            return 0
+        docs = list(self.db.browse_class(SCHEDULE_CLASS))
+        fired = 0
+        for sec in range(start, cur + 1):
+            for doc in docs:
+                name = doc.get("name")
+                if not name or not doc.get("enabled", True):
+                    continue
+                rule = self._rule_for(doc.get("rule") or "")
+                if rule is None or not rule.matches(float(sec)):
+                    continue
+                if self._last_fired.get(name) == sec:
+                    continue  # at-most-once per matching second
+                self._last_fired[name] = sec
+                fired += 1
+                self._fire(name, doc)
+        return fired
+
+    def _fire(self, name: str, doc) -> None:
+        fn_name = doc.get("function")
+        fn = self.db.functions.get(fn_name) if fn_name else None
+        if fn is None:
+            log.warning(
+                "scheduled event %r: function %r not found", name, fn_name
+            )
+            return
+        try:
+            fn.invoke(self.db, list(doc.get("arguments") or []))
+            metrics.incr("scheduler.fired")
+            self.run_counts[name] = self.run_counts.get(name, 0) + 1
+        except Exception:
+            metrics.incr("scheduler.failed")
+            log.exception("scheduled event %r failed", name)
+
+

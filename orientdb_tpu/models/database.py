@@ -121,6 +121,7 @@ class Database:
         # Sequence/function libraries (models/metadata.py), lazy.
         self._sequences = None
         self._functions = None
+        self._scheduler = None
         # Hook manager ([E] ORecordHook registry) attached lazily.
         self._hooks = None
         # Optimistic transactions ([E] OTransactionOptimistic): one active
@@ -815,6 +816,19 @@ class Database:
 
             self._functions = FunctionManager(self)
         return self._functions
+
+    @property
+    def scheduler(self):
+        """Scheduled events ([E] OScheduler): OSchedule records firing
+        stored functions on cron rules. Start the loop explicitly with
+        ``db.scheduler.start()``."""
+        if self._scheduler is None:
+            from orientdb_tpu.exec.scheduler import Scheduler
+
+            with self._lock:
+                if self._scheduler is None:
+                    self._scheduler = Scheduler(self)
+        return self._scheduler
 
     # -- hooks & transactions ----------------------------------------------
 

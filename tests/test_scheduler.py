@@ -1,0 +1,174 @@
+"""Scheduled events ([E] OScheduler / OScheduledEvent): OSchedule
+records with cron rules invoke stored functions; tick-driven tests
+plus one real-thread smoke test."""
+
+import time
+
+import pytest
+
+from orientdb_tpu import Database
+from orientdb_tpu.exec.scheduler import CronError, CronRule
+
+
+class TestCron:
+    def test_wildcards_match_always(self):
+        assert CronRule("* * * * * *").matches()
+        assert CronRule("* * * * *").matches(time.time()) in (True, False)
+
+    def test_five_field_means_second_zero(self):
+        r = CronRule("* * * * *")
+        t0 = time.mktime((2026, 7, 31, 12, 30, 0, 0, 0, -1))
+        assert r.matches(t0)
+        assert not r.matches(t0 + 1)  # second 1
+
+    def test_specific_minute(self):
+        r = CronRule("0 30 12 * * ?")
+        t = time.mktime((2026, 7, 31, 12, 30, 0, 0, 0, -1))
+        assert r.matches(t)
+        assert not r.matches(t + 60)
+
+    def test_steps_and_lists(self):
+        r = CronRule("0/15 * * * * ?")
+        base = time.mktime((2026, 7, 31, 12, 0, 0, 0, 0, -1))
+        assert r.matches(base)
+        assert r.matches(base + 15)
+        assert not r.matches(base + 10)
+        r2 = CronRule("0 0 9,17 * * ?")
+        t9 = time.mktime((2026, 7, 31, 9, 0, 0, 0, 0, -1))
+        t17 = time.mktime((2026, 7, 31, 17, 0, 0, 0, 0, -1))
+        t12 = time.mktime((2026, 7, 31, 12, 0, 0, 0, 0, -1))
+        assert r2.matches(t9) and r2.matches(t17) and not r2.matches(t12)
+
+    def test_day_of_week(self):
+        # 2026-08-02 is a Sunday
+        sun = time.mktime((2026, 8, 2, 9, 0, 0, 0, 0, -1))
+        mon = time.mktime((2026, 8, 3, 9, 0, 0, 0, 0, -1))
+        r = CronRule("0 0 9 ? * 0")
+        assert r.matches(sun) and not r.matches(mon)
+        # 7 also means Sunday (both conventions accepted)
+        assert CronRule("0 0 9 ? * 7").matches(sun)
+
+    def test_bad_rules_raise(self):
+        with pytest.raises(CronError):
+            CronRule("99 * * * * *")
+        with pytest.raises(CronError):
+            CronRule("* * *")
+        with pytest.raises(CronError):
+            CronRule("*/0 * * * * *")
+        with pytest.raises(CronError):
+            CronRule("0 30-10 * * * ?")  # reversed range: matches nothing
+
+
+@pytest.fixture()
+def db():
+    d = Database("sch")
+    d.schema.create_class("Log")
+    d.functions.create(
+        "logit", "INSERT INTO Log SET at = 'tick'", ()
+    )
+    return d
+
+
+class TestScheduler:
+    def test_schedule_fires_on_matching_tick(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        fired = db.scheduler.tick(now=1000.0)
+        assert fired == 1
+        assert db.count_class("Log") == 1
+
+    def test_at_most_once_per_second(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        db.scheduler.tick(now=1000.0)
+        db.scheduler.tick(now=1000.4)  # same second: no refire
+        assert db.count_class("Log") == 1
+        db.scheduler.tick(now=1001.0)
+        assert db.count_class("Log") == 2
+
+    def test_disabled_event_does_not_fire(self, db):
+        doc = db.scheduler.schedule("ev", "* * * * * *", "logit")
+        doc.set("enabled", False)
+        db.save(doc)
+        assert db.scheduler.tick(now=1000.0) == 0
+
+    def test_schedule_replaces_by_name(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        db.scheduler.schedule("ev", "0 0 0 1 1 ?", "logit")
+        evs = db.scheduler.events()
+        assert len(evs) == 1 and evs[0]["rule"] == "0 0 0 1 1 ?"
+
+    def test_unschedule(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        assert db.scheduler.unschedule("ev")
+        assert db.scheduler.events() == []
+        assert db.scheduler.tick(now=1000.0) == 0
+
+    def test_events_managed_with_plain_sql(self, db):
+        """The reference manages events as records — INSERT INTO
+        OSchedule works without touching the scheduler API."""
+        db.scheduler._ensure_class()
+        db.command(
+            "INSERT INTO OSchedule SET name = 'sq', "
+            "rule = '* * * * * *', function = 'logit', enabled = true"
+        )
+        assert db.scheduler.tick(now=1000.0) == 1
+        assert db.count_class("Log") == 1
+
+    def test_missing_function_is_logged_not_fatal(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "nosuch")
+        assert db.scheduler.tick(now=1000.0) == 1  # matched, ran nothing
+        assert db.count_class("Log") == 0
+
+    def test_bad_rule_rejected_eagerly(self, db):
+        with pytest.raises(CronError):
+            db.scheduler.schedule("ev", "not a rule", "logit")
+
+    def test_function_arguments_bind(self, db):
+        db.functions.create(
+            "logv", "INSERT INTO Log SET v = tag", ("tag",)
+        )
+        db.scheduler.schedule("ev", "* * * * * *", "logv", ["hello"])
+        db.scheduler.tick(now=1000.0)
+        rows = db.query("SELECT v FROM Log").to_dicts()
+        assert rows == [{"v": "hello"}]
+
+    def test_catchup_fires_slept_through_seconds(self, db):
+        """A tick arriving late evaluates every second it missed, so a
+        sparse rule's one matching second still fires (review
+        regression: a slow function spanning the second must not
+        silently skip a daily job)."""
+        import time as _t
+
+        target = _t.mktime((2026, 7, 31, 12, 30, 0, 0, 0, -1))
+        db.functions.create("mark", "INSERT INTO Log SET at = 'daily'", ())
+        db.scheduler.schedule("daily", "0 30 12 * * ?", "mark")
+        db.scheduler.tick(now=target - 2)  # baseline scan
+        # next tick arrives AFTER the matching second passed
+        fired = db.scheduler.tick(now=target + 3)
+        assert fired == 1
+        assert db.count_class("Log") == 1
+
+    def test_stall_beyond_catchup_window_skips(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        db.scheduler.tick(now=1000.0)
+        from orientdb_tpu.exec.scheduler import Scheduler
+
+        fired = db.scheduler.tick(now=1000.0 + Scheduler.MAX_CATCHUP_S + 500)
+        # bounded: at most the window's worth of seconds, not 800 fires
+        assert fired <= Scheduler.MAX_CATCHUP_S + 1
+
+    def test_same_second_tick_returns_early(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        assert db.scheduler.tick(now=1000.0) == 1
+        assert db.scheduler.tick(now=1000.9) == 0
+
+    def test_real_thread_smoke(self, db):
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        db.scheduler.start()
+        try:
+            deadline = time.time() + 5
+            while db.count_class("Log") < 2 and time.time() < deadline:
+                time.sleep(0.1)
+            assert db.count_class("Log") >= 2
+        finally:
+            db.scheduler.stop()
+        assert not db.scheduler.running
