@@ -242,6 +242,142 @@ _MATH_FNS = {
 }
 
 
+def _as_vertex(ctx: EvalContext, v) -> Optional[Vertex]:
+    """Resolve a graph-function endpoint argument: a Vertex, a RID, a
+    rid string, or a single-element result list (``$a`` bindings)."""
+    # RID is a NamedTuple: test it BEFORE the list/tuple unwrap
+    if not isinstance(v, RID) and isinstance(v, (list, tuple)):
+        v = v[0] if len(v) == 1 else None
+    if isinstance(v, Vertex):
+        return v
+    if isinstance(v, Document):
+        return None
+    rid = None
+    if isinstance(v, RID):
+        rid = v
+    elif isinstance(v, str) and v.startswith("#"):
+        try:
+            rid = RID.parse(v)
+        except ValueError:
+            return None
+    if rid is not None and ctx.db is not None:
+        doc = ctx.db.load(rid)
+        return doc if isinstance(doc, Vertex) else None
+    return None
+
+
+def _path_direction(arg) -> Direction:
+    d = str(arg or "BOTH").upper()
+    return {
+        "OUT": Direction.OUT,
+        "IN": Direction.IN,
+    }.get(d, Direction.BOTH)
+
+
+def _shortest_path(ctx: EvalContext, args) -> List[RID]:
+    """[E] OSQLFunctionShortestPath: unweighted BFS source→target.
+    ``shortestPath(v1, v2 [, direction [, edgeClass [, {maxDepth}]]])``
+    → list of rids INCLUDING both endpoints; [] when unreachable."""
+    if len(args) < 2:
+        return []
+    src = _as_vertex(ctx, args[0])
+    dst = _as_vertex(ctx, args[1])
+    if src is None or dst is None:
+        return []
+    if src.rid == dst.rid:
+        return [src.rid]
+    direction = _path_direction(args[2] if len(args) > 2 else None)
+    edge_class = args[3] if len(args) > 3 else None
+    # the reference accepts a single class name OR a collection of them
+    if isinstance(edge_class, str) or edge_class is None:
+        edge_classes: List[Optional[str]] = [edge_class]
+    else:
+        edge_classes = list(edge_class) or [None]
+    max_depth = None
+    if len(args) > 4 and isinstance(args[4], dict):
+        max_depth = args[4].get("maxDepth")
+    parent: Dict[RID, RID] = {src.rid: src.rid}
+    frontier = [src]
+    depth = 0
+    while frontier:
+        depth += 1
+        if max_depth is not None and depth > max_depth:
+            return []
+        nxt: List[Vertex] = []
+        for v in frontier:
+            for ec in edge_classes:
+                for w in v.vertices(direction, ec):
+                    if w.rid in parent:
+                        continue
+                    parent[w.rid] = v.rid
+                    if w.rid == dst.rid:
+                        path = [w.rid]
+                        while path[-1] != src.rid:
+                            path.append(parent[path[-1]])
+                        path.reverse()
+                        return path
+                    nxt.append(w)
+        frontier = nxt
+    return []
+
+
+def _dijkstra(ctx: EvalContext, args) -> List[Vertex]:
+    """[E] OSQLFunctionDijkstra: cheapest path by a numeric EDGE field.
+    ``dijkstra(v1, v2, weightField [, direction])`` → vertex list
+    including both endpoints; [] when unreachable. Edges missing the
+    weight field cost 1."""
+    import heapq
+    import itertools
+
+    if len(args) < 3:
+        return []
+    src = _as_vertex(ctx, args[0])
+    dst = _as_vertex(ctx, args[1])
+    weight_field = str(args[2])
+    if src is None or dst is None:
+        return []
+    direction = _path_direction(args[3] if len(args) > 3 else "OUT")
+    tie = itertools.count()  # heap tiebreaker: vertices don't compare
+    dist: Dict[RID, float] = {src.rid: 0.0}
+    parent: Dict[RID, RID] = {}
+    heap = [(0.0, next(tie), src)]
+    done: set = set()
+    while heap:
+        d, _t, v = heapq.heappop(heap)
+        if v.rid in done:
+            continue
+        done.add(v.rid)
+        if v.rid == dst.rid:
+            path = [v]
+            cur = v.rid
+            while cur != src.rid:
+                cur = parent[cur]
+                path.append(ctx.db.load(cur))
+            path.reverse()
+            return path
+        for e in v.edges(direction):
+            if direction is Direction.BOTH:
+                other = e.in_rid if e.out_rid == v.rid else e.out_rid
+            elif direction is Direction.OUT:
+                if e.out_rid != v.rid:
+                    continue
+                other = e.in_rid
+            else:
+                if e.in_rid != v.rid:
+                    continue
+                other = e.out_rid
+            w = e.get(weight_field)
+            cost = float(w) if isinstance(w, (int, float)) else 1.0
+            nd = d + cost
+            if nd < dist.get(other, float("inf")):
+                dist[other] = nd
+                parent[other] = v.rid
+                nv = ctx.db.load(other)
+                if isinstance(nv, Vertex):
+                    heapq.heappush(heap, (nd, next(tie), nv))
+    return []
+
+
 def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
     """Non-aggregate function dispatch ([E] OSQLFunctionFactory)."""
     name = name.lower()
@@ -374,6 +510,18 @@ def eval_function(ctx: EvalContext, name: str, arg_exprs, evaluator) -> object:
         return d
     if name in _MATH_FNS:
         return None if args[0] is None else _MATH_FNS[name](args[0])
+    if name == "shortestpath":
+        return _shortest_path(ctx, args)
+    if name == "dijkstra":
+        return _dijkstra(ctx, args)
+    if name == "astar":
+        # [E] OSQLFunctionAstar — without coordinate heuristics the
+        # honest admissible heuristic is 0, which IS Dijkstra; the
+        # option map (4th arg) is accepted for direction
+        d_args = list(args[:3])
+        if len(args) > 3 and isinstance(args[3], dict):
+            d_args.append(args[3].get("direction", "OUT"))
+        return _dijkstra(ctx, d_args)
     if name == "date":
         # [E] OSQLFunctionDate: no args → now; 1 arg → parse/passthrough
         # (format args beyond that are passthrough too)

@@ -106,16 +106,23 @@ class CronRule:
     def matches(self, t: Optional[float] = None) -> bool:
         lt = time.localtime(t if t is not None else time.time())
         dow = (lt.tm_wday + 1) % 7  # Python Mon=0 → cron Sun=0
-        vals = [lt.tm_sec, lt.tm_min, lt.tm_hour, lt.tm_mday, lt.tm_mon]
-        for field, v in zip(self._fields[:5], vals):
+        for field, v in zip(
+            self._fields[:3], (lt.tm_sec, lt.tm_min, lt.tm_hour)
+        ):
             if field is not None and v not in field:
                 return False
-        f_dow = self._fields[5]
-        if f_dow is not None and dow not in f_dow and not (
-            dow == 0 and 7 in f_dow
-        ):
+        if self._fields[4] is not None and lt.tm_mon not in self._fields[4]:
             return False
-        return True
+        # Vixie-cron semantics: when BOTH day-of-month and day-of-week
+        # are restricted, the rule fires when EITHER matches ('0 9 1 * 1'
+        # = 09:00 on the 1st OR on Mondays); a single restricted field
+        # applies alone
+        f_dom, f_dow = self._fields[3], self._fields[5]
+        dom_ok = f_dom is None or lt.tm_mday in f_dom
+        dow_ok = f_dow is None or dow in f_dow or (dow == 0 and 7 in f_dow)
+        if f_dom is not None and f_dow is not None:
+            return dom_ok or dow_ok
+        return dom_ok and dow_ok
 
 
 class Scheduler:
@@ -177,13 +184,16 @@ class Scheduler:
         )
 
     def unschedule(self, name: str) -> bool:
+        """Remove EVERY event record with the name — SQL inserts may
+        have created duplicates schedule() would have replaced."""
         if not self.db.schema.exists_class(SCHEDULE_CLASS):
             return False
+        found = False
         for doc in list(self.db.browse_class(SCHEDULE_CLASS)):
             if doc.get("name") == name:
                 self.db.delete(doc)
-                return True
-        return False
+                found = True
+        return found
 
     def events(self) -> List[dict]:
         if not self.db.schema.exists_class(SCHEDULE_CLASS):
@@ -269,10 +279,18 @@ class Scheduler:
             return 0
         docs = list(self.db.browse_class(SCHEDULE_CLASS))
         fired = 0
+        fired_events: set = set()
         for sec in range(start, cur + 1):
             for doc in docs:
                 name = doc.get("name")
                 if not name or not doc.get("enabled", True):
+                    continue
+                if name in fired_events:
+                    # at most ONE catch-up fire per event per tick: a
+                    # dense rule behind a slow function must not spiral
+                    # into a back-to-back replay burst — its backlog is
+                    # dropped (the scan cursor advanced), a sparse rule
+                    # still gets its one missed fire
                     continue
                 rule = self._rule_for(doc.get("rule") or "")
                 if rule is None or not rule.matches(float(sec)):
@@ -280,6 +298,7 @@ class Scheduler:
                 if self._last_fired.get(name) == sec:
                     continue  # at-most-once per matching second
                 self._last_fired[name] = sec
+                fired_events.add(name)
                 fired += 1
                 self._fire(name, doc)
         return fired

@@ -1,0 +1,138 @@
+"""Graph path functions ([E] OSQLFunctionShortestPath /
+OSQLFunctionDijkstra / OSQLFunctionAstar)."""
+
+import pytest
+
+from orientdb_tpu import Database
+
+
+@pytest.fixture()
+def g():
+    db = Database("gf")
+    db.schema.create_vertex_class("P")
+    db.schema.create_edge_class("L")
+    db.schema.create_edge_class("R")
+    vs = [db.new_vertex("P", uid=i) for i in range(6)]
+    # chain 0→1→2→3, shortcut 0→4→3 (same hops), detour 3→5
+    db.new_edge("L", vs[0], vs[1])
+    db.new_edge("L", vs[1], vs[2])
+    db.new_edge("L", vs[2], vs[3])
+    db.new_edge("L", vs[0], vs[4])
+    db.new_edge("L", vs[4], vs[3])
+    db.new_edge("L", vs[3], vs[5])
+    return db, vs
+
+
+class TestShortestPath:
+    def test_basic_path(self, g):
+        db, vs = g
+        rows = db.query(
+            f"SELECT shortestPath({vs[0].rid}, {vs[3].rid}) AS p"
+        ).to_dicts()
+        path = rows[0]["p"]
+        assert len(path) == 3  # 0 → (1|4) → 3
+        assert path[0] == str(vs[0].rid) and path[-1] == str(vs[3].rid)
+
+    def test_same_vertex(self, g):
+        db, vs = g
+        rows = db.query(
+            f"SELECT shortestPath({vs[0].rid}, {vs[0].rid}) AS p"
+        ).to_dicts()
+        assert rows[0]["p"] == [str(vs[0].rid)]
+
+    def test_unreachable_with_direction(self, g):
+        db, vs = g
+        # OUT-only: 5 has no outgoing edges toward 0
+        rows = db.query(
+            f"SELECT shortestPath({vs[5].rid}, {vs[0].rid}, 'OUT') AS p"
+        ).to_dicts()
+        assert rows[0]["p"] == []
+        # BOTH reaches backwards
+        rows = db.query(
+            f"SELECT shortestPath({vs[5].rid}, {vs[0].rid}, 'BOTH') AS p"
+        ).to_dicts()
+        assert rows[0]["p"][0] == str(vs[5].rid)
+        assert rows[0]["p"][-1] == str(vs[0].rid)
+
+    def test_edge_class_filter(self, g):
+        db, vs = g
+        # an R edge 0→3 exists but filtering on L ignores it
+        db.new_edge("R", vs[0], vs[3])
+        rows = db.query(
+            f"SELECT shortestPath({vs[0].rid}, {vs[3].rid}, 'OUT', 'R') AS p"
+        ).to_dicts()
+        assert len(rows[0]["p"]) == 2  # direct R hop
+        rows = db.query(
+            f"SELECT shortestPath({vs[0].rid}, {vs[3].rid}, 'OUT', 'L') AS p"
+        ).to_dicts()
+        assert len(rows[0]["p"]) == 3
+
+    def test_edge_class_list(self, g):
+        """Review regression: a COLLECTION of edge classes traverses
+        all of them, not just the first."""
+        db, vs = g
+        # only route 3→5 uses L; give R a separate 0→5 shortcut
+        db.new_edge("R", vs[0], vs[5])
+        rows = db.query(
+            f"SELECT shortestPath({vs[0].rid}, {vs[5].rid}, 'OUT',"
+            " ['L', 'R']) AS p"
+        ).to_dicts()
+        assert len(rows[0]["p"]) == 2  # takes the R shortcut
+        rows = db.query(
+            f"SELECT shortestPath({vs[0].rid}, {vs[5].rid}, 'OUT',"
+            " ['L']) AS p"
+        ).to_dicts()
+        assert len(rows[0]["p"]) == 4  # L-only: 0→(1|4)→3→5
+
+    def test_max_depth(self, g):
+        db, vs = g
+        rows = db.query(
+            f"SELECT shortestPath({vs[0].rid}, {vs[5].rid}, 'OUT', null,"
+            " {maxDepth: 2}) AS p"
+        ).to_dicts()
+        assert rows[0]["p"] == []  # needs 3 hops
+
+
+class TestDijkstra:
+    def test_weighted_route_wins(self, g):
+        db, vs = g
+        # weight the 0→1→2→3 chain cheap, the 0→4→3 shortcut expensive
+        for e in vs[0].edges():
+            pass
+        db2 = Database("gw")
+        db2.schema.create_vertex_class("P")
+        db2.schema.create_edge_class("W")
+        a = db2.new_vertex("P", uid=0)
+        b = db2.new_vertex("P", uid=1)
+        c = db2.new_vertex("P", uid=2)
+        db2.new_edge("W", a, c, w=10)  # direct but expensive
+        db2.new_edge("W", a, b, w=1)
+        db2.new_edge("W", b, c, w=1)  # two cheap hops
+        rows = db2.query(
+            f"SELECT dijkstra({a.rid}, {c.rid}, 'w') AS p"
+        ).to_dicts()
+        assert rows[0]["p"] == [str(a.rid), str(b.rid), str(c.rid)]
+
+    def test_missing_weight_defaults_to_one(self, g):
+        db, vs = g
+        rows = db.query(
+            f"SELECT dijkstra({vs[0].rid}, {vs[3].rid}, 'nope') AS p"
+        ).to_dicts()
+        assert len(rows[0]["p"]) == 3
+
+    def test_unreachable(self, g):
+        db, vs = g
+        rows = db.query(
+            f"SELECT dijkstra({vs[5].rid}, {vs[0].rid}, 'w', 'OUT') AS p"
+        ).to_dicts()
+        assert rows[0]["p"] == []
+
+    def test_astar_matches_dijkstra(self, g):
+        db, vs = g
+        d = db.query(
+            f"SELECT dijkstra({vs[0].rid}, {vs[3].rid}, 'w', 'OUT') AS p"
+        ).to_dicts()[0]["p"]
+        a = db.query(
+            f"SELECT astar({vs[0].rid}, {vs[3].rid}, 'w') AS p"
+        ).to_dicts()[0]["p"]
+        assert a == d and len(d) == 3

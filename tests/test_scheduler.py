@@ -161,6 +161,36 @@ class TestScheduler:
         assert db.scheduler.tick(now=1000.0) == 1
         assert db.scheduler.tick(now=1000.9) == 0
 
+    def test_dense_rule_fires_once_per_tick_after_stall(self, db):
+        """Review regression: a per-second rule behind a stalled tick
+        must not burst-replay the backlog — one catch-up fire, cursor
+        advances past the gap."""
+        db.scheduler.schedule("ev", "* * * * * *", "logit")
+        db.scheduler.tick(now=1000.0)
+        fired = db.scheduler.tick(now=1030.0)  # 30s stall
+        assert fired == 1
+        assert db.count_class("Log") == 2
+
+    def test_unschedule_removes_sql_created_duplicates(self, db):
+        db.scheduler._ensure_class()
+        for _ in range(2):
+            db.command(
+                "INSERT INTO OSchedule SET name = 'dup', "
+                "rule = '* * * * * *', function = 'logit'"
+            )
+        assert db.scheduler.unschedule("dup")
+        assert db.scheduler.tick(now=1000.0) == 0
+
+    def test_vixie_dom_dow_or_semantics(self):
+        # '0 9 1 * 1' = 09:00 on the 1st OR on Mondays
+        r = CronRule("0 9 1 * 1")
+        first = time.mktime((2026, 8, 1, 9, 0, 0, 0, 0, -1))  # Saturday the 1st
+        monday = time.mktime((2026, 8, 3, 9, 0, 0, 0, 0, -1))  # Monday the 3rd
+        tuesday = time.mktime((2026, 8, 4, 9, 0, 0, 0, 0, -1))
+        assert r.matches(first)
+        assert r.matches(monday)
+        assert not r.matches(tuesday)
+
     def test_real_thread_smoke(self, db):
         db.scheduler.schedule("ev", "* * * * * *", "logit")
         db.scheduler.start()
