@@ -2780,19 +2780,71 @@ class _CompiledPlan(_AotWarmup):
         self.wait_compiled()
         B = len(dyns)
         Bb = 1 << (B - 1).bit_length()
-        all_dyns = dyns + [dyns[-1]] * (Bb - B)
-        stacked = {
-            k: np.stack([np.asarray(d[k]) for d in all_dyns])
-            for k in dyns[0]
-        }
+        cap = self._group_lane_cap()
+        if Bb > cap and self._rows_grouped():
+            # chunking would break the page ladder's (Bb, C, W) shape
+            # contract; rows plans past the cap stay per-lane
+            return None
+        Bb = min(Bb, cap)
+        nchunks = -(-B // Bb)  # oversized batches run capped chunks
         cache = self.__dict__.setdefault("_jitted_many", {})
         fn = cache.get(Bb)
         if fn is False:
             return None  # compile failed permanently: per-lane forever
+        all_dyns = dyns + [dyns[-1]] * (nchunks * Bb - B)
+
+        def _stack(c: int) -> Dict:
+            return {
+                k: np.stack(
+                    [
+                        np.asarray(d[k])
+                        for d in all_dyns[c * Bb : (c + 1) * Bb]
+                    ]
+                )
+                for k in dyns[0]
+            }
+
         if fn is None:
-            self._compile_group_async(Bb, stacked)
+            self._compile_group_async(Bb, _stack(0))
             return None
-        return fn(self._arg_subset(), stacked)
+        if nchunks == 1:
+            return fn(self._arg_subset(), _stack(0))
+        outs = [fn(self._arg_subset(), _stack(c)) for c in range(nchunks)]
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *outs
+        )
+
+    def _group_lane_cap(self) -> int:
+        """Max vmapped lanes per Execute for plans that READ EDGE
+        STATE: the fused edge-predicate select materializes an O(E)
+        int32 intermediate per lane, so an uncapped pow2 width on an
+        80M-edge graph asks the compiler for lanes × 320 MB and OOMs —
+        which costs a failed 20s+ compile AND drops the plan to
+        per-lane forever. Cap so lanes × 4E fits
+        config.group_hbm_budget_bytes, sized by the LARGEST edge class
+        this plan's recording touched; edge-free plans (vertex-only
+        counts/filters) keep unbounded width — they materialize no
+        O(E) intermediate and live off group amortization."""
+        dg = self.solver.dg
+        keys = getattr(self, "arg_keys", None)
+        if keys is None:
+            classes = set(dg.edges)
+        else:
+            classes = {
+                k.split(":", 2)[1] for k in keys if k.startswith("e:")
+            }
+        E = max(
+            (
+                dg.edges[c].num_edges
+                for c in classes
+                if c in dg.edges
+            ),
+            default=0,
+        )
+        if E <= 0:
+            return 1 << 30
+        cap = max(1, int(config.group_hbm_budget_bytes) // (4 * E))
+        return 1 << (cap.bit_length() - 1)  # floor to pow2
 
     def _compile_group_async(self, Bb: int, stacked: Dict) -> None:
         import atexit

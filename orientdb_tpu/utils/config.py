@@ -94,6 +94,14 @@ class GlobalConfiguration:
     # election.
     result_group_lane_bytes: int = 4 << 20
 
+    # Vmapped group lanes materialize O(E) int32 intermediates in the
+    # fused edge-predicate select; the group width is capped so
+    # lanes × 4E stays inside this budget (v5e chips carry 16 GB HBM;
+    # the graph itself plus runtime overhead take the rest). Oversized
+    # batches dispatch as several capped Executes instead of OOMing
+    # the compile and falling back to per-lane.
+    group_hbm_budget_bytes: int = 6 << 30
+
     # Per-query property-column pruning (SURVEY.md §7's SF100 memory
     # plan): property columns upload to HBM on a plan's first reference
     # instead of eagerly at snapshot attach — columns no query touches

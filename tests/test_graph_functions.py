@@ -94,11 +94,7 @@ class TestShortestPath:
 
 
 class TestDijkstra:
-    def test_weighted_route_wins(self, g):
-        db, vs = g
-        # weight the 0→1→2→3 chain cheap, the 0→4→3 shortcut expensive
-        for e in vs[0].edges():
-            pass
+    def test_weighted_route_wins(self):
         db2 = Database("gw")
         db2.schema.create_vertex_class("P")
         db2.schema.create_edge_class("W")

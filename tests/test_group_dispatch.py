@@ -288,3 +288,28 @@ class TestRowsGroupDispatch:
                     tuple(sorted(r.items())) in legal for r in rows
                 )
             drain_warmups()
+
+
+def test_capped_group_width_chunks_oversized_batches(db, monkeypatch):
+    """HBM-budget cap (bench regression): a group whose pow2 width
+    would materialize lanes × 4E beyond the budget dispatches as
+    several capped Executes — same results, no OOM-doomed compile."""
+    from orientdb_tpu.utils.config import config
+
+    # demodb here has ~800×5 edges; this budget caps the group near 4
+    # lanes, so the 12-item batch must run as several capped chunks
+    monkeypatch.setattr(config, "group_hbm_budget_bytes", 4 * 800 * 5 * 4)
+    plist = [{"u": i * 3} for i in range(12)]
+    want = [
+        db.query(SQL, params=p, engine="oracle").to_dicts() for p in plist
+    ]
+    for _ in range(2):
+        db.query_batch([SQL] * 12, params_list=plist, engine="tpu", strict=True)
+        drain_warmups()
+    got = [
+        rs.to_dicts()
+        for rs in db.query_batch(
+            [SQL] * 12, params_list=plist, engine="tpu", strict=True
+        )
+    ]
+    assert got == want
