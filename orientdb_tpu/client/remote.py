@@ -573,6 +573,156 @@ class FailoverDatabase:
         self.close()
 
 
+class DatabasePool:
+    """Bounded session pool over the remote client ([E] ODatabasePool:
+    acquire()/close() recycling authenticated sessions instead of
+    reconnecting per request). ``acquire()`` returns a context-manager
+    wrapper whose ``close()`` (or ``with`` exit) RETURNS the session to
+    the pool; ``close()`` on the pool itself closes every session."""
+
+    def __init__(
+        self,
+        url: str,
+        user: str,
+        password: str,
+        max_sessions: int = 8,
+        **kw,
+    ) -> None:
+        import queue
+
+        self.url = url
+        self.user = user
+        self.password = password
+        self.kw = kw
+        self.max_sessions = max_sessions
+        self._made = 0
+        self._mu = threading.Lock()
+        self._idle: "queue.Queue" = queue.Queue()
+        self._closed = False
+
+    def acquire(self, timeout: float = 30.0) -> "PooledSession":
+        import queue
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            if self._closed:
+                raise RemoteError("pool is closed")
+            try:
+                return PooledSession(self, self._idle.get_nowait())
+            except queue.Empty:
+                pass
+            with self._mu:
+                can_make = self._made < self.max_sessions
+                if can_make:
+                    self._made += 1
+            if can_make:
+                try:
+                    db = connect(
+                        self.url, self.user, self.password, **self.kw
+                    )
+                except BaseException:
+                    with self._mu:
+                        self._made -= 1
+                    raise
+                return PooledSession(self, db)
+            # all slots busy: wait briefly, then RE-CHECK creation too —
+            # a concurrent connect() failure frees a slot without ever
+            # putting anything on the idle queue
+            wait = min(0.05, max(0.0, deadline - _time.monotonic()))
+            if wait <= 0:
+                raise RemoteError(
+                    f"pool exhausted ({self.max_sessions} sessions "
+                    f"busy for {timeout}s)"
+                )
+            try:
+                return PooledSession(self, self._idle.get(timeout=wait))
+            except queue.Empty:
+                continue
+
+    def _release(self, db, broken: bool = False) -> None:
+        # the put and the _closed check share _mu with close(), so a
+        # racing close() either sees the session on the queue (drained)
+        # or we see _closed here (closed directly) — nothing leaks
+        with self._mu:
+            if broken or self._closed:
+                # a dead connection must not circulate, and its slot
+                # must free up for a replacement
+                self._made -= 1
+                try:
+                    db.close()
+                except Exception:
+                    pass
+                return
+            self._idle.put(db)
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+        self._drain()
+
+    def _drain(self) -> None:
+        import queue
+
+        while True:
+            try:
+                db = self._idle.get_nowait()
+            except queue.Empty:
+                break
+            with self._mu:
+                self._made -= 1
+            try:
+                db.close()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "DatabasePool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PooledSession:
+    """One checked-out session: proxies the client API; ``close()``
+    returns the underlying connection to the pool. A call that raises
+    RemoteConnectionError marks the session BROKEN — its connection is
+    closed and its slot freed instead of circulating a dead socket."""
+
+    def __init__(self, pool: DatabasePool, db) -> None:
+        self._pool = pool
+        self._db = db
+        self._broken = False
+
+    def close(self) -> None:
+        db, self._db = self._db, None
+        if db is not None:
+            self._pool._release(db, broken=self._broken)
+
+    def __enter__(self) -> "PooledSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __getattr__(self, name: str):
+        db = object.__getattribute__(self, "_db")
+        if db is None:
+            raise RemoteError("session returned to pool")
+        attr = getattr(db, name)
+        if not callable(attr):
+            return attr
+
+        def wrapped(*a, **kw):
+            try:
+                return attr(*a, **kw)
+            except RemoteConnectionError:
+                self._broken = True
+                raise
+
+        return wrapped
+
+
 def _parse_addrs(hostports: str):
     out = []
     for part in hostports.replace(",", ";").split(";"):

@@ -160,6 +160,22 @@ def _truncate_class(db, stmt: A.TruncateClassStatement) -> List[Result]:
 
 def _alter_class(db, stmt: A.AlterClassStatement) -> List[Result]:
     attr = stmt.attribute.upper()
+    if attr == "ADDCLUSTER":
+        # [E] ALTER CLASS x ADDCLUSTER: widen the class's cluster set
+        # (round-robin insertion spreads across them). Clusters here
+        # are numeric-only; a NAMED cluster must fail loudly, not be
+        # silently created anonymous
+        if stmt.value is not None:
+            raise CommandError(
+                "named clusters are not supported; use ALTER CLASS "
+                f"{stmt.class_name} ADDCLUSTER (ids are numeric)"
+            )
+        cid = db.schema.add_cluster(stmt.class_name)
+        return [
+            Result(
+                props={"operation": "alter class", "cluster": cid}
+            )
+        ]
     if attr == "NAME":
         db.rename_class(stmt.class_name, str(stmt.value))
         return [

@@ -1053,6 +1053,13 @@ class Parser:
                 if t.kind not in ("IDENT", "STRING"):
                     raise ParseError("expected new class name", t)
                 return A.AlterClassStatement(cls, attr, t.value)
+            if attr == "ADDCLUSTER":
+                name = (
+                    self.eat_ident()
+                    if self.peek().kind == "IDENT"
+                    else None
+                )
+                return A.AlterClassStatement(cls, attr, name)
             raise ParseError(f"unsupported ALTER CLASS attribute {attr}")
         self.eat_kw("PROPERTY")
         cls = self.eat_ident()

@@ -377,3 +377,80 @@ class TestHttpBatch:
         )
         assert out["result"][0]["uid"] == 5
         assert db.load(v.rid) is None
+
+
+class TestDatabasePool:
+    def test_pool_recycles_and_bounds_sessions(self):
+        from orientdb_tpu.client.remote import DatabasePool, RemoteError
+        from orientdb_tpu.server.server import Server
+
+        s = Server(admin_password="pw")
+        s.startup()
+        db = s.create_database("pl")
+        db.schema.create_vertex_class("P")
+        try:
+            with DatabasePool(
+                f"remote:127.0.0.1:{s.binary_port}/pl",
+                "admin",
+                "pw",
+                max_sessions=2,
+            ) as pool:
+                with pool.acquire() as a:
+                    a.command("INSERT INTO P SET uid = 1")
+                # session returned: reacquire reuses the SAME connection
+                with pool.acquire() as b:
+                    assert b.query("SELECT count(*) AS c FROM P").to_dicts() == [
+                        {"c": 1}
+                    ]
+                # exhaustion surfaces as an error, not a hang
+                s1 = pool.acquire()
+                s2 = pool.acquire()
+                import pytest as _pytest
+
+                with _pytest.raises(RemoteError):
+                    pool.acquire(timeout=0.2)
+                s1.close()
+                s3 = pool.acquire(timeout=1)
+                s3.close()
+                s2.close()
+                # a closed-out session refuses use
+                with _pytest.raises(RemoteError):
+                    s1.query("SELECT FROM P")
+        finally:
+            s.shutdown()
+
+
+class TestPoolBrokenSessions:
+    def test_broken_session_frees_slot(self):
+        """Review regression: a session whose call dies with a
+        connection error is closed and its slot freed — the pool does
+        not circulate dead sockets."""
+        from orientdb_tpu.client.remote import (
+            DatabasePool,
+            RemoteConnectionError,
+        )
+        from orientdb_tpu.server.server import Server
+
+        s = Server(admin_password="pw")
+        s.startup()
+        s.create_database("pb")
+        pool = DatabasePool(
+            f"remote:127.0.0.1:{s.binary_port}/pb",
+            "admin",
+            "pw",
+            max_sessions=1,
+        )
+        try:
+            sess = pool.acquire()
+            # sever the connection underneath the session
+            sess._db._sock.close()
+            with pytest.raises((RemoteConnectionError, Exception)):
+                sess.query("SELECT FROM OUser")
+            sess.close()  # broken: closed + slot freed, NOT recycled
+            assert pool._made == 0
+            # the freed slot lets a FRESH session connect
+            with pool.acquire(timeout=5) as s2:
+                assert s2.query("SELECT 1 AS x").to_dicts() == [{"x": 1}]
+        finally:
+            pool.close()
+            s.shutdown()

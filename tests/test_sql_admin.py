@@ -309,3 +309,20 @@ class TestFindReferences:
         gdb.new_element("Note", about=a.rid)
         rows = gdb.query(f"FIND REFERENCES {a.rid} [Note]").to_dicts()
         assert len(rows[0]["referredBy"]) == 1
+
+
+class TestAddCluster:
+    def test_addcluster_widens_round_robin(self, gdb):
+        cls = gdb.schema.get_class("P")
+        n0 = len(cls.cluster_ids)
+        out = gdb.command("ALTER CLASS P ADDCLUSTER").to_dicts()
+        assert "cluster" in out[0]
+        assert len(cls.cluster_ids) == n0 + 1
+        # round-robin insertion spreads new records across clusters
+        rids = [gdb.new_vertex("P", uid=i).rid for i in range(4)]
+        assert {r.cluster for r in rids} == set(cls.cluster_ids)
+        assert gdb.count_class("P") == 4
+
+    def test_named_cluster_rejected_loudly(self, gdb):
+        with pytest.raises(CommandError):
+            gdb.command("ALTER CLASS P ADDCLUSTER east")
