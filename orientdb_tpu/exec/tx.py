@@ -37,6 +37,16 @@ class TxError(Exception):
 
 def _clone(doc: Document) -> Document:
     """Tx-local copy: same identity/version, independent fields/bags."""
+    from orientdb_tpu.models.record import Blob
+
+    if isinstance(doc, Blob):
+        # Blob.__init__ takes only the payload; from_fields keeps any
+        # metadata fields riding alongside `data`
+        c: Document = Blob.from_fields(dict(doc.fields()))
+        c.rid = doc.rid
+        c.version = doc.version
+        c._db = doc._db
+        return c
     c = type(doc)(doc.class_name, dict(doc.fields()))
     c.rid = doc.rid
     c.version = doc.version

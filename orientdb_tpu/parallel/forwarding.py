@@ -225,6 +225,15 @@ class ForwardedTransaction:
         self._created: Dict[str, tuple] = {}
         #: rid -> buffered updated doc (read-your-writes)
         self._updated: Dict[RID, Document] = {}
+        #: rid -> tx-local CLONE handed out by load() (version frozen
+        #: at read time; the store object stays untouched)
+        self._workspace: Dict[RID, Document] = {}
+        #: rid -> (fields copy, version) captured at FIRST in-place
+        #: mutation of a SHARED store object (scan results bypass
+        #: load()'s clone): the version freezes the MVCC base the tx
+        #: actually read, and rollback / failed commit restores the
+        #: fields so uncommitted dirt never outlives the tx
+        self._preimages: Dict[RID, tuple] = {}
         self._deleted: set = set()
         #: owner-key -> WriteOwner for ops tagged "@owner" (per-class
         #: owner streams: one tx may span owners → 2PC at commit)
@@ -294,7 +303,13 @@ class ForwardedTransaction:
         op = {
             "kind": "update",
             "rid": str(doc.rid),
-            "base_version": doc.version,
+            # the MVCC base is the version this tx READ: for a shared
+            # store object mutated in place that is the touch()-time
+            # preimage version, not the object's current (possibly
+            # apply-bumped) one
+            "base_version": self._preimages.get(
+                doc.rid, (None, doc.version)
+            )[1],
             "fields": self._enc_fields(doc),
             "@owner": self._owner_key(doc.class_name),
         }
@@ -343,9 +358,17 @@ class ForwardedTransaction:
         doc._deleted = True
 
     def touch(self, doc: Document) -> None:
-        """In-place mutation of a shared replica object: nothing to
-        capture — the owner's committed state replicates back and
-        overwrites local fields regardless of what this buffer does."""
+        """First in-place mutation of a SHARED store object (a scan
+        result that bypassed load()'s clone): capture (fields, version)
+        BEFORE the write — the version is the MVCC base this tx
+        actually read (a replication apply bumping the object between
+        read and save must conflict, not silently win), and the fields
+        let rollback erase the uncommitted dirt."""
+        rid = doc.rid
+        if not rid.is_persistent or rid in self._preimages:
+            return
+        if self.db._load_raw(rid) is doc:
+            self._preimages[rid] = (dict(doc.fields()), doc.version)
 
     def load(self, rid: RID):
         if rid in self._deleted:
@@ -356,7 +379,22 @@ class ForwardedTransaction:
         doc, _op = self._created.get(str(rid), (None, None))
         if doc is not None:
             return doc
-        return self.db._load_raw(rid)
+        stored = self.db._load_raw(rid)
+        if stored is None:
+            return None
+        # CLONE (the exec.tx.Transaction.load discipline): mutating the
+        # shared store object in place would (a) leak uncommitted state
+        # to other sessions and the owner-apply path, and (b) let a
+        # concurrent replication apply bump the object's version AFTER
+        # this tx read its fields — the buffered update would then ship
+        # a FRESH base_version with a STALE read, silently losing the
+        # concurrent write (caught by the racing-coordinators test)
+        hit = self._workspace.get(rid)
+        if hit is None:
+            from orientdb_tpu.exec.tx import _clone
+
+            hit = self._workspace[rid] = _clone(stored)
+        return hit
 
     def overlay(self, doc: Document):
         """Scan view: buffered update wins; buffered delete hides."""
@@ -418,6 +456,16 @@ class ForwardedTransaction:
         self._finish()
         if not self.ops:
             return {}
+        try:
+            return self._commit_groups()
+        except BaseException:
+            # nothing (or only part, for in-doubt) applied: erase the
+            # uncommitted in-place dirt — the owner's authoritative
+            # state replicates back over restored fields either way
+            self._restore_preimages()
+            raise
+
+    def _commit_groups(self) -> Dict:
         groups: Dict[str, list] = {}
         for op in self.ops:
             key = op.pop("@owner", None)
@@ -438,6 +486,14 @@ class ForwardedTransaction:
             resp = owner.transaction(ops)
             return self._adopt(ops, resp["results"])
         return self._commit_two_phase(groups)
+
+    def _restore_preimages(self) -> None:
+        for rid, (fields, version) in self._preimages.items():
+            live = self.db._load_raw(rid)
+            if live is not None:
+                live._fields = dict(fields)
+                live.version = version
+        self._preimages.clear()
 
     def _commit_two_phase(self, groups: Dict[str, list]) -> Dict:
         """Coordinator for a forwarded tx spanning write owners ([E]
@@ -470,7 +526,9 @@ class ForwardedTransaction:
         return mapping
 
     def rollback(self) -> None:
-        """Nothing shipped, nothing to undo locally: drop the buffer."""
+        """Drop the buffer; restore in-place mutations of shared store
+        objects (the touch()-time preimages)."""
+        self._restore_preimages()
         self._finish()
 
 

@@ -307,3 +307,85 @@ class TestRegistryLocks:
         assert db._tx2pc_locks == {}
         with pytest.raises(TwoPhaseError):
             reg.commit("t6")
+
+
+class TestConcurrentCoordinators:
+    def test_racing_cross_owner_txs_serialize(self, duo):
+        """Two coordinators (primary and secondary owner) race
+        cross-owner transactions over the SAME records: the prepare
+        locks + MVCC bases force serialization — every round one wins,
+        conflicts surface as ConcurrentModificationError, and all
+        members converge on one consistent history."""
+        import threading
+
+        cl, servers, pdb = duo
+        n1db = cl.members["n1"].db
+        p = pdb.new_vertex("P", uid=1, n=0)
+        q = n1db.new_vertex("Q", uid=2, n=0)
+        assert wait_for(
+            lambda: pdb.load(q.rid) is not None
+            and n1db.load(p.rid) is not None
+        )
+        wins = {"a": 0, "b": 0}
+        errs = []
+
+        def bump(db, who, rounds=6):
+            for _ in range(rounds):
+                for attempt in range(25):
+                    try:
+                        db.begin()
+                        pc = db.load(p.rid)
+                        qc = db.load(q.rid)
+                        if pc is None or qc is None:
+                            db.rollback()
+                            time.sleep(0.05)
+                            continue
+                        pc.set("n", pc.get("n") + 1)
+                        db.save(pc)
+                        qc.set("n", qc.get("n") + 1)
+                        db.save(qc)
+                        db.commit()
+                        wins[who] += 1
+                        break
+                    except ConcurrentModificationError:
+                        try:
+                            if db.tx is not None:
+                                db.rollback()
+                        except Exception:
+                            pass
+                        time.sleep(0.03)
+                    except Exception as e:  # pragma: no cover
+                        errs.append(repr(e))
+                        try:
+                            if db.tx is not None:
+                                db.rollback()
+                        except Exception:
+                            pass
+                        time.sleep(0.05)
+                else:
+                    errs.append(f"{who}: starved out of retries")
+
+        ta = threading.Thread(target=bump, args=(pdb, "a"))
+        tb = threading.Thread(target=bump, args=(n1db, "b"))
+        ta.start(); tb.start()
+        ta.join(120); tb.join(120)
+        assert not errs, errs
+        assert wins == {"a": 6, "b": 6}
+        # every member converges on n = 12 for BOTH records
+        def converged():
+            for m in cl.members.values():
+                pd = m.db.load(p.rid)
+                qd = m.db.load(q.rid)
+                if pd is None or qd is None:
+                    return False
+                if pd.get("n") != 12 or qd.get("n") != 12:
+                    return False
+            return True
+
+        assert wait_for(converged, timeout=30), {
+            m.name: (
+                m.db.load(p.rid).get("n") if m.db.load(p.rid) else None,
+                m.db.load(q.rid).get("n") if m.db.load(q.rid) else None,
+            )
+            for m in cl.members.values()
+        }
