@@ -389,3 +389,53 @@ class TestConcurrentCoordinators:
             )
             for m in cl.members.values()
         }
+
+
+class TestInDoubt:
+    def test_phase2_failure_reports_partial_or_clean(self, duo, monkeypatch):
+        """A participant failing at PHASE 2 either aborts cleanly
+        (nothing committed yet) or surfaces TxInDoubtError naming the
+        partial application — never a silent half-commit."""
+        from orientdb_tpu.parallel.forwarding import WriteOwner
+        from orientdb_tpu.parallel.twophase import TxInDoubtError
+
+        cl, servers, pdb = duo
+        real = WriteOwner.tx2pc
+
+        def failing(self, phase, txid, **kw):
+            if phase == "commit":
+                raise OSError("injected wire failure at commit")
+            return real(self, phase, txid, **kw)
+
+        monkeypatch.setattr(WriteOwner, "tx2pc", failing)
+        pdb.begin()
+        pdb.new_vertex("P", uid=1)
+        pdb.new_vertex("Q", uid=2)
+        try:
+            pdb.commit()
+            raised = None
+        except TxInDoubtError as e:
+            raised = "indoubt"
+        except Exception as e:
+            raised = "clean"
+        assert raised in ("indoubt", "clean")
+        time.sleep(0.3)
+        if raised == "indoubt":
+            # local P committed, the Q commit was the failure
+            assert pdb.count_class("P") == 1
+            assert count_or_zero(cl.members["n1"].db, "Q") == 0
+        else:
+            # clean abort: nothing anywhere, locks released
+            assert pdb.count_class("P") == 0
+            assert count_or_zero(cl.members["n1"].db, "Q") == 0
+        # the participant's prepared locks were released either way:
+        # a follow-up tx on the same classes succeeds once the patch
+        # is lifted
+        monkeypatch.setattr(WriteOwner, "tx2pc", real)
+        pdb.begin()
+        pdb.new_vertex("P", uid=3)
+        pdb.new_vertex("Q", uid=4)
+        pdb.commit()
+        assert wait_for(
+            lambda: count_or_zero(cl.members["n1"].db, "Q") == 1
+        )
