@@ -108,6 +108,17 @@ class Server:
             # SERVER's security manager (exec/dml._security_of)
             db._security = self.security
             self.databases[name] = db
+            # a durable database recovered with OSchedule events resumes
+            # firing them ([E] the scheduler starts with the database)
+            try:
+                from orientdb_tpu.exec.scheduler import SCHEDULE_CLASS
+
+                if db.schema.exists_class(SCHEDULE_CLASS) and any(
+                    True for _ in db.browse_class(SCHEDULE_CLASS)
+                ):
+                    db.scheduler.start()
+            except Exception:  # pragma: no cover - never blocks open
+                log.exception("scheduler resume failed for '%s'", name)
             return db
 
     def get_database(self, name: str) -> Optional[Database]:
@@ -118,8 +129,12 @@ class Server:
             db = self.databases.pop(name, None)
         if db is not None:
             # the coalescer's worker thread must not outlive (and pin)
-            # the dropped database
+            # the dropped database — nor may its scheduler keep firing
+            # functions into a detached store
             self.coalescer.evict(db)
+            sch = getattr(db, "_scheduler", None)
+            if sch is not None:
+                sch.stop()
         return db is not None
 
     def attach_database(self, db: Database) -> Database:
@@ -150,6 +165,18 @@ class Server:
             # shutdown() stops the coalescer permanently; a restarted
             # server must not silently lose the cross-session group path
             self.coalescer = QueryCoalescer()
+        # symmetric with shutdown()'s scheduler stop: databases still
+        # attached with OSchedule events resume firing
+        from orientdb_tpu.exec.scheduler import SCHEDULE_CLASS
+
+        for db in list(self.databases.values()):
+            try:
+                if db.schema.exists_class(SCHEDULE_CLASS) and any(
+                    True for _ in db.browse_class(SCHEDULE_CLASS)
+                ):
+                    db.scheduler.start()
+            except Exception:  # pragma: no cover - never blocks startup
+                log.exception("scheduler resume failed for '%s'", db.name)
         for p in self.plugins:
             p.startup()
         self._http = HttpListener(self, self._http_port)
@@ -177,6 +204,10 @@ class Server:
         if self._binary is not None:
             self._binary.stop()
         self.coalescer.stop()
+        for db in list(self.databases.values()):
+            sch = getattr(db, "_scheduler", None)
+            if sch is not None:
+                sch.stop()
 
     @property
     def http_port(self) -> int:

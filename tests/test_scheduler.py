@@ -202,3 +202,64 @@ class TestScheduler:
         finally:
             db.scheduler.stop()
         assert not db.scheduler.running
+
+class TestServerLifecycle:
+    def test_durable_events_resume_after_restart(self, tmp_path, monkeypatch):
+        """A durable server database holding OSchedule events resumes
+        firing them when the server reopens it ([E] the scheduler
+        starts with the database)."""
+        from orientdb_tpu.server.server import Server
+        from orientdb_tpu.utils.config import config
+
+        monkeypatch.setattr(config, "wal_enabled", True)
+        monkeypatch.setattr(config, "wal_dir", str(tmp_path))
+        s = Server(admin_password="pw")
+        s.startup()
+        db = s.create_database("shd")
+        db.schema.create_class("Log")
+        db.functions.create("logit", "INSERT INTO Log SET at = 'tick'", ())
+        db.scheduler.schedule("hb", "* * * * * *", "logit")
+        assert not db.scheduler.running  # explicit start, not on schedule()
+        s.shutdown()
+
+        s2 = Server(admin_password="pw")
+        s2.startup()
+        try:
+            db2 = s2.create_database("shd")  # recover-or-create path
+            assert db2.scheduler.running, "events present: loop resumes"
+            deadline = time.time() + 5
+            while db2.count_class("Log") < 1 and time.time() < deadline:
+                time.sleep(0.1)
+            assert db2.count_class("Log") >= 1
+        finally:
+            s2.shutdown()
+        assert not db2.scheduler.running  # shutdown stops the loop
+
+    def test_drop_and_restart_lifecycle(self):
+        """Review regressions: a DROPPED database's scheduler stops
+        firing, and a server startup() after shutdown() resumes the
+        schedulers of still-attached databases."""
+        from orientdb_tpu.server.server import Server
+
+        s = Server(admin_password="pw")
+        s.startup()
+        db = s.create_database("lc")
+        db.schema.create_class("Log")
+        db.functions.create("logit", "INSERT INTO Log SET a = 1", ())
+        db.scheduler.schedule("hb", "* * * * * *", "logit")
+        db.scheduler.start()
+        s.drop_database("lc")
+        assert not db.scheduler.running  # drop kills the loop
+
+        db2 = s.create_database("lc2")
+        db2.schema.create_class("Log")
+        db2.functions.create("logit", "INSERT INTO Log SET a = 1", ())
+        db2.scheduler.schedule("hb", "* * * * * *", "logit")
+        db2.scheduler.start()
+        s.shutdown()
+        assert not db2.scheduler.running  # shutdown stops it
+        s.startup()
+        try:
+            assert db2.scheduler.running  # restart resumes it
+        finally:
+            s.shutdown()
