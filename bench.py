@@ -59,6 +59,65 @@ def canon(rows):
     return sorted(tuple(sorted(r.items())) for r in rows)
 
 
+#: the driver records only the last ~2000 chars of stdout; leave room
+#: for its wrapper/prefix
+LINE_BUDGET = 1800
+
+
+def compact_line(
+    out: dict, budget: int = LINE_BUDGET, detail_name: str = "BENCH_DETAIL.json"
+) -> str:
+    """The printed stdout line: required keys + a compact extras subset
+    guaranteed to fit the driver's tail-capture window (the full result
+    lives in BENCH_DETAIL.json). Degrades by dropping the bulkier
+    extras first; the required keys always survive."""
+
+    def _slim(d, keys):
+        return {k: d[k] for k in keys if isinstance(d, dict) and k in d}
+
+    ex = out.get("extras", {})
+    compact = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "extras": {
+            "detail_file": detail_name,
+            **_slim(
+                ex,
+                (
+                    "batch_size",
+                    "single_query_qps",
+                    "rows_1hop_batched_qps",
+                    "var_depth_while_batched_qps",
+                    "traverse_bfs_batched_qps",
+                    "select_count_batched_qps",
+                    "ldbc_is",
+                ),
+            ),
+            "remote": _slim(
+                ex.get("remote", {}),
+                ("single_qps", "batch_qps", "pipeline_qps"),
+            ),
+            "phase_split_ms_per_query": ex.get(
+                "phase_split_ms_per_query", {}
+            ),
+        },
+    }
+    line = json.dumps(compact)
+    # q/s families go first: phase_split is the gate's STABLE signal
+    # (device/host ms) and must be the last thing sacrificed
+    for victim in ("ldbc_is", "remote", "phase_split_ms_per_query"):
+        if len(line) <= budget:
+            break
+        compact["extras"].pop(victim, None)
+        line = json.dumps(compact)
+    if len(line) > budget:
+        compact["extras"] = {"detail_file": detail_name}
+        line = json.dumps(compact)
+    return line
+
+
 def gate_regressions(
     cur: dict,
     prev: dict,
@@ -82,7 +141,10 @@ def gate_regressions(
     raw printed line or the wrapper with a "parsed" key). Returns
     [(metric_name, prev, cur), ...] — ms entries' names end in ``_ms``
     (for them, HIGHER current is the regression)."""
-    prev = prev.get("parsed", prev)
+    # r4's driver record carried parsed=null (line exceeded the tail
+    # capture): fall through to the wrapper rather than crashing on None
+    if isinstance(prev, dict):
+        prev = prev.get("parsed") or prev
     regs = []
 
     def qps_leaves(d, prefix=""):
@@ -155,7 +217,64 @@ def run_virtual_mesh_subprocess(module: str, argv, timeout: int, n_devices: int 
         return {"error": str(e)[:200]}
 
 
+def _round_stamp() -> int:
+    """THIS run's round number: one past the newest driver record
+    (BENCH_r{N}.json) in the repo root. Stamps the detail file so a
+    later round's gate can never confuse rounds — a single shared
+    filename would be overwritten by every run and the parsed=null
+    fallback would silently compare a run against itself."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    ns = []
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            ns.append(int(m.group(1)))
+    return (max(ns) + 1) if ns else 1
+
+
+def detail_filename(round_n: int) -> str:
+    return f"BENCH_DETAIL_r{round_n:02d}.json"
+
+
+def _gate_path_from_env() -> "str | None":
+    gate_path = os.environ.get("BENCH_GATE")
+    if "--gate" in sys.argv:
+        i = sys.argv.index("--gate") + 1
+        if i >= len(sys.argv):
+            print("usage: bench.py --gate BENCH_rNN.json", file=sys.stderr)
+            sys.exit(2)
+        gate_path = sys.argv[i]
+    return gate_path
+
+
+def _resolve_gate_prev(gate_path: str):
+    """Load the reference round. MUST run BEFORE this run overwrites
+    BENCH_DETAIL.json: when the driver record's parse failed (truncated
+    tail, round 4), the committed detail file from THAT round carries
+    the real numbers — reading it after the overwrite would gate the
+    run against itself."""
+    with open(gate_path) as f:
+        prev = json.load(f)
+    if isinstance(prev, dict) and not prev.get("parsed") and "tail" in prev:
+        n = prev.get("n")
+        if isinstance(n, int):
+            detail = os.path.join(
+                os.path.dirname(os.path.abspath(gate_path)) or ".",
+                detail_filename(n),
+            )
+            if os.path.exists(detail):
+                with open(detail) as f:
+                    prev = json.load(f)
+    return prev
+
+
 def main() -> None:
+    # resolve the gate reference FIRST (see _resolve_gate_prev)
+    gate_path = _gate_path_from_env()
+    gate_prev = _resolve_gate_prev(gate_path) if gate_path else None
     n_profiles = int(os.environ.get("BENCH_PROFILES", "20000"))
     avg_friends = int(os.environ.get("BENCH_AVG_FRIENDS", "10"))
     batch = int(os.environ.get("BENCH_BATCH", "64"))
@@ -444,6 +563,12 @@ def main() -> None:
         finally:
             srv.shutdown()
 
+    # demodb's device graph is done (the oracle timing later is host-
+    # only): free its HBM before the bigger graphs load — 16 GB cannot
+    # hold every block's graph at once, and plan-cache cycles keep
+    # plain `del` from freeing eagerly
+    db.detach_snapshot()
+
     # shared by the IS / IC / sf10 sections -------------------------------
     def parity_or_die(dbx, q, p, label):
         """Oracle-vs-compiled gate (exact compare under ORDER BY, canon
@@ -540,6 +665,10 @@ def main() -> None:
                 snb, q, [ic_params(name, i) for i in range(batch)]
             )
 
+    if snb_persons > 0:
+        snb.detach_snapshot()
+        del snb
+
     # ---- SF10 every round (VERDICT r3 #2): the IS spot check at 10x ----
     sf10 = {}
     sf10_persons = int(os.environ.get("BENCH_SF10_PERSONS", "100000"))
@@ -560,6 +689,7 @@ def main() -> None:
                 [{"personId": (i * 37) % sf10_persons} for i in range(batch)],
             )
         sf10["persons"] = sf10_persons
+        snb10.detach_snapshot()
         del snb10
 
     # ---- SF100-shaped single-chip run (the north-star scale, VERDICT
@@ -608,6 +738,7 @@ def main() -> None:
         }
         sf100["edges"] = int(bsnap.edge_classes["knows"].num_edges)
         sf100["persons"] = sf100_persons
+        big.detach_snapshot()
         del big, bsnap
 
         # ---- config 5 REAL (VERDICT r4 #2): the SNB interactive shape —
@@ -661,6 +792,7 @@ def main() -> None:
         sf100["config5_messages"] = int(
             bsnap5.edge_classes["hasCreator"].num_edges
         )
+        big5.detach_snapshot()
         del big5, bsnap5
 
         # sharded sub-block: the same SNB shape row-sharded over an
@@ -715,6 +847,7 @@ def main() -> None:
             skew[tag.replace("_qps", "_edges")] = int(
                 ssnap.edge_classes["knows"].num_edges
             )
+            sdb.detach_snapshot()
             del sdb, ssnap
 
     # ---- shard-count scaling of the ring-compacted merge (VERDICT r3
@@ -764,23 +897,44 @@ def main() -> None:
             },
         },
     }
-    print(json.dumps(out))
+    # The driver captures only the TAIL (~2000 chars) of stdout and
+    # parses the last JSON line — round 4's full line exceeded that and
+    # was recorded with parsed=null, losing every extra. So: the FULL
+    # result persists to a repo file (the judge and next round's gate
+    # read it), and the printed line carries the required keys plus a
+    # compact extras subset that stays well under the capture window.
+    detail_name = detail_filename(_round_stamp())
+    with open(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     detail_name),
+        "w",
+    ) as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+
+    print(compact_line(out, detail_name=detail_name))
 
     # regression gate: `python bench.py --gate BENCH_r03.json` (or env
     # BENCH_GATE=...) fails the run when any workload drops >15% vs the
     # recorded round — so a silent IS3-IS7-style regression (VERDICT r3
     # #1) can never ship again. Diagnostics on stderr; the driver's one
     # stdout JSON line stays intact.
-    gate_path = os.environ.get("BENCH_GATE")
-    if "--gate" in sys.argv:
-        i = sys.argv.index("--gate") + 1
-        if i >= len(sys.argv):
-            print("usage: bench.py --gate BENCH_rNN.json", file=sys.stderr)
-            sys.exit(2)
-        gate_path = sys.argv[i]
     if gate_path:
-        with open(gate_path) as f:
-            prev = json.load(f)
+        norm = (
+            (gate_prev.get("parsed") or gate_prev)
+            if isinstance(gate_prev, dict)
+            else gate_prev
+        )
+        if not (
+            isinstance(norm, dict)
+            and (norm.get("extras") or norm.get("value"))
+        ):
+            # zero comparisons would silently read as a pass
+            print(
+                f"gate vs {gate_path}: SKIPPED (no usable numbers in "
+                "the recorded round)",
+                file=sys.stderr,
+            )
+            return
         # q/s tolerance reflects the measured tunnel noise: identical
         # back-to-back IS runs vary ±40% on this link, so it only flags
         # drops beyond that envelope (override: BENCH_GATE_TOL). The
@@ -788,7 +942,9 @@ def main() -> None:
         # (BENCH_GATE_TOL_MS), catching what q/s noise hides.
         tol = float(os.environ.get("BENCH_GATE_TOL", "0.55"))
         ms_tol = float(os.environ.get("BENCH_GATE_TOL_MS", "0.85"))
-        regs = gate_regressions(out, prev, tolerance=tol, ms_tolerance=ms_tol)
+        regs = gate_regressions(
+            out, gate_prev, tolerance=tol, ms_tolerance=ms_tol
+        )
         for name, pv, cv in regs:
             unit = "ms/query" if name.endswith("_ms") else "q/s"
             print(

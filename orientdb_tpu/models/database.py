@@ -946,6 +946,15 @@ class Database:
         self._snapshot = snapshot
         self._snapshot_epoch = self.mutation_epoch
 
+    def detach_snapshot(self) -> None:
+        """Drop the attached snapshot and FREE its HBM buffers (the
+        device arrays delete eagerly; see GraphSnapshot.release_device).
+        Queries fall back to the oracle until a new snapshot attaches."""
+        snap = self._snapshot
+        self._snapshot = None
+        if snap is not None:
+            snap.release_device()
+
     def current_snapshot(self, require_fresh: bool = False):
         if self._snapshot is None:
             return None

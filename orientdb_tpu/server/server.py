@@ -21,6 +21,21 @@ from orientdb_tpu.utils.logging import get_logger
 log = get_logger("server")
 
 
+def _maybe_resume_scheduler(db) -> None:
+    """Start the database's scheduler loop when OSchedule events exist
+    ([E] the scheduler starts with the database). Shared by the open
+    and server-restart paths; never blocks either."""
+    try:
+        from orientdb_tpu.exec.scheduler import SCHEDULE_CLASS
+
+        if db.schema.exists_class(SCHEDULE_CLASS) and any(
+            True for _ in db.browse_class(SCHEDULE_CLASS)
+        ):
+            db.scheduler.start()
+    except Exception:  # pragma: no cover - never blocks open/startup
+        log.exception("scheduler resume failed for '%s'", db.name)
+
+
 class ServerPlugin:
     """Lifecycle SPI ([E] OServerPluginAbstract): subclass and register."""
 
@@ -110,15 +125,7 @@ class Server:
             self.databases[name] = db
             # a durable database recovered with OSchedule events resumes
             # firing them ([E] the scheduler starts with the database)
-            try:
-                from orientdb_tpu.exec.scheduler import SCHEDULE_CLASS
-
-                if db.schema.exists_class(SCHEDULE_CLASS) and any(
-                    True for _ in db.browse_class(SCHEDULE_CLASS)
-                ):
-                    db.scheduler.start()
-            except Exception:  # pragma: no cover - never blocks open
-                log.exception("scheduler resume failed for '%s'", name)
+            _maybe_resume_scheduler(db)
             return db
 
     def get_database(self, name: str) -> Optional[Database]:
@@ -167,16 +174,8 @@ class Server:
             self.coalescer = QueryCoalescer()
         # symmetric with shutdown()'s scheduler stop: databases still
         # attached with OSchedule events resume firing
-        from orientdb_tpu.exec.scheduler import SCHEDULE_CLASS
-
         for db in list(self.databases.values()):
-            try:
-                if db.schema.exists_class(SCHEDULE_CLASS) and any(
-                    True for _ in db.browse_class(SCHEDULE_CLASS)
-                ):
-                    db.scheduler.start()
-            except Exception:  # pragma: no cover - never blocks startup
-                log.exception("scheduler resume failed for '%s'", db.name)
+            _maybe_resume_scheduler(db)
         for p in self.plugins:
             p.startup()
         self._http = HttpListener(self, self._http_port)

@@ -199,6 +199,31 @@ class GraphSnapshot:
         #: DeviceGraph to lay adjacency out shard-wise (parallel/mesh_graph)
         self._mesh = None
 
+    def release_device(self) -> None:
+        """Free every HBM buffer this snapshot pinned: device arrays are
+        deleted eagerly (not just dereferenced — compiled plans and
+        stray references would otherwise keep them alive until GC), and
+        the plan cache goes with them (its executables captured the
+        arrays). The host-side snapshot survives; the next device use
+        re-uploads. Multi-graph workloads (the bench's block sequence)
+        need this — 16 GB of HBM cannot hold every graph at once."""
+        dg = self._device_cache
+        self._device_cache = None
+        if dg is not None:
+            # mutate the CANONICAL store: `dg.arrays = {}` would only
+            # install a thread-local override (the jit-trace swap
+            # mechanism) and leave every deleted buffer referenced
+            for a in list(dg._arrays.values()):
+                try:
+                    a.delete()
+                except Exception:  # pragma: no cover - already deleted
+                    pass
+            dg._arrays.clear()
+            dg._pending.clear()
+        cache = getattr(self, "_plan_cache", None)
+        if cache is not None:
+            cache.clear()
+
     # -- lookups -----------------------------------------------------------
 
     def vertex_hull(self, name: str) -> tuple:

@@ -157,3 +157,117 @@ class TestDeviceMsGate:
         cur["extras"]["rows_1hop_batched_qps"] = 28.0  # -44%: passes 0.55
         regs = bench.gate_regressions(cur, self._run(), tolerance=0.55)
         assert regs == [("rows_1hop.device_ms", 20.0, 36.0)]
+
+
+class TestCompactLine:
+    def test_fits_driver_capture_window(self):
+        """The stdout line must survive the driver's ~2000-char tail
+        capture (round 4's full line exceeded it and was recorded with
+        parsed=null, losing every extra)."""
+        import json
+
+        from bench import LINE_BUDGET, compact_line
+
+        # a representative fat result: every extras family populated
+        out = {
+            "metric": "demodb_match_2hop_count_qps",
+            "value": 600.0,
+            "unit": "queries/sec",
+            "vs_baseline": 8000.0,
+            "extras": {
+                "batch_size": 64,
+                "single_query_qps": 9.1,
+                "rows_1hop_batched_qps": 58.2,
+                "var_depth_while_batched_qps": 480.0,
+                "traverse_bfs_batched_qps": 260.0,
+                "select_count_batched_qps": 610.0,
+                "remote": {
+                    "single_qps": 8.5,
+                    "batch_qps": 410.0,
+                    "pipeline_qps": 120.0,
+                    "clients": 4,
+                    "extra_detail": list(range(50)),
+                },
+                "ldbc_is": {f"IS{i}": 100.0 + i for i in range(1, 8)},
+                "ldbc_ic": {f"IC{i}": 200.0 + i for i in range(1, 4)},
+                "sf10": {f"IS{i}": 300.0 + i for i in range(1, 8)},
+                "sf100_shape": {"big": list(range(200))},
+                "phase_split_ms_per_query": {
+                    t: {"device_ms": 1.2, "transfer_ms": 3.4, "host_ms": 0.5}
+                    for t in (
+                        "single_2hop",
+                        "batched_2hop",
+                        "rows_1hop",
+                        "rows_1hop_param",
+                    )
+                },
+                "mesh_scaling": [{"S": s, "rows": 4096} for s in (2, 4, 8)],
+            },
+        }
+        line = compact_line(out)
+        assert len(line) <= LINE_BUDGET
+        parsed = json.loads(line)
+        # the required contract keys always survive
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            assert k in parsed
+        assert parsed["extras"]["detail_file"] == "BENCH_DETAIL.json"
+        # the gate's stable signal rides along when it fits
+        assert "phase_split_ms_per_query" in parsed["extras"]
+
+    def test_gate_survives_null_parsed_wrapper(self):
+        from bench import gate_regressions
+
+        cur = {"value": 100.0, "extras": {"x_qps": 50.0}}
+        prev_wrapper = {"n": 4, "rc": 0, "tail": "…", "parsed": None}
+        # no numeric leaves in the wrapper: trivially no regressions,
+        # and no crash on parsed=None
+        assert gate_regressions(cur, prev_wrapper) == []
+
+    def test_stable_signal_survives_longest(self):
+        """phase_split (the device/host-ms gate signal) is the LAST
+        extras family dropped when the line runs over budget."""
+        import json
+
+        from bench import compact_line
+
+        out = {
+            "metric": "m",
+            "value": 1.0,
+            "unit": "q/s",
+            "vs_baseline": 1.0,
+            "extras": {
+                "ldbc_is": {f"IS{i}": float(i) for i in range(1, 8)},
+                "remote": {"single_qps": 1.0, "batch_qps": 2.0},
+                "phase_split_ms_per_query": {
+                    "a": {"device_ms": 1.0, "host_ms": 2.0}
+                },
+            },
+        }
+        # a budget that can hold phase_split but not everything
+        base = len(json.dumps({"metric": "m", "value": 1.0, "unit": "q/s",
+                               "vs_baseline": 1.0}))
+        line = compact_line(out, budget=base + 160)
+        parsed = json.loads(line)
+        assert "phase_split_ms_per_query" in parsed["extras"]
+        assert "ldbc_is" not in parsed["extras"]
+
+    def test_gate_prev_resolution_order(self, tmp_path):
+        """A parsed=null driver record falls back to the round's
+        committed BENCH_DETAIL.json — resolved BEFORE the current run
+        overwrites it (self-comparison would never fail)."""
+        import json
+
+        from bench import _resolve_gate_prev
+
+        wrapper = tmp_path / "BENCH_r04.json"
+        wrapper.write_text(json.dumps({"n": 4, "tail": "x", "parsed": None}))
+        # the fallback reads the ROUND-STAMPED detail file only — a
+        # shared filename would be overwritten by every later run and
+        # the gate would compare a run against itself
+        detail = tmp_path / "BENCH_DETAIL_r04.json"
+        detail.write_text(json.dumps({"value": 42.0, "extras": {"x_qps": 9.0}}))
+        (tmp_path / "BENCH_DETAIL.json").write_text(
+            json.dumps({"value": 1.0})
+        )
+        prev = _resolve_gate_prev(str(wrapper))
+        assert prev["value"] == 42.0

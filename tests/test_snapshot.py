@@ -193,3 +193,29 @@ class TestExportImport:
         db2 = import_database(p)
         idx = db2.indexes.get_index("Profiles.name")
         assert idx is not None and idx.size() == 5
+
+
+class TestDetachSnapshot:
+    def test_detach_frees_device_arrays_and_reattach_works(self):
+        from orientdb_tpu.storage.ingest import generate_demodb
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+        db = generate_demodb(n_profiles=200, avg_friends=4, seed=3)
+        attach_fresh_snapshot(db)
+        q = (
+            "MATCH {class:Profiles, as:p}-HasFriend->{as:f} "
+            "RETURN count(*) AS n"
+        )
+        want = db.query(q, engine="oracle").to_dicts()
+        assert db.query(q, engine="tpu", strict=True).to_dicts() == want
+        snap = db.current_snapshot()
+        dg = snap._device_cache
+        assert dg is not None and dg.arrays
+        db.detach_snapshot()
+        assert db.current_snapshot() is None
+        assert snap._device_cache is None and not dg.arrays
+        # queries still answer (oracle fallback)
+        assert db.query(q).to_dicts() == want
+        # a fresh attach re-uploads and the compiled path works again
+        attach_fresh_snapshot(db)
+        assert db.query(q, engine="tpu", strict=True).to_dicts() == want
