@@ -27,7 +27,7 @@ from orientdb_tpu.models.record import Document, Vertex, Edge, Direction
 from orientdb_tpu.models.database import Database, ConcurrentModificationError
 from orientdb_tpu.exec.result import Result, ResultSet
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "RID",
