@@ -217,6 +217,214 @@ def run_virtual_mesh_subprocess(module: str, argv, timeout: int, n_devices: int 
         return {"error": str(e)[:200]}
 
 
+def _timing_knobs():
+    """(batch, iters, reps) — ONE parse shared by main and --block
+    children so the two timing loops can never desynchronize."""
+    return (
+        int(os.environ.get("BENCH_BATCH", "64")),
+        int(os.environ.get("BENCH_ITERS", "3")),
+        max(1, int(os.environ.get("BENCH_REPS", "3"))),
+    )
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    mid = n // 2
+    return xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+def time_param_batch(dbx, q, plist, iters, reps):
+    """Two warm rounds with drains (group executables and
+    overflow-driven variant re-records settle), then the timed batched
+    loop; returns the median-of-reps q/s. Shared by main's closure and
+    the --block subprocess children — one statistic everywhere."""
+    from orientdb_tpu.exec.tpu_engine import drain_warmups
+
+    qs = [q] * len(plist)
+    dbx.query_batch(qs, params_list=plist, engine="tpu", strict=True)
+    drain_warmups()
+    dbx.query_batch(qs, params_list=plist, engine="tpu", strict=True)
+    drain_warmups()
+    qpss = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            for rs in dbx.query_batch(
+                qs, params_list=plist, engine="tpu", strict=True
+            ):
+                rs.to_dicts()
+        qpss.append((iters * len(plist)) / (time.perf_counter() - t0))
+    return round(_median(qpss), 3)
+
+
+def _fatal_parity(error: str) -> None:
+    print(json.dumps({"metric": "demodb_match_2hop_count_qps",
+                      "value": 0.0, "unit": "queries/sec",
+                      "vs_baseline": 0.0, "error": error}))
+    sys.exit(1)
+
+
+def bench_sf100_block(batch: int, iters: int, reps: int) -> dict:
+    """The SF100-shape + config-5 blocks, run in their own PROCESS: the
+    tunneled TPU runtime does not reliably return deleted buffers to
+    the allocator, so graphs from earlier in-process blocks reduce the
+    headroom until RESOURCE_EXHAUSTED — process exit is the one free()
+    this runtime honors."""
+    import numpy as _np
+
+    from orientdb_tpu.storage.bigshape import (
+        build_person_knows,
+        build_snb_shape,
+        numpy_1hop_count,
+        numpy_2hop_count,
+        numpy_config5_count,
+    )
+
+    sf100 = {}
+    sf100_persons = int(os.environ.get("BENCH_SF100_PERSONS", "8000000"))
+    big, bsnap = build_person_knows(sf100_persons, avg_knows=10, seed=5)
+    b1 = (
+        "MATCH {class:Person, as:p, where:(age > 40)}"
+        "-knows->{as:f, where:(age < 30)} RETURN count(*) AS n"
+    )
+    b2 = (
+        "MATCH {class:Person, as:p, where:(age > 40)}-knows->{as:f}"
+        "-knows->{as:g, where:(age < 30)} RETURN count(*) AS n"
+    )
+    age = bsnap.v_columns["age"].values
+    src_m, mid, dst = age > 40, _np.ones(age.shape[0], bool), age < 30
+    want1 = numpy_1hop_count(bsnap, src_m, dst)
+    want2 = numpy_2hop_count(bsnap, src_m, mid, dst)
+    got1 = big.query(b1, engine="tpu", strict=True).to_dicts()
+    got2 = big.query(b2, engine="tpu", strict=True).to_dicts()
+    if got1 != [{"n": want1}] or got2 != [{"n": want2}]:
+        _fatal_parity("sf100_shape parity mismatch")
+    for tag, q in (("one_hop_count_qps", b1), ("two_hop_count_qps", b2)):
+        sf100[tag] = time_param_batch(
+            big, q, [None] * batch, iters, reps
+        )
+    rep = bsnap._device_cache.memory_report()
+    sf100["hbm_bytes"] = {
+        "per_device_total": sum(rep["per_device"].values()),
+        **{f"per_device_{k}": v for k, v in rep["per_device"].items()},
+        "pruned_column_bytes": rep.get("pruned_bytes", 0),
+    }
+    sf100["edges"] = int(bsnap.edge_classes["knows"].num_edges)
+    sf100["persons"] = sf100_persons
+    big.detach_snapshot()
+    del big, bsnap
+
+    # ---- config 5 REAL (VERDICT r4 #2) ----
+    big5, bsnap5 = build_snb_shape(
+        sf100_persons, msgs_per_person=2, avg_knows=10, seed=7
+    )
+    q5 = (
+        "MATCH {class:Person, as:p, where:(age > 40)}"
+        ".outE('knows'){where:(creationDate > :d)}"
+        ".inV(){as:f, where:(age < 30)}, "
+        "{class:Message, as:m}-hasCreator->{as:f} "
+        "RETURN count(*) AS n"
+    )
+    for d in (12_000, 15_000, 18_500):
+        want = numpy_config5_count(bsnap5, d)
+        got = big5.query(
+            q5, params={"d": d}, engine="tpu", strict=True
+        ).to_dicts()
+        if got != [{"n": want}]:
+            _fatal_parity(f"config5 parity mismatch d={d}")
+    sf100["config5_qps"] = time_param_batch(
+        big5,
+        q5,
+        [{"d": 12_000 + (i * 211) % 8000} for i in range(batch)],
+        iters,
+        reps,
+    )
+    rep5 = bsnap5._device_cache.memory_report()
+    sf100["config5_hbm_bytes"] = {
+        "per_device_total": sum(rep5["per_device"].values()),
+        **{f"per_device_{k}": v for k, v in rep5["per_device"].items()},
+        # pruning observable (VERDICT r4 #8): columns the config-5
+        # plan never references (uid, length) stay host-side
+        "pruned_column_bytes": rep5.get("pruned_bytes", 0),
+    }
+    sf100["config5_knows_edges"] = int(
+        bsnap5.edge_classes["knows"].num_edges
+    )
+    sf100["config5_messages"] = int(
+        bsnap5.edge_classes["hasCreator"].num_edges
+    )
+    return sf100
+
+
+def bench_skew_block(batch: int, iters: int, reps: int) -> dict:
+    """The degree-skew block in its own process (see bench_sf100_block
+    for why)."""
+    import numpy as _np
+
+    from orientdb_tpu.storage.bigshape import (
+        build_person_knows as _bpk,
+        numpy_2hop_count as _np2,
+    )
+
+    skew = {}
+    skew_persons = int(os.environ.get("BENCH_SKEW_PERSONS", "1000000"))
+    qskew = (
+        "MATCH {class:Person, as:p, where:(age > 40)}-knows->{as:f}"
+        "-knows->{as:g, where:(age < 30)} RETURN count(*) AS n"
+    )
+    for tag, kw in (
+        ("uniform_qps", {}),
+        ("supernode_qps", {"supernodes": 100, "supernode_degree": 20000}),
+    ):
+        sdb, ssnap = _bpk(skew_persons, avg_knows=12, seed=9, **kw)
+        age = ssnap.v_columns["age"].values
+        want = _np2(
+            ssnap, age > 40, _np.ones(age.shape[0], bool), age < 30
+        )
+        if sdb.query(qskew, engine="tpu", strict=True).to_dicts() != [
+            {"n": want}
+        ]:
+            _fatal_parity(f"skew parity mismatch: {tag}")
+        skew[tag] = time_param_batch(
+            sdb, qskew, [None] * batch, iters, reps
+        )
+        skew[tag.replace("_qps", "_edges")] = int(
+            ssnap.edge_classes["knows"].num_edges
+        )
+        sdb.detach_snapshot()
+        del sdb, ssnap
+    return skew
+
+
+def run_tpu_subprocess(block: str, timeout: int) -> dict:
+    """Re-invoke bench.py for ONE heavy block on the real device in a
+    fresh process (memory isolation; see bench_sf100_block). Env knobs
+    propagate; the block prints one JSON line."""
+    import subprocess
+
+    try:
+        out_s = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--block", block],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=timeout,
+        )
+        lines = out_s.stdout.strip().splitlines()
+        last = lines[-1] if lines else ""
+        if out_s.returncode != 0 or not lines:
+            # a parity failure prints its fatal JSON to stdout; any
+            # other crash's diagnostic lives on STDERR — prefer it so
+            # a stray stdout line can't mask the real traceback
+            if "parity mismatch" in last:
+                return {"error": last[-300:]}
+            return {
+                "error": (out_s.stderr.strip() or last)[-300:]
+            }
+        return json.loads(last)
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": str(e)[:300]}
+
+
 def _round_stamp() -> int:
     """THIS run's round number: one past the newest driver record
     (BENCH_r{N}.json) in the repo root. Stamps the detail file so a
@@ -272,6 +480,16 @@ def _resolve_gate_prev(gate_path: str):
 
 
 def main() -> None:
+    if "--block" in sys.argv:
+        i = sys.argv.index("--block") + 1
+        kind = sys.argv[i] if i < len(sys.argv) else ""
+        fn = {"sf100": bench_sf100_block, "skew": bench_skew_block}.get(kind)
+        if fn is None:
+            print(f"usage: bench.py --block sf100|skew (got {kind!r})",
+                  file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps(fn(*_timing_knobs())))
+        return
     # resolve the gate reference FIRST (see _resolve_gate_prev)
     gate_path = _gate_path_from_env()
     gate_prev = _resolve_gate_prev(gate_path) if gate_path else None
@@ -347,11 +565,6 @@ def main() -> None:
     # q/s rides the tunnel's ±40% noise; the median of 3 — and medians of
     # the per-phase ms — are what the gate compares round over round
     reps = max(1, int(os.environ.get("BENCH_REPS", "3")))
-
-    def _median(xs):
-        s = sorted(xs)
-        m = len(s) // 2
-        return s[m] if len(s) % 2 else 0.5 * (s[m - 1] + s[m])
 
     def _median_split(ss):
         return {
@@ -591,26 +804,13 @@ def main() -> None:
             )
             sys.exit(1)
 
-    def time_param_batch(dbx, q, plist, n=None):
-        """Two warm rounds with drains (group executables and
-        overflow-driven variant re-records settle — see time_batched),
-        then the timed batched loop; returns the median-of-reps q/s."""
-        n = iters if n is None else n
-        qs = [q] * len(plist)
-        dbx.query_batch(qs, params_list=plist, engine="tpu", strict=True)
-        drain_warmups()
-        dbx.query_batch(qs, params_list=plist, engine="tpu", strict=True)
-        drain_warmups()
-        qpss = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            for _ in range(n):
-                for rs in dbx.query_batch(
-                    qs, params_list=plist, engine="tpu", strict=True
-                ):
-                    rs.to_dicts()
-            qpss.append((n * len(plist)) / (time.perf_counter() - t0))
-        return round(_median(qpss), 3)
+    def time_param_batch_local(dbx, q, plist, n=None):
+        """Main's thin wrapper over the shared module-level
+        time_param_batch (one timing loop + one median statistic for
+        in-process AND --block-subprocess metrics)."""
+        return time_param_batch(
+            dbx, q, plist, iters if n is None else n, reps
+        )
 
     # LDBC SNB interactive short reads (IS1–IS7) on an SF1-shaped graph
     snb_persons = int(os.environ.get("BENCH_SNB_PERSONS", "10000"))
@@ -635,7 +835,7 @@ def main() -> None:
             # in tests/test_ldbc_is.py)
             for i in (0, 5, 9):
                 parity_or_die(snb, q, is_params(q, i), f"IS {name}")
-            ldbc_is[name] = time_param_batch(
+            ldbc_is[name] = time_param_batch_local(
                 snb, q, [is_params(q, i) for i in range(batch)]
             )
 
@@ -661,7 +861,7 @@ def main() -> None:
             q = IC_QUERIES[name]
             for i in (0, 5, 9):
                 parity_or_die(snb, q, ic_params(name, i), f"IC {name}")
-            ldbc_ic[name + "_qps"] = time_param_batch(
+            ldbc_ic[name + "_qps"] = time_param_batch_local(
                 snb, q, [ic_params(name, i) for i in range(batch)]
             )
 
@@ -683,7 +883,7 @@ def main() -> None:
             parity_or_die(
                 snb10, q, {"personId": 37 % sf10_persons}, f"sf10 {name}"
             )
-            sf10[name + "_qps"] = time_param_batch(
+            sf10[name + "_qps"] = time_param_batch_local(
                 snb10,
                 q,
                 [{"personId": (i * 37) % sf10_persons} for i in range(batch)],
@@ -693,108 +893,25 @@ def main() -> None:
         del snb10
 
     # ---- SF100-shaped single-chip run (the north-star scale, VERDICT
-    # r3 #2): 10^8-edge Person-knows graph built array-natively
-    # (storage/bigshape), int32 CSR in HBM, COUNT shapes parity-checked
-    # against exact int64 numpy references, hbm.* byte gauges recorded ----
+    # r3 #2) + config 5, in a SUBPROCESS: the tunneled runtime does not
+    # reliably return deleted buffers, so the heavy graphs get their
+    # own process (exit is the one free() it honors) ----
     sf100 = {}
     sf100_persons = int(os.environ.get("BENCH_SF100_PERSONS", "8000000"))
     if sf100_persons > 0:
-        import numpy as _np
-
-        from orientdb_tpu.storage.bigshape import (
-            build_person_knows,
-            numpy_1hop_count,
-            numpy_2hop_count,
-        )
-
-        big, bsnap = build_person_knows(sf100_persons, avg_knows=10, seed=5)
-        b1 = (
-            "MATCH {class:Person, as:p, where:(age > 40)}"
-            "-knows->{as:f, where:(age < 30)} RETURN count(*) AS n"
-        )
-        b2 = (
-            "MATCH {class:Person, as:p, where:(age > 40)}-knows->{as:f}"
-            "-knows->{as:g, where:(age < 30)} RETURN count(*) AS n"
-        )
-        age = bsnap.v_columns["age"].values
-        src, mid, dst = age > 40, _np.ones(age.shape[0], bool), age < 30
-        want1 = numpy_1hop_count(bsnap, src, dst)
-        want2 = numpy_2hop_count(bsnap, src, mid, dst)
-        got1 = big.query(b1, engine="tpu", strict=True).to_dicts()
-        got2 = big.query(b2, engine="tpu", strict=True).to_dicts()
-        if got1 != [{"n": want1}] or got2 != [{"n": want2}]:
-            print(json.dumps({"metric": "demodb_match_2hop_count_qps",
-                              "value": 0.0, "unit": "queries/sec",
-                              "vs_baseline": 0.0,
-                              "error": "sf100_shape parity mismatch"}))
+        sf100 = run_tpu_subprocess("sf100", timeout=3600)
+        if "error" in sf100:
+            # fatal like the old in-process block: a workload that
+            # silently disappears would sail through the gate
+            if "parity mismatch" in str(sf100["error"]):
+                print(sf100["error"])  # the block's own fatal line
+            else:
+                print(json.dumps({
+                    "metric": "demodb_match_2hop_count_qps",
+                    "value": 0.0, "unit": "queries/sec",
+                    "vs_baseline": 0.0,
+                    "error": f"sf100 block failed: {sf100['error']}"}))
             sys.exit(1)
-        for tag, q in (("one_hop_count_qps", b1), ("two_hop_count_qps", b2)):
-            sf100[tag] = time_param_batch(big, q, [None] * batch)
-        rep = bsnap._device_cache.memory_report()
-        sf100["hbm_bytes"] = {
-            "per_device_total": sum(rep["per_device"].values()),
-            **{f"per_device_{k}": v for k, v in rep["per_device"].items()},
-            "pruned_column_bytes": rep.get("pruned_bytes", 0),
-        }
-        sf100["edges"] = int(bsnap.edge_classes["knows"].num_edges)
-        sf100["persons"] = sf100_persons
-        big.detach_snapshot()
-        del big, bsnap
-
-        # ---- config 5 REAL (VERDICT r4 #2): the SNB interactive shape —
-        # multi-class (Person + Message), a creationDate EDGE property
-        # column, and the multi-pattern MATCH with the fused
-        # edge-property WHERE (SURVEY.md:52-54, configs[4]) — parity
-        # against the exact numpy reference, parameters varying across
-        # the batch ----
-        from orientdb_tpu.storage.bigshape import (
-            build_snb_shape,
-            numpy_config5_count,
-        )
-
-        big5, bsnap5 = build_snb_shape(
-            sf100_persons, msgs_per_person=2, avg_knows=10, seed=7
-        )
-        q5 = (
-            "MATCH {class:Person, as:p, where:(age > 40)}"
-            ".outE('knows'){where:(creationDate > :d)}"
-            ".inV(){as:f, where:(age < 30)}, "
-            "{class:Message, as:m}-hasCreator->{as:f} "
-            "RETURN count(*) AS n"
-        )
-        for d in (12_000, 15_000, 18_500):
-            want = numpy_config5_count(bsnap5, d)
-            got = big5.query(
-                q5, params={"d": d}, engine="tpu", strict=True
-            ).to_dicts()
-            if got != [{"n": want}]:
-                print(json.dumps({"metric": "demodb_match_2hop_count_qps",
-                                  "value": 0.0, "unit": "queries/sec",
-                                  "vs_baseline": 0.0,
-                                  "error": f"config5 parity mismatch d={d}"}))
-                sys.exit(1)
-        sf100["config5_qps"] = time_param_batch(
-            big5,
-            q5,
-            [{"d": 12_000 + (i * 211) % 8000} for i in range(batch)],
-        )
-        rep5 = bsnap5._device_cache.memory_report()
-        sf100["config5_hbm_bytes"] = {
-            "per_device_total": sum(rep5["per_device"].values()),
-            **{f"per_device_{k}": v for k, v in rep5["per_device"].items()},
-            # pruning observable (VERDICT r4 #8): columns the config-5
-            # plan never references (uid, length) stay host-side
-            "pruned_column_bytes": rep5.get("pruned_bytes", 0),
-        }
-        sf100["config5_knows_edges"] = int(
-            bsnap5.edge_classes["knows"].num_edges
-        )
-        sf100["config5_messages"] = int(
-            bsnap5.edge_classes["hasCreator"].num_edges
-        )
-        big5.detach_snapshot()
-        del big5, bsnap5
-
         # sharded sub-block: the same SNB shape row-sharded over an
         # 8-device virtual mesh in a subprocess (adjacency + columns at
         # O(E/S) per device), parity-gated, with per-device hbm and
@@ -811,44 +928,21 @@ def main() -> None:
                 timeout=1800,
             )
 
-    # ---- degree skew (VERDICT r3 #7): supernode graph vs uniform at
-    # ~equal edge count; within ~2x is the bar ----
+    # ---- degree skew (VERDICT r3 #7), same subprocess isolation ----
     skew = {}
     skew_persons = int(os.environ.get("BENCH_SKEW_PERSONS", "1000000"))
     if skew_persons > 0:
-        from orientdb_tpu.storage.bigshape import (
-            build_person_knows as _bpk,
-            numpy_2hop_count as _np2,
-        )
-        import numpy as _np
-
-        qskew = (
-            "MATCH {class:Person, as:p, where:(age > 40)}-knows->{as:f}"
-            "-knows->{as:g, where:(age < 30)} RETURN count(*) AS n"
-        )
-        for tag, kw in (
-            ("uniform_qps", {}),
-            ("supernode_qps", {"supernodes": 100, "supernode_degree": 20000}),
-        ):
-            sdb, ssnap = _bpk(skew_persons, avg_knows=12, seed=9, **kw)
-            age = ssnap.v_columns["age"].values
-            want = _np2(
-                ssnap, age > 40, _np.ones(age.shape[0], bool), age < 30
-            )
-            if sdb.query(qskew, engine="tpu", strict=True).to_dicts() != [
-                {"n": want}
-            ]:
-                print(json.dumps({"metric": "demodb_match_2hop_count_qps",
-                                  "value": 0.0, "unit": "queries/sec",
-                                  "vs_baseline": 0.0,
-                                  "error": f"skew parity mismatch: {tag}"}))
-                sys.exit(1)
-            skew[tag] = time_param_batch(sdb, qskew, [None] * batch)
-            skew[tag.replace("_qps", "_edges")] = int(
-                ssnap.edge_classes["knows"].num_edges
-            )
-            sdb.detach_snapshot()
-            del sdb, ssnap
+        skew = run_tpu_subprocess("skew", timeout=3600)
+        if "error" in skew:
+            if "parity mismatch" in str(skew["error"]):
+                print(skew["error"])
+            else:
+                print(json.dumps({
+                    "metric": "demodb_match_2hop_count_qps",
+                    "value": 0.0, "unit": "queries/sec",
+                    "vs_baseline": 0.0,
+                    "error": f"skew block failed: {skew['error']}"}))
+            sys.exit(1)
 
     # ---- shard-count scaling of the ring-compacted merge (VERDICT r3
     # #6): per-S subprocesses on virtual CPU meshes; merge_rows must stay
