@@ -40,7 +40,10 @@ BENCH_SKEW_PERSONS (1000000; 0 skips), BENCH_MESH_SCALING (1; 0 skips
 the per-shard-count subprocess probes), BENCH_SF100_SHARDED_PERSONS
 (1000000; 0 skips the 8-virtual-device sharded config-5 sub-block — one
 CPU core executes all 8 devices, so the default adds several minutes),
-BENCH_REMOTE (1; 0 skips the wire-throughput block),
+BENCH_REMOTE (1; 0 skips the wire-throughput block), BENCH_EVIDENCE
+(path of the crash-safe JSONL evidence stream; default
+BENCH_EVIDENCE_r{NN}.jsonl next to this file — one fsync'd record per
+completed block, so a timed-out run still leaves partial numbers),
 BENCH_REMOTE_CLIENTS (4), BENCH_REPS (3 — timed reps per workload; the
 recorded q/s and phase-split ms are MEDIANS across reps), BENCH_GATE /
 --gate <json> (regression gate vs a recorded round: q/s leaves at
@@ -493,12 +496,40 @@ def main() -> None:
     # resolve the gate reference FIRST (see _resolve_gate_prev)
     gate_path = _gate_path_from_env()
     gate_prev = _resolve_gate_prev(gate_path) if gate_path else None
+    # crash-safe evidence stream (obs/evidence): one fsync'd JSONL
+    # record after EVERY completed block, so a driver timeout (round
+    # 5's rc:124) still leaves the finished blocks' numbers on disk.
+    # BENCH_EVIDENCE overrides the path (tests point it at a tmpdir).
+    from orientdb_tpu.obs.evidence import EvidenceSink
+
+    round_n = _round_stamp()
+    detail_name = detail_filename(round_n)
+    evidence = EvidenceSink(
+        os.environ.get("BENCH_EVIDENCE")
+        or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"BENCH_EVIDENCE_r{round_n:02d}.jsonl",
+        )
+    )
+
+    def ev(block: str, **data) -> None:
+        evidence.emit(block, data)
+
     n_profiles = int(os.environ.get("BENCH_PROFILES", "20000"))
     avg_friends = int(os.environ.get("BENCH_AVG_FRIENDS", "10"))
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     single_iters = int(os.environ.get("BENCH_SINGLE_ITERS", "10"))
     oracle_iters = int(os.environ.get("BENCH_ORACLE_ITERS", "1"))
+
+    ev(
+        "start",
+        round=round_n,
+        profiles=n_profiles,
+        avg_friends=avg_friends,
+        batch=batch,
+        iters=iters,
+    )
 
     from orientdb_tpu.storage.ingest import generate_demodb
     from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
@@ -556,6 +587,8 @@ def main() -> None:
                 )
             )
             sys.exit(1)
+
+    ev("parity", queries=5, status="ok")
 
     from orientdb_tpu.exec.tpu_engine import drain_warmups
     from orientdb_tpu.utils.metrics import metrics
@@ -632,8 +665,11 @@ def main() -> None:
         return _median(qpss)
 
     single_qps = time_single(sql, tag="single_2hop")
+    ev("single_2hop", qps=round(single_qps, 3), split=splits.get("single_2hop"))
     batched_qps = time_batched(sql, tag="batched_2hop")
+    ev("batched_2hop", qps=round(batched_qps, 3), split=splits.get("batched_2hop"))
     rows_qps = time_batched(sql_rows, tag="rows_1hop")
+    ev("rows_1hop", qps=round(rows_qps, 3), split=splits.get("rows_1hop"))
     # varied-parameter row-returning batch: parameters differ per lane,
     # so this exercises the vmapped rows-group dispatch (one Execute +
     # one compact group page for B distinct result sets) — the honest
@@ -666,9 +702,13 @@ def main() -> None:
     rows_param_qps = time_batched(
         sql_rows_param, tag="rows_1hop_param", params_list=rows_param_plist
     )
+    ev("rows_1hop_param", qps=round(rows_param_qps, 3))
     var_qps = time_batched(sql_var, tag="var_depth")
+    ev("var_depth", qps=round(var_qps, 3))
     trav_qps = time_batched(sql_trav, tag="traverse")
+    ev("traverse", qps=round(trav_qps, 3))
     select_qps = time_batched(sql_select, tag="select_count")
+    ev("select_count", qps=round(select_qps, 3))
 
     # ---- remote (wire) throughput (VERDICT r4 #1): the same workloads
     # measured THROUGH the binary protocol — a batch op (one frame, one
@@ -775,6 +815,7 @@ def main() -> None:
             remote["coalesced_grouped"] = snap.get("coalesce.grouped", 0)
         finally:
             srv.shutdown()
+        ev("remote", **remote)
 
     # demodb's device graph is done (the oracle timing later is host-
     # only): free its HBM before the bigger graphs load — 16 GB cannot
@@ -838,6 +879,7 @@ def main() -> None:
             ldbc_is[name] = time_param_batch_local(
                 snb, q, [is_params(q, i) for i in range(batch)]
             )
+        ev("ldbc_is", **ldbc_is)
 
     # ---- LDBC interactive COMPLEX reads (IC1/IC2 + 3-hop aggregate):
     # the multi-pattern half of BASELINE configs[4], on the same
@@ -864,6 +906,7 @@ def main() -> None:
             ldbc_ic[name + "_qps"] = time_param_batch_local(
                 snb, q, [ic_params(name, i) for i in range(batch)]
             )
+        ev("ldbc_ic", **ldbc_ic)
 
     if snb_persons > 0:
         snb.detach_snapshot()
@@ -889,6 +932,7 @@ def main() -> None:
                 [{"personId": (i * 37) % sf10_persons} for i in range(batch)],
             )
         sf10["persons"] = sf10_persons
+        ev("sf10", **sf10)
         snb10.detach_snapshot()
         del snb10
 
@@ -927,6 +971,7 @@ def main() -> None:
                 [8, sharded_persons],
                 timeout=1800,
             )
+        ev("sf100_shape", **sf100)
 
     # ---- degree skew (VERDICT r3 #7), same subprocess isolation ----
     skew = {}
@@ -943,6 +988,7 @@ def main() -> None:
                     "vs_baseline": 0.0,
                     "error": f"skew block failed: {skew['error']}"}))
             sys.exit(1)
+        ev("degree_skew", **skew)
 
     # ---- shard-count scaling of the ring-compacted merge (VERDICT r3
     # #6): per-S subprocesses on virtual CPU meshes; merge_rows must stay
@@ -956,11 +1002,13 @@ def main() -> None:
             )
             res.setdefault("shards", S)
             mesh_scaling.append(res)
+        ev("mesh_scaling", results=mesh_scaling)
 
     t0 = time.perf_counter()
     for _ in range(oracle_iters):
         run("oracle")
     oracle_qps = oracle_iters / (time.perf_counter() - t0)
+    ev("oracle_2hop", qps=round(oracle_qps, 4))
 
     out = {
         "metric": "demodb_match_2hop_count_qps",
@@ -997,13 +1045,19 @@ def main() -> None:
     # result persists to a repo file (the judge and next round's gate
     # read it), and the printed line carries the required keys plus a
     # compact extras subset that stays well under the capture window.
-    detail_name = detail_filename(_round_stamp())
+    # (detail_name was round-stamped up front, before the first block.)
     with open(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      detail_name),
         "w",
     ) as f:
         json.dump(out, f, indent=1, sort_keys=True)
+    ev(
+        "final",
+        value=out["value"],
+        vs_baseline=out["vs_baseline"],
+        detail_file=detail_name,
+    )
 
     print(compact_line(out, detail_name=detail_name))
 

@@ -112,6 +112,19 @@ def _result_set(rows, engine_used: str) -> ResultSet:
     return rs
 
 
+def _observe_query(sql: str, t0: float, engine_used: str, trace_id) -> None:
+    """Per-query latency accounting shared by query()/command(): the
+    duration stat + histogram feed /metrics, the slowlog keeps the tail."""
+    import time
+
+    from orientdb_tpu.obs.registry import obs as _obs
+    from orientdb_tpu.obs.slowlog import slowlog
+
+    dur = time.perf_counter() - t0
+    _obs.observe("query.latency_s", dur)
+    slowlog.record(sql, dur, engine=engine_used, trace_id=trace_id)
+
+
 def execute_query(
     db,
     sql: str,
@@ -122,6 +135,28 @@ def execute_query(
     """Idempotent statements only ([E] ODatabaseSession.query contract).
     PROFILE executes its inner statement, so a PROFILE of a write is
     rejected here too."""
+    import time
+
+    from orientdb_tpu.obs.trace import span
+
+    t0 = time.perf_counter()
+    with span("query", sql=sql[:120]) as sp:
+        rs = _execute_query(db, sql, params, engine, strict)
+        sp.set("engine", getattr(rs, "engine", None))
+        rows = getattr(rs, "_rows", None)
+        if hasattr(rows, "__len__"):
+            sp.set("rows", len(rows))
+    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id)
+    return rs
+
+
+def _execute_query(
+    db,
+    sql: str,
+    params=None,
+    engine: Optional[str] = None,
+    strict: bool = False,
+) -> ResultSet:
     stmt = parse_cached(sql)
     if isinstance(stmt, A.ExplainStatement):
         inner_writes = stmt.profile and not stmt.inner.is_idempotent
@@ -163,6 +198,25 @@ def execute_command(
     engine: Optional[str] = None,
     strict: bool = False,
 ) -> ResultSet:
+    import time
+
+    from orientdb_tpu.obs.trace import span
+
+    t0 = time.perf_counter()
+    with span("command", sql=sql[:120]) as sp:
+        rs = _execute_command(db, sql, params, engine, strict)
+        sp.set("engine", getattr(rs, "engine", None))
+    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id)
+    return rs
+
+
+def _execute_command(
+    db,
+    sql: str,
+    params=None,
+    engine: Optional[str] = None,
+    strict: bool = False,
+) -> ResultSet:
     stmt = parse_cached(sql)
     if isinstance(stmt, A.ExplainStatement):
         return explain_statement(db, stmt, _normalize_params(params))
@@ -190,6 +244,19 @@ def execute_query_batch(
     answer to the tunneled-TPU's fixed per-transfer RTT. Per-statement
     Uncompilable failures fall back to the oracle (unless ``strict``).
     """
+    from orientdb_tpu.obs.trace import span
+
+    with span("query_batch", n=len(sqls)):
+        return _execute_query_batch(db, sqls, params_list, engine, strict)
+
+
+def _execute_query_batch(
+    db,
+    sqls,
+    params_list=None,
+    engine: Optional[str] = None,
+    strict: bool = False,
+) -> List[ResultSet]:
     n = len(sqls)
     if params_list is None:
         params_list = [None] * n

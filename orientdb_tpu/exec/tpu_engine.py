@@ -943,20 +943,42 @@ class TpuMatchSolver:
             steps = self.plan[:-1]
         else:
             steps = self.plan
+        from contextlib import nullcontext
+
+        from orientdb_tpu.obs.registry import obs as _obs
+        from orientdb_tpu.obs.trace import span as _span
+
+        # spans/histograms only on the eager RECORDING execution: replay
+        # re-traces this body under jax.jit (compile time, recorded-size
+        # padding) — observing there would record tracing artifacts as if
+        # they were query execution
+        rec = self.sched.recording
         table = Table(count=1, width=0)
         for step in steps:
             if table.empty():
                 # required-edge pipeline already empty → no rows; optional
                 # steps cannot resurrect rows
                 return table
-            if step.kind == "root":
-                table = self._root(table, step.alias)
-            elif step.kind == "expand":
-                table = self._expand(table, step, optional=False)
-            else:
-                table = self._expand(table, step, optional=True)
+            # one span per plan step (root seed / PatternEdge hop): the
+            # per-hop stage timings PROFILE surfaces; frontier sizes feed
+            # the tpu.frontier_rows histogram on /metrics
+            sp = _span("tpu.step", step=step.describe()) if rec else None
+            with sp if sp is not None else nullcontext():
+                if step.kind == "root":
+                    table = self._root(table, step.alias)
+                elif step.kind == "expand":
+                    table = self._expand(table, step, optional=False)
+                else:
+                    table = self._expand(table, step, optional=True)
+                if sp is not None:
+                    sp.set("frontier_rows", table.count)
+            if rec:
+                _obs.observe_size("tpu.frontier_rows", table.count)
         if self._not_compiled and not table.empty():
-            table = self._apply_not_paths(table)
+            with _span("tpu.step", step="NOT anti-join") if rec else (
+                nullcontext()
+            ):
+                table = self._apply_not_paths(table)
         if pushdown and not table.empty():
             return self._apply_count_pushdown(table, pushdown)
         if var_count is not None and not table.empty():
@@ -1185,77 +1207,94 @@ class TpuMatchSolver:
         V = self.dg.num_vertices
         vb = K.bucket(max(V, 1))
         mg = self.dg.mesh_graph
+        univ = None
         if mg is not None:
             univ = jnp.arange(vb, dtype=jnp.int32)
             univ = jnp.where(univ < V, univ, -1)
+        from contextlib import nullcontext
+
+        from orientdb_tpu.obs.trace import span as _span
+
+        # recording-only spans, like solve_table: replays re-trace this
+        # under jax.jit, where a span would time XLA tracing, not work
+        rec = self.sched.recording
         w = None  # None ≡ all-ones (the implicit weight after the last hop)
         for step in reversed(steps):
-            item = step.edge.item
-            direction = item.direction
-            if step.reverse:
-                direction = _REVERSE_DIR[direction]
-            dst_alias = (
-                step.edge.from_alias if step.reverse else step.edge.to_alias
-            )
-            node_mask = self._node_masks[dst_alias]
-            ok_vec = node_mask(univ) if mg is not None else None
-            f = item.edge_filter
-            new_w = jnp.zeros(vb, dtype)
-            for cname in self._resolve_edge_classes(item):
-                dec = self.dg.edges[cname]
-                E = dec.num_edges
-                if E == 0:
-                    continue
-                eids = jnp.arange(E, dtype=jnp.int32)
-                emask = (
-                    self._edge_where(cname, f.where)(eids, {})
-                    if (f is not None and f.where is not None)
-                    else jnp.ones(E, bool)
-                )
-                for d in ("out", "in") if direction == "both" else (direction,):
-                    # scanning the full out-CSR edge list covers both
-                    # directions: eid == position for either walk
-                    if mg is not None:
-                        from orientdb_tpu.parallel.mesh_graph import (
-                            sharded_weight_pass,
-                        )
-
-                        p = mg.edge[cname].prefix
-                        src_sh = self.dg.arrays[f"{p}:el:src"]
-                        dst_sh = self.dg.arrays[f"{p}:el:dst"]
-                        eid_sh = self.dg.arrays[f"{p}:el:eid"]
-                        seg_sh, emit_sh = (
-                            (src_sh, dst_sh) if d == "out" else (dst_sh, src_sh)
-                        )
-                        new_w = new_w + sharded_weight_pass(
-                            mg.mesh,
-                            seg_sh,
-                            emit_sh,
-                            eid_sh,
-                            emask,
-                            ok_vec,
-                            w if w is not None else jnp.ones(vb, dtype),
-                            vb,
-                        )
-                        continue
-                    # both CSR orders exist in HBM, so either direction
-                    # sums via cumsum+boundary-gather (indptr_segment_sum)
-                    # instead of the ~7x-costlier TPU scatter-add; the
-                    # in-direction reorders the out-order edge mask
-                    # through the in-CSR's edge-id map first
-                    if d == "out":
-                        emit, ip = dec.dst, dec.indptr_out
-                        em = emask
-                    else:
-                        emit, ip = dec.src, dec.indptr_in
-                        em = jnp.take(emask, dec.edge_id_in)
-                    contrib = em & node_mask(emit)
-                    vals = contrib.astype(dtype)
-                    if w is not None:
-                        vals = vals * K.take_pad(w, emit, dtype(0))
-                    new_w = new_w + K.indptr_segment_sum(vals, ip, vb)
-            w = new_w
+            # one span per PatternEdge hop: the COUNT pushdown fuses all
+            # hops into one weight chain, so the honest per-hop timing is
+            # each hop's weight-pass build/dispatch
+            with _span(
+                "tpu.step", step=step.describe(), stage="count-pushdown"
+            ) if rec else nullcontext():
+                w = self._pushdown_weight_step(step, w, univ, mg, vb, dtype)
         return w
+
+    def _pushdown_weight_step(self, step, w, univ, mg, vb, dtype):
+        item = step.edge.item
+        direction = item.direction
+        if step.reverse:
+            direction = _REVERSE_DIR[direction]
+        dst_alias = (
+            step.edge.from_alias if step.reverse else step.edge.to_alias
+        )
+        node_mask = self._node_masks[dst_alias]
+        ok_vec = node_mask(univ) if mg is not None else None
+        f = item.edge_filter
+        new_w = jnp.zeros(vb, dtype)
+        for cname in self._resolve_edge_classes(item):
+            dec = self.dg.edges[cname]
+            E = dec.num_edges
+            if E == 0:
+                continue
+            eids = jnp.arange(E, dtype=jnp.int32)
+            emask = (
+                self._edge_where(cname, f.where)(eids, {})
+                if (f is not None and f.where is not None)
+                else jnp.ones(E, bool)
+            )
+            for d in ("out", "in") if direction == "both" else (direction,):
+                # scanning the full out-CSR edge list covers both
+                # directions: eid == position for either walk
+                if mg is not None:
+                    from orientdb_tpu.parallel.mesh_graph import (
+                        sharded_weight_pass,
+                    )
+
+                    p = mg.edge[cname].prefix
+                    src_sh = self.dg.arrays[f"{p}:el:src"]
+                    dst_sh = self.dg.arrays[f"{p}:el:dst"]
+                    eid_sh = self.dg.arrays[f"{p}:el:eid"]
+                    seg_sh, emit_sh = (
+                        (src_sh, dst_sh) if d == "out" else (dst_sh, src_sh)
+                    )
+                    new_w = new_w + sharded_weight_pass(
+                        mg.mesh,
+                        seg_sh,
+                        emit_sh,
+                        eid_sh,
+                        emask,
+                        ok_vec,
+                        w if w is not None else jnp.ones(vb, dtype),
+                        vb,
+                    )
+                    continue
+                # both CSR orders exist in HBM, so either direction
+                # sums via cumsum+boundary-gather (indptr_segment_sum)
+                # instead of the ~7x-costlier TPU scatter-add; the
+                # in-direction reorders the out-order edge mask
+                # through the in-CSR's edge-id map first
+                if d == "out":
+                    emit, ip = dec.dst, dec.indptr_out
+                    em = emask
+                else:
+                    emit, ip = dec.src, dec.indptr_in
+                    em = jnp.take(emask, dec.edge_id_in)
+                contrib = em & node_mask(emit)
+                vals = contrib.astype(dtype)
+                if w is not None:
+                    vals = vals * K.take_pad(w, emit, dtype(0))
+                new_w = new_w + K.indptr_segment_sum(vals, ip, vb)
+        return new_w
 
     def _root_candidates(self, alias: str):
         """Candidate scan for a root alias, restricted to the dense-index
@@ -3127,9 +3166,14 @@ def _record(db, stmt, params):
     (``arg_keys``), so lazily pruned columns uploading later never
     change a cached plan's pytree structure — and a plan ships only the
     graph arrays it actually uses to its executable."""
+    from orientdb_tpu.obs.trace import span as _span
+
     stmt, element_alias = _translate(stmt)
     snap = db.current_snapshot(require_fresh=True)
-    dg = device_graph(snap)
+    with _span("tpu.load"):
+        # snapshot → HBM upload (CSR + referenced columns); a warm cache
+        # makes this span ~free, a cold one shows the real upload cost
+        dg = device_graph(snap)
     with _TRACE_LOCK:
         dg.start_touch_log()
         try:
@@ -3137,13 +3181,17 @@ def _record(db, stmt, params):
                 solver = TpuMatchSolver(
                     db, stmt, params, element_alias=element_alias
                 )
-                table = solver.solve_table()
-                rows = solver.rows_from_table(table)
+                with _span("tpu.solve"):
+                    table = solver.solve_table()
+                with _span("tpu.marshal"):
+                    rows = solver.rows_from_table(table)
                 plan: object = _CompiledPlan(solver, table)
             else:
                 tsolver = TpuTraverseSolver(db, stmt, params)
-                idx, total = tsolver.solve()
-                rows = tsolver.rows_from(np.asarray(idx), total)
+                with _span("tpu.solve"):
+                    idx, total = tsolver.solve()
+                with _span("tpu.marshal"):
+                    rows = tsolver.rows_from(np.asarray(idx), total)
                 plan = _CompiledTraverse(tsolver, total)
         finally:
             keys = dg.stop_touch_log()
@@ -3609,46 +3657,74 @@ def profile_execute(db, stmt, params) -> Tuple[List[Result], Dict]:
     """Execute on the compiled path with per-phase wall timings — the
     observability PROFILE needs to attack dispatch overhead (SURVEY.md
     §5.1; the whole device solve is ONE fused dispatch, so phases — not
-    per-step device kernels — are the honest breakdown)."""
+    per-step device kernels — are the honest breakdown).
+
+    Also traces: the returned phases carry ``traceId`` and ``spans`` —
+    per-hop TPU-engine stage spans (``tpu.load``/``tpu.step``/
+    ``tpu.marshal``). A replay is one fused dispatch with no per-hop
+    boundary, so PROFILE re-solves eagerly under the tracer to produce
+    them; PROFILE is an explicitly-requested diagnostic, so paying one
+    extra eager execution for real timings is the honest trade."""
     import time as _time
+
+    from orientdb_tpu.obs.trace import span as _span, tracer as _tracer
 
     if db.tx is not None:
         # same guard as engine._run: the snapshot cannot see the tx overlay
         raise Uncompilable("active transaction on this thread")
     phases: Dict[str, object] = {}
-    t0 = _time.perf_counter()
-    variants, rows, _fresh = _prepare(db, stmt, params)
-    phases["prepareUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
-    if variants is None:
-        # recording first execution: eager, one blocking sync per observe
-        phases["mode"] = "record"
-        return rows, phases
-    plan = variants.pick(params)
-    phases["mode"] = "replay"
-    phases["variants"] = len(variants.plans)
-    t0 = _time.perf_counter()
-    plan.wait_compiled()  # keep a pending AOT compile out of dispatchUs
-    phases["compileWaitUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
-    t0 = _time.perf_counter()
-    dev = plan.dispatch(params or {})
-    phases["dispatchUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
-    t0 = _time.perf_counter()
-    jax.block_until_ready(dev)
-    phases["deviceUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
-    t0 = _time.perf_counter()
-    try:
-        rows = plan.materialize(dev, params or {})
-        variants.remember(params, plan)
-    except ScheduleOverflow:
-        rows = _run_variants(db, stmt, params, variants, tried=plan)
-        phases["mode"] = "overflow-variant"
-    phases["fetchMarshalUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
-    solver = plan.solver
-    sched = getattr(solver, "sched", None)
-    if sched is not None:
-        phases["scheduleObserves"] = len(sched.values)
-        phases["scheduleSizes"] = sched.values[:32]
-    steps = getattr(solver, "plan", None)
-    if steps:
-        phases["steps"] = [s.describe() for s in steps]
+    with _span("profile", statement=type(stmt).__name__) as root:
+        t0 = _time.perf_counter()
+        variants, rows, _fresh = _prepare(db, stmt, params)
+        phases["prepareUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+        if variants is None:
+            # recording first execution: eager, one blocking sync per
+            # observe — the per-hop spans came from solve_table just now
+            phases["mode"] = "record"
+        else:
+            plan = variants.pick(params)
+            phases["mode"] = "replay"
+            phases["variants"] = len(variants.plans)
+            t0 = _time.perf_counter()
+            plan.wait_compiled()  # keep a pending AOT compile out of dispatchUs
+            phases["compileWaitUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+            t0 = _time.perf_counter()
+            with _span("tpu.dispatch"):
+                dev = plan.dispatch(params or {})
+            phases["dispatchUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+            t0 = _time.perf_counter()
+            with _span("tpu.device"):
+                jax.block_until_ready(dev)
+            phases["deviceUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
+            t0 = _time.perf_counter()
+            with _span("tpu.marshal"):
+                try:
+                    rows = plan.materialize(dev, params or {})
+                    variants.remember(params, plan)
+                except ScheduleOverflow:
+                    rows = _run_variants(db, stmt, params, variants, tried=plan)
+                    phases["mode"] = "overflow-variant"
+            phases["fetchMarshalUs"] = round(
+                (_time.perf_counter() - t0) * 1e6, 1
+            )
+            solver = plan.solver
+            sched = getattr(solver, "sched", None)
+            if sched is not None:
+                phases["scheduleObserves"] = len(sched.values)
+                phases["scheduleSizes"] = sched.values[:32]
+            steps = getattr(solver, "plan", None)
+            if steps:
+                phases["steps"] = [s.describe() for s in steps]
+            # the replay has no per-hop boundaries: re-solve eagerly under
+            # the tracer so the spans show real per-hop stage timings
+            try:
+                _record(db, stmt, params)
+            except Exception as e:  # noqa: BLE001 - diagnostic only
+                # rows are already computed; a failing diagnostic
+                # re-solve must not fail the PROFILE itself
+                phases["traceError"] = f"{type(e).__name__}: {e}"
+    phases["traceId"] = root.trace_id
+    phases["spans"] = [
+        s.to_dict() for s in _tracer.spans(trace_id=root.trace_id)
+    ]
     return rows, phases

@@ -148,7 +148,15 @@ class Transaction:
             {
                 "kind": "update",
                 "rid": key,
-                "base_version": doc.version,
+                # the MVCC base is the version this tx READ: for a shared
+                # store object mutated in place that is the touch()-time
+                # preimage version — a replication apply bumping the
+                # object between read and save must conflict at the
+                # owner, not silently win (ADVICE r5; mirrors
+                # ForwardedTransaction.save)
+                "base_version": self._preimages.get(
+                    doc.rid, (None, doc.version)
+                )[1],
                 "fields": self._enc_fields(doc),
             }
         )
@@ -227,7 +235,18 @@ class Transaction:
                 fb["ops"] = [o for o in fb["ops"] if o is not op]
                 self.workspace.pop(rid, None)
                 return
-            fb["ops"].append({"kind": "delete", "rid": key})
+            # the delete ships the version this tx read so the owner's
+            # execute_tx_ops MVCC-checks it — matching the local
+            # _commit_locked path (ADVICE r5)
+            fb["ops"].append(
+                {
+                    "kind": "delete",
+                    "rid": key,
+                    "base_version": self._preimages.get(
+                        rid, (None, doc.version)
+                    )[1],
+                }
+            )
             self._foreign_deleted.add(rid)
             self.workspace.pop(rid, None)
             return
@@ -336,14 +355,28 @@ class Transaction:
                         f"class '{doc.class_name}' is owned by another "
                         "member; buffered locally by mistake"
                     )
+        from orientdb_tpu.obs.trace import span
+
         if self._foreign:
-            return self._commit_distributed(db)
+            with span(
+                "tx.commit",
+                distributed=True,
+                owners=len(self._foreign),
+            ):
+                return self._commit_distributed(db)
         try:
             # quorum pushes deferred during the locked apply (the
             # atomic tx entry) ship once the db-wide lock is free
-            with db._quorum_deferral():
-                with db._lock:
-                    return self._commit_locked(db)
+            with span(
+                "tx.commit",
+                creates=len(self.created),
+                edges=len(self.edge_ops),
+                updates=len(self.dirty),
+                deletes=len(self.deleted),
+            ):
+                with db._quorum_deferral():
+                    with db._lock:
+                        return self._commit_locked(db)
         except Exception:
             # a failed commit invalidates the tx (the reference rolls the
             # whole transaction back on OConcurrentModificationException /

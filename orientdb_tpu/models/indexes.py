@@ -494,10 +494,14 @@ class IndexManager:
 
     # -- hooks wired from Database.save/delete -----------------------------
 
-    def validate_save(self, doc: Document, rid_hint=None) -> None:
+    def validate_save(self, doc: Document, rid_hint=None, exclude_rids=()) -> None:
         """Raise DuplicateKeyError BEFORE any store/index mutation if saving
         ``doc`` would violate a unique index (two-phase validate-then-apply:
-        keeps store and indexes consistent on constraint failure)."""
+        keeps store and indexes consistent on constraint failure).
+
+        ``exclude_rids``: holders to ignore — records a pending batch
+        deletes/rewrites before this doc applies (2PC phase-1 validation
+        of a delete-then-recreate batch must not see the doomed holder)."""
         rid = rid_hint if rid_hint is not None else doc.rid
         for idx in self._applicable(doc):
             if not idx.unique:
@@ -505,12 +509,26 @@ class IndexManager:
             key = idx._key_of(doc)
             if key is None:
                 continue
-            holders = idx.get(key) - {rid}
+            holders = idx.get(key) - {rid} - set(exclude_rids)
             if holders:
                 raise DuplicateKeyError(
                     f"index '{idx.name}': key {key!r} already mapped to "
                     f"{next(iter(holders))}"
                 )
+
+    def unique_keys_of(self, doc: Document) -> List[tuple]:
+        """The ``(index_name, key)`` pairs ``doc`` would claim in unique
+        indexes — lets 2PC phase-1 detect two staged creates in one
+        batch fighting over the same key (neither is a holder yet, so
+        validate_save alone cannot see the collision)."""
+        out = []
+        for idx in self._applicable(doc):
+            if not idx.unique:
+                continue
+            key = idx._key_of(doc)
+            if key is not None:
+                out.append((idx.name, key))
+        return out
 
     def on_save(self, doc: Document) -> None:
         for idx in self._applicable(doc):

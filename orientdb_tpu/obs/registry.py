@@ -1,0 +1,173 @@
+"""Histogram metrics + Prometheus-style text exposition.
+
+Extends the process registry (``utils/metrics.py``: counters, gauges,
+duration stats — the [E] OProfiler analog) with two things the serving
+story needs:
+
+- **histograms** — bucketed distributions (query latency, WAL fsync
+  latency, frontier sizes) whose tails survive aggregation, unlike the
+  count/total/max duration stats;
+- **exposition** — :func:`render_prometheus` renders the ENTIRE
+  registry (counters → ``_total`` counters, gauges → gauges, duration
+  stats → summaries, histograms → classic cumulative-bucket
+  histograms) in the Prometheus text format (version 0.0.4), served by
+  the HTTP listener at ``GET /metrics``.
+
+Metric names keep their internal dotted form in code
+(``wal.append_s``) and sanitize to Prometheus identifiers on render
+(``orienttpu_wal_append_s``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: default latency buckets (seconds): 100 µs … 10 s, roughly 1-2.5-5
+_LATENCY_BUCKETS = (
+    0.0001,
+    0.00025,
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
+)
+
+#: default size buckets (rows/bytes): pow4 ladder
+_SIZE_BUCKETS = tuple(float(4**i) for i in range(1, 13))
+
+
+class Histogram:
+    """Cumulative-bucket histogram (thread-safe)."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, buckets: Iterable[float]) -> None:
+        self.name = name
+        self.buckets: Tuple[float, ...] = tuple(sorted(set(buckets)))
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._count
+        cum, out = 0, {}
+        for le, c in zip(self.buckets, counts):
+            cum += c
+            out[le] = cum
+        return {"buckets": out, "sum": total, "count": n}
+
+
+class ObsRegistry:
+    """Process-wide histogram registry (counters/gauges/durations stay
+    in ``utils.metrics.metrics``; this adds only what it lacks)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hist: Dict[str, Histogram] = {}
+
+    def histogram(
+        self, name: str, buckets: Optional[Iterable[float]] = None
+    ) -> Histogram:
+        with self._lock:
+            h = self._hist.get(name)
+            if h is None:
+                h = self._hist[name] = Histogram(
+                    name, buckets or _LATENCY_BUCKETS
+                )
+            return h
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        buckets: Optional[Iterable[float]] = None,
+    ) -> None:
+        self.histogram(name, buckets).observe(value)
+
+    def observe_size(self, name: str, value: float) -> None:
+        """Observe into a pow4 size ladder (rows, bytes)."""
+        self.histogram(name, _SIZE_BUCKETS).observe(value)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            hists = list(self._hist.values())
+        return {h.name: h.snapshot() for h in hists}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hist.clear()
+
+
+#: the process-wide instance (mirrors utils.metrics.metrics)
+obs = ObsRegistry()
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return "orienttpu_" + _NAME_RE.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus() -> str:
+    """The whole process registry in Prometheus text format 0.0.4."""
+    from orientdb_tpu.utils.metrics import metrics
+
+    snap = metrics.snapshot()
+    lines: List[str] = []
+    for name, v in sorted(snap["counters"].items()):
+        m = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(v)}")
+    for name, v in sorted(snap["gauges"].items()):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(v)}")
+    for name, d in sorted(snap["durations"].items()):
+        # count/total/max duration stats render as a summary plus a
+        # companion _max gauge (Prometheus summaries carry no max)
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} summary")
+        lines.append(f"{m}_count {_fmt(d['count'])}")
+        lines.append(f"{m}_sum {_fmt(d['total_s'])}")
+        lines.append(f"# TYPE {m}_max gauge")
+        lines.append(f"{m}_max {_fmt(d['max_s'])}")
+    for name, h in sorted(obs.snapshot().items()):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        for le, cum in h["buckets"].items():
+            lines.append(f'{m}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{m}_sum {_fmt(h['sum'])}")
+        lines.append(f"{m}_count {h['count']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,73 @@
+"""Slow-query log: a bounded ring of queries over the threshold.
+
+Analog of the reference's command profiling + the classic database
+slow-query log ([E] OProfiler records per-command chronos; operators
+watch the tail). Queries slower than ``config.slow_query_ms`` land in
+a process-wide ring with their SQL, engine, duration, and trace id —
+the console surfaces it (``SLOWLOG``), and every recorded entry bumps
+the ``slowlog.recorded`` counter so /metrics shows the rate.
+
+``slow_query_ms = 0`` disables recording entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("slowlog")
+
+
+class SlowQueryLog:
+    def __init__(self, capacity: int) -> None:
+        self._lock = threading.Lock()
+        self._entries: deque = deque(maxlen=max(capacity, 8))
+
+    def record(
+        self,
+        sql: str,
+        duration_s: float,
+        engine: str,
+        trace_id: Optional[str] = None,
+    ) -> bool:
+        """Record ``sql`` if it crossed the threshold; returns whether
+        it did. Reads the threshold per call so tests (and a live
+        console) can retune without restarting."""
+        threshold_ms = config.slow_query_ms
+        ms = duration_s * 1000.0
+        if threshold_ms <= 0 or ms < threshold_ms:
+            return False
+        entry = {
+            "ts": time.time(),
+            "sql": sql,
+            "ms": round(ms, 2),
+            "engine": engine,
+            "trace_id": trace_id,
+        }
+        with self._lock:
+            self._entries.append(entry)
+        from orientdb_tpu.utils.metrics import metrics
+
+        metrics.incr("slowlog.recorded")
+        log.info("slow query (%.1f ms, %s): %s", ms, engine, sql)
+        return True
+
+    def entries(self, limit: Optional[int] = None) -> List[Dict]:
+        """Most recent first."""
+        with self._lock:
+            items = list(self._entries)
+        items.reverse()
+        return items if limit is None else items[:limit]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+#: the process-wide instance (sized by config.slowlog_capacity)
+slowlog = SlowQueryLog(config.slowlog_capacity)

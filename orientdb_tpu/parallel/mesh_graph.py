@@ -37,7 +37,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from orientdb_tpu.parallel.shard_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from orientdb_tpu.ops import csr as K

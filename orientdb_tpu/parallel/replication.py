@@ -94,8 +94,12 @@ def apply_pushed_entries(
     still-empty replica fully up to date synchronously instead of
     waiting a pull interval. A non-fresh replica refuses it (restoring
     over applied state would lose writes) and stays puller territory."""
+    from orientdb_tpu.obs.trace import span
+
     dblock = db.__dict__.setdefault("_repl_lock", threading.Lock())
-    with dblock:
+    with span(
+        "replication.apply", source="push", entries=len(entries)
+    ), dblock:
         if term is not None:
             cur = getattr(db, "_repl_term", 0)
             if term < cur:
@@ -357,7 +361,11 @@ def entries_after(
         return {"checkpoint": payload, "entries": [], "lsn": upto}
     out = [e for e in entries if e["lsn"] > from_lsn][:limit]
     last = out[-1]["lsn"] if out else from_lsn
-    return {"entries": out, "lsn": last}
+    # head_lsn is the SOURCE's true tail, past this limit window — the
+    # replica's lag gauge needs it (lsn alone reads as "caught up" the
+    # moment the replica applies a truncated window)
+    head = entries[-1]["lsn"] if entries else last
+    return {"entries": out, "lsn": last, "head_lsn": head}
 
 
 class ReplicaPuller:
@@ -459,8 +467,14 @@ class ReplicaPuller:
         # stopper may hold a lock its loop is blocked on) can race its last
         # in-flight pull against the replacement puller on the same db, and
         # per-puller applied_lsn alone would double-apply the overlap
+        from orientdb_tpu.obs.trace import span
+
         dblock = self.db.__dict__.setdefault("_repl_lock", threading.Lock())
-        with self._lock, dblock:
+        with span(
+            "replication.apply",
+            source="pull",
+            entries=len(payload.get("entries", ())),
+        ), self._lock, dblock:
             if self._stop.is_set():
                 # request_stop is an apply BARRIER: once the stopper has
                 # acquired this db's apply lock after setting the flag, no
@@ -551,6 +565,14 @@ class ReplicaPuller:
                     self.db._tx_local.suppress_wal = False
         if applied:
             metrics.incr("replication.applied", applied)
+        # lag vs the SOURCE's head LSN (entries past this pull's limit
+        # window; 0 when fully caught up) — the /metrics replication
+        # signal. Older sources omit head_lsn; fall back to the window.
+        head = payload.get("head_lsn", payload.get("lsn", 0))
+        metrics.gauge(
+            "replication.lag_entries", max(0, head - self.applied_lsn)
+        )
+        metrics.gauge("replication.applied_lsn", self.applied_lsn)
         return applied
 
     def _db_floor(self) -> int:

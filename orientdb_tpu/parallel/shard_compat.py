@@ -1,0 +1,25 @@
+"""jax.shard_map compatibility shim.
+
+Newer jax exports ``shard_map`` at top level with a ``check_vma``
+kwarg; older releases (e.g. 0.4.x) only have
+``jax.experimental.shard_map.shard_map`` whose equivalent kwarg is
+``check_rep``. Import :func:`shard_map` from here so the sharded
+execution plane runs on either.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.4.31 area: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # older jax: the experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f, **kw):
+    if _LEGACY and "check_vma" in kw:
+        kw["check_rep"] = kw.pop("check_vma")
+    return _shard_map(f, **kw)

@@ -29,8 +29,9 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from orientdb_tpu.parallel.shard_compat import shard_map
 
 from orientdb_tpu.storage.snapshot import GraphSnapshot
 from orientdb_tpu.utils.config import config

@@ -176,6 +176,20 @@ def execute_tx_ops(
             elif kind == "delete":
                 cur = db.load(RID.parse(op["rid"]))
                 if cur is not None:
+                    base = op.get("base_version")
+                    if base is not None and cur.version != base:
+                        # a forwarded delete carries the version its tx
+                        # read: deleting over a concurrent update would
+                        # be a lost update — conflict, matching the
+                        # local _commit_locked path (ADVICE r5)
+                        from orientdb_tpu.models.database import (
+                            ConcurrentModificationError,
+                        )
+
+                        raise ConcurrentModificationError(
+                            f"{op['rid']}: stored v{cur.version} != "
+                            f"base v{base}"
+                        )
                     db.delete(cur)
                 results.append(None)
             else:
@@ -249,9 +263,29 @@ class TwoPhaseRegistry:
         with self._mu:
             if txid in self._staged:
                 raise TwoPhaseError(f"tx {txid} already prepared here")
+            # rids this batch rewrites before its creates apply: their
+            # unique keys are released (or re-checked at apply), so the
+            # phase-1 probe must not count them as conflicting holders
+            # (delete-then-recreate of a unique key is a valid batch)
+            batch_writes = {
+                RID.parse(op["rid"])
+                for op in ops
+                if op.get("kind") in ("update", "delete") and "rid" in op
+            }
+            claimed: set = set()  # unique keys staged creates claim
             with db._lock:
                 for op in ops:
-                    if op.get("kind") != "update":
+                    kind = op.get("kind")
+                    if kind in ("create", "edge"):
+                        # deterministic constraint checks belong in
+                        # phase 1: a schema/unique violation that only
+                        # surfaced at phase-2 commit would turn a clean
+                        # abort into TxInDoubtError (ADVICE r5)
+                        self._validate_staged_create(
+                            op, batch_writes, claimed
+                        )
+                        continue
+                    if kind != "update":
                         continue
                     rid = RID.parse(op["rid"])
                     cur = db._load_raw(rid)
@@ -317,6 +351,44 @@ class TwoPhaseRegistry:
         if st is not None:
             self._release(st)
             metrics.incr("tx2pc.abort")
+
+    def _validate_staged_create(
+        self, op: Dict, batch_writes=(), claimed=None
+    ) -> None:
+        """Class validation + unique-index probe for a staged create/
+        edge op (caller holds db._lock). Raises ValueError /
+        DuplicateKeyError so a doomed batch aborts in phase 1 with
+        nothing locked or applied anywhere. ``batch_writes``: rids the
+        same batch updates/deletes — excluded from the unique probe.
+        ``claimed``: unique keys earlier creates in this batch claimed —
+        two creates fighting over one key are invisible to the holder
+        probe (neither is indexed yet) but equally deterministic."""
+        from orientdb_tpu.models.indexes import DuplicateKeyError
+        from orientdb_tpu.models.record import Document, Edge, Vertex
+        from orientdb_tpu.storage.durability import _dec
+
+        db = self.db
+        fields = {k: _dec(v) for k, v in op.get("fields", {}).items()}
+        class_name = op.get("class", "")
+        cls = db.schema.get_class(class_name)
+        if cls is not None:
+            cls.validate(fields)
+        if db._indexes is not None:
+            if op.get("kind") == "edge":
+                probe: Document = Edge(class_name, fields)
+            elif op.get("type") == "vertex":
+                probe = Vertex(class_name, fields)
+            else:
+                probe = Document(class_name, fields)
+            db._indexes.validate_save(probe, exclude_rids=batch_writes)
+            for tag in db._indexes.unique_keys_of(probe):
+                if claimed is not None and tag in claimed:
+                    raise DuplicateKeyError(
+                        f"index '{tag[0]}': key {tag[1]!r} claimed by "
+                        "two creates in one batch"
+                    )
+                if claimed is not None:
+                    claimed.add(tag)
 
     # -- bookkeeping ---------------------------------------------------------
 

@@ -217,11 +217,39 @@ class _Handler(BaseHTTPRequestHandler):
                     200, {"databases": sorted(self.server.ot_server.databases)}
                 )
             if head == "metrics":
-                # the [E] /profiler analog (SURVEY.md §5.1/§5.5): process
-                # counters + duration stats as JSON
-                from orientdb_tpu.utils.metrics import metrics
+                # the [E] /profiler analog (SURVEY.md §5.1/§5.5):
+                # Prometheus text exposition by default (scrapeable);
+                # ?format=json or Accept: application/json keeps the
+                # raw registry snapshot for programmatic readers
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                accept = self.headers.get("Accept", "")
+                if "json" in q.get("format", []) or (
+                    "application/json" in accept
+                ):
+                    from orientdb_tpu.obs.registry import obs
+                    from orientdb_tpu.utils.metrics import metrics
 
-                return self._send(200, metrics.snapshot())
+                    return self._send(
+                        200,
+                        {
+                            **metrics.snapshot(),
+                            "histograms": obs.snapshot(),
+                        },
+                    )
+                from orientdb_tpu.obs.registry import render_prometheus
+
+                body = render_prometheus().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if head == "replication" and len(rest) == 2:
                 # WAL shipping for replicas ([E] the distributed delta-sync
                 # request); admin-only — the stream exposes every record

@@ -148,7 +148,7 @@ async function run() {
   }
 }
 async function showMetrics() {
-  const d = await api("/metrics");
+  const d = await api("/metrics?format=json");
   const rows = Object.entries(d.counters || {})
     .map(([k, v]) => ({metric: k, value: v}))
     .concat(Object.entries(d.durations || {}).map(([k, v]) =>

@@ -1054,6 +1054,15 @@ class Parser:
                     raise ParseError("expected new class name", t)
                 return A.AlterClassStatement(cls, attr, t.value)
             if attr == "ADDCLUSTER":
+                if self.peek().kind == "NUMBER":
+                    # the reference accepted numeric cluster ids; here
+                    # ids are engine-assigned — reject with the reason
+                    # instead of a trailing-token ParseError at EOF
+                    raise ParseError(
+                        "ADDCLUSTER takes a cluster NAME: cluster ids "
+                        "are assigned automatically",
+                        self.peek(),
+                    )
                 name = (
                     self.eat_ident()
                     if self.peek().kind == "IDENT"

@@ -166,6 +166,22 @@ class WriteAheadLog:
         return self._native
 
     def append(self, entry: Dict) -> int:
+        import time as _time
+
+        from orientdb_tpu.obs.trace import span
+
+        t0 = _time.perf_counter()
+        with span("wal.append", fsync=bool(self.fsync)) as sp:
+            lsn = self._append_inner(entry)
+            sp.set("lsn", lsn)
+        # the whole append — including the (group-commit) fsync wait —
+        # is the durability latency a committer pays
+        from orientdb_tpu.obs.registry import obs
+
+        obs.observe("wal.append_s", _time.perf_counter() - t0)
+        return lsn
+
+    def _append_inner(self, entry: Dict) -> int:
         gen = None
         with self._lock:
             # a close() in progress is draining the native flusher; new

@@ -74,11 +74,16 @@ class PropertyColumn:
         marshalling decodes string codes per QUERY, and re-converting a
         10^4-entry Python list each time dominated IS1-style host time
         at sf10 scale."""
+        from orientdb_tpu.utils.metrics import metrics
+
         a = self._dict_arr
         if a is None:
+            metrics.incr("snapshot.dict_array.miss")
             a = self._dict_arr = np.asarray(
                 self.dictionary if self.dictionary else [""], object
             )
+        else:
+            metrics.incr("snapshot.dict_array.hit")
         return a
 
     def encode(self, value) -> Optional[np.int32]:

@@ -227,6 +227,32 @@ class Console(cmd.Cmd):
         except Exception as e:
             self._p(f"!! {type(e).__name__}: {e}")
 
+    def do_slowlog(self, arg: str) -> None:
+        """SLOWLOG [<n>|CLEAR] — recent slow queries (most recent
+        first; threshold = config.slow_query_ms, 0 disables)."""
+        from orientdb_tpu.obs.slowlog import slowlog
+        from orientdb_tpu.utils.config import config
+
+        a = arg.strip().lower()
+        if a == "clear":
+            slowlog.clear()
+            self._p("slowlog cleared")
+            return
+        limit = int(a) if a.isdigit() else 20
+        entries = slowlog.entries(limit)
+        if not entries:
+            self._p(
+                "slowlog empty "
+                f"(threshold {config.slow_query_ms:g} ms; 0 = disabled)"
+            )
+            return
+        for e in entries:
+            trace = f" trace={e['trace_id']}" if e.get("trace_id") else ""
+            self._p(
+                f"{e['ms']:>9.1f} ms  [{e['engine']}]{trace}  {e['sql']}"
+            )
+        self._p(f"({len(entries)} entries)")
+
     def do_quit(self, _arg: str) -> bool:
         return True
 

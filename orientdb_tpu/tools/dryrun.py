@@ -129,7 +129,22 @@ def run_body(n_devices: int) -> None:
     def canon(rows):
         return sorted(tuple(sorted(r.items())) for r in rows)
 
-    for sql, params in QUERIES:
+    # crash-safe evidence (obs/evidence, same stream discipline as
+    # bench.py): a driver timeout mid-corpus still leaves every
+    # completed query's parity verdict on disk. ORIENTTPU_EVIDENCE
+    # overrides the path.
+    import time as _time
+
+    from orientdb_tpu.obs.evidence import evidence_sink
+
+    sink = evidence_sink(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            "MULTICHIP_EVIDENCE.jsonl",
+        )
+    )
+    for i, (sql, params) in enumerate(QUERIES):
+        t0 = _time.perf_counter()
         recorded = canon(
             db.query(sql, params=params, engine="tpu", strict=True).to_dicts()
         )
@@ -139,6 +154,17 @@ def run_body(n_devices: int) -> None:
         oracle = canon(db.query(sql, params=params, engine="oracle").to_dicts())
         assert recorded == oracle, f"record-run parity broke: {sql}"
         assert replayed == oracle, f"sharded replay parity broke: {sql}"
+        if sink is not None:
+            sink.emit(
+                "dryrun_query",
+                {
+                    "i": i,
+                    "sql": sql[:80],
+                    "rows": len(oracle),
+                    "parity": "ok",
+                    "s": round(_time.perf_counter() - t0, 3),
+                },
+            )
 
     # config-5 shape (BASELINE configs[4]): multi-class + EDGE property
     # column + multi-pattern edge-property WHERE, sharded on the same
@@ -163,6 +189,11 @@ def run_body(n_devices: int) -> None:
             q5, params={"d": d}, engine="tpu", strict=True
         ).to_dicts()
         assert got == [{"n": want}], f"sharded config5 parity broke: d={d}"
+    if sink is not None:
+        sink.emit(
+            "dryrun_done",
+            {"mesh": dict(mesh.shape), "queries": len(QUERIES) + 1},
+        )
     print(
         f"dryrun_multichip ok: mesh {dict(mesh.shape)}, "
         f"{len(QUERIES)} MATCH/SELECT queries + config5 edge-property-"

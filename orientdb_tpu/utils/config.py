@@ -127,6 +127,14 @@ class GlobalConfiguration:
     # Logging level for get_logger default.
     log_level: str = "WARNING"
 
+    # Observability (orientdb_tpu/obs): queries slower than this many
+    # milliseconds enter the slow-query log (0 disables); the ring keeps
+    # the most recent slowlog_capacity entries, and the span tracer keeps
+    # the most recent trace_capacity finished spans.
+    slow_query_ms: float = 1000.0
+    slowlog_capacity: int = 256
+    trace_capacity: int = 4096
+
     # WAL / durability for the host record store
     # (orientdb_tpu.storage.durability): when wal_enabled and wal_dir are
     # set, server-created databases recover-or-create durably under

@@ -17,6 +17,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import tempfile
+
+# dryrun evidence (obs/evidence) defaults to a repo-root JSONL for the
+# driver; tests redirect it so suite runs never dirty the worktree
+os.environ.setdefault(
+    "ORIENTTPU_EVIDENCE",
+    os.path.join(tempfile.gettempdir(), "orienttpu-test-evidence.jsonl"),
+)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,214 @@
+"""ADVICE r5 regressions (ISSUE 1 satellites): cross-owner MVCC bases
+ship the version the tx actually READ, deterministic constraint
+violations abort 2PC in phase 1, foreign deletes are MVCC-checked, and
+ALTER CLASS ADDCLUSTER rejects numeric ids with the real reason."""
+
+import pytest
+
+from orientdb_tpu.models.database import (
+    ConcurrentModificationError,
+    Database,
+)
+from orientdb_tpu.models.indexes import DuplicateKeyError
+from orientdb_tpu.models.schema import PropertyType
+from orientdb_tpu.parallel.twophase import (
+    LocalRegistryParticipant,
+    execute_tx_ops,
+    get_registry,
+    run_coordinator,
+)
+from orientdb_tpu.sql.parser import ParseError, parse
+
+
+class _FakeOwner:
+    """Stands in for a WriteOwner: ops must buffer, never ship, before
+    commit — any wire call in these tests is a bug."""
+
+    def __getattr__(self, name):  # pragma: no cover - defensive
+        raise AssertionError(f"unexpected owner call: {name}")
+
+
+class TestForeignSaveBaseVersion:
+    """exec/tx.py::_foreign_save must ship the touch()-time preimage
+    version, not the (possibly apply-bumped) live one — mirroring the
+    ForwardedTransaction fix."""
+
+    def test_update_op_carries_preimage_version(self):
+        db = Database("advr5_a")
+        doc = db.new_element("Q", uid=1)
+        v0 = doc.version
+        db._class_owners["q"] = _FakeOwner()
+        t = db.begin()
+        try:
+            # scan-path shared store object mutated in place: touch()
+            # captures (fields, v0) before the first write
+            doc.set("uid", 2)
+            # a replication apply lands between read and save, bumping
+            # the shared object's version
+            doc.version = v0 + 3
+            db.save(doc)
+            batch = next(iter(t._foreign.values()))
+            op = next(o for o in batch["ops"] if o["kind"] == "update")
+            # without the preimage base the op would ship v0+3 and the
+            # owner's MVCC check would silently bless a lost update
+            assert op["base_version"] == v0
+        finally:
+            t.rollback()
+
+    def test_clean_doc_still_ships_read_version(self):
+        db = Database("advr5_b")
+        doc = db.new_element("Q", uid=1)
+        db._class_owners["q"] = _FakeOwner()
+        t = db.begin()
+        try:
+            d = db.load(doc.rid)  # tx clone, version frozen at read
+            d.set("uid", 5)
+            db.save(d)
+            batch = next(iter(t._foreign.values()))
+            op = next(o for o in batch["ops"] if o["kind"] == "update")
+            assert op["base_version"] == doc.version
+        finally:
+            t.rollback()
+
+
+class TestForeignDeleteMvcc:
+    """exec/tx.py foreign deletes carry base_version; execute_tx_ops
+    MVCC-checks it like the local _commit_locked path."""
+
+    def test_delete_op_carries_base_version(self):
+        db = Database("advr5_c")
+        doc = db.new_element("Q", uid=1)
+        v0 = doc.version
+        db._class_owners["q"] = _FakeOwner()
+        t = db.begin()
+        try:
+            d = db.load(doc.rid)
+            db.delete(d)
+            batch = next(iter(t._foreign.values()))
+            op = next(o for o in batch["ops"] if o["kind"] == "delete")
+            assert op["base_version"] == v0
+        finally:
+            t.rollback()
+
+    def test_execute_tx_ops_checks_delete_base(self):
+        db = Database("advr5_d")
+        doc = db.new_element("P", uid=1)
+        stale = doc.version
+        doc.set("uid", 2)
+        db.save(doc)  # bumps the stored version past `stale`
+        rid = str(doc.rid)
+        with pytest.raises(ConcurrentModificationError):
+            execute_tx_ops(
+                db, [{"kind": "delete", "rid": rid, "base_version": stale}]
+            )
+        assert db.load(doc.rid) is not None  # nothing applied
+        results, _tm = execute_tx_ops(
+            db,
+            [{"kind": "delete", "rid": rid, "base_version": doc.version}],
+        )
+        assert results == [{}]
+        assert db.load(doc.rid) is None
+
+    def test_versionless_delete_still_applies(self):
+        # wire compatibility: an op from an older forwarder carries no
+        # base_version and keeps last-writer-wins semantics
+        db = Database("advr5_e")
+        doc = db.new_element("P", uid=1)
+        execute_tx_ops(db, [{"kind": "delete", "rid": str(doc.rid)}])
+        assert db.load(doc.rid) is None
+
+
+class TestPrepareValidatesCreates:
+    """parallel/twophase.py::TwoPhaseRegistry.prepare runs class and
+    unique-index validation over staged creates, so deterministic
+    violations abort in phase 1 instead of becoming TxInDoubtError."""
+
+    @staticmethod
+    def _create_op(cls, temp="#-1:-2", **fields):
+        return {
+            "kind": "create",
+            "type": "vertex",
+            "class": cls,
+            "temp": temp,
+            "fields": fields,
+        }
+
+    def test_unique_violation_rejected_at_prepare(self):
+        db = Database("advr5_f")
+        cls = db.schema.create_vertex_class("P")
+        cls.create_property("uid", PropertyType.LONG)
+        db.command("CREATE INDEX P.uid UNIQUE")
+        db.new_vertex("P", uid=1)
+        reg = get_registry(db)
+        with pytest.raises(DuplicateKeyError):
+            reg.prepare("txu", [self._create_op("P", uid=1)])
+        assert db._tx2pc_locks == {}
+        assert "txu" not in reg._staged
+        # a non-conflicting key prepares fine
+        reg.prepare("txu", [self._create_op("P", uid=2)])
+        reg.abort("txu")
+
+    def test_two_creates_same_key_rejected_at_prepare(self):
+        """Neither create is a holder yet, so the holder probe alone
+        passes both — the claimed-key set must catch the collision in
+        phase 1 instead of letting phase 2 in-doubt the batch."""
+        db = Database("advr5_f2")
+        cls = db.schema.create_vertex_class("P")
+        cls.create_property("uid", PropertyType.LONG)
+        db.command("CREATE INDEX P.uid UNIQUE")
+        reg = get_registry(db)
+        with pytest.raises(DuplicateKeyError, match="two creates"):
+            reg.prepare(
+                "txdup",
+                [
+                    self._create_op("P", temp="#-1:-2", uid=5),
+                    self._create_op("P", temp="#-1:-3", uid=5),
+                ],
+            )
+        assert "txdup" not in reg._staged
+        assert db._tx2pc_locks == {}
+
+    def test_mandatory_property_rejected_at_prepare(self):
+        db = Database("advr5_g")
+        cls = db.schema.create_vertex_class("M")
+        cls.create_property("name", PropertyType.STRING, mandatory=True)
+        reg = get_registry(db)
+        with pytest.raises(ValueError, match="mandatory"):
+            reg.prepare("txm", [self._create_op("M", uid=1)])
+        assert "txm" not in reg._staged
+
+    def test_doomed_create_aborts_phase1_not_indoubt(self):
+        """Coordinator view: one participant's staged create violates a
+        unique index — the whole tx cleanly aborts with NOTHING applied
+        anywhere (previously the violation only surfaced at phase-2
+        commit, leaving the other participant committed: in-doubt)."""
+        dba = Database("advr5_h")
+        dba.schema.create_vertex_class("P")
+        dbb = Database("advr5_i")
+        rcls = dbb.schema.create_vertex_class("R")
+        rcls.create_property("uid", PropertyType.LONG)
+        dbb.command("CREATE INDEX R.uid UNIQUE")
+        dbb.new_vertex("R", uid=7)
+        ops_a = [self._create_op("P", temp="#-1:-2", uid=1)]
+        ops_b = [self._create_op("R", temp="#-1:-3", uid=7)]  # dup
+        parts = {
+            "A": LocalRegistryParticipant(dba, ops_a, lambda *a: None),
+            "B": LocalRegistryParticipant(dbb, ops_b, lambda *a: None),
+        }
+        rows = [("A", {"#-1:-2"}, set()), ("B", {"#-1:-3"}, set())]
+        with pytest.raises(DuplicateKeyError):
+            run_coordinator("txd", parts, rows)
+        assert dba.count_class("P") == 0  # clean abort: nothing applied
+        assert dbb.count_class("R") == 1
+        assert dba._tx2pc_locks == {} and dbb._tx2pc_locks == {}
+
+
+class TestAddClusterNumericId:
+    def test_numeric_cluster_id_raises_clear_error(self):
+        with pytest.raises(ParseError, match="assigned automatically"):
+            parse("ALTER CLASS X ADDCLUSTER 5")
+
+    def test_named_cluster_still_parses(self):
+        stmt = parse("ALTER CLASS X ADDCLUSTER extra")
+        assert stmt.value == "extra"
+        assert parse("ALTER CLASS X ADDCLUSTER").value is None

@@ -334,10 +334,16 @@ class TestDistribution:
         from orientdb_tpu.server.__main__ import main  # noqa: F401
         from orientdb_tpu.tools.console import main as cmain  # noqa: F401
 
-        assert orientdb_tpu.__version__ == "0.2.0"
         import os
+        import re
 
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # the one source of truth is pyproject.toml: asserting a literal
+        # here made every version bump break the suite (round 5)
+        with open(os.path.join(root, "pyproject.toml")) as f:
+            m = re.search(r'^version\s*=\s*"([^"]+)"', f.read(), re.M)
+        assert m is not None
+        assert orientdb_tpu.__version__ == m.group(1)
         assert os.path.exists(os.path.join(root, "pyproject.toml"))
         assert os.path.exists(os.path.join(root, "distribution", "server.sh"))
         assert os.path.exists(os.path.join(root, "distribution", "console.sh"))

@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -22,6 +24,8 @@ def test_dryrun_body_in_process():
     run_body(8)
 
 
+@pytest.mark.slow  # ~75s; the driver invokes dryrun_multichip itself,
+# and tier-1 already runs the identical corpus via the in-process body
 def test_graft_entry_dryrun_subprocess_is_cpu_pinned():
     # The wrapper must succeed even when the calling process exports a
     # non-CPU JAX_PLATFORMS (the axon environment does exactly this).

@@ -80,8 +80,18 @@ def test_http_metrics_endpoint():
     s.startup()
     try:
         cred = base64.b64encode(b"admin:pw").decode()
+        # default exposition: Prometheus text (scrapeable)
         req = urllib.request.Request(
             f"http://127.0.0.1:{s.http_port}/metrics",
+            headers={"Authorization": f"Basic {cred}"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "# TYPE orienttpu_" in text
+        # JSON stays available for programmatic readers
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.http_port}/metrics?format=json",
             headers={"Authorization": f"Basic {cred}"},
         )
         with urllib.request.urlopen(req, timeout=5) as r:

@@ -176,6 +176,10 @@ class TestReplication:
         assert len(page["entries"]) == 2
         rest = entries_after(db, page["lsn"])
         assert len(rest["entries"]) >= 3
+        # a truncated window still reports the source's true head so
+        # the replica's lag gauge reads the real backlog, not ~0
+        assert page["head_lsn"] == rest["entries"][-1]["lsn"]
+        assert page["head_lsn"] > page["lsn"]
 
     def test_quiet_late_armed_source_does_not_gap_after_restore(self):
         """Review-fix regression (r5): a fresh replica that restored a

@@ -229,8 +229,13 @@ def test_forwarded_update_respects_mvcc(trio):
     def wait_local():
         deadline = time.time() + 10
         while time.time() < deadline:
-            if rdb.count_class("P") == 1:
-                return True
+            # the class itself replicates asynchronously too — a poll
+            # before the schema entry arrives must retry, not raise
+            try:
+                if rdb.count_class("P") == 1:
+                    return True
+            except ValueError:
+                pass
             time.sleep(0.02)
         return False
 
