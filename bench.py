@@ -512,8 +512,29 @@ def main() -> None:
         )
     )
 
+    #: block tag -> trace id of the span wrapping its measured reps:
+    #: evidence records carry it so a slow bench number can be joined
+    #: to its trace in the debug bundle (obs/bundle)
+    block_trace = {}
+
     def ev(block: str, **data) -> None:
+        tid = block_trace.get(block)
+        if tid:
+            data.setdefault("trace_id", tid)
         evidence.emit(block, data)
+
+    from contextlib import contextmanager
+
+    from orientdb_tpu.obs.trace import span as _bench_span
+
+    @contextmanager
+    def block_span(tag: str):
+        """Wrap one measured block in a span: its queries nest under
+        it, and the recorded trace id joins the block's evidence record
+        to its per-query spans in the debug bundle."""
+        with _bench_span("bench.block", block=tag) as sp:
+            yield
+        block_trace[tag] = sp.trace_id
 
     n_profiles = int(os.environ.get("BENCH_PROFILES", "20000"))
     avg_friends = int(os.environ.get("BENCH_AVG_FRIENDS", "10"))
@@ -627,15 +648,20 @@ def main() -> None:
         run("tpu", q)  # warm (compiles the sync-free replay plan)
         drain_warmups()
         qpss, ss = [], []
-        for _ in range(reps):
-            before = metrics.snapshot()
-            t0 = time.perf_counter()
-            for _ in range(n):
-                run("tpu", q)
-            qpss.append(n / (time.perf_counter() - t0))
-            ss.append(_phase_split(before, metrics.snapshot(), n))
+        # one span per measured block: every query inside nests under
+        # it, so the block's trace id (recorded in the evidence stream)
+        # joins the number to its per-query spans in the debug bundle
+        with _bench_span("bench.block", block=tag or "single") as sp:
+            for _ in range(reps):
+                before = metrics.snapshot()
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    run("tpu", q)
+                qpss.append(n / (time.perf_counter() - t0))
+                ss.append(_phase_split(before, metrics.snapshot(), n))
         if tag:
             splits[tag] = _median_split(ss)
+            block_trace[tag] = sp.trace_id
         return _median(qpss)
 
     def time_batched(q, n=iters, tag=None, params_list=None):
@@ -649,19 +675,23 @@ def main() -> None:
         db.query_batch(qs, params_list, engine="tpu", strict=True)
         drain_warmups()
         qpss, ss = [], []
-        for _ in range(reps):
-            before = metrics.snapshot()
-            t0 = time.perf_counter()
-            for _ in range(n):
-                rss = db.query_batch(
-                    qs, params_list, engine="tpu", strict=True
+        with _bench_span("bench.block", block=tag or "batched") as sp:
+            for _ in range(reps):
+                before = metrics.snapshot()
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    rss = db.query_batch(
+                        qs, params_list, engine="tpu", strict=True
+                    )
+                    for rs in rss:
+                        rs.to_dicts()
+                qpss.append((n * batch) / (time.perf_counter() - t0))
+                ss.append(
+                    _phase_split(before, metrics.snapshot(), n * batch)
                 )
-                for rs in rss:
-                    rs.to_dicts()
-            qpss.append((n * batch) / (time.perf_counter() - t0))
-            ss.append(_phase_split(before, metrics.snapshot(), n * batch))
         if tag:
             splits[tag] = _median_split(ss)
+            block_trace[tag] = sp.trace_id
         return _median(qpss)
 
     single_qps = time_single(sql, tag="single_2hop")
@@ -727,6 +757,11 @@ def main() -> None:
         srv.attach_database(db)
         srv.startup()
         url = f"remote:127.0.0.1:{srv.binary_port}/{db.name}"
+        # explicit enter/exit (not `with`): the span must close before
+        # the evidence record reads its trace id, without reindenting
+        # the whole wire section under another block
+        _rsp = _bench_span("bench.block", block="remote")
+        _rsp.__enter__()
         try:
             with connect(url, "admin", "pw") as rdb:
                 # sequential singles: the r4 floor (~RTT-bound)
@@ -814,6 +849,8 @@ def main() -> None:
             remote["coalesced_items"] = snap.get("coalesce.items", 0)
             remote["coalesced_grouped"] = snap.get("coalesce.grouped", 0)
         finally:
+            _rsp.__exit__(None, None, None)
+            block_trace["remote"] = _rsp.trace_id
             srv.shutdown()
         ev("remote", **remote)
 
@@ -870,15 +907,16 @@ def main() -> None:
                 return {"personId": (i * 37) % snb_persons}
             return {"messageId": (i * 101) % n_messages}
 
-        for name in sorted(IS_QUERIES):
-            q = IS_QUERIES[name]
-            # parity gate on a few parameter values (broad coverage lives
-            # in tests/test_ldbc_is.py)
-            for i in (0, 5, 9):
-                parity_or_die(snb, q, is_params(q, i), f"IS {name}")
-            ldbc_is[name] = time_param_batch_local(
-                snb, q, [is_params(q, i) for i in range(batch)]
-            )
+        with block_span("ldbc_is"):
+            for name in sorted(IS_QUERIES):
+                q = IS_QUERIES[name]
+                # parity gate on a few parameter values (broad coverage
+                # lives in tests/test_ldbc_is.py)
+                for i in (0, 5, 9):
+                    parity_or_die(snb, q, is_params(q, i), f"IS {name}")
+                ldbc_is[name] = time_param_batch_local(
+                    snb, q, [is_params(q, i) for i in range(batch)]
+                )
         ev("ldbc_is", **ldbc_is)
 
     # ---- LDBC interactive COMPLEX reads (IC1/IC2 + 3-hop aggregate):
@@ -899,13 +937,14 @@ def main() -> None:
                 p["maxDate"] = 2**30 + i * 1000
             return p
 
-        for name in sorted(IC_QUERIES):
-            q = IC_QUERIES[name]
-            for i in (0, 5, 9):
-                parity_or_die(snb, q, ic_params(name, i), f"IC {name}")
-            ldbc_ic[name + "_qps"] = time_param_batch_local(
-                snb, q, [ic_params(name, i) for i in range(batch)]
-            )
+        with block_span("ldbc_ic"):
+            for name in sorted(IC_QUERIES):
+                q = IC_QUERIES[name]
+                for i in (0, 5, 9):
+                    parity_or_die(snb, q, ic_params(name, i), f"IC {name}")
+                ldbc_ic[name + "_qps"] = time_param_batch_local(
+                    snb, q, [ic_params(name, i) for i in range(batch)]
+                )
         ev("ldbc_ic", **ldbc_ic)
 
     if snb_persons > 0:
@@ -921,16 +960,20 @@ def main() -> None:
 
         snb10 = generate_ldbc_snb(n_persons=sf10_persons, seed=17)
         attach_fresh_snapshot(snb10)
-        for name in ("IS1", "IS3"):
-            q = IS_QUERIES[name]
-            parity_or_die(
-                snb10, q, {"personId": 37 % sf10_persons}, f"sf10 {name}"
-            )
-            sf10[name + "_qps"] = time_param_batch_local(
-                snb10,
-                q,
-                [{"personId": (i * 37) % sf10_persons} for i in range(batch)],
-            )
+        with block_span("sf10"):
+            for name in ("IS1", "IS3"):
+                q = IS_QUERIES[name]
+                parity_or_die(
+                    snb10, q, {"personId": 37 % sf10_persons}, f"sf10 {name}"
+                )
+                sf10[name + "_qps"] = time_param_batch_local(
+                    snb10,
+                    q,
+                    [
+                        {"personId": (i * 37) % sf10_persons}
+                        for i in range(batch)
+                    ],
+                )
         sf10["persons"] = sf10_persons
         ev("sf10", **sf10)
         snb10.detach_snapshot()
@@ -1004,10 +1047,11 @@ def main() -> None:
             mesh_scaling.append(res)
         ev("mesh_scaling", results=mesh_scaling)
 
-    t0 = time.perf_counter()
-    for _ in range(oracle_iters):
-        run("oracle")
-    oracle_qps = oracle_iters / (time.perf_counter() - t0)
+    with block_span("oracle_2hop"):
+        t0 = time.perf_counter()
+        for _ in range(oracle_iters):
+            run("oracle")
+        oracle_qps = oracle_iters / (time.perf_counter() - t0)
     ev("oracle_2hop", qps=round(oracle_qps, 4))
 
     out = {

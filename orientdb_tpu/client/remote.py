@@ -109,11 +109,15 @@ class RemoteDatabase:
                 raise RemoteError(resp.get("error", "open failed"))
 
     def _call(self, req: dict) -> dict:
+        from orientdb_tpu.obs.propagation import inject_frame
+
         with self._lock:
             if self._sock is None:
                 raise RemoteConnectionError("connection closed")
             self._reqid += 1
-            req = {**req, "reqid": self._reqid}
+            # an active client-side trace rides the frame envelope so
+            # the server session continues it (obs/propagation)
+            req = inject_frame({**req, "reqid": self._reqid})
             try:
                 send_frame(self._sock, req)
                 if self._resp_q is not None:

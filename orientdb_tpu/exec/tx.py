@@ -418,8 +418,16 @@ class Transaction:
             def prepare(self, txid: str) -> None:
                 import time as _t
 
+                from orientdb_tpu.obs.trace import span as _span
+
                 deadline = _t.time() + tp.DEFAULT_TTL
-                with db._lock:
+                # same span names as TwoPhaseRegistry's: the assembled
+                # trace shows every participant uniformly, local or not
+                with _span(
+                    "tx2pc.participant.prepare",
+                    txid=txid,
+                    ops=len(outer.dirty) + len(local_creates),
+                ), db._lock:
                     for rid, base in outer.dirty.items():
                         db._check_2pc_lock(rid)
                         stored = db._load_raw(rid)
@@ -448,12 +456,15 @@ class Transaction:
                     self.locked = []
 
             def commit(self, txid: str, rid_map: Dict[str, str]) -> None:
+                from orientdb_tpu.obs.trace import span as _span
+
                 db._tx_local.tx2pc_commit = txid
                 try:
-                    outer._substitute_local_edges(db, rid_map)
-                    with db._quorum_deferral():
-                        with db._lock:
-                            local_map = outer._commit_locked(db)
+                    with _span("tx2pc.participant.commit", txid=txid):
+                        outer._substitute_local_edges(db, rid_map)
+                        with db._quorum_deferral():
+                            with db._lock:
+                                local_map = outer._commit_locked(db)
                 finally:
                     db._tx_local.tx2pc_commit = None
                     self._unlock(txid)

@@ -17,21 +17,66 @@ both claims needs more than `utils/metrics.py`'s counters:
 - :mod:`orientdb_tpu.obs.evidence` — append-only fsync'd JSONL sink so
   a timed-out bench/dryrun still leaves every completed block's numbers
   on disk (round 5 shipped rc:124 with NO perf evidence because the
-  detail artifact wrote only at process exit).
+  detail artifact wrote only at process exit);
+- :mod:`orientdb_tpu.obs.propagation` — cross-node trace propagation:
+  context injection/extraction for HTTP headers, binary-protocol
+  frames, and WAL entries, so forwarded writes, 2PC rounds, and
+  replication applies assemble into ONE trace;
+- :mod:`orientdb_tpu.obs.cluster_view` — the fleet aggregation plane:
+  ``GET /cluster/health`` and the member-labeled ``GET
+  /cluster/metrics`` fan-in;
+- :mod:`orientdb_tpu.obs.bundle` — the flight-recorder debug bundle
+  (``GET /debug/bundle``, console ``DIAG``): traces assembled by
+  trace id, slowlog, metrics snapshot, in-doubt 2PC state;
+- :mod:`orientdb_tpu.obs.promlint` — Prometheus text-exposition
+  grammar lint, run by tier-1 tests over the full ``/metrics`` and
+  ``/cluster/metrics`` output.
 """
 
+from orientdb_tpu.obs.bundle import assemble_traces, debug_bundle
 from orientdb_tpu.obs.evidence import EvidenceSink, read_evidence
-from orientdb_tpu.obs.registry import obs, render_prometheus
+from orientdb_tpu.obs.promlint import lint_exposition
+from orientdb_tpu.obs.propagation import (
+    baggage,
+    continue_trace,
+    current_context,
+    extract_headers,
+    inject_frame,
+    inject_headers,
+)
+from orientdb_tpu.obs.registry import (
+    obs,
+    render_prometheus,
+    render_prometheus_multi,
+    snapshot_all,
+)
 from orientdb_tpu.obs.slowlog import slowlog
-from orientdb_tpu.obs.trace import current_trace_id, span, tracer
+from orientdb_tpu.obs.trace import (
+    current_span,
+    current_trace_id,
+    span,
+    tracer,
+)
 
 __all__ = [
     "EvidenceSink",
     "read_evidence",
     "obs",
     "render_prometheus",
+    "render_prometheus_multi",
+    "snapshot_all",
     "slowlog",
     "span",
     "tracer",
     "current_trace_id",
+    "current_span",
+    "current_context",
+    "continue_trace",
+    "baggage",
+    "inject_headers",
+    "inject_frame",
+    "extract_headers",
+    "assemble_traces",
+    "debug_bundle",
+    "lint_exposition",
 ]

@@ -1,0 +1,87 @@
+"""Flight-recorder debug bundle: one JSON artifact for incident triage.
+
+When a distributed failure mode shows up (a quorum stall, an in-doubt
+2PC, a replica diverging), the operator needs the process's recent
+history as ONE artifact, not four endpoints scraped in a hurry:
+
+- recent traces, ASSEMBLED by trace id (cross-node spans land in one
+  group thanks to propagation — coordinator, participants, and
+  replication applies of one write share a trace);
+- the slow-query log;
+- a full metrics snapshot (counters/gauges/durations/histograms);
+- in-doubt 2PC state: staged-but-undecided batches per database, plus
+  the coordinator-side in-doubt reports (``twophase.INDOUBT_LOG``).
+
+Served as ``GET /debug/bundle`` (admin-only) and from the console as
+``DIAG [<path>]``. Everything here is JSON-friendly by construction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional
+
+from orientdb_tpu.obs.registry import snapshot_all
+from orientdb_tpu.obs.slowlog import slowlog
+from orientdb_tpu.obs.trace import tracer
+
+
+def assemble_traces(max_traces: int = 50) -> List[Dict]:
+    """The tracer ring grouped by trace id: newest ``max_traces``
+    traces, each as ``{"trace_id", "spans": [...]}`` with spans in
+    finish order. Cross-node spans that continued a propagated context
+    group under the originating trace id."""
+    groups: Dict[str, List[Dict]] = {}
+    order: List[str] = []  # trace ids by FIRST finished span
+    for sp in tracer.spans():
+        tid = sp.trace_id
+        if tid not in groups:
+            groups[tid] = []
+            order.append(tid)
+        groups[tid].append(sp.to_dict())
+    newest = order[-max_traces:] if max_traces else order
+    return [
+        {"trace_id": tid, "spans": groups[tid]} for tid in newest
+    ]
+
+
+def in_doubt_state(dbs: Iterable) -> Dict:
+    """Participant-side staged (prepared, undecided) 2PC batches per
+    database plus the coordinator-side in-doubt reports."""
+    from orientdb_tpu.parallel.twophase import INDOUBT_LOG
+
+    staged: Dict[str, List[Dict]] = {}
+    for db in dbs:
+        reg = getattr(db, "_tx2pc_registry", None)
+        items = reg.staged_report() if reg is not None else []
+        if items:
+            staged[db.name] = items
+    return {
+        "staged": staged,
+        "coordinator_reports": list(INDOUBT_LOG),
+    }
+
+
+def debug_bundle(
+    dbs: Iterable = (),
+    member: Optional[str] = None,
+    cluster=None,
+    max_traces: int = 50,
+) -> Dict:
+    """The full bundle. ``dbs`` are this process's databases (for
+    staged-2PC state); ``cluster`` (when attached) contributes the
+    membership status block."""
+    out: Dict[str, object] = {
+        "ts": round(time.time(), 3),
+        "member": member,
+        "traces": assemble_traces(max_traces),
+        "slowlog": slowlog.entries(),
+        "metrics": snapshot_all(),
+        "in_doubt_2pc": in_doubt_state(dbs),
+    }
+    if cluster is not None:
+        try:
+            out["cluster"] = cluster.status()
+        except Exception as e:  # never let status wedge the bundle
+            out["cluster"] = {"error": f"{type(e).__name__}: {e}"}
+    return out

@@ -1,0 +1,179 @@
+"""Fleet-level aggregation: ``/cluster/health`` and ``/cluster/metrics``.
+
+The obs plane so far is per-process; a replicated/sharded deployment is
+operated from the FLEET view ([E] the reference's distributed status
+output — ``ODistributedServerManager.dump()`` / the ``HA STATUS``
+command — and every serving stack's health+metrics aggregator):
+
+- :func:`cluster_health` — one JSON document with per-member liveness
+  (a real HTTP probe, not the coordinator's cached view), role,
+  replication lag, in-doubt 2PC count, and slowlog depth;
+- :func:`cluster_metrics_text` — fan-in: every member's registry
+  snapshot (``GET /metrics?format=json``) merged into one Prometheus
+  exposition, every series labeled ``member="<name>"``
+  (``obs/registry.render_prometheus_multi``), plus a synthetic
+  ``cluster.member_up`` gauge so an unreachable member is a visible
+  0-series instead of a silent hole.
+
+Both read ``server.cluster`` (set by ``parallel/cluster.Cluster`` when
+the member registers). A server outside any cluster serves a
+single-member degenerate view — the endpoints always answer, so
+dashboards need no special-casing for standalone nodes.
+
+Tests run all members in one process (the multi-server-in-one-JVM
+strategy, SURVEY.md §4); the registries there are process-wide
+singletons, so per-member numbers coincide — the fan-in transport and
+labeling are what this module exercises.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+from orientdb_tpu.obs.registry import (
+    render_prometheus_multi,
+    snapshot_all,
+)
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("cluster_view")
+
+#: per-member probe/scrape timeout (seconds) — the health endpoint must
+#: answer promptly even with a member down
+PROBE_TIMEOUT = 1.5
+
+
+def _get_json(url: str, user: str, password: str) -> Dict:
+    cred = base64.b64encode(f"{user}:{password}".encode()).decode()
+    req = urllib.request.Request(
+        url, headers={"Authorization": f"Basic {cred}"}
+    )
+    with urllib.request.urlopen(req, timeout=PROBE_TIMEOUT) as r:
+        return json.loads(r.read())
+
+
+def _staged_2pc(db) -> int:
+    """In-doubt (prepared, undecided) 2PC batches staged on a database."""
+    reg = getattr(db, "_tx2pc_registry", None)
+    return 0 if reg is None else len(reg.staged_report())
+
+
+def _member_health(cluster, m) -> Dict:
+    from orientdb_tpu.obs.slowlog import slowlog
+
+    out: Dict[str, object] = {
+        "role": m.role,
+        "url": m.url,
+        "in_doubt_2pc": _staged_2pc(m.db),
+        "slowlog_depth": len(slowlog.entries()),
+    }
+    if m.puller is not None:
+        out["replication"] = m.puller.lag()
+    try:
+        _get_json(
+            f"{m.url}/listDatabases", cluster.user, cluster.password
+        )
+        out["alive"] = True
+    except Exception as e:
+        out["alive"] = False
+        out["probe_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def cluster_health(server) -> Dict:
+    """The fleet health document. ``server`` is the answering member's
+    ``server.Server``; without an attached cluster the view degrades to
+    this one node."""
+    cluster = getattr(server, "cluster", None)
+    if cluster is None:
+        from orientdb_tpu.obs.slowlog import slowlog
+
+        return {
+            "ts": round(time.time(), 3),
+            "cluster": None,
+            "members": {
+                server.name: {
+                    "role": "STANDALONE",
+                    "alive": True,
+                    "in_doubt_2pc": sum(
+                        _staged_2pc(db) for db in server.databases.values()
+                    ),
+                    "slowlog_depth": len(slowlog.entries()),
+                }
+            },
+        }
+    with cluster._lock:
+        members = dict(cluster.members)
+        primary = cluster.primary
+        failovers = cluster.failovers
+        dbname = cluster.dbname
+    # probe members concurrently: one DOWN node must cost one timeout,
+    # not one per caller-visible second of serial probing
+    with ThreadPoolExecutor(max_workers=max(len(members), 1)) as pool:
+        futs = {
+            name: pool.submit(_member_health, cluster, m)
+            for name, m in members.items()
+        }
+        out_members = {name: f.result() for name, f in futs.items()}
+    return {
+        "ts": round(time.time(), 3),
+        "cluster": {
+            "dbname": dbname,
+            "primary": primary,
+            "failovers": failovers,
+        },
+        "members": out_members,
+    }
+
+
+def _member_snapshots(server) -> Dict[str, Optional[Dict]]:
+    """Per-member registry snapshots: scraped over HTTP from each
+    member (``None`` marks an unreachable one). A cluster-less server
+    answers with its own in-process snapshot."""
+    cluster = getattr(server, "cluster", None)
+    if cluster is None:
+        return {server.name: snapshot_all()}
+    with cluster._lock:
+        members = [(m.name, m.url) for m in cluster.members.values()]
+
+    def scrape(url: str) -> Optional[Dict]:
+        try:
+            return _get_json(
+                f"{url}/metrics?format=json",
+                cluster.user,
+                cluster.password,
+            )
+        except Exception:
+            return None
+
+    with ThreadPoolExecutor(max_workers=max(len(members), 1)) as pool:
+        futs = {name: pool.submit(scrape, url) for name, url in members}
+        return {name: f.result() for name, f in futs.items()}
+
+
+def cluster_metrics_json(server) -> Dict:
+    """The raw fan-in: ``{member: snapshot-or-null}``."""
+    return {"members": _member_snapshots(server)}
+
+
+def cluster_metrics_text(server) -> str:
+    """The merged Prometheus exposition, labeled by member."""
+    snaps = _member_snapshots(server)
+    merged: Dict[str, Dict] = {}
+    for name, snap in snaps.items():
+        up = snap is not None
+        snap = dict(snap) if up else {}
+        # the synthetic liveness series: an unreachable member shows as
+        # member_up 0 with no other series, never as a silent hole
+        counters = dict(snap.get("counters", {}))
+        gauges = dict(snap.get("gauges", {}))
+        gauges["cluster.member_up"] = 1 if up else 0
+        snap["counters"] = counters
+        snap["gauges"] = gauges
+        merged[name] = snap
+    return render_prometheus_multi(merged)

@@ -1,0 +1,189 @@
+"""Prometheus text-exposition grammar lint.
+
+A malformed metric — a bad name character, a TYPE after its samples, a
+duplicate series — makes a real Prometheus server drop the WHOLE
+scrape, silently blinding every dashboard. This linter checks the
+text-format 0.0.4 grammar so a tier-1 test can fail the build instead
+(`tests/test_cluster_obs.py` lints the full ``/metrics`` and
+``/cluster/metrics`` output):
+
+- metric names match ``[a-zA-Z_:][a-zA-Z0-9_:]*``, label names match
+  ``[a-zA-Z_][a-zA-Z0-9_]*`` and never start ``__``;
+- label values use only the legal escapes (``\\\\``, ``\\"``, ``\\n``);
+- ``# TYPE`` at most once per family, BEFORE any of its samples, with
+  a known type; ``# HELP`` at most once per family;
+- all samples of a family form one contiguous group;
+- histogram/summary child samples (``_bucket``/``_sum``/``_count``)
+  attach to their declared family;
+- no duplicate series (same name + label set);
+- sample values parse as floats (``+Inf``/``-Inf``/``NaN`` included);
+- the document ends with a newline.
+
+Returns problems as strings; an empty list means the document is clean.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+_METRIC_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+#: child-sample suffixes per complex type
+_CHILD_SUFFIXES = {
+    "histogram": ("_bucket", "_sum", "_count"),
+    "summary": ("_sum", "_count"),
+}
+
+
+def _parse_labels(raw: str) -> Optional[List[Tuple[str, str]]]:
+    """``a="b",c="d"`` → pairs, honoring escapes; None on bad syntax."""
+    out: List[Tuple[str, str]] = []
+    i, n = 0, len(raw)
+    while i < n:
+        j = raw.find("=", i)
+        if j < 0:
+            return None
+        name = raw[i:j].strip()
+        if j + 1 >= n or raw[j + 1] != '"':
+            return None
+        k = j + 2
+        val = []
+        while k < n:
+            ch = raw[k]
+            if ch == "\\":
+                if k + 1 >= n or raw[k + 1] not in ('\\', '"', "n"):
+                    return None
+                val.append(raw[k : k + 2])
+                k += 2
+                continue
+            if ch == '"':
+                break
+            if ch == "\n":
+                return None
+            val.append(ch)
+            k += 1
+        else:
+            return None  # unterminated value
+        out.append((name, "".join(val)))
+        k += 1
+        if k < n:
+            if raw[k] != ",":
+                return None
+            k += 1
+        i = k
+    return out
+
+
+def _value_ok(v: str) -> bool:
+    if v in ("+Inf", "-Inf", "Inf", "NaN"):
+        return True
+    try:
+        float(v)
+        return True
+    except ValueError:
+        return False
+
+
+def _family_of(name: str, types: Dict[str, str]) -> str:
+    """The declared family a sample belongs to: exact, or the base of a
+    histogram/summary child suffix."""
+    if name in types:
+        return name
+    for typ, suffixes in _CHILD_SUFFIXES.items():
+        for suf in suffixes:
+            if name.endswith(suf):
+                base = name[: -len(suf)]
+                if types.get(base) == typ:
+                    return base
+    return name
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Lint one exposition document; returns problems (empty = clean)."""
+    problems: List[str] = []
+    if not text.endswith("\n"):
+        problems.append("document must end with a newline")
+    types: Dict[str, str] = {}
+    helps: set = set()
+    sampled: set = set()  # families that already emitted samples
+    closed: set = set()  # families whose group ended (another began)
+    current: Optional[str] = None
+    seen_series: set = set()
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue  # plain comment: legal, ignored
+            kind, name = parts[1], parts[2]
+            if not _METRIC_RE.match(name):
+                problems.append(f"line {ln}: bad metric name {name!r}")
+                continue
+            if kind == "TYPE":
+                typ = parts[3].strip() if len(parts) > 3 else ""
+                if typ not in _TYPES:
+                    problems.append(
+                        f"line {ln}: unknown TYPE {typ!r} for {name}"
+                    )
+                if name in types:
+                    problems.append(
+                        f"line {ln}: duplicate TYPE for {name}"
+                    )
+                if name in sampled:
+                    problems.append(
+                        f"line {ln}: TYPE for {name} after its samples"
+                    )
+                types[name] = typ
+            else:
+                if name in helps:
+                    problems.append(
+                        f"line {ln}: duplicate HELP for {name}"
+                    )
+                helps.add(name)
+            continue
+        # sample line: name[{labels}] value [timestamp]
+        m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)"
+                     r"(\s+-?\d+)?\s*\Z", line)
+        if m is None:
+            problems.append(f"line {ln}: unparsable sample: {line!r}")
+            continue
+        name, _braced, rawlabels, value = (
+            m.group(1), m.group(2), m.group(3), m.group(4),
+        )
+        labels: List[Tuple[str, str]] = []
+        if rawlabels:
+            parsed = _parse_labels(rawlabels)
+            if parsed is None:
+                problems.append(
+                    f"line {ln}: bad label syntax: {rawlabels!r}"
+                )
+                continue
+            labels = parsed
+            for lname, _v in labels:
+                if not _LABEL_RE.match(lname) or lname.startswith("__"):
+                    problems.append(
+                        f"line {ln}: bad label name {lname!r}"
+                    )
+        if not _value_ok(value):
+            problems.append(f"line {ln}: bad sample value {value!r}")
+        fam = _family_of(name, types)
+        if fam in closed:
+            problems.append(
+                f"line {ln}: samples of {fam} are not contiguous"
+            )
+        if current is not None and fam != current:
+            closed.add(current)
+        current = fam
+        sampled.add(fam)
+        series = (name, tuple(sorted(labels)))
+        if series in seen_series:
+            problems.append(
+                f"line {ln}: duplicate series {name}"
+                f"{{{rawlabels or ''}}}"
+            )
+        seen_series.add(series)
+    return problems

@@ -1,0 +1,172 @@
+"""Cross-node trace propagation: the Dapper-style context carrier.
+
+`obs/trace.py` gives every query a trace id, but a span stack is
+thread- (and therefore process-) local: a forwarded write, a 2PC
+phase, or a replication apply lands on another node's server thread
+and mints an unrelated trace. This module carries the context across
+every inter-node channel so the remote side CONTINUES the trace
+instead:
+
+- **context** — ``{"trace_id": ..., "span_id": ..., "baggage": {...}}``.
+  ``span_id`` is the caller's active span; the remote side's first span
+  uses it as ``parent_id``. Baggage is a small key→scalar dict that
+  propagates onward across further hops (2PC puts the ``txid`` there so
+  every participant span is joinable by transaction).
+- **HTTP** — :func:`inject_headers` / :func:`extract_headers` move the
+  context through ``X-Orienttpu-Trace-Id`` / ``-Parent-Span`` /
+  ``-Baggage`` request headers (forwarding, 2PC phases, quorum pushes).
+- **binary protocol** — the frame envelope carries the same dict under
+  a ``"trace"`` key (:func:`inject_frame`; `binary_server` extracts).
+- **WAL entries** — the originating write's context is stamped onto the
+  entry (``storage/durability.WriteAheadLog.append``), so an
+  asynchronous replica apply — pulled seconds later by a thread that
+  never saw the request — still links back to the write that produced
+  it (:func:`continue_trace` with ``force=True``).
+
+Nothing here talks to the network; callers inject/extract at their own
+channel's framing layer.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from orientdb_tpu.obs.trace import current_span, span
+
+#: HTTP header names (one per context field; baggage is a JSON object)
+HDR_TRACE_ID = "X-Orienttpu-Trace-Id"
+HDR_PARENT_SPAN = "X-Orienttpu-Parent-Span"
+HDR_BAGGAGE = "X-Orienttpu-Baggage"
+
+_local = threading.local()
+
+
+def current_baggage() -> Dict[str, object]:
+    """The merged baggage visible on this thread (innermost wins)."""
+    stack = getattr(_local, "baggage", None)
+    if not stack:
+        return {}
+    out: Dict[str, object] = {}
+    for frame in stack:
+        out.update(frame)
+    return out
+
+
+@contextmanager
+def baggage(**items):
+    """Attach key→scalar items to every context captured inside the
+    block; they ride along on every outbound hop and re-propagate from
+    the receiving side (``continue_trace`` re-opens them there)."""
+    stack = getattr(_local, "baggage", None)
+    if stack is None:
+        stack = _local.baggage = []
+    stack.append(dict(items))
+    try:
+        yield
+    finally:
+        if stack and stack[-1] is not None:
+            stack.pop()
+
+
+def current_context() -> Optional[Dict]:
+    """The propagatable context of this thread's active span (plus
+    baggage), or None outside any trace."""
+    sp = current_span()
+    if sp is None:
+        return None
+    ctx: Dict[str, object] = {
+        "trace_id": sp.trace_id,
+        "span_id": sp.span_id,
+    }
+    bag = current_baggage()
+    if bag:
+        ctx["baggage"] = bag
+    return ctx
+
+
+# -- channel framing ---------------------------------------------------------
+
+
+def inject_headers(headers: Dict, ctx: Optional[Dict] = None) -> Dict:
+    """Add the context (given, or this thread's current) to an HTTP
+    header dict; returns the dict. No-op outside any trace."""
+    ctx = ctx if ctx is not None else current_context()
+    if not ctx or not ctx.get("trace_id"):
+        return headers
+    headers[HDR_TRACE_ID] = str(ctx["trace_id"])
+    if ctx.get("span_id"):
+        headers[HDR_PARENT_SPAN] = str(ctx["span_id"])
+    bag = ctx.get("baggage")
+    if bag:
+        try:
+            headers[HDR_BAGGAGE] = json.dumps(bag, sort_keys=True)
+        except (TypeError, ValueError):
+            pass  # non-JSON baggage never breaks the request itself
+    return headers
+
+
+def extract_headers(headers) -> Optional[Dict]:
+    """Context from an HTTP request's headers (an ``email.Message`` or
+    any mapping with ``.get``), or None when the request carries none."""
+    tid = headers.get(HDR_TRACE_ID)
+    if not tid:
+        return None
+    ctx: Dict[str, object] = {"trace_id": tid}
+    parent = headers.get(HDR_PARENT_SPAN)
+    if parent:
+        ctx["span_id"] = parent
+    raw = headers.get(HDR_BAGGAGE)
+    if raw:
+        try:
+            bag = json.loads(raw)
+            if isinstance(bag, dict):
+                ctx["baggage"] = bag
+        except ValueError:
+            pass  # malformed baggage: keep the trace link anyway
+    return ctx
+
+
+def inject_frame(frame: Dict, ctx: Optional[Dict] = None) -> Dict:
+    """Binary-protocol variant: the envelope dict carries the context
+    under ``"trace"``. No-op outside any trace."""
+    ctx = ctx if ctx is not None else current_context()
+    if ctx and ctx.get("trace_id"):
+        frame["trace"] = ctx
+    return frame
+
+
+# -- continuing a trace ------------------------------------------------------
+
+
+@contextmanager
+def continue_trace(
+    name: str, ctx: Optional[Dict], force: bool = False, **attrs
+):
+    """Open a span that CONTINUES a remote context: it adopts the
+    remote trace id and parents onto the remote span, so the two sides
+    assemble into one cross-node trace.
+
+    Without a usable ``ctx`` this is exactly ``span(name, **attrs)``.
+    Adoption normally applies only when this thread has no active span
+    (a server thread picking up a request); ``force=True`` adopts even
+    under a local parent — the replication-apply case, where the
+    per-entry span must join the ORIGINATING WRITE's trace, not the
+    apply batch's. Remote baggage lands in the span's attrs and is
+    re-opened as local baggage so it propagates across further hops.
+    """
+    remote = bool(ctx and ctx.get("trace_id"))
+    with span(name, **attrs) as sp:
+        if remote and (force or sp.parent_id is None):
+            sp.trace_id = ctx["trace_id"]
+            sp.parent_id = ctx.get("span_id")
+        bag = (ctx or {}).get("baggage") if remote else None
+        if bag:
+            for k, v in bag.items():
+                sp.attrs.setdefault(k, v)
+            with baggage(**bag):
+                yield sp
+        else:
+            yield sp

@@ -139,35 +139,176 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
-def render_prometheus() -> str:
-    """The whole process registry in Prometheus text format 0.0.4."""
+#: cache families whose effectiveness renders as a derived hit-ratio
+#: gauge (hits / (hits + misses)): the command (result) cache and the
+#: TPU engine's compiled-plan cache (its hit/miss counters ARE the
+#: compile-cache behavior — a miss records + compiles a new plan)
+_CACHE_RATIO_FAMILIES = ("command_cache", "plan_cache")
+
+
+def derived_gauges(counters: Dict[str, float]) -> Dict[str, float]:
+    """Gauges computed FROM a counter snapshot at render time — cache
+    hit ratios, so ``/metrics`` (and ``/cluster/metrics``, per member)
+    shows cache effectiveness directly instead of leaving the division
+    to every dashboard."""
+    out: Dict[str, float] = {}
+    for fam in _CACHE_RATIO_FAMILIES:
+        hits = counters.get(f"{fam}.hit", 0)
+        misses = counters.get(f"{fam}.miss", 0)
+        if hits or misses:
+            out[f"{fam}.hit_ratio"] = round(hits / (hits + misses), 6)
+    return out
+
+
+def snapshot_all() -> Dict[str, Dict]:
+    """One combined snapshot of BOTH process registries (counters /
+    gauges / durations from ``utils.metrics``, histograms from here) —
+    the unit ``/metrics?format=json`` serves and ``/cluster/metrics``
+    fans in per member."""
     from orientdb_tpu.utils.metrics import metrics
 
     snap = metrics.snapshot()
-    lines: List[str] = []
-    for name, v in sorted(snap["counters"].items()):
+    snap["histograms"] = obs.snapshot()
+    return snap
+
+
+def _render_into(lines: List[str], snap: Dict) -> None:
+    """Render one process snapshot (the single-member exposition; the
+    member-labeled fan-in lives in :func:`render_prometheus_multi`,
+    which must iterate families OUTER and members inner and therefore
+    cannot reuse this per-snapshot walk)."""
+
+    def header(m: str, typ: str) -> None:
+        lines.append(f"# HELP {m} orientdb-tpu metric {m}")
+        lines.append(f"# TYPE {m} {typ}")
+
+    def sample(m: str, v, extra: str = "") -> None:
+        lines.append(f"{m}{{{extra}}} {v}" if extra else f"{m} {v}")
+
+    counters = snap.get("counters", {})
+    for name, v in sorted(counters.items()):
         m = _prom_name(name) + "_total"
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_fmt(v)}")
-    for name, v in sorted(snap["gauges"].items()):
+        header(m, "counter")
+        sample(m, _fmt(v))
+    gauges = dict(snap.get("gauges", {}))
+    gauges.update(derived_gauges(counters))
+    for name, v in sorted(gauges.items()):
         m = _prom_name(name)
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(v)}")
-    for name, d in sorted(snap["durations"].items()):
+        header(m, "gauge")
+        sample(m, _fmt(v))
+    for name, d in sorted(snap.get("durations", {}).items()):
         # count/total/max duration stats render as a summary plus a
         # companion _max gauge (Prometheus summaries carry no max)
         m = _prom_name(name)
-        lines.append(f"# TYPE {m} summary")
-        lines.append(f"{m}_count {_fmt(d['count'])}")
-        lines.append(f"{m}_sum {_fmt(d['total_s'])}")
-        lines.append(f"# TYPE {m}_max gauge")
-        lines.append(f"{m}_max {_fmt(d['max_s'])}")
-    for name, h in sorted(obs.snapshot().items()):
+        header(m, "summary")
+        sample(f"{m}_count", _fmt(d["count"]))
+        sample(f"{m}_sum", _fmt(d["total_s"]))
+        header(f"{m}_max", "gauge")
+        sample(f"{m}_max", _fmt(d["max_s"]))
+    for name, h in sorted(snap.get("histograms", {}).items()):
         m = _prom_name(name)
-        lines.append(f"# TYPE {m} histogram")
-        for le, cum in h["buckets"].items():
-            lines.append(f'{m}_bucket{{le="{_fmt(le)}"}} {cum}')
-        lines.append(f'{m}_bucket{{le="+Inf"}} {h["count"]}')
-        lines.append(f"{m}_sum {_fmt(h['sum'])}")
-        lines.append(f"{m}_count {h['count']}")
+        header(m, "histogram")
+        # bucket keys survive a JSON round trip as strings (the
+        # /cluster/metrics fan-in path): normalize + sort numerically
+        buckets = sorted(
+            ((float(le), cum) for le, cum in h["buckets"].items()),
+            key=lambda kv: kv[0],
+        )
+        for le, cum in buckets:
+            sample(f"{m}_bucket", cum, extra=f'le="{_fmt(le)}"')
+        sample(f"{m}_bucket", h["count"], extra='le="+Inf"')
+        sample(f"{m}_sum", _fmt(h["sum"]))
+        sample(f"{m}_count", h["count"])
+
+
+def render_prometheus() -> str:
+    """The whole process registry in Prometheus text format 0.0.4."""
+    lines: List[str] = []
+    _render_into(lines, snapshot_all())
+    return "\n".join(lines) + "\n"
+
+
+def render_prometheus_multi(snapshots: Dict[str, Dict]) -> str:
+    """Fan-in exposition: each member's registry snapshot (the
+    ``snapshot_all`` shape, possibly JSON-round-tripped) merged into
+    ONE text document, every sample labeled ``member="<name>"``.
+
+    Families iterate OUTER and members inner: the exposition grammar
+    requires all samples of one metric family to form a single group
+    (HELP/TYPE first, then every series) — interleaving members by
+    whole snapshots would scatter a family across the document. The
+    member label keeps merged series unique."""
+    lines: List[str] = []
+    members = sorted(snapshots)
+
+    def fam(kind: str) -> List[str]:
+        names: set = set()
+        for m in members:
+            names.update(snapshots[m].get(kind, {}))
+        return sorted(names)
+
+    def header(m: str, typ: str) -> None:
+        lines.append(f"# HELP {m} orientdb-tpu metric {m}")
+        lines.append(f"# TYPE {m} {typ}")
+
+    for name in fam("counters"):
+        m = _prom_name(name) + "_total"
+        header(m, "counter")
+        for mem in members:
+            v = snapshots[mem].get("counters", {}).get(name)
+            if v is not None:
+                lines.append(f'{m}{{member="{mem}"}} {_fmt(v)}')
+    gauge_snaps = {
+        mem: {
+            **snapshots[mem].get("gauges", {}),
+            **derived_gauges(snapshots[mem].get("counters", {})),
+        }
+        for mem in members
+    }
+    for name in sorted({n for g in gauge_snaps.values() for n in g}):
+        m = _prom_name(name)
+        header(m, "gauge")
+        for mem in members:
+            v = gauge_snaps[mem].get(name)
+            if v is not None:
+                lines.append(f'{m}{{member="{mem}"}} {_fmt(v)}')
+    for name in fam("durations"):
+        m = _prom_name(name)
+        header(m, "summary")
+        for mem in members:
+            d = snapshots[mem].get("durations", {}).get(name)
+            if d is not None:
+                lines.append(
+                    f'{m}_count{{member="{mem}"}} {_fmt(d["count"])}'
+                )
+                lines.append(
+                    f'{m}_sum{{member="{mem}"}} {_fmt(d["total_s"])}'
+                )
+        header(f"{m}_max", "gauge")
+        for mem in members:
+            d = snapshots[mem].get("durations", {}).get(name)
+            if d is not None:
+                lines.append(
+                    f'{m}_max{{member="{mem}"}} {_fmt(d["max_s"])}'
+                )
+    for name in fam("histograms"):
+        m = _prom_name(name)
+        header(m, "histogram")
+        for mem in members:
+            h = snapshots[mem].get("histograms", {}).get(name)
+            if h is None:
+                continue
+            buckets = sorted(
+                ((float(le), cum) for le, cum in h["buckets"].items()),
+                key=lambda kv: kv[0],
+            )
+            for le, cum in buckets:
+                lines.append(
+                    f'{m}_bucket{{le="{_fmt(le)}",member="{mem}"}} {cum}'
+                )
+            lines.append(
+                f'{m}_bucket{{le="+Inf",member="{mem}"}} {h["count"]}'
+            )
+            lines.append(f'{m}_sum{{member="{mem}"}} {_fmt(h["sum"])}')
+            lines.append(f'{m}_count{{member="{mem}"}} {h["count"]}')
     return "\n".join(lines) + "\n"

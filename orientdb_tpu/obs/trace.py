@@ -27,12 +27,18 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Dict, List, Optional
 
 from orientdb_tpu.utils.config import config
 
 _ids = itertools.count(1)
+#: process-unique id prefix: trace/span ids cross process boundaries
+#: now (obs/propagation ships them to other nodes, and the debug
+#: bundle groups by trace id), so two processes drawing from their own
+#: counters must never mint the same id
+_PROC = uuid.uuid4().hex[:8]
 _local = threading.local()
 
 
@@ -47,6 +53,13 @@ def current_trace_id() -> Optional[str]:
     """The active trace id on this thread, or None outside any span."""
     st = _stack()
     return st[-1].trace_id if st else None
+
+
+def current_span() -> Optional["span"]:
+    """The innermost active span on this thread, or None. Propagation
+    (obs/propagation.py) reads it to build the outbound context."""
+    st = _stack()
+    return st[-1] if st else None
 
 
 class span:
@@ -91,8 +104,8 @@ class span:
             self.trace_id = parent.trace_id
             self.parent_id = parent.span_id
         else:
-            self.trace_id = f"t{next(_ids):08x}"
-        self.span_id = f"s{next(_ids):08x}"
+            self.trace_id = f"t{_PROC}{next(_ids):08x}"
+        self.span_id = f"s{_PROC}{next(_ids):08x}"
         self.start_ts = time.time()
         self._t0 = time.perf_counter()
         st.append(self)

@@ -153,6 +153,9 @@ class Cluster:
         m = ClusterMember(name, server, db)
         m.role = "PRIMARY"
         enable_replication_source(db)
+        # every member's HTTP listener can now serve the fleet view
+        # (/cluster/health, /cluster/metrics — obs/cluster_view)
+        server.cluster = self
         with self._lock:
             self.members[name] = m
             self.primary = name
@@ -166,6 +169,7 @@ class Cluster:
         if db is None:
             db = server.create_database(self.dbname)
         m = ClusterMember(name, server, db)
+        server.cluster = self
         with self._lock:
             self.members[name] = m
         return m

@@ -53,23 +53,34 @@ class WriteOwner:
         raise TypeError(f"not JSON-forwardable: {type(v).__name__}")
 
     def _req(self, method: str, path: str, payload: Optional[Dict] = None):
+        from orientdb_tpu.obs.propagation import inject_headers
+        from orientdb_tpu.obs.trace import span
+
         cred = base64.b64encode(
             f"{self.user}:{self.password}".encode()
         ).decode()
-        req = urllib.request.Request(
-            f"{self.base_url}{path}",
-            data=None
-            if payload is None
-            else json.dumps(payload, default=self._json_enc).encode(),
-            headers={
-                "Authorization": f"Basic {cred}",
-                "Content-Type": "application/json",
-            },
-            method=method,
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            body = r.read()
-            return json.loads(body) if body else {}
+        # the forward is a client span; its context travels in the
+        # request headers so the owner's server span CONTINUES this
+        # trace instead of minting an unrelated one (obs/propagation)
+        with span(
+            "forward.request", method=method, path=path.split("?")[0][:80]
+        ):
+            req = urllib.request.Request(
+                f"{self.base_url}{path}",
+                data=None
+                if payload is None
+                else json.dumps(payload, default=self._json_enc).encode(),
+                headers=inject_headers(
+                    {
+                        "Authorization": f"Basic {cred}",
+                        "Content-Type": "application/json",
+                    }
+                ),
+                method=method,
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = r.read()
+                return json.loads(body) if body else {}
 
     # -- the forwarded record operations ------------------------------------
 

@@ -118,13 +118,25 @@ def apply_pushed_entries(
             # additive, so restoring twice is never safe)
             db._repl_restored_ckpt_lsn = floor
             metrics.incr("replication.full_sync")
+        from orientdb_tpu.obs.propagation import continue_trace
+
         for e in entries:
             lsn = e["lsn"]
             if lsn <= floor:
                 continue  # already here via an earlier push or a pull
             if lsn > floor + 1:
                 break  # gap: refuse; the puller will close it
-            _apply_entry(db, e)
+            # the entry carries the ORIGINATING write's trace context
+            # (stamped at WAL append): force-adopt it so the apply span
+            # joins that write's trace, not this batch's
+            with continue_trace(
+                "replication.apply_entry",
+                e.get("trace"),
+                force=True,
+                lsn=lsn,
+                source="push",
+            ):
+                _apply_entry(db, e)
             floor = lsn
             db._repl_applied_lsn = floor
     return floor
@@ -175,19 +187,31 @@ class QuorumPusher:
         self._ckpt_refused: Dict[str, float] = {}
 
     def _post(self, url: str, entries: List[Dict], **extra) -> int:
+        from orientdb_tpu.obs.propagation import inject_headers
+
         cred = base64.b64encode(
             f"{self.user}:{self.password}".encode()
         ).decode()
         body = json.dumps(
             {"entries": entries, "term": self.term, **extra}
         ).encode()
+        # each entry carries the ORIGINATING write's trace context
+        # (stamped at WAL append) — this pool thread has no span stack
+        # of its own, so the request headers borrow the first entry's
+        # stamp to keep the push visible in the writer's trace
+        ctx = next(
+            (e.get("trace") for e in entries if e.get("trace")), None
+        )
         req = urllib.request.Request(
             f"{url}/replication/{self.dbname}/apply",
             data=body,
-            headers={
-                "Authorization": f"Basic {cred}",
-                "Content-Type": "application/json",
-            },
+            headers=inject_headers(
+                {
+                    "Authorization": f"Basic {cred}",
+                    "Content-Type": "application/json",
+                },
+                ctx=ctx,
+            ),
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read()).get("applied_lsn", 0)
@@ -220,7 +244,10 @@ class QuorumPusher:
             # delta range gone (late-armed source): ship it — a FRESH
             # replica restores synchronously and the push acks without
             # waiting a pull interval; a refusal starts the cool-down
-            ok = self._post(url, [], checkpoint=payload["checkpoint"]) >= lsn
+            ok = (
+                self._post(url, [], checkpoint=payload["checkpoint"])
+                >= lsn
+            )
             if ok:
                 self._ckpt_refused.pop(url, None)
             else:
@@ -543,6 +570,8 @@ class ReplicaPuller:
             )
             if suppress:
                 self.db._tx_local.suppress_wal = True
+            from orientdb_tpu.obs.propagation import continue_trace
+
             try:
                 for e in payload["entries"]:
                     lsn = e["lsn"]
@@ -556,7 +585,16 @@ class ReplicaPuller:
                     # a failing entry must NOT be skipped: advancing past
                     # it would silently diverge the replica while
                     # reporting ONLINE — raise, count as a failure, retry
-                    _apply_entry(self.db, e)
+                    # (the apply span force-joins the ORIGINATING
+                    # write's trace, carried on the entry)
+                    with continue_trace(
+                        "replication.apply_entry",
+                        e.get("trace"),
+                        force=True,
+                        lsn=lsn,
+                        source="pull",
+                    ):
+                        _apply_entry(self.db, e)
                     self.applied_lsn = floor = lsn
                     self._set_db_floor(lsn)
                     applied += 1

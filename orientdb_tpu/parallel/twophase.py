@@ -58,7 +58,23 @@ class TwoPhaseError(Exception):
 class TxInDoubtError(Exception):
     """A participant failed AFTER the commit decision: some
     participants applied, this one did not. The coordinator surfaces
-    the partial state instead of pretending either outcome."""
+    the partial state instead of pretending either outcome.
+
+    ``report`` is the structured in-doubt record (txid, trace id,
+    committed/failed/skipped participants, unresolved temp rids) — the
+    same dict logged to :data:`INDOUBT_LOG` for the debug bundle."""
+
+    def __init__(self, msg: str, report: Optional[Dict] = None) -> None:
+        super().__init__(msg)
+        self.report = report or {}
+
+
+#: recent coordinator-side in-doubt reports, newest last — the debug
+#: bundle (obs/bundle) and /cluster/health read it; bounded so an
+#: unlucky fleet can't grow it without limit
+from collections import deque as _deque  # noqa: E402
+
+INDOUBT_LOG: "_deque" = _deque(maxlen=64)
 
 
 class TxOpError(Exception):
@@ -251,6 +267,16 @@ class TwoPhaseRegistry:
         stage's deadline so writers treat an expired lock as free even
         if no registry call ever sweeps it (presumed abort needs no
         timer thread)."""
+        from orientdb_tpu.obs.trace import span as _span
+
+        with _span(
+            "tx2pc.participant.prepare", txid=txid, ops=len(ops)
+        ):
+            self._prepare_inner(txid, ops, ttl)
+
+    def _prepare_inner(
+        self, txid: str, ops: List[Dict], ttl: float = DEFAULT_TTL
+    ):
         from orientdb_tpu.models.database import ConcurrentModificationError
 
         self.sweep()
@@ -324,6 +350,14 @@ class TwoPhaseRegistry:
         """Execute the staged batch as one local tx; release locks.
         Raises TwoPhaseError when the txid is unknown (never prepared,
         aborted, or expired — the coordinator maps that to in-doubt)."""
+        from orientdb_tpu.obs.trace import span as _span
+
+        with _span("tx2pc.participant.commit", txid=txid):
+            return self._commit_inner(txid, rid_map)
+
+    def _commit_inner(
+        self, txid: str, rid_map: Optional[Dict[str, str]] = None
+    ) -> Tuple[List[Dict], Dict[str, str]]:
         with self._mu:
             self._sweep_locked()
             st = self._staged.pop(txid, None)
@@ -349,7 +383,10 @@ class TwoPhaseRegistry:
         with self._mu:
             st = self._staged.pop(txid, None)
         if st is not None:
-            self._release(st)
+            from orientdb_tpu.obs.trace import span as _span
+
+            with _span("tx2pc.participant.abort", txid=txid):
+                self._release(st)
             metrics.incr("tx2pc.abort")
 
     def _validate_staged_create(
@@ -404,6 +441,22 @@ class TwoPhaseRegistry:
         """Presumed abort: drop staged batches past their deadline."""
         with self._mu:
             self._sweep_locked()
+
+    def staged_report(self) -> List[Dict]:
+        """JSON-friendly snapshot of the staged (prepared, undecided)
+        batches — the observability accessor (/cluster/health counts,
+        the debug bundle lists) so readers never touch the registry's
+        lock or internals."""
+        with self._mu:
+            return [
+                {
+                    "txid": st.txid,
+                    "ops": len(st.ops),
+                    "locked_rids": [str(r) for r in st.locks],
+                    "expires_in_s": round(st.deadline - time.time(), 3),
+                }
+                for st in self._staged.values()
+            ]
 
     def _sweep_locked(self) -> None:
         now = time.time()
@@ -506,49 +559,103 @@ def run_coordinator(
     dependency order, threading the accumulated rid map; a failure
     BEFORE any commit is still a clean abort, a failure after one is
     in-doubt (TxInDoubtError) but the remaining decided commits still
-    run. Returns the final temp→real rid map."""
+    run — EXCEPT participants whose ops transitively depend on a failed
+    participant's unresolved temp rids: their edge endpoints can never
+    arrive, so instead of spinning ``_load_with_wait`` for the full
+    endpoint-wait per dangling endpoint (ADVICE r5) they are skipped,
+    aborted (locks released now, not at TTL expiry), and recorded as
+    not-applied in the in-doubt report. Returns the final temp→real
+    rid map.
+
+    The whole round runs under a ``tx2pc.coordinate`` span with the
+    txid as baggage, so every participant's prepare/commit span — local
+    or across the wire — assembles into ONE trace keyed by the txid."""
+    import time as _time
+
+    from orientdb_tpu.obs.propagation import baggage
+    from orientdb_tpu.obs.trace import span
+
     order = order_participants(rows)
-    prepared: List[Participant] = []
-    try:
-        for p in parts.values():
-            p.prepare(txid)
-            prepared.append(p)
-    except Exception:
-        for p in prepared:
-            try:
-                p.abort(txid)
-            except Exception:  # pragma: no cover - best effort
-                pass
-        raise
-    rid_map: Dict[str, str] = {}
-    committed: List[object] = []
-    failures: List[str] = []
-    pending = list(order)
-    while pending:
-        key = pending.pop(0)
+    creates_of = {key: set(creates) for key, creates, _refs in rows}
+    refs_of = {key: set(refs) for key, _creates, refs in rows}
+    with span(
+        "tx2pc.coordinate", txid=txid, participants=len(parts)
+    ) as coord_sp, baggage(txid=txid):
+        prepared: List[Participant] = []
         try:
-            parts[key].commit(txid, rid_map)
-            committed.append(key)
-        except Exception as e:
-            if not committed:
-                # nothing applied anywhere yet: clean abort — including
-                # the participant whose commit call failed (abort of an
-                # already-resolved stage is a no-op; leaving it staged
-                # would hold its locks until TTL expiry)
-                for k2 in [key] + pending:
-                    try:
-                        parts[k2].abort(txid)
-                    except Exception:  # pragma: no cover - best effort
-                        pass
-                raise
-            failures.append(f"{type(e).__name__}: {e}")
-    if failures:
-        metrics.incr("tx2pc.indoubt")
-        raise TxInDoubtError(
-            "distributed tx partially applied: " + "; ".join(failures)
-        )
-    metrics.incr("tx2pc.coordinated")
-    return rid_map
+            for p in parts.values():
+                p.prepare(txid)
+                prepared.append(p)
+        except Exception:
+            for p in prepared:
+                try:
+                    p.abort(txid)
+                except Exception:  # pragma: no cover - best effort
+                    pass
+            raise
+        rid_map: Dict[str, str] = {}
+        committed: List[object] = []
+        failures: List[str] = []
+        skipped: List[object] = []
+        unresolved: set = set()  # temps a failed/skipped owner never mapped
+        pending = list(order)
+        while pending:
+            key = pending.pop(0)
+            if unresolved & refs_of.get(key, set()):
+                # this participant's edge ops reference temps whose
+                # creator failed: they will never resolve — skip and
+                # release its staged locks immediately
+                unresolved |= creates_of.get(key, set())
+                skipped.append(key)
+                try:
+                    parts[key].abort(txid)
+                except Exception:  # pragma: no cover - best effort
+                    pass
+                continue
+            try:
+                parts[key].commit(txid, rid_map)
+                committed.append(key)
+            except Exception as e:
+                if not committed:
+                    # nothing applied anywhere yet: clean abort —
+                    # including the participant whose commit call failed
+                    # (abort of an already-resolved stage is a no-op;
+                    # leaving it staged would hold its locks until TTL
+                    # expiry)
+                    for k2 in [key] + pending:
+                        try:
+                            parts[k2].abort(txid)
+                        except Exception:  # pragma: no cover
+                            pass
+                    raise
+                failures.append(f"{key}: {type(e).__name__}: {e}")
+                unresolved |= {
+                    t
+                    for t in creates_of.get(key, ())
+                    if t not in rid_map
+                }
+        if failures:
+            metrics.incr("tx2pc.indoubt")
+            report = {
+                "txid": txid,
+                "ts": round(_time.time(), 3),
+                "trace_id": coord_sp.trace_id,
+                "committed": [str(k) for k in committed],
+                "failed": failures,
+                "skipped": [str(k) for k in skipped],
+                "unresolved_temps": sorted(unresolved),
+            }
+            INDOUBT_LOG.append(report)
+            msg = "distributed tx partially applied: " + "; ".join(
+                failures
+            )
+            if skipped:
+                msg += "; skipped (dependent, not applied): " + ", ".join(
+                    str(k) for k in skipped
+                )
+            raise TxInDoubtError(msg, report)
+        metrics.incr("tx2pc.coordinated")
+        return rid_map
 
 
 def order_participants(

@@ -170,6 +170,17 @@ class _Session:
                 pass
 
     def _dispatch(self, req: dict) -> dict:
+        # the envelope's "trace" field is the binary channel's
+        # propagation carrier (obs/propagation.inject_frame on the
+        # client): this session thread CONTINUES the caller's trace
+        from orientdb_tpu.obs.propagation import continue_trace
+
+        with continue_trace(
+            f"binary.{req.get('op')}", req.get("trace")
+        ):
+            return self._dispatch_inner(req)
+
+    def _dispatch_inner(self, req: dict) -> dict:
         op = req.get("op")
         try:
             if op == "connect":

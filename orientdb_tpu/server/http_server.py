@@ -57,6 +57,30 @@ class _DeferredHttpError(Exception):
         self.msg = msg
 
 
+def _traced(fn):
+    """Wrap an HTTP verb handler in a server span that CONTINUES the
+    caller's trace when the request carries propagation headers
+    (obs/propagation) — the receiving half of cross-node tracing for
+    forwarding, 2PC phases, and quorum pushes."""
+
+    verb = fn.__name__[3:]
+
+    def wrapper(self):
+        from orientdb_tpu.obs.propagation import (
+            continue_trace,
+            extract_headers,
+        )
+
+        path = urllib.parse.urlparse(self.path).path
+        with continue_trace(
+            f"http.{verb}", extract_headers(self.headers), path=path[:120]
+        ):
+            return fn(self)
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "orientdb-tpu/0.1"
     protocol_version = "HTTP/1.1"
@@ -193,6 +217,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- verbs --------------------------------------------------------------
 
+    @_traced
     def do_GET(self):  # noqa: N802
         head, rest = self._route()
         if head in ("studio", ""):
@@ -228,16 +253,12 @@ class _Handler(BaseHTTPRequestHandler):
                 if "json" in q.get("format", []) or (
                     "application/json" in accept
                 ):
-                    from orientdb_tpu.obs.registry import obs
-                    from orientdb_tpu.utils.metrics import metrics
+                    from orientdb_tpu.obs.registry import snapshot_all
 
-                    return self._send(
-                        200,
-                        {
-                            **metrics.snapshot(),
-                            "histograms": obs.snapshot(),
-                        },
-                    )
+                    # snapshot_all is the shape /cluster/metrics fans
+                    # in per member — this endpoint must serve exactly
+                    # it, or scraped members drift from the local one
+                    return self._send(200, snapshot_all())
                 from orientdb_tpu.obs.registry import render_prometheus
 
                 body = render_prometheus().encode()
@@ -250,6 +271,60 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if head == "cluster" and rest in (["health"], ["metrics"]):
+                # fleet-level aggregation plane (obs/cluster_view):
+                # per-member liveness/role/lag/in-doubt, and the fan-in
+                # exposition that merges every member's registries into
+                # one scrape labeled by member
+                from orientdb_tpu.obs.cluster_view import (
+                    cluster_health,
+                    cluster_metrics_json,
+                    cluster_metrics_text,
+                )
+
+                if rest == ["health"]:
+                    return self._send(
+                        200, cluster_health(self.server.ot_server)
+                    )
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                if "json" in q.get("format", []) or (
+                    "application/json" in self.headers.get("Accept", "")
+                ):
+                    return self._send(
+                        200, cluster_metrics_json(self.server.ot_server)
+                    )
+                body = cluster_metrics_text(self.server.ot_server).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if head == "debug" and rest == ["bundle"]:
+                # the flight-recorder bundle (obs/bundle): recent
+                # cross-node traces assembled by trace_id, slowlog,
+                # metrics snapshot, and in-doubt 2PC state — admin-only
+                # (traces carry SQL text, like the replication stream
+                # carries records)
+                self.server.ot_server.security.check(
+                    user, "server.debug", "read"
+                )
+                from orientdb_tpu.obs.bundle import debug_bundle
+
+                srv = self.server.ot_server
+                return self._send(
+                    200,
+                    debug_bundle(
+                        dbs=list(srv.databases.values()),
+                        member=srv.name,
+                        cluster=getattr(srv, "cluster", None),
+                    ),
+                )
             if head == "replication" and len(rest) == 2:
                 # WAL shipping for replicas ([E] the distributed delta-sync
                 # request); admin-only — the stream exposes every record
@@ -337,6 +412,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             return self._error(500, f"{type(e).__name__}: {e}")
 
+    @_traced
     def do_POST(self):  # noqa: N802
         user = self._auth()
         if user is None:
@@ -592,6 +668,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(409, str(e))
             return self._error(500, f"{type(e).__name__}: {e}")
 
+    @_traced
     def do_PUT(self):  # noqa: N802
         user = self._auth()
         if user is None:
@@ -718,6 +795,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             return self._error(500, f"{type(e).__name__}: {e}")
 
+    @_traced
     def do_DELETE(self):  # noqa: N802
         user = self._auth()
         if user is None:

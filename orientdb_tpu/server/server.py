@@ -74,6 +74,11 @@ class Server:
         from orientdb_tpu.server.coalesce import QueryCoalescer
 
         self.coalescer = QueryCoalescer()
+        #: the cluster coordinator this server is a member of, set by
+        #: parallel/cluster.Cluster at registration — the aggregation
+        #: endpoints (/cluster/health, /cluster/metrics; obs/
+        #: cluster_view) read it; None for a standalone server
+        self.cluster = None
         self._lock = threading.Lock()
         self._http = None
         self._binary = None

@@ -172,6 +172,18 @@ class WriteAheadLog:
 
         t0 = _time.perf_counter()
         with span("wal.append", fsync=bool(self.fsync)) as sp:
+            # stamp the originating trace onto the entry IN PLACE:
+            # replication ships WAL entries verbatim, so a replica's
+            # apply span — on a thread that never saw the request — can
+            # join the write's trace (continue_trace force=True). The
+            # caller's dict is mutated deliberately: the quorum-push
+            # payload (_quorum_push) is built from the same object and
+            # must carry the stamp too.
+            if "trace" not in entry:
+                entry["trace"] = {
+                    "trace_id": sp.trace_id,
+                    "span_id": sp.span_id,
+                }
             lsn = self._append_inner(entry)
             sp.set("lsn", lsn)
         # the whole append — including the (group-commit) fsync wait —

@@ -11,6 +11,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   CLASSES                             BROWSE CLASS <name>
   LOAD RECORD <rid>                   EXPORT DATABASE <path>
   IMPORT DATABASE <path>              DISCONNECT / QUIT / EXIT
+  SLOWLOG [<n>|CLEAR]                 DIAG [<path>]
 """
 
 from __future__ import annotations
@@ -252,6 +253,43 @@ class Console(cmd.Cmd):
                 f"{e['ms']:>9.1f} ms  [{e['engine']}]{trace}  {e['sql']}"
             )
         self._p(f"({len(entries)} entries)")
+
+    def do_diag(self, arg: str) -> None:
+        """DIAG [<path>] — flight-recorder debug bundle (obs/bundle):
+        recent traces assembled by trace id, the slowlog, a metrics
+        snapshot, and in-doubt 2PC state. With a path, the full JSON
+        artifact is written there; either way a summary prints."""
+        import json
+
+        from orientdb_tpu.obs.bundle import debug_bundle
+
+        dbs = list(self._embedded.values())
+        if self.db is not None and self.db not in dbs:
+            dbs.append(self.db)
+        bundle = debug_bundle(dbs=dbs, member="console")
+        path = arg.strip()
+        if path:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, sort_keys=True, default=str)
+            self._p(f"debug bundle written to {path}")
+        traces = bundle["traces"]
+        n_spans = sum(len(t["spans"]) for t in traces)
+        indoubt = bundle["in_doubt_2pc"]
+        staged = sum(len(v) for v in indoubt["staged"].values())
+        self._p(
+            f"traces: {len(traces)} ({n_spans} spans)",
+            f"slowlog entries: {len(bundle['slowlog'])}",
+            f"in-doubt 2pc: {staged} staged, "
+            f"{len(indoubt['coordinator_reports'])} coordinator reports",
+            f"metric counters: {len(bundle['metrics']['counters'])}",
+        )
+        for t in traces[-3:]:
+            names = [s["name"] for s in t["spans"]]
+            self._p(
+                f"  {t['trace_id']}: "
+                + " -> ".join(names[:8])
+                + (" ..." if len(names) > 8 else "")
+            )
 
     def do_quit(self, _arg: str) -> bool:
         return True

@@ -1,0 +1,267 @@
+"""The cluster aggregation plane + debug bundle (ISSUE 2):
+`/cluster/health`, the member-labeled `/cluster/metrics` fan-in, the
+`/debug/bundle` flight recorder, the console `DIAG` command, and the
+tier-1 Prometheus text-exposition grammar lint."""
+
+import base64
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from orientdb_tpu.obs.promlint import lint_exposition
+from orientdb_tpu.obs.registry import render_prometheus
+from orientdb_tpu.obs.trace import tracer
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.server.server import Server
+
+
+def wait_for(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _get(url, user="admin", password="pw", raw=False):
+    cred = base64.b64encode(f"{user}:{password}".encode()).decode()
+    req = urllib.request.Request(
+        url, headers={"Authorization": f"Basic {cred}"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        body = r.read()
+        ctype = r.headers.get("Content-Type", "")
+    return (body.decode(), ctype) if raw else json.loads(body)
+
+
+@pytest.fixture()
+def duo():
+    """Async trio cluster with TWO write owners: n0 (primary) owns P
+    and L, n1 owns Q — the acceptance-criteria shape."""
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("f")
+    cl = Cluster("f", user="admin", password="pw", interval=0.05, down_after=2)
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    pdb.schema.create_edge_class("L")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    n1db = cl.members["n1"].db
+    assert wait_for(lambda: n1db.schema.exists_class("P"))
+    cl.assign_class_owner("Q", "n1")
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+class TestClusterHealth:
+    def test_fleet_health_document(self, duo):
+        cl, servers, pdb = duo
+        doc = _get(f"{cl.members['n0'].url}/cluster/health")
+        assert doc["cluster"]["dbname"] == "f"
+        assert doc["cluster"]["primary"] == "n0"
+        members = doc["members"]
+        assert set(members) == {"n0", "n1", "n2"}
+        assert members["n0"]["role"] == "PRIMARY"
+        for name in ("n1", "n2"):
+            assert members[name]["role"] == "REPLICA"
+            assert members[name]["alive"] is True
+            # replication lag block from the member's puller
+            assert "status" in members[name]["replication"]
+            assert "applied_lsn" in members[name]["replication"]
+        for m in members.values():
+            assert m["in_doubt_2pc"] == 0
+            assert "slowlog_depth" in m
+        # ANY member serves the fleet view, not just the primary
+        doc2 = _get(f"{cl.members['n1'].url}/cluster/health")
+        assert set(doc2["members"]) == {"n0", "n1", "n2"}
+
+    def test_standalone_server_degenerate_view(self):
+        srv = Server(admin_password="pw")
+        srv.create_database("solo")
+        srv.startup()
+        try:
+            doc = _get(
+                f"http://127.0.0.1:{srv.http_port}/cluster/health"
+            )
+            assert doc["cluster"] is None
+            (member,) = doc["members"].values()
+            assert member["role"] == "STANDALONE"
+            assert member["alive"] is True
+        finally:
+            srv.shutdown()
+
+
+class TestClusterMetrics:
+    def test_merged_exposition_labeled_and_grammar_clean(self, duo):
+        """The acceptance path: /cluster/metrics returns ONE merged
+        exposition labeled by member that passes the grammar lint."""
+        cl, servers, pdb = duo
+        pdb.new_vertex("P", uid=1)  # make sure counters exist
+        text, ctype = _get(
+            f"{cl.members['n0'].url}/cluster/metrics", raw=True
+        )
+        assert ctype.startswith("text/plain")
+        for member in ("n0", "n1", "n2"):
+            assert f'member="{member}"' in text
+        assert "orienttpu_cluster_member_up{" in text
+        problems = lint_exposition(text)
+        assert problems == [], problems
+
+    def test_json_format_returns_raw_snapshots(self, duo):
+        cl, servers, pdb = duo
+        doc = _get(
+            f"{cl.members['n0'].url}/cluster/metrics?format=json"
+        )
+        assert set(doc["members"]) == {"n0", "n1", "n2"}
+        for snap in doc["members"].values():
+            assert "counters" in snap and "histograms" in snap
+
+
+class TestPromLint:
+    def test_full_process_metrics_pass_the_grammar(self):
+        """Tier-1 gate: whatever the suite has put into the registries
+        by now, the full /metrics exposition must lint clean — a
+        malformed metric can never ship silently."""
+        problems = lint_exposition(render_prometheus())
+        assert problems == [], problems
+
+    def test_lint_catches_malformed_documents(self):
+        bad = (
+            "# TYPE ok_metric counter\n"
+            "ok_metric 1\n"
+            "bad-name 2\n"  # illegal metric name charset
+            "late_typed 3\n"
+            "# TYPE late_typed gauge\n"  # TYPE after its samples
+            'dup{a="1"} 1\n'
+            'dup{a="1"} 2\n'  # duplicate series
+            "ok_metric nope\n"  # bad value (also non-contiguous family)
+        )
+        problems = lint_exposition(bad)
+        assert any("bad-name" in p or "unparsable" in p for p in problems)
+        assert any("after its samples" in p for p in problems)
+        assert any("duplicate series" in p for p in problems)
+        assert any("bad sample value" in p for p in problems)
+        assert any("not contiguous" in p for p in problems)
+
+
+class TestDebugBundle:
+    def test_2pc_trace_assembled_in_bundle(self, duo):
+        """Acceptance: a distributed tx through run_coordinator against
+        two owners yields a single trace_id whose assembled trace (via
+        GET /debug/bundle) contains coordinator prepare/commit spans
+        and both participants' apply spans."""
+        cl, servers, pdb = duo
+        tracer.reset()
+        pdb.begin()
+        pdb.new_vertex("P", uid=1)
+        pdb.new_vertex("Q", uid=2)
+        pdb.commit()
+        bundle = _get(f"{cl.members['n0'].url}/debug/bundle")
+        coords = [
+            t
+            for t in bundle["traces"]
+            if any(
+                s["name"] == "tx2pc.coordinate" for s in t["spans"]
+            )
+        ]
+        assert coords, "no assembled trace holds the coordinator span"
+        t = coords[-1]
+        names = [s["name"] for s in t["spans"]]
+        txids = {
+            s["attrs"]["txid"]
+            for s in t["spans"]
+            if s["name"] == "tx2pc.coordinate"
+        }
+        assert len(txids) == 1
+        # ONE trace id across coordinator, wire, and both participants
+        assert all(s["trace_id"] == t["trace_id"] for s in t["spans"])
+        assert names.count("tx2pc.participant.prepare") >= 2
+        assert names.count("tx2pc.participant.commit") >= 2
+        assert "forward.request" in names and "http.POST" in names
+        # the bundle's other sections are present and well-formed
+        assert "staged" in bundle["in_doubt_2pc"]
+        assert "coordinator_reports" in bundle["in_doubt_2pc"]
+        assert "counters" in bundle["metrics"]
+        assert isinstance(bundle["slowlog"], list)
+        assert bundle["cluster"]["primary"] == "n0"
+
+    def test_bundle_surfaces_staged_in_doubt_tx(self, duo):
+        from orientdb_tpu.parallel.twophase import get_registry
+
+        cl, servers, pdb = duo
+        d = pdb.new_vertex("P", uid=5)
+        reg = get_registry(pdb)
+        reg.prepare(
+            "txstuck",
+            [
+                {
+                    "kind": "update",
+                    "rid": str(d.rid),
+                    "base_version": d.version,
+                    "fields": {"a": 1},
+                }
+            ],
+            ttl=30.0,
+        )
+        try:
+            bundle = _get(f"{cl.members['n0'].url}/debug/bundle")
+            staged = bundle["in_doubt_2pc"]["staged"]
+            assert "f" in staged
+            (entry,) = [
+                e for e in staged["f"] if e["txid"] == "txstuck"
+            ]
+            assert entry["locked_rids"] == [str(d.rid)]
+            assert entry["expires_in_s"] > 0
+            # health counts it too
+            doc = _get(f"{cl.members['n0'].url}/cluster/health")
+            assert doc["members"]["n0"]["in_doubt_2pc"] >= 1
+        finally:
+            reg.abort("txstuck")
+
+    def test_bundle_requires_admin(self, duo):
+        cl, servers, pdb = duo
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(
+                f"{cl.members['n0'].url}/debug/bundle",
+                user="reader",
+                password="reader",
+            )
+        assert ei.value.code == 403
+
+
+class TestConsoleDiag:
+    def test_diag_prints_summary_and_writes_artifact(self, tmp_path):
+        from orientdb_tpu.tools.console import Console
+
+        buf = io.StringIO()
+        c = Console(stdout=buf)
+        c.onecmd("CREATE DATABASE diagdb")
+        c.onecmd("CREATE CLASS P EXTENDS V")
+        c.onecmd("INSERT INTO P SET uid = 1")
+        c.onecmd("SELECT FROM P")
+        path = str(tmp_path / "bundle.json")
+        c.onecmd(f"DIAG {path}")
+        out = buf.getvalue()
+        assert "traces:" in out and "in-doubt 2pc:" in out
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["member"] == "console"
+        assert bundle["traces"], "bundle artifact holds no traces"
+        assert "counters" in bundle["metrics"]
+        names = {
+            s["name"] for t in bundle["traces"] for s in t["spans"]
+        }
+        assert "query" in names or "command" in names

@@ -1,0 +1,343 @@
+"""Cross-node trace propagation (obs/propagation, ISSUE 2 tentpole):
+forwarded writes, 2PC rounds, binary-protocol ops, and replication
+applies each continue the originating trace across the process
+boundary — spans from BOTH sides share one trace id. Plus the ADVICE
+r5 coordinator fix: a phase-2 failure no longer stalls dependent
+participants in `_load_with_wait`."""
+
+import time
+
+import pytest
+
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.obs.trace import span, tracer
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.server.server import Server
+
+
+def wait_for(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def count_or_zero(db, cls):
+    try:
+        return db.count_class(cls)
+    except ValueError:
+        return 0
+
+
+def trace_names(trace_id):
+    return {s.name for s in tracer.spans(trace_id=trace_id)}
+
+
+@pytest.fixture()
+def pair():
+    """Primary + one async replica, replicating database 'pr'."""
+    servers = [Server(admin_password="pw") for _ in range(2)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("pr")
+    cl = Cluster("pr", user="admin", password="pw", interval=0.05, down_after=2)
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    cl.add_replica("n1", servers[1])
+    cl.start()
+    n1db = cl.members["n1"].db
+    assert wait_for(lambda: n1db.schema.exists_class("P"))
+    yield cl, servers, pdb, n1db
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+@pytest.fixture()
+def trio():
+    """Async trio with THREE write owners: n0 (primary) owns P and L,
+    n1 owns Q, n2 owns R — the shape a coordinator-driven 2PC with a
+    dependent edge group needs."""
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("f")
+    cl = Cluster("f", user="admin", password="pw", interval=0.05, down_after=2)
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    pdb.schema.create_edge_class("L")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    n1db = cl.members["n1"].db
+    n2db = cl.members["n2"].db
+    assert wait_for(lambda: n1db.schema.exists_class("P"))
+    assert wait_for(lambda: n2db.schema.exists_class("P"))
+    cl.assign_class_owner("Q", "n1")
+    cl.assign_class_owner("R", "n2")
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+class TestForwardedWrite:
+    def test_forwarded_create_continues_one_trace(self, pair):
+        """A write on a non-owner member forwards to the owner; the
+        forwarder's client span and the owner's server span (plus the
+        owner-side write spans under it) share ONE trace id."""
+        cl, servers, pdb, n1db = pair
+        with span("client.save") as root:
+            doc = n1db.new_vertex("P", uid=77)
+        assert doc.rid.is_persistent
+        names = trace_names(root.trace_id)
+        # forwarder side + owner side, one trace
+        assert "forward.request" in names
+        assert "http.POST" in names
+        # the owner-side server span really is PARENTED on the
+        # forwarder's span, not just id-stamped
+        srv_spans = [
+            s
+            for s in tracer.spans(trace_id=root.trace_id)
+            if s.name == "http.POST"
+        ]
+        fwd = [
+            s
+            for s in tracer.spans(trace_id=root.trace_id)
+            if s.name == "forward.request"
+        ]
+        assert srv_spans[-1].parent_id == fwd[-1].span_id
+
+    def test_forwarded_update_mvcc_conflict_span_records_error(self, pair):
+        cl, servers, pdb, n1db = pair
+        from orientdb_tpu.models.database import (
+            ConcurrentModificationError,
+        )
+
+        d = pdb.new_vertex("P", uid=1)
+        assert wait_for(lambda: n1db.load(d.rid) is not None)
+        stale = n1db.load(d.rid)
+        stale_version = stale.version
+        # owner-side write bumps the version
+        cur = pdb.load(d.rid)
+        cur.set("x", 1)
+        pdb.save(cur)
+        with span("client.conflict") as root:
+            with pytest.raises(ConcurrentModificationError):
+                n1db._write_owner.update(
+                    d.rid, {"x": 9}, base_version=stale_version
+                )
+        names = trace_names(root.trace_id)
+        assert "forward.request" in names and "http.PUT" in names
+
+
+class TestTwoPhaseTrace:
+    def test_cross_owner_tx_assembles_one_trace(self, trio):
+        """Coordinator + every participant (local registry AND remote
+        over the wire) share the coordinate span's trace; the txid
+        baggage lands on the wire-crossing spans."""
+        cl, servers, pdb = trio
+        tracer.reset()
+        pdb.begin()
+        pdb.new_vertex("P", uid=1)
+        pdb.new_vertex("Q", uid=2)
+        pdb.commit()
+        coords = tracer.spans(name="tx2pc.coordinate")
+        assert coords, "no coordinator span recorded"
+        coord = coords[-1]
+        txid = coord.attrs["txid"]
+        got = tracer.spans(trace_id=coord.trace_id)
+        names = [s.name for s in got]
+        # both participants prepared and committed inside ONE trace
+        assert names.count("tx2pc.participant.prepare") >= 2
+        assert names.count("tx2pc.participant.commit") >= 2
+        # the wire hop is in the same trace too
+        assert "forward.request" in names and "http.POST" in names
+        # participant spans carry the txid (attr at the participant,
+        # baggage-propagated onto the remote server span)
+        for s in got:
+            if s.name.startswith("tx2pc.participant."):
+                assert s.attrs.get("txid") == txid
+        assert any(
+            s.name == "http.POST" and s.attrs.get("txid") == txid
+            for s in got
+        )
+
+
+class TestBinaryPropagation:
+    def test_remote_query_continues_client_trace(self):
+        from orientdb_tpu.client.remote import connect
+
+        srv = Server(admin_password="pw")
+        db = srv.create_database("binprop")
+        db.schema.create_vertex_class("P")
+        db.new_vertex("P", uid=1)
+        srv.startup()
+        try:
+            rdb = connect(
+                f"remote:127.0.0.1:{srv.binary_port}/binprop",
+                "admin",
+                "pw",
+            )
+            with span("client.remote_query") as root:
+                rows = rdb.query("SELECT uid FROM P").to_dicts()
+            assert rows == [{"uid": 1}]
+            got = tracer.spans(trace_id=root.trace_id)
+            binq = [s for s in got if s.name == "binary.query"]
+            assert binq, "server session span did not join the trace"
+            # the server-side session span is PARENTED on the client's
+            # span (the frame's "trace" field carried it). The query
+            # itself runs on the coalescer worker pool — a documented
+            # propagation boundary; the session span is the wire hop.
+            assert binq[-1].parent_id == root.span_id
+            rdb.close()
+        finally:
+            srv.shutdown()
+
+
+class TestReplicationApplyTrace:
+    def test_pulled_apply_joins_the_originating_write_trace(self):
+        """The WAL entry carries the write's trace context; a replica's
+        per-entry apply span — pulled later on a thread that never saw
+        the request — force-joins that trace."""
+        from orientdb_tpu.parallel.replication import (
+            ReplicaPuller,
+            enable_replication_source,
+        )
+
+        srv = Server(admin_password="pw")
+        d = srv.create_database("reptrace")
+        enable_replication_source(d)
+        d.schema.create_vertex_class("P")
+        with span("client.write") as root:
+            d.new_vertex("P", uid=1)
+        srv.startup()
+        try:
+            rep = ReplicaPuller(
+                f"http://127.0.0.1:{srv.http_port}",
+                "reptrace",
+                Database("reptrace_r"),
+                user="admin",
+                password="pw",
+            )
+            assert rep.pull_once() > 0
+            assert rep.db.count_class("P") == 1
+        finally:
+            srv.shutdown()
+        names = trace_names(root.trace_id)
+        assert "wal.append" in names
+        assert "replication.apply_entry" in names
+        applies = [
+            s
+            for s in tracer.spans(trace_id=root.trace_id)
+            if s.name == "replication.apply_entry"
+        ]
+        # parented on the write's wal.append span (the stamped context)
+        appends = {
+            s.span_id
+            for s in tracer.spans(trace_id=root.trace_id)
+            if s.name == "wal.append"
+        }
+        assert applies[-1].parent_id in appends
+
+    def test_quorum_push_apply_joins_the_write_trace(self):
+        """Synchronous quorum replication: the push headers AND the
+        entry stamp both tie the replica's apply back to the writer."""
+        servers = [Server(admin_password="pw") for _ in range(2)]
+        for s in servers:
+            s.startup()
+        cl = Cluster(
+            "qp",
+            user="admin",
+            password="pw",
+            interval=0.05,
+            down_after=2,
+            write_quorum="majority",
+        )
+        pdb = servers[0].create_database("qp")
+        cl.set_primary("n0", servers[0], pdb)
+        pdb.schema.create_vertex_class("P")
+        cl.add_replica("n1", servers[1])
+        cl.start()
+        try:
+            n1db = cl.members["n1"].db
+            assert wait_for(lambda: n1db.schema.exists_class("P"))
+            with span("client.quorum_write") as root:
+                pdb.new_vertex("P", uid=5)
+            # the write blocked on the majority ack, so the apply span
+            # is already recorded
+            names = trace_names(root.trace_id)
+            assert "replication.apply_entry" in names
+            assert "http.POST" in names  # the push request itself
+        finally:
+            cl.stop()
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:
+                    pass
+
+
+class TestPhase2DependencySkip:
+    def test_dependent_participant_skipped_without_stall(self, trio):
+        """ADVICE r5: after a phase-2 failure, a pending participant
+        whose edge ops reference the failed participant's unresolved
+        temps resolves IMMEDIATELY (skipped + aborted + reported as
+        not-applied), instead of polling `_load_with_wait` for the full
+        10 s per dangling endpoint."""
+        from orientdb_tpu.parallel.forwarding import WriteOwner
+        from orientdb_tpu.parallel.twophase import (
+            INDOUBT_LOG,
+            TxInDoubtError,
+        )
+
+        cl, servers, pdb = trio
+        real = WriteOwner.tx2pc
+        calls = {"commit": 0}
+
+        def failing(self, phase, txid, **kw):
+            if phase == "commit":
+                calls["commit"] += 1
+                if calls["commit"] == 2:
+                    # the SECOND remote commit fails: the first already
+                    # applied, so the coordinator is in-doubt — and the
+                    # local edge group depends on the failed owner's
+                    # unresolved temp rid
+                    raise OSError("injected wire failure at commit")
+            return real(self, phase, txid, **kw)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(WriteOwner, "tx2pc", failing):
+            pdb.begin()
+            q = pdb.new_vertex("Q", uid=1)
+            r = pdb.new_vertex("R", uid=2)
+            pdb.new_edge("L", q, r)
+            t0 = time.monotonic()
+            with pytest.raises(TxInDoubtError) as ei:
+                pdb.commit()
+            elapsed = time.monotonic() - t0
+        # the fix: no 10s-per-endpoint _load_with_wait stall
+        assert elapsed < 5.0, f"coordinator stalled {elapsed:.1f}s"
+        report = ei.value.report
+        assert report["committed"], "in-doubt implies one applied"
+        assert len(report["failed"]) == 1
+        # the dependent local edge group was skipped, not stalled, and
+        # is recorded as not-applied
+        assert report["skipped"] == ["local"]
+        assert report["unresolved_temps"]
+        assert INDOUBT_LOG and INDOUBT_LOG[-1]["txid"] == report["txid"]
+        # nothing of the skipped group applied
+        assert pdb.count_class("L") == 0
+        # locks were released by the skip-abort: a fresh local write to
+        # the same class succeeds immediately
+        pdb.new_vertex("P", uid=9)
+        assert pdb.count_class("P") == 1
