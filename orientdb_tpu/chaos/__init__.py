@@ -1,0 +1,30 @@
+"""Deterministic fault injection for partial-failure hardening.
+
+``from orientdb_tpu.chaos import fault`` and wrap inter-node I/O in
+``with fault.point("<name>"): ...``; tests arm a seeded
+:class:`~orientdb_tpu.chaos.faults.FaultPlan` to drop/delay/error/crash
+at those points reproducibly. ``orientdb_tpu/chaos/iolint.py`` is the
+tier-1 lint keeping every channel routed through a point.
+"""
+
+from orientdb_tpu.chaos.faults import (  # noqa: F401
+    POINTS,
+    FaultDropped,
+    FaultError,
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    SimulatedCrash,
+    fault,
+)
+
+__all__ = [
+    "POINTS",
+    "FaultDropped",
+    "FaultError",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultRule",
+    "SimulatedCrash",
+    "fault",
+]

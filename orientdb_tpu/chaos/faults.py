@@ -1,0 +1,253 @@
+"""Deterministic, seedable fault injection with NAMED points.
+
+The chaos tests so far monkeypatch one transport method per test
+(``QuorumPusher._post`` in ``tests/test_replication_chaos.py``) — a
+shape that cannot compose (one wrapper per test), cannot target the
+other channels (forwarding, 2PC phases, WAL fsync, the binary framing)
+and is not reproducible across runs. This module makes fault injection
+first-class:
+
+- every inter-node I/O site (and the WAL fsync) is wrapped in a NAMED
+  injection point: ``with fault.point("repl.push"): urlopen(...)``.
+  The catalog is :data:`POINTS`; the AST lint
+  (``orientdb_tpu/chaos/iolint.py``) keeps new channels from bypassing
+  it.
+- a :class:`FaultPlan` is a seeded schedule of :class:`FaultRule`\\ s
+  per point — drop / delay / error / crash actions, each with a match
+  count, a skip count, and a firing probability drawn from the plan's
+  OWN ``random.Random(seed)`` so a failing chaos run replays exactly.
+- arming is process-wide (``fault.arm(plan)`` / ``fault.disarm()``)
+  and cheap when disarmed: the fast path is one attribute read.
+
+Actions:
+
+``drop``
+    raise :class:`FaultDropped` (an ``OSError``): the message vanished
+    on the wire — callers see exactly a channel failure.
+``delay``
+    sleep ``delay_s`` then proceed (slow network / fsync stall).
+``error``
+    raise the rule's exception instance/factory (defaults to
+    :class:`FaultError`).
+``crash``
+    raise :class:`SimulatedCrash` — a ``BaseException`` so it ESCAPES
+    ordinary ``except Exception`` recovery exactly like a process
+    death would; tests catch it at the "process" boundary and restart
+    the member from its durability directory (the durable-2PC recovery
+    path, ``storage/durability.open_database``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("chaos")
+
+#: the documented injection-point catalog (README "Failure modes &
+#: recovery" lists what each one covers). Sites may add dynamic
+#: suffixes; the lint only requires membership of a point CALL, not of
+#: this set — the set is the operator-facing index.
+POINTS = frozenset(
+    {
+        "fwd.req",  # WriteOwner._req: every forwarded HTTP request
+        "repl.push",  # QuorumPusher._post: quorum-push apply RPC
+        "repl.pull",  # ReplicaPuller.pull_once: delta-pull request
+        "tx2pc.prepare",  # participant phase-1 (both flavors)
+        "tx2pc.commit",  # participant phase-2 commit
+        "tx2pc.abort",  # participant abort
+        "tx2pc.decide",  # coordinator between phase 1 and phase 2
+        "wal.fsync",  # WriteAheadLog append (write+flush+fsync)
+        "bin.send",  # binary-protocol frame send (client and server)
+        "bin.recv",  # binary-protocol frame receive
+        "bin.connect",  # client socket connect
+        "cluster.probe",  # /cluster/health member probe + scrape
+    }
+)
+
+
+class FaultError(OSError):
+    """Generic injected failure (the ``error`` action's default)."""
+
+
+class FaultDropped(FaultError):
+    """The ``drop`` action: the message was lost on the wire."""
+
+
+class SimulatedCrash(BaseException):
+    """The ``crash`` action: simulated process death. Inherits
+    BaseException deliberately so ``except Exception`` recovery paths
+    do NOT swallow it — in-process chaos tests need the 'crash' to
+    unwind like a real SIGKILL, then restart the member from disk."""
+
+
+class FaultRule:
+    """One scheduled fault at one point.
+
+    ``times``  — fire at most this many matches (None = unlimited);
+    ``after``  — skip this many matching hits first;
+    ``p``      — firing probability per hit, drawn from the PLAN's rng;
+    ``action`` — drop | delay | error | crash.
+    """
+
+    __slots__ = ("point", "action", "times", "after", "p", "delay_s",
+                 "error", "fired", "_skipped")
+
+    def __init__(
+        self,
+        point: str,
+        action: str,
+        times: Optional[int] = 1,
+        after: int = 0,
+        p: float = 1.0,
+        delay_s: float = 0.05,
+        error: Optional[Callable[[], BaseException]] = None,
+    ) -> None:
+        if action not in ("drop", "delay", "error", "crash"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.point = point
+        self.action = action
+        self.times = times
+        self.after = after
+        self.p = p
+        self.delay_s = delay_s
+        self.error = error
+        self.fired = 0
+        self._skipped = 0
+
+    def _take(self, rng) -> bool:
+        """Decide (under the injector lock) whether this hit fires."""
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self._skipped < self.after:
+            self._skipped += 1
+            return False
+        if self.p < 1.0 and rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+
+class FaultPlan:
+    """A seeded schedule of rules; build with chained :meth:`at` calls:
+
+    >>> plan = FaultPlan(seed=7).at("repl.push", "drop", times=2)
+    ...                          .at("wal.fsync", "delay", delay_s=0.1)
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        import random
+
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.rules: Dict[str, List[FaultRule]] = {}
+
+    def at(self, point: str, action: str, **kw) -> "FaultPlan":
+        self.rules.setdefault(point, []).append(
+            FaultRule(point, action, **kw)
+        )
+        return self
+
+    def fired(self, point: Optional[str] = None) -> int:
+        """Total fires (for one point, or the whole plan)."""
+        rules = (
+            self.rules.get(point, [])
+            if point is not None
+            else [r for rs in self.rules.values() for r in rs]
+        )
+        return sum(r.fired for r in rules)
+
+
+class FaultInjector:
+    """Process-wide injection registry. The no-plan fast path is one
+    attribute read, so production code pays ~nothing for the points."""
+
+    def __init__(self) -> None:
+        self._plan: Optional[FaultPlan] = None
+        self._lock = threading.Lock()
+        #: point -> hit count (armed or not) — the coverage ledger the
+        #: chaos tests assert against ("every named point was crossed")
+        self.hits: Dict[str, int] = {}
+        self._count_hits = False
+
+    # -- arming -------------------------------------------------------------
+
+    def arm(self, plan: FaultPlan) -> FaultPlan:
+        with self._lock:
+            self._plan = plan
+        log.warning("chaos: armed plan seed=%s rules=%s", plan.seed,
+                    sorted(plan.rules))
+        return plan
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._plan = None
+
+    @contextmanager
+    def armed(self, plan: FaultPlan):
+        """``with fault.armed(plan): ...`` — disarms on exit, always."""
+        self.arm(plan)
+        try:
+            yield plan
+        finally:
+            self.disarm()
+
+    def record_hits(self, on: bool = True) -> None:
+        """Toggle the coverage ledger (off by default: the ledger dict
+        write is the only per-hit cost worth avoiding in production)."""
+        self._count_hits = on
+        if on:
+            self.hits.clear()
+
+    # -- the injection point -------------------------------------------------
+
+    @contextmanager
+    def point(self, name: str):
+        """Mark one inter-node I/O (or durability) site. Fires any
+        armed rule BEFORE the wrapped block runs — a dropped/delayed
+        message never reaches the channel, like a real network fault."""
+        self.check(name)
+        yield
+
+    def check(self, name: str) -> None:
+        """The non-context form for call sites that cannot nest a
+        ``with`` (rarely needed; the lint only accepts ``point``)."""
+        plan = self._plan
+        if plan is None and not self._count_hits:
+            return
+        rule = None
+        with self._lock:
+            if self._count_hits:
+                self.hits[name] = self.hits.get(name, 0) + 1
+            if plan is not None:
+                for r in plan.rules.get(name, ()):
+                    if r._take(plan.rng):
+                        rule = r
+                        break
+        if rule is None:
+            return
+        metrics.incr(f"chaos.fired.{name}")
+        if rule.action == "delay":
+            log.warning("chaos: delay %.3fs at %s", rule.delay_s, name)
+            time.sleep(rule.delay_s)
+            return
+        if rule.action == "drop":
+            log.warning("chaos: drop at %s", name)
+            raise FaultDropped(f"[chaos] message dropped at {name}")
+        if rule.action == "error":
+            err = rule.error() if callable(rule.error) else rule.error
+            if err is None:
+                err = FaultError(f"[chaos] injected error at {name}")
+            log.warning("chaos: error at %s: %r", name, err)
+            raise err
+        log.warning("chaos: CRASH at %s", name)
+        raise SimulatedCrash(f"[chaos] simulated process crash at {name}")
+
+
+#: the process-wide injector (mirrors utils.metrics.metrics)
+fault = FaultInjector()

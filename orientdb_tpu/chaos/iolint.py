@@ -1,0 +1,149 @@
+"""AST lint: every inter-node I/O call site routes through a fault point.
+
+The chaos subsystem (``chaos/faults.py``) only covers what is wrapped —
+a NEW channel added without a ``fault.point("...")`` would silently
+bypass both injection and the resilience story built on it (breakers,
+the chaos acceptance suite). This lint makes that a tier-1 failure
+(pattern: ``obs/promlint.py``'s grammar lint): it parses every module
+under ``orientdb_tpu/{parallel,server,client,obs}/`` and asserts that
+any top-level function or method performing raw inter-node I/O —
+``urlopen``, socket ``sendall``/``recv``/``create_connection`` — also
+contains a ``*.point(...)`` call somewhere in its body (nested helper
+functions count as part of their enclosing def).
+
+``EXEMPT`` names the deliberate exceptions: helpers whose ONLY callers
+already hold the point (so a second point would double-fire per
+operation). Enforced by ``tests/test_chaos_faults.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Tuple
+
+#: package-relative directories scanned for inter-node I/O
+SCAN_DIRS = ("parallel", "server", "client", "obs")
+
+#: bare-name calls that are inter-node I/O
+IO_NAMES = frozenset({"urlopen", "create_connection"})
+#: attribute calls that are inter-node I/O (sock.sendall, sock.recv,
+#: urllib.request.urlopen, socket.create_connection)
+IO_ATTRS = frozenset({"urlopen", "sendall", "recv", "create_connection"})
+
+#: (module-relative path, function name) pairs allowed to do raw I/O
+#: without their own point — every caller holds one already
+EXEMPT = frozenset(
+    {
+        # recv_frame wraps the frame read in fault.point("bin.recv");
+        # _recv_exact is its private chunk loop
+        ("server/binary_server.py", "_recv_exact"),
+    }
+)
+
+
+def _is_io_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in IO_NAMES
+    if isinstance(f, ast.Attribute):
+        return f.attr in IO_ATTRS
+    return False
+
+
+def _is_point_call(call: ast.Call) -> bool:
+    f = call.func
+    return isinstance(f, ast.Attribute) and f.attr == "point"
+
+
+def _outermost_functions(
+    tree: ast.Module,
+) -> Iterator[ast.FunctionDef]:
+    """Top-level functions and class methods — nested defs (closures,
+    local helpers) are checked as part of their enclosing function."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield sub
+
+
+def lint_source(src: str, rel: str) -> List[str]:
+    """Lint one module's source; returns problems (empty = clean)."""
+    problems: List[str] = []
+    tree = ast.parse(src, filename=rel)
+    for fn in _outermost_functions(tree):
+        calls = [
+            n for n in ast.walk(fn) if isinstance(n, ast.Call)
+        ]
+        if not any(_is_io_call(c) for c in calls):
+            continue
+        if (rel, fn.name) in EXEMPT:
+            continue
+        if not any(_is_point_call(c) for c in calls):
+            problems.append(
+                f"{rel}:{fn.lineno}: {fn.name}() performs inter-node "
+                "I/O with no fault.point(...) — wrap the call site in a "
+                "named injection point (chaos/faults.py) or add an "
+                "EXEMPT entry with a justification"
+            )
+    return problems
+
+
+def lint_package(root: str = None) -> List[str]:
+    """Lint every module under the scanned directories; returns all
+    problems found (empty = every channel is injectable)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    problems: List[str] = []
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _dirs, files in os.walk(base):
+            for f in sorted(files):
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, f)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                try:
+                    problems.extend(lint_source(src, rel))
+                except SyntaxError as e:  # pragma: no cover
+                    problems.append(f"{rel}: unparsable: {e}")
+    return problems
+
+
+def _iter_points(root: str = None) -> List[Tuple[str, int, str]]:
+    """Every literal point name used in the scanned tree (for the
+    catalog cross-check): (rel path, line, name)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: List[Tuple[str, int, str]] = []
+    for d in SCAN_DIRS + ("storage", "exec", "chaos"):
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _dirs, files in os.walk(base):
+            for f in sorted(files):
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, f)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path, "r", encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=rel)
+                for n in ast.walk(tree):
+                    if (
+                        isinstance(n, ast.Call)
+                        and _is_point_call(n)
+                        and n.args
+                        and isinstance(n.args[0], ast.Constant)
+                        and isinstance(n.args[0].value, str)
+                    ):
+                        out.append((rel, n.lineno, n.args[0].value))
+    return out

@@ -27,6 +27,22 @@ class RemoteConnectionError(RemoteError):
     server-reported request error."""
 
 
+class ServerOverloadedError(RemoteError):
+    """The server shed this request with admission control (code 503)
+    BEFORE executing it — safe to retry any op, idempotent or not, after
+    honoring ``retry_after`` (the server's backoff hint)."""
+
+    def __init__(self, msg: str, retry_after: float = 0.5) -> None:
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class _ReconnectFailed(RemoteConnectionError):
+    """No member accepted a connection during a failover scan — kept
+    retryable (under the client's RetryPolicy budget) because a
+    flapping cluster is often back moments later."""
+
+
 class RemoteResultSet:
     """List-backed result mirror of the embedded ResultSet surface."""
 
@@ -92,7 +108,12 @@ class RemoteDatabase:
     # -- channel ------------------------------------------------------------
 
     def _connect(self) -> None:
-        self._sock = socket.create_connection((self.host, self.port), timeout=30)
+        from orientdb_tpu.chaos import fault
+
+        with fault.point("bin.connect"):
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=30
+            )
         resp = self._call({"op": "connect", "user": self._user, "password": self._password})
         if not resp.get("ok"):
             raise RemoteError(resp.get("error", "connect failed"))
@@ -227,6 +248,11 @@ class RemoteDatabase:
     def _checked(self, req: dict) -> dict:
         resp = self._call(req)
         if not resp.get("ok"):
+            if resp.get("code") == 503:
+                raise ServerOverloadedError(
+                    resp.get("error", "server overloaded"),
+                    retry_after=float(resp.get("retry_after", 0.5)),
+                )
             raise RemoteError(resp.get("error", "request failed"))
         return resp
 
@@ -452,10 +478,16 @@ class FailoverDatabase:
     failover: `remote:host1;host2/<db>`).
 
     Wraps a RemoteDatabase; a channel failure (RemoteConnectionError /
-    OSError) rotates to the next address and retries the call once per
-    address. Server-reported errors (bad SQL, permission denied) are NOT
-    failed over. For a replicated cluster the list is the member servers:
-    after a failover the promoted member serves the reconnect."""
+    OSError) rotates to the next address and retries under the shared
+    :class:`~orientdb_tpu.parallel.resilience.RetryPolicy` — capped
+    JITTERED backoff with a total budget, so a flapping cluster is not
+    hammered by every client in lockstep. Admission-control 503s
+    (:class:`ServerOverloadedError`) are retried for EVERY op (the
+    server shed them before execution) after honoring their
+    ``retry_after`` hint. Server-reported errors (bad SQL, permission
+    denied) are NOT failed over. For a replicated cluster the list is
+    the member servers: after a failover the promoted member serves the
+    reconnect."""
 
     def __init__(
         self,
@@ -465,13 +497,19 @@ class FailoverDatabase:
         password: str,
         serialization: str = "json",
         pipeline: bool = False,
+        retry_policy=None,
     ) -> None:
+        from orientdb_tpu.parallel.resilience import RetryPolicy
+
         self._addrs = list(addrs)
         self._name, self._user, self._password = name, user, password
         self._serialization = serialization
         self._pipeline = pipeline
         self._db: Optional[RemoteDatabase] = None
         self._lock = threading.Lock()
+        self._policy = retry_policy or RetryPolicy(
+            attempts=4, base_s=0.05, cap_s=1.0, budget_s=8.0
+        )
         self._connect_any()
 
     @property
@@ -498,13 +536,22 @@ class FailoverDatabase:
         raise RemoteError(f"no reachable server in {self._addrs}: {last}")
 
     def _retry(self, method: str, *a, idempotent: bool = True):
-        with self._lock:
-            if getattr(self, "_closed", False):
-                raise RemoteError("client is closed")
+        from orientdb_tpu.parallel.resilience import RetryBudgetExceeded
+
+        class _Ambiguous(Exception):
+            """Channel died mid-op on a non-idempotent call: never
+            retried (at-most-once), surfaced as the ambiguity below."""
+
+        def attempt():
             if self._db is None:
-                # a previous total outage left no connection; servers may
-                # be back — reconnect before giving up on the client object
-                self._connect_any()
+                # a previous failure left no connection; servers may be
+                # back — reconnect (retryable under the policy budget)
+                try:
+                    self._connect_any()
+                except RemoteConnectionError:
+                    raise
+                except RemoteError as e:
+                    raise _ReconnectFailed(str(e)) from e
             try:
                 return getattr(self._db, method)(*a)
             except (RemoteConnectionError, OSError) as e:
@@ -512,17 +559,51 @@ class FailoverDatabase:
                 # demote the failed head so reconnection scans the OTHER
                 # members first (the dead host may hang, not refuse)
                 self._addrs = self._addrs[1:] + self._addrs[:1]
-                self._connect_any()
+                try:
+                    self._connect_any()
+                except RemoteError:
+                    pass  # next policy attempt (or the caller) reconnects
                 if not idempotent:
                     # at-most-once for writes: the dead channel may have
                     # delivered the op before failing — resending could
                     # apply it twice, so surface the ambiguity instead
-                    raise RemoteConnectionError(
+                    raise _Ambiguous(
                         f"connection failed mid-{method}; reconnected to "
                         f"{self._addrs[0]} but the op was NOT retried "
                         "(outcome on the old server unknown)"
                     ) from e
-                return getattr(self._db, method)(*a)
+                raise
+
+        def locked_attempt():
+            # the lock covers ONE attempt (the connection objects are
+            # not thread-safe), not the whole policy loop: backoff
+            # sleeps between attempts must not stall every other
+            # thread's operation on this client
+            with self._lock:
+                if getattr(self, "_closed", False):
+                    raise RemoteError("client is closed")
+                return attempt()
+
+        try:
+            # 503-shed ops are retried regardless of idempotence
+            # (the server refused them BEFORE execution), honoring
+            # the server's retry_after hint over the jitter
+            return self._policy.call(
+                locked_attempt,
+                retry_on=(
+                    RemoteConnectionError,
+                    OSError,
+                    ServerOverloadedError,
+                ),
+                give_up_on=(_Ambiguous,),
+            )
+        except _Ambiguous as e:
+            raise RemoteConnectionError(str(e)) from e.__cause__
+        except RetryBudgetExceeded as e:
+            cause = e.__cause__
+            if isinstance(cause, RemoteError):
+                raise cause
+            raise RemoteConnectionError(str(e)) from cause
 
     def query(self, sql, params=None):
         return self._retry("query", sql, params)

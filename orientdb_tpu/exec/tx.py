@@ -418,6 +418,7 @@ class Transaction:
             def prepare(self, txid: str) -> None:
                 import time as _t
 
+                from orientdb_tpu.chaos import fault
                 from orientdb_tpu.obs.trace import span as _span
 
                 deadline = _t.time() + tp.DEFAULT_TTL
@@ -427,7 +428,7 @@ class Transaction:
                     "tx2pc.participant.prepare",
                     txid=txid,
                     ops=len(outer.dirty) + len(local_creates),
-                ), db._lock:
+                ), fault.point("tx2pc.prepare"), db._lock:
                     for rid, base in outer.dirty.items():
                         db._check_2pc_lock(rid)
                         stored = db._load_raw(rid)
@@ -456,11 +457,14 @@ class Transaction:
                     self.locked = []
 
             def commit(self, txid: str, rid_map: Dict[str, str]) -> None:
+                from orientdb_tpu.chaos import fault
                 from orientdb_tpu.obs.trace import span as _span
 
                 db._tx_local.tx2pc_commit = txid
                 try:
-                    with _span("tx2pc.participant.commit", txid=txid):
+                    with _span(
+                        "tx2pc.participant.commit", txid=txid
+                    ), fault.point("tx2pc.commit"):
                         outer._substitute_local_edges(db, rid_map)
                         with db._quorum_deferral():
                             with db._lock:
@@ -502,7 +506,7 @@ class Transaction:
                 batch["owner"], batch["ops"], _adopt
             )
         try:
-            tp.run_coordinator(txid, parts, rows)
+            tp.run_coordinator(txid, parts, rows, coord_db=db)
         except tp.TxInDoubtError:
             # some participants applied: the tx is spent either way
             if self.active:
@@ -632,6 +636,12 @@ class Transaction:
                 db._tx_local.wal_buffer = None
             if db._wal is not None and wal_ops and not db._wal.replaying:
                 tx_entry = {"op": "tx", "ops": wal_ops}
+                txid2pc = getattr(db._tx_local, "tx2pc_commit", None)
+                if txid2pc:
+                    # stamp the distributed txid: recovery classifies
+                    # this txid as decided-commit (parallel/twophase.
+                    # recover_from_wal) instead of re-staging it
+                    tx_entry["txid2pc"] = txid2pc
                 lsn = db._wal.append(tx_entry)
                 db._mark_ckpt_dirty(tx_entry)
                 # quorum mode: the whole tx ships as ONE atomic entry and

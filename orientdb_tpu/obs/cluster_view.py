@@ -49,12 +49,15 @@ PROBE_TIMEOUT = 1.5
 
 
 def _get_json(url: str, user: str, password: str) -> Dict:
+    from orientdb_tpu.chaos import fault
+
     cred = base64.b64encode(f"{user}:{password}".encode()).decode()
     req = urllib.request.Request(
         url, headers={"Authorization": f"Basic {cred}"}
     )
-    with urllib.request.urlopen(req, timeout=PROBE_TIMEOUT) as r:
-        return json.loads(r.read())
+    with fault.point("cluster.probe"):
+        with urllib.request.urlopen(req, timeout=PROBE_TIMEOUT) as r:
+            return json.loads(r.read())
 
 
 def _staged_2pc(db) -> int:
@@ -89,6 +92,9 @@ def cluster_health(server) -> Dict:
     """The fleet health document. ``server`` is the answering member's
     ``server.Server``; without an attached cluster the view degrades to
     this one node."""
+    from orientdb_tpu.parallel.resilience import breaker_snapshot
+    from orientdb_tpu.parallel.twophase import resolver
+
     cluster = getattr(server, "cluster", None)
     if cluster is None:
         from orientdb_tpu.obs.slowlog import slowlog
@@ -106,6 +112,8 @@ def cluster_health(server) -> Dict:
                     "slowlog_depth": len(slowlog.entries()),
                 }
             },
+            "breakers": breaker_snapshot(),
+            "indoubt_pending": resolver.pending(),
         }
     with cluster._lock:
         members = dict(cluster.members)
@@ -128,6 +136,10 @@ def cluster_health(server) -> Dict:
             "failovers": failovers,
         },
         "members": out_members,
+        # per-channel circuit-breaker state (parallel/resilience) and
+        # the coordinator-side in-doubt backlog the probe is resolving
+        "breakers": breaker_snapshot(),
+        "indoubt_pending": resolver.pending(),
     }
 
 

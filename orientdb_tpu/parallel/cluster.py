@@ -111,6 +111,14 @@ class Cluster:
         self.primary: Optional[str] = None
         self._lock = threading.RLock()
         self.failovers = 0
+        #: periodic maintenance probe (partial-failure hardening): every
+        #: probe_interval it sweeps each member's 2PC registry (so an
+        #: IDLE member's expired staged locks release — presumed abort
+        #: needs no traffic) and drives the in-doubt resolver
+        #: (parallel/twophase.resolver) toward termination
+        self.probe_interval = max(interval, 0.25)
+        self._probe_stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
 
     # -- quorum plumbing ----------------------------------------------------
 
@@ -181,9 +189,55 @@ class Cluster:
             for m in self.members.values():
                 if m.role == "REPLICA" and m.puller is None:
                     self._start_puller(m)
+            # under the lock: two concurrent start() calls must not
+            # each observe None and spawn duplicate probe loops (the
+            # overwritten handle would never be joined by stop())
+            if self._probe_thread is None:
+                self._probe_stop.clear()
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop,
+                    name="cluster-probe",
+                    daemon=True,
+                )
+                self._probe_thread.start()
         return self
 
+    def probe_once(self) -> None:
+        """One maintenance round: sweep every member's 2PC registry
+        (releasing expired staged locks on QUIET members — before this,
+        presumed abort only fired when another registry call happened
+        to arrive) and give the in-doubt resolver a resolution round."""
+        from orientdb_tpu.parallel.twophase import resolver
+
+        with self._lock:
+            dbs = [m.db for m in self.members.values()]
+        for db in dbs:
+            reg = getattr(db, "_tx2pc_registry", None)
+            if reg is not None:
+                try:
+                    reg.sweep()
+                except Exception:  # pragma: no cover - keep probing
+                    log.exception("2pc sweep failed on a member")
+        try:
+            resolver.resolve_once()
+        except Exception:  # pragma: no cover - keep probing
+            log.exception("in-doubt resolution round failed")
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # pragma: no cover - the loop must live
+                log.exception("cluster probe round failed")
+            self._probe_stop.wait(self.probe_interval)
+
     def stop(self) -> None:
+        self._probe_stop.set()
+        with self._lock:
+            t = self._probe_thread
+            self._probe_thread = None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
         with self._lock:
             members = list(self.members.values())
         for m in members:

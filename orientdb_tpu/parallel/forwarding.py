@@ -24,11 +24,19 @@ import urllib.parse
 import urllib.request
 from typing import Dict, Optional
 
+from orientdb_tpu.chaos import fault
 from orientdb_tpu.models.rid import RID
+from orientdb_tpu.parallel.resilience import RetryPolicy, breaker
 from orientdb_tpu.utils.logging import get_logger
 from orientdb_tpu.utils.metrics import metrics
 
 log = get_logger("forwarding")
+
+#: shared backoff for the IDEMPOTENT 2PC phases (prepare/abort): a
+#: transient channel blip must not turn a clean round into an abort (or
+#: a lingering staged batch). Commit is NOT retried here — the resolver
+#: owns post-decision replay with its own at-least-once semantics.
+_2PC_RETRY = RetryPolicy(attempts=3, base_s=0.05, cap_s=0.5, budget_s=3.0)
 
 
 class WriteOwner:
@@ -78,9 +86,23 @@ class WriteOwner:
                 ),
                 method=method,
             )
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                body = r.read()
-                return json.loads(body) if body else {}
+
+            def _send():
+                # the fault point sits INSIDE the breaker so injected
+                # drops/errors count as channel failures and can trip it
+                with fault.point("fwd.req"):
+                    with urllib.request.urlopen(
+                        req, timeout=self.timeout
+                    ) as r:
+                        body = r.read()
+                        return json.loads(body) if body else {}
+
+            # per-target fuse: a dead owner fails fast after the
+            # threshold instead of charging every forwarder a timeout;
+            # HTTPError (a 409/404/...) proves the channel HEALTHY
+            return breaker(f"fwd:{self.base_url}").call(
+                _send, success_on=(urllib.error.HTTPError,)
+            )
 
     # -- the forwarded record operations ------------------------------------
 
@@ -178,6 +200,34 @@ class WriteOwner:
         if ttl is not None:
             payload["ttl"] = ttl
         try:
+            if phase in ("prepare", "abort"):
+                # idempotent phases (a re-delivered prepare of the same
+                # txid+ops answers "prepared" again server-side; a
+                # double abort is a no-op): retry transient channel
+                # failures under the shared policy instead of aborting
+                # the whole round
+                from orientdb_tpu.parallel.resilience import (
+                    CircuitOpenError,
+                    RetryBudgetExceeded,
+                )
+
+                try:
+                    return _2PC_RETRY.call(
+                        self._req,
+                        "POST",
+                        f"/tx2pc/{self.dbname}",
+                        payload,
+                        give_up_on=(
+                            urllib.error.HTTPError,
+                            CircuitOpenError,
+                        ),
+                    )
+                except RetryBudgetExceeded as e:
+                    raise (
+                        e.__cause__
+                        if isinstance(e.__cause__, Exception)
+                        else e
+                    )
             return self._req("POST", f"/tx2pc/{self.dbname}", payload)
         except urllib.error.HTTPError as e:
             if e.code == 409:
@@ -533,7 +583,7 @@ class ForwardedTransaction:
                 parts[key] = tp.RemoteParticipant(
                     self._owners[key], ops, _adopt
                 )
-        tp.run_coordinator(txid, parts, rows)
+        tp.run_coordinator(txid, parts, rows, coord_db=self.db)
         return mapping
 
     def rollback(self) -> None:

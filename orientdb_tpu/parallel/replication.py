@@ -35,7 +35,9 @@ import threading
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
+from orientdb_tpu.chaos import fault
 from orientdb_tpu.models.database import Database
+from orientdb_tpu.parallel.resilience import breaker
 from orientdb_tpu.storage.durability import WriteAheadLog, _apply_entry
 from orientdb_tpu.utils.logging import get_logger
 from orientdb_tpu.utils.metrics import metrics
@@ -178,13 +180,31 @@ class QuorumPusher:
         self.user = user
         self.password = password
         self.timeout = timeout
+        #: True after a replicate() failed to reach majority, False
+        #: after one succeeds — the read-only-degradation latch (writes
+        #: shed with 503 + Retry-After while quorum is lost, instead of
+        #: each paying the full quorum timeout)
+        self.quorum_lost = False
+        self._lost_at = 0.0
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=8)
+        #: seconds the write path stays shed after a quorum failure
+        #: before a probe write is admitted again (half-open — see
+        #: writes_degraded)
+        self.degraded_retry_s = max(timeout, 1.0)
         #: url -> monotonic time of the last REFUSED checkpoint ship:
         #: a non-fresh replica refuses restores, so don't serialize and
         #: ship a full database at it on every subsequent write
         self._ckpt_refused: Dict[str, float] = {}
+        from orientdb_tpu.parallel.resilience import RetryPolicy
+
+        #: per-entry push retry: a transient channel blip must not cost
+        #: the writer its quorum ack. Budgeted inside the quorum
+        #: timeout so replicate()'s deadline still bounds the write
+        self._push_retry = RetryPolicy(
+            attempts=3, base_s=0.05, cap_s=0.5, budget_s=timeout
+        )
 
     def _post(self, url: str, entries: List[Dict], **extra) -> int:
         from orientdb_tpu.obs.propagation import inject_headers
@@ -213,12 +233,54 @@ class QuorumPusher:
                 ctx=ctx,
             ),
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return json.loads(r.read()).get("applied_lsn", 0)
+
+        def _send():
+            # fault point inside the breaker: injected drops/errors are
+            # channel failures and count toward tripping it
+            with fault.point("repl.push"):
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout
+                ) as r:
+                    return json.loads(r.read()).get("applied_lsn", 0)
+
+        import urllib.error as _uerr
+
+        # per-replica fuse: a dead member costs ONE timeout per reset
+        # window instead of one per write; quorum counting treats the
+        # fast-fail exactly like any other missing ack
+        return breaker(f"repl:{url}").call(
+            _send, success_on=(_uerr.HTTPError,)
+        )
 
     def _push_one(self, url: str, entry: Dict) -> bool:
+        import urllib.error as _uerr
+
+        from orientdb_tpu.parallel.resilience import (
+            CircuitOpenError,
+            RetryBudgetExceeded,
+        )
+
         lsn = entry["lsn"]
-        floor = self._post(url, [entry])
+        try:
+            # channel failures retry under the policy; an HTTP error is
+            # the replica ANSWERING (no retry), and an open breaker
+            # fast-fails by design
+            floor = self._push_retry.call(
+                self._post,
+                url,
+                [entry],
+                retry_on=(OSError,),
+                give_up_on=(
+                    _uerr.HTTPError,
+                    CircuitOpenError,
+                ),
+            )
+        except RetryBudgetExceeded as e:
+            raise (
+                e.__cause__
+                if isinstance(e.__cause__, Exception)
+                else e
+            )
         if floor >= lsn:
             return True
         if floor < 0 or self.source_db is None:
@@ -294,12 +356,32 @@ class QuorumPusher:
                     pass  # dead/slow replica: no ack, never a blocker
         if acks < need:
             metrics.incr("replication.quorum_failed")
+            self.quorum_lost = True
+            self._lost_at = _time.monotonic()
+            metrics.gauge("replication.quorum_lost", 1)
             raise QuorumError(
                 f"write lsn={entry.get('lsn')} reached {acks}/{need} "
                 f"(cluster of {total})"
             )
+        if self.quorum_lost:
+            self.quorum_lost = False
+            metrics.gauge("replication.quorum_lost", 0)
         metrics.incr("replication.quorum_acked")
         return acks
+
+    def writes_degraded(self) -> bool:
+        """The admission-control check (server/admission): shed writes
+        only WITHIN the retry window after a quorum failure. Once it
+        elapses, the next write is admitted as a half-open probe — its
+        replicate() either clears the latch (majority back) or renews
+        the window. Shedding on the raw latch forever would leave an
+        HTTP/binary-only cluster read-only after the replicas
+        recovered: no admitted write, nothing to ever clear it."""
+        import time as _time
+
+        return self.quorum_lost and (
+            _time.monotonic() - self._lost_at < self.degraded_retry_s
+        )
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)
@@ -486,8 +568,12 @@ class ReplicaPuller:
             f"{self.applied_lsn}{exact}",
             headers={"Authorization": f"Basic {cred}"},
         )
-        with urllib.request.urlopen(req, timeout=5) as r:
-            payload = json.loads(r.read())
+        # fault point only, no breaker: the pull loop IS the failure
+        # detector (down_after consecutive failures mark the source
+        # DOWN) — a breaker here would starve it of real probes
+        with fault.point("repl.pull"):
+            with urllib.request.urlopen(req, timeout=5) as r:
+                payload = json.loads(r.read())
         applied = 0
         # the duplicate guard lives on the DATABASE, not the puller: during
         # failover a signal-stopped predecessor puller (not joinable — the

@@ -1,0 +1,286 @@
+"""Shared retry + circuit-breaker policy for inter-node channels.
+
+Every inter-node call (forwarding ``_req``, ``QuorumPusher._post``,
+2PC RPCs, the client's failover reconnect) used to fail hard on its
+first timeout and reconnect with zero backoff — a flapping member got
+hammered by every peer in lockstep, and a dead one cost every caller a
+full timeout per call. This module is the one place that policy lives:
+
+- :class:`RetryPolicy` — capped exponential backoff with full jitter
+  drawn from an optional seeded rng (deterministic chaos runs), a
+  per-call attempt cap AND a total wall-clock budget, honoring a
+  server-provided ``retry_after`` hint (the admission-control 503s)
+  over the computed delay.
+- :class:`CircuitBreaker` — the classic closed → open → half-open
+  machine, one per named channel (:func:`breaker` get-or-creates from
+  a process-wide registry). While open, calls fail fast with
+  :class:`CircuitOpenError` (an ``OSError``, so existing channel-error
+  handling applies) instead of burning a timeout each. State and trip
+  counts export through the PR-1 metrics registry
+  (``breaker.<name>.state`` gauge: 0 closed / 1 open / 2 half-open;
+  ``breaker.trip`` counter) and through ``/cluster/health``
+  (:func:`breaker_snapshot`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterator, Optional, Tuple, Type
+
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("resilience")
+
+
+class RetryBudgetExceeded(OSError):
+    """The retry policy ran out of attempts or wall-clock budget; the
+    ``__cause__`` chain carries the last underlying failure."""
+
+
+class CircuitOpenError(OSError):
+    """The channel's breaker is open: failing fast instead of waiting
+    out another timeout against a member already known unhealthy."""
+
+
+class RetryPolicy:
+    """Capped jittered exponential backoff with a total budget.
+
+    ``delays()`` yields the sleep before retry *i* (full jitter:
+    ``uniform(0, min(cap, base * 2**i))``, never below ``floor_s``);
+    :meth:`call` runs a function under the policy. A raised exception
+    with a ``retry_after`` attribute (the admission-control 503s)
+    overrides the computed delay for that step.
+    """
+
+    def __init__(
+        self,
+        attempts: int = 4,
+        base_s: float = 0.05,
+        cap_s: float = 2.0,
+        budget_s: Optional[float] = 10.0,
+        floor_s: float = 0.005,
+        seed: Optional[int] = None,
+    ) -> None:
+        import random
+
+        self.attempts = max(1, attempts)
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.budget_s = budget_s
+        self.floor_s = floor_s
+        self._rng = random.Random(seed) if seed is not None else random
+
+    def delays(self) -> Iterator[float]:
+        for i in range(self.attempts - 1):
+            hi = min(self.cap_s, self.base_s * (2 ** i))
+            yield max(self.floor_s, self._rng.uniform(0.0, hi))
+
+    def call(
+        self,
+        fn: Callable,
+        *args,
+        retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+        give_up_on: Tuple[Type[BaseException], ...] = (),
+        sleep: Callable[[float], None] = time.sleep,
+        **kw,
+    ):
+        """Run ``fn`` with retries on ``retry_on`` exceptions.
+        ``give_up_on`` wins over ``retry_on`` (e.g. retry OSError but
+        never a CircuitOpenError). Exhaustion raises
+        :class:`RetryBudgetExceeded` from the last failure."""
+        deadline = (
+            None
+            if self.budget_s is None
+            else time.monotonic() + self.budget_s
+        )
+        last: Optional[BaseException] = None
+        it = self.delays()
+        for attempt in range(self.attempts):
+            try:
+                return fn(*args, **kw)
+            except give_up_on:
+                raise
+            except retry_on as e:
+                last = e
+                delay = next(it, None)
+                if delay is None:
+                    break
+                hint = getattr(e, "retry_after", None)
+                if hint is not None:
+                    delay = max(delay, float(hint))
+                if deadline is not None and (
+                    time.monotonic() + delay >= deadline
+                ):
+                    break
+                metrics.incr("resilience.retry")
+                sleep(delay)
+        raise RetryBudgetExceeded(
+            f"retries exhausted after {self.attempts} attempt(s): {last}"
+        ) from last
+
+
+#: CircuitBreaker.state codes for the exported gauge
+STATE_CLOSED, STATE_OPEN, STATE_HALF_OPEN = 0, 1, 2
+_STATE_NAMES = {0: "closed", 1: "open", 2: "half_open"}
+
+
+class CircuitBreaker:
+    """Per-channel failure fuse (closed → open → half-open → closed).
+
+    ``allow()`` is the admission check: True in closed, True for ONE
+    probe call per ``reset_s`` window while open (that call runs
+    half-open), False otherwise. ``record_success``/``record_failure``
+    report the outcome; ``failure_threshold`` consecutive failures trip
+    the breaker. :meth:`call` bundles the three for the common shape.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 5,
+        reset_s: float = 2.0,
+    ) -> None:
+        self.name = name
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_s = reset_s
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._failures = 0  # consecutive
+        self._opened_at = 0.0
+        self._probing = False  # a half-open trial is in flight
+        self.trips = 0
+        self._export()
+
+    # -- state machine -------------------------------------------------------
+
+    def _export(self) -> None:
+        metrics.gauge(f"breaker.{self.name}.state", self._state)
+
+    def _set(self, state: int) -> None:
+        if state != self._state:
+            log.warning(
+                "breaker %s: %s -> %s",
+                self.name,
+                _STATE_NAMES[self._state],
+                _STATE_NAMES[state],
+            )
+        self._state = state
+        self._export()
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._state == STATE_CLOSED:
+                return True
+            now = time.monotonic()
+            if (
+                self._state == STATE_OPEN
+                and now - self._opened_at >= self.reset_s
+            ):
+                self._set(STATE_HALF_OPEN)
+                self._probing = False
+            if self._state == STATE_HALF_OPEN and not self._probing:
+                self._probing = True  # exactly one probe at a time
+                return True
+            metrics.incr("breaker.fast_fail")
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != STATE_CLOSED:
+                self._set(STATE_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._state == STATE_HALF_OPEN or (
+                self._state == STATE_CLOSED
+                and self._failures >= self.failure_threshold
+            ):
+                self._opened_at = time.monotonic()
+                if self._state != STATE_OPEN:
+                    self.trips += 1
+                    metrics.incr("breaker.trip")
+                self._set(STATE_OPEN)
+
+    # -- call wrapper --------------------------------------------------------
+
+    def call(
+        self,
+        fn: Callable,
+        *args,
+        failure_on: Tuple[Type[BaseException], ...] = (OSError,),
+        success_on: Tuple[Type[BaseException], ...] = (),
+        **kw,
+    ):
+        """Run ``fn`` under the breaker: fast-fail while open, count
+        ``failure_on`` exceptions (anything else records success: the
+        CHANNEL worked). ``success_on`` wins over ``failure_on`` — an
+        application-level ``urllib.error.HTTPError`` is an OSError by
+        inheritance but proves the channel healthy."""
+        if not self.allow():
+            raise CircuitOpenError(
+                f"circuit '{self.name}' is open "
+                f"(trips={self.trips}); failing fast"
+            )
+        try:
+            out = fn(*args, **kw)
+        except success_on:
+            self.record_success()
+            raise
+        except failure_on:
+            self.record_failure()
+            raise
+        except BaseException:
+            self.record_success()
+            raise
+        self.record_success()
+        return out
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "state": _STATE_NAMES[self._state],
+                "consecutive_failures": self._failures,
+                "trips": self.trips,
+            }
+
+
+# -- process-wide breaker registry ------------------------------------------
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker(
+    name: str, failure_threshold: int = 5, reset_s: float = 2.0
+) -> CircuitBreaker:
+    """Get-or-create the named channel's breaker. Names are
+    ``<channel>:<target>`` (e.g. ``fwd:http://127.0.0.1:40213``) so one
+    dead member's fuse never blocks a healthy sibling."""
+    br = _breakers.get(name)
+    if br is None:
+        with _breakers_lock:
+            br = _breakers.get(name)
+            if br is None:
+                br = _breakers[name] = CircuitBreaker(
+                    name, failure_threshold, reset_s
+                )
+    return br
+
+
+def breaker_snapshot() -> Dict[str, Dict[str, object]]:
+    """Every breaker's state for ``/cluster/health`` / the bundle."""
+    with _breakers_lock:
+        items = list(_breakers.items())
+    return {name: br.snapshot() for name, br in items}
+
+
+def reset_breakers() -> None:
+    """Drop every registered breaker (test isolation)."""
+    with _breakers_lock:
+        _breakers.clear()

@@ -32,12 +32,31 @@ The owner-side commit executes the sub-batch through one ordinary
 LOCAL transaction (``execute_tx_ops``), so it hits the WAL as a single
 atomic entry and replicates through the owner's own stream exactly
 like a directly-forwarded transaction.
+
+Durability & recovery (partial-failure hardening):
+
+- a durable participant WAL-logs every prepare (``tx2pc_prepare``) and
+  every abort decision (``tx2pc_decision``); a phase-2 commit's ``tx``
+  entry carries ``txid2pc``, so the three records together classify any
+  txid after a crash. ``recover_from_wal`` (called by
+  ``storage/durability.open_database``) RE-STAGES prepared-undecided
+  transactions — locks and all — instead of silently losing them.
+- the coordinator WAL-logs its commit decision (``tx2pc_coord`` with
+  participant descriptors) before phase 2 and ``tx2pc_coord_done``
+  after, so an interrupted round is re-drivable.
+- :data:`resolver` (an :class:`IndoubtResolver`) terminates every
+  in-doubt transaction with no human in the loop: it replays the
+  recorded commit at participants that missed phase 2 (with backoff),
+  treats a participant's "unknown txid" as its presumed abort, and is
+  driven from the cluster's periodic probe
+  (``parallel/cluster.Cluster.probe_once``).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from orientdb_tpu.models.rid import RID
@@ -248,6 +267,13 @@ class _Staged:
         self.deadline = deadline
 
 
+#: decided-txid memory entries kept per registry — late/retried
+#: coordinator RPCs for an already-terminated txid get a sane answer
+#: ("commit" → idempotent success, "abort" → TwoPhaseError) instead of
+#: being indistinguishable from never-prepared
+_DECIDED_CAP = 512
+
+
 class TwoPhaseRegistry:
     """Participant-side staging: one per Database, created lazily by
     :func:`get_registry`. Thread-safe and thread-AGNOSTIC — prepare and
@@ -257,6 +283,32 @@ class TwoPhaseRegistry:
         self.db = db
         self._mu = threading.Lock()
         self._staged: Dict[str, _Staged] = {}
+        #: txid -> "commit" | "abort", bounded FIFO (_DECIDED_CAP)
+        self._decided: "OrderedDict[str, str]" = OrderedDict()
+        #: txids whose phase-2 commit is EXECUTING right now (popped
+        #: from _staged, not yet in _decided): a replayed commit landing
+        #: in that window must answer "retry later", not "never
+        #: prepared" — the resolver would record a presumed abort for a
+        #: transaction that is in fact committing
+        self._committing: set = set()
+
+    def _mark_decided(self, txid: str, decision: str) -> None:
+        """Caller holds self._mu (or is single-threaded recovery)."""
+        self._decided[txid] = decision
+        self._decided.move_to_end(txid)
+        while len(self._decided) > _DECIDED_CAP:
+            self._decided.popitem(last=False)
+
+    def _log_decision(self, txid: str, decision: str) -> None:
+        """Durable decision record — callers must NOT hold self._mu or
+        db._lock (the append may quorum-push to the network)."""
+        try:
+            self.db._wal_log(
+                {"op": "tx2pc_decision", "txid": txid,
+                 "decision": decision}
+            )
+        except Exception:  # pragma: no cover - in-memory dbs, torn logs
+            log.exception("2pc decision log failed for %s", txid)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -266,17 +318,33 @@ class TwoPhaseRegistry:
         lock held by another in-flight distributed tx. Locks carry the
         stage's deadline so writers treat an expired lock as free even
         if no registry call ever sweeps it (presumed abort needs no
-        timer thread)."""
+        timer thread).
+
+        On a durable database the stage is WAL-logged
+        (``tx2pc_prepare``) BEFORE the call returns: the coordinator
+        only ever sees "prepared" once a restart would re-stage it.
+
+        Idempotent for a RETRIED delivery: a coordinator whose prepare
+        request landed but whose ack was lost re-sends the same txid +
+        ops — that must answer "prepared" again, not error the round
+        into an abort with this participant's locks stranded for the
+        full TTL."""
+        from orientdb_tpu.chaos import fault
         from orientdb_tpu.obs.trace import span as _span
 
         with _span(
             "tx2pc.participant.prepare", txid=txid, ops=len(ops)
-        ):
-            self._prepare_inner(txid, ops, ttl)
+        ), fault.point("tx2pc.prepare"):
+            fresh = self._prepare_inner(txid, ops, ttl)
+            if fresh:
+                self.db._wal_log(
+                    {"op": "tx2pc_prepare", "txid": txid, "ops": ops,
+                     "ttl": ttl}
+                )
 
     def _prepare_inner(
         self, txid: str, ops: List[Dict], ttl: float = DEFAULT_TTL
-    ):
+    ) -> bool:
         from orientdb_tpu.models.database import ConcurrentModificationError
 
         self.sweep()
@@ -287,7 +355,12 @@ class TwoPhaseRegistry:
                 lock_rids.append(RID.parse(op["rid"]))
         db = self.db
         with self._mu:
-            if txid in self._staged:
+            existing = self._staged.get(txid)
+            if existing is not None:
+                if existing.ops == ops:
+                    # retried delivery (ack lost in transit): the stage
+                    # from the first attempt stands — idempotent success
+                    return False
                 raise TwoPhaseError(f"tx {txid} already prepared here")
             # rids this batch rewrites before its creates apply: their
             # unique keys are released (or re-checked at apply), so the
@@ -343,24 +416,54 @@ class TwoPhaseRegistry:
                     locks[rid] = (txid, deadline)
             self._staged[txid] = _Staged(txid, ops, lock_rids, deadline)
         metrics.incr("tx2pc.prepare")
+        return True
 
     def commit(
         self, txid: str, rid_map: Optional[Dict[str, str]] = None
     ) -> Tuple[List[Dict], Dict[str, str]]:
         """Execute the staged batch as one local tx; release locks.
         Raises TwoPhaseError when the txid is unknown (never prepared,
-        aborted, or expired — the coordinator maps that to in-doubt)."""
+        aborted, or expired — the coordinator maps that to in-doubt).
+        A commit replay for an ALREADY-COMMITTED txid (the resolver
+        re-driving phase 2 after a lost ack, or after a participant
+        restart replayed the decision from its WAL) answers with an
+        idempotent empty success instead."""
+        from orientdb_tpu.chaos import fault
         from orientdb_tpu.obs.trace import span as _span
 
-        with _span("tx2pc.participant.commit", txid=txid):
+        with _span(
+            "tx2pc.participant.commit", txid=txid
+        ), fault.point("tx2pc.commit"):
             return self._commit_inner(txid, rid_map)
 
     def _commit_inner(
         self, txid: str, rid_map: Optional[Dict[str, str]] = None
     ) -> Tuple[List[Dict], Dict[str, str]]:
         with self._mu:
-            self._sweep_locked()
+            expired = self._sweep_locked()
             st = self._staged.pop(txid, None)
+            replayed = (
+                st is None and self._decided.get(txid) == "commit"
+            )
+            in_flight = st is None and txid in self._committing
+            if st is not None:
+                self._committing.add(txid)
+        for t in expired:
+            # durable presumed-abort for stages expired by THIS sweep:
+            # without the decision record a restart would re-stage an
+            # already-aborted tx and re-take its locks for a fresh TTL
+            self._log_decision(t, "abort")
+        if replayed:
+            # replayed decision: already applied here — the results
+            # were delivered (or superseded) on the original call
+            return [], {}
+        if in_flight:
+            # the ORIGINAL commit is still executing (it can block up
+            # to its endpoint wait): retryable, NOT terminal — a
+            # TwoPhaseError here would read as presumed abort
+            raise TxOpError(
+                503, f"tx {txid} phase-2 commit still in flight here"
+            )
         if st is None:
             raise TwoPhaseError(
                 f"tx {txid} not prepared here (expired or aborted)"
@@ -372,22 +475,35 @@ class TwoPhaseRegistry:
         tl = db._tx_local
         tl.tx2pc_commit = txid
         try:
+            # the commit's WAL `tx` entry carries txid2pc (stamped in
+            # exec/tx._commit_locked from the thread-local marker), so a
+            # restart classifies this txid as decided-commit
             out = execute_tx_ops(db, ops, endpoint_wait=10.0)
+            with self._mu:
+                self._mark_decided(txid, "commit")
         finally:
             tl.tx2pc_commit = None
             self._release(st)
+            with self._mu:
+                self._committing.discard(txid)
         metrics.incr("tx2pc.commit")
         return out
 
     def abort(self, txid: str) -> None:
-        with self._mu:
-            st = self._staged.pop(txid, None)
-        if st is not None:
-            from orientdb_tpu.obs.trace import span as _span
+        from orientdb_tpu.chaos import fault
 
-            with _span("tx2pc.participant.abort", txid=txid):
-                self._release(st)
-            metrics.incr("tx2pc.abort")
+        with fault.point("tx2pc.abort"):
+            with self._mu:
+                st = self._staged.pop(txid, None)
+                if st is not None:
+                    self._mark_decided(txid, "abort")
+            if st is not None:
+                from orientdb_tpu.obs.trace import span as _span
+
+                with _span("tx2pc.participant.abort", txid=txid):
+                    self._release(st)
+                self._log_decision(txid, "abort")
+                metrics.incr("tx2pc.abort")
 
     def _validate_staged_create(
         self, op: Dict, batch_writes=(), claimed=None
@@ -438,9 +554,21 @@ class TwoPhaseRegistry:
                     del db._tx2pc_locks[rid]
 
     def sweep(self) -> None:
-        """Presumed abort: drop staged batches past their deadline."""
+        """Presumed abort: drop staged batches past their deadline and
+        durably record the abort decision so a later restart never
+        re-stages them (the cluster's periodic probe calls this on every
+        member, so an IDLE member's expired locks release too instead of
+        waiting for the next registry call)."""
         with self._mu:
-            self._sweep_locked()
+            expired = self._sweep_locked()
+        for txid in expired:
+            self._log_decision(txid, "abort")
+
+    def staged_count(self) -> int:
+        """Prepared-undecided batches currently staged (the admission
+        -control pressure signal; cheaper than staged_report)."""
+        with self._mu:
+            return len(self._staged)
 
     def staged_report(self) -> List[Dict]:
         """JSON-friendly snapshot of the staged (prepared, undecided)
@@ -458,13 +586,36 @@ class TwoPhaseRegistry:
                 for st in self._staged.values()
             ]
 
-    def _sweep_locked(self) -> None:
+    def snapshot_for_checkpoint(self) -> Dict:
+        """Prepared-undecided stages + the decided-txid memory, JSON
+        form — embedded in checkpoint/delta payloads
+        (``storage/durability``). Without it, a checkpoint that covers
+        a ``tx2pc_prepare`` WAL record ARCHIVES the segment recovery
+        would have re-staged the tx from; the snapshot carries that
+        state across the checkpoint boundary instead."""
+        with self._mu:
+            return {
+                "staged": [
+                    {
+                        "txid": st.txid,
+                        "ops": st.ops,
+                        "ttl": DEFAULT_TTL,
+                    }
+                    for st in self._staged.values()
+                ],
+                "decided": dict(self._decided),
+            }
+
+    def _sweep_locked(self) -> List[str]:
         now = time.time()
+        expired: List[str] = []
         for txid in [
             t for t, s in self._staged.items() if s.deadline < now
         ]:
             st = self._staged.pop(txid)
             self._release(st)
+            self._mark_decided(txid, "abort")
+            expired.append(txid)
             metrics.incr("tx2pc.expired")
             log.warning(
                 "2pc tx %s expired after %.0fs without a coordinator "
@@ -472,6 +623,7 @@ class TwoPhaseRegistry:
                 txid,
                 DEFAULT_TTL,
             )
+        return expired
 
 
 def get_registry(db) -> TwoPhaseRegistry:
@@ -484,13 +636,222 @@ def get_registry(db) -> TwoPhaseRegistry:
     return reg
 
 
+# -- crash recovery (participant side) --------------------------------------
+
+
+def recover_from_wal(db, entries: List[Dict]) -> int:
+    """Re-stage prepared-undecided 2PC transactions after a restart.
+
+    Called by ``storage/durability.open_database`` with the recovered
+    WAL entries. Classification per txid:
+
+    - ``tx2pc_prepare`` with no later decision → RE-STAGE (locks and
+      all): the coordinator saw "prepared", so the participant must
+      still honor a commit arriving after the restart.
+    - ``tx2pc_decision`` (abort, incl. presumed-abort sweeps) or a
+      ``tx`` entry stamped ``txid2pc`` (the phase-2 commit itself) →
+      decided; remembered so a late/replayed coordinator RPC gets an
+      idempotent answer instead of "never prepared".
+
+    Returns the number of re-staged transactions. A prepare whose
+    revalidation fails (it should not — its locks kept every written
+    rid untouched) is logged and presumed aborted, never fatal to
+    recovery."""
+    prepared: Dict[str, Dict] = {}
+    decided: Dict[str, str] = {}
+    for e in entries:
+        op = e.get("op")
+        if op == "tx2pc_prepare":
+            prepared[e["txid"]] = e
+        elif op == "tx2pc_decision":
+            decided[e["txid"]] = e["decision"]
+        elif op == "tx" and e.get("txid2pc"):
+            decided[e["txid2pc"]] = "commit"
+    if not prepared and not decided:
+        return 0
+    reg = get_registry(db)
+    restaged = 0
+    for txid, e in prepared.items():
+        if txid in decided:
+            continue
+        try:
+            # fresh TTL (the entry has no wall-clock stamp): the
+            # coordinator's resolver replays the commit well within it,
+            # and a vanished coordinator hits presumed abort as usual
+            reg._prepare_inner(
+                txid, e["ops"], float(e.get("ttl", DEFAULT_TTL))
+            )
+            restaged += 1
+            metrics.incr("tx2pc.restaged")
+            log.warning(
+                "2pc recovery: re-staged prepared tx %s (%d ops)",
+                txid,
+                len(e["ops"]),
+            )
+        except Exception:
+            log.exception(
+                "2pc recovery: could not re-stage %s; presumed abort",
+                txid,
+            )
+    with reg._mu:
+        for txid, d in decided.items():
+            reg._mark_decided(txid, d)
+    return restaged
+
+
+# -- coordinator-side in-doubt resolution ------------------------------------
+
+
+class IndoubtResolver:
+    """Terminates every coordinator-side :class:`TxInDoubtError` with no
+    human in the loop. ``run_coordinator`` registers the participants
+    whose phase-2 commit failed AFTER the decision; :meth:`resolve_once`
+    (driven from the cluster's periodic probe,
+    ``parallel/cluster.Cluster.probe_once``) replays the recorded commit
+    at each with capped exponential backoff until one of:
+
+    - the replay succeeds (the participant applied late, or had already
+      applied and answers idempotently — durable participants re-stage
+      prepared txs on restart, so a crash-restarted member lands here);
+    - the participant answers "unknown txid" (HTTP 410 /
+      :class:`TwoPhaseError`): its stage expired — presumed abort, the
+      terminal answer of the protocol.
+
+    Outcomes are written into the original in-doubt ``report`` (the one
+    carried by the raised error and logged to :data:`INDOUBT_LOG`), so
+    the debug bundle shows resolution next to the failure."""
+
+    #: backoff bounds between replay rounds per transaction
+    BASE_BACKOFF = 0.25
+    MAX_BACKOFF = 5.0
+    #: replay rounds before giving up on a participant that never
+    #: answers (~20 min at MAX_BACKOFF): the outcome is recorded as
+    #: ``unreachable_gave_up`` — the stage's TTL has long presumed
+    #: abort by then, so further replays could never change anything
+    MAX_ATTEMPTS = 240
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._pending: Dict[str, Dict] = {}
+
+    def register(
+        self,
+        txid: str,
+        failed_parts: Dict[object, "Participant"],
+        rid_map: Dict[str, str],
+        report: Dict,
+    ) -> None:
+        with self._mu:
+            self._pending[txid] = {
+                "txid": txid,
+                "parts": dict(failed_parts),
+                "rid_map": dict(rid_map),
+                "report": report,
+                "attempts": 0,
+                "next_try": 0.0,
+                "backoff": self.BASE_BACKOFF,
+            }
+            metrics.gauge("tx2pc.indoubt_pending", len(self._pending))
+
+    def pending(self) -> List[Dict]:
+        """JSON-friendly snapshot for /cluster/health and the bundle."""
+        with self._mu:
+            return [
+                {
+                    "txid": r["txid"],
+                    "attempts": r["attempts"],
+                    "participants": [str(k) for k in r["parts"]],
+                }
+                for r in self._pending.values()
+            ]
+
+    def resolve_once(self) -> int:
+        """One resolution round over due transactions; returns how many
+        became fully resolved."""
+        now = time.time()
+        with self._mu:
+            work = [
+                r for r in self._pending.values() if r["next_try"] <= now
+            ]
+        resolved = 0
+        for rec in work:
+            txid = rec["txid"]
+            outcomes = rec["report"].setdefault("resolution", {})
+            done: List[object] = []
+            for key, part in list(rec["parts"].items()):
+                try:
+                    part.commit(txid, dict(rec["rid_map"]))
+                    outcomes[str(key)] = "commit_replayed"
+                    done.append(key)
+                except TwoPhaseError:
+                    outcomes[str(key)] = "presumed_abort"
+                    done.append(key)
+                except Exception as e:
+                    if getattr(e, "code", None) == 410:
+                        # the wire form of TwoPhaseError (http 410)
+                        outcomes[str(key)] = "presumed_abort"
+                        done.append(key)
+                    else:
+                        log.warning(
+                            "indoubt %s: %s still unresolved: %r",
+                            txid,
+                            key,
+                            e,
+                        )
+            with self._mu:
+                live = self._pending.get(txid)
+                if live is None:
+                    continue
+                for k in done:
+                    live["parts"].pop(k, None)
+                if (
+                    live["parts"]
+                    and live["attempts"] + 1 >= self.MAX_ATTEMPTS
+                ):
+                    for k in list(live["parts"]):
+                        outcomes[str(k)] = "unreachable_gave_up"
+                    live["parts"].clear()
+                    metrics.incr("tx2pc.indoubt_gave_up")
+                if not live["parts"]:
+                    del self._pending[txid]
+                    resolved += 1
+                    metrics.incr("tx2pc.indoubt_resolved")
+                    log.warning(
+                        "indoubt tx %s resolved: %s", txid, outcomes
+                    )
+                else:
+                    live["attempts"] += 1
+                    live["backoff"] = min(
+                        live["backoff"] * 2, self.MAX_BACKOFF
+                    )
+                    live["next_try"] = time.time() + live["backoff"]
+                metrics.gauge(
+                    "tx2pc.indoubt_pending", len(self._pending)
+                )
+        return resolved
+
+
+#: the process-wide resolver (every coordinator in this process
+#: registers here; Cluster.probe_once drives it)
+resolver = IndoubtResolver()
+
+
 # -- coordinator ------------------------------------------------------------
 
 
 class Participant:
     """One coordinated party: ``prepare``/``commit``/``abort`` keyed by
     the coordinator's txid. ``commit`` receives (and extends) the
-    accumulated temp→real rid map."""
+    accumulated temp→real rid map.
+
+    ``replayable`` marks commits the :class:`IndoubtResolver` may
+    safely re-drive: registry-backed participants answer a replayed
+    commit idempotently (the ``_decided`` guard). The coordinator's own
+    buffered-tx flavor (``exec/tx._LocalTx``) is NOT — re-running its
+    commit would re-apply already-applied ops — so it keeps the False
+    default and is never registered for replay."""
+
+    replayable = False
 
     def prepare(self, txid: str) -> None:  # pragma: no cover - protocol
         raise NotImplementedError
@@ -504,6 +865,8 @@ class Participant:
 
 class RemoteParticipant(Participant):
     """A WriteOwner reached over the wire (``POST /tx2pc``)."""
+
+    replayable = True
 
     def __init__(self, owner, ops: List[Dict], adopt) -> None:
         self.owner = owner
@@ -528,6 +891,8 @@ class LocalRegistryParticipant(Participant):
     """The coordinator's own database as a participant, driven through
     the same registry/lock machinery a remote owner uses."""
 
+    replayable = True
+
     def __init__(self, db, ops: List[Dict], adopt) -> None:
         self.db = db
         self.ops = ops
@@ -551,6 +916,7 @@ def run_coordinator(
     txid: str,
     parts: Dict[object, Participant],
     rows: List[Tuple[object, set, set]],
+    coord_db=None,
 ) -> Dict[str, str]:
     """Drive one 2PC round over ``parts`` (key → participant; ``rows``
     as for :func:`order_participants`). Phase 1 prepares everyone —
@@ -567,11 +933,19 @@ def run_coordinator(
     not-applied in the in-doubt report. Returns the final temp→real
     rid map.
 
+    ``coord_db``, when given (both tx paths pass their database), gets
+    a durable ``tx2pc_coord`` decision record before phase 2 and a
+    ``tx2pc_coord_done`` after — so an interrupted round is visible in
+    the coordinator's own log. Phase-2 failures AFTER the decision are
+    handed to :data:`resolver`, which terminates them from the cluster
+    probe (replayed commit or presumed abort) — no human in the loop.
+
     The whole round runs under a ``tx2pc.coordinate`` span with the
     txid as baggage, so every participant's prepare/commit span — local
     or across the wire — assembles into ONE trace keyed by the txid."""
     import time as _time
 
+    from orientdb_tpu.chaos import fault
     from orientdb_tpu.obs.propagation import baggage
     from orientdb_tpu.obs.trace import span
 
@@ -593,9 +967,16 @@ def run_coordinator(
                 except Exception:  # pragma: no cover - best effort
                     pass
             raise
+        # the decision point: every participant is prepared — a crash
+        # here (fault "tx2pc.decide") is the canonical coordinator death
+        # between phases, leaving participants staged until presumed
+        # abort / the probe-driven sweep terminates them
+        with fault.point("tx2pc.decide"):
+            _log_coord(coord_db, txid, parts)
         rid_map: Dict[str, str] = {}
         committed: List[object] = []
         failures: List[str] = []
+        failed_keys: List[object] = []
         skipped: List[object] = []
         unresolved: set = set()  # temps a failed/skipped owner never mapped
         pending = list(order)
@@ -629,6 +1010,7 @@ def run_coordinator(
                             pass
                     raise
                 failures.append(f"{key}: {type(e).__name__}: {e}")
+                failed_keys.append(key)
                 unresolved |= {
                     t
                     for t in creates_of.get(key, ())
@@ -646,6 +1028,28 @@ def run_coordinator(
                 "unresolved_temps": sorted(unresolved),
             }
             INDOUBT_LOG.append(report)
+            _log_coord_done(coord_db, txid, "indoubt")
+            # hand the failed (decided-commit, not applied) participants
+            # to the resolver: it replays the commit until it lands or
+            # the participant answers presumed-abort. Only REPLAYABLE
+            # flavors register — re-driving the coordinator's own
+            # buffered-tx commit (exec/tx._LocalTx, which can fail
+            # AFTER applying, e.g. a QuorumError on the deferred push)
+            # would double-apply; its failure stays in the report.
+            replay = {
+                k: parts[k]
+                for k in failed_keys
+                if getattr(parts[k], "replayable", False)
+            }
+            not_replayable = [
+                str(k) for k in failed_keys if k not in replay
+            ]
+            if not_replayable:
+                report.setdefault("resolution", {}).update(
+                    {k: "not_replayable" for k in not_replayable}
+                )
+            if replay:
+                resolver.register(txid, replay, rid_map, report)
             msg = "distributed tx partially applied: " + "; ".join(
                 failures
             )
@@ -654,8 +1058,38 @@ def run_coordinator(
                     str(k) for k in skipped
                 )
             raise TxInDoubtError(msg, report)
+        _log_coord_done(coord_db, txid, "committed")
         metrics.incr("tx2pc.coordinated")
         return rid_map
+
+
+def _log_coord(coord_db, txid: str, parts: Dict) -> None:
+    """Durable coordinator decision record ('every participant
+    prepared; committing'). Best effort — an in-memory coordinator
+    (no WAL) simply has no record."""
+    if coord_db is None:
+        return
+    try:
+        coord_db._wal_log(
+            {
+                "op": "tx2pc_coord",
+                "txid": txid,
+                "participants": [str(k) for k in parts],
+            }
+        )
+    except Exception:  # pragma: no cover - best effort
+        log.exception("2pc coordinator record failed for %s", txid)
+
+
+def _log_coord_done(coord_db, txid: str, outcome: str) -> None:
+    if coord_db is None:
+        return
+    try:
+        coord_db._wal_log(
+            {"op": "tx2pc_coord_done", "txid": txid, "outcome": outcome}
+        )
+    except Exception:  # pragma: no cover - best effort
+        log.exception("2pc coordinator done-record failed for %s", txid)
 
 
 def order_participants(

@@ -1,0 +1,48 @@
+"""Shared admission-control pressure checks for both listeners.
+
+One definition of "writes to this database should shed" — the HTTP
+listener (``http_server._shed_write``) adds its per-listener in-flight
+depth check on top; the binary listener uses this alone. Keeping the
+db-pressure signals here stops the two servers drifting apart (each
+new signal lands in one place).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def quorum_degraded(q) -> bool:
+    """True while the quorum pusher's write path should stay shed.
+
+    Prefers :meth:`QuorumPusher.writes_degraded` (a half-open window:
+    after it elapses, writes are admitted again so one can reach
+    ``replicate()`` and actually CLEAR the latch — shedding on the raw
+    latch forever would leave an HTTP/binary-only cluster read-only
+    even after the replicas recovered); falls back to the plain
+    ``quorum_lost`` attribute for simple stand-ins."""
+    fn = getattr(q, "writes_degraded", None)
+    if callable(fn):
+        return bool(fn())
+    return bool(getattr(q, "quorum_lost", False))
+
+
+def db_pressure(db) -> Tuple[Optional[str], float]:
+    """(shed reason or None, Retry-After seconds) for writes to ``db``."""
+    from orientdb_tpu.utils.config import config
+
+    retry = config.retry_after_s
+    if db is None:
+        return None, retry
+    reg = getattr(db, "_tx2pc_registry", None)
+    if reg is not None and config.tx2pc_staged_max:
+        n = reg.staged_count()
+        if n > config.tx2pc_staged_max:
+            return (
+                f"staged 2PC backlog {n} > {config.tx2pc_staged_max}",
+                retry,
+            )
+    q = getattr(db, "_repl_quorum", None)
+    if q is not None and quorum_degraded(q):
+        return "write quorum lost; serving read-only", max(retry, 1.0)
+    return None, retry

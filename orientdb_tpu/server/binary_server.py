@@ -39,6 +39,7 @@ import struct
 import threading
 from typing import Optional
 
+from orientdb_tpu.chaos import fault
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.models.security import (
     RES_DATABASE,
@@ -57,11 +58,13 @@ def send_frame(sock: socket.socket, payload: dict) -> None:
     from orientdb_tpu.storage.durability import json_channel_default
 
     data = json.dumps(payload, default=json_channel_default).encode()
-    sock.sendall(struct.pack(">I", len(data)) + data)
+    with fault.point("bin.send"):
+        sock.sendall(struct.pack(">I", len(data)) + data)
 
 
 def recv_frame(sock: socket.socket) -> Optional[dict]:
-    head = _recv_exact(sock, 4)
+    with fault.point("bin.recv"):
+        head = _recv_exact(sock, 4)
     if head is None:
         return None
     (n,) = struct.unpack(">I", head)
@@ -79,6 +82,28 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
             return None
         buf += chunk
     return buf
+
+
+def _req_is_read(req: dict) -> bool:
+    """A command/script op whose every statement classifies as READ
+    rides through admission shedding — degradation means read-only,
+    not read-nothing."""
+    try:
+        if req.get("op") == "command":
+            _res, action = classify_sql(req.get("sql", ""))
+            return action == "read"
+        if req.get("op") == "script":
+            from orientdb_tpu.exec.script import script_permissions
+
+            return all(
+                action == "read"
+                for _res, action in script_permissions(
+                    req.get("script", "")
+                )
+            )
+    except Exception:
+        pass
+    return False
 
 
 class _Session:
@@ -221,6 +246,22 @@ class _Session:
                 )}
             if self.db is None and op != "close":
                 return {"ok": False, "error": "no database open"}
+            if op in (
+                "command", "script", "save", "delete"
+            ) and not _req_is_read(req):
+                from orientdb_tpu.server.admission import db_pressure
+
+                shed, retry_after = db_pressure(self.db)
+                if shed is not None:
+                    from orientdb_tpu.utils.metrics import metrics
+
+                    metrics.incr("binary.shed")
+                    return {
+                        "ok": False,
+                        "error": shed,
+                        "code": 503,
+                        "retry_after": retry_after,
+                    }
             if op == "query":
                 self.server.security.check(self.user, RES_RECORD, "read")
                 # singles ride the cross-session group path: concurrent

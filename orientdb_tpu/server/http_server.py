@@ -61,7 +61,8 @@ def _traced(fn):
     """Wrap an HTTP verb handler in a server span that CONTINUES the
     caller's trace when the request carries propagation headers
     (obs/propagation) — the receiving half of cross-node tracing for
-    forwarding, 2PC phases, and quorum pushes."""
+    forwarding, 2PC phases, and quorum pushes. Also maintains the
+    listener's in-flight depth (the admission-control signal)."""
 
     verb = fn.__name__[3:]
 
@@ -70,12 +71,23 @@ def _traced(fn):
             continue_trace,
             extract_headers,
         )
+        from orientdb_tpu.utils.metrics import metrics
 
+        srv = self.server
+        with srv.inflight_lock:
+            srv.inflight += 1
+            metrics.gauge("http.inflight", srv.inflight)
         path = urllib.parse.urlparse(self.path).path
-        with continue_trace(
-            f"http.{verb}", extract_headers(self.headers), path=path[:120]
-        ):
-            return fn(self)
+        try:
+            with continue_trace(
+                f"http.{verb}", extract_headers(self.headers),
+                path=path[:120],
+            ):
+                return fn(self)
+        finally:
+            with srv.inflight_lock:
+                srv.inflight -= 1
+                metrics.gauge("http.inflight", srv.inflight)
 
     wrapper.__name__ = fn.__name__
     return wrapper
@@ -110,6 +122,58 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code: int, msg: str) -> None:
         self._send(code, {"errors": [{"code": code, "content": msg}]})
 
+    #: write routes exempt from admission shedding: a replication apply
+    #: or a 2PC phase carries an already-made decision — refusing it
+    #: would CREATE gaps / in-doubt transactions instead of load relief
+    _ADMISSION_EXEMPT = frozenset({"replication", "tx2pc"})
+
+    def _shed_write(self, head: str, dbname: Optional[str]) -> bool:
+        """Admission control for write verbs: True when the request was
+        shed (a 503 with Retry-After has been sent). Sheds on listener
+        in-flight depth plus the shared db-pressure checks
+        (server/admission: staged-2PC backlog, quorum-lost read-only
+        degradation). A ``POST /command`` carrying a READ statement
+        (SELECT/MATCH through the standard REST command path) is never
+        shed — degradation means read-only, not read-nothing."""
+        from orientdb_tpu.server.admission import db_pressure
+        from orientdb_tpu.utils.config import config
+        from orientdb_tpu.utils.metrics import metrics
+
+        if head in self._ADMISSION_EXEMPT:
+            return False
+        if head == "command" and self._command_is_read():
+            return False
+        reason = None
+        retry_after = config.retry_after_s
+        maxin = config.http_max_inflight
+        if maxin and self.server.inflight > maxin:
+            reason = (
+                f"in-flight depth {self.server.inflight} > {maxin}"
+            )
+        if reason is None:
+            db = (
+                self.server.ot_server.get_database(dbname)
+                if dbname
+                else None
+            )
+            reason, retry_after = db_pressure(db)
+        if reason is None:
+            return False
+        metrics.incr("http.shed")
+        body = json.dumps(
+            {
+                "errors": [{"code": 503, "content": reason}],
+                "retry_after": retry_after,
+            }
+        ).encode()
+        self.send_response(503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", f"{retry_after:g}")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return True
+
     def _auth(self):
         hdr = self.headers.get("Authorization", "")
         if hdr.startswith("Basic "):
@@ -134,8 +198,30 @@ class _Handler(BaseHTTPRequestHandler):
         return None
 
     def _body(self) -> bytes:
+        # _command_is_read may have consumed the stream already (the
+        # request body can only be read once): serve the cached copy
+        cached = self.__dict__.pop("_body_cache", None)
+        if cached is not None:
+            return cached
         n = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(n) if n else b""
+
+    def _command_is_read(self) -> bool:
+        """Classify a POST /command body before admission shedding: a
+        READ statement rides through degradation. The body is cached
+        for the route handler's own _body() call."""
+        try:
+            body = self._body()
+            self._body_cache = body
+            text = body.decode(errors="replace")
+            try:
+                sql = json.loads(text).get("command", text)
+            except (json.JSONDecodeError, AttributeError):
+                sql = text
+            _resource, op = classify_sql(sql)
+            return op == "read"
+        except Exception:
+            return False  # unclassifiable: treat as a write
 
     def _route(self) -> Tuple[str, list]:
         path = urllib.parse.urlparse(self.path).path
@@ -414,10 +500,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_POST(self):  # noqa: N802
+        head, rest = self._route()
+        # auth FIRST: an unauthenticated client must see its 401 (and
+        # must not get its body parsed) even while the listener sheds
         user = self._auth()
         if user is None:
             return
-        head, rest = self._route()
+        if self._shed_write(head, rest[0] if rest else None):
+            return
         try:
             if head == "database" and rest:
                 self.server.ot_server.security.check(user, RES_DATABASE, "create")
@@ -670,10 +760,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_PUT(self):  # noqa: N802
+        head, rest = self._route()
+        # auth FIRST: an unauthenticated client must see its 401 (and
+        # must not get its body parsed) even while the listener sheds
         user = self._auth()
         if user is None:
             return
-        head, rest = self._route()
+        if self._shed_write(head, rest[0] if rest else None):
+            return
         try:
             if head == "document" and len(rest) == 2:
                 db = self._db(rest[0])
@@ -797,10 +891,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_DELETE(self):  # noqa: N802
+        head, rest = self._route()
+        # auth FIRST: an unauthenticated client must see its 401 (and
+        # must not get its body parsed) even while the listener sheds
         user = self._auth()
         if user is None:
             return
-        head, rest = self._route()
+        if self._shed_write(head, rest[0] if rest else None):
+            return
         try:
             if head == "document" and len(rest) == 2:
                 db = self._db(rest[0])
@@ -832,6 +930,10 @@ class HttpListener:
     def __init__(self, ot_server, port: int = 0) -> None:
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self.httpd.ot_server = ot_server
+        # admission-control signal: requests currently being handled
+        # (maintained by _traced, read by _shed_write)
+        self.httpd.inflight = 0
+        self.httpd.inflight_lock = threading.Lock()
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

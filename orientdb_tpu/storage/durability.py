@@ -168,10 +168,17 @@ class WriteAheadLog:
     def append(self, entry: Dict) -> int:
         import time as _time
 
+        from orientdb_tpu.chaos import fault
         from orientdb_tpu.obs.trace import span
 
         t0 = _time.perf_counter()
-        with span("wal.append", fsync=bool(self.fsync)) as sp:
+        # the durability fault point: a drop/error here is a failed
+        # append (the entry never becomes durable — the caller's write
+        # fails BEFORE acknowledgment), a delay is an fsync stall, a
+        # crash is death mid-commit (recovery finds no entry)
+        with span(
+            "wal.append", fsync=bool(self.fsync)
+        ) as sp, fault.point("wal.fsync"):
             # stamp the originating trace onto the entry IN PLACE:
             # replication ships WAL entries verbatim, so a replica's
             # apply span — on a thread that never saw the request — can
@@ -382,6 +389,15 @@ def _apply_entry(db: Database, e: Dict) -> None:
     if op in ("tx", "bulk"):
         for sub in e["ops"]:
             _apply_entry(db, sub)
+        return
+    if op in (
+        "tx2pc_prepare",
+        "tx2pc_decision",
+        "tx2pc_coord",
+        "tx2pc_coord_done",
+    ):
+        # 2PC protocol records (parallel/twophase): not data — replay
+        # ignores them here; recover_from_wal classifies them instead
         return
     if op == "create":
         rid = RID.parse(e["rid"])
@@ -761,6 +777,22 @@ def capture_payload(db: Database, under_lock=None, serialize_in_lock=False):
     return payload, lsn, extra
 
 
+def _tx2pc_snapshot(db: Database) -> Dict:
+    """2PC protocol state for a checkpoint/delta payload. Captured
+    AFTER the payload's covered LSN (callers invoke this once the
+    locked capture has returned): a prepare staged since the LSN cut
+    shows up in both the snapshot and the replayed WAL tail —
+    recovery classifies idempotently — while capturing BEFORE the cut
+    could miss a prepare whose record the checkpoint then archives.
+    Taken outside ``db._lock`` because the registry acquires its own
+    mutex before ``db._lock`` (prepare's lock-order); nesting the
+    other way around would deadlock."""
+    reg = getattr(db, "_tx2pc_registry", None)
+    if reg is None:
+        return {"staged": [], "decided": {}}
+    return reg.snapshot_for_checkpoint()
+
+
 def checkpoint(db: Database, directory: Optional[str] = None) -> str:
     """Write a full checkpoint; returns its path. With an attached WAL the
     checkpoint records the last covered LSN and ARCHIVES the log segment
@@ -790,6 +822,11 @@ def checkpoint(db: Database, directory: Optional[str] = None) -> str:
         return dirty_snap, prev_base
 
     payload, lsn, (dirty_snap, prev_base) = capture_payload(db, swap_dirty)
+    # prepared-undecided 2PC stages + decided memory must cross the
+    # checkpoint boundary in the payload: this checkpoint archives (and
+    # eventually retires) the WAL segments holding their tx2pc_prepare
+    # records, so recovery can no longer re-stage them from the log
+    payload["tx2pc"] = _tx2pc_snapshot(db)
     try:
         data = json.dumps(payload, separators=(",", ":")).encode()
     except BaseException:
@@ -940,6 +977,9 @@ def delta_checkpoint(db: Database, directory: Optional[str] = None) -> str:
             deleted=deleted,
             lsn=db._wal.next_lsn - 1,
         )
+    # same discipline as the full checkpoint: the delta advances the
+    # covered LSN, so undecided 2PC state must ride with it
+    payload["tx2pc"] = _tx2pc_snapshot(db)
     data = json.dumps(payload, separators=(",", ":")).encode()
     digest = format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
     name = (
@@ -970,6 +1010,9 @@ def delta_checkpoint(db: Database, directory: Optional[str] = None) -> str:
 
 def _apply_delta(db: Database, payload: Dict) -> int:
     """Apply a delta payload onto a recovered base; returns its LSN."""
+    if "tx2pc" in payload:
+        # newer 2PC protocol snapshot than the base checkpoint's
+        db._tx2pc_ckpt_state = payload["tx2pc"]
     # schema/metadata: absolute — create what's missing, drop what's gone
     _sync_schema(db, payload)
     # deletions first (cascade fixes survivors' adjacency, like WAL replay)
@@ -1167,6 +1210,10 @@ def _sync_schema(db: Database, payload: Dict) -> None:
 def restore_payload(db: Database, payload: Dict) -> int:
     """Rebuild a database from a checkpoint payload (recovery and the
     replication full-sync bootstrap both land here)."""
+    if "tx2pc" in payload:
+        # 2PC protocol state that rode in the payload: stashed for
+        # open_database's recovery scan (a later delta's stash wins)
+        db._tx2pc_ckpt_state = payload["tx2pc"]
     schema = db.schema
     # classes: fixpoint loop honors superclass order; cluster ids forced
     # to the checkpointed values (V/E already exist from bootstrap)
@@ -1444,4 +1491,29 @@ def open_database(directory: str, name: Optional[str] = None) -> Database:
     if entries:
         wal.next_lsn = max(wal.next_lsn, entries[-1]["lsn"] + 1)
     db.schema.on_ddl = db._wal_log
+    # re-stage prepared-undecided 2PC transactions (locks and all): a
+    # participant crash between prepare and commit must not silently
+    # lose what the coordinator was told is prepared. The checkpoint's
+    # embedded 2PC snapshot covers prepares whose WAL records the
+    # checkpoint archived; synthesized FIRST so the replayed tail's
+    # decisions override it
+    from orientdb_tpu.parallel.twophase import recover_from_wal
+
+    ckpt2pc = db.__dict__.pop("_tx2pc_ckpt_state", None) or {}
+    synth: List[Dict] = [
+        {
+            "op": "tx2pc_prepare",
+            "txid": st["txid"],
+            "ops": st["ops"],
+            "ttl": st.get("ttl", 60.0),
+        }
+        for st in ckpt2pc.get("staged", ())
+    ] + [
+        {"op": "tx2pc_decision", "txid": txid, "decision": d}
+        for txid, d in (ckpt2pc.get("decided") or {}).items()
+    ]
+    try:
+        recover_from_wal(db, synth + entries)
+    except Exception:  # pragma: no cover - recovery must finish
+        log.exception("2pc recovery scan failed for %s", db.name)
     return db

@@ -135,6 +135,18 @@ class GlobalConfiguration:
     slowlog_capacity: int = 256
     trace_capacity: int = 4096
 
+    # Admission control (server/http_server, server/binary_server):
+    # shed WRITE requests with 503 + Retry-After when the listener's
+    # in-flight depth or a database's staged-2PC backlog crosses these
+    # thresholds — bounded queues beat collapse under overload. The
+    # internal replication/2PC routes are exempt (shedding a phase-2
+    # commit would CREATE in-doubt transactions). 0 disables a check.
+    http_max_inflight: int = 128
+    tx2pc_staged_max: int = 256
+    # the Retry-After hint handed to shed clients; the shared
+    # RetryPolicy (parallel/resilience) honors it over its own backoff
+    retry_after_s: float = 0.5
+
     # WAL / durability for the host record store
     # (orientdb_tpu.storage.durability): when wal_enabled and wal_dir are
     # set, server-created databases recover-or-create durably under

@@ -1,0 +1,467 @@
+"""The deterministic fault-injection subsystem (orientdb_tpu/chaos).
+
+Covers: seeded FaultPlan reproducibility, the four actions at a named
+point, injection through the REAL channels (quorum push, WAL append),
+the per-channel circuit breakers + their operator surfaces, the shared
+RetryPolicy, HTTP/binary admission control (503 + Retry-After), and the
+tier-1 AST lint keeping every inter-node I/O site injectable."""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from orientdb_tpu.chaos import (
+    POINTS,
+    FaultDropped,
+    FaultError,
+    FaultPlan,
+    SimulatedCrash,
+    fault,
+)
+from orientdb_tpu.parallel.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    breaker,
+    breaker_snapshot,
+    reset_breakers,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault.disarm()
+    fault.record_hits(False)
+    yield
+    fault.disarm()
+    fault.record_hits(False)
+
+
+def wait_for(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestFaultPlan:
+    def test_seeded_plan_replays_exactly(self):
+        """Two plans with one seed fire on the SAME hit sequence — a
+        failing chaos run is reproducible by seed alone."""
+
+        def run(seed):
+            plan = FaultPlan(seed=seed).at(
+                "fwd.req", "error", times=None, p=0.5
+            )
+            pattern = []
+            with fault.armed(plan):
+                for _ in range(40):
+                    try:
+                        with fault.point("fwd.req"):
+                            pattern.append(0)
+                    except FaultError:
+                        pattern.append(1)
+            return pattern
+
+        a, b = run(7), run(7)
+        assert a == b
+        assert 0 in a and 1 in a  # p=0.5 actually branched both ways
+        assert run(8) != a  # a different seed is a different schedule
+
+    def test_times_and_after(self):
+        plan = FaultPlan().at("repl.push", "drop", times=2, after=1)
+        fired = []
+        with fault.armed(plan):
+            for _ in range(5):
+                try:
+                    with fault.point("repl.push"):
+                        fired.append(False)
+                except FaultDropped:
+                    fired.append(True)
+        # hit 1 skipped (after=1), hits 2-3 fire (times=2), rest pass
+        assert fired == [False, True, True, False, False]
+        assert plan.fired("repl.push") == 2
+
+    def test_delay_and_crash_actions(self):
+        plan = (
+            FaultPlan()
+            .at("wal.fsync", "delay", delay_s=0.05)
+            .at("tx2pc.decide", "crash")
+        )
+        with fault.armed(plan):
+            t0 = time.perf_counter()
+            with fault.point("wal.fsync"):
+                pass
+            assert time.perf_counter() - t0 >= 0.04
+            with pytest.raises(SimulatedCrash):
+                with fault.point("tx2pc.decide"):
+                    pass
+        # SimulatedCrash must escape `except Exception` recovery paths
+        assert not issubclass(SimulatedCrash, Exception)
+
+    def test_disarmed_points_are_free_and_silent(self):
+        with fault.point("fwd.req"):
+            pass  # no plan: nothing raised, nothing counted
+
+    def test_coverage_ledger(self):
+        fault.record_hits(True)
+        with fault.point("bin.send"):
+            pass
+        with fault.point("bin.send"):
+            pass
+        assert fault.hits["bin.send"] == 2
+
+
+class TestIolint:
+    def test_every_io_site_routes_through_a_point(self):
+        """Tier-1: a new inter-node channel cannot silently bypass the
+        injection/resilience layer."""
+        from orientdb_tpu.chaos.iolint import lint_package
+
+        assert lint_package() == []
+
+    def test_point_names_match_the_catalog(self):
+        """Every literal point name in the tree is documented in POINTS
+        and vice versa — the catalog IS the operator-facing index."""
+        from orientdb_tpu.chaos.iolint import _iter_points
+
+        used = {name for _f, _l, name in _iter_points()}
+        assert used == set(POINTS), (
+            f"undocumented: {sorted(used - POINTS)}; "
+            f"stale catalog: {sorted(POINTS - used)}"
+        )
+
+
+class TestCircuitBreaker:
+    def test_trips_fast_fails_and_recovers(self):
+        br = CircuitBreaker("t1", failure_threshold=3, reset_s=0.1)
+        boom = OSError("down")
+
+        def failing():
+            raise boom
+
+        for _ in range(3):
+            with pytest.raises(OSError):
+                br.call(failing)
+        assert br.snapshot()["state"] == "open"
+        assert br.trips == 1
+        # open: fail fast, no call attempted
+        with pytest.raises(CircuitOpenError):
+            br.call(lambda: pytest.fail("must not run while open"))
+        # after reset_s one probe runs half-open; success closes
+        time.sleep(0.12)
+        assert br.call(lambda: 42) == 42
+        assert br.snapshot()["state"] == "closed"
+
+    def test_half_open_failure_reopens(self):
+        br = CircuitBreaker("t2", failure_threshold=1, reset_s=0.05)
+        with pytest.raises(OSError):
+            br.call(lambda: (_ for _ in ()).throw(OSError("x")))
+        time.sleep(0.07)
+        with pytest.raises(OSError):
+            br.call(lambda: (_ for _ in ()).throw(OSError("y")))
+        assert br.snapshot()["state"] == "open"
+        assert br.trips == 2
+
+    def test_application_error_is_channel_success(self):
+        """An HTTPError (subclass of OSError!) proves the channel WORKS
+        — it must never trip the breaker."""
+        br = CircuitBreaker("t3", failure_threshold=1)
+        err = urllib.error.HTTPError("u", 409, "conflict", {}, None)
+
+        def conflicting():
+            raise err
+
+        with pytest.raises(urllib.error.HTTPError):
+            br.call(
+                conflicting, success_on=(urllib.error.HTTPError,)
+            )
+        assert br.snapshot()["state"] == "closed"
+
+    def test_state_exported_to_metrics_and_registry(self):
+        from orientdb_tpu.utils.metrics import metrics
+
+        reset_breakers()
+        try:
+            br = breaker("chan:test", failure_threshold=1)
+            with pytest.raises(OSError):
+                br.call(lambda: (_ for _ in ()).throw(OSError()))
+            assert metrics.gauge_value("breaker.chan:test.state") == 1
+            snap = breaker_snapshot()
+            assert snap["chan:test"]["state"] == "open"
+            assert snap["chan:test"]["trips"] == 1
+        finally:
+            reset_breakers()
+
+
+class TestRetryPolicy:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("blip")
+            return "ok"
+
+        p = RetryPolicy(attempts=4, base_s=0.001, cap_s=0.002)
+        assert p.call(flaky) == "ok"
+        assert len(calls) == 3
+
+    def test_exhaustion_chains_the_last_failure(self):
+        p = RetryPolicy(attempts=2, base_s=0.001)
+        boom = OSError("always")
+        with pytest.raises(RetryBudgetExceeded) as ei:
+            p.call(lambda: (_ for _ in ()).throw(boom))
+        assert ei.value.__cause__ is boom
+
+    def test_retry_after_hint_overrides_jitter(self):
+        slept = []
+
+        class Shed(OSError):
+            retry_after = 0.5
+
+        def shed_once(state=[0]):
+            if not state[0]:
+                state[0] = 1
+                raise Shed("503")
+            return "ok"
+
+        p = RetryPolicy(attempts=3, base_s=0.001, cap_s=0.002)
+        assert p.call(shed_once, sleep=slept.append) == "ok"
+        assert slept == [0.5]  # the server's hint, not the tiny jitter
+
+    def test_give_up_on_wins(self):
+        p = RetryPolicy(attempts=5, base_s=0.001)
+        with pytest.raises(CircuitOpenError):
+            p.call(
+                lambda: (_ for _ in ()).throw(CircuitOpenError("open")),
+                give_up_on=(CircuitOpenError,),
+            )
+
+    def test_seeded_delays_are_deterministic(self):
+        a = list(RetryPolicy(attempts=5, seed=3).delays())
+        b = list(RetryPolicy(attempts=5, seed=3).delays())
+        assert a == b
+
+
+class TestChannelInjection:
+    """Faults injected at the REAL channels behave like the outage they
+    model."""
+
+    def test_wal_fsync_error_fails_the_write_before_ack(self, tmp_path):
+        from orientdb_tpu.storage.durability import open_database
+
+        db = open_database(str(tmp_path), "w")
+        db.schema.create_class("C")
+        db.new_element("C", a=1)
+        plan = FaultPlan().at("wal.fsync", "error", times=1)
+        with fault.armed(plan):
+            with pytest.raises(FaultError):
+                db.new_element("C", a=2)
+        # the failed write never became durable; the next one does
+        db.new_element("C", a=3)
+        db2 = open_database(str(tmp_path), "w")
+        vals = sorted(d.get("a") for d in db2.browse_class("C"))
+        assert vals == [1, 3]
+
+    def test_dropped_quorum_pushes_raise_quorum_error_then_recover(self):
+        from orientdb_tpu.parallel.cluster import Cluster
+        from orientdb_tpu.parallel.replication import QuorumError
+        from orientdb_tpu.server.server import Server
+
+        reset_breakers()
+        servers = [Server(admin_password="pw") for _ in range(3)]
+        for s in servers:
+            s.startup()
+        try:
+            pdb = servers[0].create_database("cq")
+            cl = Cluster(
+                "cq", user="admin", password="pw", interval=0.05,
+                down_after=100,  # pushes drop; pullers must stay up
+                write_quorum="majority", quorum_timeout=2.0,
+            )
+            cl.set_primary("n0", servers[0], pdb)
+            pdb.schema.create_vertex_class("P")
+            cl.add_replica("n1", servers[1])
+            cl.add_replica("n2", servers[2])
+            cl.start()
+            try:
+                pdb.new_vertex("P", n=1)  # clean write replicates
+                # a SHORT blip (one drop per replica) is absorbed by
+                # the push retry policy: the write still quorum-acks
+                plan = FaultPlan().at("repl.push", "drop", times=2)
+                with fault.armed(plan):
+                    pdb.new_vertex("P", n=5)
+                assert not pdb._repl_quorum.quorum_lost
+                # a SUSTAINED outage (every push drops for as long as
+                # the plan is armed — a finite count could be partly
+                # consumed by a straggler retry from the blip write's
+                # already-acked replicate): retry budget exhausted, no
+                # majority
+                plan = FaultPlan().at("repl.push", "drop", times=None)
+                with fault.armed(plan):
+                    with pytest.raises(QuorumError):
+                        pdb.new_vertex("P", n=999)
+                assert pdb._repl_quorum.quorum_lost
+                # the degradation latch is a half-open WINDOW, not a
+                # permanent 503: inside it writes shed, after it a probe
+                # write is admitted so replicate() can clear the latch
+                # (an HTTP-only cluster would otherwise stay read-only
+                # forever)
+                assert pdb._repl_quorum.writes_degraded()
+                pdb._repl_quorum._lost_at = 0.0  # window elapsed
+                assert not pdb._repl_quorum.writes_degraded()
+                # faults gone: the next write acks and clears the flag;
+                # the in-doubt entry converges via the pullers
+                pdb.new_vertex("P", n=2)
+                assert not pdb._repl_quorum.quorum_lost
+                assert wait_for(
+                    lambda: all(
+                        m.db.count_class("P") == 4
+                        for m in cl.members.values()
+                    )
+                )
+            finally:
+                cl.stop()
+        finally:
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:
+                    pass
+            reset_breakers()
+
+
+class TestAdmissionControl:
+    def test_http_write_shed_with_retry_after(self):
+        import base64
+        import json
+
+        from orientdb_tpu.server.server import Server
+        from orientdb_tpu.utils.config import config
+
+        with Server(admin_password="pw") as srv:
+            srv.create_database("adm")
+            cred = base64.b64encode(b"admin:pw").decode()
+            url = f"http://127.0.0.1:{srv.http_port}"
+
+            def post_doc():
+                req = urllib.request.Request(
+                    f"{url}/document/adm",
+                    data=json.dumps(
+                        {"@class": "X", "n": 1}
+                    ).encode(),
+                    headers={
+                        "Authorization": f"Basic {cred}",
+                        "Content-Type": "application/json",
+                    },
+                )
+                return urllib.request.urlopen(req, timeout=5)
+
+            old = config.http_max_inflight
+            # simulate saturation: preload the in-flight depth
+            srv._http.httpd.inflight = 5
+            config.http_max_inflight = 1
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    post_doc()
+                assert ei.value.code == 503
+                assert float(ei.value.headers["Retry-After"]) > 0
+                # reads are never shed by depth alone here: GET works
+                req = urllib.request.Request(
+                    f"{url}/listDatabases",
+                    headers={"Authorization": f"Basic {cred}"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    assert r.status == 200
+                # ... and a READ statement through the standard REST
+                # command path rides through too (read-only, not
+                # read-nothing)
+                req = urllib.request.Request(
+                    f"{url}/command/adm/sql",
+                    data=b"SELECT FROM V",
+                    headers={"Authorization": f"Basic {cred}"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    assert r.status == 200
+                # auth runs BEFORE admission: bad credentials answer
+                # 401 (and never get their body parsed), not a 503
+                # inviting the unauthenticated client to retry forever
+                req = urllib.request.Request(
+                    f"{url}/document/adm",
+                    data=b'{"@class": "X"}',
+                    headers={"Authorization": "Basic bm90OnJlYWw="},
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=5)
+                assert ei.value.code == 401
+            finally:
+                config.http_max_inflight = old
+                srv._http.httpd.inflight = 0
+            with post_doc() as r:  # pressure gone: writes flow again
+                assert r.status == 201
+
+    def test_internal_routes_exempt_from_shedding(self):
+        """A 2PC phase / replication apply must NEVER be shed — refusing
+        an already-decided message would create in-doubt state."""
+        from orientdb_tpu.server.http_server import _Handler
+
+        assert "tx2pc" in _Handler._ADMISSION_EXEMPT
+        assert "replication" in _Handler._ADMISSION_EXEMPT
+
+    def test_binary_shed_and_failover_client_honors_retry_after(self):
+        from orientdb_tpu.client.remote import (
+            ServerOverloadedError,
+            connect,
+        )
+        from orientdb_tpu.server.server import Server
+
+        class _FakeQuorum:
+            quorum_lost = True
+
+        with Server(admin_password="pw") as srv:
+            db = srv.create_database("sh")
+            db.schema.create_class("C")
+            port = srv.binary_port
+            cli = connect(f"remote:127.0.0.1:{port}/sh", "admin", "pw")
+            try:
+                db._repl_quorum = _FakeQuorum()
+                # plain client: the typed error with the backoff hint
+                with pytest.raises(ServerOverloadedError) as ei:
+                    cli.command("INSERT INTO C SET n = 1")
+                assert ei.value.retry_after > 0
+                # reads keep flowing while writes degrade — including
+                # READ statements through the command op
+                assert cli.query("SELECT FROM C").to_dicts() == []
+                assert cli.command("SELECT FROM C").to_dicts() == []
+            finally:
+                db._repl_quorum = None
+                cli.close()
+            # failover client: retries the shed op after the hint and
+            # succeeds once pressure clears — no data loss, no double
+            fo = connect(
+                f"remote:127.0.0.1:{port};127.0.0.1:{port}/sh",
+                "admin",
+                "pw",
+            )
+            try:
+                db._repl_quorum = _FakeQuorum()
+                t = threading.Timer(
+                    0.3, lambda: setattr(db, "_repl_quorum", None)
+                )
+                t.start()
+                try:
+                    fo.command("INSERT INTO C SET n = 2")
+                finally:
+                    t.cancel()
+                assert db.count_class("C") == 1
+            finally:
+                db._repl_quorum = None
+                fo.close()

@@ -1,0 +1,483 @@
+"""Partial-failure survival: durable 2PC recovery, the in-doubt
+resolver, and promotion racing an in-flight distributed tx.
+
+The acceptance story: with faults injected at the 2PC points (including
+crash-restart), no quorum-acked write is lost, every TxInDoubtError is
+auto-resolved after restart/probe — no human in the loop."""
+
+import time
+
+import pytest
+
+from orientdb_tpu.chaos import FaultPlan, SimulatedCrash, fault
+from orientdb_tpu.models.database import ConcurrentModificationError
+from orientdb_tpu.parallel import twophase as tp
+from orientdb_tpu.parallel.cluster import Cluster
+from orientdb_tpu.parallel.twophase import (
+    IndoubtResolver,
+    TwoPhaseError,
+    TxInDoubtError,
+    get_registry,
+    recover_from_wal,
+)
+from orientdb_tpu.server.server import Server
+from orientdb_tpu.storage.durability import open_database
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault.disarm()
+    yield
+    fault.disarm()
+
+
+def wait_for(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def count_or_zero(db, cls):
+    try:
+        return db.count_class(cls)
+    except ValueError:
+        return 0
+
+
+def _reopen(db, path, name):
+    """Simulate a crash-restart: drop the live process state and
+    recover strictly from the durability directory."""
+    db._wal.close()
+    return open_database(path, name)
+
+
+class TestDurable2pcRecovery:
+    def _seed(self, tmp_path):
+        db = open_database(str(tmp_path), "d")
+        db.schema.create_class("C")
+        d = db.new_element("C", a=1)
+        return db, d
+
+    def _update_op(self, d, value):
+        return {
+            "kind": "update",
+            "rid": str(d.rid),
+            "base_version": d.version,
+            "fields": {"a": value},
+        }
+
+    def test_prepared_tx_survives_restart_and_commits(self, tmp_path):
+        """The headline fix: a participant crash between prepare and
+        commit used to silently lose the staged batch (memory-only) —
+        now the restart RE-STAGES it, locks and all, and the
+        coordinator's phase-2 still lands."""
+        db, d = self._seed(tmp_path)
+        get_registry(db).prepare("tx1", [self._update_op(d, 2)])
+        db2 = _reopen(db, str(tmp_path), "d")
+        reg2 = get_registry(db2)
+        rep = reg2.staged_report()
+        assert [r["txid"] for r in rep] == ["tx1"]
+        assert rep[0]["locked_rids"] == [str(d.rid)]
+        # the re-staged lock still fences local writers
+        cur = db2.load(d.rid)
+        cur.set("a", 99)
+        with pytest.raises(ConcurrentModificationError):
+            db2.save(cur)
+        # the replayed phase-2 commit applies the staged batch
+        results, _tm = reg2.commit("tx1")
+        assert results[0]["@rid"] == str(d.rid)
+        assert db2.load(d.rid).get("a") == 2
+
+    def test_committed_tx_not_restaged_and_replay_is_idempotent(
+        self, tmp_path
+    ):
+        db, d = self._seed(tmp_path)
+        reg = get_registry(db)
+        reg.prepare("tx2", [self._update_op(d, 5)])
+        reg.commit("tx2")
+        db2 = _reopen(db, str(tmp_path), "d")
+        reg2 = get_registry(db2)
+        # the commit's tx entry carries txid2pc: classified as decided
+        assert reg2.staged_report() == []
+        assert db2.load(d.rid).get("a") == 5
+        # a resolver-driven commit replay answers idempotently instead
+        # of "never prepared" (which would read as presumed abort)
+        assert reg2.commit("tx2") == ([], {})
+
+    def test_aborted_tx_not_restaged(self, tmp_path):
+        db, d = self._seed(tmp_path)
+        reg = get_registry(db)
+        reg.prepare("tx3", [self._update_op(d, 7)])
+        reg.abort("tx3")
+        db2 = _reopen(db, str(tmp_path), "d")
+        reg2 = get_registry(db2)
+        assert reg2.staged_report() == []
+        assert db2.load(d.rid).get("a") == 1
+        with pytest.raises(TwoPhaseError):
+            reg2.commit("tx3")
+
+    def test_swept_expiry_is_a_durable_abort(self, tmp_path):
+        """Presumed abort reached by the (probe-driven) sweep writes a
+        decision record: a restart must not resurrect the stage."""
+        db, d = self._seed(tmp_path)
+        reg = get_registry(db)
+        reg.prepare("tx4", [self._update_op(d, 8)], ttl=0.01)
+        time.sleep(0.03)
+        reg.sweep()
+        db2 = _reopen(db, str(tmp_path), "d")
+        assert get_registry(db2).staged_report() == []
+
+    def test_crash_at_commit_point_then_restart_then_commit(
+        self, tmp_path
+    ):
+        """Crash-restart at the tx2pc.commit fault point: the 'process'
+        dies before the commit executes; recovery re-stages and the
+        replay commits — the acked prepare is never lost."""
+        db, d = self._seed(tmp_path)
+        reg = get_registry(db)
+        reg.prepare("tx5", [self._update_op(d, 3)])
+        plan = FaultPlan().at("tx2pc.commit", "crash", times=1)
+        with fault.armed(plan):
+            with pytest.raises(SimulatedCrash):
+                reg.commit("tx5")
+        db2 = _reopen(db, str(tmp_path), "d")
+        reg2 = get_registry(db2)
+        assert [r["txid"] for r in reg2.staged_report()] == ["tx5"]
+        reg2.commit("tx5")
+        assert db2.load(d.rid).get("a") == 3
+
+    def test_prepared_tx_survives_checkpoint_then_restart(self, tmp_path):
+        """A checkpoint between prepare and the crash archives the WAL
+        segment holding the tx2pc_prepare record — the checkpoint's
+        embedded 2PC snapshot must carry the stage across, or recovery
+        silently loses an acked prepare."""
+        from orientdb_tpu.storage.durability import checkpoint
+
+        db, d = self._seed(tmp_path)
+        get_registry(db).prepare("txck", [self._update_op(d, 11)])
+        checkpoint(db)
+        db2 = _reopen(db, str(tmp_path), "d")
+        reg2 = get_registry(db2)
+        assert [r["txid"] for r in reg2.staged_report()] == ["txck"]
+        reg2.commit("txck")
+        assert db2.load(d.rid).get("a") == 11
+
+    def test_decided_memory_survives_checkpoint(self, tmp_path):
+        """Commit, checkpoint (covering both the prepare and the
+        decision records), restart: a replayed commit must still answer
+        idempotently, and a delta checkpoint's newer snapshot must also
+        carry a stage prepared after the full checkpoint."""
+        from orientdb_tpu.storage.durability import (
+            checkpoint,
+            delta_checkpoint,
+        )
+
+        db, d = self._seed(tmp_path)
+        reg = get_registry(db)
+        reg.prepare("txdone", [self._update_op(d, 12)])
+        reg.commit("txdone")
+        checkpoint(db)
+        d2 = db.load(d.rid)
+        reg.prepare("txlate", [self._update_op(d2, 13)])
+        delta_checkpoint(db)
+        db2 = _reopen(db, str(tmp_path), "d")
+        reg2 = get_registry(db2)
+        assert [r["txid"] for r in reg2.staged_report()] == ["txlate"]
+        assert reg2.commit("txdone") == ([], {})
+        reg2.commit("txlate")
+        assert db2.load(d.rid).get("a") == 13
+
+    def test_reprepare_same_txid_and_ops_is_idempotent(self, tmp_path):
+        """A retried prepare delivery (request landed, ack lost) must
+        answer 'prepared' again — NOT error the round into an abort
+        that strands this participant's locks for the full TTL."""
+        db, d = self._seed(tmp_path)
+        reg = get_registry(db)
+        ops = [self._update_op(d, 21)]
+        reg.prepare("txr", ops)
+        reg.prepare("txr", list(ops))  # the retry: same txid + ops
+        assert len(reg.staged_report()) == 1
+        # a DIFFERENT batch reusing the txid is still a loud error
+        with pytest.raises(TwoPhaseError):
+            reg.prepare("txr", [self._update_op(d, 22)])
+        reg.commit("txr")
+        assert db.load(d.rid).get("a") == 21
+
+    def test_commit_replay_racing_inflight_commit_is_retryable(
+        self, tmp_path
+    ):
+        """A resolver replay landing while the ORIGINAL commit is still
+        executing must get a retryable answer (503), never the terminal
+        'not prepared' that records a presumed abort for a transaction
+        that is in fact committing."""
+        import threading
+
+        db, d = self._seed(tmp_path)
+        reg = get_registry(db)
+        reg.prepare("txrace", [self._update_op(d, 31)])
+        entered = threading.Event()
+        release = threading.Event()
+        real = tp.execute_tx_ops
+
+        def slow_execute(xdb, ops, endpoint_wait=0.0):
+            entered.set()
+            assert release.wait(10)
+            return real(xdb, ops, endpoint_wait=endpoint_wait)
+
+        tp.execute_tx_ops = slow_execute
+        try:
+            t = threading.Thread(
+                target=reg.commit, args=("txrace",), daemon=True
+            )
+            t.start()
+            assert entered.wait(10)
+            with pytest.raises(tp.TxOpError) as ei:
+                reg.commit("txrace")
+            assert ei.value.code == 503
+            release.set()
+            t.join(10)
+        finally:
+            tp.execute_tx_ops = real
+        # once landed, the replay answers idempotently
+        assert reg.commit("txrace") == ([], {})
+        assert db.load(d.rid).get("a") == 31
+
+    def test_recover_from_wal_ignores_foreign_noise(self, tmp_path):
+        db, _d = self._seed(tmp_path)
+        # unrelated entries never confuse the classifier
+        n = recover_from_wal(
+            db,
+            [
+                {"op": "create", "rid": "#9:9", "lsn": 1},
+                {"op": "tx", "ops": [], "lsn": 2},
+            ],
+        )
+        assert n == 0
+
+
+class _FakePart(tp.Participant):
+    """Scriptable participant for resolver unit tests."""
+
+    def __init__(self, commit_fails=0, commit_error=None):
+        self.commit_fails = commit_fails
+        self.commit_error = commit_error
+        self.commits = 0
+        self.committed = False
+        self.aborted = False
+
+    def prepare(self, txid):
+        pass
+
+    def commit(self, txid, rid_map):
+        self.commits += 1
+        if self.commit_error is not None:
+            raise self.commit_error
+        if self.commits <= self.commit_fails:
+            raise OSError("injected channel failure")
+        self.committed = True
+
+    def abort(self, txid):
+        self.aborted = True
+
+
+class TestIndoubtResolver:
+    def test_replays_commit_until_it_lands(self):
+        res = IndoubtResolver()
+        part = _FakePart(commit_fails=2)
+        report = {}
+        res.register("r1", {"o1": part}, {"#-1:-2": "#9:0"}, report)
+        assert [p["txid"] for p in res.pending()] == ["r1"]
+        deadline = time.time() + 10
+        while res.pending() and time.time() < deadline:
+            res.resolve_once()
+            time.sleep(0.05)
+        assert res.pending() == []
+        assert part.committed
+        assert report["resolution"]["o1"] == "commit_replayed"
+
+    def test_unknown_txid_is_presumed_abort(self):
+        res = IndoubtResolver()
+        part = _FakePart(commit_error=TwoPhaseError("not prepared"))
+        report = {}
+        res.register("r2", {"o1": part}, {}, report)
+        assert res.resolve_once() == 1
+        assert res.pending() == []
+        assert report["resolution"]["o1"] == "presumed_abort"
+
+    def test_http_410_maps_to_presumed_abort(self):
+        import urllib.error
+
+        res = IndoubtResolver()
+        err = urllib.error.HTTPError("u", 410, "gone", {}, None)
+        part = _FakePart(commit_error=err)
+        report = {}
+        res.register("r3", {"o1": part}, {}, report)
+        assert res.resolve_once() == 1
+        assert report["resolution"]["o1"] == "presumed_abort"
+
+    def test_backoff_spaces_replay_rounds(self):
+        res = IndoubtResolver()
+        part = _FakePart(commit_fails=99)
+        res.register("r4", {"o1": part}, {}, {})
+        res.resolve_once()
+        n = part.commits
+        res.resolve_once()  # inside the backoff window: no new attempt
+        assert part.commits == n
+
+
+@pytest.fixture()
+def duo():
+    """Async trio cluster, two write owners (n0: P + the QE edge class
+    pre-created; n1: Q), probe thread running."""
+    servers = [Server(admin_password="pw") for _ in range(3)]
+    for s in servers:
+        s.startup()
+    pdb = servers[0].create_database("pf")
+    cl = Cluster(
+        "pf", user="admin", password="pw", interval=0.05, down_after=2
+    )
+    cl.set_primary("n0", servers[0], pdb)
+    pdb.schema.create_vertex_class("P")
+    pdb.schema.create_edge_class("QE")
+    cl.add_replica("n1", servers[1])
+    cl.add_replica("n2", servers[2])
+    cl.start()
+    n1db = cl.members["n1"].db
+    assert wait_for(lambda: n1db.schema.exists_class("P"))
+    cl.assign_class_owner("Q", "n1")
+    cl.assign_class_owner("QE", "n1")
+    yield cl, servers, pdb
+    cl.stop()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+class TestResolverEndToEnd:
+    def test_phase2_wire_failure_resolves_from_the_probe(
+        self, duo, monkeypatch
+    ):
+        """One dropped phase-2 commit ack → TxInDoubtError; the cluster
+        probe replays the recorded commit at the participant until the
+        tx terminates on every member — no human in the loop."""
+        from orientdb_tpu.parallel.forwarding import WriteOwner
+
+        cl, servers, pdb = duo
+        n1db = cl.members["n1"].db
+        real = WriteOwner.tx2pc
+        state = {"failed": False}
+
+        def fail_first_commit(self, phase, txid, **kw):
+            if phase == "commit" and not state["failed"]:
+                state["failed"] = True
+                raise OSError("injected wire failure at commit")
+            return real(self, phase, txid, **kw)
+
+        monkeypatch.setattr(WriteOwner, "tx2pc", fail_first_commit)
+        pdb.begin()
+        p1 = pdb.new_vertex("P", uid=1)
+        p2 = pdb.new_vertex("P", uid=2)
+        # the foreign batch (QE is owned by n1) REFERENCES the local
+        # temps: local commits first, so the injected foreign-commit
+        # failure is in-doubt (not a clean abort)
+        pdb.new_edge("QE", p1, p2)
+        with pytest.raises(TxInDoubtError) as ei:
+            pdb.commit()
+        report = ei.value.report
+        assert report["txid"]
+        assert report["failed"]
+        # the probe-driven resolver replays the commit: the edge lands
+        # at its owner and replicates everywhere — and the resolver's
+        # backlog drains
+        assert wait_for(
+            lambda: all(
+                count_or_zero(m.db, "QE") == 1
+                for m in cl.members.values()
+            ),
+            timeout=30,
+        ), {
+            m.name: count_or_zero(m.db, "QE")
+            for m in cl.members.values()
+        }
+        assert wait_for(
+            lambda: report.get("resolution") is not None
+        )
+        assert wait_for(
+            lambda: report["txid"]
+            not in [r["txid"] for r in tp.resolver.pending()]
+        )
+
+
+class TestPromotionRacing2pc:
+    def test_primary_death_between_phases_terminates_consistently(
+        self, duo
+    ):
+        """The coordinator (primary) dies at the decision point —
+        between phase 1 and phase 2. The replica promotes; the staged
+        batch on the surviving owner is terminated by the probe-driven
+        sweep (presumed abort): nothing half-applied anywhere, locks
+        released, and the cluster keeps serving writes."""
+        cl, servers, pdb = duo
+        n1db = cl.members["n1"].db
+        plan = FaultPlan().at("tx2pc.decide", "crash", times=1)
+        pdb.begin()
+        pdb.new_vertex("P", uid=1)
+        pdb.new_vertex("Q", uid=2)
+        with fault.armed(plan):
+            with pytest.raises(SimulatedCrash):
+                pdb.commit()
+        # the 'dead' coordinator's thread state must not leak into
+        # later test writes on this thread
+        pdb._tx_local.tx = None
+        # phase 1 completed: the surviving owner holds the staged batch
+        reg = get_registry(n1db)
+        assert reg.staged_count() == 1
+        # the primary's process dies with the coordinator
+        servers[0].shutdown()
+        assert wait_for(
+            lambda: cl.status()["primary"] in ("n1", "n2")
+        )
+        # collapse the stage's TTL so the test doesn't wait DEFAULT_TTL:
+        # the PROBE must sweep it even though n1 serves no 2PC traffic
+        with reg._mu:
+            for st in reg._staged.values():
+                st.deadline = time.time() - 1
+        with n1db._lock:
+            for rid, (txid, _dl) in list(n1db._tx2pc_locks.items()):
+                n1db._tx2pc_locks[rid] = (txid, time.time() - 1)
+        assert wait_for(lambda: reg.staged_count() == 0), (
+            "the cluster probe must sweep expired stages on a quiet "
+            "member"
+        )
+        # consistent termination: the crashed tx applied NOWHERE
+        survivors = [
+            m for m in cl.members.values() if m.name != "n0"
+        ]
+        assert all(
+            count_or_zero(m.db, "P") == 0
+            and count_or_zero(m.db, "Q") == 0
+            for m in survivors
+        )
+        # and the released locks admit new writes at the owner
+        n1db.new_vertex("Q", uid=9)
+        assert wait_for(
+            lambda: all(
+                count_or_zero(m.db, "Q") == 1 for m in survivors
+            )
+        )
+
+
+class TestHealthSurfaces:
+    def test_cluster_health_exposes_breakers_and_indoubt(self, duo):
+        from orientdb_tpu.obs.cluster_view import cluster_health
+
+        cl, servers, pdb = duo
+        h = cluster_health(servers[0])
+        assert "breakers" in h
+        assert isinstance(h["indoubt_pending"], list)
+        assert set(h["members"]) == {"n0", "n1", "n2"}

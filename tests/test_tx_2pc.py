@@ -430,7 +430,15 @@ class TestInDoubt:
             assert count_or_zero(cl.members["n1"].db, "Q") == 0
         # the participant's prepared locks were released either way:
         # a follow-up tx on the same classes succeeds once the patch
-        # is lifted
+        # is lifted. Drop the in-doubt registration FIRST — this test
+        # pins the raw failure surface; the probe-driven resolver would
+        # otherwise replay the old commit once the patch lifts and land
+        # a second Q (auto-resolution is covered by
+        # test_partial_failure.TestResolverEndToEnd)
+        from orientdb_tpu.parallel import twophase as tp
+
+        with tp.resolver._mu:
+            tp.resolver._pending.clear()
         monkeypatch.setattr(WriteOwner, "tx2pc", real)
         pdb.begin()
         pdb.new_vertex("P", uid=3)
