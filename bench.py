@@ -44,6 +44,13 @@ BENCH_REMOTE (1; 0 skips the wire-throughput block), BENCH_EVIDENCE
 (path of the crash-safe JSONL evidence stream; default
 BENCH_EVIDENCE_r{NN}.jsonl next to this file — one fsync'd record per
 completed block, so a timed-out run still leaves partial numbers),
+BENCH_BUDGET_S (600 — wall-clock budget in seconds: each block checks
+the remaining budget BEFORE starting; once spent, the rest skip with
+{"skipped": "budget"} evidence records and the run exits rc 0 with the
+numbers it measured — a driver timeout can no longer leave zero
+evidence), BENCH_DETAIL_DIR (where BENCH_DETAIL_r{NN}.json lands,
+default next to this file; it is rewritten atomically after EVERY
+completed block, not only at exit),
 BENCH_REMOTE_CLIENTS (4), BENCH_REPS (3 — timed reps per workload; the
 recorded q/s and phase-split ms are MEDIANS across reps), BENCH_GATE /
 --gate <json> (regression gate vs a recorded round: q/s leaves at
@@ -504,6 +511,17 @@ def main() -> None:
 
     round_n = _round_stamp()
     detail_name = detail_filename(round_n)
+    detail_dir = os.environ.get("BENCH_DETAIL_DIR") or os.path.dirname(
+        os.path.abspath(__file__)
+    )
+    os.makedirs(detail_dir, exist_ok=True)
+    detail_path = os.path.join(detail_dir, detail_name)
+    if os.path.exists(detail_path):
+        # same round re-run before the driver recorded it: the flushes
+        # below rewrite the file from the very first evidence record,
+        # so preserve the earlier run's measured numbers instead of
+        # clobbering them with a fresh run's zeros
+        os.replace(detail_path, detail_path + ".prev")
     evidence = EvidenceSink(
         os.environ.get("BENCH_EVIDENCE")
         or os.path.join(
@@ -512,16 +530,58 @@ def main() -> None:
         )
     )
 
+    # wall-clock budget (VERDICT r5: rc 124 with zero numbers): blocks
+    # check remaining budget BEFORE starting; once it is spent, the
+    # rest skip with {"skipped": "budget"} evidence records and the run
+    # exits rc 0 with whatever it measured.
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "600"))
+    t_start = time.perf_counter()
+
+    def budget_left() -> float:
+        return budget_s - (time.perf_counter() - t_start)
+
     #: block tag -> trace id of the span wrapping its measured reps:
     #: evidence records carry it so a slow bench number can be joined
     #: to its trace in the debug bundle (obs/bundle)
     block_trace = {}
+
+    # the result accumulates INCREMENTALLY: extras/agg fill as blocks
+    # complete, and the detail artifact is rewritten after every block
+    # (a driver timeout mid-run must never again leave parsed: null
+    # with zero numbers on disk)
+    extras = {}
+    agg = {"value": 0.0, "vs_baseline": 0.0}
+    skipped = []
+
+    def _compose_out() -> dict:
+        return {
+            "metric": "demodb_match_2hop_count_qps",
+            "value": agg["value"],
+            "unit": "queries/sec",
+            "vs_baseline": agg["vs_baseline"],
+            "extras": dict(extras),
+        }
+
+    def _flush_detail() -> None:
+        tmp = f"{detail_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(_compose_out(), f, indent=1, sort_keys=True)
+        os.replace(tmp, detail_path)
 
     def ev(block: str, **data) -> None:
         tid = block_trace.get(block)
         if tid:
             data.setdefault("trace_id", tid)
         evidence.emit(block, data)
+        _flush_detail()
+
+    def budget_ok(block: str) -> bool:
+        if budget_left() > 0:
+            return True
+        skipped.append(block)
+        extras["skipped_blocks"] = list(skipped)
+        ev(block, skipped="budget")
+        return False
 
     from contextlib import contextmanager
 
@@ -543,6 +603,8 @@ def main() -> None:
     single_iters = int(os.environ.get("BENCH_SINGLE_ITERS", "10"))
     oracle_iters = int(os.environ.get("BENCH_ORACLE_ITERS", "1"))
 
+    extras["batch_size"] = batch
+    extras["graph"] = {"profiles": n_profiles, "avg_friends": avg_friends}
     ev(
         "start",
         round=round_n,
@@ -550,13 +612,16 @@ def main() -> None:
         avg_friends=avg_friends,
         batch=batch,
         iters=iters,
+        budget_s=budget_s,
     )
 
-    from orientdb_tpu.storage.ingest import generate_demodb
-    from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+    db = None
+    if budget_ok("parity"):
+        from orientdb_tpu.storage.ingest import generate_demodb
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
 
-    db = generate_demodb(n_profiles=n_profiles, avg_friends=avg_friends)
-    attach_fresh_snapshot(db)
+        db = generate_demodb(n_profiles=n_profiles, avg_friends=avg_friends)
+        attach_fresh_snapshot(db)
 
     # headline: the analytic multi-hop pattern (BASELINE config #2 shape) —
     # whole-class 2-hop expansion with vertex predicates on both ends
@@ -594,27 +659,30 @@ def main() -> None:
 
     # parity gates before timing (result-set parity is part of the metric);
     # TRAVERSE rows are records, so canon compares @rid dicts
-    for q in (sql, sql_rows, sql_var, sql_trav, sql_select):
-        if canon(run("tpu", q)) != canon(run("oracle", q)):
-            print(
-                json.dumps(
-                    {
-                        "metric": "demodb_match_2hop_count_qps",
-                        "value": 0.0,
-                        "unit": "queries/sec",
-                        "vs_baseline": 0.0,
-                        "error": f"parity mismatch: {q[:60]}",
-                    }
+    if db is not None:
+        for q in (sql, sql_rows, sql_var, sql_trav, sql_select):
+            if canon(run("tpu", q)) != canon(run("oracle", q)):
+                print(
+                    json.dumps(
+                        {
+                            "metric": "demodb_match_2hop_count_qps",
+                            "value": 0.0,
+                            "unit": "queries/sec",
+                            "vs_baseline": 0.0,
+                            "error": f"parity mismatch: {q[:60]}",
+                        }
+                    )
                 )
-            )
-            sys.exit(1)
+                sys.exit(1)
 
-    ev("parity", queries=5, status="ok")
+        ev("parity", queries=5, status="ok")
 
     from orientdb_tpu.exec.tpu_engine import drain_warmups
     from orientdb_tpu.utils.metrics import metrics
 
     splits = {}
+    # live reference: every _flush_detail sees the splits recorded so far
+    extras["phase_split_ms_per_query"] = splits
     # medians of >= 3 timed reps per workload (VERDICT r4 #6): one rep's
     # q/s rides the tunnel's ±40% noise; the median of 3 — and medians of
     # the per-phase ms — are what the gate compares round over round
@@ -694,12 +762,22 @@ def main() -> None:
             block_trace[tag] = sp.trace_id
         return _median(qpss)
 
-    single_qps = time_single(sql, tag="single_2hop")
-    ev("single_2hop", qps=round(single_qps, 3), split=splits.get("single_2hop"))
-    batched_qps = time_batched(sql, tag="batched_2hop")
-    ev("batched_2hop", qps=round(batched_qps, 3), split=splits.get("batched_2hop"))
-    rows_qps = time_batched(sql_rows, tag="rows_1hop")
-    ev("rows_1hop", qps=round(rows_qps, 3), split=splits.get("rows_1hop"))
+    if budget_ok("single_2hop"):
+        single_qps = time_single(sql, tag="single_2hop")
+        extras["single_query_qps"] = round(single_qps, 3)
+        ev("single_2hop", qps=round(single_qps, 3),
+           split=splits.get("single_2hop"))
+    if budget_ok("batched_2hop"):
+        batched_qps = time_batched(sql, tag="batched_2hop")
+        # the headline lands in the detail artifact the moment it is
+        # measured — a later timeout cannot lose it
+        agg["value"] = round(batched_qps, 3)
+        ev("batched_2hop", qps=round(batched_qps, 3),
+           split=splits.get("batched_2hop"))
+    if budget_ok("rows_1hop"):
+        rows_qps = time_batched(sql_rows, tag="rows_1hop")
+        extras["rows_1hop_batched_qps"] = round(rows_qps, 3)
+        ev("rows_1hop", qps=round(rows_qps, 3), split=splits.get("rows_1hop"))
     # varied-parameter row-returning batch: parameters differ per lane,
     # so this exercises the vmapped rows-group dispatch (one Execute +
     # one compact group page for B distinct result sets) — the honest
@@ -710,35 +788,46 @@ def main() -> None:
         "RETURN p.uid AS p, f.uid AS f"
     )
     rows_param_plist = [{"a": 40 + (i % 15)} for i in range(batch)]
-    for pv in ({"a": 40}, {"a": 47}):
-        o = db.query(sql_rows_param, params=pv, engine="oracle").to_dicts()
-        t = db.query(
-            sql_rows_param, params=pv, engine="tpu", strict=True
-        ).to_dicts()
-        if canon(o) != canon(t):
-            print(
-                json.dumps(
-                    {
-                        "metric": "demodb_match_2hop_count_qps",
-                        "value": 0.0,
-                        "unit": "queries/sec",
-                        "vs_baseline": 0.0,
-                        "error": f"rows_param parity mismatch: {pv}",
-                    }
+    if budget_ok("rows_1hop_param"):
+        for pv in ({"a": 40}, {"a": 47}):
+            o = db.query(
+                sql_rows_param, params=pv, engine="oracle"
+            ).to_dicts()
+            t = db.query(
+                sql_rows_param, params=pv, engine="tpu", strict=True
+            ).to_dicts()
+            if canon(o) != canon(t):
+                print(
+                    json.dumps(
+                        {
+                            "metric": "demodb_match_2hop_count_qps",
+                            "value": 0.0,
+                            "unit": "queries/sec",
+                            "vs_baseline": 0.0,
+                            "error": f"rows_param parity mismatch: {pv}",
+                        }
+                    )
                 )
-            )
-            sys.exit(1)
+                sys.exit(1)
 
-    rows_param_qps = time_batched(
-        sql_rows_param, tag="rows_1hop_param", params_list=rows_param_plist
-    )
-    ev("rows_1hop_param", qps=round(rows_param_qps, 3))
-    var_qps = time_batched(sql_var, tag="var_depth")
-    ev("var_depth", qps=round(var_qps, 3))
-    trav_qps = time_batched(sql_trav, tag="traverse")
-    ev("traverse", qps=round(trav_qps, 3))
-    select_qps = time_batched(sql_select, tag="select_count")
-    ev("select_count", qps=round(select_qps, 3))
+        rows_param_qps = time_batched(
+            sql_rows_param, tag="rows_1hop_param",
+            params_list=rows_param_plist,
+        )
+        extras["rows_1hop_param_batched_qps"] = round(rows_param_qps, 3)
+        ev("rows_1hop_param", qps=round(rows_param_qps, 3))
+    if budget_ok("var_depth"):
+        var_qps = time_batched(sql_var, tag="var_depth")
+        extras["var_depth_while_batched_qps"] = round(var_qps, 3)
+        ev("var_depth", qps=round(var_qps, 3))
+    if budget_ok("traverse"):
+        trav_qps = time_batched(sql_trav, tag="traverse")
+        extras["traverse_bfs_batched_qps"] = round(trav_qps, 3)
+        ev("traverse", qps=round(trav_qps, 3))
+    if budget_ok("select_count"):
+        select_qps = time_batched(sql_select, tag="select_count")
+        extras["select_count_batched_qps"] = round(select_qps, 3)
+        ev("select_count", qps=round(select_qps, 3))
 
     # ---- remote (wire) throughput (VERDICT r4 #1): the same workloads
     # measured THROUGH the binary protocol — a batch op (one frame, one
@@ -747,7 +836,7 @@ def main() -> None:
     # ~2x of the embedded numbers, vs the r4 state where a remote client
     # got 8.7 of the embedded 553 q/s. ----
     remote = {}
-    if os.environ.get("BENCH_REMOTE", "1") != "0":
+    if os.environ.get("BENCH_REMOTE", "1") != "0" and budget_ok("remote"):
         import threading
 
         from orientdb_tpu.client.remote import connect
@@ -852,13 +941,15 @@ def main() -> None:
             _rsp.__exit__(None, None, None)
             block_trace["remote"] = _rsp.trace_id
             srv.shutdown()
+        extras["remote"] = remote
         ev("remote", **remote)
 
     # demodb's device graph is done (the oracle timing later is host-
     # only): free its HBM before the bigger graphs load — 16 GB cannot
     # hold every block's graph at once, and plan-cache cycles keep
     # plain `del` from freeing eagerly
-    db.detach_snapshot()
+    if db is not None:
+        db.detach_snapshot()
 
     # shared by the IS / IC / sf10 sections -------------------------------
     def parity_or_die(dbx, q, p, label):
@@ -892,9 +983,12 @@ def main() -> None:
 
     # LDBC SNB interactive short reads (IS1–IS7) on an SF1-shaped graph
     snb_persons = int(os.environ.get("BENCH_SNB_PERSONS", "10000"))
+    extras["snb_persons"] = snb_persons
     ldbc_is = {}
-    if snb_persons > 0:
+    snb = None
+    if snb_persons > 0 and budget_ok("ldbc_is"):
         from orientdb_tpu.storage.ingest import generate_ldbc_snb
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
         from orientdb_tpu.workloads.ldbc import IS_QUERIES
 
         snb = generate_ldbc_snb(n_persons=snb_persons, seed=13)
@@ -917,13 +1011,14 @@ def main() -> None:
                 ldbc_is[name] = time_param_batch_local(
                     snb, q, [is_params(q, i) for i in range(batch)]
                 )
+        extras["ldbc_is"] = ldbc_is
         ev("ldbc_is", **ldbc_is)
 
     # ---- LDBC interactive COMPLEX reads (IC1/IC2 + 3-hop aggregate):
     # the multi-pattern half of BASELINE configs[4], on the same
     # SF1-shaped graph as the IS section ----
     ldbc_ic = {}
-    if snb_persons > 0:
+    if snb is not None and budget_ok("ldbc_ic"):
         from orientdb_tpu.workloads.ldbc import IC_QUERIES
 
         someone = next(snb.browse_class("Person"))
@@ -945,17 +1040,19 @@ def main() -> None:
                 ldbc_ic[name + "_qps"] = time_param_batch_local(
                     snb, q, [ic_params(name, i) for i in range(batch)]
                 )
+        extras["ldbc_ic"] = ldbc_ic
         ev("ldbc_ic", **ldbc_ic)
 
-    if snb_persons > 0:
+    if snb is not None:
         snb.detach_snapshot()
         del snb
 
     # ---- SF10 every round (VERDICT r3 #2): the IS spot check at 10x ----
     sf10 = {}
     sf10_persons = int(os.environ.get("BENCH_SF10_PERSONS", "100000"))
-    if sf10_persons > 0:
+    if sf10_persons > 0 and budget_ok("sf10"):
         from orientdb_tpu.storage.ingest import generate_ldbc_snb
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
         from orientdb_tpu.workloads.ldbc import IS_QUERIES
 
         snb10 = generate_ldbc_snb(n_persons=sf10_persons, seed=17)
@@ -975,6 +1072,7 @@ def main() -> None:
                     ],
                 )
         sf10["persons"] = sf10_persons
+        extras["sf10"] = sf10
         ev("sf10", **sf10)
         snb10.detach_snapshot()
         del snb10
@@ -985,7 +1083,7 @@ def main() -> None:
     # own process (exit is the one free() it honors) ----
     sf100 = {}
     sf100_persons = int(os.environ.get("BENCH_SF100_PERSONS", "8000000"))
-    if sf100_persons > 0:
+    if sf100_persons > 0 and budget_ok("sf100_shape"):
         sf100 = run_tpu_subprocess("sf100", timeout=3600)
         if "error" in sf100:
             # fatal like the old in-process block: a workload that
@@ -1014,12 +1112,13 @@ def main() -> None:
                 [8, sharded_persons],
                 timeout=1800,
             )
+        extras["sf100_shape"] = sf100
         ev("sf100_shape", **sf100)
 
     # ---- degree skew (VERDICT r3 #7), same subprocess isolation ----
     skew = {}
     skew_persons = int(os.environ.get("BENCH_SKEW_PERSONS", "1000000"))
-    if skew_persons > 0:
+    if skew_persons > 0 and budget_ok("degree_skew"):
         skew = run_tpu_subprocess("skew", timeout=3600)
         if "error" in skew:
             if "parity mismatch" in str(skew["error"]):
@@ -1031,13 +1130,16 @@ def main() -> None:
                     "vs_baseline": 0.0,
                     "error": f"skew block failed: {skew['error']}"}))
             sys.exit(1)
+        extras["degree_skew"] = skew
         ev("degree_skew", **skew)
 
     # ---- shard-count scaling of the ring-compacted merge (VERDICT r3
     # #6): per-S subprocesses on virtual CPU meshes; merge_rows must stay
     # ~flat while the old all_gather design's row count grows with S ----
     mesh_scaling = []
-    if os.environ.get("BENCH_MESH_SCALING", "1") != "0":
+    if os.environ.get("BENCH_MESH_SCALING", "1") != "0" and budget_ok(
+        "mesh_scaling"
+    ):
         for S in (2, 4, 8):
             res = run_virtual_mesh_subprocess(
                 "orientdb_tpu.tools.mesh_scaling", [S],
@@ -1045,62 +1147,49 @@ def main() -> None:
             )
             res.setdefault("shards", S)
             mesh_scaling.append(res)
+        extras["mesh_scaling"] = mesh_scaling
         ev("mesh_scaling", results=mesh_scaling)
 
-    with block_span("oracle_2hop"):
-        t0 = time.perf_counter()
-        for _ in range(oracle_iters):
-            run("oracle")
-        oracle_qps = oracle_iters / (time.perf_counter() - t0)
-    ev("oracle_2hop", qps=round(oracle_qps, 4))
+    if db is not None and budget_ok("oracle_2hop"):
+        with block_span("oracle_2hop"):
+            t0 = time.perf_counter()
+            for _ in range(oracle_iters):
+                run("oracle")
+            oracle_qps = oracle_iters / (time.perf_counter() - t0)
+        extras["oracle_2hop_qps"] = round(oracle_qps, 4)
+        if agg["value"] and oracle_qps:
+            agg["vs_baseline"] = round(agg["value"] / oracle_qps, 2)
+        ev("oracle_2hop", qps=round(oracle_qps, 4))
 
-    out = {
-        "metric": "demodb_match_2hop_count_qps",
-        "value": round(batched_qps, 3),
-        "unit": "queries/sec",
-        "vs_baseline": round(batched_qps / oracle_qps, 2),
-        "extras": {
-            "batch_size": batch,
-            "single_query_qps": round(single_qps, 3),
-            "rows_1hop_batched_qps": round(rows_qps, 3),
-            "rows_1hop_param_batched_qps": round(rows_param_qps, 3),
-            "var_depth_while_batched_qps": round(var_qps, 3),
-            "traverse_bfs_batched_qps": round(trav_qps, 3),
-            "select_count_batched_qps": round(select_qps, 3),
-            "remote": remote,
-            "ldbc_is": ldbc_is,
-            "ldbc_ic": ldbc_ic,
-            "sf10": sf10,
-            "sf100_shape": sf100,
-            "degree_skew": skew,
-            "mesh_scaling": mesh_scaling,
-            "phase_split_ms_per_query": splits,
-            "snb_persons": snb_persons,
-            "oracle_2hop_qps": round(oracle_qps, 4),
-            "graph": {
-                "profiles": n_profiles,
-                "avg_friends": avg_friends,
-            },
-        },
-    }
+    # this process ran every query through the engine front door: its
+    # own query-stats table is bench evidence too (top shapes by
+    # cumulative latency, fingerprints joinable to the slowlog/traces)
+    from orientdb_tpu.obs.stats import stats as _qstats
+
+    extras["query_stats_top"] = [
+        {
+            k: r[k]
+            for k in ("fingerprint", "query", "calls", "mean_ms",
+                      "device_s", "compile_s")
+        }
+        for r in _qstats.top(5)
+    ]
+
     # The driver captures only the TAIL (~2000 chars) of stdout and
     # parses the last JSON line — round 4's full line exceeded that and
     # was recorded with parsed=null, losing every extra. So: the FULL
     # result persists to a repo file (the judge and next round's gate
-    # read it), and the printed line carries the required keys plus a
-    # compact extras subset that stays well under the capture window.
-    # (detail_name was round-stamped up front, before the first block.)
-    with open(
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     detail_name),
-        "w",
-    ) as f:
-        json.dump(out, f, indent=1, sort_keys=True)
+    # read it; _flush_detail has been rewriting it after every block),
+    # and the printed line carries the required keys plus a compact
+    # extras subset that stays well under the capture window.
+    out = _compose_out()
+    _flush_detail()
     ev(
         "final",
         value=out["value"],
         vs_baseline=out["vs_baseline"],
         detail_file=detail_name,
+        skipped_blocks=skipped,
     )
 
     print(compact_line(out, detail_name=detail_name))
@@ -1111,6 +1200,16 @@ def main() -> None:
     # #1) can never ship again. Diagnostics on stderr; the driver's one
     # stdout JSON line stays intact.
     if gate_path:
+        if skipped:
+            # a budget-truncated run would gate its 0.0/missing leaves
+            # (headline included) as false regressions and exit 2 —
+            # partial evidence is for reading, not for gating
+            print(
+                f"gate vs {gate_path}: SKIPPED (budget-skipped blocks: "
+                f"{', '.join(skipped)})",
+                file=sys.stderr,
+            )
+            return
         norm = (
             (gate_prev.get("parsed") or gate_prev)
             if isinstance(gate_prev, dict)

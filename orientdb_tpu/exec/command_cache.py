@@ -57,6 +57,11 @@ class CommandCache:
                 return None
             self._map.move_to_end(key)
         metrics.incr("command_cache.hit")
+        # per-fingerprint accounting (obs/stats): a cached execution
+        # still counts as a call; this marks it served without running
+        from orientdb_tpu.obs.stats import note_result_cache_hit
+
+        note_result_cache_hit()
         return rows, used
 
     def put(self, key: Tuple, rows: List, used: str, epoch: int) -> None:

@@ -112,17 +112,49 @@ def _result_set(rows, engine_used: str) -> ResultSet:
     return rs
 
 
-def _observe_query(sql: str, t0: float, engine_used: str, trace_id) -> None:
-    """Per-query latency accounting shared by query()/command(): the
-    duration stat + histogram feed /metrics, the slowlog keeps the tail."""
+def _observe_query(
+    sql: str, t0: float, engine_used: str, trace_id, acc
+) -> None:
+    """Per-query accounting shared by query()/command(): the duration
+    stat + histogram feed /metrics, the per-fingerprint stats table
+    aggregates cost by query shape, and the slowlog keeps the tail —
+    stamped with the fingerprint so slowlog ↔ stats ↔ trace join on
+    one id."""
     import time
 
+    import orientdb_tpu.obs.stats as S  # noqa: F401 (module)
     from orientdb_tpu.obs.registry import obs as _obs
     from orientdb_tpu.obs.slowlog import slowlog
 
     dur = time.perf_counter() - t0
     _obs.observe("query.latency_s", dur)
-    slowlog.record(sql, dur, engine=engine_used, trace_id=trace_id)
+    plan_cache = None
+    if acc is not None:
+        if acc.plan_cache_hits:
+            plan_cache = "hit"
+        elif acc.plan_cache_misses:
+            plan_cache = "miss"
+        elif acc.result_cache_hits:
+            plan_cache = "result-cache"
+    rows = getattr(acc, "_rows", None) if acc is not None else None
+    fid = S.stats.finish(acc, dur, engine=engine_used, rows=rows)
+    slowlog.record(
+        sql,
+        dur,
+        engine=engine_used,
+        trace_id=trace_id,
+        fingerprint=fid,
+        cache=plan_cache,
+    )
+
+
+def _observe_error(sql: str, t0: float, acc, exc: BaseException) -> None:
+    """A failing query still counts: calls + errors per fingerprint."""
+    import time
+
+    import orientdb_tpu.obs.stats as S
+
+    S.stats.finish(acc, time.perf_counter() - t0, engine="?", error=exc)
 
 
 def execute_query(
@@ -137,16 +169,24 @@ def execute_query(
     rejected here too."""
     import time
 
+    import orientdb_tpu.obs.stats as S
     from orientdb_tpu.obs.trace import span
 
     t0 = time.perf_counter()
-    with span("query", sql=sql[:120]) as sp:
-        rs = _execute_query(db, sql, params, engine, strict)
-        sp.set("engine", getattr(rs, "engine", None))
-        rows = getattr(rs, "_rows", None)
-        if hasattr(rows, "__len__"):
-            sp.set("rows", len(rows))
-    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id)
+    acc = S.stats.begin(sql)
+    try:
+        with span("query", sql=sql[:120]) as sp:
+            rs = _execute_query(db, sql, params, engine, strict)
+            sp.set("engine", getattr(rs, "engine", None))
+            rows = getattr(rs, "_rows", None)
+            if hasattr(rows, "__len__"):
+                sp.set("rows", len(rows))
+                if acc is not None:
+                    acc._rows = len(rows)  # type: ignore[attr-defined]
+    except BaseException as e:
+        _observe_error(sql, t0, acc, e)
+        raise
+    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
     return rs
 
 
@@ -200,13 +240,22 @@ def execute_command(
 ) -> ResultSet:
     import time
 
+    import orientdb_tpu.obs.stats as S
     from orientdb_tpu.obs.trace import span
 
     t0 = time.perf_counter()
-    with span("command", sql=sql[:120]) as sp:
-        rs = _execute_command(db, sql, params, engine, strict)
-        sp.set("engine", getattr(rs, "engine", None))
-    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id)
+    acc = S.stats.begin(sql)
+    try:
+        with span("command", sql=sql[:120]) as sp:
+            rs = _execute_command(db, sql, params, engine, strict)
+            sp.set("engine", getattr(rs, "engine", None))
+            rows = getattr(rs, "_rows", None)
+            if acc is not None and hasattr(rows, "__len__"):
+                acc._rows = len(rows)  # type: ignore[attr-defined]
+    except BaseException as e:
+        _observe_error(sql, t0, acc, e)
+        raise
+    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
     return rs
 
 
@@ -244,10 +293,32 @@ def execute_query_batch(
     answer to the tunneled-TPU's fixed per-transfer RTT. Per-statement
     Uncompilable failures fall back to the oracle (unless ``strict``).
     """
+    import time
+
+    import orientdb_tpu.obs.stats as S
     from orientdb_tpu.obs.trace import span
 
+    t0 = time.perf_counter()
+    # a failing batch records NO per-statement stats: which statements
+    # actually executed is unknowable here, and charging calls+errors
+    # to all N shapes would fabricate exactly the aggregate evidence
+    # this table exists to make trustworthy (the failure still lands in
+    # query.latency_s / the caller's error path)
     with span("query_batch", n=len(sqls)):
-        return _execute_query_batch(db, sqls, params_list, engine, strict)
+        out = _execute_query_batch(db, sqls, params_list, engine, strict)
+    # per-statement stats with the batch's amortized wall clock: device
+    # time overlaps across the whole batch, so per-item attribution
+    # would be fiction — calls/rows/engine are what aggregate honestly
+    per = (time.perf_counter() - t0) / max(len(sqls), 1)
+    for sql, rs in zip(sqls, out):
+        rows = getattr(rs, "_rows", None)
+        S.stats.record_external(
+            sql,
+            per,
+            engine=getattr(rs, "engine", "?"),
+            rows=len(rows) if hasattr(rows, "__len__") else None,
+        )
+    return out
 
 
 def _execute_query_batch(

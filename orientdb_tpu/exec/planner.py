@@ -211,6 +211,12 @@ def explain_plan(db, stmt: A.ExplainStatement, params) -> ResultSet:
     inner = stmt.inner
     engine = _choose_engine(db, inner, None)
     plan = build_plan(db, inner, engine)
+    # per-plan cost accounting (obs/stats): the EXPLAIN/PROFILE's own
+    # fingerprint entry carries the plan it rendered, so the stats
+    # table shows WHAT plan a query shape runs, not just how much
+    from orientdb_tpu.obs.stats import note_plan
+
+    note_plan(plan.pretty())
     props: Dict[str, object] = {
         "executionPlan": plan.pretty(),
         "engine": engine,

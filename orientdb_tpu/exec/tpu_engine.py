@@ -97,7 +97,13 @@ def _fetch_profiled(devs: List, split_sync: bool = True) -> List[np.ndarray]:
     if devs:
         metrics.observe("tpu.device_s", t1 - t0)
         metrics.observe("tpu.transfer_s", t2 - t1)
-        metrics.incr("tpu.bytes_fetched", sum(int(a.nbytes) for a in arrs))
+        nbytes = sum(int(a.nbytes) for a in arrs)
+        metrics.incr("tpu.bytes_fetched", nbytes)
+        # per-fingerprint attribution (obs/stats): one thread-local add
+        # when a query accumulator is active, a no-op otherwise
+        from orientdb_tpu.obs.stats import add_device
+
+        add_device(t1 - t0, t2 - t1, nbytes)
     return arrs
 
 
@@ -3231,6 +3237,8 @@ def _prepare(db, stmt, params):
         raise Uncompilable("no fresh snapshot attached")
     from orientdb_tpu.utils.metrics import metrics
 
+    import orientdb_tpu.obs.stats as _stats
+
     cache = _plan_cache(snap)
     key = _cache_key(stmt, params)
     if key is not None:
@@ -3238,9 +3246,20 @@ def _prepare(db, stmt, params):
         if variants is not None:
             cache.move_to_end(key)  # LRU: keep hot plans
             metrics.incr("plan_cache.hit")
+            _stats.note_plan_cache(True)
             return variants, None, None
     metrics.incr("plan_cache.miss")
+    _stats.note_plan_cache(False)
+    # the eager recording execution IS the compile cost a caller absorbs
+    # on a plan-cache miss: charge it to the query's fingerprint
+    import time as _time
+
+    _t0 = _time.perf_counter()
     plan_obj, rows = _record(db, stmt, params)
+    _stats.add_compile(_time.perf_counter() - _t0)
+    steps = getattr(getattr(plan_obj, "solver", None), "plan", None)
+    if steps:
+        _stats.note_plan(" -> ".join(s.describe() for s in steps))
     if key is not None and config.plan_cache_size > 0:
         while len(cache) >= config.plan_cache_size:
             cache.popitem(last=False)
@@ -3315,10 +3334,17 @@ def _run_variants(
             continue
         variants.remember(params, plan)
         return rows
+    import time as _time
+
+    import orientdb_tpu.obs.stats as _stats
     from orientdb_tpu.utils.metrics import metrics
 
     metrics.incr("plan_cache.overflow_rerecord")
+    # recompile-due-to-shape: the replay's buffers were too small for
+    # these parameters — charge the re-record to the fingerprint
+    _t0 = _time.perf_counter()
     plan_obj, rows = _record(db, stmt, params)
+    _stats.add_compile(_time.perf_counter() - _t0, rerecord=True)
     variants.add(plan_obj)
     variants.remember(params, plan_obj)
     plan_obj.ensure_compiled()

@@ -30,12 +30,36 @@ both claims needs more than `utils/metrics.py`'s counters:
   trace id, slowlog, metrics snapshot, in-doubt 2PC state;
 - :mod:`orientdb_tpu.obs.promlint` — Prometheus text-exposition
   grammar lint, run by tier-1 tests over the full ``/metrics`` and
-  ``/cluster/metrics`` output.
+  ``/cluster/metrics`` output;
+- :mod:`orientdb_tpu.obs.stats` — the query-statistics plane
+  (pg_stat_statements analog): normalized query fingerprints with
+  cumulative per-shape cost (calls, latency, device-ms, compile vs
+  cache-hit, bytes), served at ``GET /stats/queries`` and fanned into
+  ``/cluster/metrics``;
+- :mod:`orientdb_tpu.obs.profile` — continuous profiling: finished
+  span trees fold into per-stage self-time profiles (``GET
+  /stats/profile``), plus scrape-time memory/process telemetry gauges;
+- :mod:`orientdb_tpu.obs.spanlint` — span-name catalog lint: every
+  literal ``span(...)`` name must appear in ``SPAN_CATALOG``, so a
+  typo cannot silently split profiles or break cross-node trace joins.
 """
 
 from orientdb_tpu.obs.bundle import assemble_traces, debug_bundle
 from orientdb_tpu.obs.evidence import EvidenceSink, read_evidence
+from orientdb_tpu.obs.profile import (
+    profiler,
+    register_gauge_provider,
+    register_server_telemetry,
+)
 from orientdb_tpu.obs.promlint import lint_exposition
+from orientdb_tpu.obs.spanlint import SPAN_CATALOG, lint_spans
+from orientdb_tpu.obs.stats import (
+    QueryStats,
+    fingerprint,
+    fingerprint_cached,
+    render_stats_prometheus,
+)
+from orientdb_tpu.obs.stats import stats as query_stats
 from orientdb_tpu.obs.propagation import (
     baggage,
     continue_trace,
@@ -60,6 +84,16 @@ from orientdb_tpu.obs.trace import (
 
 __all__ = [
     "EvidenceSink",
+    "QueryStats",
+    "SPAN_CATALOG",
+    "fingerprint",
+    "fingerprint_cached",
+    "lint_spans",
+    "profiler",
+    "register_gauge_provider",
+    "register_server_telemetry",
+    "render_stats_prometheus",
+    "query_stats",
     "read_evidence",
     "obs",
     "render_prometheus",

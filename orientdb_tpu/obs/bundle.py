@@ -7,8 +7,11 @@ history as ONE artifact, not four endpoints scraped in a hurry:
 - recent traces, ASSEMBLED by trace id (cross-node spans land in one
   group thanks to propagation — coordinator, participants, and
   replication applies of one write share a trace);
-- the slow-query log;
+- the slow-query log (entries carry their query fingerprint);
 - a full metrics snapshot (counters/gauges/durations/histograms);
+- the query-statistics table (obs/stats: per-fingerprint cumulative
+  cost) and the span-profile self-time tree (obs/profile) — the
+  aggregate context a single slow trace is judged against;
 - in-doubt 2PC state: staged-but-undecided batches per database, plus
   the coordinator-side in-doubt reports (``twophase.INDOUBT_LOG``).
 
@@ -71,12 +74,17 @@ def debug_bundle(
     """The full bundle. ``dbs`` are this process's databases (for
     staged-2PC state); ``cluster`` (when attached) contributes the
     membership status block."""
+    from orientdb_tpu.obs.profile import profiler
+    from orientdb_tpu.obs.stats import stats
+
     out: Dict[str, object] = {
         "ts": round(time.time(), 3),
         "member": member,
         "traces": assemble_traces(max_traces),
         "slowlog": slowlog.entries(),
         "metrics": snapshot_all(),
+        "query_stats": stats.top(50),
+        "profile": profiler.profile(),
         "in_doubt_2pc": in_doubt_state(dbs),
     }
     if cluster is not None:

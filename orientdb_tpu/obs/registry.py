@@ -162,13 +162,19 @@ def derived_gauges(counters: Dict[str, float]) -> Dict[str, float]:
 
 def snapshot_all() -> Dict[str, Dict]:
     """One combined snapshot of BOTH process registries (counters /
-    gauges / durations from ``utils.metrics``, histograms from here) —
-    the unit ``/metrics?format=json`` serves and ``/cluster/metrics``
-    fans in per member."""
+    gauges / durations from ``utils.metrics``, histograms from here)
+    plus the per-fingerprint query-stats table — the unit
+    ``/metrics?format=json`` serves and ``/cluster/metrics`` fans in
+    per member. Memory/process telemetry gauges (obs/profile) refresh
+    at scrape time, right before the snapshot is taken."""
+    from orientdb_tpu.obs.profile import run_gauge_providers
+    from orientdb_tpu.obs.stats import stats
     from orientdb_tpu.utils.metrics import metrics
 
+    run_gauge_providers()
     snap = metrics.snapshot()
     snap["histograms"] = obs.snapshot()
+    snap["query_stats"] = stats.export()
     return snap
 
 
@@ -219,6 +225,11 @@ def _render_into(lines: List[str], snap: Dict) -> None:
         sample(f"{m}_bucket", h["count"], extra='le="+Inf"')
         sample(f"{m}_sum", _fmt(h["sum"]))
         sample(f"{m}_count", h["count"])
+    qs = snap.get("query_stats")
+    if qs:
+        from orientdb_tpu.obs.stats import render_stats_into
+
+        render_stats_into(lines, {None: qs})
 
 
 def render_prometheus() -> str:
@@ -311,4 +322,14 @@ def render_prometheus_multi(snapshots: Dict[str, Dict]) -> str:
             )
             lines.append(f'{m}_sum{{member="{mem}"}} {_fmt(h["sum"])}')
             lines.append(f'{m}_count{{member="{mem}"}} {h["count"]}')
+    # per-fingerprint query stats, fanned in with BOTH labels — the
+    # same fingerprint id labels every member's series, so a shape's
+    # fleet-wide cost reads off one family
+    if any(snapshots[m].get("query_stats") for m in members):
+        from orientdb_tpu.obs.stats import render_stats_into
+
+        render_stats_into(
+            lines,
+            {m: snapshots[m].get("query_stats") or {} for m in members},
+        )
     return "\n".join(lines) + "\n"

@@ -34,20 +34,38 @@ class SlowQueryLog:
         duration_s: float,
         engine: str,
         trace_id: Optional[str] = None,
+        fingerprint: Optional[str] = None,
+        cache: Optional[str] = None,
     ) -> bool:
         """Record ``sql`` if it crossed the threshold; returns whether
         it did. Reads the threshold per call so tests (and a live
-        console) can retune without restarting."""
+        console) can retune without restarting. ``fingerprint`` is the
+        query-shape id (obs/stats) — the pivot from one slow query into
+        its cumulative ``STATS QUERIES`` row and trace; ``cache``
+        records how the plan was obtained (``hit``/``miss``/
+        ``result-cache``/None)."""
         threshold_ms = config.slow_query_ms
         ms = duration_s * 1000.0
         if threshold_ms <= 0 or ms < threshold_ms:
             return False
+        if fingerprint is None:
+            # a caller outside the engine front door (or a sampled-out
+            # query) still gets a joinable id — the fingerprint is pure
+            # text normalization
+            from orientdb_tpu.obs.stats import fingerprint_cached
+
+            try:
+                fingerprint = fingerprint_cached(sql).fid
+            except Exception:
+                fingerprint = None
         entry = {
             "ts": time.time(),
             "sql": sql,
             "ms": round(ms, 2),
             "engine": engine,
             "trace_id": trace_id,
+            "fingerprint": fingerprint,
+            "cache": cache,
         }
         with self._lock:
             self._entries.append(entry)

@@ -1,0 +1,139 @@
+"""AST lint: every span name literal in the codebase is cataloged.
+
+The profile aggregator (``obs/profile.py``) groups stages by span NAME
+and cross-node traces join on the names both sides emit — a typo'd
+name in a new ``span("replication.aply")`` would silently split a
+stage out of every profile and break trace joins, with no test to
+notice. This lint (pattern: ``chaos/iolint.py``, enforced tier-1 by
+``tests/test_query_stats.py``) makes that a build failure:
+
+- every **string-literal** first argument of a ``span(...)`` /
+  ``_span(...)`` / ``continue_trace(...)`` / ``_bench_span(...)``
+  call under ``orientdb_tpu/`` and in ``bench.py`` must appear in
+  :data:`SPAN_CATALOG`;
+- every catalog entry must be used by at least one call site (a stale
+  entry is dead documentation).
+
+Dynamically named spans (f-strings like ``f"http.{verb}"``) cannot be
+linted literal-by-literal; their families are documented in
+:data:`DYNAMIC_FAMILIES` instead. Tests are exempt — ad-hoc span names
+there are fixtures, not stages.
+
+The catalog doubles as the span-name reference the README links.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Tuple
+
+#: span name → what the stage covers. The profile aggregator's stage
+#: names and the cross-node trace vocabulary, in one place.
+SPAN_CATALOG: Dict[str, str] = {
+    "query": "engine front door: one idempotent statement via query()",
+    "command": "engine front door: one statement via command()",
+    "query_batch": "batched front door: N statements, one dispatch wave",
+    "profile": "EXPLAIN PROFILE execution of the inner statement",
+    "tpu.load": "device-graph upload / fetch for a compiled execution",
+    "tpu.solve": "compiled MATCH/TRAVERSE solve (recording execution)",
+    "tpu.step": "one compiled plan step (root scan / expansion hop)",
+    "tpu.marshal": "device results → host rows marshalling",
+    "tpu.dispatch": "compiled replay dispatch (profile_execute)",
+    "tpu.device": "device execution sync (profile_execute)",
+    "tx.commit": "local transaction commit (MVCC checks + WAL append)",
+    "tx2pc.coordinate": "2PC coordinator round (prepare + decide)",
+    "tx2pc.participant.prepare": "2PC phase 1: validate + lock + stage",
+    "tx2pc.participant.commit": "2PC phase 2: execute the staged batch",
+    "tx2pc.participant.abort": "2PC abort: release the staged batch",
+    "wal.append": "write-ahead-log append (+fsync when configured)",
+    "replication.apply": "replica apply batch (push or pull)",
+    "replication.apply_entry": "one WAL entry applied on a replica "
+    "(joins the originating write's trace)",
+    "forward.request": "non-owner → write-owner HTTP forward",
+    "bench.block": "one measured bench block (evidence carries its "
+    "trace id)",
+}
+
+#: dynamically named span families (f-string call sites the literal
+#: lint cannot see) — documented here so the catalog stays the one
+#: reference for every name shape in the ring
+DYNAMIC_FAMILIES: Dict[str, str] = {
+    "http.<verb>": "HTTP listener request (server/http_server._traced)",
+    "binary.<op>": "binary-protocol op (server/binary_server)",
+}
+
+#: call names whose first positional string argument is a span name
+#: (bench's block_span() helper takes a block TAG, not a span name —
+#: its inner _bench_span("bench.block", ...) literal is what's linted)
+SPAN_CALLS = frozenset({"span", "_span", "continue_trace", "_bench_span"})
+
+
+def _literal_span_names(tree: ast.Module) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if not (isinstance(f, ast.Name) and f.id in SPAN_CALLS):
+            continue
+        if (
+            n.args
+            and isinstance(n.args[0], ast.Constant)
+            and isinstance(n.args[0].value, str)
+        ):
+            out.append((n.lineno, n.args[0].value))
+    return out
+
+
+def _iter_sources(root: str) -> List[Tuple[str, str]]:
+    """(relative path, source) for every linted module: the package
+    tree plus bench.py; tests excluded (ad-hoc fixture spans)."""
+    out: List[Tuple[str, str]] = []
+    pkg = os.path.join(root, "orientdb_tpu")
+    files: List[str] = []
+    for dirpath, _dirs, names in os.walk(pkg):
+        for f in sorted(names):
+            if f.endswith(".py"):
+                files.append(os.path.join(dirpath, f))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        files.append(bench)
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as fh:
+            out.append((rel, fh.read()))
+    return out
+
+
+def lint_spans(root: str = None) -> List[str]:
+    """Lint the tree; returns problems (empty = every literal span name
+    is cataloged and every catalog entry is live)."""
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    problems: List[str] = []
+    used: set = set()
+    for rel, src in _iter_sources(root):
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:  # pragma: no cover
+            problems.append(f"{rel}: unparsable: {e}")
+            continue
+        for lineno, name in _literal_span_names(tree):
+            used.add(name)
+            if name not in SPAN_CATALOG:
+                problems.append(
+                    f"{rel}:{lineno}: span name {name!r} is not in "
+                    "SPAN_CATALOG (obs/spanlint.py) — a typo here would "
+                    "silently split profiles and break trace joins; add "
+                    "the name with a description or fix the call site"
+                )
+    for name in sorted(SPAN_CATALOG):
+        if name not in used:
+            problems.append(
+                f"SPAN_CATALOG entry {name!r} is used by no call site — "
+                "remove it or fix the spelling at the call site"
+            )
+    return problems

@@ -1,0 +1,552 @@
+"""Query statistics: fingerprints + cumulative per-plan cost accounting.
+
+The obs plane so far answers "what happened to THIS request" (traces,
+slowlog, /metrics); this module answers "which query SHAPES dominate
+the fleet, what do they cost on-device, and when did they regress" —
+the pg_stat_statements / Dapper-aggregation analog ([E] OProfiler's
+per-command chronos kept the per-statement totals; SURVEY.md §5.1):
+
+- **fingerprint** — a normalized form of the SQL (literals → ``?``,
+  literal IN-/list bodies collapsed to ``[?]``, case and whitespace
+  folded) with a stable 64-bit id. The id is process-independent
+  (BLAKE2b over the canonical token stream), so slowlog entries, stats
+  rows, traces, and ``/cluster/metrics`` series from different members
+  join on one value.
+- **QueryStats** — a lock-cheap bounded table of per-fingerprint
+  cumulative statistics: calls, errors, rows returned, a latency
+  histogram, per-hop device/transfer time and bytes materialized
+  (``exec/tpu_engine._fetch_profiled``), compile time vs plan-cache
+  hits (recording executions ARE the compile path), recompiles due to
+  parameter-driven shape overflow, and result-cache hits
+  (``exec/command_cache``). Updated from hooks in ``exec/engine.py``;
+  attribution of device/compile cost rides a **thread-local
+  accumulator** (:meth:`QueryStats.begin` / :meth:`QueryStats.finish`)
+  so the hot paths never search or lock per event.
+
+``config.stats_sample_rate`` (default 1.0) samples whole queries out of
+accounting; sampled-out queries skip every hook at ~one comparison.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, NamedTuple, Optional
+
+from orientdb_tpu.utils.config import config
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+
+class Fingerprint(NamedTuple):
+    fid: str  #: stable 64-bit id, 16 hex chars
+    text: str  #: normalized one-line SQL (display form)
+
+
+def _normalize_tokens(sql: str):
+    """(canonical token texts, display token texts).
+
+    Canonical folds identifier case (class/field lookups are
+    case-insensitive throughout the engine) and replaces literals with
+    ``?``; display keeps the query's own identifier spelling so the
+    stats table stays readable. A bracket group holding only literals
+    and commas — an IN-list or literal list — collapses to ``[?]`` in
+    both, so ``IN [1,2]`` and ``IN [1,2,3,4]`` share a fingerprint.
+    """
+    from orientdb_tpu.sql.lexer import tokenize
+
+    toks = tokenize(sql)
+    canon: List[str] = []
+    disp: List[str] = []
+    i, n = 0, len(toks)
+    while i < n:
+        t = toks[i]
+        if t.kind == "EOF":
+            break
+        if t.kind == "OP" and t.text == "[":
+            # literal-only bracket group → one collapsed placeholder
+            # (commas and unary signs included, so [-1,-2] and
+            # [-1,-2,-3] are one shape like their positive twins)
+            j = i + 1
+            only_literals = True
+            has_literal = False
+            while j < n and not (toks[j].kind == "OP" and toks[j].text == "]"):
+                k = toks[j].kind
+                if k in ("NUMBER", "STRING", "RID"):
+                    has_literal = True
+                elif not (k == "OP" and toks[j].text in (",", "-", "+")):
+                    only_literals = False
+                    break
+                j += 1
+            if only_literals and has_literal and j < n:
+                canon.append("[?]")
+                disp.append("[?]")
+                i = j + 1
+                continue
+        if t.kind in ("NUMBER", "STRING", "RID"):
+            canon.append("?")
+            disp.append("?")
+        elif t.kind == "IDENT":
+            canon.append(t.text.casefold())
+            disp.append(t.text)
+        elif t.kind == "VAR":
+            canon.append("$" + t.text.casefold())
+            disp.append("$" + str(t.value))
+        else:
+            canon.append(t.text)
+            disp.append(t.text)
+        i += 1
+    return canon, disp
+
+
+def fingerprint(sql: str) -> Fingerprint:
+    """Normalize ``sql`` and derive its stable 64-bit id. Unlexable
+    input (a malformed statement that still reached the front door)
+    falls back to the whitespace-collapsed raw text — it still gets a
+    stable id, just without literal folding."""
+    try:
+        canon, disp = _normalize_tokens(sql)
+        canon_s = " ".join(canon)
+        text = " ".join(disp)
+    except Exception:
+        text = " ".join(sql.split())
+        canon_s = text.casefold()
+    fid = hashlib.blake2b(canon_s.encode(), digest_size=8).hexdigest()
+    return Fingerprint(fid, text)
+
+
+def sampled(rate: Optional[float] = None) -> bool:
+    """ONE sampling decision for both planes (the stats table and the
+    span-profile aggregator): record this query/trace?"""
+    r = config.stats_sample_rate if rate is None else rate
+    return r > 0 and (r >= 1.0 or random.random() < r)
+
+
+_fp_cache: "OrderedDict[str, Fingerprint]" = OrderedDict()
+_fp_lock = threading.Lock()
+
+
+def fingerprint_cached(sql: str) -> Fingerprint:
+    """LRU-cached :func:`fingerprint` (mirrors the statement cache —
+    serving paths re-run the same SQL text constantly)."""
+    with _fp_lock:
+        fp = _fp_cache.get(sql)
+        if fp is not None:
+            _fp_cache.move_to_end(sql)
+            return fp
+    fp = fingerprint(sql)
+    with _fp_lock:
+        _fp_cache[sql] = fp
+        while len(_fp_cache) > config.statement_cache_size:
+            _fp_cache.popitem(last=False)
+    return fp
+
+
+# ---------------------------------------------------------------------------
+# per-fingerprint statistics
+# ---------------------------------------------------------------------------
+
+#: latency histogram buckets (seconds) per fingerprint — coarser than
+#: the global ladder; per-entry memory stays small
+_LAT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0)
+
+#: scalar fields exported to /stats/queries, the exposition fan-in, and
+#: the debug bundle: (field, prometheus family suffix, prometheus type)
+EXPORT_FIELDS = (
+    ("calls", "query_calls_total", "counter"),
+    ("errors", "query_errors_total", "counter"),
+    ("rows_returned", "query_rows_returned_total", "counter"),
+    ("total_s", "query_latency_seconds_total", "counter"),
+    ("max_s", "query_latency_seconds_max", "gauge"),
+    ("device_s", "query_device_seconds_total", "counter"),
+    ("transfer_s", "query_transfer_seconds_total", "counter"),
+    ("bytes_fetched", "query_bytes_fetched_total", "counter"),
+    ("compile_s", "query_compile_seconds_total", "counter"),
+    ("compiles", "query_compiles_total", "counter"),
+    ("recompiles", "query_recompiles_total", "counter"),
+    ("plan_cache_hits", "query_plan_cache_hits_total", "counter"),
+    ("plan_cache_misses", "query_plan_cache_misses_total", "counter"),
+    ("result_cache_hits", "query_result_cache_hits_total", "counter"),
+)
+
+#: columns /stats/queries?by=… may sort on (every numeric export field
+#: plus the derived mean)
+SORT_COLUMNS = tuple(f for f, _m, _t in EXPORT_FIELDS) + ("mean_ms",)
+
+
+class _Entry:
+    __slots__ = (
+        "fid",
+        "text",
+        "calls",
+        "errors",
+        "rows_returned",
+        "total_s",
+        "max_s",
+        "device_s",
+        "transfer_s",
+        "bytes_fetched",
+        "compile_s",
+        "compiles",
+        "recompiles",
+        "plan_cache_hits",
+        "plan_cache_misses",
+        "result_cache_hits",
+        "engines",
+        "buckets",
+        "first_ts",
+        "last_ts",
+        "plan",
+    )
+
+    def __init__(self, fid: str, text: str) -> None:
+        self.fid = fid
+        self.text = text
+        self.calls = 0
+        self.errors = 0
+        self.rows_returned = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.device_s = 0.0
+        self.transfer_s = 0.0
+        self.bytes_fetched = 0
+        self.compile_s = 0.0
+        self.compiles = 0
+        self.recompiles = 0
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
+        self.result_cache_hits = 0
+        self.engines: Dict[str, int] = {}
+        self.buckets = [0] * (len(_LAT_BUCKETS) + 1)
+        self.first_ts = time.time()
+        self.last_ts = self.first_ts
+        self.plan: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "fingerprint": self.fid,
+            "query": self.text,
+        }
+        for f, _m, _t in EXPORT_FIELDS:
+            v = getattr(self, f)
+            out[f] = round(v, 6) if isinstance(v, float) else v
+        out["mean_ms"] = (
+            round(self.total_s * 1000.0 / self.calls, 3) if self.calls else 0.0
+        )
+        out["engines"] = dict(self.engines)
+        out["latency_buckets"] = {
+            ("+Inf" if le is None else repr(le)): c
+            for le, c in zip(list(_LAT_BUCKETS) + [None], self.buckets)
+        }
+        out["first_ts"] = round(self.first_ts, 3)
+        out["last_ts"] = round(self.last_ts, 3)
+        if self.plan:
+            out["plan"] = self.plan
+        return out
+
+
+class _Acc:
+    """Per-query thread-local accumulator: the exec layers add device,
+    compile, and cache events here without touching the shared table;
+    :meth:`QueryStats.finish` folds it in under one short lock."""
+
+    __slots__ = (
+        "sql",
+        "device_s",
+        "transfer_s",
+        "bytes_fetched",
+        "compile_s",
+        "compiles",
+        "recompiles",
+        "plan_cache_hits",
+        "plan_cache_misses",
+        "result_cache_hits",
+        "plan",
+        "_rows",
+    )
+
+    def __init__(self, sql: str) -> None:
+        self.sql = sql
+        self.device_s = 0.0
+        self.transfer_s = 0.0
+        self.bytes_fetched = 0
+        self.compile_s = 0.0
+        self.compiles = 0
+        self.recompiles = 0
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
+        self.result_cache_hits = 0
+        self.plan: Optional[str] = None
+        self._rows: Optional[int] = None  # row count noted by the caller
+
+
+_local = threading.local()
+
+
+def _acc_stack() -> list:
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def current_acc() -> Optional[_Acc]:
+    st = getattr(_local, "stack", None)
+    return st[-1] if st else None
+
+
+class QueryStats:
+    """The process-wide per-fingerprint table (LRU-bounded)."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._map: "OrderedDict[str, _Entry]" = OrderedDict()
+        #: None = read config.query_stats_capacity live per insert (the
+        #: slowlog convention: retune without restarting); an explicit
+        #: capacity is fixed
+        self._capacity = capacity
+
+    # -- accumulator lifecycle (called by exec/engine) ----------------------
+
+    def begin(self, sql: str) -> Optional[_Acc]:
+        """Open accounting for one query on this thread; returns None
+        when the query is sampled out (every later hook then no-ops at
+        one thread-local read)."""
+        if not sampled():
+            return None
+        acc = _Acc(sql)
+        _acc_stack().append(acc)
+        return acc
+
+    def finish(
+        self,
+        acc: Optional[_Acc],
+        duration_s: float,
+        engine: str,
+        rows: Optional[int] = None,
+        error: Optional[BaseException] = None,
+    ) -> Optional[str]:
+        """Close the accumulator and fold it into the table; returns
+        the fingerprint id (None when sampled out)."""
+        if acc is None:
+            return None
+        st = _acc_stack()
+        if st and st[-1] is acc:
+            st.pop()
+        else:  # unbalanced (should not happen): drop without corrupting
+            try:
+                st.remove(acc)
+            except ValueError:
+                pass
+        fp = fingerprint_cached(acc.sql)
+        self._record(fp, acc, duration_s, engine, rows, error)
+        return fp.fid
+
+    def _record(
+        self,
+        fp: Fingerprint,
+        acc: _Acc,
+        duration_s: float,
+        engine: str,
+        rows: Optional[int],
+        error: Optional[BaseException],
+    ) -> None:
+        import bisect
+
+        bi = bisect.bisect_left(_LAT_BUCKETS, duration_s)
+        cap = (
+            self._capacity
+            if self._capacity is not None
+            else config.query_stats_capacity
+        )
+        with self._lock:
+            e = self._map.get(fp.fid)
+            if e is None:
+                if cap <= 0:
+                    return
+                while len(self._map) >= cap:
+                    self._map.popitem(last=False)
+                e = self._map[fp.fid] = _Entry(fp.fid, fp.text)
+            else:
+                self._map.move_to_end(fp.fid)
+            e.calls += 1
+            e.last_ts = time.time()
+            e.total_s += duration_s
+            e.max_s = max(e.max_s, duration_s)
+            e.buckets[bi] += 1
+            if error is not None:
+                e.errors += 1
+            if rows is not None:
+                e.rows_returned += rows
+            e.engines[engine] = e.engines.get(engine, 0) + 1
+            e.device_s += acc.device_s
+            e.transfer_s += acc.transfer_s
+            e.bytes_fetched += acc.bytes_fetched
+            e.compile_s += acc.compile_s
+            e.compiles += acc.compiles
+            e.recompiles += acc.recompiles
+            e.plan_cache_hits += acc.plan_cache_hits
+            e.plan_cache_misses += acc.plan_cache_misses
+            e.result_cache_hits += acc.result_cache_hits
+            if acc.plan:
+                e.plan = acc.plan
+
+    def record_external(
+        self,
+        sql: str,
+        duration_s: float,
+        engine: str,
+        rows: Optional[int] = None,
+        error: Optional[BaseException] = None,
+    ) -> Optional[str]:
+        """Record a query that ran without a thread-local accumulator —
+        batch members (``query_batch`` amortizes one wall clock across
+        its statements) and cached replays driven off-thread. Device and
+        compile attribution are absent by construction."""
+        if not sampled():
+            return None
+        fp = fingerprint_cached(sql)
+        self._record(fp, _Acc(sql), duration_s, engine, rows, error)
+        return fp.fid
+
+    # -- reading ------------------------------------------------------------
+
+    def top(self, k: int = 50, by: str = "total_s") -> List[Dict]:
+        """The top-``k`` fingerprints ordered by any export column
+        (``SORT_COLUMNS``); unknown columns fall back to total_s."""
+        if by not in SORT_COLUMNS:
+            by = "total_s"
+        with self._lock:
+            rows = [e.to_dict() for e in self._map.values()]
+        rows.sort(key=lambda r: r.get(by, 0), reverse=True)
+        return rows[: max(k, 0)]
+
+    def export(self, limit: int = 128) -> Dict[str, Dict]:
+        """Scalar-only snapshot for the exposition fan-in
+        (``registry.snapshot_all``): ``{fid: {field: value}}`` for the
+        ``limit`` costliest fingerprints by total latency."""
+        with self._lock:
+            entries = list(self._map.values())
+        entries.sort(key=lambda e: e.total_s, reverse=True)
+        out: Dict[str, Dict] = {}
+        for e in entries[:limit]:
+            out[e.fid] = {
+                f: (round(getattr(e, f), 6) if isinstance(getattr(e, f), float)
+                    else getattr(e, f))
+                for f, _m, _t in EXPORT_FIELDS
+            }
+        return out
+
+    def get(self, fid: str) -> Optional[Dict]:
+        with self._lock:
+            e = self._map.get(fid)
+            return e.to_dict() if e is not None else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+#: the process-wide table (mirrors utils.metrics.metrics / obs registry)
+stats = QueryStats()
+
+
+# -- hot-path hooks (no-ops when no accumulator is active) -------------------
+
+
+def add_device(device_s: float, transfer_s: float, nbytes: int) -> None:
+    """Called by ``tpu_engine._fetch_profiled`` with each fetch wave's
+    device-sync/transfer split and bytes moved."""
+    acc = current_acc()
+    if acc is not None:
+        acc.device_s += device_s
+        acc.transfer_s += transfer_s
+        acc.bytes_fetched += nbytes
+
+
+def add_compile(compile_s: float, rerecord: bool = False) -> None:
+    """Called around ``tpu_engine._record`` — the eager recording
+    execution IS the compile cost a caller absorbs on a plan-cache miss
+    (``rerecord=True`` marks a shape-overflow re-record)."""
+    acc = current_acc()
+    if acc is not None:
+        acc.compile_s += compile_s
+        if rerecord:
+            acc.recompiles += 1
+        else:
+            acc.compiles += 1
+
+
+def note_plan_cache(hit: bool) -> None:
+    acc = current_acc()
+    if acc is not None:
+        if hit:
+            acc.plan_cache_hits += 1
+        else:
+            acc.plan_cache_misses += 1
+
+
+def note_result_cache_hit() -> None:
+    """Called by ``exec/command_cache`` — cached executions still count
+    as calls; this marks how many were served without running."""
+    acc = current_acc()
+    if acc is not None:
+        acc.result_cache_hits += 1
+
+
+def note_plan(description: str) -> None:
+    """Attach a plan description (compiled step chain / EXPLAIN head)
+    to the active query's fingerprint entry."""
+    acc = current_acc()
+    if acc is not None:
+        acc.plan = description[:400]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering (shared by /stats/queries and the registry fan-in)
+# ---------------------------------------------------------------------------
+
+
+def render_stats_into(
+    lines: List[str],
+    snapshots: Dict[Optional[str], Dict[str, Dict]],
+) -> None:
+    """Render per-fingerprint families into ``lines`` in exposition
+    order (family outer, members+fingerprints inner — the grammar
+    requires one contiguous group per family). ``snapshots`` maps a
+    member name (or None for the single-process form) to that member's
+    :meth:`QueryStats.export` dict."""
+    members = sorted(snapshots, key=lambda m: m or "")
+    for field, fam, typ in EXPORT_FIELDS:
+        m = f"orienttpu_{fam}"
+        header_done = False
+        for mem in members:
+            for fid in sorted(snapshots[mem] or {}):
+                v = snapshots[mem][fid].get(field)
+                if v is None:
+                    continue
+                if not header_done:
+                    lines.append(f"# HELP {m} orientdb-tpu metric {m}")
+                    lines.append(f"# TYPE {m} {typ}")
+                    header_done = True
+                labels = f'fingerprint="{fid}"'
+                if mem is not None:
+                    labels += f',member="{mem}"'
+                lines.append(f"{m}{{{labels}}} {v}")
+
+
+def render_stats_prometheus(limit: int = 128) -> str:
+    """The process's own query-stats exposition (``GET
+    /stats/queries?format=prometheus``)."""
+    lines: List[str] = []
+    render_stats_into(lines, {None: stats.export(limit)})
+    return "\n".join(lines) + "\n"

@@ -151,10 +151,28 @@ class Tracer:
     def __init__(self, capacity: int) -> None:
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=max(capacity, 16))
+        #: finished-span listeners (obs/profile's aggregator); called
+        #: OUTSIDE the ring lock, on the finishing span's own thread
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     def record(self, sp: span) -> None:
         with self._lock:
             self._spans.append(sp)
+        for fn in self._listeners:
+            try:
+                fn(sp)
+            except Exception:  # a listener must never fail a span exit
+                pass
 
     def spans(
         self,

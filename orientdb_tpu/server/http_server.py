@@ -357,6 +357,48 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if head == "stats" and rest in (["queries"], ["profile"]):
+                # the query-statistics plane (obs/stats, obs/profile):
+                # per-fingerprint cumulative cost, top-K by any column,
+                # and the span-profile self-time tree. JSON by default
+                # (an operator/API surface); ?format=prometheus serves
+                # the promlint-clean per-fingerprint exposition.
+                if rest == ["profile"]:
+                    from orientdb_tpu.obs.profile import profiler
+
+                    return self._send(200, profiler.profile())
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                from orientdb_tpu.obs.stats import (
+                    SORT_COLUMNS,
+                    render_stats_prometheus,
+                    stats,
+                )
+
+                if "prometheus" in q.get("format", []):
+                    body = render_stats_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                try:
+                    k = int(q.get("k", ["50"])[0])
+                except ValueError:
+                    k = 50
+                by = q.get("by", ["total_s"])[0]
+                return self._send(
+                    200,
+                    {
+                        "by": by if by in SORT_COLUMNS else "total_s",
+                        "queries": stats.top(k, by=by),
+                    },
+                )
             if head == "cluster" and rest in (["health"], ["metrics"]):
                 # fleet-level aggregation plane (obs/cluster_view):
                 # per-member liveness/role/lag/in-doubt, and the fan-in

@@ -187,6 +187,12 @@ class Server:
         self._http.start()
         self._binary = BinaryListener(self, self._binary_port)
         self._binary.start()
+        # scrape-time memory telemetry over this server's databases
+        # (snapshot column/adjacency bytes, WAL segment bytes —
+        # obs/profile refreshes them on every /metrics snapshot)
+        from orientdb_tpu.obs.profile import register_server_telemetry
+
+        self._telemetry_provider = register_server_telemetry(self)
         self.running = True
         log.info(
             "server '%s' up: http=%d binary=%d",
@@ -207,6 +213,12 @@ class Server:
             self._http.stop()
         if self._binary is not None:
             self._binary.stop()
+        provider = getattr(self, "_telemetry_provider", None)
+        if provider is not None:
+            from orientdb_tpu.obs.profile import unregister_gauge_provider
+
+            unregister_gauge_provider(provider)
+            self._telemetry_provider = None
         self.coalescer.stop()
         for db in list(self.databases.values()):
             sch = getattr(db, "_scheduler", None)

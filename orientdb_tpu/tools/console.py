@@ -12,6 +12,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   LOAD RECORD <rid>                   EXPORT DATABASE <path>
   IMPORT DATABASE <path>              DISCONNECT / QUIT / EXIT
   SLOWLOG [<n>|CLEAR]                 DIAG [<path>]
+  STATS QUERIES [<k>]                 STATS PROFILE / STATS RESET
 """
 
 from __future__ import annotations
@@ -249,10 +250,65 @@ class Console(cmd.Cmd):
             return
         for e in entries:
             trace = f" trace={e['trace_id']}" if e.get("trace_id") else ""
+            # the fingerprint is the pivot into STATS QUERIES: one slow
+            # query joins its shape's cumulative cost on this id
+            fp = f" fp={e['fingerprint']}" if e.get("fingerprint") else ""
+            cache = f" cache={e['cache']}" if e.get("cache") else ""
             self._p(
-                f"{e['ms']:>9.1f} ms  [{e['engine']}]{trace}  {e['sql']}"
+                f"{e['ms']:>9.1f} ms  [{e['engine']}]{fp}{cache}{trace}"
+                f"  {e['sql']}"
             )
         self._p(f"({len(entries)} entries)")
+
+    def do_stats(self, arg: str) -> None:
+        """STATS QUERIES [<k>] — top-k query shapes by cumulative
+        latency (fingerprint, calls, errors, mean ms, device/compile
+        ms, cache hits); STATS PROFILE — per-stage self-time from the
+        span aggregator; STATS RESET — clear both planes."""
+        from orientdb_tpu.obs.profile import profiler
+        from orientdb_tpu.obs.stats import stats
+
+        parts = arg.split()
+        sub = parts[0].lower() if parts else "queries"
+        if sub == "reset":
+            stats.reset()
+            profiler.reset()
+            self._p("query stats and profile reset")
+            return
+        if sub == "profile":
+            rows = profiler.flat(20)
+            if not rows:
+                self._p("profile empty")
+                return
+            self._p(f"{'self ms':>12} {'total ms':>12} {'count':>8}  stage")
+            for r in rows:
+                self._p(
+                    f"{r['self_ms']:>12.1f} {r['total_ms']:>12.1f} "
+                    f"{r['count']:>8}  {r['name']}"
+                )
+            return
+        if sub != "queries":
+            self._p("!! usage: STATS QUERIES [<k>] | PROFILE | RESET")
+            return
+        k = int(parts[1]) if len(parts) > 1 and parts[1].isdigit() else 10
+        rows = stats.top(k)
+        if not rows:
+            self._p("no recorded queries")
+            return
+        self._p(
+            f"{'fingerprint':<16} {'calls':>7} {'err':>5} {'mean ms':>9} "
+            f"{'dev ms':>9} {'compile ms':>11} {'cache':>6}  query"
+        )
+        for r in rows:
+            self._p(
+                f"{r['fingerprint']:<16} {r['calls']:>7} {r['errors']:>5} "
+                f"{r['mean_ms']:>9.2f} "
+                f"{r['device_s'] * 1000:>9.1f} "
+                f"{r['compile_s'] * 1000:>11.1f} "
+                f"{r['plan_cache_hits'] + r['result_cache_hits']:>6}  "
+                f"{r['query'][:70]}"
+            )
+        self._p(f"({len(rows)} shapes)")
 
     def do_diag(self, arg: str) -> None:
         """DIAG [<path>] — flight-recorder debug bundle (obs/bundle):

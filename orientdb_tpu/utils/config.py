@@ -134,6 +134,13 @@ class GlobalConfiguration:
     slow_query_ms: float = 1000.0
     slowlog_capacity: int = 256
     trace_capacity: int = 4096
+    # Query statistics & continuous profiling (obs/stats, obs/profile):
+    # fraction of queries/traces folded into the per-fingerprint stats
+    # table and the span-profile aggregator (1.0 = everything, 0
+    # disables); the table keeps the query_stats_capacity hottest
+    # fingerprints (LRU).
+    stats_sample_rate: float = 1.0
+    query_stats_capacity: int = 512
 
     # Admission control (server/http_server, server/binary_server):
     # shed WRITE requests with 503 + Retry-After when the listener's
