@@ -615,6 +615,29 @@ def main() -> None:
         budget_s=budget_s,
     )
 
+    # static-analysis gate, recorded per round: pass names + finding
+    # counts ride the evidence stream so a regression that slipped past
+    # tier-1 (or a run from a dirtied tree) is visible next to the
+    # numbers it may have tainted
+    if budget_ok("static_analysis"):
+        try:
+            from orientdb_tpu.analysis import run as run_analysis
+
+            _rep = run_analysis()
+            extras["static_analysis"] = dict(_rep.counts)
+            ev(
+                "static_analysis",
+                ok=_rep.ok,
+                passes=dict(_rep.counts),
+                findings=len(_rep.findings),
+                suppressed=len(_rep.suppressed),
+            )
+        except Exception as e:
+            # the bench must still measure when the analysis can't run
+            # (e.g. stripped source tree); the failure itself is
+            # evidence
+            ev("static_analysis", error=f"{type(e).__name__}: {e}")
+
     db = None
     if budget_ok("parity"):
         from orientdb_tpu.storage.ingest import generate_demodb

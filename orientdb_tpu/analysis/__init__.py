@@ -1,0 +1,39 @@
+"""Unified static-analysis subsystem.
+
+Three PRs in a row grew one-off AST lints (``chaos/iolint.py``,
+``obs/spanlint.py``, the AST side of ``obs/promlint.py``) because hand
+review kept missing whole bug classes — the meta-level-checking
+argument of "Bugs as Deviant Behavior" (Engler et al. 2001): the
+codebase's own invariants are machine-checkable, so check them on
+every build. This package is the shared framework those lints (and the
+heavier lock/config/exception passes) now run on:
+
+- :mod:`core` — module discovery over the whole tree, the
+  :class:`~orientdb_tpu.analysis.core.Finding` record, per-line
+  ``# lint: allow(<pass>)`` suppressions WITH unused-suppression
+  detection, and the pass registry;
+- :mod:`locklint` — static lock-nesting graph (lock-order cycles) and
+  blocking calls made while a lock is held (lockdep-style discipline);
+- :mod:`configlint` — every ``config.<key>`` read has a declared
+  default in ``utils/config.py`` and a README mention; dead keys flag;
+- :mod:`exceptlint` — no ``BaseException`` swallow anywhere
+  (``SimulatedCrash`` must always escape), no silent ``except
+  Exception`` in dispatch paths;
+- :mod:`iolint` / :mod:`spanlint` / :mod:`promlint` — the three
+  migrated lints (fault-point routing, span-name catalog, metric-name
+  grammar).
+
+CLI: ``python -m orientdb_tpu.analysis [--json]`` exits non-zero on
+any unsuppressed finding; ``tests/test_analysis.py`` enforces that
+tier-1.
+"""
+
+from orientdb_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    PASSES,
+    Report,
+    SourceTree,
+    load_passes,
+    register,
+    run,
+)

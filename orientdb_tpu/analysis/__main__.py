@@ -1,0 +1,71 @@
+"""CLI: ``python -m orientdb_tpu.analysis [--json] [--pass NAME]``.
+
+Exit status 0 when every pass is clean (no unsuppressed findings),
+1 otherwise — the same gate ``tests/test_analysis.py`` enforces
+tier-1 and ``bench.py`` records into its evidence stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from orientdb_tpu.analysis import core
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m orientdb_tpu.analysis",
+        description="run the static-analysis passes over the tree",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report on stdout",
+    )
+    p.add_argument(
+        "--list", action="store_true",
+        help="list registered passes and exit",
+    )
+    p.add_argument(
+        "--pass", dest="passes", action="append", metavar="NAME",
+        help="run only this pass (repeatable; default: all)",
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="repo root to scan (default: this checkout)",
+    )
+    args = p.parse_args(argv)
+    core.load_passes()
+    if args.list:
+        for name in sorted(core.PASSES):
+            print(f"{name:12s} {core.PASSES[name].title}")
+        return 0
+    if args.passes:
+        unknown = [n for n in args.passes if n not in core.PASSES]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+    report = core.run(passes=args.passes, root=args.root)
+    if args.json:
+        json.dump(report.to_dict(), sys.stdout, indent=1,
+                  sort_keys=True)
+        print()
+    else:
+        for f in report.findings:
+            print(f)
+        total = len(report.findings)
+        counts = ", ".join(
+            f"{n}={c}" for n, c in sorted(report.counts.items())
+        )
+        print(
+            f"{'CLEAN' if report.ok else 'FAIL'}: {total} unsuppressed "
+            f"finding(s) [{counts}] "
+            f"({len(report.suppressed)} suppressed)"
+        )
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""configlint — config reads, declarations, and docs agree.
+
+``utils/config.py`` is the single typed registry of tunables (the
+OGlobalConfiguration analog). An ad-hoc ``config.some_knob`` read that
+nobody declared crashes at runtime with AttributeError on the first
+code path that reaches it; a declared key nobody reads is dead weight
+that operators still try to tune; an undocumented key is invisible to
+them. This pass closes the triangle:
+
+- every ``config.<key>`` / ``getattr(config, "<key>")`` read anywhere
+  in the tree (on a name imported from ``utils.config``) must be a
+  declared ``GlobalConfiguration`` field;
+- every declared field must be read somewhere;
+- every declared field must be mentioned in README.md (skipped when
+  the tree carries no README text, e.g. installed packages).
+
+Declarations are read from the AST of ``utils/config.py`` (annotated
+assignments on the ``GlobalConfiguration`` class body), so the pass
+works on synthetic trees in mutation tests too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from orientdb_tpu.analysis.core import Finding, SourceTree, register
+
+CONFIG_PATH = "orientdb_tpu/utils/config.py"
+_CLASS = "GlobalConfiguration"
+
+
+def declared_keys(tree: SourceTree) -> Optional[Dict[str, int]]:
+    """field name → declaration line, or None when the config module
+    is absent from the tree (nothing to check against)."""
+    mod = tree.module(CONFIG_PATH)
+    if mod is None or mod.tree is None:
+        return None
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == _CLASS:
+            out: Dict[str, int] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    out[stmt.target.id] = stmt.lineno
+            return out
+    return None
+
+
+def _config_aliases(tree_mod: ast.Module) -> Set[str]:
+    """Local names bound to the global config singleton in a module
+    (``from ...utils.config import config [as X]``, at any depth)."""
+    out: Set[str] = set()
+    for n in ast.walk(tree_mod):
+        if not isinstance(n, ast.ImportFrom):
+            continue
+        modname = n.module or ""
+        if not (
+            modname.endswith("utils.config") or modname == "utils"
+        ):
+            continue
+        for alias in n.names:
+            if alias.name == "config":
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def config_reads(tree: SourceTree) -> List[Tuple[str, int, str]]:
+    """Every static read/write of a config key: (path, line, key)."""
+    out: List[Tuple[str, int, str]] = []
+    for m in tree.modules:
+        if m.path == CONFIG_PATH or m.tree is None:
+            continue
+        aliases = _config_aliases(m.tree)
+        if not aliases:
+            continue
+        for n in ast.walk(m.tree):
+            if (
+                isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id in aliases
+            ):
+                out.append((m.path, n.lineno, n.attr))
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id == "getattr"
+                and len(n.args) >= 2
+                and isinstance(n.args[0], ast.Name)
+                and n.args[0].id in aliases
+                and isinstance(n.args[1], ast.Constant)
+                and isinstance(n.args[1].value, str)
+            ):
+                out.append((m.path, n.lineno, n.args[1].value))
+    return out
+
+
+@register(
+    "configlint",
+    "config.<key> reads have declared defaults in utils/config.py "
+    "and README docs; dead keys flag",
+)
+def run_configlint(tree: SourceTree) -> Iterable[Finding]:
+    declared = declared_keys(tree)
+    if declared is None:
+        return []
+    findings: List[Finding] = []
+    read_keys: Set[str] = set()
+    for path, line, key in config_reads(tree):
+        read_keys.add(key)
+        if key not in declared:
+            findings.append(
+                Finding(
+                    "configlint", path, line,
+                    f"config.{key} has no declared default — add the "
+                    f"field to {_CLASS} in utils/config.py",
+                )
+            )
+    readme = tree.readme
+    for key in sorted(declared):
+        if key not in read_keys:
+            findings.append(
+                Finding(
+                    "configlint", CONFIG_PATH, declared[key],
+                    f"declared config key {key!r} is never read — "
+                    "delete it or wire it in",
+                )
+            )
+        elif readme and key not in readme:
+            findings.append(
+                Finding(
+                    "configlint", CONFIG_PATH, declared[key],
+                    f"config key {key!r} is not mentioned in "
+                    "README.md — document it in the configuration "
+                    "reference",
+                )
+            )
+    return findings

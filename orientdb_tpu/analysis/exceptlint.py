@@ -1,0 +1,133 @@
+"""exceptlint — exception-safety discipline.
+
+Two rules, both motivated by real near-misses PR 3's review caught by
+hand:
+
+1. **No BaseException swallow, anywhere.** The chaos harness's
+   ``SimulatedCrash`` deliberately subclasses ``BaseException`` so
+   that ``except Exception`` recovery paths cannot absorb an injected
+   crash — but a bare ``except:`` or ``except BaseException`` that
+   does not unconditionally re-raise CAN, silently voiding every
+   crash test that passes through it. Handlers catching
+   ``BaseException`` (or bare) must contain a bare ``raise`` (cleanup
+   + re-raise is the legitimate shape). The allowlist for deliberate
+   exceptions is the framework's per-line
+   ``lint: allow(exceptlint)`` comment, which self-reports when
+   stale.
+
+2. **No silent ``except Exception`` in dispatch paths.** Under
+   ``server/``, ``parallel/`` and ``exec/`` — the request-dispatch,
+   replication and engine loops — a handler whose body is only
+   ``pass``/``continue`` discards the error with no log line and no
+   metric: the operator sees dropped acks, stuck stages or missing
+   results with nothing in any signal plane. Such handlers must log,
+   count a metric, re-raise, or at least return an explicit fallback.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from orientdb_tpu.analysis.core import Finding, SourceTree, register
+
+#: dirs whose dispatch/apply loops rule 2 patrols
+DISPATCH_DIRS = ("server", "parallel", "exec")
+
+
+def _catches_base(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id == "BaseException":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "BaseException":
+            return True
+    return False
+
+
+def _catches_exception(handler: ast.ExceptHandler) -> bool:
+    """``except Exception`` — as a bare name or anywhere in a tuple
+    (``except (Exception, OSError)`` discards just as silently)."""
+    t = handler.type
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(
+        isinstance(n, ast.Name) and n.id == "Exception" for n in names
+    )
+
+
+def _has_bare_raise(body: List[ast.stmt]) -> bool:
+    """A bare ``raise`` anywhere in the handler body, not counting
+    nested function definitions (those run later, under a different
+    active exception)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Raise) and n.exc is None:
+            return True
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _body_is_silent(body: List[ast.stmt]) -> bool:
+    return all(
+        isinstance(s, (ast.Pass, ast.Continue))
+        or (
+            isinstance(s, ast.Expr)
+            and isinstance(s.value, ast.Constant)
+        )
+        for s in body
+    )
+
+
+@register(
+    "exceptlint",
+    "no BaseException swallow anywhere (SimulatedCrash-safe); no "
+    "silent except-Exception in dispatch paths",
+)
+def run_exceptlint(tree: SourceTree) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    dispatch = {m.path for m in tree.in_dirs(*DISPATCH_DIRS)}
+    for m in tree.modules:
+        if m.tree is None:
+            continue
+        for n in ast.walk(m.tree):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            if _catches_base(n):
+                if _has_bare_raise(n.body):
+                    continue
+                what = (
+                    "bare except:" if n.type is None
+                    else "except BaseException"
+                )
+                findings.append(
+                    Finding(
+                        "exceptlint", m.path, n.lineno,
+                        f"{what} without re-raise can swallow "
+                        "SimulatedCrash (and KeyboardInterrupt) — "
+                        "re-raise after cleanup, narrow the type, or "
+                        "suppress this line with a justification",
+                    )
+                )
+            elif (
+                m.path in dispatch
+                and _catches_exception(n)
+                and _body_is_silent(n.body)
+            ):
+                findings.append(
+                    Finding(
+                        "exceptlint", m.path, n.lineno,
+                        "except Exception discards the error with no "
+                        "log/metric in a dispatch path — log it, "
+                        "count a metric, or return an explicit "
+                        "fallback",
+                    )
+                )
+    return findings

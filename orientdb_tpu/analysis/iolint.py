@@ -1,0 +1,66 @@
+"""iolint — every inter-node I/O call site routes through a fault
+point (migrated from ``chaos/iolint.py`` onto the shared framework).
+
+The chaos subsystem only covers what is wrapped: a new channel added
+without a ``fault.point("...")`` silently bypasses injection, the
+breakers, and the whole chaos acceptance suite. Any outermost
+function/method under the scanned dirs that performs raw inter-node
+I/O (``urlopen``, socket ``sendall``/``recv``/``create_connection``)
+must also contain a ``*.point(...)`` call (nested helper defs count
+as part of their enclosing def).
+
+The I/O vocabulary and the deliberate ``EXEMPT`` list stay in
+``chaos/iolint.py`` next to the fault-point catalog they protect;
+this module is the framework pass over them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from orientdb_tpu.analysis.core import Finding, SourceTree, register
+from orientdb_tpu.chaos.iolint import (
+    EXEMPT,
+    SCAN_DIRS,
+    _is_io_call,
+    _is_point_call,
+    _outermost_functions,
+)
+
+_PKG_PREFIX = "orientdb_tpu/"
+
+
+@register(
+    "iolint",
+    "every raw inter-node I/O call site routes through a chaos "
+    "fault.point(...)",
+)
+def run_iolint(tree: SourceTree) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for m in tree.in_dirs(*SCAN_DIRS):
+        if m.tree is None:
+            continue
+        # EXEMPT entries are package-relative (chaos/iolint.py)
+        rel = m.path[len(_PKG_PREFIX):] if m.path.startswith(
+            _PKG_PREFIX
+        ) else m.path
+        for fn in _outermost_functions(m.tree):
+            calls = [
+                n for n in ast.walk(fn) if isinstance(n, ast.Call)
+            ]
+            if not any(_is_io_call(c) for c in calls):
+                continue
+            if (rel, fn.name) in EXEMPT:
+                continue
+            if not any(_is_point_call(c) for c in calls):
+                findings.append(
+                    Finding(
+                        "iolint", m.path, fn.lineno,
+                        f"{fn.name}() performs inter-node I/O with no "
+                        "fault.point(...) — wrap the call site in a "
+                        "named injection point (chaos/faults.py) or "
+                        "add an EXEMPT entry with a justification",
+                    )
+                )
+    return findings

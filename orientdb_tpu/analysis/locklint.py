@@ -1,0 +1,251 @@
+"""locklint — static lock-nesting graph + blocking-under-lock.
+
+Lockdep-style discipline for a codebase whose locks are plain
+``threading.Lock``/``RLock`` attributes acquired with ``with``:
+
+1. every ``with <lock>:`` acquisition made while another lock is
+   lexically held adds an edge to the **static lock-nesting graph**;
+   a cycle in that graph is a potential ABBA deadlock and is flagged
+   even though no test ever interleaves the two paths;
+2. any **blocking call** made while a lock is held — ``time.sleep``,
+   ``urlopen``, socket ``sendall``/``recv``/``create_connection``,
+   ``block_until_ready()``, ``jax.device_get`` — is flagged: a sleep
+   or network round-trip under a hot lock serializes every other
+   thread behind one slow peer (the FailoverDatabase bug PR 3's
+   review caught by hand; this pass catches the whole class).
+
+Lock recognition is lexical: a ``with`` context expression whose name
+or attribute contains ``lock`` (any case) or is ``_mu``/``mu``.
+Graph nodes are qualified as ``<module>.<Class>.<attr>`` for
+``self.<attr>``, ``*.<attr>`` for other attribute locks (one node per
+attribute name — cross-object order still holds), and
+``<module>.<name>`` for bare names. The analysis is intra-procedural
+and lexical: nested ``def``/``lambda`` bodies run later, not under
+the enclosing lock, so they restart with an empty hold-stack.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from orientdb_tpu.analysis.core import Finding, SourceTree, register
+from orientdb_tpu.chaos.iolint import IO_ATTRS, IO_NAMES
+
+#: package dirs whose locks participate (the concurrent subsystems)
+SCAN_DIRS = ("exec", "parallel", "server", "storage", "obs")
+
+_LOCKY = re.compile(r"lock", re.IGNORECASE)
+_MUTEX_NAMES = frozenset({"_mu", "mu"})
+
+#: bare-name calls that block: the chaos lint's inter-node I/O
+#: vocabulary (ONE list to extend when a channel primitive is added)
+#: plus sleeping
+BLOCKING_NAMES = IO_NAMES | {"sleep"}
+#: attribute calls that block: I/O vocabulary + time.sleep + jax
+#: array sync and device fetch
+BLOCKING_ATTRS = IO_ATTRS | {"sleep", "block_until_ready", "device_get"}
+
+#: an edge: (held lock, acquired lock) → (path, line) of one witness
+LockEdges = Dict[Tuple[str, str], Tuple[str, int]]
+
+
+def _lock_name(expr: ast.expr) -> Optional[str]:
+    """The lock-ish attribute/name of a with-context, or None."""
+    if isinstance(expr, ast.Name):
+        n = expr.id
+    elif isinstance(expr, ast.Attribute):
+        n = expr.attr
+    else:
+        return None
+    if _LOCKY.search(n) or n in _MUTEX_NAMES:
+        return n
+    return None
+
+
+def _node_id(expr: ast.expr, modname: str, classname: Optional[str]) -> str:
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id == "self" and classname:
+            return f"{modname}.{classname}.{expr.attr}"
+        return f"*.{expr.attr}"
+    assert isinstance(expr, ast.Name)
+    return f"{modname}.{expr.id}"
+
+
+def _blocking_callee(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in BLOCKING_NAMES:
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in BLOCKING_ATTRS:
+        return f.attr
+    return None
+
+
+class _Walker:
+    def __init__(self, path: str, modname: str) -> None:
+        self.path = path
+        self.modname = modname
+        self.edges: LockEdges = {}
+        self.findings: List[Finding] = []
+
+    def walk(self, node: ast.AST, held: List[Tuple[str, int]],
+             classname: Optional[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            for c in node.body:
+                self.walk(c, held, node.name)
+            return
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            # a nested def's body runs later, not under the lock
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for c in body:
+                self.walk(c, [], classname)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[Tuple[str, int]] = []
+            for item in node.items:
+                ce = item.context_expr
+                if _lock_name(ce) is not None:
+                    nid = _node_id(ce, self.modname, classname)
+                    for h, _hl in held + acquired:
+                        if h != nid:  # reentrant re-acquire is legal
+                            self.edges.setdefault(
+                                (h, nid), (self.path, ce.lineno)
+                            )
+                    acquired.append((nid, ce.lineno))
+                else:
+                    # a later item's context expression evaluates
+                    # AFTER earlier items acquired — e.g.
+                    # `with self._lock, urlopen(u):` blocks under
+                    # the lock
+                    self.walk(ce, held + acquired, classname)
+                if item.optional_vars is not None:
+                    self.walk(
+                        item.optional_vars, held + acquired, classname
+                    )
+            for stmt in node.body:
+                self.walk(stmt, held + acquired, classname)
+            return
+        if isinstance(node, ast.Call) and held:
+            callee = _blocking_callee(node)
+            if callee is not None:
+                lock, lline = held[-1]
+                self.findings.append(
+                    Finding(
+                        "locklint", self.path, node.lineno,
+                        f"blocking call {callee}() while holding "
+                        f"{lock} (acquired line {lline}) — move the "
+                        "wait outside the critical section",
+                    )
+                )
+        for c in ast.iter_child_nodes(node):
+            self.walk(c, held, classname)
+
+
+def lock_graph(tree: SourceTree) -> Tuple[LockEdges, List[Finding]]:
+    """Build the nesting graph over the scanned dirs; returns
+    (edges, blocking-call findings)."""
+    edges: LockEdges = {}
+    findings: List[Finding] = []
+    for m in tree.in_dirs(*SCAN_DIRS):
+        if m.tree is None:
+            continue
+        modname = m.path.rsplit("/", 1)[-1][:-3]
+        w = _Walker(m.path, modname)
+        w.walk(m.tree, [], None)
+        for k, v in w.edges.items():
+            edges.setdefault(k, v)
+        findings.extend(w.findings)
+    return edges, findings
+
+
+def _cycles(edges: LockEdges) -> List[List[str]]:
+    """Strongly-connected components of size > 1 (each is at least one
+    lock-order cycle), canonicalized for stable reporting."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the graph is tiny, but recursion depth
+        # must not depend on lock count)
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+@register(
+    "locklint",
+    "lock-order cycles + blocking calls (sleep/network/device sync) "
+    "made while a lock is held",
+)
+def run_locklint(tree: SourceTree) -> Iterable[Finding]:
+    edges, findings = lock_graph(tree)
+    for comp in _cycles(edges):
+        members = set(comp)
+        # anchor the report at one edge inside the cycle
+        witness = min(
+            (
+                loc
+                for (a, b), loc in edges.items()
+                if a in members and b in members
+            ),
+            default=("?", 0),
+        )
+        findings.append(
+            Finding(
+                "locklint", witness[0], witness[1],
+                "lock-order cycle between "
+                + " <-> ".join(comp)
+                + " — two threads taking them in opposite orders "
+                "deadlock; pick one global order",
+            )
+        )
+    return findings

@@ -1,0 +1,75 @@
+"""promlint (AST side) — metric-name discipline at registration sites.
+
+``obs/promlint.py`` lints the *rendered* exposition grammar at
+runtime (tier-1 over ``/metrics`` and ``/cluster/metrics``). That
+catches malformed documents, but only for metrics a test actually
+emits. This pass is the static half: every **string-literal** metric
+name handed to the process registries —
+``metrics.incr/gauge/observe`` (utils/metrics) and
+``obs.observe/observe_size/histogram`` (obs/registry) — must match
+the internal dotted grammar ``[a-z][a-z0-9_.]*``. Anything else
+(dashes, uppercase, leading digits) sanitizes lossily in
+``_prom_name`` — two distinct internal names can collide into one
+exposed family, corrupting dashboards with merged series.
+
+Dynamically built names (f-strings like ``f"breaker.{name}.state"``)
+cannot be linted literal-by-literal; the runtime grammar lint covers
+what they render to.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from orientdb_tpu.analysis.core import Finding, SourceTree, register
+
+#: internal dotted metric-name grammar: sanitizes 1:1 to a Prometheus
+#: identifier (dots → underscores) with no possibility of collision
+INTERNAL_NAME_RE = re.compile(r"[a-z][a-z0-9_.]*\Z")
+
+#: registry receivers whose listed methods take a metric name first
+_RECEIVERS = frozenset({"metrics", "obs"})
+_METHODS = frozenset({"incr", "gauge", "observe", "observe_size", "histogram"})
+
+
+@register(
+    "promlint",
+    "literal metric names at registration sites match the internal "
+    "dotted grammar (static half of obs/promlint)",
+)
+def run_promlint(tree: SourceTree) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for m in tree.modules:
+        if m.tree is None:
+            continue
+        for n in ast.walk(m.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in _METHODS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _RECEIVERS
+            ):
+                continue
+            if not (
+                n.args
+                and isinstance(n.args[0], ast.Constant)
+                and isinstance(n.args[0].value, str)
+            ):
+                continue  # dynamic name: the runtime grammar lint's job
+            name = n.args[0].value
+            if not INTERNAL_NAME_RE.match(name):
+                findings.append(
+                    Finding(
+                        "promlint", m.path, n.lineno,
+                        f"metric name {name!r} violates the internal "
+                        "grammar [a-z][a-z0-9_.]* — it sanitizes "
+                        "lossily in _prom_name and can collide with "
+                        "another family in the exposition",
+                    )
+                )
+    return findings

@@ -13,7 +13,12 @@ functions count as part of their enclosing def).
 
 ``EXEMPT`` names the deliberate exceptions: helpers whose ONLY callers
 already hold the point (so a second point would double-fire per
-operation). Enforced by ``tests/test_chaos_faults.py``.
+operation). The check now runs as the ``iolint`` pass of
+``orientdb_tpu/analysis`` (enforced tier-1 by
+``tests/test_analysis.py``); ``lint_package`` below stays as a
+back-compat shim. The I/O vocabulary, ``EXEMPT``, and the
+``_iter_points`` catalog cross-check live here, next to the fault
+points they protect.
 """
 
 from __future__ import annotations
@@ -95,28 +100,25 @@ def lint_source(src: str, rel: str) -> List[str]:
 
 
 def lint_package(root: str = None) -> List[str]:
-    """Lint every module under the scanned directories; returns all
-    problems found (empty = every channel is injectable)."""
-    if root is None:
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    problems: List[str] = []
-    for d in SCAN_DIRS:
-        base = os.path.join(root, d)
-        if not os.path.isdir(base):
-            continue
-        for dirpath, _dirs, files in os.walk(base):
-            for f in sorted(files):
-                if not f.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, f)
-                rel = os.path.relpath(path, root).replace(os.sep, "/")
-                with open(path, "r", encoding="utf-8") as fh:
-                    src = fh.read()
-                try:
-                    problems.extend(lint_source(src, rel))
-                except SyntaxError as e:  # pragma: no cover
-                    problems.append(f"{rel}: unparsable: {e}")
-    return problems
+    """Legacy entry point — now a thin shim over the framework pass
+    (``orientdb_tpu.analysis``, pass ``iolint``): shared discovery,
+    per-line suppressions, and reporting. ``root`` is the package
+    directory (historical signature); returns problem strings (empty =
+    every channel is injectable)."""
+    from orientdb_tpu.analysis import core
+
+    repo = None if root is None else os.path.dirname(
+        os.path.abspath(root)
+    )
+    rep = core.run(passes=["iolint"], root=repo)
+    scanned = tuple(f"orientdb_tpu/{d}/" for d in SCAN_DIRS)
+    return [
+        str(f)
+        for f in rep.findings
+        if f.pass_name == "iolint"
+        # the old contract also reported unparsable scanned modules
+        or (f.pass_name == "parse" and f.path.startswith(scanned))
+    ]
 
 
 def _iter_points(root: str = None) -> List[Tuple[str, int, str]]:

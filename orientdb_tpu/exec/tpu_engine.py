@@ -68,6 +68,22 @@ from orientdb_tpu.utils.metrics import metrics, timed
 log = get_logger("tpu_engine")
 
 
+def _block_until_ready(d) -> None:
+    """Device sync; host-resident numpy results (the CPU-backend fast
+    paths) lack the method and need none."""
+    fn = getattr(d, "block_until_ready", None)
+    if fn is not None:
+        fn()
+
+
+def _copy_to_host_async(d) -> None:
+    """Start an async device→host copy; host-resident numpy results
+    lack the method and need none."""
+    fn = getattr(d, "copy_to_host_async", None)
+    if fn is not None:
+        fn()
+
+
 def _fetch_profiled(devs: List, split_sync: bool = True) -> List[np.ndarray]:
     """Fetch dispatched device results with the 3-way accounting the
     perf work aims by: device-sync time, transfer time, bytes moved
@@ -82,16 +98,10 @@ def _fetch_profiled(devs: List, split_sync: bool = True) -> List[np.ndarray]:
 
     t0 = _time.perf_counter()
     if split_sync and len(devs) > 1:
-        try:
-            devs[-1].block_until_ready()
-        except Exception:
-            pass  # already a host array (CPU backend fast paths)
+        _block_until_ready(devs[-1])
     t1 = _time.perf_counter()
     for d in devs:
-        try:
-            d.copy_to_host_async()
-        except Exception:
-            pass  # CPU backend: already host-resident
+        _copy_to_host_async(d)
     arrs = [np.asarray(d) for d in devs]
     t2 = _time.perf_counter()
     if devs:
@@ -2398,8 +2408,12 @@ class _AotWarmup:
             try:
                 for attempt in (0, 1):
                     try:
+                        # the lock serializes TRACING (thread-local
+                        # device-graph cache swaps); device execution
+                        # is async, so wait for it after release
                         with _TRACE_LOCK:
-                            jax.block_until_ready(self._warm_call())
+                            res = self._warm_call()
+                        jax.block_until_ready(res)
                         metrics.incr("plan_cache.aot_compile")
                         break
                     except Exception:
@@ -2919,9 +2933,11 @@ class _CompiledPlan(_AotWarmup):
                         fn = jax.jit(
                             jax.vmap(replay, in_axes=(None, 0))
                         )
+                        # tracing completes when the call returns;
+                        # the device-side wait runs lock-free
                         with _TRACE_LOCK:
                             res = fn(self._arg_subset(), stacked)
-                            jax.block_until_ready(res)
+                        jax.block_until_ready(res)
                         if (
                             isinstance(res, tuple)
                             and len(res) == 2
@@ -3562,10 +3578,7 @@ def execute_batch(db, items) -> List:
                 continue
             seen_groups.add(id(d.grp))
             d = d.grp.dev
-        try:
-            d.copy_to_host_async()
-        except Exception:
-            pass
+        _copy_to_host_async(d)
     t0 = _time.perf_counter()
     metas: List = []
     for k, (_i, _v, plan, _dev) in enumerate(pending):
@@ -3578,10 +3591,7 @@ def execute_batch(db, items) -> List:
         pages = pair[1] if int(meta[2]) else pair[0]
         need = plan.fetch_rows_needed(int(meta[0]))
         d = next(p for p in pages if int(p.shape[1]) >= need)
-        try:
-            d.copy_to_host_async()
-        except Exception:
-            pass
+        _copy_to_host_async(d)
         pages_sel[k] = d
     # rows groups: elect ONE compact page for each group's whole lane
     # stack — a single slice(+int16 cast) Execute and a single host
@@ -3616,10 +3626,7 @@ def execute_batch(db, items) -> List:
             d = plan.group_page(
                 grp.data_dev, len(lane_metas), need, fits16
             )
-        try:
-            d.copy_to_host_async()
-        except Exception:
-            pass
+        _copy_to_host_async(d)
         grp_fetch.append((grp, d))
     t1 = _time.perf_counter()
     datas: List = [None] * len(pending)

@@ -20,6 +20,11 @@ text-format 0.0.4 grammar so a tier-1 test can fail the build instead
 - the document ends with a newline.
 
 Returns problems as strings; an empty list means the document is clean.
+
+This is the RUNTIME half; the static half — literal metric names at
+registration call sites must match the internal dotted grammar so
+``_prom_name`` sanitizes them collision-free — runs as the
+``promlint`` pass of ``orientdb_tpu/analysis`` on every tier-1 build.
 """
 
 from __future__ import annotations

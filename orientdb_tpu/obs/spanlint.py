@@ -4,8 +4,9 @@ The profile aggregator (``obs/profile.py``) groups stages by span NAME
 and cross-node traces join on the names both sides emit — a typo'd
 name in a new ``span("replication.aply")`` would silently split a
 stage out of every profile and break trace joins, with no test to
-notice. This lint (pattern: ``chaos/iolint.py``, enforced tier-1 by
-``tests/test_query_stats.py``) makes that a build failure:
+notice. This lint (now the ``spanlint`` pass of ``orientdb_tpu/analysis``,
+enforced tier-1 by ``tests/test_analysis.py``; ``lint_spans`` below
+stays as a back-compat shim) makes that a build failure:
 
 - every **string-literal** first argument of a ``span(...)`` /
   ``_span(...)`` / ``continue_trace(...)`` / ``_bench_span(...)``
@@ -25,7 +26,6 @@ The catalog doubles as the span-name reference the README links.
 from __future__ import annotations
 
 import ast
-import os
 from typing import Dict, List, Tuple
 
 #: span name → what the stage covers. The profile aggregator's stage
@@ -86,54 +86,18 @@ def _literal_span_names(tree: ast.Module) -> List[Tuple[int, str]]:
     return out
 
 
-def _iter_sources(root: str) -> List[Tuple[str, str]]:
-    """(relative path, source) for every linted module: the package
-    tree plus bench.py; tests excluded (ad-hoc fixture spans)."""
-    out: List[Tuple[str, str]] = []
-    pkg = os.path.join(root, "orientdb_tpu")
-    files: List[str] = []
-    for dirpath, _dirs, names in os.walk(pkg):
-        for f in sorted(names):
-            if f.endswith(".py"):
-                files.append(os.path.join(dirpath, f))
-    bench = os.path.join(root, "bench.py")
-    if os.path.exists(bench):
-        files.append(bench)
-    for path in files:
-        rel = os.path.relpath(path, root).replace(os.sep, "/")
-        with open(path, "r", encoding="utf-8") as fh:
-            out.append((rel, fh.read()))
-    return out
-
-
 def lint_spans(root: str = None) -> List[str]:
-    """Lint the tree; returns problems (empty = every literal span name
-    is cataloged and every catalog entry is live)."""
-    if root is None:
-        root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        )
-    problems: List[str] = []
-    used: set = set()
-    for rel, src in _iter_sources(root):
-        try:
-            tree = ast.parse(src, filename=rel)
-        except SyntaxError as e:  # pragma: no cover
-            problems.append(f"{rel}: unparsable: {e}")
-            continue
-        for lineno, name in _literal_span_names(tree):
-            used.add(name)
-            if name not in SPAN_CATALOG:
-                problems.append(
-                    f"{rel}:{lineno}: span name {name!r} is not in "
-                    "SPAN_CATALOG (obs/spanlint.py) — a typo here would "
-                    "silently split profiles and break trace joins; add "
-                    "the name with a description or fix the call site"
-                )
-    for name in sorted(SPAN_CATALOG):
-        if name not in used:
-            problems.append(
-                f"SPAN_CATALOG entry {name!r} is used by no call site — "
-                "remove it or fix the spelling at the call site"
-            )
-    return problems
+    """Legacy entry point — now a thin shim over the framework pass
+    (``orientdb_tpu.analysis``, pass ``spanlint``): shared discovery,
+    per-line suppressions, and reporting. Returns problems (empty =
+    every literal span name is cataloged and every catalog entry is
+    live)."""
+    from orientdb_tpu.analysis import core
+
+    rep = core.run(passes=["spanlint"], root=root)
+    # the old contract also reported unparsable modules
+    return [
+        str(f)
+        for f in rep.findings
+        if f.pass_name in ("spanlint", "parse")
+    ]

@@ -444,7 +444,13 @@ class Cluster:
                 m.db._repl_term = self.failovers + 1
             self._start_puller(m, applied_lsn=0)
         except Exception:
-            pass  # transient; the puller thread keeps retrying
+            # transient; the puller thread keeps retrying — but the
+            # probe failure itself must leave a trail
+            metrics.incr("cluster.probe_pull_error")
+            log.warning(
+                "synchronous pull probe for %s failed", m.name,
+                exc_info=True,
+            )
 
     # -- per-class owner streams (multi-owner writes) -----------------------
 

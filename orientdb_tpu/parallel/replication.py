@@ -353,7 +353,9 @@ class QuorumPusher:
                     if f.result():
                         acks += 1
                 except Exception:
-                    pass  # dead/slow replica: no ack, never a blocker
+                    # dead/slow replica: no ack, never a blocker —
+                    # but the dropped ack must show up in a signal
+                    metrics.incr("replication.ack_error")
         if acks < need:
             metrics.incr("replication.quorum_failed")
             self.quorum_lost = True

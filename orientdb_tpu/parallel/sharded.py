@@ -35,6 +35,9 @@ from orientdb_tpu.parallel.shard_compat import shard_map
 
 from orientdb_tpu.storage.snapshot import GraphSnapshot
 from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+
+log = get_logger("sharded")
 
 
 
@@ -54,7 +57,9 @@ def provision_devices(n_devices: int) -> list:
     try:
         jax.config.update("jax_num_cpu_devices", n_devices)
     except Exception:
-        pass
+        # backends already initialized: the update is rejected and we
+        # fall through to whatever device count is live
+        log.debug("jax_num_cpu_devices update rejected", exc_info=True)
     devs = jax.devices()
     if len(devs) >= n_devices:
         return devs

@@ -240,7 +240,11 @@ def execute_tx_ops(
             if db.tx is t:
                 t.rollback()
         except Exception:
-            pass
+            # the original failure is what propagates; a rollback
+            # that ALSO failed must still be visible to operators
+            metrics.incr("tx.rollback_error")
+            log.warning("tx rollback failed during unwind",
+                        exc_info=True)
         raise
     return (
         [
@@ -912,6 +916,19 @@ class LocalRegistryParticipant(Participant):
         get_registry(self.db).abort(txid)
 
 
+def _abort_best_effort(p: Participant, txid: str) -> None:
+    """Best-effort phase-2/unwind abort: the coordinator's own outcome
+    never depends on it, but a failed abort leaves the participant
+    staged (locks held) until TTL expiry — count and log it so piled-up
+    stages have a trail instead of a silent ``pass``."""
+    try:
+        p.abort(txid)
+    except Exception:
+        metrics.incr("tx2pc.abort_error")
+        log.warning("best-effort abort of %s failed", txid,
+                    exc_info=True)
+
+
 def run_coordinator(
     txid: str,
     parts: Dict[object, Participant],
@@ -962,10 +979,7 @@ def run_coordinator(
                 prepared.append(p)
         except Exception:
             for p in prepared:
-                try:
-                    p.abort(txid)
-                except Exception:  # pragma: no cover - best effort
-                    pass
+                _abort_best_effort(p, txid)
             raise
         # the decision point: every participant is prepared — a crash
         # here (fault "tx2pc.decide") is the canonical coordinator death
@@ -988,10 +1002,7 @@ def run_coordinator(
                 # release its staged locks immediately
                 unresolved |= creates_of.get(key, set())
                 skipped.append(key)
-                try:
-                    parts[key].abort(txid)
-                except Exception:  # pragma: no cover - best effort
-                    pass
+                _abort_best_effort(parts[key], txid)
                 continue
             try:
                 parts[key].commit(txid, rid_map)
@@ -1004,10 +1015,7 @@ def run_coordinator(
                     # leaving it staged would hold its locks until TTL
                     # expiry)
                     for k2 in [key] + pending:
-                        try:
-                            parts[k2].abort(txid)
-                        except Exception:  # pragma: no cover
-                            pass
+                        _abort_best_effort(parts[k2], txid)
                     raise
                 failures.append(f"{key}: {type(e).__name__}: {e}")
                 failed_keys.append(key)

@@ -102,7 +102,9 @@ def _req_is_read(req: dict) -> bool:
                 )
             )
     except Exception:
-        pass
+        # unclassifiable (parse error): treat as a write — the error
+        # itself surfaces on the direct execution path
+        return False
     return False
 
 
@@ -187,7 +189,10 @@ class _Session:
                 try:
                     m.unsubscribe()
                 except Exception:
-                    pass
+                    log.warning(
+                        "live-query unsubscribe failed during "
+                        "session teardown", exc_info=True,
+                    )
             self._live.clear()
             try:
                 self.sock.close()

@@ -116,16 +116,10 @@ class GlobalConfiguration:
     # Parsed-statement cache entries (analog of OStatementCache [E]).
     statement_cache_size: int = 1024
 
-    # Snapshot build options.
-    string_dictionary_max: int = 1 << 24  # max distinct strings per column
-
     # Sharding: device-mesh axis names (parallel/mesh_graph.py shards the
     # CSR over the shard axis; replicas carry independent query streams).
     mesh_shard_axis: str = "shards"
     mesh_replica_axis: str = "replicas"
-
-    # Logging level for get_logger default.
-    log_level: str = "WARNING"
 
     # Observability (orientdb_tpu/obs): queries slower than this many
     # milliseconds enter the slow-query log (0 disables); the ring keeps
