@@ -34,7 +34,7 @@ from orientdb_tpu.analysis.core import Finding, SourceTree, register
 from orientdb_tpu.chaos.iolint import IO_ATTRS, IO_NAMES
 
 #: package dirs whose locks participate (the concurrent subsystems)
-SCAN_DIRS = ("exec", "parallel", "server", "storage", "obs")
+SCAN_DIRS = ("exec", "parallel", "server", "storage", "obs", "cdc")
 
 _LOCKY = re.compile(r"lock", re.IGNORECASE)
 _MUTEX_NAMES = frozenset({"_mu", "mu"})

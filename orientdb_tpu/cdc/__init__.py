@@ -1,0 +1,43 @@
+"""Change-data-capture plane: WAL-derived, resumable changefeeds.
+
+The reference's live-query hooks ([E] ``OLiveQueryHookV2`` /
+``OLiveQueryMonitor``, SURVEY.md §2 "Live queries / hooks") fire on the
+LOCAL write path only and deliver best-effort — a dropped session loses
+events forever, and a replica applying the primary's WAL stream never
+fires them at all. This package derives an ordered, RESUMABLE stream of
+committed record changes from the WAL instead:
+
+- ``cdc/decode.py`` — WAL entries (single ops and atomic ``tx``/``bulk``
+  entries alike) → normalized change events
+  ``{lsn, seq, op, class, rid, record, txid?}``;
+- ``cdc/feed.py`` — per-database :class:`ChangeFeed` with durable named
+  cursors (a cursor is just an LSN; catch-up reads ride
+  ``storage.durability.wal_entries_above`` and skip covered archives),
+  per-class/WHERE filtering via the predicate evaluator, and bounded
+  per-consumer queues with shed-vs-block backpressure.
+
+Transports live with their protocols: ``GET /changes/<db>`` long-poll in
+``server/http_server.py``, ``cdc_subscribe``/``cdc_ack``/
+``cdc_unsubscribe`` push in ``server/binary_server.py``, client resume
+in ``client/remote.py``. ``LIVE SELECT`` (``exec/live.py``) is rebased
+onto the feed, so live queries see replication-applied writes too.
+"""
+
+from orientdb_tpu.cdc.decode import EntryDecoder, decode_entry
+from orientdb_tpu.cdc.feed import (
+    CdcGapError,
+    ChangeFeed,
+    Consumer,
+    feed_of,
+    live_feed,
+)
+
+__all__ = [
+    "CdcGapError",
+    "ChangeFeed",
+    "Consumer",
+    "EntryDecoder",
+    "decode_entry",
+    "feed_of",
+    "live_feed",
+]

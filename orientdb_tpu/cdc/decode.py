@@ -1,0 +1,149 @@
+"""WAL entry → normalized change events.
+
+The WAL (``storage/durability.py``) logs *logical* operations: single
+``create``/``update``/``delete`` records, atomic ``tx``/``bulk``
+containers, DDL, and 2PC protocol records. CDC consumers want a uniform
+record-change vocabulary, so this module flattens each entry into zero
+or more events::
+
+    {"lsn": 7, "seq": 0, "op": "create", "class": "Person",
+     "rid": "#9:0", "record": {...fields, "@rid", "@class", "@version"},
+     "txid": "..."}        # only for 2PC-stamped tx entries
+    {"lsn": 8, "seq": 1, "op": "delete", "class": "Person",
+     "rid": "#9:0", "record": None, "tx": True}
+
+- ``lsn`` is the WAL entry's LSN — the cursor unit. Ops inside one
+  atomic ``tx``/``bulk`` entry share its LSN and are ordered by ``seq``
+  (acking an LSN acknowledges the WHOLE entry; resume redelivers whole
+  entries, which is the at-least-once contract).
+- ``record`` values stay in the WAL's wire encoding (``{"@link": ...}``
+  / ``{"@bytes": ...}``) so events ship over HTTP/binary unchanged;
+  Python consumers decode with ``storage.durability._dec``.
+- DDL and 2PC protocol entries decode to NO events — they still consume
+  LSNs, so catch-up contiguity checks run on raw entries, not events.
+
+Class attribution: ``create`` entries always carry their class; newer
+``update``/``delete`` entries do too (stamped since this module exists).
+For older entries the decoder falls back to classes learned from creates
+earlier in the same stream, then to the live record.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+#: ops that are record changes (everything else is schema/protocol)
+CHANGE_OPS = frozenset({"create", "update", "delete"})
+
+#: rid→class memory kept per decoder (bounded LRU; catch-up from LSN 0
+#: learns every class from the creates it replays)
+_CLASS_CACHE_MAX = 65536
+
+
+def _record_payload(e: Dict) -> Dict:
+    """Event ``record`` from a create/update entry: the WAL's
+    wire-encoded fields plus the @-meta keys ``to_dict`` would carry."""
+    rec = dict(e.get("fields") or {})
+    rec["@rid"] = e["rid"]
+    if e.get("class") is not None:
+        rec["@class"] = e["class"]
+    if e.get("version") is not None:
+        rec["@version"] = e["version"]
+    return rec
+
+
+class EntryDecoder:
+    """Stateful decoder: one per feed (and one per catch-up scan), so
+    class attribution survives across the entries it has seen."""
+
+    def __init__(self, db=None) -> None:
+        self.db = db
+        self._classes: "OrderedDict[str, str]" = OrderedDict()
+
+    def _learn(self, rid: str, class_name: Optional[str]) -> None:
+        if class_name is None:
+            return
+        self._classes[rid] = class_name
+        self._classes.move_to_end(rid)
+        while len(self._classes) > _CLASS_CACHE_MAX:
+            self._classes.popitem(last=False)
+
+    def _class_of(self, e: Dict) -> Optional[str]:
+        cname = e.get("class")
+        if cname is not None:
+            return cname
+        cname = self._classes.get(e["rid"])
+        if cname is not None:
+            return cname
+        if self.db is not None:
+            from orientdb_tpu.models.rid import RID
+
+            try:
+                doc = self.db._load_raw(RID.parse(e["rid"]))
+            except (ValueError, KeyError):
+                doc = None
+            if doc is not None:
+                return doc.class_name
+        return None
+
+    def _one(
+        self, e: Dict, lsn: int, seq: int, txid: Optional[str], in_tx: bool
+    ) -> Optional[Dict]:
+        op = e.get("op")
+        if op not in CHANGE_OPS:
+            return None
+        rid = e.get("rid")
+        if rid is None:
+            return None
+        if op == "create":
+            self._learn(rid, e.get("class"))
+        cname = self._class_of(e)
+        if op == "delete":
+            # newer delete entries carry the preimage (what consumers
+            # invalidate on); pre-CDC logs yield None
+            pre = e.get("preimage")
+            record = None
+            if pre is not None:
+                record = dict(pre)
+                record["@rid"] = rid
+                if cname is not None:
+                    record["@class"] = cname
+        else:
+            record = _record_payload(e)
+        ev: Dict = {
+            "lsn": lsn,
+            "seq": seq,
+            "op": op,
+            "class": cname,
+            "rid": rid,
+            "record": record,
+        }
+        if txid:
+            ev["txid"] = txid
+        if in_tx:
+            ev["tx"] = True
+        if op == "delete":
+            # the record is gone; forget its class AFTER attributing it
+            self._classes.pop(rid, None)
+        return ev
+
+    def decode(self, entry: Dict) -> List[Dict]:
+        """All change events of one WAL entry, in apply order."""
+        lsn = entry.get("lsn", 0)
+        op = entry.get("op")
+        if op in ("tx", "bulk"):
+            txid = entry.get("txid2pc")
+            out: List[Dict] = []
+            for i, sub in enumerate(entry.get("ops") or ()):
+                ev = self._one(sub, lsn, i, txid, True)
+                if ev is not None:
+                    out.append(ev)
+            return out
+        ev = self._one(entry, lsn, 0, None, False)
+        return [] if ev is None else [ev]
+
+
+def decode_entry(entry: Dict, db=None) -> List[Dict]:
+    """One-shot decode (unit-test / scripting convenience)."""
+    return EntryDecoder(db).decode(entry)

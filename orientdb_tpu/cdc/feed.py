@@ -1,0 +1,841 @@
+"""Per-database ChangeFeed: resumable, filtered, backpressured delivery.
+
+The feed is the durable-changefeed analog of the reference's
+``OLiveQueryMonitor`` registry, rebuilt on the WAL (see the package
+docstring). Key properties:
+
+- **a cursor is just an LSN.** Consumers ack the LSN they have durably
+  processed; restart resumes from the acked cursor with at-least-once
+  delivery in LSN order. Named cursors persist in
+  ``<durability_dir>/cdc-cursors.json`` (``atomic_write``), so they
+  survive process restarts with the database.
+- **the WAL is the source of truth, the queue an optimization.** Live
+  events arrive via taps on every WAL-append site (local writes, tx
+  commits, bulk flushes) and on the replication apply paths (a replica's
+  feed sees the primary's entries with their SOURCE LSNs). Catch-up
+  reads ``storage.durability.wal_entries_above`` — archives whose
+  name-encoded max LSN is covered are skipped unread — overlaid with a
+  bounded in-memory ring for entries the local WAL never logged
+  (replication applies on a WAL-less or suppressed replica).
+- **backpressure is explicit.** Per-consumer queues are bounded at
+  ``config.cdc_queue_max``; a slow consumer either BLOCKS the producer
+  (bounded by ``cdc_poll_timeout_s``, then sheds anyway) or is SHED:
+  its queue drops and the next poll transparently catches up from the
+  log — nothing is lost, only re-read. Shed counts and lag ride
+  ``/metrics`` and ``/cluster/health``.
+- **gaps are loud.** A cursor below the oldest retained LSN (checkpoint
+  retired the covering archives, or a non-durable feed's ring rolled
+  over) raises :class:`CdcGapError` — consumers must resync, never
+  silently skip.
+
+``LIVE SELECT`` monitors are callback-mode consumers of the same feed;
+databases with no WAL get a hook-tap fallback (synthetic LSNs, not
+resumable) so the embedded live-query surface keeps working unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+from orientdb_tpu.cdc.decode import EntryDecoder
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("cdc")
+
+CURSOR_FILE = "cdc-cursors.json"
+
+_HOOK_OPS = {
+    "after_create": "create",
+    "after_update": "update",
+    "after_delete": "delete",
+}
+
+#: live feeds, for the process-wide cdc gauges (weak: a dropped database
+#: must not be pinned by its feed's metrics)
+_FEEDS: "weakref.WeakSet[ChangeFeed]" = weakref.WeakSet()
+
+
+class CdcGapError(Exception):
+    """The requested LSN range is no longer retained (archives retired
+    by a checkpoint, or a non-durable ring rolled over): the consumer
+    must resync from current state instead of silently skipping."""
+
+
+#: gauge refresh throttle: the walk takes every consumer's lock, so the
+#: write path must not pay it per commit (registration changes force it)
+_PUB_INTERVAL_S = 0.5
+_next_pub = 0.0
+
+
+def _publish_gauges(force: bool = False) -> None:
+    global _next_pub
+    now = time.monotonic()
+    if not force and now < _next_pub:
+        return
+    _next_pub = now + _PUB_INTERVAL_S
+    consumers = 0
+    depth = 0
+    lag = 0
+    for f in list(_FEEDS):
+        s = f.quick_stats()
+        consumers += s["consumers"]
+        depth += s["queue_depth"]
+        lag = max(lag, s["max_lag"])
+    metrics.gauge("cdc.consumers", consumers)
+    metrics.gauge("cdc.queue_depth", depth)
+    metrics.gauge("cdc.lag_entries", lag)
+
+
+# ---------------------------------------------------------------------------
+# durable named cursors
+# ---------------------------------------------------------------------------
+
+
+class CursorStore:
+    """Named consumer cursors. Durable (atomic_write to the database's
+    durability directory) when the database is durable; in-memory
+    otherwise. Acks only advance — a replayed stale ack cannot move a
+    cursor backwards. Cursors idle past ``cdc_cursor_retention_s``
+    EXPIRE at the next ack: they keep a tombstone, and a consumer
+    reconnecting on one gets a loud :class:`CdcGapError` (resync or
+    re-ack explicitly) — never a silent restart at head."""
+
+    def __init__(self, db) -> None:
+        self._db = db
+        self._lock = threading.Lock()
+        self._mem: Dict[str, Dict] = {}
+        self._loaded = False
+
+    def _path(self) -> Optional[str]:
+        d = getattr(self._db, "_durability_dir", None)
+        return os.path.join(d, CURSOR_FILE) if d else None
+
+    def _load_locked(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        p = self._path()
+        if p and os.path.exists(p):
+            try:
+                with open(p, "rb") as f:
+                    self._mem = json.loads(f.read())
+            except Exception:
+                log.warning("cdc cursor file %s unreadable; starting "
+                            "empty", p, exc_info=True)
+                self._mem = {}
+
+    def get(self, name: str) -> Optional[int]:
+        """The stored LSN, None for an unknown name — or a LOUD
+        :class:`CdcGapError` for an expired one (the offline window may
+        be gone; restarting at head silently would hide that)."""
+        with self._lock:
+            self._load_locked()
+            cur = self._mem.get(name)
+            if cur is None:
+                return None
+            if cur.get("expired"):
+                raise CdcGapError(
+                    f"cursor {name!r} expired after "
+                    f"{config.cdc_cursor_retention_s:g}s idle at lsn "
+                    f"{cur['lsn']}; resync (or re-ack a position "
+                    "explicitly) to revive it"
+                )
+            return int(cur["lsn"])
+
+    def ack(self, name: str, lsn: int) -> int:
+        """Advance (never regress) a named cursor; returns the stored
+        LSN. Persists durably when the database is durable. Acking an
+        expired cursor revives it (an explicit new position)."""
+        with self._lock:
+            self._load_locked()
+            prev = int(self._mem.get(name, {}).get("lsn", 0))
+            now = time.time()
+            self._mem[name] = {"lsn": max(prev, int(lsn)), "ts": now}
+            retention = config.cdc_cursor_retention_s
+            if retention > 0:
+                for stale, cur in self._mem.items():
+                    if (
+                        stale != name
+                        and not cur.get("expired")
+                        and now - cur.get("ts", now) > retention
+                    ):
+                        cur["expired"] = True
+            data = json.dumps(self._mem, separators=(",", ":")).encode()
+            path = self._path()
+            stored = int(self._mem[name]["lsn"])
+            if path is not None:
+                # persist INSIDE the lock: two concurrent acks racing
+                # their atomic_writes outside it could land the staler
+                # snapshot last and durably regress the other cursor
+                from orientdb_tpu.storage.durability import atomic_write
+
+                atomic_write(path, data)
+        return stored
+
+    def all(self) -> Dict[str, Dict]:
+        with self._lock:
+            self._load_locked()
+            return {k: dict(v) for k, v in self._mem.items()}
+
+
+# ---------------------------------------------------------------------------
+# filtering (shared by consumers and the stateless HTTP transport)
+# ---------------------------------------------------------------------------
+
+
+def parse_where(where_sql: str, class_name: Optional[str] = None):
+    """A WHERE snippet → predicate AST (evaluated by exec/eval like any
+    LIVE SELECT filter)."""
+    from orientdb_tpu.exec.engine import parse_cached
+
+    stmt = parse_cached(
+        f"SELECT FROM {class_name or 'V'} WHERE {where_sql}"
+    )
+    return stmt.where
+
+
+def event_matches(db, ev: Dict, classes=None, where=None, doc=None) -> bool:
+    """Per-class (subclass-aware) + WHERE filtering. Delete events skip
+    the WHERE (the stored record no longer matches anything — same
+    contract as LIVE SELECT); a WHERE that errors filters the event out
+    rather than failing the feed."""
+    if classes:
+        cname = ev.get("class")
+        if cname is None:
+            return False
+        cls = db.schema.get_class(cname) if db is not None else None
+        if cls is None:
+            if not any(cname.lower() == c.lower() for c in classes):
+                return False
+        elif not any(cls.is_subclass_of(c) for c in classes):
+            return False
+    if where is not None and ev.get("op") != "delete":
+        from orientdb_tpu.exec.eval import EvalContext, evaluate, truthy
+
+        if doc is None and db is not None:
+            # prefer the LIVE record: synchronous tap deliveries run
+            # before any later write, so it matches the event state and
+            # supports @rid/@version/graph predicates exactly like the
+            # old hook path did (catch-up reads may see newer state —
+            # the documented predicate approximation)
+            from orientdb_tpu.models.rid import RID
+
+            try:
+                doc = db._load_raw(RID.parse(ev["rid"]))
+            except (ValueError, KeyError):
+                doc = None
+        if doc is None:
+            from orientdb_tpu.models.record import Document
+            from orientdb_tpu.models.rid import RID
+            from orientdb_tpu.storage.durability import _dec
+
+            rec = ev.get("record") or {}
+            fields = {
+                k: _dec(v) for k, v in rec.items() if not k.startswith("@")
+            }
+            doc = Document(ev.get("class") or "O", fields)
+            doc._db = db
+            try:
+                doc.rid = RID.parse(ev["rid"])
+            except (ValueError, KeyError):
+                pass
+            if rec.get("@version") is not None:
+                doc.version = rec["@version"]
+        try:
+            if not truthy(evaluate(EvalContext(db, current=doc), where)):
+                return False
+        except Exception:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# consumers
+# ---------------------------------------------------------------------------
+
+
+class Consumer:
+    """One subscription. Two delivery modes:
+
+    - **queue mode** (default): events buffer in a bounded deque;
+      ``poll(max_events, timeout)`` drains in LSN order, transparently
+      switching to WAL catch-up after a resume or a shed;
+    - **callback mode** (``callback=...``): events deliver inline from
+      the write path — LIVE SELECT semantics (post-commit, in-process,
+      not resumable)."""
+
+    def __init__(
+        self,
+        feed: "ChangeFeed",
+        token: int,
+        name: Optional[str] = None,
+        classes=None,
+        where=None,
+        callback: Optional[Callable] = None,
+        policy: str = "shed",
+        queue_max: Optional[int] = None,
+        resume_lsn: int = 0,
+        catchup: bool = False,
+    ) -> None:
+        if policy not in ("shed", "block"):
+            raise ValueError(f"unknown backpressure policy {policy!r}")
+        self.feed = feed
+        self.token = token
+        self.name = name
+        self.classes = list(classes) if classes else None
+        self.where = where
+        self.callback = callback
+        self.policy = policy
+        self.queue_max = queue_max or config.cdc_queue_max
+        #: where delivery resumes from (the registration-time cursor)
+        self.resume_lsn = resume_lsn
+        self.acked_lsn = resume_lsn
+        self.delivered = 0
+        self.shed_events = 0
+        self.closed = False
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        #: events at/below the floor were already handed to the consumer
+        self._floor = resume_lsn
+        #: serve the next poll from the log instead of the queue
+        self._catchup = catchup
+
+    # -- producer side ------------------------------------------------------
+
+    def _passes(self, ev: Dict, doc=None) -> bool:
+        return event_matches(
+            self.feed.db, ev, classes=self.classes, where=self.where,
+            doc=doc,
+        )
+
+    def _offer(self, events: List[Dict], doc=None) -> None:
+        if self.callback is not None:
+            for ev in events:
+                if not self._passes(ev, doc=doc):
+                    continue
+                self.delivered += 1
+                try:
+                    self.callback(ev)
+                except Exception:
+                    # a raising subscriber must not break the write path
+                    log.exception("cdc subscriber %s failed", self.token)
+            return
+        with self._cv:
+            if self.closed or self._catchup:
+                # catch-up mode re-reads this range from the log anyway
+                return
+            for ev in events:
+                if ev["lsn"] <= self._floor:
+                    continue
+                if not self._passes(ev, doc=doc):
+                    # the class/WHERE filter applies to LIVE deliveries
+                    # exactly as to catch-up reads — a filtered
+                    # subscription must not behave differently depending
+                    # on whether it is caught up
+                    continue
+                if len(self._q) >= self.queue_max and self.policy == "block":
+                    # bounded producer blocking: the writer waits for the
+                    # consumer to drain, up to the poll timeout, then the
+                    # shed path below takes over (a dead consumer must
+                    # never wedge the write path forever)
+                    deadline = time.monotonic() + config.cdc_poll_timeout_s
+                    while (
+                        len(self._q) >= self.queue_max
+                        and not self.closed
+                        and time.monotonic() < deadline
+                    ):
+                        self._cv.wait(deadline - time.monotonic())
+                if len(self._q) >= self.queue_max:
+                    # shed: drop the buffered window and fall back to the
+                    # log — redeliverable from the cursor, so nothing is
+                    # lost, only re-read (at-least-once)
+                    self.shed_events += len(self._q) + 1
+                    self._q.clear()
+                    self._catchup = True
+                    metrics.incr("cdc.shed")
+                    self._cv.notify_all()
+                    return
+                self._q.append(ev)
+            self._cv.notify_all()
+
+    # -- consumer side ------------------------------------------------------
+
+    def poll(
+        self, max_events: int = 512, timeout: float = 0.0
+    ) -> List[Dict]:
+        """Next batch of events in LSN order (possibly empty after
+        ``timeout``). Raises :class:`CdcGapError` when the resume point
+        is no longer retained."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            with self._cv:
+                if self.closed:
+                    return []
+                catchup = self._catchup
+                if not catchup:
+                    out: List[Dict] = []
+                    while self._q:
+                        if (
+                            len(out) >= max_events
+                            and self._q[0]["lsn"] != out[-1]["lsn"]
+                        ):
+                            break
+                        # never split an atomic entry at the batch
+                        # boundary: the floor advances per LSN, so a
+                        # tx's tail events left behind would be dropped
+                        # by the floor check on the next poll (the
+                        # batch may overshoot max_events instead)
+                        ev = self._q.popleft()
+                        if ev["lsn"] <= self._floor:
+                            continue  # already served by a catch-up read
+                        out.append(ev)
+                    if out:
+                        self._floor = out[-1]["lsn"]
+                        self.delivered += len(out)
+                        self._cv.notify_all()  # wake a blocked producer
+                        return out
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return []
+                    self._cv.wait(left)
+                    continue
+                floor = self._floor
+            # catch-up OUTSIDE the condition: the log read must not block
+            # producers offering to other consumers
+            events, covered, head = self.feed.events_since(
+                floor, limit=max_events
+            )
+            matched = [ev for ev in events if self._passes(ev)]
+            with self._cv:
+                if covered > self._floor:
+                    self._floor = covered
+                while self._q and self._q[0]["lsn"] <= self._floor:
+                    self._q.popleft()
+                # compare against the feed's CURRENT head, not the
+                # scan-time one: a write committed after the scan was
+                # dropped by _offer (catch-up mode) and must be picked
+                # up by one more scan — clearing on the stale head
+                # would strand it until the next shed
+                if self._floor >= self.feed.head_lsn:
+                    self._catchup = False
+                self._cv.notify_all()
+            if matched:
+                self.delivered += len(matched)
+                return matched
+            if covered <= floor and time.monotonic() >= deadline:
+                return []
+
+    def ack(self, lsn: int) -> int:
+        """The consumer has durably processed everything at/below
+        ``lsn``; persists the named cursor when one is attached. The
+        ack clamps to the feed head — a typo'd/hostile huge LSN must
+        not pin the cursor past every future commit forever (acks
+        never regress, so there would be no recovery path)."""
+        lsn = min(int(lsn), self.feed.head_lsn)
+        with self._cv:
+            self.acked_lsn = max(self.acked_lsn, lsn)
+            acked = self.acked_lsn
+        if self.name:
+            acked = self.feed.cursors.ack(self.name, acked)
+        return acked
+
+    def lag(self) -> Dict:
+        with self._cv:
+            depth = len(self._q)
+            floor = self._floor
+            acked = self.acked_lsn
+        head = self.feed.head_lsn
+        return {
+            "token": self.token,
+            "name": self.name,
+            "classes": self.classes,
+            "queue_depth": depth,
+            "delivered_lsn": floor,
+            "acked_lsn": acked,
+            "lag_entries": max(0, head - floor),
+            "unacked_entries": max(0, floor - acked),
+            "shed_events": self.shed_events,
+            "delivered": self.delivered,
+            "policy": self.policy,
+            "mode": "callback" if self.callback is not None else "queue",
+        }
+
+    def close(self) -> None:
+        with self._cv:
+            self.closed = True
+            self._q.clear()
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# the feed
+# ---------------------------------------------------------------------------
+
+
+class ChangeFeed:
+    """One database's change plane. Create via :func:`feed_of` — the
+    taps in the write/replication paths find the feed through the
+    database, so construction order matters only for the no-WAL hook
+    fallback (arm durability BEFORE the first subscription to get real,
+    resumable LSNs)."""
+
+    def __init__(self, db) -> None:
+        self.db = db
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._decoder = EntryDecoder(db)
+        self._consumers: Dict[int, Consumer] = {}
+        self._next_token = 1
+        self.cursors = CursorStore(db)
+        wal = getattr(db, "_wal", None)
+        #: newest LSN this feed knows about (tap or WAL tail; a WAL-less
+        #: replica starts at its applied floor so a cursor below what
+        #: this feed can serve raises a GAP instead of silence)
+        self.head_lsn = (
+            (wal.next_lsn - 1)
+            if wal is not None
+            else getattr(db, "_repl_applied_lsn", 0)
+        )
+        #: recent (lsn, events) pairs — INCLUDING empty event lists, so
+        #: catch-up contiguity checks see protocol-only entries. Serves
+        #: replica applies the local WAL never logged.
+        self._ring: deque = deque(
+            maxlen=max(4096, 4 * config.cdc_queue_max)
+        )
+        self._tl = threading.local()
+        self._hook_token = None
+        if wal is None:
+            # no WAL to derive from: fall back to the hook tap with
+            # synthetic LSNs (LIVE SELECT on a plain in-memory database).
+            # Not resumable across restarts — durability brings that.
+            self._hook_token = db.hooks.register(self._on_hook)
+        _FEEDS.add(self)
+
+    # -- taps ---------------------------------------------------------------
+
+    @contextmanager
+    def applying(self):
+        """Mark this thread as applying a REPLICATION entry: local taps
+        (WAL re-log of applied deletes, after-delete hooks fired by the
+        apply's cascade) stay quiet — the apply tap delivers the entry
+        once, with its SOURCE LSN."""
+        self._tl.in_apply = True
+        try:
+            yield
+        finally:
+            self._tl.in_apply = False
+
+    def on_entry(self, entry: Dict, source: str = "local") -> None:
+        """The tap: one committed WAL entry (local append or replication
+        apply). Decodes once, fans out to every consumer."""
+        if source == "local" and getattr(self._tl, "in_apply", False):
+            return
+        events = self._decoder.decode(entry)
+        lsn = entry.get("lsn", 0)
+        with self._lock:
+            self.head_lsn = max(self.head_lsn, lsn)
+            self._ring.append((lsn, events))
+            consumers = list(self._consumers.values())
+            self._cv.notify_all()
+        if events:
+            metrics.incr("cdc.events", len(events))
+        for c in consumers:
+            c._offer(events)
+        _publish_gauges()
+
+    def _on_hook(self, event: str, doc) -> None:
+        """Hook-tap fallback for WAL-less databases (synthetic LSNs)."""
+        op = _HOOK_OPS.get(event)
+        if op is None or getattr(self._tl, "in_apply", False):
+            return
+        with self._lock:
+            lsn = self.head_lsn + 1
+            self.head_lsn = lsn
+            # deletes carry the PREIMAGE here — the hook tap still holds
+            # the live document, unlike WAL decode where it is gone
+            ev = {
+                "lsn": lsn,
+                "seq": 0,
+                "op": op,
+                "class": doc.class_name,
+                "rid": str(doc.rid),
+                "record": doc.to_dict(),
+                "durable": False,
+            }
+            self._ring.append((lsn, [ev]))
+            consumers = list(self._consumers.values())
+            self._cv.notify_all()
+        metrics.incr("cdc.events")
+        for c in consumers:
+            c._offer([ev], doc=doc)
+        _publish_gauges()
+
+    # -- catch-up -----------------------------------------------------------
+
+    def _wal_entries_above(self, lsn: int, limit: int) -> List[Dict]:
+        """Like ``storage.durability.wal_entries_above`` but with an
+        early stop: segments are LSN-ordered, so once ``limit`` entries
+        past the cursor are collected, later segments need not be read
+        or parsed — a consumer paging through a deep backlog pays
+        O(segments-touched) per poll, not O(backlog)."""
+        directory = getattr(self.db, "_durability_dir", None)
+        if directory and os.path.isdir(directory):
+            from orientdb_tpu.storage.durability import (
+                WriteAheadLog,
+                _wal_segments,
+            )
+
+            out: List[Dict] = []
+            for seg in _wal_segments(directory):
+                base = os.path.basename(seg)
+                if base.startswith("wal-") and base.endswith(".log"):
+                    try:
+                        if int(base[4:-4]) <= lsn:
+                            continue  # fully below the requested range
+                    except ValueError:
+                        pass
+                out.extend(
+                    e
+                    for e in WriteAheadLog(seg).read_entries()
+                    if e["lsn"] > lsn
+                )
+                if len(out) >= limit:
+                    break
+            out.sort(key=lambda e: e["lsn"])
+            return out[:limit]
+        wal = getattr(self.db, "_wal", None)
+        if wal is not None:
+            return [e for e in wal.read_entries() if e["lsn"] > lsn][
+                :limit
+            ]
+        return []
+
+    def events_since(
+        self, lsn: int, limit: int = 1000
+    ) -> Tuple[List[Dict], int, int]:
+        """Decoded events with ``lsn >`` the cursor, LSN-ordered:
+        ``(events, covered_lsn, head_lsn)``. ``covered_lsn`` is the last
+        CONTIGUOUSLY available entry scanned (the caller's next cursor —
+        it advances past protocol/DDL entries that decode to no events).
+        Raises :class:`CdcGapError` when the range below the oldest
+        retained entry was asked for."""
+        from orientdb_tpu.obs.trace import span
+
+        with span("cdc.catchup", lsn=lsn) as sp:
+            entries = self._wal_entries_above(lsn, max(1, limit))
+            dec = EntryDecoder(self.db)
+            events: List[Dict] = []
+            raw: Dict[int, List[Dict]] = {}
+            for e in entries:
+                raw[e["lsn"]] = dec.decode(e)
+            with self._lock:
+                ring = [
+                    (rl, list(es)) for (rl, es) in self._ring if rl > lsn
+                ]
+                head = self.head_lsn
+            for rl, es in ring:
+                if rl not in raw:
+                    raw[rl] = es
+            covered = lsn
+            taken = 0
+            for rl in sorted(raw):
+                if rl > covered + 1:
+                    if covered == lsn:
+                        raise CdcGapError(
+                            f"changes in ({lsn}, {rl}) are no longer "
+                            "retained (archives retired by a checkpoint "
+                            "or ring rolled over); resync from current "
+                            "state"
+                        )
+                    break  # later discontinuity: stop at the prefix
+                if taken >= limit:
+                    break  # the limit bounds ring-served entries too
+                covered = rl
+                events.extend(raw[rl])
+                taken += 1
+            if covered == lsn and not raw and head > lsn:
+                raise CdcGapError(
+                    f"changes above lsn {lsn} are no longer retained; "
+                    "resync from current state"
+                )
+            events.sort(key=lambda ev: (ev["lsn"], ev.get("seq", 0)))
+            sp.set("events", len(events))
+            sp.set("covered", covered)
+            return events, covered, head
+
+    def wait_beyond(self, lsn: int, timeout: float) -> int:
+        """Block until the head moves past ``lsn`` (long-poll); returns
+        the current head."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cv:
+            while self.head_lsn <= lsn:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            return self.head_lsn
+
+    # -- consumer lifecycle -------------------------------------------------
+
+    def register(
+        self,
+        name: Optional[str] = None,
+        classes=None,
+        where=None,
+        callback: Optional[Callable] = None,
+        policy: str = "shed",
+        queue_max: Optional[int] = None,
+        since: Optional[int] = None,
+    ) -> Consumer:
+        """Subscribe. Resume point: explicit ``since`` wins, else the
+        named cursor's stored LSN, else the current head (only new
+        changes). Queue-mode consumers behind the head catch up from the
+        log on their first poll."""
+        resume = since
+        if resume is None and name:
+            resume = self.cursors.get(name)
+        with self._lock:
+            if resume is None:
+                resume = self.head_lsn
+            token = self._next_token
+            self._next_token += 1
+            c = Consumer(
+                self,
+                token,
+                name=name,
+                classes=classes,
+                where=where,
+                callback=callback,
+                policy=policy,
+                queue_max=queue_max,
+                resume_lsn=resume,
+                catchup=callback is None and resume < self.head_lsn,
+            )
+            self._consumers[token] = c
+        _publish_gauges(force=True)
+        return c
+
+    def unregister(self, token: int) -> bool:
+        with self._lock:
+            c = self._consumers.pop(token, None)
+        if c is None:
+            return False
+        c.close()
+        _publish_gauges(force=True)
+        return True
+
+    def get(self, token: int) -> Optional[Consumer]:
+        with self._lock:
+            return self._consumers.get(token)
+
+    def ack_cursor(self, name: str, lsn: int) -> int:
+        """Stateless cursor ack (the HTTP transport's consumers hold no
+        server-side object between polls). Clamped to the head — see
+        :meth:`Consumer.ack`."""
+        return self.cursors.ack(name, min(int(lsn), self.head_lsn))
+
+    # -- observability ------------------------------------------------------
+
+    def quick_stats(self) -> Dict:
+        with self._lock:
+            consumers = list(self._consumers.values())
+            head = self.head_lsn
+        depth = 0
+        max_lag = 0
+        for c in consumers:
+            s = c.lag()
+            depth += s["queue_depth"]
+            max_lag = max(max_lag, s["lag_entries"])
+        return {
+            "consumers": len(consumers),
+            "queue_depth": depth,
+            "max_lag": max_lag,
+            "head_lsn": head,
+        }
+
+    def stats(self) -> Dict:
+        with self._lock:
+            consumers = list(self._consumers.values())
+            head = self.head_lsn
+        return {
+            "head_lsn": head,
+            "consumers": [c.lag() for c in consumers],
+            "cursors": self.cursors.all(),
+        }
+
+    def close(self) -> None:
+        if self._hook_token is not None:
+            self.db.hooks.unregister(self._hook_token)
+            self._hook_token = None
+        with self._lock:
+            consumers = list(self._consumers.values())
+            self._consumers.clear()
+        for c in consumers:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# module helpers (the taps import these lazily)
+# ---------------------------------------------------------------------------
+
+
+def feed_of(db, create: bool = True) -> Optional[ChangeFeed]:
+    """The database's feed, created on first use."""
+    feed = db.__dict__.get("_cdc_feed")
+    if feed is None and create:
+        with db._lock:
+            feed = db.__dict__.get("_cdc_feed")
+            if feed is None:
+                feed = db._cdc_feed = ChangeFeed(db)
+    return feed
+
+
+def live_feed(db) -> ChangeFeed:
+    """Alias of :func:`feed_of` with creation forced (the LIVE SELECT
+    entry point)."""
+    return feed_of(db, create=True)
+
+
+def notify_commit(db, entry: Dict, lsn: int) -> None:
+    """WAL-append tap (database save/delete, tx commit, bulk flush):
+    near-zero cost when no feed exists."""
+    feed = db.__dict__.get("_cdc_feed")
+    if feed is not None:
+        feed.on_entry({**entry, "lsn": lsn}, source="local")
+
+
+def notify_applied(db, entry: Dict) -> None:
+    """Replication-apply tap: the entry carries its SOURCE LSN."""
+    feed = db.__dict__.get("_cdc_feed")
+    if feed is not None:
+        feed.on_entry(entry, source="apply")
+
+
+def apply_scope(db):
+    """Context manager suppressing local taps while a replication entry
+    applies (see :meth:`ChangeFeed.applying`); no-op without a feed."""
+    feed = db.__dict__.get("_cdc_feed")
+    if feed is not None:
+        return feed.applying()
+
+    @contextmanager
+    def _noop():
+        yield
+
+    return _noop()
+
+
+def feed_summary(db) -> Optional[Dict]:
+    """Compact health-endpoint summary, or None when the database has
+    no feed."""
+    feed = db.__dict__.get("_cdc_feed")
+    return None if feed is None else feed.quick_stats()

@@ -67,6 +67,8 @@ POINTS = frozenset(
         "bin.recv",  # binary-protocol frame receive
         "bin.connect",  # client socket connect
         "cluster.probe",  # /cluster/health member probe + scrape
+        "cdc.push",  # changefeed delivery: binary push frame + HTTP
+        # /changes long-poll response (orientdb_tpu/cdc)
     }
 )
 

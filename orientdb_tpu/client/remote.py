@@ -99,6 +99,12 @@ class RemoteDatabase:
         #: re-entrant so a callback may live_unsubscribe itself.
         self._orphan_pushes: Dict[int, List[dict]] = {}
         self._push_lock = threading.RLock()
+        #: cdc token → the server's resume point for that subscription
+        #: (the failover wrapper seeds its redelivery cursor from it)
+        self._cdc_resume: Dict[int, int] = {}
+        #: cdc tokens whose orphan buffer overflowed pre-registration:
+        #: the drain delivers a resync notice, never a silent gap
+        self._orphan_clipped: set = set()
         #: request/response correlation (echoed by the server): lets a
         #: timed-out _call's late reply be discarded instead of being
         #: dequeued as the NEXT op's response (channel desync)
@@ -180,23 +186,46 @@ class RemoteDatabase:
                     self._resp_q.put(None)  # unblock a waiting _call
                 return
             if frame.get("push"):
-                ev = frame.get("event", {})
-                token = ev.get("token")
+                if frame.get("cdc"):
+                    # changefeed batch (or a resync/error notice, which
+                    # delivers as a single frame-shaped event so the
+                    # subscriber hears about it loudly)
+                    token = frame.get("token")
+                    evs = frame.get("events")
+                    if evs is None:
+                        evs = [frame]
+                else:
+                    ev = frame.get("event", {})
+                    token = ev.get("token")
+                    evs = [ev]
                 with self._push_lock:
                     cb = self._live_callbacks.get(token)
                     if cb is None and token is not None:
                         # subscribe-response window: buffer (bounded) for
-                        # live_query to drain once it knows the token
+                        # live_query/cdc_subscribe to drain once it knows
+                        # the token. The cdc bound holds several full
+                        # catch-up batches; if it STILL overflows, the
+                        # buffer is incoherent (its prefix is gone and
+                        # the server-side floor advanced past it) — drop
+                        # it and mark the token CLIPPED so the drain
+                        # delivers a loud resync notice instead of a
+                        # silent gap
+                        cap = 4096 if frame.get("cdc") else 64
                         buf = self._orphan_pushes.setdefault(token, [])
-                        buf.append(ev)
-                        del buf[:-64]
+                        buf.extend(evs)
+                        if frame.get("cdc") and len(buf) > cap:
+                            buf.clear()
+                            self._orphan_clipped.add(token)
+                        else:
+                            del buf[:-cap]
                     elif cb is not None:
                         # deliver under the lock: a concurrent drain in
                         # live_query must not be overtaken (ordering)
-                        try:
-                            cb(ev)
-                        except Exception:
-                            pass  # subscriber errors must not kill the channel
+                        for ev in evs:
+                            try:
+                                cb(ev)
+                            except Exception:
+                                pass  # subscriber errors must not kill the channel
             else:
                 self._resp_q.put(frame)
 
@@ -242,6 +271,85 @@ class RemoteDatabase:
             # even when the RPC fails: pushes racing the unsubscribe land
             # in the orphan buffer (no callback) and nobody would ever
             # drain them — drop, don't park for the connection lifetime
+            with self._push_lock:
+                self._orphan_pushes.pop(token, None)
+
+    # -- changefeeds (orientdb_tpu/cdc) -------------------------------------
+
+    def cdc_subscribe(
+        self,
+        callback,
+        classes=None,
+        where: Optional[str] = None,
+        since: Optional[int] = None,
+        cursor: Optional[str] = None,
+        policy: str = "shed",
+    ) -> int:
+        """Subscribe to the database's changefeed; events push over this
+        channel as they commit (the callback runs on the reader thread).
+        ``since`` resumes from an explicit LSN, ``cursor`` from a durable
+        named cursor persisted by :meth:`cdc_ack` — reconnecting with the
+        same cursor redelivers everything unacked (at-least-once)."""
+        with self._lock:
+            self._ensure_reader()
+        req: Dict = {"op": "cdc_subscribe", "policy": policy}
+        if classes:
+            req["classes"] = list(classes)
+        if where:
+            req["where"] = where
+        if since is not None:
+            req["since"] = since
+        if cursor:
+            req["cursor"] = cursor
+        r = self._checked(req)
+        token = r["token"]
+        with self._push_lock:
+            self._cdc_resume[token] = int(r.get("since", 0))
+            self._live_callbacks[token] = callback
+            drained = self._orphan_pushes.pop(token, [])
+            if token in self._orphan_clipped:
+                # pre-registration pushes overflowed the orphan buffer:
+                # the stream's prefix is gone — say so loudly; the
+                # consumer re-subscribes from its cursor to recover
+                self._orphan_clipped.discard(token)
+                drained = [
+                    {
+                        "cdc": True,
+                        "token": token,
+                        "error": "catch-up events overflowed the "
+                        "pre-registration buffer; re-subscribe from "
+                        "your cursor",
+                        "resync": True,
+                    }
+                ]
+            for ev in drained:
+                try:
+                    callback(ev)
+                except Exception:
+                    pass
+        return token
+
+    def cdc_resume_point(self, token: int) -> int:
+        """The LSN this subscription resumed from (the server's answer
+        at subscribe time) — everything after it is the subscription's
+        responsibility."""
+        with self._push_lock:
+            return self._cdc_resume.get(token, 0)
+
+    def cdc_ack(self, token: int, lsn: int) -> int:
+        """The consumer durably processed everything at/below ``lsn``;
+        persists the named cursor server-side. Returns the stored LSN."""
+        r = self._checked({"op": "cdc_ack", "token": token, "lsn": lsn})
+        return int(r.get("lsn", lsn))
+
+    def cdc_unsubscribe(self, token: int) -> None:
+        with self._push_lock:
+            self._live_callbacks.pop(token, None)
+            self._cdc_resume.pop(token, None)
+            self._orphan_clipped.discard(token)
+        try:
+            self._checked({"op": "cdc_unsubscribe", "token": token})
+        finally:
             with self._push_lock:
                 self._orphan_pushes.pop(token, None)
 
@@ -506,7 +614,21 @@ class FailoverDatabase:
         self._serialization = serialization
         self._pipeline = pipeline
         self._db: Optional[RemoteDatabase] = None
-        self._lock = threading.Lock()
+        # REENTRANT: a subscription callback delivered on this thread
+        # (e.g. the orphan-push drain inside cdc_subscribe, which runs
+        # under locked_attempt) may naturally call back into this
+        # client (cdc_ack after processing) — a plain Lock would
+        # self-deadlock there
+        self._lock = threading.RLock()
+        #: client-token → live/cdc subscription spec, for re-subscribe
+        #: after a failover reconnect (the client-facing token stays
+        #: stable; the CURRENT member's server token lives in the spec).
+        #: Client tokens are allocated LOCALLY — reusing a server token
+        #: as the key would collide with a post-failover member's fresh
+        #: counter and clobber another subscription's spec.
+        self._subs: Dict[int, Dict] = {}
+        self._subs_lock = threading.Lock()
+        self._next_sub_token = 1
         self._policy = retry_policy or RetryPolicy(
             attempts=4, base_s=0.05, cap_s=1.0, budget_s=8.0
         )
@@ -535,8 +657,19 @@ class FailoverDatabase:
             # would misreport an auth failure as a total outage
         raise RemoteError(f"no reachable server in {self._addrs}: {last}")
 
-    def _retry(self, method: str, *a, idempotent: bool = True):
+    def _retry(self, method, *a, idempotent: bool = True):
+        """Run one client op under the retry policy. ``method`` is a
+        RemoteDatabase method name, or a callable taking the CURRENT
+        connection — use a callable when an argument (e.g. a server-side
+        token) must be re-resolved per attempt, after a failover may
+        have replaced it."""
         from orientdb_tpu.parallel.resilience import RetryBudgetExceeded
+
+        mname = (
+            method
+            if isinstance(method, str)
+            else getattr(method, "__name__", "call")
+        )
 
         class _Ambiguous(Exception):
             """Channel died mid-op on a non-idempotent call: never
@@ -552,7 +685,10 @@ class FailoverDatabase:
                     raise
                 except RemoteError as e:
                     raise _ReconnectFailed(str(e)) from e
+                self._resubscribe()
             try:
+                if callable(method):
+                    return method(self._db)
                 return getattr(self._db, method)(*a)
             except (RemoteConnectionError, OSError) as e:
                 self._db = None
@@ -563,12 +699,18 @@ class FailoverDatabase:
                     self._connect_any()
                 except RemoteError:
                     pass  # next policy attempt (or the caller) reconnects
+                else:
+                    # the old channel's push subscriptions died with it:
+                    # re-establish them on the new member (or fail them
+                    # loudly) BEFORE the op retries — a reconnect must
+                    # never silently drop _live_callbacks
+                    self._resubscribe()
                 if not idempotent:
                     # at-most-once for writes: the dead channel may have
                     # delivered the op before failing — resending could
                     # apply it twice, so surface the ambiguity instead
                     raise _Ambiguous(
-                        f"connection failed mid-{method}; reconnected to "
+                        f"connection failed mid-{mname}; reconnected to "
                         f"{self._addrs[0]} but the op was NOT retried "
                         "(outcome on the old server unknown)"
                     ) from e
@@ -632,14 +774,169 @@ class FailoverDatabase:
     def create_database(self, name: str):
         return self._retry("create_database", name, idempotent=False)
 
+    def _resubscribe(self) -> None:
+        """Re-establish live/cdc subscriptions on a freshly connected
+        member (a failover reconnect must not silently drop them): cdc
+        consumers resume from their last delivered/acked LSN, so the
+        outage window redelivers at-least-once; live monitors (not
+        resumable by design) simply re-attach for future events. A
+        subscription that cannot be re-established fails LOUDLY into its
+        callback — an ``operation: "ERROR"`` event with ``unsubscribed``
+        set — instead of going quiet. Runs under self._lock."""
+        db = self._db
+        if db is None:
+            return
+        with self._subs_lock:
+            specs = list(self._subs.items())
+        for ctoken, spec in specs:
+            try:
+                if spec["kind"] == "live":
+                    st = db.live_query(spec["sql"], spec["callback"])
+                else:
+                    holder = spec["holder"]
+                    st = db.cdc_subscribe(
+                        spec["callback"],
+                        classes=spec["classes"],
+                        where=spec["where"],
+                        since=holder["lsn"],
+                        cursor=spec["cursor"],
+                        policy=spec["policy"],
+                    )
+                with self._subs_lock:
+                    if ctoken in self._subs:
+                        self._subs[ctoken]["server_token"] = st
+            except Exception as e:
+                with self._subs_lock:
+                    self._subs.pop(ctoken, None)
+                # fail LOUDLY, but on a detached thread: this runs under
+                # self._lock, and the natural subscriber reaction is to
+                # call back into this client (re-subscribe) — invoking
+                # it inline would deadlock on the non-reentrant lock
+                err = {
+                    "token": ctoken,
+                    "operation": "ERROR",
+                    "error": "subscription lost in failover; "
+                    f"re-subscribe failed: {e}",
+                    "unsubscribed": True,
+                }
+
+                def _deliver(cb=spec["callback"], ev=err):
+                    try:
+                        cb(ev)
+                    except Exception:
+                        pass  # a raising subscriber changes nothing
+
+                threading.Thread(target=_deliver, daemon=True).start()
+
+    def _server_token(self, ctoken: int) -> int:
+        with self._subs_lock:
+            spec = self._subs.get(ctoken)
+            return spec["server_token"] if spec else ctoken
+
+    def _alloc_sub_token(self) -> int:
+        with self._subs_lock:
+            token = self._next_sub_token
+            self._next_sub_token += 1
+            return token
+
     def live_query(self, sql: str, callback) -> int:
-        """Subscribe on the CURRENT member; subscriptions do not survive
-        a failover (the reference's remote monitors don't either — the
-        client re-subscribes after reconnect)."""
-        return self._retry("live_query", sql, callback, idempotent=False)
+        """Subscribe on the CURRENT member. The subscription is tracked:
+        a failover reconnect re-subscribes it on the new member (or
+        fails it loudly to the callback); the returned client token
+        stays valid across failovers. Events are relabeled to carry it —
+        ``live_unsubscribe(ev["token"])`` keeps working even though the
+        per-member server token changes on every failover."""
+        ctoken = self._alloc_sub_token()
+
+        def relabeled(ev, _cb=callback, _t=ctoken):
+            if isinstance(ev, dict) and "token" in ev:
+                ev = {**ev, "token": _t}
+            _cb(ev)
+
+        st = self._retry("live_query", sql, relabeled, idempotent=False)
+        with self._subs_lock:
+            self._subs[ctoken] = {
+                "kind": "live",
+                "sql": sql,
+                "callback": relabeled,
+                "server_token": st,
+            }
+        return ctoken
 
     def live_unsubscribe(self, token: int) -> None:
-        self._retry("live_unsubscribe", token, idempotent=False)
+        with self._subs_lock:
+            spec = self._subs.pop(token, None)
+        st = spec["server_token"] if spec else token
+        self._retry("live_unsubscribe", st, idempotent=False)
+
+    def cdc_subscribe(
+        self,
+        callback,
+        classes=None,
+        where: Optional[str] = None,
+        since: Optional[int] = None,
+        cursor: Optional[str] = None,
+        policy: str = "shed",
+    ) -> int:
+        """Changefeed subscription with failover resume: the client
+        tracks the last delivered LSN, so a reconnect re-subscribes from
+        it (at-least-once across member failures)."""
+        holder = {"lsn": since}
+
+        def tracking(ev, _cb=callback, _h=holder):
+            lsn = ev.get("lsn")
+            if isinstance(lsn, int):
+                _h["lsn"] = max(_h["lsn"] or 0, lsn)
+            _cb(ev)
+
+        ctoken = self._alloc_sub_token()
+        st = self._retry(
+            "cdc_subscribe",
+            tracking,
+            classes,
+            where,
+            since,
+            cursor,
+            policy,
+            idempotent=False,
+        )
+        if holder["lsn"] is None:
+            # no explicit resume point: seed from where the SERVER
+            # started this subscription, so a failover before the first
+            # delivered event still replays the whole outage window
+            # instead of silently restarting at the new member's head
+            try:
+                holder["lsn"] = self._db.cdc_resume_point(st)
+            except (AttributeError, RemoteError):
+                pass  # worst case: the pre-seeding behavior
+        with self._subs_lock:
+            self._subs[ctoken] = {
+                "kind": "cdc",
+                "callback": tracking,
+                "classes": classes,
+                "where": where,
+                "cursor": cursor,
+                "policy": policy,
+                "holder": holder,
+                "server_token": st,
+            }
+        return ctoken
+
+    def cdc_ack(self, token: int, lsn: int) -> int:
+        # acks never regress server-side, so the retry is idempotent.
+        # The server token is re-resolved PER ATTEMPT: a failover during
+        # the ack installs a fresh token via _resubscribe, and retrying
+        # with the stale one would hit "unknown cdc token"
+        def cdc_ack(db):
+            return db.cdc_ack(self._server_token(token), lsn)
+
+        return self._retry(cdc_ack)
+
+    def cdc_unsubscribe(self, token: int) -> None:
+        with self._subs_lock:
+            spec = self._subs.pop(token, None)
+        st = spec["server_token"] if spec else token
+        self._retry("cdc_unsubscribe", st, idempotent=False)
 
     def close(self) -> None:
         # under the lock: a concurrent _retry may be mid-reconnect, and

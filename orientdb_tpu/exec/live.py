@@ -8,6 +8,14 @@ The WHERE clause (if any) is evaluated against the record for create/update
 (delete events always fire, as in the reference, since the stored record no
 longer matches anything).
 
+Monitors are callback-mode consumers of the database's CDC plane
+(``orientdb_tpu/cdc``): on a WAL-armed database events derive from the
+committed log — a replica's monitors therefore see replication-applied
+writes too (the hook path never fired for those), gap-free and carrying
+real LSNs; on a plain in-memory database the feed's hook tap preserves
+the original embedded semantics (post-commit delivery, tx events only
+after the whole commit succeeded).
+
 Python API: ``monitor = live_query(db, sql, callback)`` →
 ``monitor.unsubscribe()``. SQL surface: ``LIVE SELECT FROM Class`` returns
 a row with the monitor token and buffers events on the monitor
@@ -25,47 +33,53 @@ from orientdb_tpu.utils.logging import get_logger
 
 log = get_logger("live")
 
-_EVENT_OPS = {
-    "after_create": "CREATE",
-    "after_update": "UPDATE",
-    "after_delete": "DELETE",
-}
-
 
 class LiveQueryMonitor:
-    """One live subscription ([E] OLiveQueryMonitor)."""
+    """One live subscription ([E] OLiveQueryMonitor) — a callback-mode
+    CDC consumer restricted to the statement's class + WHERE."""
 
     def __init__(self, db, stmt: A.SelectStatement, callback: Callable) -> None:
         if not isinstance(stmt.target, A.ClassTarget):
             raise ValueError("LIVE SELECT supports class targets only")
+        from orientdb_tpu.cdc.feed import live_feed
+
         self.db = db
         self.stmt = stmt
         self.callback = callback
         self.class_name = stmt.target.name
         self._lock = threading.Lock()
         self._active = True
-        self.token = db.hooks.register(self._on_event, class_name=self.class_name)
+        self._consumer = live_feed(db).register(
+            classes=[self.class_name],
+            where=stmt.where,
+            callback=self._on_change,
+        )
+        self.token = self._consumer.token
 
-    def _on_event(self, event: str, doc) -> None:
-        op = _EVENT_OPS.get(event)
-        if op is None or not self._active:
+    def _on_change(self, ev: Dict) -> None:
+        if not self._active:
             return
-        if op in ("CREATE", "UPDATE") and self.stmt.where is not None:
-            from orientdb_tpu.exec.eval import EvalContext, evaluate, truthy
+        record = ev.get("record")
+        if record is not None:
+            # WAL-derived events carry wire-encoded values ({"@link"},
+            # {"@bytes"}); embedded subscribers expect the native shapes
+            # the hook path always delivered (RID objects, bytes). _dec
+            # is a no-op on already-native values, so hook-tap events
+            # pass through unchanged.
+            from orientdb_tpu.storage.durability import _dec
 
-            ctx = EvalContext(self.db, current=doc)
-            try:
-                if not truthy(evaluate(ctx, self.stmt.where)):
-                    return
-            except Exception:
-                return
+            record = {
+                k: (v if k.startswith("@") else _dec(v))
+                for k, v in record.items()
+            }
         try:
             self.callback(
                 {
                     "token": self.token,
-                    "operation": op,
-                    "rid": str(doc.rid),
-                    "record": doc.to_dict(),
+                    "operation": ev["op"].upper(),
+                    "rid": ev["rid"],
+                    "record": record,
+                    "lsn": ev.get("lsn"),
                 }
             )
         except Exception:  # subscriber errors must not break commits
@@ -75,7 +89,7 @@ class LiveQueryMonitor:
         with self._lock:
             if self._active:
                 self._active = False
-                self.db.hooks.unregister(self.token)
+                self._consumer.feed.unregister(self.token)
                 reg = getattr(self.db, "_live_registry", None)
                 if reg is not None:
                     reg.monitors.pop(self.token, None)

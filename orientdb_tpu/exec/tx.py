@@ -644,6 +644,11 @@ class Transaction:
                     tx_entry["txid2pc"] = txid2pc
                 lsn = db._wal.append(tx_entry)
                 db._mark_ckpt_dirty(tx_entry)
+                # changefeed tap: the committed tx is ONE atomic entry —
+                # consumers see its ops share an LSN (seq-ordered)
+                from orientdb_tpu.cdc.feed import notify_commit
+
+                notify_commit(db, tx_entry, lsn)
                 # quorum mode: the whole tx ships as ONE atomic entry and
                 # the commit blocks until a majority holds it
                 db._quorum_push(tx_entry, lsn)

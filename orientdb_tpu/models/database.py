@@ -180,6 +180,13 @@ class Database:
                 return
         lsn = w.append(entry)
         self._mark_ckpt_dirty(entry)
+        # changefeed tap BEFORE the quorum push: the entry is committed
+        # and durable locally, and the push may block on the network (or
+        # raise QuorumError with the entry still in the WAL — in-doubt
+        # writes are exactly what at-least-once delivery must carry)
+        from orientdb_tpu.cdc.feed import notify_commit
+
+        notify_commit(self, entry, lsn)
         self._quorum_push(entry, lsn)
 
     def _mark_ckpt_dirty(self, entry: Dict) -> None:

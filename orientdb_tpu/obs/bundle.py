@@ -13,7 +13,10 @@ history as ONE artifact, not four endpoints scraped in a hurry:
   cost) and the span-profile self-time tree (obs/profile) — the
   aggregate context a single slow trace is judged against;
 - in-doubt 2PC state: staged-but-undecided batches per database, plus
-  the coordinator-side in-doubt reports (``twophase.INDOUBT_LOG``).
+  the coordinator-side in-doubt reports (``twophase.INDOUBT_LOG``);
+- changefeed state per database (``orientdb_tpu/cdc``): head LSN,
+  consumer lag/queue depth/shed counts, durable cursors — the first
+  thing to read when a downstream pipeline reports missing events.
 
 Served as ``GET /debug/bundle`` (admin-only) and from the console as
 ``DIAG [<path>]``. Everything here is JSON-friendly by construction.
@@ -48,6 +51,17 @@ def assemble_traces(max_traces: int = 50) -> List[Dict]:
     ]
 
 
+def cdc_state(dbs: Iterable) -> Dict:
+    """Per-database changefeed stats (databases without a feed are
+    omitted — no feed means no subscribers and nothing to triage)."""
+    out: Dict[str, Dict] = {}
+    for db in dbs:
+        feed = db.__dict__.get("_cdc_feed")
+        if feed is not None:
+            out[db.name] = feed.stats()
+    return out
+
+
 def in_doubt_state(dbs: Iterable) -> Dict:
     """Participant-side staged (prepared, undecided) 2PC batches per
     database plus the coordinator-side in-doubt reports."""
@@ -77,6 +91,7 @@ def debug_bundle(
     from orientdb_tpu.obs.profile import profiler
     from orientdb_tpu.obs.stats import stats
 
+    dbs = list(dbs)  # iterated twice: 2PC state and cdc state
     out: Dict[str, object] = {
         "ts": round(time.time(), 3),
         "member": member,
@@ -86,6 +101,7 @@ def debug_bundle(
         "query_stats": stats.top(50),
         "profile": profiler.profile(),
         "in_doubt_2pc": in_doubt_state(dbs),
+        "cdc": cdc_state(dbs),
     }
     if cluster is not None:
         try:

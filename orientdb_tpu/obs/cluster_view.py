@@ -67,6 +67,7 @@ def _staged_2pc(db) -> int:
 
 
 def _member_health(cluster, m) -> Dict:
+    from orientdb_tpu.cdc.feed import feed_summary
     from orientdb_tpu.obs.slowlog import slowlog
 
     out: Dict[str, object] = {
@@ -75,6 +76,10 @@ def _member_health(cluster, m) -> Dict:
         "in_doubt_2pc": _staged_2pc(m.db),
         "slowlog_depth": len(slowlog.entries()),
     }
+    cdc = feed_summary(m.db)
+    if cdc is not None:
+        # changefeed pressure: consumer count, queue depth, worst lag
+        out["cdc"] = cdc
     if m.puller is not None:
         out["replication"] = m.puller.lag()
     try:
@@ -97,21 +102,29 @@ def cluster_health(server) -> Dict:
 
     cluster = getattr(server, "cluster", None)
     if cluster is None:
+        from orientdb_tpu.cdc.feed import feed_summary
         from orientdb_tpu.obs.slowlog import slowlog
 
+        member: Dict[str, object] = {
+            "role": "STANDALONE",
+            "alive": True,
+            "in_doubt_2pc": sum(
+                _staged_2pc(db) for db in server.databases.values()
+            ),
+            "slowlog_depth": len(slowlog.entries()),
+        }
+        cdc = {
+            db.name: s
+            for db in server.databases.values()
+            for s in [feed_summary(db)]
+            if s is not None
+        }
+        if cdc:
+            member["cdc"] = cdc
         return {
             "ts": round(time.time(), 3),
             "cluster": None,
-            "members": {
-                server.name: {
-                    "role": "STANDALONE",
-                    "alive": True,
-                    "in_doubt_2pc": sum(
-                        _staged_2pc(db) for db in server.databases.values()
-                    ),
-                    "slowlog_depth": len(slowlog.entries()),
-                }
-            },
+            "members": {server.name: member},
             "breakers": breaker_snapshot(),
             "indoubt_pending": resolver.pending(),
         }

@@ -53,6 +53,10 @@ SPAN_CATALOG: Dict[str, str] = {
     "forward.request": "non-owner → write-owner HTTP forward",
     "bench.block": "one measured bench block (evidence carries its "
     "trace id)",
+    "cdc.catchup": "changefeed catch-up read: WAL entries above a "
+    "consumer's cursor decoded to events",
+    "cdc.push": "one changefeed delivery (binary push frame or HTTP "
+    "/changes long-poll response)",
 }
 
 #: dynamically named span families (f-string call sites the literal

@@ -120,6 +120,7 @@ def apply_pushed_entries(
             # additive, so restoring twice is never safe)
             db._repl_restored_ckpt_lsn = floor
             metrics.incr("replication.full_sync")
+        from orientdb_tpu.cdc.feed import apply_scope, notify_applied
         from orientdb_tpu.obs.propagation import continue_trace
 
         for e in entries:
@@ -137,8 +138,12 @@ def apply_pushed_entries(
                 force=True,
                 lsn=lsn,
                 source="push",
-            ):
+            ), apply_scope(db):
                 _apply_entry(db, e)
+            # changefeed tap: a replica's subscribers see the entry with
+            # its SOURCE lsn (apply_scope muted the local-write taps the
+            # apply may have fired, e.g. a delete's cascade)
+            notify_applied(db, e)
             floor = lsn
             db._repl_applied_lsn = floor
     return floor
@@ -658,6 +663,7 @@ class ReplicaPuller:
             )
             if suppress:
                 self.db._tx_local.suppress_wal = True
+            from orientdb_tpu.cdc.feed import apply_scope, notify_applied
             from orientdb_tpu.obs.propagation import continue_trace
 
             try:
@@ -681,8 +687,15 @@ class ReplicaPuller:
                         force=True,
                         lsn=lsn,
                         source="pull",
-                    ):
+                    ), apply_scope(self.db):
                         _apply_entry(self.db, e)
+                    if self.stream is None:
+                        # changefeed tap (source lsn; local taps were
+                        # muted). NAMED streams carry a foreign owner's
+                        # independent LSN space — feeding them into the
+                        # same feed would collide cursors, so CDC covers
+                        # the primary stream only (documented limit)
+                        notify_applied(self.db, e)
                     self.applied_lsn = floor = lsn
                     self._set_db_floor(lsn)
                     applied += 1

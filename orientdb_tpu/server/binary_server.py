@@ -108,6 +108,89 @@ def _req_is_read(req: dict) -> bool:
     return False
 
 
+class _CdcPump:
+    """Push loop for one changefeed subscription on a binary session:
+    drains the feed consumer's bounded queue and ships event batches as
+    unsolicited ``{"push": true, "cdc": true}`` frames (riding the
+    live-push framing and the session's send lock). A dead channel ends
+    the pump with ONE warning — the events stay redeliverable from the
+    consumer's cursor, which is the whole point of the plane."""
+
+    def __init__(self, session: "_Session", consumer) -> None:
+        self.session = session
+        self.consumer = consumer
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"cdc-push-{consumer.token}",
+            daemon=True,
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Idempotent; never joins (the pump may be blocked in a send
+        the caller's socket close is about to break)."""
+        self._stop.set()
+        self.consumer.close()  # wakes a poll() wait
+        self.consumer.feed.unregister(self.consumer.token)
+
+    def _run(self) -> None:
+        from orientdb_tpu.cdc.feed import CdcGapError
+        from orientdb_tpu.obs.trace import span
+        from orientdb_tpu.utils.metrics import metrics
+
+        token = self.consumer.token
+        while not self._stop.is_set():
+            try:
+                events = self.consumer.poll(max_events=256, timeout=0.25)
+            except CdcGapError as e:
+                # the resume point fell off retention: tell the client
+                # loudly (it must resync), then end the subscription
+                try:
+                    self.session._send(
+                        {
+                            "push": True,
+                            "cdc": True,
+                            "token": token,
+                            "error": str(e),
+                            "resync": True,
+                        }
+                    )
+                except OSError:
+                    pass
+                break
+            if not events:
+                continue
+            if self._stop.is_set():
+                # teardown raced the poll: the batch is NOT sent — it
+                # remains redeliverable from the cursor, and a frame at
+                # a closing socket would be an event to a dead callback
+                break
+            try:
+                with span(
+                    "cdc.push", transport="binary", events=len(events)
+                ), fault.point("cdc.push"):
+                    self.session._send(
+                        {
+                            "push": True,
+                            "cdc": True,
+                            "token": token,
+                            "events": events,
+                        }
+                    )
+                metrics.incr("cdc.delivered", len(events))
+            except OSError:
+                log.warning(
+                    "cdc push failed for token %s (session gone); "
+                    "consumer resumes from its cursor",
+                    token,
+                )
+                break
+        self.stop()
+
+
 class _Session:
     def __init__(self, server, sock: socket.socket) -> None:
         self.server = server
@@ -120,6 +203,8 @@ class _Session:
         self._send_lock = threading.Lock()
         #: token -> LiveQueryMonitor subscribed over THIS session
         self._live: dict = {}
+        #: token -> _CdcPump for changefeed subscriptions on THIS session
+        self._cdc: dict = {}
         #: pipeline mode (db_open {"pipeline": true}): query ops run on
         #: this pool and respond out-of-order by reqid
         self._pool = None
@@ -177,6 +262,13 @@ class _Session:
                 if "reqid" in req:
                     resp["reqid"] = req["reqid"]
                 self._send(resp)
+                # a cdc_subscribe's pump starts only AFTER its response
+                # is on the wire: a catch-up batch pushed ahead of the
+                # response would land before the client knows the token
+                # and could overflow its orphan buffer (lost events)
+                pending = self.__dict__.pop("_pending_pump", None)
+                if pending is not None:
+                    pending.start()
                 if req.get("op") == "close":
                     break
         except OSError:
@@ -184,7 +276,12 @@ class _Session:
         finally:
             if self._pool is not None:
                 self._pool.shutdown(wait=False)
-            # a dropped session must not leave dangling subscriptions
+            # a dropped session must not leave dangling subscriptions.
+            # cdc pumps stop FIRST (their consumers close, waking any
+            # in-flight poll) so no event is pushed at the dying socket
+            for pump in list(self._cdc.values()):
+                pump.stop()
+            self._cdc.clear()
             for m in list(self._live.values()):
                 try:
                     m.unsubscribe()
@@ -394,6 +491,50 @@ class _Session:
                 m = live_query(self.db, req["sql"], push)
                 self._live[m.token] = m
                 return {"ok": True, "token": m.token}
+            if op == "cdc_subscribe":
+                # resumable changefeed push over the session channel
+                # (orientdb_tpu/cdc): {"classes": [...], "where": "...",
+                # "since": <lsn> | "cursor": "<name>", "policy":
+                # "shed"|"block"} → events arrive as {"push": true,
+                # "cdc": true, "token": t, "events": [...]} frames;
+                # cdc_ack persists the cursor for reconnect resume
+                self.server.security.check(self.user, RES_RECORD, "read")
+                from orientdb_tpu.cdc.feed import feed_of, parse_where
+
+                classes = req.get("classes") or None
+                where = req.get("where")
+                consumer = feed_of(self.db).register(
+                    name=req.get("cursor"),
+                    classes=classes,
+                    where=parse_where(
+                        where, classes[0] if classes else None
+                    )
+                    if where
+                    else None,
+                    since=req.get("since"),
+                    policy=req.get("policy", "shed"),
+                )
+                pump = _CdcPump(self, consumer)
+                self._cdc[consumer.token] = pump
+                # started by the run loop AFTER the response is sent
+                self._pending_pump = pump
+                return {
+                    "ok": True,
+                    "token": consumer.token,
+                    "since": consumer.resume_lsn,
+                }
+            if op == "cdc_ack":
+                pump = self._cdc.get(req.get("token"))
+                if pump is None:
+                    return {"ok": False, "error": "unknown cdc token"}
+                acked = pump.consumer.ack(int(req.get("lsn", 0)))
+                return {"ok": True, "lsn": acked}
+            if op == "cdc_unsubscribe":
+                pump = self._cdc.pop(req.get("token"), None)
+                if pump is None:
+                    return {"ok": False, "error": "unknown cdc token"}
+                pump.stop()
+                return {"ok": True}
             if op == "live_unsubscribe":
                 m = self._live.pop(req.get("token"), None)
                 if m is None:

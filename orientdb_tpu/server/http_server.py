@@ -124,8 +124,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     #: write routes exempt from admission shedding: a replication apply
     #: or a 2PC phase carries an already-made decision — refusing it
-    #: would CREATE gaps / in-doubt transactions instead of load relief
-    _ADMISSION_EXEMPT = frozenset({"replication", "tx2pc"})
+    #: would CREATE gaps / in-doubt transactions instead of load relief.
+    #: A changefeed cursor ack is exempt too: acking lets a lagging
+    #: consumer DRAIN, which reduces pressure rather than adding it.
+    _ADMISSION_EXEMPT = frozenset({"replication", "tx2pc", "changes"})
 
     def _shed_write(self, head: str, dbname: Optional[str]) -> bool:
         """Admission control for write verbs: True when the request was
@@ -453,6 +455,94 @@ class _Handler(BaseHTTPRequestHandler):
                         cluster=getattr(srv, "cluster", None),
                     ),
                 )
+            if head == "changes" and len(rest) == 1:
+                # resumable changefeed pull (orientdb_tpu/cdc): WAL-
+                # derived change events with lsn > the cursor, long-poll
+                # when caught up. ?since=<lsn> (explicit cursor) or
+                # ?cursor=<name> (durable named cursor; since overrides);
+                # ?timeout= bounds the long-poll, ?limit= the batch,
+                # ?class=A,B filters (subclass-aware), ?where= adds a
+                # predicate. A pruned range answers 410: resync, never a
+                # silent gap.
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, RES_RECORD, "read")
+                import time as _time
+
+                from orientdb_tpu.cdc.feed import (
+                    CdcGapError,
+                    event_matches,
+                    feed_of,
+                    parse_where,
+                )
+                from orientdb_tpu.chaos import fault
+                from orientdb_tpu.obs.trace import span
+                from orientdb_tpu.utils.config import config
+
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                feed = feed_of(db)
+                cursor = q.get("cursor", [None])[0]
+                if "since" in q:
+                    since = int(q["since"][0])
+                elif cursor:
+                    # first contact with a NEW named cursor starts at
+                    # the head (new changes only) — same semantics as
+                    # binary cdc_subscribe, and it cannot 410 on a
+                    # database whose early archives were retired.
+                    # Explicit ?since=0 still requests a full replay.
+                    # An EXPIRED cursor answers 410 loudly instead.
+                    try:
+                        stored = feed.cursors.get(cursor)
+                    except CdcGapError as e:
+                        return self._error(410, str(e))
+                    since = feed.head_lsn if stored is None else stored
+                else:
+                    since = 0
+                timeout = min(
+                    float(q.get("timeout", [config.cdc_poll_timeout_s])[0]),
+                    60.0,
+                )
+                limit = max(1, int(q.get("limit", ["1000"])[0]))
+                classes = [
+                    c for c in ",".join(q.get("class", [])).split(",") if c
+                ] or None
+                where = q.get("where", [None])[0]
+                where_ast = (
+                    parse_where(where, classes[0] if classes else None)
+                    if where
+                    else None
+                )
+                deadline = _time.monotonic() + timeout
+                while True:
+                    try:
+                        events, covered, head_lsn = feed.events_since(
+                            since, limit=limit
+                        )
+                    except CdcGapError as e:
+                        return self._error(410, str(e))
+                    events = [
+                        ev
+                        for ev in events
+                        if event_matches(db, ev, classes, where_ast)
+                    ]
+                    left = deadline - _time.monotonic()
+                    if events or covered > since or left <= 0:
+                        break
+                    feed.wait_beyond(since, left)
+                with span(
+                    "cdc.push", transport="http", events=len(events)
+                ), fault.point("cdc.push"):
+                    return self._send(
+                        200,
+                        {
+                            "events": events,
+                            "cursor": covered,
+                            "head": head_lsn,
+                        },
+                    )
             if head == "replication" and len(rest) == 2:
                 # WAL shipping for replicas ([E] the distributed delta-sync
                 # request); admin-only — the stream exposes every record
@@ -568,6 +658,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self.server.ot_server.security.check(user, resource, op)
                 rows = db.command(sql).to_dicts()
                 return self._send(200, {"result": rows})
+            if head == "changes" and len(rest) == 2 and rest[1] == "ack":
+                # persist a named changefeed cursor: the consumer has
+                # durably processed everything at/below lsn — restart
+                # resumes there (at-least-once; acks never regress)
+                db = self._db(rest[0])
+                if db is None:
+                    return
+                self.server.ot_server.security.check(user, RES_RECORD, "read")
+                from orientdb_tpu.cdc.feed import feed_of
+
+                payload = json.loads(self._body() or b"{}")
+                name = payload.get("cursor")
+                if not name:
+                    return self._error(400, "cursor name required")
+                stored = feed_of(db).ack_cursor(
+                    name, int(payload.get("lsn", 0))
+                )
+                return self._send(200, {"cursor": name, "lsn": stored})
             if head == "replication" and len(rest) == 2 and rest[1] == "apply":
                 # quorum-push apply ([E] the distributed task execution
                 # endpoint); admin-only like the pull stream

@@ -114,8 +114,11 @@ class BulkLoader:
             db.mutation_epoch += 1
             if wal_entries:
                 bulk_entry = {"op": "bulk", "ops": wal_entries}
-                db._wal.append(bulk_entry)
+                lsn = db._wal.append(bulk_entry)
                 db._mark_ckpt_dirty(bulk_entry)
+                from orientdb_tpu.cdc.feed import notify_commit
+
+                notify_commit(db, bulk_entry, lsn)
         n_v, n_e = len(self._vertices), len(self._edges)
         self._vertices = []
         self._edges = []

@@ -363,13 +363,26 @@ def entry_for_save(doc: Document, is_new: bool) -> Dict:
     return {
         "op": "update",
         "rid": str(doc.rid),
+        # class attribution for CDC decode (replay keys on rid alone and
+        # ignores it; older logs without it fall back to the decoder's
+        # learned-class cache / live lookup)
+        "class": doc.class_name,
         "version": doc.version,
         "fields": _enc_fields(doc),
     }
 
 
 def entry_for_delete(doc: Document) -> Dict:
-    return {"op": "delete", "rid": str(doc.rid)}
+    # class + preimage ride along for CDC decode (see entry_for_save):
+    # a delete event's consumers (cache invalidation, search indexers)
+    # need what was deleted, and only this call site still holds it.
+    # Replay keys on rid alone and ignores both.
+    return {
+        "op": "delete",
+        "rid": str(doc.rid),
+        "class": doc.class_name,
+        "preimage": _enc_fields(doc),
+    }
 
 
 # ---------------------------------------------------------------------------

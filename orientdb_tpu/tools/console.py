@@ -13,6 +13,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   IMPORT DATABASE <path>              DISCONNECT / QUIT / EXIT
   SLOWLOG [<n>|CLEAR]                 DIAG [<path>]
   STATS QUERIES [<k>]                 STATS PROFILE / STATS RESET
+  CDC LIST                            CDC LAG
 """
 
 from __future__ import annotations
@@ -309,6 +310,54 @@ class Console(cmd.Cmd):
                 f"{r['query'][:70]}"
             )
         self._p(f"({len(rows)} shapes)")
+
+    def do_cdc(self, arg: str) -> None:
+        """CDC LIST — changefeed consumers and durable cursors per
+        connected embedded database; CDC LAG — head LSN and per-consumer
+        lag / queue depth / shed counts (the slow-consumer triage
+        view)."""
+        sub = (arg.strip().split() or ["list"])[0].lower()
+        if sub not in ("list", "lag"):
+            self._p("!! usage: CDC LIST | CDC LAG")
+            return
+        dbs = list(self._embedded.values())
+        if self.db is not None and self.db not in dbs:
+            dbs.append(self.db)
+        feeds = [
+            (db, db.__dict__.get("_cdc_feed"))
+            for db in dbs
+            if db.__dict__.get("_cdc_feed") is not None
+        ]
+        if not feeds:
+            self._p("no changefeeds (no database has subscribers)")
+            return
+        for db, feed in feeds:
+            s = feed.stats()
+            if sub == "list":
+                self._p(
+                    f"database '{db.name}': head_lsn={s['head_lsn']} "
+                    f"consumers={len(s['consumers'])} "
+                    f"cursors={len(s['cursors'])}"
+                )
+                for c in s["consumers"]:
+                    name = c["name"] or "-"
+                    cls = ",".join(c["classes"] or []) or "*"
+                    self._p(
+                        f"  #{c['token']:<4} {name:<16} classes={cls} "
+                        f"mode={c['mode']} policy={c['policy']}"
+                    )
+                for name, cur in sorted(s["cursors"].items()):
+                    self._p(f"  cursor {name:<16} lsn={cur['lsn']}")
+            else:
+                self._p(f"database '{db.name}': head_lsn={s['head_lsn']}")
+                for c in s["consumers"]:
+                    name = c["name"] or f"#{c['token']}"
+                    self._p(
+                        f"  {name:<16} lag={c['lag_entries']:<6} "
+                        f"queue={c['queue_depth']:<6} "
+                        f"unacked={c['unacked_entries']:<6} "
+                        f"shed={c['shed_events']}"
+                    )
 
     def do_diag(self, arg: str) -> None:
         """DIAG [<path>] — flight-recorder debug bundle (obs/bundle):

@@ -148,6 +148,18 @@ class GlobalConfiguration:
     # RetryPolicy (parallel/resilience) honors it over its own backoff
     retry_after_s: float = 0.5
 
+    # Change-data-capture (orientdb_tpu/cdc): per-consumer event queues
+    # are bounded at cdc_queue_max — a slow consumer either blocks the
+    # producer (policy "block", bounded by cdc_poll_timeout_s) or sheds
+    # its queue and transparently catches back up from the WAL.
+    # cdc_poll_timeout_s also caps the default HTTP /changes long-poll
+    # wait. Durable named cursors idle longer than
+    # cdc_cursor_retention_s seconds are pruned at the next ack
+    # (0 disables pruning).
+    cdc_queue_max: int = 1024
+    cdc_poll_timeout_s: float = 10.0
+    cdc_cursor_retention_s: float = 7 * 86400.0
+
     # WAL / durability for the host record store
     # (orientdb_tpu.storage.durability): when wal_enabled and wal_dir are
     # set, server-created databases recover-or-create durably under
