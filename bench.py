@@ -435,6 +435,35 @@ def run_tpu_subprocess(block: str, timeout: int) -> dict:
         return {"error": str(e)[:300]}
 
 
+def _read_sanitizer_edges():
+    """The lock-order sanitizer's session dump (SANITIZER_EDGES.json,
+    written by the tier-1 pytest plugin at session end), summarized for
+    the static_analysis evidence record: dynamic edge count, violation
+    count, and the dynamic-vs-static locklint coverage cross-check.
+    None when no sanitized session has run here."""
+    try:
+        from orientdb_tpu.analysis.sanitizer import edges_path
+
+        p = edges_path()
+        if p is None or not os.path.exists(p):
+            return None
+        with open(p) as f:
+            doc = json.load(f)
+        return {
+            "edges": len(doc.get("edges", ())),
+            "repo_edges": len(doc.get("repo_edges", ())),
+            "violations": doc.get("violations", 0),
+            "long_holds": len(doc.get("long_holds", ())),
+            "cross_check": doc.get("cross_check", {}),
+            # dump age: a disabled/subset test session leaves the old
+            # file in place — readers must be able to tell this round's
+            # dynamic evidence from a stale week-old one
+            "age_s": round(time.time() - os.path.getmtime(p), 1),
+        }
+    except Exception:  # pragma: no cover - evidence is best-effort
+        return None
+
+
 def _round_stamp() -> int:
     """THIS run's round number: one past the newest driver record
     (BENCH_r{N}.json) in the repo root. Stamps the detail file so a
@@ -575,12 +604,42 @@ def main() -> None:
         evidence.emit(block, data)
         _flush_detail()
 
-    def budget_ok(block: str) -> bool:
-        if budget_left() > 0:
+    def budget_ok(
+        block: str, est_s: float = 0.0, needs_db: bool = False
+    ) -> bool:
+        """Gate a block on the REMAINING budget covering its estimated
+        cost (dataset build + first compile included — r05 timed out
+        because blocks could START with one second of budget left and
+        run unbounded), and on its dataset existing (a budget-starved
+        parity block leaves db=None; the timing blocks must skip, not
+        crash)."""
+        if budget_left() < est_s:
+            skipped.append(block)
+            extras["skipped_blocks"] = list(skipped)
+            ev(block, skipped="budget")
+            return False
+        if needs_db and db is None:
+            skipped.append(block)
+            extras["skipped_blocks"] = list(skipped)
+            ev(block, skipped="no_dataset")
+            return False
+        return True
+
+    def clamp_timeout(cap: int) -> int:
+        """Subprocess timeout bounded by the remaining budget: a heavy
+        block launched near the budget edge must die AT the edge, not
+        at its own generous cap (that overrun is what let the harness
+        timeout fire first in r05)."""
+        return max(30, min(cap, int(budget_left())))
+
+    def budget_truncated(block: str, err: str) -> bool:
+        """A subprocess error that is just the budget clamp firing is a
+        skip (the run still exits 0 with a headline), never fatal."""
+        if budget_left() <= 60 and "timed out" in err.lower():
+            skipped.append(block)
+            extras["skipped_blocks"] = list(skipped)
+            ev(block, skipped="budget_timeout")
             return True
-        skipped.append(block)
-        extras["skipped_blocks"] = list(skipped)
-        ev(block, skipped="budget")
         return False
 
     from contextlib import contextmanager
@@ -619,18 +678,30 @@ def main() -> None:
     # counts ride the evidence stream so a regression that slipped past
     # tier-1 (or a run from a dirtied tree) is visible next to the
     # numbers it may have tainted
-    if budget_ok("static_analysis"):
+    if budget_ok("static_analysis", est_s=15):
         try:
             from orientdb_tpu.analysis import run as run_analysis
 
             _rep = run_analysis()
             extras["static_analysis"] = dict(_rep.counts)
+            # the runtime sanitizer's last tier-1 session dumps its
+            # dynamic lock-order graph + locklint cross-check (analysis/
+            # sanitizer): the dynamic-vs-static coverage ratio rides the
+            # same evidence record as the racelint counts — one place to
+            # watch both halves of race detection regress
+            _san = _read_sanitizer_edges()
+            if _san is not None:
+                extras["static_analysis"]["dyn_edge_coverage"] = (
+                    _san.get("cross_check", {}).get("coverage")
+                )
             ev(
                 "static_analysis",
                 ok=_rep.ok,
                 passes=dict(_rep.counts),
                 findings=len(_rep.findings),
                 suppressed=len(_rep.suppressed),
+                racelint=_rep.counts.get("racelint", 0),
+                sanitizer=_san,
             )
         except Exception as e:
             # the bench must still measure when the analysis can't run
@@ -639,7 +710,7 @@ def main() -> None:
             ev("static_analysis", error=f"{type(e).__name__}: {e}")
 
     db = None
-    if budget_ok("parity"):
+    if budget_ok("parity", est_s=120):
         from orientdb_tpu.storage.ingest import generate_demodb
         from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
 
@@ -785,19 +856,19 @@ def main() -> None:
             block_trace[tag] = sp.trace_id
         return _median(qpss)
 
-    if budget_ok("single_2hop"):
+    if budget_ok("single_2hop", est_s=20, needs_db=True):
         single_qps = time_single(sql, tag="single_2hop")
         extras["single_query_qps"] = round(single_qps, 3)
         ev("single_2hop", qps=round(single_qps, 3),
            split=splits.get("single_2hop"))
-    if budget_ok("batched_2hop"):
+    if budget_ok("batched_2hop", est_s=25, needs_db=True):
         batched_qps = time_batched(sql, tag="batched_2hop")
         # the headline lands in the detail artifact the moment it is
         # measured — a later timeout cannot lose it
         agg["value"] = round(batched_qps, 3)
         ev("batched_2hop", qps=round(batched_qps, 3),
            split=splits.get("batched_2hop"))
-    if budget_ok("rows_1hop"):
+    if budget_ok("rows_1hop", est_s=25, needs_db=True):
         rows_qps = time_batched(sql_rows, tag="rows_1hop")
         extras["rows_1hop_batched_qps"] = round(rows_qps, 3)
         ev("rows_1hop", qps=round(rows_qps, 3), split=splits.get("rows_1hop"))
@@ -811,7 +882,7 @@ def main() -> None:
         "RETURN p.uid AS p, f.uid AS f"
     )
     rows_param_plist = [{"a": 40 + (i % 15)} for i in range(batch)]
-    if budget_ok("rows_1hop_param"):
+    if budget_ok("rows_1hop_param", est_s=35, needs_db=True):
         for pv in ({"a": 40}, {"a": 47}):
             o = db.query(
                 sql_rows_param, params=pv, engine="oracle"
@@ -839,15 +910,15 @@ def main() -> None:
         )
         extras["rows_1hop_param_batched_qps"] = round(rows_param_qps, 3)
         ev("rows_1hop_param", qps=round(rows_param_qps, 3))
-    if budget_ok("var_depth"):
+    if budget_ok("var_depth", est_s=25, needs_db=True):
         var_qps = time_batched(sql_var, tag="var_depth")
         extras["var_depth_while_batched_qps"] = round(var_qps, 3)
         ev("var_depth", qps=round(var_qps, 3))
-    if budget_ok("traverse"):
+    if budget_ok("traverse", est_s=25, needs_db=True):
         trav_qps = time_batched(sql_trav, tag="traverse")
         extras["traverse_bfs_batched_qps"] = round(trav_qps, 3)
         ev("traverse", qps=round(trav_qps, 3))
-    if budget_ok("select_count"):
+    if budget_ok("select_count", est_s=25, needs_db=True):
         select_qps = time_batched(sql_select, tag="select_count")
         extras["select_count_batched_qps"] = round(select_qps, 3)
         ev("select_count", qps=round(select_qps, 3))
@@ -859,7 +930,9 @@ def main() -> None:
     # ~2x of the embedded numbers, vs the r4 state where a remote client
     # got 8.7 of the embedded 553 q/s. ----
     remote = {}
-    if os.environ.get("BENCH_REMOTE", "1") != "0" and budget_ok("remote"):
+    if os.environ.get("BENCH_REMOTE", "1") != "0" and budget_ok(
+        "remote", est_s=60, needs_db=True
+    ):
         import threading
 
         from orientdb_tpu.client.remote import connect
@@ -1009,7 +1082,7 @@ def main() -> None:
     extras["snb_persons"] = snb_persons
     ldbc_is = {}
     snb = None
-    if snb_persons > 0 and budget_ok("ldbc_is"):
+    if snb_persons > 0 and budget_ok("ldbc_is", est_s=180):
         from orientdb_tpu.storage.ingest import generate_ldbc_snb
         from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
         from orientdb_tpu.workloads.ldbc import IS_QUERIES
@@ -1041,7 +1114,7 @@ def main() -> None:
     # the multi-pattern half of BASELINE configs[4], on the same
     # SF1-shaped graph as the IS section ----
     ldbc_ic = {}
-    if snb is not None and budget_ok("ldbc_ic"):
+    if snb is not None and budget_ok("ldbc_ic", est_s=90):
         from orientdb_tpu.workloads.ldbc import IC_QUERIES
 
         someone = next(snb.browse_class("Person"))
@@ -1073,7 +1146,7 @@ def main() -> None:
     # ---- SF10 every round (VERDICT r3 #2): the IS spot check at 10x ----
     sf10 = {}
     sf10_persons = int(os.environ.get("BENCH_SF10_PERSONS", "100000"))
-    if sf10_persons > 0 and budget_ok("sf10"):
+    if sf10_persons > 0 and budget_ok("sf10", est_s=120):
         from orientdb_tpu.storage.ingest import generate_ldbc_snb
         from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
         from orientdb_tpu.workloads.ldbc import IS_QUERIES
@@ -1106,74 +1179,82 @@ def main() -> None:
     # own process (exit is the one free() it honors) ----
     sf100 = {}
     sf100_persons = int(os.environ.get("BENCH_SF100_PERSONS", "8000000"))
-    if sf100_persons > 0 and budget_ok("sf100_shape"):
-        sf100 = run_tpu_subprocess("sf100", timeout=3600)
+    if sf100_persons > 0 and budget_ok("sf100_shape", est_s=120):
+        sf100 = run_tpu_subprocess("sf100", timeout=clamp_timeout(3600))
         if "error" in sf100:
-            # fatal like the old in-process block: a workload that
-            # silently disappears would sail through the gate
-            if "parity mismatch" in str(sf100["error"]):
-                print(sf100["error"])  # the block's own fatal line
-            else:
-                print(json.dumps({
-                    "metric": "demodb_match_2hop_count_qps",
-                    "value": 0.0, "unit": "queries/sec",
-                    "vs_baseline": 0.0,
-                    "error": f"sf100 block failed: {sf100['error']}"}))
-            sys.exit(1)
-        # sharded sub-block: the same SNB shape row-sharded over an
-        # 8-device virtual mesh in a subprocess (adjacency + columns at
-        # O(E/S) per device), parity-gated, with per-device hbm and
-        # sharded q/s recorded. Scale via BENCH_SF100_SHARDED_PERSONS
-        # (one CPU core executes all 8 virtual devices, so the full 8M
-        # would take hours — the layout is identical at any scale).
-        sharded_persons = int(
-            os.environ.get("BENCH_SF100_SHARDED_PERSONS", "1000000")
-        )
-        if sharded_persons > 0:
-            sf100["sharded"] = run_virtual_mesh_subprocess(
-                "orientdb_tpu.tools.sharded_sf",
-                [8, sharded_persons],
-                timeout=1800,
+            # a clamp-killed subprocess at the budget edge is a SKIP
+            # (headline still prints, rc 0 — the r05 failure mode);
+            # any other error is fatal like the old in-process block:
+            # a workload that silently disappears would sail through
+            # the gate
+            if not budget_truncated("sf100_shape", str(sf100["error"])):
+                if "parity mismatch" in str(sf100["error"]):
+                    print(sf100["error"])  # the block's own fatal line
+                else:
+                    print(json.dumps({
+                        "metric": "demodb_match_2hop_count_qps",
+                        "value": 0.0, "unit": "queries/sec",
+                        "vs_baseline": 0.0,
+                        "error": f"sf100 block failed: {sf100['error']}"}))
+                sys.exit(1)
+        else:
+            # sharded sub-block: the same SNB shape row-sharded over an
+            # 8-device virtual mesh in a subprocess (adjacency + columns
+            # at O(E/S) per device), parity-gated, with per-device hbm
+            # and sharded q/s recorded. Scale via
+            # BENCH_SF100_SHARDED_PERSONS (one CPU core executes all 8
+            # virtual devices, so the full 8M would take hours — the
+            # layout is identical at any scale).
+            sharded_persons = int(
+                os.environ.get("BENCH_SF100_SHARDED_PERSONS", "1000000")
             )
-        extras["sf100_shape"] = sf100
-        ev("sf100_shape", **sf100)
+            if sharded_persons > 0:
+                sf100["sharded"] = run_virtual_mesh_subprocess(
+                    "orientdb_tpu.tools.sharded_sf",
+                    [8, sharded_persons],
+                    timeout=clamp_timeout(1800),
+                )
+            extras["sf100_shape"] = sf100
+            ev("sf100_shape", **sf100)
 
     # ---- degree skew (VERDICT r3 #7), same subprocess isolation ----
     skew = {}
     skew_persons = int(os.environ.get("BENCH_SKEW_PERSONS", "1000000"))
-    if skew_persons > 0 and budget_ok("degree_skew"):
-        skew = run_tpu_subprocess("skew", timeout=3600)
+    if skew_persons > 0 and budget_ok("degree_skew", est_s=90):
+        skew = run_tpu_subprocess("skew", timeout=clamp_timeout(3600))
         if "error" in skew:
-            if "parity mismatch" in str(skew["error"]):
-                print(skew["error"])
-            else:
-                print(json.dumps({
-                    "metric": "demodb_match_2hop_count_qps",
-                    "value": 0.0, "unit": "queries/sec",
-                    "vs_baseline": 0.0,
-                    "error": f"skew block failed: {skew['error']}"}))
-            sys.exit(1)
-        extras["degree_skew"] = skew
-        ev("degree_skew", **skew)
+            if not budget_truncated("degree_skew", str(skew["error"])):
+                if "parity mismatch" in str(skew["error"]):
+                    print(skew["error"])
+                else:
+                    print(json.dumps({
+                        "metric": "demodb_match_2hop_count_qps",
+                        "value": 0.0, "unit": "queries/sec",
+                        "vs_baseline": 0.0,
+                        "error": f"skew block failed: {skew['error']}"}))
+                sys.exit(1)
+        else:
+            extras["degree_skew"] = skew
+            ev("degree_skew", **skew)
 
     # ---- shard-count scaling of the ring-compacted merge (VERDICT r3
     # #6): per-S subprocesses on virtual CPU meshes; merge_rows must stay
     # ~flat while the old all_gather design's row count grows with S ----
     mesh_scaling = []
     if os.environ.get("BENCH_MESH_SCALING", "1") != "0" and budget_ok(
-        "mesh_scaling"
+        "mesh_scaling", est_s=60
     ):
         for S in (2, 4, 8):
             res = run_virtual_mesh_subprocess(
                 "orientdb_tpu.tools.mesh_scaling", [S],
-                timeout=600, n_devices=S,
+                timeout=clamp_timeout(600), n_devices=S,
             )
             res.setdefault("shards", S)
             mesh_scaling.append(res)
         extras["mesh_scaling"] = mesh_scaling
         ev("mesh_scaling", results=mesh_scaling)
 
-    if db is not None and budget_ok("oracle_2hop"):
+    if db is not None and budget_ok("oracle_2hop", est_s=30):
         with block_span("oracle_2hop"):
             t0 = time.perf_counter()
             for _ in range(oracle_iters):

@@ -14,6 +14,14 @@ heavier lock/config/exception passes) now run on:
   detection, and the pass registry;
 - :mod:`locklint` — static lock-nesting graph (lock-order cycles) and
   blocking calls made while a lock is held (lockdep-style discipline);
+- :mod:`racelint` — guard consistency for shared state: a
+  ``self.<attr>`` rebinding in a thread-crossing class guarded at one
+  site may not be lock-free (mixed-guard) or under a different lock
+  (guard-inconsistent) at another;
+- :mod:`sanitizer` — NOT a pass but the runtime half of race
+  detection: a TSan-lite pytest plugin that fails tests on observed
+  lock-order cycles and cross-checks the dynamic graph against
+  locklint's static one;
 - :mod:`configlint` — every ``config.<key>`` read has a declared
   default in ``utils/config.py`` and a README mention; dead keys flag;
 - :mod:`exceptlint` — no ``BaseException`` swallow anywhere

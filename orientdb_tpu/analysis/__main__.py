@@ -1,14 +1,26 @@
-"""CLI: ``python -m orientdb_tpu.analysis [--json] [--pass NAME]``.
+"""CLI: ``python -m orientdb_tpu.analysis [--json] [--pass NAME]
+[--baseline PATH]``.
 
 Exit status 0 when every pass is clean (no unsuppressed findings),
 1 otherwise — the same gate ``tests/test_analysis.py`` enforces
 tier-1 and ``bench.py`` records into its evidence stream.
+
+``--baseline PATH`` is the adopt-in-a-dirty-tree mode CI wants: the
+first run snapshots the current findings to PATH (exit 0 even when
+findings exist — they are now the accepted debt); later runs compare
+and exit 1 only on NEW findings, listing exactly those. Fixed findings
+are reported so the snapshot can be re-tightened with
+``--write-baseline``. Comparison keys are (pass, path, message) — line
+numbers drift with every edit and would make the snapshot useless.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import json
+import os
+import re
 import sys
 
 from orientdb_tpu.analysis import core
@@ -35,6 +47,15 @@ def main(argv=None) -> int:
         "--root", default=None,
         help="repo root to scan (default: this checkout)",
     )
+    p.add_argument(
+        "--baseline", metavar="PATH", default=None,
+        help="finding snapshot: written when PATH is missing, "
+        "compared otherwise (exit 1 only on NEW findings)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the --baseline snapshot from this run",
+    )
     args = p.parse_args(argv)
     core.load_passes()
     if args.list:
@@ -48,6 +69,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
     report = core.run(passes=args.passes, root=args.root)
+    if args.baseline:
+        return _baseline(report, args)
     if args.json:
         json.dump(report.to_dict(), sys.stdout, indent=1,
                   sort_keys=True)
@@ -65,6 +88,83 @@ def main(argv=None) -> int:
             f"({len(report.suppressed)} suppressed)"
         )
     return 0 if report.ok else 1
+
+
+_LINE_REF = re.compile(r"\bline \d+\b")
+
+
+def _key(d) -> tuple:
+    """(pass, path, message) with embedded line references blanked:
+    several passes anchor their prose to other lines ("acquired line
+    50"), which would drift on unrelated edits just like the excluded
+    line field."""
+    return (d["pass"], d["path"], _LINE_REF.sub("line ?", d["message"]))
+
+
+def _baseline(report: "core.Report", args) -> int:
+    cur = [f.to_dict() for f in report.findings]
+    if args.write_baseline or not os.path.exists(args.baseline):
+        from orientdb_tpu.storage.durability import atomic_write
+
+        atomic_write(
+            args.baseline,
+            json.dumps(
+                {"findings": cur}, indent=1, sort_keys=True
+            ).encode(),
+        )
+        if args.json:
+            json.dump(
+                {"written": True, "baselined": len(cur)},
+                sys.stdout, indent=1, sort_keys=True,
+            )
+            print()
+        else:
+            print(
+                f"baseline written: {len(cur)} finding(s) -> "
+                f"{args.baseline}"
+            )
+        return 0
+    with open(args.baseline) as f:
+        base = json.load(f).get("findings", [])
+    # multisets: two same-message findings in one file must not hide
+    # behind a single baselined one
+    have = collections.Counter(_key(d) for d in base)
+    new = []
+    for d in cur:
+        k = _key(d)
+        if have[k] > 0:
+            have[k] -= 1
+        else:
+            new.append(d)
+    fixed = sum(have.values())
+    if args.json:
+        json.dump(
+            {
+                "ok": not new,
+                "new": new,
+                "fixed": fixed,
+                "carried": len(cur) - len(new),
+                "baselined": len(base),
+            },
+            sys.stdout, indent=1, sort_keys=True,
+        )
+        print()
+        return 1 if new else 0
+    for d in new:
+        print(
+            f"NEW: {d['path']}:{d['line']}: [{d['pass']}] {d['message']}"
+        )
+    print(
+        f"baseline {args.baseline}: {len(new)} new, {fixed} fixed, "
+        f"{len(cur) - len(new)} carried "
+        f"({len(base)} baselined)"
+        + (
+            " — re-tighten with --write-baseline"
+            if fixed and not new
+            else ""
+        )
+    )
+    return 1 if new else 0
 
 
 if __name__ == "__main__":

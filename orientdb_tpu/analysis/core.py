@@ -205,6 +205,7 @@ def load_passes() -> None:
         iolint,
         locklint,
         promlint,
+        racelint,
         spanlint,
     )
 

@@ -33,8 +33,25 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 from orientdb_tpu.analysis.core import Finding, SourceTree, register
 from orientdb_tpu.chaos.iolint import IO_ATTRS, IO_NAMES
 
-#: package dirs whose locks participate (the concurrent subsystems)
-SCAN_DIRS = ("exec", "parallel", "server", "storage", "obs", "cdc")
+#: package dirs whose locks participate. Originally just the obviously
+#: concurrent subsystems; the runtime sanitizer's first cross-check
+#: showed dynamic lock edges through models/ (Database._lock), client/
+#: (FailoverDatabase), chaos/ and utils/ — locks the static graph had
+#: never seen — so every dir that defines or acquires a lock scans now.
+SCAN_DIRS = (
+    "api",
+    "cdc",
+    "chaos",
+    "client",
+    "exec",
+    "models",
+    "obs",
+    "parallel",
+    "server",
+    "storage",
+    "tools",
+    "utils",
+)
 
 _LOCKY = re.compile(r"lock", re.IGNORECASE)
 _MUTEX_NAMES = frozenset({"_mu", "mu"})

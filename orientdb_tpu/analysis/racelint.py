@@ -1,0 +1,320 @@
+"""racelint — static guard-consistency for shared attribute writes.
+
+locklint checks the ORDER locks are taken in; nothing checked that the
+state they guard is consistently guarded at all. The classic latent
+race in this codebase's shape is an attribute written under ``with
+self._lock`` in one method and rebound lock-free in another — both
+writes are correct in their author's mental model, and the torn state
+only shows up under production interleavings.
+
+The pass classifies every ``self.<attr>`` REBINDING site (``=``,
+``+=``, annotated assignment, ``del``) in **thread-crossing classes**
+by the locks lexically held at the write, using locklint's acquisition
+machinery (same lock recognition, same ``mod.Class.attr`` node ids).
+A class is thread-crossing when any of:
+
+- it acquires a ``self.<lock>`` anywhere (lock-guarded state — these
+  classes appear in locklint's lock graph);
+- it subclasses ``threading.Thread``;
+- one of its bound methods is used as a ``Thread(target=self.m)`` or
+  submitted to an executor (``pool.submit(self.m, ...)``).
+
+Findings, one per attribute:
+
+- **mixed-guard** — written under a lock at one site, lock-free at
+  another: the lock-free write can interleave with any guarded
+  read-modify-write;
+- **guard-inconsistent** — every write is guarded but no single lock
+  covers them all (two writers under *different* locks exclude
+  nobody).
+
+Deliberately NOT counted (precision over recall):
+
+- container mutation (``self.d[k] = v``, ``self.l.append(x)``) — the
+  pass is about attribute *rebinding*; interior mutation is a
+  different (and far noisier) analysis;
+- writes in ``__init__``/``__new__``/``__post_init__`` — construction
+  happens-before publication, no concurrent reader exists yet;
+- methods named ``*_locked`` — the codebase's documented convention
+  that the CALLER holds the lock (locklint already checks those call
+  sites are in fact under it).
+
+Suppress a deliberate site with ``# lint: allow(racelint)`` plus a
+one-line justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from orientdb_tpu.analysis.core import Finding, SourceTree, register
+from orientdb_tpu.analysis.locklint import SCAN_DIRS, _lock_name, _node_id
+
+#: construction-time methods: writes happen-before publication
+INIT_METHODS = frozenset({"__init__", "__new__", "__post_init__"})
+
+
+@dataclasses.dataclass(frozen=True)
+class WriteSite:
+    """One ``self.<attr>`` rebinding: where, in which method, and the
+    lock node ids lexically held at the write."""
+
+    path: str
+    line: int
+    method: str
+    guards: Tuple[str, ...]  # sorted lock node ids; () = lock-free
+
+
+class _ClassRecord:
+    __slots__ = ("modname", "name", "crossing", "sites")
+
+    def __init__(self, modname: str, name: str) -> None:
+        self.modname = modname
+        self.name = name
+        self.crossing: Optional[str] = None  # why it is thread-crossing
+        self.sites: Dict[str, List[WriteSite]] = {}
+
+
+def _self_attr(expr: ast.expr) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _is_thread_ctor(func: ast.expr) -> bool:
+    """``Thread(...)`` / ``threading.Thread(...)`` (any *Thread name —
+    the codebase subclasses as e.g. ReplicaPuller(threading.Thread))."""
+    if isinstance(func, ast.Name):
+        return func.id.endswith("Thread")
+    if isinstance(func, ast.Attribute):
+        return func.attr.endswith("Thread")
+    return False
+
+
+class _Walker:
+    """One module walk: records write sites + thread-crossing evidence
+    per class. Lock tracking mirrors locklint (lexical; nested def
+    bodies run later so they restart lock-free)."""
+
+    def __init__(self, path: str, modname: str) -> None:
+        self.path = path
+        self.modname = modname
+        self.classes: Dict[str, _ClassRecord] = {}
+
+    def record(self, name: str) -> _ClassRecord:
+        rec = self.classes.get(name)
+        if rec is None:
+            rec = self.classes[name] = _ClassRecord(self.modname, name)
+        return rec
+
+    def walk(
+        self,
+        node: ast.AST,
+        held: List[str],
+        classname: Optional[str],
+        method: Optional[str],
+        exempt: bool,
+    ) -> None:
+        if isinstance(node, ast.ClassDef):
+            rec = self.record(node.name)
+            for base in node.bases:
+                if (
+                    isinstance(base, ast.Name) and base.id == "Thread"
+                ) or (
+                    isinstance(base, ast.Attribute)
+                    and base.attr == "Thread"
+                ):
+                    rec.crossing = rec.crossing or "subclasses Thread"
+            for c in node.body:
+                self.walk(c, held, node.name, None, False)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = method or node.name  # attribute nested defs to the method
+            ex = exempt or (
+                method is None
+                and (
+                    node.name in INIT_METHODS
+                    or node.name.endswith("_locked")
+                )
+            )
+            for c in node.body:
+                self.walk(c, [], classname, name, ex)
+            return
+        if isinstance(node, ast.Lambda):
+            self.walk(node.body, [], classname, method, exempt)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                ce = item.context_expr
+                if _lock_name(ce) is not None:
+                    if _self_attr(ce) is not None and classname:
+                        self.record(classname).crossing = (
+                            self.classes[classname].crossing
+                            or f"guards state with self.{ce.attr}"
+                        )
+                    nid = _node_id(ce, self.modname, classname)
+                    if nid not in held and nid not in acquired:
+                        acquired.append(nid)
+                else:
+                    self.walk(
+                        ce, held + acquired, classname, method, exempt
+                    )
+                if item.optional_vars is not None:
+                    self.walk(
+                        item.optional_vars,
+                        held + acquired,
+                        classname,
+                        method,
+                        exempt,
+                    )
+            for stmt in node.body:
+                self.walk(stmt, held + acquired, classname, method, exempt)
+            return
+        if isinstance(node, ast.Call) and classname:
+            self._check_thread_use(node, classname)
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.AnnAssign):
+            # a bare annotation (`self.state: int`) declares a type and
+            # performs NO runtime store — only annotated assignments
+            # with a value rebind
+            if node.value is not None:
+                targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        for t in targets:
+            for el in ast.walk(t):
+                attr = _self_attr(el)
+                # only REBINDING of self.<attr>: a Subscript/Attribute
+                # store *through* it (self.d[k]=v, self.x.y=v) mutates
+                # the object, not the binding
+                if (
+                    attr is not None
+                    and isinstance(el.ctx, (ast.Store, ast.Del))
+                    and classname
+                    and method
+                    and not exempt
+                ):
+                    self.record(classname).sites.setdefault(
+                        attr, []
+                    ).append(
+                        WriteSite(
+                            self.path,
+                            el.lineno,
+                            method,
+                            tuple(sorted(set(held))),
+                        )
+                    )
+        for c in ast.iter_child_nodes(node):
+            self.walk(c, held, classname, method, exempt)
+
+    def _check_thread_use(self, call: ast.Call, classname: str) -> None:
+        """``Thread(target=self.m)`` / ``pool.submit(self.m, ...)``
+        inside the class marks it thread-crossing."""
+        rec_reason = None
+        if _is_thread_ctor(call.func):
+            for kw in call.keywords:
+                if kw.arg == "target" and _self_attr(kw.value):
+                    rec_reason = (
+                        f"runs self.{kw.value.attr} as a Thread target"
+                    )
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "submit"
+            and call.args
+            and _self_attr(call.args[0])
+        ):
+            rec_reason = (
+                f"submits self.{call.args[0].attr} to an executor"
+            )
+        if rec_reason:
+            rec = self.record(classname)
+            rec.crossing = rec.crossing or rec_reason
+
+
+def classify(tree: SourceTree) -> List[_ClassRecord]:
+    """Every class record over the scanned dirs (tests poke this)."""
+    out: List[_ClassRecord] = []
+    for m in tree.in_dirs(*SCAN_DIRS):
+        if m.tree is None:
+            continue
+        modname = m.path.rsplit("/", 1)[-1][:-3]
+        w = _Walker(m.path, modname)
+        w.walk(m.tree, [], None, None, False)
+        out.extend(w.classes.values())
+    return out
+
+
+@register(
+    "racelint",
+    "mixed-guard / guard-inconsistent self.<attr> writes in "
+    "thread-crossing classes",
+)
+def run_racelint(tree: SourceTree) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for rec in classify(tree):
+        if rec.crossing is None:
+            continue
+        for attr, sites in sorted(rec.sites.items()):
+            guarded = [s for s in sites if s.guards]
+            free = [s for s in sites if not s.guards]
+            if not guarded:
+                # never guarded anywhere: no stated guard expectation
+                # to be inconsistent with
+                continue
+            cname = f"{rec.modname}.{rec.name}"
+            if free:
+                g = guarded[0]
+                for site in free:
+                    findings.append(
+                        Finding(
+                            "racelint",
+                            site.path,
+                            site.line,
+                            f"mixed-guard write: {cname}.{attr} is "
+                            f"written under {g.guards[0]} in "
+                            f"{g.method}() (line {g.line}) but "
+                            f"lock-free here in {site.method}() — "
+                            f"{rec.crossing}; guard every write or "
+                            "allow() with a justification",
+                        )
+                    )
+                continue
+            # mutual exclusion of rebinding is PAIRWISE: two sites are
+            # only a race when their guard sets are disjoint (sites
+            # guarded {L1,L2} and {L2,L3} are serialized by L2 even
+            # though no single lock covers every site)
+            pair = next(
+                (
+                    (a, b)
+                    for i, a in enumerate(guarded)
+                    for b in guarded[i + 1:]
+                    if not (set(a.guards) & set(b.guards))
+                ),
+                None,
+            )
+            if pair is not None:
+                a, b = pair
+                findings.append(
+                    Finding(
+                        "racelint",
+                        b.path,
+                        b.line,
+                        f"guard-inconsistent write: {cname}.{attr} is "
+                        f"written under {a.guards[0]} in {a.method}() "
+                        f"(line {a.line}) but under {b.guards[0]} "
+                        f"here in {b.method}() — two locks exclude "
+                        "nobody; pick one guard",
+                    )
+                )
+    return findings

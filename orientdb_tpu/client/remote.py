@@ -117,7 +117,8 @@ class RemoteDatabase:
         from orientdb_tpu.chaos import fault
 
         with fault.point("bin.connect"):
-            self._sock = socket.create_connection(
+            # only reached from __init__, before the client is published
+            self._sock = socket.create_connection(  # lint: allow(racelint)
                 (self.host, self.port), timeout=30
             )
         resp = self._call({"op": "connect", "user": self._user, "password": self._password})
@@ -642,7 +643,8 @@ class FailoverDatabase:
         last: Optional[Exception] = None
         for i, (h, p) in enumerate(self._addrs):
             try:
-                self._db = RemoteDatabase(
+                # callers hold _lock (locked_attempt) or run pre-publication (__init__)
+                self._db = RemoteDatabase(  # lint: allow(racelint)
                     h, p, self._name, self._user, self._password,
                     serialization=self._serialization,
                     pipeline=self._pipeline,
@@ -691,7 +693,8 @@ class FailoverDatabase:
                     return method(self._db)
                 return getattr(self._db, method)(*a)
             except (RemoteConnectionError, OSError) as e:
-                self._db = None
+                # attempt() only runs under locked_attempt's self._lock
+                self._db = None  # lint: allow(racelint)
                 # demote the failed head so reconnection scans the OTHER
                 # members first (the dead host may hang, not refuse)
                 self._addrs = self._addrs[1:] + self._addrs[:1]

@@ -76,11 +76,15 @@ class Transaction:
         self.deleted: Dict[RID, Document] = {}
         #: (edge_doc, src_rid, dst_rid) — rids may be temporary
         self.edge_ops: List[Tuple[Edge, RID, RID]] = []
-        #: cross-owner sub-batches (parallel/twophase 2PC): owner-id →
-        #: {"owner", "ops", "created" {temp: (doc, op)}, "updated"
-        #: {ridstr: doc}} — ops for classes OTHER members own buffer
-        #: here and 2-phase-commit at their owners
-        self._foreign: Dict[int, Dict] = {}
+        #: cross-owner sub-batches (parallel/twophase 2PC): MEMBER
+        #: identity (forwarding.member_key) → {"owner", "ops",
+        #: "created" {temp: (doc, op)}, "updated" {ridstr: doc}} — ops
+        #: for classes OTHER members own buffer here and 2-phase-commit
+        #: at their owners. Keyed by member, not WriteOwner object id:
+        #: assign_class_owner mints one route object per class, and two
+        #: sub-batches of one txid landing at the SAME member collided
+        #: in TwoPhaseRegistry.prepare ("already prepared here")
+        self._foreign: Dict[str, Dict] = {}
         self._foreign_deleted: set = set()
         self.active = True
 
@@ -97,9 +101,12 @@ class Transaction:
         owner = self.db._owner_for(class_name)
         if owner is None:
             return None
-        batch = self._foreign.get(id(owner))
+        from orientdb_tpu.parallel.forwarding import member_key
+
+        key = member_key(owner)
+        batch = self._foreign.get(key)
         if batch is None:
-            batch = self._foreign[id(owner)] = {
+            batch = self._foreign[key] = {
                 "owner": owner,
                 "ops": [],
                 "created": {},

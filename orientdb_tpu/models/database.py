@@ -157,6 +157,13 @@ class Database:
         # path refuses them until the batch commits/aborts/expires.
         self._tx2pc_locks: Dict[RID, str] = {}
         self._tx2pc_registry = None
+        # Replication apply serialization (parallel/replication): push
+        # and pull applies to THIS database take it so a signal-stopped
+        # puller's in-flight pull can't race its replacement. A real
+        # attribute (not a lazy __dict__.setdefault at the acquire
+        # sites) so locklint's static graph and the runtime sanitizer
+        # agree on the lock's identity.
+        self._repl_lock = threading.Lock()
 
     # -- WAL ---------------------------------------------------------------
 

@@ -318,8 +318,7 @@ class Cluster:
         and silently diverge (its dedup floor skips the new primary's
         conflicting entries at the same LSNs)."""
         m.puller.request_stop()
-        lock = m.db.__dict__.setdefault("_repl_lock", threading.Lock())
-        with lock:
+        with m.db._repl_lock:
             return max(
                 m.puller.applied_lsn,
                 getattr(m.db, "_repl_applied_lsn", 0),

@@ -39,6 +39,15 @@ log = get_logger("forwarding")
 _2PC_RETRY = RetryPolicy(attempts=3, base_s=0.05, cap_s=0.5, budget_s=3.0)
 
 
+def member_key(owner) -> str:
+    """The MEMBER identity a route object points at. Per-class
+    assignment mints one WriteOwner per class, so anything grouping
+    work per member (2PC sub-batches in BOTH tx paths) must key on
+    this, never on the route object — two prepares of one txid at one
+    member collide in its registry."""
+    return f"{owner.base_url}/{owner.dbname}"
+
+
 class WriteOwner:
     """Forwarding target attached to a non-owner member's database
     (``db._write_owner``). Cleared on promotion."""
@@ -322,7 +331,7 @@ class ForwardedTransaction:
         owner = self.db._owner_for(class_name)
         if owner is None:
             return "local"
-        key = f"o{id(owner)}"
+        key = f"o:{member_key(owner)}"
         self._owners[key] = owner
         return key
 
@@ -531,8 +540,12 @@ class ForwardedTransaction:
         for op in self.ops:
             key = op.pop("@owner", None)
             if key is None:  # pre-tag op (defensive): default owner
-                key = "o%d" % id(self.db._write_owner)
-                self._owners[key] = self.db._write_owner
+                wo = self.db._write_owner
+                # wo may be None (cleared on promotion mid-tx): keep
+                # the key resolvable so the single-group path below
+                # raises its explicit "no write owner" TxErrorProxy
+                key = "o:none" if wo is None else f"o:{member_key(wo)}"
+                self._owners[key] = wo
             groups.setdefault(key, []).append(op)
         if len(groups) == 1:
             key, ops = next(iter(groups.items()))

@@ -98,10 +98,9 @@ def apply_pushed_entries(
     over applied state would lose writes) and stays puller territory."""
     from orientdb_tpu.obs.trace import span
 
-    dblock = db.__dict__.setdefault("_repl_lock", threading.Lock())
     with span(
         "replication.apply", source="push", entries=len(entries)
-    ), dblock:
+    ), db._repl_lock:
         if term is not None:
             cur = getattr(db, "_repl_term", 0)
             if term < cur:
@@ -557,8 +556,12 @@ class ReplicaPuller:
         # (possibly a push-side full sync) may have advanced the database
         # past this puller's last pull — requesting from the stale cursor
         # would refetch the range, or worse demand a second checkpoint a
-        # no-longer-fresh replica must refuse (ReplicationGap)
-        self.applied_lsn = max(self.applied_lsn, self._db_floor())
+        # no-longer-fresh replica must refuse (ReplicationGap). The sync
+        # stays a LOCAL until the apply lock is held below: rebinding
+        # applied_lsn here raced the request_stop apply barrier (a
+        # signal-stopped puller could bump the cursor AFTER the election
+        # sampled it).
+        cursor = max(self.applied_lsn, self._db_floor())
         cred = base64.b64encode(
             f"{self.user}:{self.password}".encode()
         ).decode()
@@ -572,7 +575,7 @@ class ReplicaPuller:
         )
         req = urllib.request.Request(
             f"{self.source_url}/replication/{self.dbname}/"
-            f"{self.applied_lsn}{exact}",
+            f"{cursor}{exact}",
             headers={"Authorization": f"Basic {cred}"},
         )
         # fault point only, no breaker: the pull loop IS the failure
@@ -589,18 +592,20 @@ class ReplicaPuller:
         # per-puller applied_lsn alone would double-apply the overlap
         from orientdb_tpu.obs.trace import span
 
-        dblock = self.db.__dict__.setdefault("_repl_lock", threading.Lock())
         with span(
             "replication.apply",
             source="pull",
             entries=len(payload.get("entries", ())),
-        ), self._lock, dblock:
+        ), self._lock, self.db._repl_lock:
             if self._stop.is_set():
                 # request_stop is an apply BARRIER: once the stopper has
                 # acquired this db's apply lock after setting the flag, no
                 # further entries can land from this puller — the cluster
                 # election relies on that to sample a settled applied LSN
                 return 0
+            # adopt the pre-fetch cursor sync now that the apply lock
+            # serializes it against the stop barrier
+            self.applied_lsn = max(self.applied_lsn, cursor)
             if "checkpoint" in payload and self.stream is not None:
                 # a NAMED stream consumer already holds the base state
                 # (it arrived via the primary stream): restoring the

@@ -32,6 +32,37 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from orientdb_tpu.analysis import sanitizer as _sanitizer  # noqa: E402
+
+# -- runtime lock-order sanitizer (analysis/sanitizer) -----------------------
+# TSan-lite over the concurrency-heavy suites: records per-thread lock
+# acquisition stacks, fails a test that exhibits a lock-order cycle
+# (both witness stacks printed), flags long holds, and cross-checks the
+# dynamic edges against locklint's static graph at session end.
+# ORIENTTPU_SANITIZER=0 disables it locally.
+
+# install the lock factories NOW, before any product module imports:
+# module-level locks (_TRACE_LOCK, registry singletons) must be
+# proxies for the dynamic graph to see them; recording stays off
+# outside the sanitized suites
+_sanitizer.plugin_configure()
+
+
+def pytest_runtest_setup(item):
+    _sanitizer.plugin_runtest_setup(item)
+
+
+def pytest_runtest_teardown(item):
+    _sanitizer.plugin_runtest_teardown(item)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _sanitizer.plugin_sessionfinish()
+
+
+def pytest_terminal_summary(terminalreporter):
+    _sanitizer.plugin_terminal_summary(terminalreporter)
+
 
 @pytest.fixture
 def db():

@@ -22,7 +22,12 @@ from orientdb_tpu.sql.parser import ParseError, parse
 
 class _FakeOwner:
     """Stands in for a WriteOwner: ops must buffer, never ship, before
-    commit — any wire call in these tests is a bug."""
+    commit — any wire call in these tests is a bug. The routing
+    identity attributes every real WriteOwner carries (sub-batches are
+    keyed by member, not object id) are data, not wire calls."""
+
+    base_url = "http://fake-owner:0"
+    dbname = "fake"
 
     def __getattr__(self, name):  # pragma: no cover - defensive
         raise AssertionError(f"unexpected owner call: {name}")

@@ -36,10 +36,10 @@ class TestTreeIsClean:
         assert rep.findings == [], "\n" + "\n".join(
             str(f) for f in rep.findings
         )
-        # all six passes actually ran
+        # all seven passes actually ran
         assert set(rep.counts) >= {
             "locklint", "configlint", "exceptlint",
-            "iolint", "spanlint", "promlint",
+            "iolint", "spanlint", "promlint", "racelint",
         }
 
 
@@ -225,6 +225,338 @@ class TestLocklintMutations:
         assert run_pass(
             "locklint", {"orientdb_tpu/exec/m.py": src}
         ) == []
+
+
+class TestRacelintMutations:
+    """The static half of race detection: guard-consistency for
+    self.<attr> rebinding in thread-crossing classes."""
+
+    _MIXED = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.state = 0\n"
+        "    def guarded(self):\n"
+        "        with self._lock:\n"
+        "            self.state = 1\n"
+        "    def unguarded(self):\n"
+        "        self.state = 2\n"
+    )
+
+    def test_mixed_guard_write_flags_at_the_lock_free_site(self):
+        fs = run_pass("racelint", {"orientdb_tpu/exec/m.py": self._MIXED})
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.pass_name == "racelint"
+        assert f.line == 10  # the LOCK-FREE write
+        assert "mixed-guard" in f.message
+        assert "m.S.state" in f.message
+        assert "m.S._lock" in f.message
+        assert "guarded()" in f.message and "unguarded()" in f.message
+
+    def test_guard_inconsistent_two_locks(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self._b_lock = threading.Lock()\n"
+            "        self.state = 0\n"
+            "    def f(self):\n"
+            "        with self._a_lock:\n"
+            "            self.state = 1\n"
+            "    def g(self):\n"
+            "        with self._b_lock:\n"
+            "            self.state = 2\n"
+        )
+        fs = run_pass("racelint", {"orientdb_tpu/parallel/m.py": src})
+        assert len(fs) == 1
+        assert "guard-inconsistent" in fs[0].message
+        assert "m.S._a_lock" in fs[0].message
+        assert "m.S._b_lock" in fs[0].message
+
+    def test_pairwise_overlapping_guards_are_clean(self):
+        """{L1,L2}, {L2,L3}, {L1,L3}: no single lock covers all three
+        sites, but every PAIR shares one — all writes are serialized,
+        so there is no race to report."""
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self._b_lock = threading.Lock()\n"
+            "        self._c_lock = threading.Lock()\n"
+            "        self.state = 0\n"
+            "    def f(self):\n"
+            "        with self._a_lock, self._b_lock:\n"
+            "            self.state = 1\n"
+            "    def g(self):\n"
+            "        with self._b_lock, self._c_lock:\n"
+            "            self.state = 2\n"
+            "    def h(self):\n"
+            "        with self._a_lock, self._c_lock:\n"
+            "            self.state = 3\n"
+        )
+        assert run_pass(
+            "racelint", {"orientdb_tpu/parallel/m.py": src}
+        ) == []
+
+    def test_init_writes_are_exempt(self):
+        """Construction happens-before publication: __init__'s
+        lock-free writes never count against the guarded ones."""
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = 0\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.state = 1\n"
+        )
+        assert run_pass(
+            "racelint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_locked_suffix_methods_are_exempt(self):
+        """*_locked methods document 'caller holds the lock'."""
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = 0\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self._f_locked()\n"
+            "    def _f_locked(self):\n"
+            "        self.state = 1\n"
+            "    def g(self):\n"
+            "        with self._lock:\n"
+            "            self.state = 2\n"
+        )
+        assert run_pass(
+            "racelint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_non_thread_crossing_class_is_clean(self):
+        """No self-lock, no Thread subclass/target/submit: single-
+        threaded staging objects stay out of scope."""
+        src = (
+            "class Loader:\n"
+            "    def __init__(self, db):\n"
+            "        self.db = db\n"
+            "        self.items = []\n"
+            "    def flush(self):\n"
+            "        with self.db._lock:\n"
+            "            self.items = []\n"
+            "    def reset(self):\n"
+            "        self.items = []\n"
+        )
+        assert run_pass(
+            "racelint", {"orientdb_tpu/storage/m.py": src}
+        ) == []
+
+    def test_thread_target_marks_the_class_crossing(self):
+        """A class whose method runs as a Thread target is checked
+        even without a self-lock (guards can be module-level)."""
+        src = (
+            "import threading\n"
+            "_mod_lock = threading.Lock()\n"
+            "class Pump:\n"
+            "    def __init__(self):\n"
+            "        self.running = False\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        with _mod_lock:\n"
+            "            self.running = True\n"
+            "    def stop(self):\n"
+            "        self.running = False\n"
+        )
+        fs = run_pass("racelint", {"orientdb_tpu/cdc/m.py": src})
+        assert len(fs) == 1
+        assert "m.Pump.running" in fs[0].message
+        assert "Thread target" in fs[0].message
+
+    def test_executor_submit_marks_the_class_crossing(self):
+        src = (
+            "import threading\n"
+            "_mod_lock = threading.Lock()\n"
+            "class Job:\n"
+            "    def __init__(self, pool):\n"
+            "        self.pool = pool\n"
+            "        self.done = False\n"
+            "    def kick(self):\n"
+            "        self.pool.submit(self._work)\n"
+            "    def _work(self):\n"
+            "        with _mod_lock:\n"
+            "            self.done = True\n"
+            "    def reset(self):\n"
+            "        self.done = False\n"
+        )
+        fs = run_pass("racelint", {"orientdb_tpu/server/m.py": src})
+        assert len(fs) == 1
+        assert "executor" in fs[0].message
+
+    def test_bare_annotation_is_not_a_write(self):
+        """`self.state: int` declares a type — no runtime store, no
+        mixed-guard finding."""
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = 0\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.state = 1\n"
+            "    def g(self):\n"
+            "        self.state: int\n"
+            "    def h(self):\n"
+            "        with self._lock:\n"
+            "            self.state: int = 2\n"
+        )
+        assert run_pass(
+            "racelint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_container_mutation_does_not_count(self):
+        """self.d[k] = v mutates the dict, not the binding — out of
+        scope by design (rebinding races only)."""
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.d = {}\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.d = {}\n"
+            "    def g(self, k, v):\n"
+            "        self.d[k] = v\n"
+        )
+        assert run_pass(
+            "racelint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_suppression_with_justification_silences(self):
+        src = self._MIXED.replace(
+            "        self.state = 2\n",
+            "        self.state = 2  # lint: allow(racelint)\n",
+        )
+        tree = SourceTree.from_sources({"orientdb_tpu/exec/m.py": src})
+        rep = core.run(tree=tree, passes=["racelint"])
+        assert rep.findings == []
+        assert len(rep.suppressed) == 1
+
+
+class TestCliBaseline:
+    """--baseline: snapshot findings, fail only on NEW ones."""
+
+    def _tree(self, tmp_path, extra=""):
+        d = tmp_path / "orientdb_tpu" / "exec"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "m.py").write_text(
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(1)\n" + extra
+        )
+        return str(tmp_path)
+
+    def _main(self, *argv):
+        from orientdb_tpu.analysis.__main__ import main
+
+        return main(list(argv))
+
+    def test_write_then_clean_compare_then_new_finding(
+        self, tmp_path, capsys
+    ):
+        root = self._tree(tmp_path)
+        snap = str(tmp_path / "snap.json")
+        args = ("--root", root, "--pass", "locklint", "--baseline", snap)
+        assert self._main(*args) == 0  # first run writes
+        assert "baseline written" in capsys.readouterr().out
+        assert self._main(*args) == 0  # same tree: carried, no new
+        out = capsys.readouterr().out
+        assert "0 new" in out
+        root = self._tree(
+            tmp_path,
+            "def g(sock, data):\n"
+            "    with _lock:\n"
+            "        sock.sendall(data)\n",
+        )
+        assert self._main(*args) == 1  # NEW finding → fail
+        out = capsys.readouterr().out
+        assert "NEW:" in out and "sendall" in out
+        # --write-baseline adopts, then compares clean again
+        assert self._main(*args, "--write-baseline") == 0
+        capsys.readouterr()
+        assert self._main(*args) == 0
+
+    def test_unrelated_edits_do_not_resurface_baselined_debt(
+        self, tmp_path, capsys
+    ):
+        """Messages embed OTHER lines' numbers ("acquired line N");
+        the comparison key must blank them or an inserted import above
+        a baselined finding reports it as NEW."""
+        root = self._tree(tmp_path)
+        snap = str(tmp_path / "snap.json")
+        args = ("--root", root, "--pass", "locklint", "--baseline", snap)
+        assert self._main(*args) == 0  # adopt the sleep-under-lock
+        capsys.readouterr()
+        # shift every line down: the finding and its "acquired line"
+        # reference both move, the debt itself is unchanged
+        m = tmp_path / "orientdb_tpu" / "exec" / "m.py"
+        m.write_text("import os  # noqa: shifts lines\n" + m.read_text())
+        assert self._main(*args) == 0
+        out = capsys.readouterr().out
+        assert "0 new" in out and "0 fixed" in out
+
+    def test_json_composes_with_baseline(self, tmp_path, capsys):
+        """--json --baseline emits a machine-readable comparison (a CI
+        piping stdout to json.load must not get the prose lines)."""
+        root = self._tree(tmp_path)
+        snap = str(tmp_path / "snap.json")
+        args = (
+            "--root", root, "--pass", "locklint",
+            "--baseline", snap, "--json",
+        )
+        assert self._main(*args) == 0  # write
+        doc = json.loads(capsys.readouterr().out)
+        assert doc == {"written": True, "baselined": 1}
+        assert self._main(*args) == 0  # compare
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ok"] is True and doc["new"] == []
+        assert doc["carried"] == 1 and doc["baselined"] == 1
+        self._tree(
+            tmp_path,
+            "def g(sock, data):\n"
+            "    with _lock:\n"
+            "        sock.sendall(data)\n",
+        )
+        assert self._main(*args) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ok"] is False and len(doc["new"]) == 1
+        assert doc["new"][0]["pass"] == "locklint"
+
+    def test_fixed_findings_reported(self, tmp_path, capsys):
+        root = self._tree(
+            tmp_path,
+            "def g(sock, data):\n"
+            "    with _lock:\n"
+            "        sock.sendall(data)\n",
+        )
+        snap = str(tmp_path / "snap.json")
+        args = ("--root", root, "--pass", "locklint", "--baseline", snap)
+        assert self._main(*args) == 0
+        self._tree(tmp_path)  # rewrite without g(): one finding fixed
+        assert self._main(*args) == 0
+        out = capsys.readouterr().out
+        assert "1 fixed" in out and "--write-baseline" in out
 
 
 _MINI_CONFIG = (
@@ -508,7 +840,7 @@ class TestCli:
         assert doc["findings"] == []
         for name in (
             "locklint", "configlint", "exceptlint",
-            "iolint", "spanlint", "promlint",
+            "iolint", "spanlint", "promlint", "racelint",
         ):
             assert doc["counts"][name] == 0
 

@@ -447,3 +447,59 @@ class TestInDoubt:
         assert wait_for(
             lambda: count_or_zero(cl.members["n1"].db, "Q") == 1
         )
+
+
+class TestSameOwnerSubBatches:
+    """PR-3 known limit, fixed: two per-class routes to ONE member must
+    merge into one sub-batch before prepare — keyed by object id they
+    collided in TwoPhaseRegistry.prepare ("already prepared here")."""
+
+    def test_two_classes_one_owner_commit_from_primary(self, duo):
+        """Q and Q2 are both n1's; a primary tx writing P + Q + Q2 used
+        to ship TWO prepares of one txid at n1 and abort."""
+        cl, servers, pdb = duo
+        cl.assign_class_owner("Q2", "n1")
+        n1db = cl.members["n1"].db
+        assert wait_for(lambda: n1db.schema.exists_class("Q2"))
+        pdb.begin()
+        pdb.new_vertex("P", uid=20)
+        q = pdb.new_vertex("Q", uid=21)
+        q2 = pdb.new_vertex("Q2", uid=22)
+        pdb.commit()
+        assert q.rid.is_persistent and q2.rid.is_persistent
+        assert wait_for(
+            lambda: all(
+                count_or_zero(m.db, "Q") == 1
+                and count_or_zero(m.db, "Q2") == 1
+                for m in cl.members.values()
+            )
+        ), {
+            m.name: (count_or_zero(m.db, "Q"), count_or_zero(m.db, "Q2"))
+            for m in cl.members.values()
+        }
+
+    def test_two_classes_one_owner_commit_from_replica(self, duo):
+        """Same shape through the ForwardedTransaction path (a replica
+        coordinating): both foreign groups land at n1 as ONE batch."""
+        cl, servers, pdb = duo
+        cl.assign_class_owner("Q2", "n1")
+        n2db = cl.members["n2"].db
+        assert wait_for(lambda: n2db.schema.exists_class("Q2"))
+        n2db.begin()
+        q = n2db.new_vertex("Q", uid=31)
+        q2 = n2db.new_vertex("Q2", uid=32)
+        p = n2db.new_vertex("P", uid=33)
+        n2db.commit()
+        assert q.rid.is_persistent and q2.rid.is_persistent
+        assert p.rid.is_persistent
+        assert wait_for(
+            lambda: all(
+                count_or_zero(m.db, "Q") == 1
+                and count_or_zero(m.db, "Q2") == 1
+                and count_or_zero(m.db, "P") == 1
+                for m in cl.members.values()
+            )
+        ), {
+            m.name: (count_or_zero(m.db, "Q"), count_or_zero(m.db, "Q2"))
+            for m in cl.members.values()
+        }
