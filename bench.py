@@ -464,6 +464,38 @@ def _read_sanitizer_edges():
         return None
 
 
+def _read_deviceguard():
+    """The transfer/compile guard's session dump (DEVICEGUARD.json,
+    written by the tier-1 pytest plugin at session end), summarized for
+    the static_analysis evidence record: transfers blocked, same-shape
+    re-records, recompile assertions passed, and the observed-vs-
+    jaxlint static-coverage ratio. None when no guarded session has
+    run here."""
+    try:
+        from orientdb_tpu.analysis.deviceguard import dump_path
+
+        p = dump_path()
+        if p is None or not os.path.exists(p):
+            return None
+        with open(p) as f:
+            doc = json.load(f)
+        return {
+            "mode": doc.get("mode"),
+            "tests_guarded": doc.get("tests_guarded", 0),
+            "transfers_blocked": len(doc.get("transfers", ())),
+            "rerecords": len(doc.get("rerecords", ())),
+            "recompile_assertions": doc.get("recompile_assertions", 0),
+            "static_coverage": doc.get("cross_check", {}).get("coverage"),
+            "counters": doc.get("counters", {}),
+            # freshness: a disabled/subset session leaves the old dump
+            # in place — readers must see this round's evidence apart
+            # from a stale one (the sanitizer-dump convention)
+            "age_s": round(time.time() - os.path.getmtime(p), 1),
+        }
+    except Exception:  # pragma: no cover - evidence is best-effort
+        return None
+
+
 def _round_stamp() -> int:
     """THIS run's round number: one past the newest driver record
     (BENCH_r{N}.json) in the repo root. Stamps the detail file so a
@@ -694,6 +726,14 @@ def main() -> None:
                 extras["static_analysis"]["dyn_edge_coverage"] = (
                     _san.get("cross_check", {}).get("coverage")
                 )
+            # deviceguard: the jax-boundary twin of the sanitizer dump —
+            # transfers blocked, recompile assertions, and the observed-
+            # vs-jaxlint coverage ratio ride the same evidence record
+            _dg = _read_deviceguard()
+            if _dg is not None:
+                extras["static_analysis"]["deviceguard_coverage"] = (
+                    _dg.get("static_coverage")
+                )
             ev(
                 "static_analysis",
                 ok=_rep.ok,
@@ -701,7 +741,9 @@ def main() -> None:
                 findings=len(_rep.findings),
                 suppressed=len(_rep.suppressed),
                 racelint=_rep.counts.get("racelint", 0),
+                jaxlint=_rep.counts.get("jaxlint", 0),
                 sanitizer=_san,
+                deviceguard=_dg,
             )
         except Exception as e:
             # the bench must still measure when the analysis can't run

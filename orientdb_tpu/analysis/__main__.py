@@ -40,8 +40,9 @@ def main(argv=None) -> int:
         help="list registered passes and exit",
     )
     p.add_argument(
-        "--pass", dest="passes", action="append", metavar="NAME",
-        help="run only this pass (repeatable; default: all)",
+        "--pass", dest="passes", action="append", metavar="NAME[,NAME]",
+        help="run only these passes (repeatable and/or "
+        "comma-separated; default: all)",
     )
     p.add_argument(
         "--root", default=None,
@@ -60,9 +61,16 @@ def main(argv=None) -> int:
     core.load_passes()
     if args.list:
         for name in sorted(core.PASSES):
-            print(f"{name:12s} {core.PASSES[name].title}")
+            print(f"{name:12s} {pass_description(name)}")
         return 0
     if args.passes:
+        # `--pass a,b --pass c` and `--pass a --pass b` are the same
+        args.passes = [
+            n.strip()
+            for chunk in args.passes
+            for n in chunk.split(",")
+            if n.strip()
+        ]
         unknown = [n for n in args.passes if n not in core.PASSES]
         if unknown:
             print(f"unknown pass(es): {', '.join(unknown)}",
@@ -88,6 +96,23 @@ def main(argv=None) -> int:
             f"({len(report.suppressed)} suppressed)"
         )
     return 0 if report.ok else 1
+
+
+def pass_description(name: str) -> str:
+    """One-line description of a registered pass, pulled from its
+    module docstring (the source of truth a reader lands on) — every
+    pass module must carry one (tier-1 asserts it)."""
+    import importlib
+    import sys as _sys
+
+    fn = core.PASSES[name].fn
+    mod = _sys.modules.get(fn.__module__) or importlib.import_module(
+        fn.__module__
+    )
+    doc = (mod.__doc__ or "").strip()
+    if doc:
+        return doc.splitlines()[0].strip()
+    return core.PASSES[name].title
 
 
 _LINE_REF = re.compile(r"\bline \d+\b")

@@ -203,6 +203,7 @@ def load_passes() -> None:
         configlint,
         exceptlint,
         iolint,
+        jaxlint,
         locklint,
         promlint,
         racelint,

@@ -17,11 +17,20 @@ Lockdep-style discipline for a codebase whose locks are plain
 Lock recognition is lexical: a ``with`` context expression whose name
 or attribute contains ``lock`` (any case) or is ``_mu``/``mu``.
 Graph nodes are qualified as ``<module>.<Class>.<attr>`` for
-``self.<attr>``, ``*.<attr>`` for other attribute locks (one node per
-attribute name — cross-object order still holds), and
-``<module>.<name>`` for bare names. The analysis is intra-procedural
-and lexical: nested ``def``/``lambda`` bodies run later, not under
-the enclosing lock, so they restart with an empty hold-stack.
+``self.<attr>``; locks reached through a *typed* receiver resolve to
+the receiver's class via annotations (``m.db._repl_lock`` with
+``m: ClusterMember`` storing a ``db: Database`` parameter →
+``database.Database._repl_lock`` — the PR 7 sanitizer cross-check
+proved the ``*.attr`` wildcard hid a real ``Cluster._lock ->
+Database._repl_lock`` edge behind an unrelated holder); untyped
+attribute locks stay ``*.<attr>`` (one node per attribute name —
+cross-object order still holds), and bare names are
+``<module>.<name>``. The analysis is lexical with ONE call-closure
+extension: a ``self.<method>()`` call made while a lock is held walks
+that same-class method's body under the held stack (the
+``_promote_locked``-style convention means real acquisitions hide one
+call deep); nested ``def``/``lambda`` bodies run later, not under the
+enclosing lock, so they restart with an empty hold-stack.
 """
 
 from __future__ import annotations
@@ -31,6 +40,7 @@ import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from orientdb_tpu.analysis.core import Finding, SourceTree, register
+from orientdb_tpu.analysis.typeres import TypeTable
 from orientdb_tpu.chaos.iolint import IO_ATTRS, IO_NAMES
 
 #: package dirs whose locks participate. Originally just the obviously
@@ -81,11 +91,26 @@ def _lock_name(expr: ast.expr) -> Optional[str]:
     return None
 
 
-def _node_id(expr: ast.expr, modname: str, classname: Optional[str]) -> str:
+def _node_id(
+    expr: ast.expr,
+    modname: str,
+    classname: Optional[str],
+    types: Optional[TypeTable] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> str:
     if isinstance(expr, ast.Attribute):
         base = expr.value
         if isinstance(base, ast.Name) and base.id == "self" and classname:
             return f"{modname}.{classname}.{expr.attr}"
+        if types is not None:
+            # typed receiver: m.db._repl_lock with m: ClusterMember →
+            # database.Database._repl_lock, same namespace the runtime
+            # sanitizer names locks in
+            owner = types.resolve(base, classname, env or {})
+            if owner is not None:
+                qid = types.qualify(owner, expr.attr)
+                if qid is not None:
+                    return qid
         return f"*.{expr.attr}"
     assert isinstance(expr, ast.Name)
     return f"{modname}.{expr.id}"
@@ -101,32 +126,89 @@ def _blocking_callee(call: ast.Call) -> Optional[str]:
 
 
 class _Walker:
-    def __init__(self, path: str, modname: str) -> None:
+    def __init__(
+        self,
+        path: str,
+        modname: str,
+        types: Optional[TypeTable] = None,
+    ) -> None:
         self.path = path
         self.modname = modname
+        self.types = types
         self.edges: LockEdges = {}
         self.findings: List[Finding] = []
+        self._finding_keys: Set[Tuple[int, str]] = set()
+        #: (classname, method name) -> def node, for the held-lock
+        #: call closure (self.<m>() under a lock walks m's body)
+        self.methods: Dict[Tuple[str, str], ast.AST] = {}
+        self._visiting: Set[Tuple[str, str, frozenset]] = set()
+
+    def index_methods(self, tree_mod: ast.Module) -> None:
+        for node in tree_mod.body:
+            if isinstance(node, ast.ClassDef):
+                for c in node.body:
+                    if isinstance(
+                        c, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self.methods.setdefault((node.name, c.name), c)
+
+    def _fresh_env(self, fn: ast.AST) -> Dict[str, str]:
+        if self.types is None:
+            return {}
+        return self.types.local_env(fn)
+
+    def _blocking_finding(self, node: ast.Call, held) -> None:
+        callee = _blocking_callee(node)
+        if callee is None:
+            return
+        lock, lline = held[-1]
+        key = (node.lineno, callee)
+        if key in self._finding_keys:
+            return  # one finding per site (closure can revisit)
+        self._finding_keys.add(key)
+        self.findings.append(
+            Finding(
+                "locklint", self.path, node.lineno,
+                f"blocking call {callee}() while holding "
+                f"{lock} (acquired line {lline}) — move the "
+                "wait outside the critical section",
+            )
+        )
 
     def walk(self, node: ast.AST, held: List[Tuple[str, int]],
-             classname: Optional[str]) -> None:
+             classname: Optional[str],
+             env: Optional[Dict[str, str]] = None) -> None:
+        env = {} if env is None else env
         if isinstance(node, ast.ClassDef):
             for c in node.body:
-                self.walk(c, held, node.name)
+                self.walk(c, held, node.name, env)
             return
         if isinstance(
             node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
         ):
             # a nested def's body runs later, not under the lock
             body = node.body if isinstance(node.body, list) else [node.body]
+            fenv = self._fresh_env(node)
             for c in body:
-                self.walk(c, [], classname)
+                self.walk(c, [], classname, fenv)
             return
+        if isinstance(node, ast.Assign) and self.types is not None:
+            # track typed locals as they bind (lexical order):
+            # `live = self.members[old]` stays unknown, but
+            # `m = ClusterMember(...)` / `db = self.db` resolve
+            t = self.types.resolve(node.value, classname, env)
+            if t is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        env[tgt.id] = t
         if isinstance(node, (ast.With, ast.AsyncWith)):
             acquired: List[Tuple[str, int]] = []
             for item in node.items:
                 ce = item.context_expr
                 if _lock_name(ce) is not None:
-                    nid = _node_id(ce, self.modname, classname)
+                    nid = _node_id(
+                        ce, self.modname, classname, self.types, env
+                    )
                     for h, _hl in held + acquired:
                         if h != nid:  # reentrant re-acquire is legal
                             self.edges.setdefault(
@@ -138,28 +220,46 @@ class _Walker:
                     # AFTER earlier items acquired — e.g.
                     # `with self._lock, urlopen(u):` blocks under
                     # the lock
-                    self.walk(ce, held + acquired, classname)
+                    self.walk(ce, held + acquired, classname, env)
                 if item.optional_vars is not None:
                     self.walk(
-                        item.optional_vars, held + acquired, classname
+                        item.optional_vars, held + acquired, classname, env
                     )
             for stmt in node.body:
-                self.walk(stmt, held + acquired, classname)
+                self.walk(stmt, held + acquired, classname, env)
             return
         if isinstance(node, ast.Call) and held:
-            callee = _blocking_callee(node)
-            if callee is not None:
-                lock, lline = held[-1]
-                self.findings.append(
-                    Finding(
-                        "locklint", self.path, node.lineno,
-                        f"blocking call {callee}() while holding "
-                        f"{lock} (acquired line {lline}) — move the "
-                        "wait outside the critical section",
-                    )
-                )
+            self._blocking_finding(node, held)
+            self._follow_self_call(node, held, classname)
         for c in ast.iter_child_nodes(node):
-            self.walk(c, held, classname)
+            self.walk(c, held, classname, env)
+
+    def _follow_self_call(
+        self, node: ast.Call, held, classname: Optional[str]
+    ) -> None:
+        """``self.m()`` while locks are held: the acquisitions inside
+        ``m`` happen under those locks at runtime — walk its body with
+        the current hold stack (``_elect`` under ``Cluster._lock``
+        reaching ``_settled_lsn``'s ``m.db._repl_lock`` is the edge
+        the sanitizer proved the lexical walk missed)."""
+        f = node.func
+        if not (
+            classname
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+        ):
+            return
+        target = self.methods.get((classname, f.attr))
+        if target is None:
+            return
+        key = (classname, f.attr, frozenset(h for h, _l in held))
+        if key in self._visiting:
+            return  # recursion / already walked under this hold set
+        self._visiting.add(key)
+        env = self._fresh_env(target)  # ONE env: typed locals bound in
+        for stmt in target.body:       # one stmt must reach the next
+            self.walk(stmt, list(held), classname, env)
 
 
 def lock_graph(tree: SourceTree) -> Tuple[LockEdges, List[Finding]]:
@@ -167,11 +267,13 @@ def lock_graph(tree: SourceTree) -> Tuple[LockEdges, List[Finding]]:
     (edges, blocking-call findings)."""
     edges: LockEdges = {}
     findings: List[Finding] = []
+    types = TypeTable.build(tree)
     for m in tree.in_dirs(*SCAN_DIRS):
         if m.tree is None:
             continue
         modname = m.path.rsplit("/", 1)[-1][:-3]
-        w = _Walker(m.path, modname)
+        w = _Walker(m.path, modname, types)
+        w.index_methods(m.tree)
         w.walk(m.tree, [], None)
         for k, v in w.edges.items():
             edges.setdefault(k, v)

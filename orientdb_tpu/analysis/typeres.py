@@ -1,0 +1,177 @@
+"""typeres — lightweight nominal type resolution from annotations.
+
+Static passes keep hitting the same wall: an attribute reached through
+a *non-self* receiver (``m.db._repl_lock``, ``solver.solve_table()``)
+is anonymous to a purely lexical matcher, so locklint collapsed every
+such lock to a ``*.attr`` wildcard and the PR 7 sanitizer cross-check
+duly reported the real dynamic edge ``Cluster._lock ->
+Database._repl_lock`` as a gap. The codebase, however, annotates its
+plumbing: ``def _settled_lsn(self, m: ClusterMember)`` and
+``ClusterMember.__init__(self, ..., db: Database)`` carry everything
+needed to resolve ``m.db`` to ``models/database.Database``.
+
+This module is that resolver, shared by locklint (typed lock
+receivers) and jaxlint (typed receivers extending a traced region's
+same-module call closure). It is deliberately nominal and best-effort:
+
+- class attribute types come from class-body annotations and from
+  ``__init__`` storing an annotated parameter (``self.db = db``);
+- local types come from parameter annotations, ``x = ClassName(...)``
+  constructor calls of known classes, and ``x = self.<typed attr>``;
+- ``Optional[T]`` / string annotations unwrap to ``T``.
+
+Anything it cannot resolve returns None and callers keep their
+wildcard fallback — unresolved is never wrong, only less precise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from orientdb_tpu.analysis.core import SourceTree
+
+
+def _ann_name(a: Optional[ast.expr]) -> Optional[str]:
+    """The class name an annotation denotes, or None (builtins and
+    generics other than Optional are not class references we track)."""
+    if a is None:
+        return None
+    if isinstance(a, ast.Name):
+        return a.id
+    if isinstance(a, ast.Attribute):
+        return a.attr
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        # forward reference: "Database" / "Optional[Database]"
+        inner = a.value.strip()
+        if inner.startswith("Optional[") and inner.endswith("]"):
+            inner = inner[len("Optional[") : -1]
+        return inner.rsplit(".", 1)[-1] or None
+    if isinstance(a, ast.Subscript):
+        head = _ann_name(a.value)
+        if head == "Optional":
+            return _ann_name(a.slice)
+    return None
+
+
+class TypeTable:
+    """Nominal class/attribute type facts for one :class:`SourceTree`."""
+
+    def __init__(self) -> None:
+        #: class name -> module stem (file name without .py)
+        self.class_module: Dict[str, str] = {}
+        #: class name -> {attr: class name}
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+
+    @classmethod
+    def build(cls, tree: SourceTree) -> "TypeTable":
+        tt = cls()
+        for m in tree.modules:
+            if m.tree is None:
+                continue
+            modname = m.path.rsplit("/", 1)[-1][:-3]
+            for node in m.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    tt._add_class(node, modname)
+        return tt
+
+    def _add_class(self, node: ast.ClassDef, modname: str) -> None:
+        # first definition wins: class names are unique enough in this
+        # package, and a stable choice beats an order-dependent one
+        self.class_module.setdefault(node.name, modname)
+        attrs = self.attr_types.setdefault(node.name, {})
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                t = _ann_name(stmt.annotation)
+                if t is not None:
+                    attrs.setdefault(stmt.target.id, t)
+            elif isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__":
+                params = {
+                    a.arg: _ann_name(a.annotation)
+                    for a in stmt.args.args + stmt.args.kwonlyargs
+                }
+                for s in ast.walk(stmt):
+                    target: Optional[ast.expr] = None
+                    value: Optional[ast.expr] = None
+                    if isinstance(s, ast.Assign) and len(s.targets) == 1:
+                        target, value = s.targets[0], s.value
+                    elif isinstance(s, ast.AnnAssign):
+                        target, value = s.target, s.value
+                        ann = _ann_name(s.annotation)
+                        if (
+                            ann is not None
+                            and _is_self_attr(target)
+                        ):
+                            attrs.setdefault(target.attr, ann)
+                            continue
+                    if (
+                        target is not None
+                        and _is_self_attr(target)
+                        and isinstance(value, ast.Name)
+                    ):
+                        t = params.get(value.id)
+                        if t is not None:
+                            attrs.setdefault(target.attr, t)
+
+    # -- resolution ----------------------------------------------------------
+
+    def qualify(self, classname: str, attr: str) -> Optional[str]:
+        """``<module>.<Class>.<attr>`` for a known class, else None."""
+        mod = self.class_module.get(classname)
+        if mod is None:
+            return None
+        return f"{mod}.{classname}.{attr}"
+
+    def resolve(
+        self,
+        expr: ast.expr,
+        classname: Optional[str],
+        env: Dict[str, str],
+    ) -> Optional[str]:
+        """The class name ``expr`` evaluates to, given the enclosing
+        class (for ``self``) and a local name→class environment."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return classname
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve(expr.value, classname, env)
+            if base is None:
+                return None
+            return self.attr_types.get(base, {}).get(expr.attr)
+        if isinstance(expr, ast.Call):
+            # ClassName(...) constructor of a known class
+            f = expr.func
+            name = (
+                f.id
+                if isinstance(f, ast.Name)
+                else f.attr
+                if isinstance(f, ast.Attribute)
+                else None
+            )
+            if name in self.class_module:
+                return name
+        return None
+
+    def local_env(self, fn: ast.AST) -> Dict[str, str]:
+        """Seed a function's name→class environment from its annotated
+        parameters (callers extend it as assignments resolve)."""
+        env: Dict[str, str] = {}
+        args = getattr(fn, "args", None)
+        if args is None:
+            return env
+        for a in list(args.args) + list(args.kwonlyargs):
+            t = _ann_name(a.annotation)
+            if t is not None and t in self.class_module:
+                env[a.arg] = t
+        return env
+
+
+def _is_self_attr(expr: ast.expr) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    )

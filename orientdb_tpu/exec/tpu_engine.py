@@ -244,7 +244,10 @@ def _cap_of(n: int) -> int:
     re-record."""
     if n <= 0:
         return K.bucket(0)
-    return K.bucket(max(1, int(n * config.schedule_headroom)))
+    # deliberate trace-time read: capacities are frozen per RECORDED
+    # plan by design — retuning the headroom applies to the next
+    # (re-)recording, never to a live executable
+    return K.bucket(max(1, int(n * config.schedule_headroom)))  # lint: allow(jaxlint)
 
 
 def _observe_compact(sched: "SizeSchedule", mask, min_capacity: int = 0):
@@ -2564,6 +2567,11 @@ class _CompiledPlan(_AotWarmup):
             and self.width >= 2  # meta row needs [count, overflow] slots
             and 4 * self.width * self.ncols <= config.result_direct_bytes
         )
+        #: page-ladder HBM budget, frozen per plan at construction —
+        #: reading config inside _replay would bake it at trace time
+        #: invisibly (jaxlint); freezing here makes the staleness
+        #: boundary explicit: retuning applies from the next recording
+        self.page_budget_bytes = int(config.result_page_budget_bytes)
         #: dynamic parameters the compiled predicates actually read
         self.dyn_spec = dict(solver.param_box.used)
         #: index-seeded root capacities (alias → padded length)
@@ -2642,9 +2650,11 @@ class _CompiledPlan(_AotWarmup):
 
     @staticmethod
     def _page_fn(B: int, n: int, fits16: bool):
+        # both callers memoize the result in _group_page_fns keyed
+        # (B, n, fits16) — the construction itself never serves a batch
         if fits16:
-            return jax.jit(lambda d: d[:B, :, :n].astype(jnp.int16))
-        return jax.jit(lambda d: d[:B, :, :n])
+            return jax.jit(lambda d: d[:B, :, :n].astype(jnp.int16))  # lint: allow(jaxlint)
+        return jax.jit(lambda d: d[:B, :, :n])  # lint: allow(jaxlint)
 
     def _compile_page_async(self, key, data_dev) -> None:
         """Background trace+compile of one (B, n, fits16) page fn —
@@ -2760,7 +2770,7 @@ class _CompiledPlan(_AotWarmup):
         # behind device compute in the interleaved fetch anyway.
         C = int(data.shape[0])
         pages32, pages16 = [], []
-        if 12 * width * C <= config.result_page_budget_bytes:
+        if 12 * width * C <= self.page_budget_bytes:
             p = _PAGE_MIN
             while p < width:
                 pages32.append(data[:, :p])
@@ -2797,7 +2807,14 @@ class _CompiledPlan(_AotWarmup):
     def dispatch(self, params: Optional[Dict] = None):
         """Enqueue the replay on device; returns the un-fetched result."""
         self.wait_compiled()
-        return self.jitted(self._arg_subset(), self._dyn_args(params))
+        dyn = self._dyn_args(params)
+        if dyn:
+            # EXPLICIT host→device upload of the parameter scalars/seed
+            # arrays: handing the jitted call raw numpy made the same
+            # transfer implicitly on every dispatch — invisible to
+            # profiling and flagged by the deviceguard transfer guard
+            dyn = jax.device_put(dyn)
+        return self.jitted(self._arg_subset(), dyn)
 
     def batchable(self) -> bool:
         """Eligible for the vmapped one-Execute group dispatch: count-only
@@ -2853,15 +2870,19 @@ class _CompiledPlan(_AotWarmup):
         all_dyns = dyns + [dyns[-1]] * (nchunks * Bb - B)
 
         def _stack(c: int) -> Dict:
-            return {
-                k: np.stack(
-                    [
-                        np.asarray(d[k])
-                        for d in all_dyns[c * Bb : (c + 1) * Bb]
-                    ]
-                )
-                for k in dyns[0]
-            }
+            # explicit upload (deviceguard): one device_put per chunk
+            # instead of an implicit transfer inside the vmapped call
+            return jax.device_put(
+                {
+                    k: np.stack(
+                        [
+                            np.asarray(d[k])
+                            for d in all_dyns[c * Bb : (c + 1) * Bb]
+                        ]
+                    )
+                    for k in dyns[0]
+                }
+            )
 
         if fn is None:
             self._compile_group_async(Bb, _stack(0))

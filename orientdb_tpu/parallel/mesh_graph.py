@@ -174,20 +174,24 @@ def expand_totals(mesh: Mesh, R: int, ind_sh, srcs) -> jnp.ndarray:
     expansion cap and the global total for the SizeSchedule.
     """
 
+    # axis NAME read on the host, before the trace boundary: a config
+    # read inside `local` would bake silently at trace time (jaxlint)
+    ax = config.mesh_shard_axis
+
     def local(ind_l, srcs_rep):
         ind_l = ind_l[0]
-        sid = jax.lax.axis_index(config.mesh_shard_axis)
+        sid = jax.lax.axis_index(ax)
         lo = sid * R
         owned = (srcs_rep >= lo) & (srcs_rep < lo + R)
         ls = jnp.where(owned, srcs_rep - lo, -1)
         counts = K.degree_counts(ind_l, ls)
         tot = counts.sum()[None]
-        return jax.lax.all_gather(tot, config.mesh_shard_axis).reshape(-1)
+        return jax.lax.all_gather(tot, ax).reshape(-1)
 
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(config.mesh_shard_axis, None), P(None)),
+        in_specs=(P(ax, None), P(None)),
         out_specs=P(None),
         # the output IS replicated (it is an all_gather over the shard
         # axis), but VMA's static inference marks all_gather results as
@@ -227,9 +231,11 @@ def expand_gather(
     ``eid = local edge pos + base``) or the sharded ``edge_id_in`` map
     (in-CSR: local pos → out-order id)."""
 
+    ax = config.mesh_shard_axis  # host-side read; see expand_totals
+
     def local(ind_l, nbr_l, extra_l, srcs_rep):
         ind_l, nbr_l, extra_l = ind_l[0], nbr_l[0], extra_l[0]
-        sid = jax.lax.axis_index(config.mesh_shard_axis)
+        sid = jax.lax.axis_index(ax)
         lo = sid * R
         owned = (srcs_rep >= lo) & (srcs_rep < lo + R)
         ls = jnp.where(owned, srcs_rep - lo, -1)
@@ -245,7 +251,7 @@ def expand_gather(
         # at this shard's exclusive offset in the global segment; psum
         # merges the disjoint writes (values shifted +1 so the zero
         # identity becomes the -1 padding after the merge).
-        all_tot = jax.lax.all_gather(tot, config.mesh_shard_axis)
+        all_tot = jax.lax.all_gather(tot, ax)
         my_off = jnp.cumsum(all_tot)[sid] - tot
         pos = jnp.arange(cap, dtype=jnp.int32)
         dest = jnp.where(pos < tot, pos + my_off, cap_total)  # drop pads
@@ -254,7 +260,7 @@ def expand_gather(
             seg = jnp.zeros(cap_total, jnp.int32).at[dest].add(
                 x + 1, mode="drop"
             )
-            return jax.lax.psum(seg, config.mesh_shard_axis) - 1
+            return jax.lax.psum(seg, ax) - 1
 
         return merge(row), merge(eid), merge(nbr)
 
@@ -262,9 +268,9 @@ def expand_gather(
         local,
         mesh=mesh,
         in_specs=(
-            P(config.mesh_shard_axis, None),
-            P(config.mesh_shard_axis, None),
-            P(config.mesh_shard_axis, None),
+            P(ax, None),
+            P(ax, None),
+            P(ax, None),
             P(None),
         ),
         out_specs=(P(None), P(None), P(None)),
@@ -279,19 +285,21 @@ def sharded_bitmap_hop(
     shard scatter-ORs its edge slice's activations, and the [C, vb] bitmaps
     merge with a psum over the shards axis (SURVEY.md §5.7)."""
 
+    ax = config.mesh_shard_axis  # host-side read; see expand_totals
+
     def local(act_l, emit_l, eid_l, emask_rep, frontier_rep):
         act_l, emit_l, eid_l = act_l[0], emit_l[0], eid_l[0]
         em = K.take_pad(emask_rep, eid_l, False) & (act_l >= 0)
         contrib = K.bitmap_hop(act_l, emit_l, em, frontier_rep)
-        return jax.lax.psum(contrib.astype(jnp.int32), config.mesh_shard_axis) > 0
+        return jax.lax.psum(contrib.astype(jnp.int32), ax) > 0
 
     return shard_map(
         local,
         mesh=mesh,
         in_specs=(
-            P(config.mesh_shard_axis, None),
-            P(config.mesh_shard_axis, None),
-            P(config.mesh_shard_axis, None),
+            P(ax, None),
+            P(ax, None),
+            P(ax, None),
             P(None),
             P(None, None),
         ),
@@ -309,6 +317,8 @@ def sharded_weight_pass(
     over the vertex universe (replicated); ``w`` [vb] carries the weights
     of the level below (all-ones for the last hop)."""
 
+    ax = config.mesh_shard_axis  # host-side read; see expand_totals
+
     def local(seg_l, emit_l, eid_l, emask_rep, ok_rep, w_rep):
         seg_l, emit_l, eid_l = seg_l[0], emit_l[0], eid_l[0]
         em = K.take_pad(emask_rep, eid_l, False) & (seg_l >= 0)
@@ -319,15 +329,15 @@ def sharded_weight_pass(
         part = jax.ops.segment_sum(
             vals, jnp.clip(seg_l, 0, vb - 1), num_segments=vb
         )
-        return jax.lax.psum(part, config.mesh_shard_axis)
+        return jax.lax.psum(part, ax)
 
     return shard_map(
         local,
         mesh=mesh,
         in_specs=(
-            P(config.mesh_shard_axis, None),
-            P(config.mesh_shard_axis, None),
-            P(config.mesh_shard_axis, None),
+            P(ax, None),
+            P(ax, None),
+            P(ax, None),
             P(None),
             P(None),
             P(None),

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -144,12 +144,14 @@ class ShardedCSR:
         return cls(mesh, csr.indptr_out, csr.dst)
 
 
-def _local_hop(indptr_l, dst_l, frontier, rows_per_shard, v_pad):
+def _local_hop(indptr_l, dst_l, frontier, rows_per_shard, v_pad, shard_axis):
     """One shard's contribution to the next frontier.
 
     indptr_l [rows+1] local CSR; dst_l [E_max] global dst (-1 pad);
-    frontier [Q, V_pad] replicated bitmap. Returns [Q, V_pad] bitmap of
-    vertices reached through this shard's edges.
+    frontier [Q, V_pad] replicated bitmap; ``shard_axis`` is the mesh
+    axis NAME, read from config on the host before the trace boundary.
+    Returns [Q, V_pad] bitmap of vertices reached through this shard's
+    edges.
     """
     e_max = dst_l.shape[0]
     epos = jnp.arange(e_max, dtype=jnp.int32)
@@ -158,7 +160,7 @@ def _local_hop(indptr_l, dst_l, frontier, rows_per_shard, v_pad):
         0,
         rows_per_shard - 1,
     )
-    shard_id = jax.lax.axis_index(config.mesh_shard_axis)
+    shard_id = jax.lax.axis_index(shard_axis)
     src_global = src_local + shard_id * rows_per_shard
     edge_live = (dst_l >= 0) & (epos < indptr_l[-1])
     # [Q, E_max]: edge active iff its source is in that query's frontier
@@ -168,12 +170,29 @@ def _local_hop(indptr_l, dst_l, frontier, rows_per_shard, v_pad):
     return contrib
 
 
+#: (mesh, axes, geometry) → jitted BFS step. Un-memoized, every
+#: bfs_reachability call built a FRESH jax.jit wrapper — a fresh trace
+#: cache, so every query paid a full retrace+recompile (jaxlint's
+#: un-memoized-jit finding, confirmed by deviceguard's re-record
+#: counters). Meshes per process are few; the cache is unbounded.
+_BFS_STEP_CACHE: Dict[Tuple, object] = {}
+
+
 def build_bfs_step(
     mesh: Mesh, rows_per_shard: int, v_pad: int, max_depth: int
 ):
     """Compile the sharded multi-hop BFS step (the framework's
     `dryrun_multichip` "training step": DP over query replicas × TP over
     CSR shards, psum OR-merge per hop over ICI)."""
+    # axis names are host-side trace constants: read them here, not
+    # inside the traced closure (they also key the memo — a retuned
+    # axis name must not serve a stale executable)
+    shard_ax = config.mesh_shard_axis
+    rep_ax = config.mesh_replica_axis
+    key = (mesh, shard_ax, rep_ax, rows_per_shard, v_pad, max_depth)
+    cached = _BFS_STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
 
     def step(indptr_sh, dst_sh, roots):
         # roots: [Q, V_pad] bool, replica-sharded on axis 0
@@ -184,10 +203,11 @@ def build_bfs_step(
             def body(_, state):
                 frontier, visited = state
                 contrib = _local_hop(
-                    indptr_l, dst_l, frontier, rows_per_shard, v_pad
+                    indptr_l, dst_l, frontier, rows_per_shard, v_pad,
+                    shard_ax,
                 )
                 merged = (
-                    jax.lax.psum(contrib.astype(jnp.int32), config.mesh_shard_axis) > 0
+                    jax.lax.psum(contrib.astype(jnp.int32), shard_ax) > 0
                 )
                 nxt = merged & ~visited
                 return nxt, visited | nxt
@@ -200,12 +220,14 @@ def build_bfs_step(
         return shard_map(
             inner,
             mesh=mesh,
-            in_specs=(P(config.mesh_shard_axis, None), P(config.mesh_shard_axis, None), P(config.mesh_replica_axis, None)),
-            out_specs=P(config.mesh_replica_axis, None),
+            in_specs=(P(shard_ax, None), P(shard_ax, None), P(rep_ax, None)),
+            out_specs=P(rep_ax, None),
             check_vma=True,
         )(indptr_sh, dst_sh, roots)
 
-    return jax.jit(step)
+    fn = jax.jit(step)
+    _BFS_STEP_CACHE[key] = fn
+    return fn
 
 
 def bfs_reachability(

@@ -32,6 +32,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from orientdb_tpu.analysis import deviceguard as _deviceguard  # noqa: E402
 from orientdb_tpu.analysis import sanitizer as _sanitizer  # noqa: E402
 
 # -- runtime lock-order sanitizer (analysis/sanitizer) -----------------------
@@ -48,20 +49,37 @@ from orientdb_tpu.analysis import sanitizer as _sanitizer  # noqa: E402
 _sanitizer.plugin_configure()
 
 
+# -- device transfer/compile guard (analysis/deviceguard) --------------------
+# jaxlint's dynamic twin: the TPU suites run under jax.transfer_guard
+# (implicit host<->device transfer fails the test that made it) with the
+# engine's intentional fetch/recording paths allowlisted, and a
+# same-shape re-record — the plan cache compiling an identical
+# statement twice — fails the observing test. Session summary lands in
+# DEVICEGUARD.json. ORIENTTPU_DEVICEGUARD=0 disables; =log warns only.
+
+
 def pytest_runtest_setup(item):
     _sanitizer.plugin_runtest_setup(item)
+    _deviceguard.plugin_runtest_setup(item)
+
+
+def pytest_runtest_makereport(item, call):
+    _deviceguard.plugin_runtest_makereport(item, call)
 
 
 def pytest_runtest_teardown(item):
     _sanitizer.plugin_runtest_teardown(item)
+    _deviceguard.plugin_runtest_teardown(item)
 
 
 def pytest_sessionfinish(session, exitstatus):
     _sanitizer.plugin_sessionfinish()
+    _deviceguard.plugin_sessionfinish()
 
 
 def pytest_terminal_summary(terminalreporter):
     _sanitizer.plugin_terminal_summary(terminalreporter)
+    _deviceguard.plugin_terminal_summary(terminalreporter)
 
 
 @pytest.fixture

@@ -36,10 +36,10 @@ class TestTreeIsClean:
         assert rep.findings == [], "\n" + "\n".join(
             str(f) for f in rep.findings
         )
-        # all seven passes actually ran
+        # all eight passes actually ran
         assert set(rep.counts) >= {
             "locklint", "configlint", "exceptlint",
-            "iolint", "spanlint", "promlint", "racelint",
+            "iolint", "spanlint", "promlint", "racelint", "jaxlint",
         }
 
 
@@ -212,6 +212,95 @@ class TestLocklintMutations:
         )
         fs = run_pass("locklint", {"orientdb_tpu/server/m.py": src})
         assert len(fs) == 1 and "urlopen" in fs[0].message
+
+    def test_typed_receiver_lock_resolves_through_call_closure(self):
+        """The PR 7 gap shape: a lock acquired through a TYPED non-self
+        receiver (`m.db._repl_lock` with m: Member storing db:
+        Database), one self-method call deep under the outer lock —
+        the edge must land fully qualified in the graph."""
+        from orientdb_tpu.analysis.locklint import lock_graph
+
+        src = (
+            "import threading\n"
+            "class Database:\n"
+            "    def __init__(self):\n"
+            "        self._repl_lock = threading.Lock()\n"
+            "class Member:\n"
+            "    def __init__(self, db: Database):\n"
+            "        self.db = db\n"
+            "class Cluster:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def probe(self, m: Member):\n"
+            "        with self._lock:\n"
+            "            self._settle(m)\n"
+            "    def _settle(self, m: Member):\n"
+            "        with m.db._repl_lock:\n"
+            "            pass\n"
+        )
+        tree = SourceTree.from_sources({"orientdb_tpu/parallel/m.py": src})
+        edges, _ = lock_graph(tree)
+        assert ("m.Cluster._lock", "m.Database._repl_lock") in edges
+
+    def test_typed_local_binding_carries_across_statements(self):
+        """`db = self.db` on one line, `with db._repl_lock:` on the
+        next: the typed-local env must persist across the followed
+        method's statements."""
+        from orientdb_tpu.analysis.locklint import lock_graph
+
+        src = (
+            "import threading\n"
+            "class Database:\n"
+            "    def __init__(self):\n"
+            "        self._repl_lock = threading.Lock()\n"
+            "class Holder:\n"
+            "    def __init__(self, db: Database):\n"
+            "        self.db = db\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self._g()\n"
+            "    def _g(self):\n"
+            "        db = self.db\n"
+            "        with db._repl_lock:\n"
+            "            pass\n"
+        )
+        tree = SourceTree.from_sources({"orientdb_tpu/parallel/m.py": src})
+        edges, _ = lock_graph(tree)
+        assert ("m.Holder._lock", "m.Database._repl_lock") in edges
+
+    def test_blocking_call_one_self_method_deep_flags(self):
+        """The call closure also carries the blocking-call check: a
+        sleep inside a *_locked helper invoked under the lock."""
+        src = (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self._work_locked()\n"
+            "    def _work_locked(self):\n"
+            "        time.sleep(1)\n"
+        )
+        fs = run_pass("locklint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert "sleep" in fs[0].message and fs[0].line == 9
+
+    def test_untyped_receiver_keeps_wildcard_node(self):
+        from orientdb_tpu.analysis.locklint import lock_graph
+
+        src = (
+            "import threading\n"
+            "_g_lock = threading.Lock()\n"
+            "def f(obj):\n"
+            "    with _g_lock:\n"
+            "        with obj._inner_lock:\n"
+            "            pass\n"
+        )
+        tree = SourceTree.from_sources({"orientdb_tpu/exec/m.py": src})
+        edges, _ = lock_graph(tree)
+        assert ("m._g_lock", "*._inner_lock") in edges
 
     def test_sleep_outside_lock_is_clean(self):
         src = (
@@ -825,6 +914,296 @@ class TestPromlintMutation:
         ) == []
 
 
+class TestJaxlintMutations:
+    """Device-boundary & recompile hygiene: one seeded violation per
+    sub-check, plus the negative spaces (statics, .shape, memoized
+    jit) the pass must NOT flag."""
+
+    def test_host_sync_under_trace(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    jax.device_get(x)\n"
+            "    x.block_until_ready()\n"
+            "    return x\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 2
+        assert "device_get" in fs[0].message
+        assert "block_until_ready" in fs[1].message
+        assert "traced region" in fs[0].message
+
+    def test_blocking_call_under_trace(self):
+        src = (
+            "import jax, time\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    time.sleep(0.1)\n"
+            "    return x\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert "sleep" in fs[0].message and fs[0].line == 4
+
+    def test_blocking_in_same_module_call_closure(self):
+        """A helper the traced root calls is part of the region."""
+        src = (
+            "import jax, time\n"
+            "def helper(x):\n"
+            "    time.sleep(1)\n"
+            "    return x\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return helper(x)\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1 and fs[0].line == 3
+
+    def test_tracer_branch_direct_param_advises_static(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, n):\n"
+            "    if n > 2:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert fs[0].line == 4
+        assert "static_argnames" in fs[0].message
+        assert "'n'" in fs[0].message
+
+    def test_tracer_branch_derived_value(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    y = x + 1\n"
+            "    while y.sum() > 0:\n"
+            "        y = y - 1\n"
+            "    return y\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert "tracer-valued" in fs[0].message
+        assert "`while`" in fs[0].message
+
+    def test_static_argnames_param_is_exempt(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('n',))\n"
+            "def f(x, n):\n"
+            "    if n > 2:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        assert run_pass(
+            "jaxlint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_shape_branch_is_clean(self):
+        """x.shape / len(x) are static host values, not tracers."""
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x.shape[0] > 2 and len(x) > 1:\n"
+            "        return x\n"
+            "    if x is None:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        assert run_pass(
+            "jaxlint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_impure_time_and_metrics_under_trace(self):
+        src = (
+            "import jax, time\n"
+            "from orientdb_tpu.utils.metrics import metrics\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    t = time.perf_counter()\n"
+            "    metrics.incr('tpu.dispatch')\n"
+            "    return x\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 2
+        assert "time.perf_counter" in fs[0].message
+        assert "baked in" in fs[0].message
+        assert "metrics.incr" in fs[1].message
+
+    def test_lock_acquisition_under_trace(self):
+        src = (
+            "import jax, threading\n"
+            "_lock = threading.Lock()\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    with _lock:\n"
+            "        return x\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert "lock acquired inside a traced region" in fs[0].message
+
+    def test_config_read_under_trace(self):
+        src = (
+            "import jax\n"
+            "from orientdb_tpu.utils.config import config\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * config.schedule_headroom\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert "config.schedule_headroom" in fs[0].message
+        assert "bakes into the executable" in fs[0].message
+
+    def test_host_coercions_on_traced_values(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    a = np.asarray(x)\n"
+            "    b = int(x)\n"
+            "    c = x.sum().item()\n"
+            "    return a, b, c\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        msgs = "\n".join(f.message for f in fs)
+        assert len(fs) == 3
+        assert "np.asarray" in msgs
+        assert "int() coercion" in msgs
+        assert ".item()" in msgs
+
+    def test_lambda_passed_to_vmap_is_a_region(self):
+        src = (
+            "import jax, time\n"
+            "def g(xs):\n"
+            "    return jax.vmap(lambda x: x * time.time())(xs)\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1 and "time.time" in fs[0].message
+
+    def test_shard_map_local_fn_is_a_region(self):
+        src = (
+            "from orientdb_tpu.parallel.shard_compat import shard_map\n"
+            "from orientdb_tpu.utils.metrics import metrics\n"
+            "def outer(mesh, data):\n"
+            "    def local(x):\n"
+            "        metrics.incr('hop')\n"
+            "        return x\n"
+            "    return shard_map(local, mesh=mesh)(data)\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/parallel/m.py": src})
+        assert len(fs) == 1 and "metrics.incr" in fs[0].message
+
+    def test_unmemoized_jit_in_function_scope(self):
+        src = (
+            "import jax\n"
+            "def make(f):\n"
+            "    return jax.jit(f)\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert "without memoization" in fs[0].message
+        assert fs[0].line == 3
+
+    def test_jit_memoized_on_self_is_clean(self):
+        src = (
+            "import jax\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.j = jax.jit(self._f)\n"
+            "    def _f(self, x):\n"
+            "        return x\n"
+        )
+        assert run_pass(
+            "jaxlint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_jit_flowing_into_cache_is_clean(self):
+        src = (
+            "import jax\n"
+            "class C:\n"
+            "    def get(self, k):\n"
+            "        fn = jax.jit(self._f)\n"
+            "        self.cache[k] = fn\n"
+            "        return fn\n"
+            "    def _f(self, x):\n"
+            "        return x\n"
+        )
+        assert run_pass(
+            "jaxlint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_module_scope_jit_is_clean(self):
+        src = (
+            "import jax\n"
+            "def _f(x):\n"
+            "    return x\n"
+            "f = jax.jit(_f)\n"
+        )
+        assert run_pass(
+            "jaxlint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_array_valued_static_argument(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('sizes',))\n"
+            "def f(x, sizes):\n"
+            "    return x\n"
+            "def g(x):\n"
+            "    return f(x, sizes=[1, 2, 3])\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/exec/m.py": src})
+        assert len(fs) == 1
+        assert "array-valued static argument" in fs[0].message
+        assert "'sizes'" in fs[0].message
+        assert fs[0].line == 7
+
+    def test_scalar_static_argument_is_clean(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('n',))\n"
+            "def f(x, n):\n"
+            "    return x\n"
+            "def g(x):\n"
+            "    return f(x, n=4)\n"
+        )
+        assert run_pass(
+            "jaxlint", {"orientdb_tpu/exec/m.py": src}
+        ) == []
+
+    def test_suppression_with_justification_silences(self):
+        src = (
+            "import jax, time\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    # deliberate: trace-time stamp for the test fixture\n"
+            "    time.sleep(0.1)  # lint: allow(jaxlint)\n"
+            "    return x\n"
+        )
+        tree = SourceTree.from_sources({"orientdb_tpu/exec/m.py": src})
+        rep = core.run(tree=tree, passes=["jaxlint"])
+        assert rep.findings == []
+        assert len(rep.suppressed) == 1
+
+    def test_unused_suppression_flags(self):
+        src = "x = 1  # lint: allow(jaxlint)\n"
+        tree = SourceTree.from_sources({"orientdb_tpu/exec/m.py": src})
+        rep = core.run(tree=tree, passes=["jaxlint"])
+        assert len(rep.findings) == 1
+        assert "unused suppression" in rep.findings[0].message
+
+
 class TestCli:
     def test_cli_json_clean_exit_zero(self):
         proc = subprocess.run(
@@ -840,7 +1219,7 @@ class TestCli:
         assert doc["findings"] == []
         for name in (
             "locklint", "configlint", "exceptlint",
-            "iolint", "spanlint", "promlint", "racelint",
+            "iolint", "spanlint", "promlint", "racelint", "jaxlint",
         ):
             assert doc["counts"][name] == 0
 
@@ -855,6 +1234,63 @@ class TestCli:
         assert proc.returncode == 0
         for name in ("locklint", "configlint", "exceptlint"):
             assert name in proc.stdout
+
+    def test_cli_pass_accepts_comma_separated_list(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "orientdb_tpu.analysis",
+                "--json", "--pass", "jaxlint,locklint",
+                "--pass", "promlint",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert set(doc["counts"]) == {"jaxlint", "locklint", "promlint"}
+
+    def test_cli_comma_list_with_unknown_name_exit_2(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "orientdb_tpu.analysis",
+                "--pass", "locklint,nosuchpass",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 2
+        assert "nosuchpass" in proc.stderr
+
+    def test_cli_list_shows_docstring_descriptions(self):
+        from orientdb_tpu.analysis.__main__ import pass_description
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "orientdb_tpu.analysis", "--list"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0
+        for name in sorted(core.PASSES):
+            desc = pass_description(name)
+            assert desc  # non-empty for every pass
+            assert desc in proc.stdout
+
+    def test_every_pass_module_has_a_docstring(self):
+        """--list pulls descriptions from module docstrings; a pass
+        without one would list as its bare registry title."""
+        import importlib
+
+        for name, ap in sorted(core.PASSES.items()):
+            mod = importlib.import_module(ap.fn.__module__)
+            doc = (mod.__doc__ or "").strip()
+            assert doc, f"pass {name} module {ap.fn.__module__} has no docstring"
+            assert doc.splitlines()[0].strip(), name
 
     def test_cli_unknown_pass_exit_2(self):
         proc = subprocess.run(

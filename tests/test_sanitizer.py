@@ -282,6 +282,35 @@ class TestCrossCheck:
         assert gap_edges == [("m.A._zzq_lock", "m.B._zzr_lock")]
         assert chk["leaf_gaps"] == 1
 
+    def test_pr7_gap_edge_is_now_statically_covered(self):
+        """The cross-check's first real catch: the dynamic edge
+        Cluster._lock -> Database._repl_lock was invisible to locklint
+        because the lock is acquired through a non-self receiver
+        (`m.db._repl_lock` in `_settled_lsn`, reached via `_elect`
+        under the cluster lock). Typed-receiver resolution plus the
+        self-method call closure must cover it now — a regression here
+        reopens a known blind spot."""
+        san = self._with_edges(
+            [("cluster.Cluster._lock", "database.Database._repl_lock")]
+        )
+        chk = san.cross_check()
+        assert chk["dynamic_edges"] == 1
+        assert chk["covered"] == 1, chk["gaps"]
+        assert chk["gaps"] == [] and chk["leaf_gaps"] == 0
+
+    def test_typed_receiver_edge_exists_in_static_graph(self):
+        """The static half of the same guarantee, independent of the
+        cross-check's matching rules: locklint's graph contains the
+        fully-qualified edge itself."""
+        from orientdb_tpu.analysis.core import SourceTree
+        from orientdb_tpu.analysis.locklint import lock_graph
+
+        edges, _ = lock_graph(SourceTree.from_repo(REPO))
+        assert (
+            "cluster.Cluster._lock",
+            "database.Database._repl_lock",
+        ) in edges
+
     def test_out_of_package_locks_are_out_of_scope(self):
         san = LockOrderSanitizer()
         san.edges[("q.Queue.mutex", "f.Foo._lock")] = {
