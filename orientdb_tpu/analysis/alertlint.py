@@ -1,0 +1,91 @@
+"""alertlint — every built-in alert rule name is cataloged.
+
+The alert plane (``obs/alerts.py``) dedupes, renders, and documents
+rules by NAME: ``orienttpu_alert_firing{rule=...}`` series, the README
+rule table, and the ``GET /alerts`` payload all join on it. A
+``_rule("replication_laag", ...)`` typo would silently register a rule
+no dashboard watches and leave the documented name a dead series —
+the exact failure mode spanlint closes for span names, so this pass
+applies the same contract to rule declarations:
+
+- every **string-literal** first argument of a ``_rule(...)`` /
+  ``AlertRule(...)`` call under ``orientdb_tpu/`` must appear in
+  :data:`~orientdb_tpu.obs.alerts.RULE_CATALOG`;
+- every catalog entry must be declared by at least one call site (a
+  stale entry is dead documentation AND a dead exposition series).
+
+The catalog stays in ``obs/alerts.py`` (it doubles as the README's
+rule reference); this module is the framework pass over it. Tests are
+exempt — rule names there are fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from orientdb_tpu.analysis.core import Finding, SourceTree, register
+from orientdb_tpu.obs.alerts import RULE_CATALOG
+
+#: call names whose first positional string argument is a rule name
+RULE_CALLS = frozenset({"_rule", "AlertRule"})
+
+
+def _literal_rule_names(tree: ast.Module) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        name = (
+            f.id
+            if isinstance(f, ast.Name)
+            else f.attr
+            if isinstance(f, ast.Attribute)
+            else None
+        )
+        if name not in RULE_CALLS:
+            continue
+        if (
+            n.args
+            and isinstance(n.args[0], ast.Constant)
+            and isinstance(n.args[0].value, str)
+        ):
+            out.append((n.lineno, n.args[0].value))
+    return out
+
+
+@register(
+    "alertlint",
+    "literal alert-rule names are in RULE_CATALOG; no stale catalog "
+    "entries",
+)
+def run_alertlint(tree: SourceTree) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    used: Set[str] = set()
+    for m in tree.modules:
+        if m.tree is None:
+            continue
+        for lineno, name in _literal_rule_names(m.tree):
+            used.add(name)
+            if name not in RULE_CATALOG:
+                findings.append(
+                    Finding(
+                        "alertlint", m.path, lineno,
+                        f"alert rule {name!r} is not in RULE_CATALOG "
+                        "(obs/alerts.py) — an uncataloged rule is a "
+                        "series no dashboard watches; add the name "
+                        "with a description or fix the declaration",
+                    )
+                )
+    for name in sorted(RULE_CATALOG):
+        if name not in used:
+            findings.append(
+                Finding(
+                    "alertlint", "orientdb_tpu/obs/alerts.py", 1,
+                    f"RULE_CATALOG entry {name!r} is declared by no "
+                    "_rule()/AlertRule() call site — remove it or fix "
+                    "the spelling at the declaration",
+                )
+            )
+    return findings

@@ -200,6 +200,7 @@ def register(name: str, title: str):
 def load_passes() -> None:
     """Import every pass module (idempotent) so PASSES is complete."""
     from orientdb_tpu.analysis import (  # noqa: F401
+        alertlint,
         configlint,
         exceptlint,
         iolint,

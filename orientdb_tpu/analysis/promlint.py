@@ -5,9 +5,10 @@ runtime (tier-1 over ``/metrics`` and ``/cluster/metrics``). That
 catches malformed documents, but only for metrics a test actually
 emits. This pass is the static half: every **string-literal** metric
 name handed to the process registries —
-``metrics.incr/gauge/observe`` (utils/metrics) and
-``obs.observe/observe_size/histogram`` (obs/registry) — must match
-the internal dotted grammar ``[a-z][a-z0-9_.]*``. Anything else
+``metrics.incr/gauge/observe`` (utils/metrics),
+``obs.observe/observe_size/histogram`` (obs/registry), and the alert
+plane's ``alert_gauge(...)`` summary-gauge helper (obs/alerts) — must
+match the internal dotted grammar ``[a-z][a-z0-9_.]*``. Anything else
 (dashes, uppercase, leading digits) sanitizes lossily in
 ``_prom_name`` — two distinct internal names can collide into one
 exposed family, corrupting dashboards with merged series.
@@ -33,6 +34,11 @@ INTERNAL_NAME_RE = re.compile(r"[a-z][a-z0-9_.]*\Z")
 _RECEIVERS = frozenset({"metrics", "obs"})
 _METHODS = frozenset({"incr", "gauge", "observe", "observe_size", "histogram"})
 
+#: bare-name gauge helpers that also take a metric name first — the
+#: alert plane's summary-gauge emission sites (obs/alerts.alert_gauge)
+#: publish into the same registry, so the same grammar applies
+_NAME_FUNCS = frozenset({"alert_gauge"})
+
 
 @register(
     "promlint",
@@ -48,12 +54,16 @@ def run_promlint(tree: SourceTree) -> Iterable[Finding]:
             if not isinstance(n, ast.Call):
                 continue
             f = n.func
-            if not (
+            is_method_site = (
                 isinstance(f, ast.Attribute)
                 and f.attr in _METHODS
                 and isinstance(f.value, ast.Name)
                 and f.value.id in _RECEIVERS
-            ):
+            )
+            is_name_site = (
+                isinstance(f, ast.Name) and f.id in _NAME_FUNCS
+            )
+            if not (is_method_site or is_name_site):
                 continue
             if not (
                 n.args

@@ -41,9 +41,20 @@ both claims needs more than `utils/metrics.py`'s counters:
   /stats/profile``), plus scrape-time memory/process telemetry gauges;
 - :mod:`orientdb_tpu.obs.spanlint` — span-name catalog lint: every
   literal ``span(...)`` name must appear in ``SPAN_CATALOG``, so a
-  typo cannot silently split profiles or break cross-node trace joins.
+  typo cannot silently split profiles or break cross-node trace joins;
+- :mod:`orientdb_tpu.obs.alerts` — the SLO alerting plane: a
+  declarative rule catalog (replication lag, open breakers, in-doubt
+  2PC age, CDC backlog, WAL/RSS/HBM watermarks, recompile storms,
+  per-fingerprint latency regression vs an online EWMA+MAD baseline,
+  two-window error-budget burn) driven pending → firing → resolved
+  with exemplar trace ids, served at ``GET /alerts``;
+- :mod:`orientdb_tpu.obs.watchdog` — the ``HealthWatchdog`` thread
+  (started/stopped with ``Server``) that ticks the alert engine —
+  evaluation never rides the query hot path.
 """
 
+from orientdb_tpu.obs.alerts import RULE_CATALOG, render_alerts_prometheus
+from orientdb_tpu.obs.alerts import engine as alert_engine
 from orientdb_tpu.obs.bundle import assemble_traces, debug_bundle
 from orientdb_tpu.obs.evidence import EvidenceSink, read_evidence
 from orientdb_tpu.obs.profile import (
@@ -85,7 +96,10 @@ from orientdb_tpu.obs.trace import (
 __all__ = [
     "EvidenceSink",
     "QueryStats",
+    "RULE_CATALOG",
     "SPAN_CATALOG",
+    "alert_engine",
+    "render_alerts_prometheus",
     "fingerprint",
     "fingerprint_cached",
     "lint_spans",

@@ -1,0 +1,770 @@
+"""SLO alerting: a declarative rule engine over the obs registries.
+
+Everything before this PR *exposes* state — spans, the Prometheus
+exposition, per-fingerprint query stats, cluster fan-in — but nothing
+*watches* it: a replica falling behind or a latency regression on a
+hot fingerprint is only found when a human scrapes an endpoint. This
+module closes the loop (the Monarch/Dapper-lineage "monitoring must
+alert, not just record" argument):
+
+- :data:`RULE_CATALOG` — the built-in rule set, one name + description
+  per rule (the operator-facing index; ``alertlint`` keeps call sites
+  and catalog in sync the way spanlint does for span names);
+- :class:`AlertEngine` — evaluates every rule over one combined
+  signal snapshot (``registry.snapshot_all()``: counters/gauges/
+  histograms/query stats, plus breaker and cluster state) and drives
+  the alert lifecycle **pending → firing → resolved** with dedupe by
+  ``(rule, key)`` and a bounded resolved-history ring. Conditions are
+  plain thresholds or two-window burn rates; the latency-regression
+  rule learns an online EWMA+MAD baseline per fingerprint from the
+  PR-4 stats table;
+- **exemplars** — an alert that fires captures the trace id of the
+  worst matching slowlog entry (latency/error rules) or the newest
+  matching span in the tracer ring, so every alert links directly
+  into the trace plane.
+
+Evaluation happens ONLY at watchdog tick (obs/watchdog) or on demand —
+the query hot path never touches this module. Reading state
+(:meth:`AlertEngine.export` at scrape time, :meth:`AlertEngine.report`
+for ``GET /alerts``) is a short lock + copy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("alerts")
+
+#: rule name -> what it watches. The alert vocabulary in one place:
+#: ``alertlint`` (orientdb_tpu/analysis) fails the build when a
+#: ``_rule(...)`` call site names something not listed here, or a
+#: catalog entry goes stale. Doubles as the README's rule reference.
+RULE_CATALOG: Dict[str, str] = {
+    "replication_lag": "a replica's applied LSN trails the source head "
+    "by more than alert_repl_lag_entries entries",
+    "breaker_open": "a circuit breaker (parallel/resilience) is OPEN — "
+    "its channel is failing fast",
+    "indoubt_2pc_age": "a prepared-undecided 2PC batch has been staged "
+    "longer than alert_indoubt_age_s (locks held, outcome unknown)",
+    "cdc_backlog": "a changefeed consumer's queue depth or entry lag "
+    "exceeds alert_cdc_queue_depth (slow consumer / gap risk)",
+    "wal_growth": "live WAL + archived segments exceed alert_wal_bytes "
+    "(checkpointing is not keeping up)",
+    "rss_watermark": "process RSS exceeds alert_rss_bytes",
+    "jax_buffer_watermark": "live jax device-buffer bytes exceed "
+    "alert_jax_buffer_bytes (HBM pressure)",
+    "recompile_storm": "shape-overflow recompiles per minute exceed "
+    "alert_recompiles_per_min (plan cache thrash)",
+    "latency_regression": "a fingerprint's per-tick mean latency "
+    "exceeds its online EWMA baseline by alert_latency_mads deviations",
+    "error_burn_rate": "query error rate burns the SLO error budget at "
+    "more than alert_burn_factor x in BOTH burn windows",
+}
+
+#: two-window burn-rate windows (seconds): the short window catches the
+#: spike, the long window keeps a transient blip from paging
+BURN_SHORT_S = 60.0
+BURN_LONG_S = 600.0
+
+#: EWMA smoothing for the latency baseline (per tick interval)
+_EWMA_ALPHA = 0.3
+#: intervals a baseline must absorb before it can flag a regression
+_BASELINE_WARMUP = 3
+#: deviation floor (seconds): sub-100µs MADs are pure jitter
+_MAD_FLOOR_S = 1e-4
+
+
+def alert_gauge(name: str, value: float) -> None:
+    """Publish one watchdog summary gauge into the process registry
+    (so ``/metrics`` and the ``/cluster/metrics`` fan-in carry the
+    alert plane's own health). promlint's AST half checks literal
+    names at these call sites exactly like ``metrics.gauge`` ones."""
+    metrics.gauge(name, value)
+
+
+class Breach:
+    """One rule violation observed in one tick: the dedupe key (e.g. a
+    member name, breaker name, or fingerprint id), the measured value,
+    the threshold it crossed, and a human detail line."""
+
+    __slots__ = ("key", "value", "threshold", "detail")
+
+    def __init__(
+        self, key: str, value: float, threshold: float, detail: str
+    ) -> None:
+        self.key = key
+        self.value = value
+        self.threshold = threshold
+        self.detail = detail
+
+
+class AlertRule:
+    """One declarative rule: a check callable returning this tick's
+    breaches, plus how to find an exemplar trace when it fires
+    (``exemplar="slowlog"`` joins the worst matching slowlog entry;
+    ``exemplar_spans`` prefixes match the newest span in the ring)."""
+
+    __slots__ = ("name", "severity", "check", "exemplar", "exemplar_spans")
+
+    def __init__(
+        self,
+        name: str,
+        severity: str,
+        check: Callable[["AlertEngine", "AlertContext"], Iterable[Breach]],
+        exemplar: str = "span",
+        exemplar_spans: Tuple[str, ...] = (),
+    ) -> None:
+        self.name = name
+        self.severity = severity
+        self.check = check
+        self.exemplar = exemplar
+        self.exemplar_spans = exemplar_spans
+
+
+class AlertContext:
+    """The signals one evaluation tick sees: a ``snapshot_all()``
+    registry snapshot plus this server's databases and cluster."""
+
+    __slots__ = ("now", "snap", "dbs", "cluster")
+
+    def __init__(self, now: float, snap: Dict, dbs, cluster) -> None:
+        self.now = now
+        self.snap = snap
+        self.dbs = list(dbs)
+        self.cluster = cluster
+
+    @property
+    def gauges(self) -> Dict[str, float]:
+        return self.snap.get("gauges", {})
+
+    @property
+    def query_stats(self) -> Dict[str, Dict]:
+        return self.snap.get("query_stats", {}) or {}
+
+
+class Alert:
+    """One deduped alert instance through its lifecycle."""
+
+    __slots__ = (
+        "rule",
+        "key",
+        "severity",
+        "state",
+        "value",
+        "threshold",
+        "detail",
+        "since_ts",
+        "last_ts",
+        "resolved_ts",
+        "streak",
+        "exemplar_trace_id",
+    )
+
+    def __init__(self, rule: AlertRule, br: Breach, now: float) -> None:
+        self.rule = rule.name
+        self.key = br.key
+        self.severity = rule.severity
+        self.state = "pending"
+        self.value = br.value
+        self.threshold = br.threshold
+        self.detail = br.detail
+        self.since_ts = now
+        self.last_ts = now
+        self.resolved_ts: Optional[float] = None
+        self.streak = 1
+        self.exemplar_trace_id: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "rule": self.rule,
+            "key": self.key,
+            "severity": self.severity,
+            "state": self.state,
+            "value": round(float(self.value), 6),
+            "threshold": round(float(self.threshold), 6),
+            "detail": self.detail,
+            "since_ts": round(self.since_ts, 3),
+            "last_ts": round(self.last_ts, 3),
+            "exemplar_trace_id": self.exemplar_trace_id,
+        }
+        if self.resolved_ts is not None:
+            out["resolved_ts"] = round(self.resolved_ts, 3)
+        return out
+
+
+class _Baseline:
+    """Online EWMA + EWMA-of-absolute-deviation (the streaming MAD
+    analog) of one fingerprint's per-tick mean latency."""
+
+    __slots__ = ("ewma_s", "mad_s", "n")
+
+    def __init__(self) -> None:
+        self.ewma_s = 0.0
+        self.mad_s = 0.0
+        self.n = 0
+
+    def update(self, mean_s: float) -> None:
+        if self.n == 0:
+            self.ewma_s = mean_s
+        else:
+            dev = abs(mean_s - self.ewma_s)
+            self.mad_s += _EWMA_ALPHA * (dev - self.mad_s)
+            self.ewma_s += _EWMA_ALPHA * (mean_s - self.ewma_s)
+        self.n += 1
+
+    def breaches(self, mean_s: float) -> bool:
+        if self.n < _BASELINE_WARMUP:
+            return False
+        return mean_s > self.ewma_s + config.alert_latency_mads * max(
+            self.mad_s, _MAD_FLOOR_S
+        )
+
+
+class AlertEngine:
+    """The process-wide rule evaluator + alert lifecycle store."""
+
+    def __init__(self, history_capacity: Optional[int] = None) -> None:
+        self._mu = threading.Lock()
+        #: serializes whole evaluation ticks: several in-process
+        #: servers each run a watchdog over this shared engine (the
+        #: process-singleton compromise every obs registry makes), so
+        #: the learning state below must never see two interleaved
+        #: rule phases. Readers only ever need _mu.
+        self._eval_mu = threading.Lock()
+        self._active: Dict[Tuple[str, str], Alert] = {}
+        self._history: deque = deque()
+        #: None = read config.alert_history_capacity live (retunable)
+        self._history_capacity = history_capacity
+        self._ticks = 0
+        self._fired_total = 0
+        self._resolved_total = 0
+        self._last_tick_ts: Optional[float] = None
+        # online learning / windowed state (written only under
+        # _eval_mu; read under _mu by summary())
+        self._baselines: Dict[str, _Baseline] = {}
+        self._prev_qs: Dict[str, Tuple[int, float, int]] = {}
+        self._prev_recompiles: Optional[int] = None
+        self._prev_recompiles_ts = 0.0
+        self._indoubt_seen: Dict[Tuple[str, str], float] = {}
+        self._burn_samples: deque = deque()  # (ts, calls, errors)
+
+    # -- evaluation (tick-time only, never the query hot path) ---------------
+
+    def evaluate(
+        self, dbs=(), cluster=None, snap: Optional[Dict] = None
+    ) -> Dict[str, int]:
+        """One tick: gather signals, run every rule, advance alert
+        lifecycles. Returns ``{"fired": n, "resolved": n}`` for this
+        tick (the watchdog logs transitions). Whole ticks serialize
+        under ``_eval_mu`` — concurrent watchdogs (one per in-process
+        server) must never interleave rule phases over the shared
+        learning state."""
+        with self._eval_mu:
+            return self._evaluate_locked(dbs, cluster, snap)
+
+    def _evaluate_locked(self, dbs, cluster, snap):
+        from orientdb_tpu.obs.registry import snapshot_all
+
+        now = time.time()
+        if snap is None:
+            snap = snapshot_all()
+        ctx = AlertContext(now, snap, dbs, cluster)
+        breaches: Dict[Tuple[str, str], Tuple[AlertRule, Breach]] = {}
+        for rule in BUILTIN_RULES:
+            try:
+                for br in rule.check(self, ctx):
+                    breaches[(rule.name, br.key)] = (rule, br)
+            except Exception:  # a broken signal must not kill the tick
+                log.exception("alert rule %s evaluation failed", rule.name)
+        # fold the per-fingerprint cumulative table forward ONCE per
+        # tick, after EVERY rule consumed this tick's deltas — the
+        # latency and burn rules both difference against it, so the
+        # update cannot live inside either rule's generator (a
+        # reordered or failed rule would silently stale the deltas)
+        for fid, row in ctx.query_stats.items():
+            self._prev_qs[fid] = (
+                int(row.get("calls", 0)),
+                float(row.get("total_s", 0.0)),
+                int(row.get("errors", 0)),
+            )
+        fired = resolved = 0
+        pending_ticks = max(int(config.alert_pending_ticks), 1)
+        with self._mu:
+            self._ticks += 1
+            self._last_tick_ts = now
+            for ident, (rule, br) in breaches.items():
+                a = self._active.get(ident)
+                if a is None:
+                    a = self._active[ident] = Alert(rule, br, now)
+                else:
+                    a.value = br.value
+                    a.threshold = br.threshold
+                    a.detail = br.detail
+                    a.last_ts = now
+                    a.streak += 1
+                if a.state == "pending" and a.streak >= pending_ticks:
+                    a.state = "firing"
+                    a.exemplar_trace_id = self._exemplar(rule, br)
+                    fired += 1
+            for ident in list(self._active):
+                if ident in breaches:
+                    continue
+                a = self._active.pop(ident)
+                if a.state == "firing":
+                    a.state = "resolved"
+                    a.resolved_ts = now
+                    resolved += 1
+                    self._push_history(a)
+                # a pending alert that clears before firing drops
+                # silently (it never alerted anyone)
+            n_firing = sum(
+                1 for a in self._active.values() if a.state == "firing"
+            )
+            n_pending = len(self._active) - n_firing
+            self._fired_total += fired
+            self._resolved_total += resolved
+        if fired:
+            metrics.incr("alerts.fired", fired)
+        if resolved:
+            metrics.incr("alerts.resolved", resolved)
+        alert_gauge("alerts.firing", n_firing)
+        alert_gauge("alerts.pending", n_pending)
+        alert_gauge("alerts.baselines", len(self._baselines))
+        return {"fired": fired, "resolved": resolved}
+
+    def _push_history(self, a: Alert) -> None:
+        cap = (
+            self._history_capacity
+            if self._history_capacity is not None
+            else config.alert_history_capacity
+        )
+        self._history.append(a.to_dict())
+        while len(self._history) > max(int(cap), 1):
+            self._history.popleft()
+
+    def _exemplar(self, rule: AlertRule, br: Breach) -> Optional[str]:
+        """The trace id this alert links to: the worst matching
+        slowlog entry for latency/error rules, else the newest span
+        whose name matches the rule's families, else the newest span
+        at all (something recent beats nothing)."""
+        from orientdb_tpu.obs.slowlog import slowlog
+        from orientdb_tpu.obs.trace import tracer
+
+        if rule.exemplar == "slowlog":
+            best = None
+            for e in slowlog.entries():
+                if e.get("trace_id") is None:
+                    continue
+                if e.get("fingerprint") not in (None, br.key):
+                    continue
+                if best is None or e["ms"] > best["ms"]:
+                    best = e
+            if best is not None:
+                return best["trace_id"]
+        spans = tracer.spans()
+        if rule.exemplar_spans:
+            for sp in reversed(spans):
+                if sp.name.startswith(rule.exemplar_spans):
+                    return sp.trace_id
+        return spans[-1].trace_id if spans else None
+
+    # -- reading (scrape-time) ----------------------------------------------
+
+    def export(self) -> Dict[str, Dict[str, int]]:
+        """Scalar per-rule counts for ``registry.snapshot_all`` — the
+        unit the exposition renders (``orienttpu_alert_firing{rule=…}``)
+        and ``/cluster/metrics`` fans in per member. Every catalog rule
+        is present (zeros included) so the series always exist."""
+        out = {r: {"firing": 0, "pending": 0} for r in RULE_CATALOG}
+        with self._mu:
+            for a in self._active.values():
+                slot = out.setdefault(a.rule, {"firing": 0, "pending": 0})
+                slot[a.state if a.state == "firing" else "pending"] += 1
+        return out
+
+    def active(self) -> List[Dict]:
+        """Active (pending + firing) alerts, firing first."""
+        with self._mu:
+            items = [a.to_dict() for a in self._active.values()]
+        items.sort(key=lambda a: (a["state"] != "firing", a["rule"], a["key"]))
+        return items
+
+    def history(self, limit: Optional[int] = None) -> List[Dict]:
+        """Resolved alerts, most recent first."""
+        with self._mu:
+            items = list(self._history)
+        items.reverse()
+        return items if limit is None else items[:limit]
+
+    def summary(self) -> Dict[str, object]:
+        """The watchdog evidence record: rules evaluated, lifecycle
+        totals, learned-baseline count, and tick freshness."""
+        with self._mu:
+            n_firing = sum(
+                1 for a in self._active.values() if a.state == "firing"
+            )
+            last = self._last_tick_ts
+            return {
+                "rules": len(RULE_CATALOG),
+                "ticks": self._ticks,
+                "firing": n_firing,
+                "pending": len(self._active) - n_firing,
+                "fired_total": self._fired_total,
+                "resolved_total": self._resolved_total,
+                "baselines": len(self._baselines),
+                "last_tick_ts": round(last, 3) if last else None,
+                "tick_age_s": (
+                    round(time.time() - last, 3) if last else None
+                ),
+            }
+
+    def report(self) -> Dict[str, object]:
+        """The ``GET /alerts`` JSON document."""
+        return {
+            "ts": round(time.time(), 3),
+            "summary": self.summary(),
+            "alerts": self.active(),
+            "history": self.history(50),
+        }
+
+    def reset(self) -> None:
+        with self._eval_mu:  # never mid-tick: ticks see reset state whole
+            with self._mu:
+                self._active.clear()
+                self._history.clear()
+                self._ticks = 0
+                self._fired_total = 0
+                self._resolved_total = 0
+                self._last_tick_ts = None
+                self._baselines.clear()
+                self._prev_qs.clear()
+                self._indoubt_seen.clear()
+                self._burn_samples.clear()
+            self._prev_recompiles = None
+
+    # -- rule conditions -----------------------------------------------------
+
+    def _check_replication_lag(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_repl_lag_entries
+        if thr <= 0:
+            return
+        if ctx.cluster is not None:
+            with ctx.cluster._lock:
+                members = list(ctx.cluster.members.values())
+                primary = ctx.cluster.primary
+            pdb = next(
+                (m.db for m in members if m.name == primary), None
+            )
+            head = getattr(getattr(pdb, "_wal", None), "next_lsn", 1) - 1
+            for m in members:
+                if m.role != "REPLICA":
+                    continue
+                applied = max(
+                    m.puller.applied_lsn if m.puller is not None else 0,
+                    getattr(m.db, "_repl_applied_lsn", 0),
+                )
+                lag = head - applied
+                if lag > thr:
+                    yield Breach(
+                        m.name, lag, thr,
+                        f"replica {m.name} applied lsn {applied} trails "
+                        f"head {head} by {lag} entries",
+                    )
+            return
+        lag = ctx.gauges.get("replication.lag_entries", 0)
+        if lag > thr:
+            yield Breach(
+                "local", lag, thr,
+                f"replication lag {int(lag)} entries (gauge)",
+            )
+
+    def _check_breaker_open(self, ctx: AlertContext) -> Iterable[Breach]:
+        from orientdb_tpu.parallel.resilience import breaker_snapshot
+
+        for name, snap in breaker_snapshot().items():
+            if snap.get("state") == "open":
+                yield Breach(
+                    name, 1, 0,
+                    f"circuit breaker {name} is open "
+                    f"(failures={snap.get('failures')})",
+                )
+
+    def _check_indoubt_age(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_indoubt_age_s
+        seen_now = set()
+        for db in ctx.dbs:
+            reg = getattr(db, "_tx2pc_registry", None)
+            if reg is None:
+                continue
+            for st in reg.staged_report():
+                ident = (db.name, st["txid"])
+                seen_now.add(ident)
+                first = self._indoubt_seen.setdefault(ident, ctx.now)
+                age = ctx.now - first
+                if age >= thr:
+                    yield Breach(
+                        f"{db.name}/{st['txid']}", age, thr,
+                        f"2PC batch {st['txid']} on '{db.name}' staged "
+                        f"for {age:.1f}s ({st['ops']} ops, "
+                        f"{len(st['locked_rids'])} locks held)",
+                    )
+        for ident in list(self._indoubt_seen):
+            if ident not in seen_now:
+                del self._indoubt_seen[ident]
+
+    def _check_cdc_backlog(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_cdc_queue_depth
+        if thr <= 0:
+            return
+        for db in ctx.dbs:
+            feed = db.__dict__.get("_cdc_feed")
+            if feed is None:
+                continue
+            for c in feed.stats()["consumers"]:
+                worst = max(c["queue_depth"], c["lag_entries"])
+                if worst > thr:
+                    name = c["name"] or f"#{c['token']}"
+                    yield Breach(
+                        f"{db.name}/{name}", worst, thr,
+                        f"cdc consumer {name} on '{db.name}': queue "
+                        f"{c['queue_depth']}, lag {c['lag_entries']} "
+                        f"entries, {c['shed_events']} shed",
+                    )
+
+    def _check_wal_growth(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_wal_bytes
+        v = ctx.gauges.get("wal.segment_bytes", 0)
+        if thr > 0 and v > thr:
+            yield Breach(
+                "wal", v, thr, f"WAL segments at {int(v)} bytes"
+            )
+
+    def _check_rss(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_rss_bytes
+        v = ctx.gauges.get("proc.rss_bytes", 0)
+        if thr > 0 and v > thr:
+            yield Breach("rss", v, thr, f"RSS at {int(v)} bytes")
+
+    def _check_jax_buffers(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_jax_buffer_bytes
+        v = ctx.gauges.get("jax.live_buffer_bytes", 0)
+        if thr > 0 and v > thr:
+            yield Breach(
+                "jax", v, thr, f"live jax buffers at {int(v)} bytes"
+            )
+
+    def _check_recompile_storm(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_recompiles_per_min
+        total = sum(
+            int(row.get("recompiles", 0))
+            for row in ctx.query_stats.values()
+        )
+        prev, prev_ts = self._prev_recompiles, self._prev_recompiles_ts
+        self._prev_recompiles = total
+        self._prev_recompiles_ts = ctx.now
+        if prev is None or thr <= 0:
+            return
+        dt = max(ctx.now - prev_ts, 1e-3)
+        rate = (total - prev) * 60.0 / dt
+        if rate > thr:
+            yield Breach(
+                "recompiles", rate, thr,
+                f"{rate:.1f} shape-overflow recompiles/min",
+            )
+
+    def _check_latency_regression(
+        self, ctx: AlertContext
+    ) -> Iterable[Breach]:
+        min_calls = max(int(config.alert_latency_min_calls), 1)
+        for fid, row in ctx.query_stats.items():
+            calls = int(row.get("calls", 0))
+            total_s = float(row.get("total_s", 0.0))
+            pc, pt, _pe = self._prev_qs.get(fid, (0, 0.0, 0))
+            d_calls = calls - pc
+            d_total = total_s - pt
+            if d_calls <= 0:
+                continue
+            mean_s = d_total / d_calls
+            base = self._baselines.setdefault(fid, _Baseline())
+            if base.breaches(mean_s):
+                # a regressed tick must NOT fold into its own baseline:
+                # with alert_pending_ticks > 1 a sustained step would
+                # otherwise teach the EWMA the new level before the
+                # dwell elapses and the alert could never reach firing
+                if d_calls >= min_calls:
+                    yield Breach(
+                        fid, mean_s * 1000.0,
+                        (base.ewma_s
+                         + config.alert_latency_mads
+                         * max(base.mad_s, _MAD_FLOOR_S)) * 1000.0,
+                        f"fingerprint {fid}: tick mean "
+                        f"{mean_s * 1e3:.2f} ms vs baseline "
+                        f"{base.ewma_s * 1e3:.2f} ms "
+                        f"(±{max(base.mad_s, _MAD_FLOOR_S) * 1e3:.2f})",
+                    )
+            else:
+                base.update(mean_s)
+
+    def _check_error_burn(self, ctx: AlertContext) -> Iterable[Breach]:
+        slo = config.alert_slo_error_rate
+        factor = config.alert_burn_factor
+        calls = sum(
+            int(r.get("calls", 0)) for r in ctx.query_stats.values()
+        )
+        errors = sum(
+            int(r.get("errors", 0)) for r in ctx.query_stats.values()
+        )
+        samples = self._burn_samples
+        samples.append((ctx.now, calls, errors))
+        # prune, but KEEP the newest sample at-or-before the long
+        # window's floor — it is that window's differencing base
+        while (
+            len(samples) >= 2
+            and samples[1][0] <= ctx.now - BURN_LONG_S
+        ):
+            samples.popleft()
+        if slo <= 0 or factor <= 0:
+            return
+
+        def window_rate(width_s: float) -> Optional[float]:
+            """Error rate over the trailing window, or None while the
+            sample history does not yet SPAN it — a young history must
+            not let the long window degenerate into the short one
+            (that would page on exactly the transient blip the long
+            window exists to absorb)."""
+            floor = ctx.now - width_s
+            base = None
+            for ts, c, e in samples:
+                if ts <= floor:
+                    base = (c, e)
+                else:
+                    break
+            if base is None:
+                return None
+            dc, de = calls - base[0], errors - base[1]
+            return (de / dc) if dc > 0 else None
+
+        short = window_rate(BURN_SHORT_S)
+        long_ = window_rate(BURN_LONG_S)
+        if short is None or long_ is None:
+            return
+        if short >= slo * factor and long_ >= slo * factor:
+            yield Breach(
+                "queries", short / slo, factor,
+                f"error rate {short:.3f} (short) / {long_:.3f} (long) "
+                f"burns the {slo:.3f} SLO budget at "
+                f"{short / slo:.1f}x / {long_ / slo:.1f}x",
+            )
+
+
+def _rule(
+    name: str,
+    severity: str,
+    check: Callable[[AlertEngine, AlertContext], Iterable[Breach]],
+    exemplar: str = "span",
+    exemplar_spans: Tuple[str, ...] = (),
+) -> AlertRule:
+    """Declare one built-in rule (the literal ``name`` is what
+    ``alertlint`` cross-checks against :data:`RULE_CATALOG`)."""
+    if name not in RULE_CATALOG:
+        raise ValueError(f"alert rule {name!r} is not in RULE_CATALOG")
+    return AlertRule(name, severity, check, exemplar, exemplar_spans)
+
+
+#: the built-in catalog, evaluated in order every tick
+BUILTIN_RULES: Tuple[AlertRule, ...] = (
+    _rule(
+        "replication_lag", "critical",
+        AlertEngine._check_replication_lag,
+        exemplar_spans=("replication.", "wal.append"),
+    ),
+    _rule(
+        "breaker_open", "critical", AlertEngine._check_breaker_open,
+        exemplar_spans=("forward.request", "replication.", "tx2pc."),
+    ),
+    _rule(
+        "indoubt_2pc_age", "critical", AlertEngine._check_indoubt_age,
+        exemplar_spans=("tx2pc.",),
+    ),
+    _rule(
+        "cdc_backlog", "warning", AlertEngine._check_cdc_backlog,
+        exemplar_spans=("cdc.",),
+    ),
+    _rule("wal_growth", "warning", AlertEngine._check_wal_growth,
+          exemplar_spans=("wal.append",)),
+    _rule("rss_watermark", "warning", AlertEngine._check_rss),
+    _rule(
+        "jax_buffer_watermark", "warning", AlertEngine._check_jax_buffers,
+        exemplar_spans=("tpu.",),
+    ),
+    _rule(
+        "recompile_storm", "warning", AlertEngine._check_recompile_storm,
+        exemplar="slowlog",
+    ),
+    _rule(
+        "latency_regression", "warning",
+        AlertEngine._check_latency_regression, exemplar="slowlog",
+    ),
+    _rule(
+        "error_burn_rate", "critical", AlertEngine._check_error_burn,
+        exemplar="slowlog",
+    ),
+)
+
+
+#: the process-wide engine (mirrors stats/profiler/tracer singletons);
+#: the watchdog ticks it, the HTTP/console/bundle surfaces read it
+engine = AlertEngine()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering (shared by /alerts and the registry fan-in)
+# ---------------------------------------------------------------------------
+
+#: exported per-rule families: (export field, family suffix)
+ALERT_FAMILIES: Tuple[Tuple[str, str], ...] = (
+    ("firing", "alert_firing"),
+    ("pending", "alert_pending"),
+)
+
+
+def render_alerts_into(
+    lines: List[str],
+    snapshots: Dict[Optional[str], Dict[str, Dict[str, int]]],
+) -> None:
+    """Render per-rule alert-state gauges in exposition order (family
+    outer, members+rules inner). ``snapshots`` maps a member name (or
+    None for the single-process form) to that member's
+    :meth:`AlertEngine.export` dict — the ``render_stats_into``
+    convention, so the fan-in joins on the ``rule`` label."""
+    members = sorted(snapshots, key=lambda m: m or "")
+    for field, fam in ALERT_FAMILIES:
+        m = f"orienttpu_{fam}"
+        header_done = False
+        for mem in members:
+            for rule in sorted(snapshots[mem] or {}):
+                v = snapshots[mem][rule].get(field)
+                if v is None:
+                    continue
+                if not header_done:
+                    lines.append(f"# HELP {m} orientdb-tpu metric {m}")
+                    lines.append(f"# TYPE {m} gauge")
+                    header_done = True
+                labels = f'rule="{rule}"'
+                if mem is not None:
+                    labels += f',member="{mem}"'
+                lines.append(f"{m}{{{labels}}} {v}")
+
+
+def render_alerts_prometheus() -> str:
+    """``GET /alerts?format=prometheus``: the per-rule state gauges."""
+    lines: List[str] = []
+    render_alerts_into(lines, {None: engine.export()})
+    return "\n".join(lines) + "\n"

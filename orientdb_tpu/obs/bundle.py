@@ -16,7 +16,12 @@ history as ONE artifact, not four endpoints scraped in a hurry:
   the coordinator-side in-doubt reports (``twophase.INDOUBT_LOG``);
 - changefeed state per database (``orientdb_tpu/cdc``): head LSN,
   consumer lag/queue depth/shed counts, durable cursors — the first
-  thing to read when a downstream pipeline reports missing events.
+  thing to read when a downstream pipeline reports missing events;
+- the alert plane (``obs/alerts``): active and recently-resolved
+  alerts (exemplar trace ids included) + the watchdog summary;
+- the bounded log ring (``utils/logging.log_ring``): recent structured
+  log records carrying the trace/span ids of whatever emitted them —
+  an alert, its exemplar trace, and its log lines join on one id.
 
 Served as ``GET /debug/bundle`` (admin-only) and from the console as
 ``DIAG [<path>]``. Everything here is JSON-friendly by construction.
@@ -88,8 +93,10 @@ def debug_bundle(
     """The full bundle. ``dbs`` are this process's databases (for
     staged-2PC state); ``cluster`` (when attached) contributes the
     membership status block."""
+    from orientdb_tpu.obs.alerts import engine
     from orientdb_tpu.obs.profile import profiler
     from orientdb_tpu.obs.stats import stats
+    from orientdb_tpu.utils.logging import log_ring
 
     dbs = list(dbs)  # iterated twice: 2PC state and cdc state
     out: Dict[str, object] = {
@@ -102,6 +109,15 @@ def debug_bundle(
         "profile": profiler.profile(),
         "in_doubt_2pc": in_doubt_state(dbs),
         "cdc": cdc_state(dbs),
+        "alerts": {
+            "summary": engine.summary(),
+            "active": engine.active(),
+            "history": engine.history(50),
+        },
+        # recent structured log records, trace/span-correlated — the
+        # ring is bounded (config.log_ring_capacity) and ships only
+        # inside this admin-only bundle
+        "logs": log_ring.entries(),
     }
     if cluster is not None:
         try:

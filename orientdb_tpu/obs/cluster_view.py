@@ -60,6 +60,14 @@ def _get_json(url: str, user: str, password: str) -> Dict:
             return json.loads(r.read())
 
 
+def _alerts_block() -> Dict:
+    """The health document's alert view: active alerts (pending +
+    firing, exemplars included) and the watchdog summary."""
+    from orientdb_tpu.obs.alerts import engine
+
+    return {"summary": engine.summary(), "active": engine.active()}
+
+
 def _staged_2pc(db) -> int:
     """In-doubt (prepared, undecided) 2PC batches staged on a database."""
     reg = getattr(db, "_tx2pc_registry", None)
@@ -127,6 +135,7 @@ def cluster_health(server) -> Dict:
             "members": {server.name: member},
             "breakers": breaker_snapshot(),
             "indoubt_pending": resolver.pending(),
+            "alerts": _alerts_block(),
         }
     with cluster._lock:
         members = dict(cluster.members)
@@ -153,6 +162,10 @@ def cluster_health(server) -> Dict:
         # the coordinator-side in-doubt backlog the probe is resolving
         "breakers": breaker_snapshot(),
         "indoubt_pending": resolver.pending(),
+        # the alert plane's view (obs/alerts): active alerts with
+        # exemplar trace ids + the watchdog summary — the "is anything
+        # wrong" answer next to the raw per-member signals above
+        "alerts": _alerts_block(),
     }
 
 

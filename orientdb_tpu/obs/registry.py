@@ -167,6 +167,7 @@ def snapshot_all() -> Dict[str, Dict]:
     ``/metrics?format=json`` serves and ``/cluster/metrics`` fans in
     per member. Memory/process telemetry gauges (obs/profile) refresh
     at scrape time, right before the snapshot is taken."""
+    from orientdb_tpu.obs.alerts import engine
     from orientdb_tpu.obs.profile import run_gauge_providers
     from orientdb_tpu.obs.stats import stats
     from orientdb_tpu.utils.metrics import metrics
@@ -175,6 +176,9 @@ def snapshot_all() -> Dict[str, Dict]:
     snap = metrics.snapshot()
     snap["histograms"] = obs.snapshot()
     snap["query_stats"] = stats.export()
+    # per-rule alert state (obs/alerts): READ-only at scrape time —
+    # rule evaluation happens at watchdog tick, never here
+    snap["alerts"] = engine.export()
     return snap
 
 
@@ -230,6 +234,11 @@ def _render_into(lines: List[str], snap: Dict) -> None:
         from orientdb_tpu.obs.stats import render_stats_into
 
         render_stats_into(lines, {None: qs})
+    al = snap.get("alerts")
+    if al:
+        from orientdb_tpu.obs.alerts import render_alerts_into
+
+        render_alerts_into(lines, {None: al})
 
 
 def render_prometheus() -> str:
@@ -331,5 +340,14 @@ def render_prometheus_multi(snapshots: Dict[str, Dict]) -> str:
         render_stats_into(
             lines,
             {m: snapshots[m].get("query_stats") or {} for m in members},
+        )
+    # per-rule alert state, fanned in with BOTH labels — one family
+    # answers "which member is firing which rule" across the fleet
+    if any(snapshots[m].get("alerts") for m in members):
+        from orientdb_tpu.obs.alerts import render_alerts_into
+
+        render_alerts_into(
+            lines,
+            {m: snapshots[m].get("alerts") or {} for m in members},
         )
     return "\n".join(lines) + "\n"

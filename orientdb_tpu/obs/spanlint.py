@@ -57,6 +57,8 @@ SPAN_CATALOG: Dict[str, str] = {
     "consumer's cursor decoded to events",
     "cdc.push": "one changefeed delivery (binary push frame or HTTP "
     "/changes long-poll response)",
+    "watchdog.tick": "one health-watchdog alert-rule evaluation round "
+    "(obs/watchdog; never on the query hot path)",
 }
 
 #: dynamically named span families (f-string call sites the literal

@@ -359,6 +359,32 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if head == "alerts" and not rest:
+                # the alerting plane (obs/alerts, obs/watchdog): active
+                # pending/firing alerts with exemplar trace ids, the
+                # resolved history ring, and the watchdog summary. JSON
+                # by default; ?format=prometheus serves the per-rule
+                # orienttpu_alert_firing{rule=...} state gauges.
+                from orientdb_tpu.obs.alerts import (
+                    engine as alert_engine,
+                    render_alerts_prometheus,
+                )
+
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                if "prometheus" in q.get("format", []):
+                    body = render_alerts_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                return self._send(200, alert_engine.report())
             if head == "stats" and rest in (["queries"], ["profile"]):
                 # the query-statistics plane (obs/stats, obs/profile):
                 # per-fingerprint cumulative cost, top-K by any column,

@@ -80,6 +80,7 @@ class Server:
         #: cluster_view) read it; None for a standalone server
         self.cluster = None
         self._lock = threading.Lock()
+        self._watchdog = None
         self._http = None
         self._binary = None
         self._http_port = http_port
@@ -193,6 +194,15 @@ class Server:
         from orientdb_tpu.obs.profile import register_server_telemetry
 
         self._telemetry_provider = register_server_telemetry(self)
+        # health watchdog (obs/watchdog): periodic alert-rule
+        # evaluation over this server's databases + cluster — started
+        # and stopped with the server, like Cluster's probe thread
+        from orientdb_tpu.utils.config import config
+
+        if config.watchdog_enabled:
+            from orientdb_tpu.obs.watchdog import HealthWatchdog
+
+            self._watchdog = HealthWatchdog(self).start()
         self.running = True
         log.info(
             "server '%s' up: http=%d binary=%d",
@@ -204,6 +214,10 @@ class Server:
 
     def shutdown(self) -> None:
         self.running = False
+        wd = self._watchdog
+        if wd is not None:
+            self._watchdog = None
+            wd.stop()
         for p in self.plugins:
             try:
                 p.shutdown()

@@ -14,6 +14,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   SLOWLOG [<n>|CLEAR]                 DIAG [<path>]
   STATS QUERIES [<k>]                 STATS PROFILE / STATS RESET
   CDC LIST                            CDC LAG
+  ALERTS [<n>|HISTORY]                HEALTH
 """
 
 from __future__ import annotations
@@ -358,6 +359,82 @@ class Console(cmd.Cmd):
                         f"unacked={c['unacked_entries']:<6} "
                         f"shed={c['shed_events']}"
                     )
+
+    def do_alerts(self, arg: str) -> None:
+        """ALERTS [<n>|HISTORY] — the alert plane (obs/alerts): active
+        pending/firing alerts with exemplar trace ids; HISTORY lists
+        recently resolved ones."""
+        from orientdb_tpu.obs.alerts import engine
+
+        a = arg.strip().lower()
+        if a == "history":
+            items = engine.history(20)
+            if not items:
+                self._p("no resolved alerts")
+                return
+            for e in items:
+                self._p(
+                    f"[resolved] {e['rule']}({e['key']}) "
+                    f"value={e['value']:g} thr={e['threshold']:g}"
+                    + (
+                        f" trace={e['exemplar_trace_id']}"
+                        if e.get("exemplar_trace_id")
+                        else ""
+                    )
+                )
+            self._p(f"({len(items)} resolved)")
+            return
+        limit = int(a) if a.isdigit() else 20
+        items = engine.active()[:limit]
+        if not items:
+            self._p("no active alerts")
+            return
+        for e in items:
+            trace = (
+                f" trace={e['exemplar_trace_id']}"
+                if e.get("exemplar_trace_id")
+                else ""
+            )
+            self._p(
+                f"[{e['state']:<7}] {e['severity']:<8} "
+                f"{e['rule']}({e['key']}) value={e['value']:g} "
+                f"thr={e['threshold']:g}{trace}  {e['detail']}"
+            )
+        self._p(f"({len(items)} active)")
+
+    def do_health(self, _arg: str) -> None:
+        """HEALTH — watchdog summary (rules/ticks/lifecycle totals),
+        circuit-breaker states, and per-database in-doubt 2PC counts —
+        the console's answer to GET /cluster/health."""
+        from orientdb_tpu.obs.alerts import engine
+        from orientdb_tpu.parallel.resilience import breaker_snapshot
+
+        s = engine.summary()
+        self._p(
+            f"watchdog: rules={s['rules']} ticks={s['ticks']} "
+            f"firing={s['firing']} pending={s['pending']} "
+            f"fired_total={s['fired_total']} "
+            f"resolved_total={s['resolved_total']} "
+            f"baselines={s['baselines']}"
+            + (
+                f" tick_age={s['tick_age_s']:g}s"
+                if s["tick_age_s"] is not None
+                else " (no tick yet)"
+            )
+        )
+        breakers = breaker_snapshot()
+        for name, b in sorted(breakers.items()):
+            self._p(f"breaker {name}: {b['state']}")
+        if not breakers:
+            self._p("no circuit breakers registered")
+        dbs = list(self._embedded.values())
+        if self.db is not None and self.db not in dbs:
+            dbs.append(self.db)
+        for db in dbs:
+            reg = getattr(db, "_tx2pc_registry", None)
+            staged = len(reg.staged_report()) if reg is not None else 0
+            if staged:
+                self._p(f"database '{db.name}': {staged} in-doubt 2pc")
 
     def do_diag(self, arg: str) -> None:
         """DIAG [<path>] — flight-recorder debug bundle (obs/bundle):

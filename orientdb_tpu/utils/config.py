@@ -160,6 +160,43 @@ class GlobalConfiguration:
     cdc_poll_timeout_s: float = 10.0
     cdc_cursor_retention_s: float = 7 * 86400.0
 
+    # Alerting & health watchdog (obs/alerts, obs/watchdog): the
+    # watchdog thread starts with Server and evaluates the alert-rule
+    # catalog every watchdog_interval_s seconds over the registry
+    # snapshot — nothing runs on the query hot path. A rule must breach
+    # for alert_pending_ticks consecutive ticks before its alert fires
+    # (pending -> firing); resolved alerts land in a bounded history
+    # ring of alert_history_capacity entries.
+    watchdog_enabled: bool = True
+    watchdog_interval_s: float = 5.0
+    alert_pending_ticks: int = 2
+    alert_history_capacity: int = 256
+    # Per-rule thresholds (the built-in catalog; README "Alerting &
+    # health watchdog" documents each rule):
+    alert_repl_lag_entries: int = 64
+    alert_indoubt_age_s: float = 30.0
+    alert_cdc_queue_depth: int = 512
+    alert_wal_bytes: int = 1 << 30
+    alert_rss_bytes: int = 12 << 30
+    alert_jax_buffer_bytes: int = 14 << 30
+    alert_recompiles_per_min: float = 30.0
+    # Latency-regression baseline: a fingerprint's per-tick mean must
+    # exceed its online EWMA by alert_latency_mads deviations (EWMA of
+    # absolute deviation, the online MAD analog) with at least
+    # alert_latency_min_calls calls in the tick to breach.
+    alert_latency_mads: float = 6.0
+    alert_latency_min_calls: int = 20
+    # Two-window error-budget burn rate: breach when the short AND long
+    # window error rates both exceed alert_burn_factor x the SLO
+    # error-rate target.
+    alert_slo_error_rate: float = 0.05
+    alert_burn_factor: float = 4.0
+
+    # Trace-correlated logging (utils/logging): the bounded in-memory
+    # ring of recent structured log records fed into the debug bundle's
+    # admin-only "logs" section.
+    log_ring_capacity: int = 512
+
     # WAL / durability for the host record store
     # (orientdb_tpu.storage.durability): when wal_enabled and wal_dir are
     # set, server-created databases recover-or-create durably under

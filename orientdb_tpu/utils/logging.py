@@ -1,12 +1,135 @@
-"""Structured logging (analog of OLogManager, [E] core/.../log/OLogManager.java)."""
+"""Structured logging (analog of OLogManager, [E] core/.../log/OLogManager.java).
+
+Grown into the trace-correlated half of the alerting plane (ISSUE 10):
+
+- a **LogRecord factory** stamps every record with the active
+  ``trace_id``/``span_id`` from :mod:`orientdb_tpu.obs.trace`, so a log
+  line emitted inside a query's span joins that query's trace, slowlog
+  entry, stats row — and any alert whose exemplar names the trace;
+- ``ORIENTTPU_LOG_FORMAT=json`` switches the stream handler to
+  one-JSON-object-per-line structured output (``ts``, ``level``,
+  ``logger``, ``msg``, plus ``trace_id``/``span_id`` when a span is
+  active). The default text format is unchanged, so existing
+  log-format assertions stay green;
+- a bounded in-memory **log ring** (``config.log_ring_capacity``)
+  captures recent records as JSON-friendly dicts and feeds the debug
+  bundle's admin-only ``logs`` section — an alert, its exemplar trace,
+  and the log lines it produced are joinable by one id.
+"""
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional
 
 _FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
 _configured = False
+
+
+def _current_ids():
+    """(trace_id, span_id) of the innermost active span on this
+    thread, or (None, None). Lazy import: logging configures before
+    the obs package (and must keep working if it cannot load)."""
+    try:
+        from orientdb_tpu.obs.trace import current_span
+
+        sp = current_span()
+        if sp is not None:
+            return sp.trace_id, sp.span_id
+    except Exception:
+        pass
+    return None, None
+
+
+def _install_record_factory() -> None:
+    """Wrap the process LogRecord factory so EVERY record carries
+    ``trace_id``/``span_id`` attributes (None outside any span) —
+    formatters and the ring read them without hasattr dances."""
+    base = logging.getLogRecordFactory()
+    if getattr(base, "_orienttpu_traced", False):
+        return  # already installed (re-entrant _ensure_configured)
+
+    def factory(*args, **kwargs):
+        record = base(*args, **kwargs)
+        record.trace_id, record.span_id = _current_ids()
+        return record
+
+    factory._orienttpu_traced = True
+    logging.setLogRecordFactory(factory)
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line (``ORIENTTPU_LOG_FORMAT=json``)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, object] = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        tid = getattr(record, "trace_id", None)
+        if tid is not None:
+            out["trace_id"] = tid
+            out["span_id"] = getattr(record, "span_id", None)
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class LogRing(logging.Handler):
+    """Bounded ring of recent records as JSON-friendly dicts — the
+    debug bundle's ``logs`` section (admin-only, like the traces that
+    share its ids). Capacity re-reads ``config.log_ring_capacity`` per
+    emit so tests (and a live console) can retune without restarting."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.NOTSET)
+        self._mu = threading.Lock()
+        self._ring: deque = deque()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            from orientdb_tpu.utils.config import config
+
+            cap = max(int(config.log_ring_capacity), 0)
+            entry: Dict[str, object] = {
+                "ts": round(record.created, 3),
+                "level": record.levelname,
+                "logger": record.name,
+                "msg": record.getMessage(),
+                "trace_id": getattr(record, "trace_id", None),
+                "span_id": getattr(record, "span_id", None),
+            }
+            with self._mu:
+                if cap <= 0:
+                    self._ring.clear()
+                    return
+                self._ring.append(entry)
+                while len(self._ring) > cap:
+                    self._ring.popleft()
+        except Exception:  # a log record must never crash its caller
+            pass
+
+    def entries(self, limit: Optional[int] = None) -> List[Dict]:
+        """Most recent first."""
+        with self._mu:
+            items = list(self._ring)
+        items.reverse()
+        return items if limit is None else items[:limit]
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+
+
+#: the process-wide ring (mirrors obs.slowlog.slowlog); attached to the
+#: package logger by _ensure_configured
+log_ring = LogRing()
 
 
 def _ensure_configured() -> None:
@@ -14,7 +137,17 @@ def _ensure_configured() -> None:
     if _configured:
         return
     level = os.environ.get("ORIENTTPU_LOG_LEVEL", "WARNING").upper()
+    _install_record_factory()
     logging.basicConfig(level=getattr(logging, level, logging.WARNING), format=_FORMAT)
+    if os.environ.get("ORIENTTPU_LOG_FORMAT", "").lower() == "json":
+        for h in logging.getLogger().handlers:
+            if isinstance(h, logging.StreamHandler):
+                h.setFormatter(JsonFormatter())
+    # the ring rides the package logger so only orientdb_tpu records
+    # land in it, regardless of what the root logger is formatted as
+    pkg = logging.getLogger("orientdb_tpu")
+    if log_ring not in pkg.handlers:
+        pkg.addHandler(log_ring)
     _configured = True
 
 

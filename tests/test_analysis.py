@@ -36,10 +36,11 @@ class TestTreeIsClean:
         assert rep.findings == [], "\n" + "\n".join(
             str(f) for f in rep.findings
         )
-        # all eight passes actually ran
+        # all nine passes actually ran
         assert set(rep.counts) >= {
             "locklint", "configlint", "exceptlint",
             "iolint", "spanlint", "promlint", "racelint", "jaxlint",
+            "alertlint",
         }
 
 
@@ -912,6 +913,57 @@ class TestPromlintMutation:
         assert run_pass(
             "promlint", {"orientdb_tpu/obs/m.py": src}
         ) == []
+
+    def test_alert_gauge_site_is_checked(self):
+        """The alert plane's summary-gauge helper (obs/alerts.
+        alert_gauge) publishes into the same registry — its literal
+        names obey the same grammar."""
+        src = (
+            "from orientdb_tpu.obs.alerts import alert_gauge\n"
+            'alert_gauge("Bad-Alert-Gauge", 1)\n'
+            'alert_gauge("alerts.firing", 2)\n'
+        )
+        fs = run_pass("promlint", {"orientdb_tpu/obs/m.py": src})
+        assert len(fs) == 1
+        assert "Bad-Alert-Gauge" in fs[0].message
+        assert fs[0].line == 2
+
+
+class TestAlertlintMutation:
+    """The ninth pass: every literal _rule()/AlertRule() name is in
+    RULE_CATALOG (obs/alerts), stale entries flag — the spanlint
+    contract applied to alert-rule declarations."""
+
+    def test_uncataloged_rule_name_flags(self):
+        src = (
+            "from orientdb_tpu.obs.alerts import _rule\n"
+            '_rule("replication_laag", "critical", lambda e, c: ())\n'
+        )
+        fs = run_pass("alertlint", {"orientdb_tpu/obs/x.py": src})
+        assert any(
+            "replication_laag" in f.message and f.line == 2 for f in fs
+        )
+
+    def test_cataloged_rule_name_is_clean(self):
+        src = (
+            "from orientdb_tpu.obs.alerts import AlertRule\n"
+            'AlertRule("replication_lag", "critical", lambda e, c: ())\n'
+        )
+        fs = run_pass("alertlint", {"orientdb_tpu/obs/x.py": src})
+        assert not any("replication_lag" in f.message for f in fs)
+
+    def test_stale_catalog_entry_flags_on_the_real_tree(
+        self, monkeypatch
+    ):
+        from orientdb_tpu.obs import alerts
+
+        monkeypatch.setitem(
+            alerts.RULE_CATALOG, "ghost_rule", "never declared"
+        )
+        rep = core.run(root=REPO, passes=["alertlint"])
+        assert len(rep.findings) == 1
+        assert "ghost_rule" in rep.findings[0].message
+        assert rep.findings[0].path == "orientdb_tpu/obs/alerts.py"
 
 
 class TestJaxlintMutations:
