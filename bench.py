@@ -113,6 +113,11 @@ def compact_line(
                     "ldbc_is",
                 ),
             ),
+            # the SLO verdict + burn from the mixed-traffic block
+            # (full report: BENCH_SLO_r{N}.json)
+            "slo": _slim(
+                ex.get("slo", {}), ("verdict", "burn", "failures")
+            ),
             "remote": _slim(
                 ex.get("remote", {}),
                 ("single_qps", "batch_qps", "pipeline_qps"),
@@ -125,7 +130,7 @@ def compact_line(
     line = json.dumps(compact)
     # q/s families go first: phase_split is the gate's STABLE signal
     # (device/host ms) and must be the last thing sacrificed
-    for victim in ("ldbc_is", "remote", "phase_split_ms_per_query"):
+    for victim in ("ldbc_is", "remote", "slo", "phase_split_ms_per_query"):
         if len(line) <= budget:
             break
         compact["extras"].pop(victim, None)
@@ -504,6 +509,55 @@ def _read_deviceguard():
         return None
 
 
+def run_mixed_slo_block(round_n: int, out_dir: str) -> dict:
+    """The production-traffic block (ISSUE 11): one seeded closed-loop
+    mixed workload (LDBC IS/IC reads + inserts/updates at the SNB
+    update ratio + cross-owner 2PC + live CDC consumers, concurrent
+    HTTP and binary sessions against a primary+replica cluster) under
+    a deterministic chaos plan, judged by the SLO plane (obs/slo). The
+    FULL run report persists to ``BENCH_SLO_r{N}.json`` (atomic_write;
+    the machine-readable verdict artifact); the returned summary rides
+    the headline extras. Env knobs: BENCH_SLO (0 skips),
+    BENCH_SLO_SEED (11 — schedule AND chaos seed), BENCH_SLO_PERSONS
+    (120), BENCH_SLO_SESSIONS (6), BENCH_SLO_OPS (25 per session)."""
+    from orientdb_tpu.storage.durability import atomic_write
+    from orientdb_tpu.workloads.driver import (
+        TrafficSim,
+        default_chaos_plan,
+    )
+
+    seed = int(os.environ.get("BENCH_SLO_SEED", "11"))
+    sim = TrafficSim(
+        seed=seed,
+        persons=int(os.environ.get("BENCH_SLO_PERSONS", "120")),
+        sessions=int(os.environ.get("BENCH_SLO_SESSIONS", "6")),
+        ops_per_session=int(os.environ.get("BENCH_SLO_OPS", "25")),
+        chaos=default_chaos_plan(seed),
+    )
+    report = sim.run()
+    path = os.path.join(out_dir, f"BENCH_SLO_r{round_n:02d}.json")
+    atomic_write(
+        path,
+        (json.dumps(report, indent=1, sort_keys=True) + "\n").encode(),
+    )
+    slo = report["slo"]
+    return {
+        "verdict": slo["verdict"],
+        "burn": slo["burn"],
+        "failures": [
+            f"{f['rule']}({f['key']})" for f in slo["failures"]
+        ][:5],
+        "calls": slo["calls"],
+        "errors": slo["errors"],
+        "schedule_digest": report["schedule_digest"],
+        "cdc_events": report["cdc"]["events"],
+        "chaos_fired": (report["chaos"] or {}).get("fired", 0),
+        "settled": report["settle"].get("settled"),
+        "wall_s": report["wall_s"],
+        "report_file": os.path.basename(path),
+    }
+
+
 def _round_stamp() -> int:
     """THIS run's round number: one past the newest driver record
     (BENCH_r{N}.json) in the repo root. Stamps the detail file so a
@@ -838,6 +892,28 @@ def _measure() -> None:
             ev("watchdog", **_ws)
         except Exception as e:
             ev("watchdog", error=f"{type(e).__name__}: {e}")
+
+    # mixed production-shaped traffic under chaos, judged by the SLO
+    # plane (ISSUE 11): the closed-loop simulator runs its OWN small
+    # cluster + dataset, so it neither needs nor disturbs the demodb
+    # graph the perf blocks time. Verdict + burn ride the headline
+    # extras; the full machine-readable report is BENCH_SLO_r{N}.json.
+    if os.environ.get("BENCH_SLO", "1") != "0" and budget_ok(
+        "mixed_slo", est_s=60
+    ):
+        with block_span("mixed_slo"):
+            try:
+                _slo = run_mixed_slo_block(round_n, detail_dir)
+                extras["slo"] = _slo
+                ev("mixed_slo", **_slo)
+            except Exception as e:
+                # the traffic sim failing IS evidence, but it must not
+                # cost the perf numbers behind it
+                extras["slo"] = {
+                    "verdict": "error",
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+                ev("mixed_slo", error=f"{type(e).__name__}: {e}")
 
     db = None
     if budget_ok("parity", est_s=120):

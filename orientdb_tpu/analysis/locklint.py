@@ -61,6 +61,7 @@ SCAN_DIRS = (
     "storage",
     "tools",
     "utils",
+    "workloads",
 )
 
 _LOCKY = re.compile(r"lock", re.IGNORECASE)

@@ -69,6 +69,9 @@ POINTS = frozenset(
         "cluster.probe",  # /cluster/health member probe + scrape
         "cdc.push",  # changefeed delivery: binary push frame + HTTP
         # /changes long-poll response (orientdb_tpu/cdc)
+        "workload.http",  # traffic-simulator HTTP client sessions
+        # (workloads/driver): every simulated HTTP request is
+        # injectable like any real channel
     }
 )
 

@@ -28,7 +28,7 @@ import os
 from typing import Iterator, List, Tuple
 
 #: package-relative directories scanned for inter-node I/O
-SCAN_DIRS = ("parallel", "server", "client", "obs", "cdc")
+SCAN_DIRS = ("parallel", "server", "client", "obs", "cdc", "workloads")
 
 #: bare-name calls that are inter-node I/O
 IO_NAMES = frozenset({"urlopen", "create_connection"})

@@ -59,6 +59,12 @@ SPAN_CATALOG: Dict[str, str] = {
     "/changes long-poll response)",
     "watchdog.tick": "one health-watchdog alert-rule evaluation round "
     "(obs/watchdog; never on the query hot path)",
+    "workload.run": "one closed-loop traffic-simulator run "
+    "(workloads/driver.TrafficSim: sessions + chaos + settle)",
+    "workload.session": "one simulated client session's closed-loop "
+    "op sequence (HTTP or binary transport)",
+    "slo.evaluate": "one SLO-verdict evaluation over a run window "
+    "(obs/slo: stats-table deltas + alert state + burn policy)",
 }
 
 #: dynamically named span families (f-string call sites the literal

@@ -174,9 +174,60 @@ EXPORT_FIELDS = (
     ("result_cache_hits", "query_result_cache_hits_total", "counter"),
 )
 
+#: latency quantiles derived from the per-entry histogram at read time
+#: (field name, quantile): the SLO plane (obs/slo) and /stats/queries
+#: read THESE instead of re-deriving their own estimates
+QUANTILE_FIELDS = (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99))
+
 #: columns /stats/queries?by=… may sort on (every numeric export field
-#: plus the derived mean)
-SORT_COLUMNS = tuple(f for f, _m, _t in EXPORT_FIELDS) + ("mean_ms",)
+#: plus the derived mean and histogram quantiles)
+SORT_COLUMNS = (
+    tuple(f for f, _m, _t in EXPORT_FIELDS)
+    + ("mean_ms",)
+    + tuple(f for f, _q in QUANTILE_FIELDS)
+)
+
+#: short spellings accepted by ``?by=`` (``by=p99`` == ``by=p99_ms``)
+SORT_ALIASES = {f.split("_")[0]: f for f, _q in QUANTILE_FIELDS}
+
+
+def resolve_sort_column(by: str) -> str:
+    """THE ``?by=`` resolution rule (alias expansion + unknown-column
+    fallback), shared by :meth:`QueryStats.top` and the HTTP handler
+    that echoes the resolved column — one copy, or the echo drifts
+    from the actual sort order."""
+    by = SORT_ALIASES.get(by, by)
+    return by if by in SORT_COLUMNS else "total_s"
+
+
+def estimate_quantile(
+    buckets, q: float, max_s: float = 0.0
+) -> float:
+    """Estimate the ``q`` latency quantile (seconds) from one entry's
+    histogram of PER-BUCKET counts (``_Entry.buckets``: one count per
+    ``_LAT_BUCKETS`` boundary plus overflow — NOT the cumulative-`le`
+    form a Prometheus exposition carries) — linear interpolation
+    inside the bucket the rank lands in. The overflow (+Inf) bucket is
+    bounded by the observed ``max_s`` instead of infinity, so a p99
+    living there still reads as a finite, honest number."""
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    seen = 0
+    lo = 0.0
+    for le, count in zip(_LAT_BUCKETS, buckets):
+        if seen + count >= rank and count > 0:
+            return lo + (le - lo) * (rank - seen) / count
+        seen += count
+        lo = le
+    # rank lands in the overflow bucket: interpolate toward max_s (or
+    # pin to the last boundary when max_s never exceeded it)
+    hi = max(max_s, lo)
+    count = buckets[-1]
+    if count <= 0:
+        return lo
+    return lo + (hi - lo) * (rank - seen) / count
 
 
 class _Entry:
@@ -238,6 +289,10 @@ class _Entry:
         out["mean_ms"] = (
             round(self.total_s * 1000.0 / self.calls, 3) if self.calls else 0.0
         )
+        for f, q in QUANTILE_FIELDS:
+            out[f] = round(
+                estimate_quantile(self.buckets, q, self.max_s) * 1000.0, 3
+            )
         out["engines"] = dict(self.engines)
         out["latency_buckets"] = {
             ("+Inf" if le is None else repr(le)): c
@@ -418,9 +473,9 @@ class QueryStats:
 
     def top(self, k: int = 50, by: str = "total_s") -> List[Dict]:
         """The top-``k`` fingerprints ordered by any export column
-        (``SORT_COLUMNS``); unknown columns fall back to total_s."""
-        if by not in SORT_COLUMNS:
-            by = "total_s"
+        (``SORT_COLUMNS``; ``p99`` et al alias their ``_ms`` forms);
+        unknown columns fall back to total_s."""
+        by = resolve_sort_column(by)
         with self._lock:
             rows = [e.to_dict() for e in self._map.values()]
         rows.sort(key=lambda r: r.get(by, 0), reverse=True)
@@ -446,6 +501,31 @@ class QueryStats:
         with self._lock:
             e = self._map.get(fid)
             return e.to_dict() if e is not None else None
+
+    def histogram_snapshot(self, fids=None) -> Dict[str, Dict]:
+        """Raw per-fingerprint histogram state for windowed readers
+        (the SLO engine differences two of these to score ONE run
+        instead of the process's whole cumulative history):
+        ``{fid: {calls, errors, total_s, max_s, buckets}}``. ``fids``
+        limits the snapshot; None snapshots the whole table."""
+        with self._lock:
+            entries = (
+                list(self._map.values())
+                if fids is None
+                else [
+                    self._map[f] for f in fids if f in self._map
+                ]
+            )
+            return {
+                e.fid: {
+                    "calls": e.calls,
+                    "errors": e.errors,
+                    "total_s": e.total_s,
+                    "max_s": e.max_s,
+                    "buckets": list(e.buckets),
+                }
+                for e in entries
+            }
 
     def reset(self) -> None:
         with self._lock:

@@ -278,6 +278,34 @@ class Cluster:
         m.puller.applied_lsn = applied_lsn
         m.puller.start()
 
+    def stop_replica(self, name: str) -> None:
+        """Stop ``name``'s puller — a simulated member death (the
+        chaos/simulator hook): the member stops replicating and its
+        applied LSN freezes while the primary's head advances, so the
+        replication-lag alert sees exactly what a dead replica looks
+        like. The primary keeps serving; no failover triggers (a dead
+        REPLICA must never cause an election)."""
+        with self._lock:
+            m = self.members.get(name)
+        if m is not None and m.puller is not None:
+            m.puller.stop()
+
+    def restart_replica(self, name: str) -> None:
+        """Bring a stopped replica back (simulated rejoin): a fresh
+        puller resumes from the member's settled cursor — the max of
+        the old puller's applied LSN and the db-level floor — and the
+        normal pull path takes it from there (including the gap/
+        full-resync handling a long outage may need)."""
+        with self._lock:
+            m = self.members.get(name)
+            if m is None or m.role != "REPLICA":
+                return
+            applied = max(
+                m.puller.applied_lsn if m.puller is not None else 0,
+                getattr(m.db, "_repl_applied_lsn", 0),
+            )
+            self._start_puller(m, applied_lsn=applied)
+
     # -- failure handling ---------------------------------------------------
 
     def _primary_down(self, reporter: str, watched: str) -> None:

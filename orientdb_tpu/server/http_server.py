@@ -385,6 +385,15 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(body)
                     return
                 return self._send(200, alert_engine.report())
+            if head == "slo" and not rest:
+                # the SLO-verdict plane (obs/slo): the last traffic-
+                # simulator run's machine-readable report — verdict,
+                # per-class windowed quantiles vs targets, failures
+                # naming their rule/key — or an explicit "none" marker
+                # when no run has been judged in this process
+                from orientdb_tpu.obs.slo import engine as slo_engine
+
+                return self._send(200, slo_engine.report())
             if head == "stats" and rest in (["queries"], ["profile"]):
                 # the query-statistics plane (obs/stats, obs/profile):
                 # per-fingerprint cumulative cost, top-K by any column,
@@ -399,8 +408,8 @@ class _Handler(BaseHTTPRequestHandler):
                     urllib.parse.urlparse(self.path).query
                 )
                 from orientdb_tpu.obs.stats import (
-                    SORT_COLUMNS,
                     render_stats_prometheus,
+                    resolve_sort_column,
                     stats,
                 )
 
@@ -419,11 +428,11 @@ class _Handler(BaseHTTPRequestHandler):
                     k = int(q.get("k", ["50"])[0])
                 except ValueError:
                     k = 50
-                by = q.get("by", ["total_s"])[0]
+                by = resolve_sort_column(q.get("by", ["total_s"])[0])
                 return self._send(
                     200,
                     {
-                        "by": by if by in SORT_COLUMNS else "total_s",
+                        "by": by,
                         "queries": stats.top(k, by=by),
                     },
                 )

@@ -15,6 +15,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   STATS QUERIES [<k>]                 STATS PROFILE / STATS RESET
   CDC LIST                            CDC LAG
   ALERTS [<n>|HISTORY]                HEALTH
+  SLO
 """
 
 from __future__ import annotations
@@ -299,12 +300,14 @@ class Console(cmd.Cmd):
             return
         self._p(
             f"{'fingerprint':<16} {'calls':>7} {'err':>5} {'mean ms':>9} "
+            f"{'p50 ms':>8} {'p99 ms':>8} "
             f"{'dev ms':>9} {'compile ms':>11} {'cache':>6}  query"
         )
         for r in rows:
             self._p(
                 f"{r['fingerprint']:<16} {r['calls']:>7} {r['errors']:>5} "
                 f"{r['mean_ms']:>9.2f} "
+                f"{r['p50_ms']:>8.1f} {r['p99_ms']:>8.1f} "
                 f"{r['device_s'] * 1000:>9.1f} "
                 f"{r['compile_s'] * 1000:>11.1f} "
                 f"{r['plan_cache_hits'] + r['result_cache_hits']:>6}  "
@@ -401,6 +404,37 @@ class Console(cmd.Cmd):
                 f"thr={e['threshold']:g}{trace}  {e['detail']}"
             )
         self._p(f"({len(items)} active)")
+
+    def do_slo(self, _arg: str) -> None:
+        """SLO — the last traffic-simulator run's SLO verdict
+        (obs/slo): pass/fail, error-budget burn, per-class windowed
+        p50/p99 vs targets, and every failure naming its rule/key."""
+        from orientdb_tpu.obs.slo import engine as slo_engine
+
+        r = slo_engine.report()
+        if r.get("verdict") == "none":
+            self._p("no SLO run recorded (workloads.driver.TrafficSim)")
+            return
+        self._p(
+            f"verdict: {r['verdict'].upper()}  burn={r['burn']:g}  "
+            f"calls={r['calls']} errors={r['errors']}  "
+            f"window={r['window_s']:g}s"
+        )
+        self._p(
+            f"{'class':<10} {'calls':>7} {'err':>5} {'p50 ms':>9} "
+            f"{'p99 ms':>9} {'targets (p50/p99/avail)':>26}"
+        )
+        for c in r["classes"]:
+            t = c["targets"]
+            self._p(
+                f"{c['class']:<10} {c['calls']:>7} {c['errors']:>5} "
+                f"{c.get('p50_ms', 0.0):>9.1f} {c.get('p99_ms', 0.0):>9.1f} "
+                f"{t['p50_ms']:>10g}/{t['p99_ms']:g}/{t['availability']:g}"
+            )
+        for f in r["failures"]:
+            self._p(f"FAIL {f['rule']}({f['key']}): {f['detail']}")
+        if not r["failures"]:
+            self._p("(no failures)")
 
     def do_health(self, _arg: str) -> None:
         """HEALTH — watchdog summary (rules/ticks/lifecycle totals),

@@ -197,6 +197,25 @@ class GlobalConfiguration:
     # admin-only "logs" section.
     log_ring_capacity: int = 512
 
+    # Traffic simulator (workloads/driver): defaults for the closed-
+    # loop mixed LDBC driver — concurrent client sessions (split HTTP/
+    # binary), operations per session, the SNB-shaped write fraction of
+    # the mix, and the settle window after chaos clears (replicas catch
+    # up, breakers half-open, alerts resolve) before the SLO verdict.
+    workload_sessions: int = 8
+    workload_ops: int = 50
+    workload_update_ratio: float = 0.1
+    workload_settle_s: float = 8.0
+    # SLO verdicts (obs/slo): default per-query-class targets a spec
+    # inherits when a class declares none — p50/p99 latency ceilings
+    # (milliseconds, read from the query-stats histograms), minimum
+    # per-class success rate, and the error-budget burn ceiling (run
+    # error rate over alert_slo_error_rate; > slo_max_burn fails).
+    slo_p50_ms: float = 500.0
+    slo_p99_ms: float = 5000.0
+    slo_availability: float = 0.99
+    slo_max_burn: float = 1.0
+
     # WAL / durability for the host record store
     # (orientdb_tpu.storage.durability): when wal_enabled and wal_dir are
     # set, server-created databases recover-or-create durably under

@@ -1,1 +1,5 @@
 from orientdb_tpu.workloads.ldbc import IS_QUERIES, is_query  # noqa: F401
+
+#: the closed-loop traffic simulator (workloads/driver) is imported
+#: lazily by its users — importing it here would pull the whole
+#: cluster/server stack into every `import orientdb_tpu.workloads`

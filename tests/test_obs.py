@@ -255,6 +255,9 @@ class TestEvidence:
             BENCH_SKEW_PERSONS="0",
             BENCH_MESH_SCALING="0",
             BENCH_REMOTE="0",
+            BENCH_SLO="0",  # the traffic sim has its own tests; here it
+            # would only slow the race to the first timed block and
+            # drop a BENCH_SLO_r*.json in the repo root
         )
         details_before = set(glob.glob(os.path.join(REPO, "BENCH_DETAIL_r*.json")))
         proc = subprocess.Popen(
