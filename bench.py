@@ -1246,6 +1246,124 @@ def _measure() -> None:
         extras["remote"] = remote
         ev("remote", **remote)
 
+    # ---- continuous cross-client micro-batching (ROADMAP item 1): N
+    # INDEPENDENT sessions firing singles must approach the in-frame
+    # query_batch ceiling — the fingerprint lanes + adaptive window +
+    # parameter rings do the batch formation the clients no longer
+    # have to. Reported: aggregate q/s, the ratio vs one client's
+    # explicit batch frame (target >= 0.8x), and the single-query
+    # latency distribution (p50 must be one micro-batch window, not
+    # the ~114 ms lone-dispatch transfer of r04). ----
+    if os.environ.get("BENCH_CONCURRENT", "1") != "0" and budget_ok(
+        "concurrent_sessions", est_s=90, needs_db=True
+    ):
+        import threading
+
+        from orientdb_tpu.client.remote import connect
+        from orientdb_tpu.server import Server
+
+        srv = Server(admin_password="pw")
+        srv.attach_database(db)
+        srv.startup()
+        url = f"remote:127.0.0.1:{srv.binary_port}/{db.name}"
+        n_sessions = int(os.environ.get("BENCH_SESSIONS", "64"))
+        per_session = int(os.environ.get("BENCH_SESSION_OPS", "12"))
+        conc = {"sessions": n_sessions, "ops_per_session": per_session}
+        _csp = _bench_span("bench.block", block="concurrent_sessions")
+        _csp.__enter__()
+        try:
+            # ceiling: ONE client's explicit in-frame batch op
+            with connect(url, "admin", "pw") as rdb:
+                rdb.query_batch([sql] * batch)
+                drain_warmups()
+                n_ceil = max(1, iters // 2)
+                t0 = time.perf_counter()
+                for _ in range(n_ceil):
+                    for rs in rdb.query_batch([sql] * batch):
+                        rs.to_dicts()
+                conc["inframe_batch_qps"] = round(
+                    (n_ceil * batch) / (time.perf_counter() - t0), 3
+                )
+            lat_lock = threading.Lock()
+            lats: list = []
+            windows: list = []
+            sess_errors: list = []
+            barrier = threading.Barrier(n_sessions)
+
+            def _session():
+                try:
+                    with connect(url, "admin", "pw") as c:
+                        c.query(sql)  # warm this session + the lane
+                        barrier.wait()
+                        t_start = time.perf_counter()
+                        my = []
+                        for _ in range(per_session):
+                            t = time.perf_counter()
+                            c.query(sql)
+                            my.append(time.perf_counter() - t)
+                        t_end = time.perf_counter()
+                        with lat_lock:
+                            lats.extend(my)
+                            windows.append((t_start, t_end))
+                except Exception as e:  # noqa: BLE001 - recorded below
+                    with lat_lock:
+                        sess_errors.append(f"{type(e).__name__}: {e}")
+                    try:
+                        barrier.abort()  # free waiting siblings
+                    except Exception:
+                        pass
+
+            ring_up0 = metrics.snapshot()["counters"].get(
+                "tpu.param_ring.upload", 0
+            )
+            threads = [
+                threading.Thread(target=_session)
+                for _ in range(n_sessions)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if windows:
+                wall = max(w[1] for w in windows) - min(
+                    w[0] for w in windows
+                )
+                done = len(lats)
+                conc["qps"] = round(done / wall, 3)
+                ls = sorted(lats)
+                conc["p50_ms"] = round(ls[len(ls) // 2] * 1000.0, 2)
+                conc["p99_ms"] = round(
+                    ls[min(len(ls) - 1, int(len(ls) * 0.99))] * 1000.0, 2
+                )
+                ceil_qps = conc.get("inframe_batch_qps", 0.0)
+                if ceil_qps:
+                    conc["vs_inframe_batch"] = round(
+                        conc["qps"] / ceil_qps, 3
+                    )
+            if sess_errors:
+                conc["errors"] = sess_errors[:3]
+            snapc = metrics.snapshot()
+            cc = snapc["counters"]
+            conc["coalesce"] = {
+                "items": cc.get("coalesce.items", 0),
+                "grouped": cc.get("coalesce.grouped", 0),
+                "batches": cc.get("coalesce.batches", 0),
+                "lane_dispatches": cc.get("tpu.lane_dispatch", 0),
+                "ring_uploads_during_run": cc.get(
+                    "tpu.param_ring.upload", 0
+                )
+                - ring_up0,
+                "window_ms_last": snapc["gauges"].get(
+                    "coalesce.window_ms", 0.0
+                ),
+            }
+        finally:
+            _csp.__exit__(None, None, None)
+            block_trace["concurrent_sessions"] = _csp.trace_id
+            srv.shutdown()
+        extras["concurrent_sessions"] = conc
+        ev("concurrent_sessions", **conc)
+
     # demodb's device graph is done (the oracle timing later is host-
     # only): free its HBM before the bigger graphs load — 16 GB cannot
     # hold every block's graph at once, and plan-cache cycles keep

@@ -49,6 +49,7 @@ GUARDED_SUITES = frozenset(
         "test_select_compile",
         "test_sharded",
         "test_group_dispatch",
+        "test_coalesce_lanes",
     }
 )
 

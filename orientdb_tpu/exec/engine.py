@@ -369,6 +369,80 @@ def _execute_query_batch(
     return out
 
 
+def dispatch_lane_batch(db, sqls, params_list=None, ring_state=None):
+    """Lane front door (server/coalesce): NON-BLOCKING dispatch of one
+    fingerprint lane's homogeneous micro-batch. Returns a handle whose
+    ``collect()`` yields the ResultSets (folding per-item stats
+    attribution — amortized wall/device/transfer plus each item's
+    queue wait), or None when the lane fast path does not apply; the
+    caller then runs ``execute_query_batch``, which also records first
+    executions and oracle statements.
+
+    ``ring_state`` is the lane's opaque per-plan staging state (a plain
+    dict the engine keeps its :class:`tpu_engine.ParamRing` in), so the
+    coalescer never has to import the device stack."""
+    n = len(sqls)
+    if params_list is None:
+        params_list = [None] * n
+    items = []
+    for sql, p in zip(sqls, params_list):
+        stmt = parse_cached(sql)
+        if isinstance(stmt, A.ExplainStatement) or not stmt.is_idempotent:
+            return None
+        items.append((stmt, _normalize_params(p)))
+    if not items or _choose_engine(db, items[0][0], None) != "tpu":
+        return None
+    from orientdb_tpu.exec import tpu_engine
+
+    ring = None
+    if ring_state is not None:
+        ring = ring_state.get("ring")
+        if ring is None:
+            ring = ring_state["ring"] = tpu_engine.ParamRing()
+    h = tpu_engine.dispatch_lane(db, items, ring=ring)
+    if h is None:
+        return None
+    return _LaneHandle(sqls, h)
+
+
+class _LaneHandle:
+    """Wraps an in-flight ``tpu_engine.LaneDispatch``: ``collect()``
+    blocks on the fetch, wraps rows in ResultSets, and attributes the
+    batch's amortized cost to each member fingerprint."""
+
+    __slots__ = ("sqls", "_h")
+
+    def __init__(self, sqls, h) -> None:
+        self.sqls = sqls
+        self._h = h
+
+    def collect(self, queue_waits=None) -> List[ResultSet]:
+        import time
+
+        import orientdb_tpu.obs.stats as S
+
+        t0 = time.perf_counter()
+        with S.capture() as cap:
+            outs = self._h.collect()
+        n = max(len(outs), 1)
+        per = (time.perf_counter() - t0) / n
+        results = []
+        for k, (sql, rows) in enumerate(zip(self.sqls, outs)):
+            rs = _result_set(rows, "tpu")
+            S.stats.record_external(
+                sql,
+                per,
+                engine="tpu",
+                rows=len(rows) if hasattr(rows, "__len__") else None,
+                queue_s=queue_waits[k] if queue_waits else 0.0,
+                device_s=cap.device_s / n,
+                transfer_s=cap.transfer_s / n,
+                bytes_fetched=cap.bytes_fetched // n,
+            )
+            results.append(rs)
+        return results
+
+
 def explain(db, sql: str, params=None) -> ResultSet:
     stmt = parse_cached(sql)
     if not isinstance(stmt, A.ExplainStatement):

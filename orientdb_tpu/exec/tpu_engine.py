@@ -2837,10 +2837,13 @@ class _CompiledPlan(_AotWarmup):
             self.count_name is not None or self.width == 0 or self.direct_fetch
         )
 
-    def dispatch_many(self, dyns: List[Dict]):
+    def dispatch_many(self, dyns: List[Dict], ring: "ParamRing" = None):
         """ONE Execute for B same-plan replays: the replay vmapped over
         stacked dynamic args, padded to a pow2 lane bucket so the jit
-        cache stays O(log B) per plan.
+        cache stays O(log B) per plan. ``ring`` (a coalesce lane's
+        :class:`ParamRing`) keeps the stacked parameter pytree
+        device-resident across dispatches: a repeated value set reuses
+        the staged buffer and ships zero host bytes.
 
         The tunneled runtime charges a fixed ~1.4 ms per Execute
         (measured: a trivial 8-element program and a 200k-row gather
@@ -2872,17 +2875,18 @@ class _CompiledPlan(_AotWarmup):
         def _stack(c: int) -> Dict:
             # explicit upload (deviceguard): one device_put per chunk
             # instead of an implicit transfer inside the vmapped call
-            return jax.device_put(
-                {
-                    k: np.stack(
-                        [
-                            np.asarray(d[k])
-                            for d in all_dyns[c * Bb : (c + 1) * Bb]
-                        ]
-                    )
-                    for k in dyns[0]
-                }
-            )
+            host = {
+                k: np.stack(
+                    [
+                        np.asarray(d[k])
+                        for d in all_dyns[c * Bb : (c + 1) * Bb]
+                    ]
+                )
+                for k in dyns[0]
+            }
+            if ring is not None:
+                return ring.stage(host)
+            return jax.device_put(host)
 
         if fn is None:
             self._compile_group_async(Bb, _stack(0))
@@ -3408,6 +3412,56 @@ def execute(db, stmt, params) -> List[Result]:
 _GROUP_MIN = 4
 
 
+class ParamRing:
+    """Device-resident parameter buffers for one dispatch lane.
+
+    A lane's repeated dispatches stack the same dynamic-arg pytree
+    shapes over and over — and under steady serving traffic, often the
+    same VALUES (hot parameter sets, un-parameterized statements' seed
+    arrays). Each distinct stacked value set is ``jax.device_put`` ONCE
+    and then reused in place: a dispatch whose host stack matches a
+    staged slot ships zero host bytes. Two slots double-buffer the
+    ring — the upload for micro-batch N+1 lands in the other slot, so
+    it can never overwrite the buffer an in-flight dispatch for batch
+    N still reads. Buffers are reused rather than donated: donation
+    would invalidate the slot after one Execute and forfeit the reuse
+    that makes the steady state transfer-free.
+
+    NOT thread-safe by design: a ring belongs to exactly one lane
+    worker thread (the coalesce lane owns it for the plan's lifetime).
+    """
+
+    __slots__ = ("_slots", "_next")
+
+    def __init__(self, depth: int = 2) -> None:
+        self._slots: List = [None] * max(1, depth)
+        self._next = 0
+
+    @staticmethod
+    def _same(a: Dict, b: Dict) -> bool:
+        if a.keys() != b.keys():
+            return False
+        return all(np.array_equal(a[k], b[k]) for k in a)
+
+    def stage(self, host: Dict):
+        """Device form of ``host`` (a dict of stacked numpy arrays):
+        the staged copy when a slot's value set matches, a fresh
+        explicit upload into the next slot otherwise."""
+        for slot in self._slots:
+            if slot is not None and self._same(slot[0], host):
+                metrics.incr("tpu.param_ring.hit")
+                return slot[1]
+        dev = jax.device_put(host)
+        metrics.incr("tpu.param_ring.upload")
+        metrics.incr(
+            "tpu.param_ring.bytes",
+            sum(int(a.nbytes) for a in host.values()),
+        )
+        self._slots[self._next] = (host, dev)
+        self._next = (self._next + 1) % len(self._slots)
+        return dev
+
+
 class _Group:
     """Stacked device result of a vmapped group dispatch; fetched to
     host ONCE and sliced per lane.
@@ -3523,51 +3577,71 @@ def execute_batch(db, items) -> List:
                 )
         if not lanes:
             continue
-        if not dyns[0]:
-            # no dynamic args: every lane is the SAME program on the same
-            # inputs — one plain dispatch serves the whole group
-            dev = plan.dispatch({})
-            if isinstance(dev, tuple) and len(dev) == 3 and dev[1]:
-                # rows plan: keep the single dispatch's page ladder so
-                # the group elects one shared page after the meta wave
-                grp = _Group(
-                    dev[0], shared_pages=(dev[1], dev[2])
-                )
-            else:
-                grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
-            ks = [None] * len(lanes)
-        else:
-            dev = plan.dispatch_many(dyns)
-            if dev is None:
-                # vmapped executable still compiling in the background
-                # (or permanently unavailable): serve per-lane, with the
-                # same overflow walk as the singles path — a seed grown
-                # since the group's _dyn_args probe must not fail the batch
-                for j in lanes:
-                    i, variants, _p, params = prepared[j]
-                    try:
-                        pending.append(
-                            (i, variants, plan, plan.dispatch(params or {}))
-                        )
-                    except ScheduleOverflow:
-                        out[i] = _run_variants(
-                            db, items[i][0], params, variants,
-                            tried=plan, fresh=fresh,
-                        )
-                continue
-            if (
-                isinstance(dev, tuple)
-                and len(dev) == 2
-                and dev[1] is not None
-            ):
-                # rows-group replay: (meta stack, data stack)
-                grp = _Group(dev[0], data_dev=dev[1])
-            else:
-                grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
-            ks = list(range(len(lanes)))
+        g = _group_dispatch(plan, dyns)
+        if g is None:
+            # vmapped executable still compiling in the background
+            # (or permanently unavailable): serve per-lane, with the
+            # same overflow walk as the singles path — a seed grown
+            # since the group's _dyn_args probe must not fail the batch
+            for j in lanes:
+                i, variants, _p, params = prepared[j]
+                try:
+                    pending.append(
+                        (i, variants, plan, plan.dispatch(params or {}))
+                    )
+                except ScheduleOverflow:
+                    out[i] = _run_variants(
+                        db, items[i][0], params, variants,
+                        tried=plan, fresh=fresh,
+                    )
+            continue
+        grp, ks = g
         for k, j in zip(ks, lanes):
             i, variants, _p, _params = prepared[j]
             pending.append((i, variants, plan, _Lane(grp, k)))
+    _finish_pending(db, items, pending, out, fresh)
+    # a batch returns replay-ready: block on warm-ups this call started so
+    # plans recorded here don't leak their XLA compile into the next batch
+    for plan in fresh:
+        plan.wait_compiled()
+    return out
+
+
+def _group_dispatch(plan, dyns: List[Dict], ring: ParamRing = None):
+    """Dispatch B same-plan replays as ONE group. Returns ``(grp, ks)``
+    — ``ks[k]`` is each item's index into the stacked result, or None
+    for the shared-single-dispatch case — or None while the vmapped
+    executable is still compiling (callers dispatch per-lane instead).
+    Shared by ``execute_batch``'s same-plan runs and the coalescer's
+    lane drains (``dispatch_lane``)."""
+    if not dyns[0]:
+        # no dynamic args: every lane is the SAME program on the same
+        # inputs — one plain dispatch serves the whole group
+        dev = plan.dispatch({})
+        if isinstance(dev, tuple) and len(dev) == 3 and dev[1]:
+            # rows plan: keep the single dispatch's page ladder so
+            # the group elects one shared page after the meta wave
+            grp = _Group(dev[0], shared_pages=(dev[1], dev[2]))
+        else:
+            grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
+        return grp, [None] * len(dyns)
+    dev = plan.dispatch_many(dyns, ring=ring)
+    if dev is None:
+        return None
+    if isinstance(dev, tuple) and len(dev) == 2 and dev[1] is not None:
+        # rows-group replay: (meta stack, data stack)
+        grp = _Group(dev[0], data_dev=dev[1])
+    else:
+        grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
+    return grp, list(range(len(dyns)))
+
+
+def _finish_pending(db, items, pending, out, fresh) -> None:
+    """Fetch + materialize dispatched work: the overlapped meta wave,
+    per-query/group page election, and host marshalling, with overflow
+    fallbacks walked per item. ``pending`` holds ``(i, variants, plan,
+    dev)`` rows dispatched by ``execute_batch`` or a coalesce lane
+    (``LaneDispatch``); results land in ``out[i]``."""
     # wave 1: metas (tiny, overlapped) — traverse plans ship their whole
     # payload here since they have no meta/data split
     meta_devs, data_devs = [], []
@@ -3670,6 +3744,14 @@ def execute_batch(db, items) -> List:
         metrics.observe("tpu.device_s", t1 - t0)
         metrics.observe("tpu.transfer_s", t2 - t1)
         metrics.incr("tpu.bytes_fetched", nbytes)
+        # per-fingerprint attribution (obs/stats): a no-op without an
+        # active accumulator (the query_batch front door deliberately
+        # skips per-item device fiction), but the coalesce lane wraps
+        # its collect in stats.capture() and splits this batch-level
+        # split across its members
+        from orientdb_tpu.obs.stats import add_device
+
+        add_device(t1 - t0, t2 - t1, nbytes)
     overflowed = []
     with timed("tpu.host_s"):
         for k, ((i, variants, plan, dev), meta) in enumerate(
@@ -3694,11 +3776,90 @@ def execute_batch(db, items) -> List:
         out[i] = _run_variants(
             db, stmt, params, variants, tried=plan, fresh=fresh
         )
-    # a batch returns replay-ready: block on warm-ups this call started so
-    # plans recorded here don't leak their XLA compile into the next batch
-    for plan in fresh:
-        plan.wait_compiled()
-    return out
+
+
+class LaneDispatch:
+    """An in-flight homogeneous micro-batch: dispatched on device, not
+    yet fetched. The coalescer's lane worker dispatches micro-batch N+1
+    (staging its parameters into the lane's :class:`ParamRing`) BEFORE
+    collecting batch N — double-buffered dispatch, so batch formation
+    and parameter upload overlap the device execution in front of them
+    instead of serializing behind it."""
+
+    __slots__ = ("db", "items", "pending")
+
+    def __init__(self, db, items, pending) -> None:
+        self.db = db
+        self.items = items
+        self.pending = pending
+
+    def collect(self) -> List:
+        """Fetch + marshal the dispatched batch; returns per-item row
+        lists in submission order (blocking — the device round trip
+        this batch amortizes across its members)."""
+        out: List = [None] * len(self.items)
+        fresh: List = []
+        _finish_pending(self.db, self.items, self.pending, out, fresh)
+        for plan in fresh:
+            plan.wait_compiled()
+        return out
+
+
+def dispatch_lane(db, items, ring: ParamRing = None):
+    """Lane-aware dispatch entry: a fingerprint-keyed coalesce lane
+    drains a HOMOGENEOUS micro-batch — every item the same statement
+    shape — so ONE cached plan serves all of them, with the stacked
+    dynamic args staged through the lane's device-resident ``ring``.
+
+    Non-blocking: enqueues the replay(s) on device and returns a
+    :class:`LaneDispatch` to collect later, or None when the fast path
+    does not apply (no cached plan yet, sticky-variant split, seed
+    overflow, vmapped executable still compiling) — the caller falls
+    back to the generic batch path, which also handles the recording
+    first execution."""
+    if db.tx is not None or not items:
+        return None
+    stmt0, params0 = items[0]
+    key = _cache_key(stmt0, params0)
+    if key is None:
+        return None
+    snap = db.current_snapshot(require_fresh=True)
+    if snap is None:
+        return None
+    cache = _plan_cache(snap)
+    variants = cache.get(key)
+    if variants is None:
+        return None  # recording first execution: generic path records
+    cache.move_to_end(key)
+    plan = variants.pick(params0)
+    if getattr(plan, "batchable", None) is None or not plan.batchable():
+        return None
+    dyns = []
+    try:
+        for stmt, params in items:
+            if (stmt is not stmt0 or params is not params0) and _cache_key(
+                stmt, params
+            ) != key:
+                # lanes fold LITERALS into one fingerprint, but plans
+                # bake literals (and static params) into the recording:
+                # a mixed-literal drain must not replay item[0]'s plan
+                # for everyone — the generic path plans each item
+                return None
+            if variants.pick(params) is not plan:
+                # sticky routing split the lane across variants: the
+                # generic path groups each variant's run correctly
+                return None
+            dyns.append(plan._dyn_args(params or {}))
+    except ScheduleOverflow:
+        return None  # the variant walk belongs to the generic path
+    g = _group_dispatch(plan, dyns, ring=ring)
+    if g is None:
+        return None  # group executable still compiling: generic path
+    grp, ks = g
+    pending = [(i, variants, plan, _Lane(grp, k)) for i, k in enumerate(ks)]
+    metrics.incr("tpu.lane_dispatch")
+    metrics.incr("tpu.lane_items", len(items))
+    return LaneDispatch(db, items, pending)
 
 
 def explain_plan_steps(db, stmt) -> List[str]:

@@ -53,6 +53,10 @@ SPAN_CATALOG: Dict[str, str] = {
     "forward.request": "non-owner → write-owner HTTP forward",
     "bench.block": "one measured bench block (evidence carries its "
     "trace id)",
+    "coalesce.lane": "cross-session micro-batching: one item's stay in "
+    "its fingerprint lane, enqueue through result (submitter side)",
+    "coalesce.dispatch": "one lane micro-batch executed on the lane "
+    "worker (continues the first submitter's trace; lane/batch attrs)",
     "cdc.catchup": "changefeed catch-up read: WAL entries above a "
     "consumer's cursor decoded to events",
     "cdc.push": "one changefeed delivery (binary push frame or HTTP "

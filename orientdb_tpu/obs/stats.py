@@ -165,6 +165,7 @@ EXPORT_FIELDS = (
     ("max_s", "query_latency_seconds_max", "gauge"),
     ("device_s", "query_device_seconds_total", "counter"),
     ("transfer_s", "query_transfer_seconds_total", "counter"),
+    ("queue_s", "query_queue_seconds_total", "counter"),
     ("bytes_fetched", "query_bytes_fetched_total", "counter"),
     ("compile_s", "query_compile_seconds_total", "counter"),
     ("compiles", "query_compiles_total", "counter"),
@@ -241,6 +242,7 @@ class _Entry:
         "max_s",
         "device_s",
         "transfer_s",
+        "queue_s",
         "bytes_fetched",
         "compile_s",
         "compiles",
@@ -265,6 +267,7 @@ class _Entry:
         self.max_s = 0.0
         self.device_s = 0.0
         self.transfer_s = 0.0
+        self.queue_s = 0.0
         self.bytes_fetched = 0
         self.compile_s = 0.0
         self.compiles = 0
@@ -314,6 +317,7 @@ class _Acc:
         "sql",
         "device_s",
         "transfer_s",
+        "queue_s",
         "bytes_fetched",
         "compile_s",
         "compiles",
@@ -329,6 +333,7 @@ class _Acc:
         self.sql = sql
         self.device_s = 0.0
         self.transfer_s = 0.0
+        self.queue_s = 0.0
         self.bytes_fetched = 0
         self.compile_s = 0.0
         self.compiles = 0
@@ -353,6 +358,31 @@ def _acc_stack() -> list:
 def current_acc() -> Optional[_Acc]:
     st = getattr(_local, "stack", None)
     return st[-1] if st else None
+
+
+class capture:
+    """Context manager capturing device/transfer attribution emitted on
+    THIS thread (``add_device`` et al) without recording a query call —
+    batch executors (the coalesce lane collect) run one fetch for N
+    statements and split the captured cost across their members via
+    :meth:`QueryStats.record_external`."""
+
+    __slots__ = ("acc",)
+
+    def __enter__(self) -> _Acc:
+        self.acc = _Acc("")
+        _acc_stack().append(self.acc)
+        return self.acc
+
+    def __exit__(self, *exc) -> None:
+        st = _acc_stack()
+        if st and st[-1] is self.acc:
+            st.pop()
+        else:  # unbalanced (should not happen): drop without corrupting
+            try:
+                st.remove(self.acc)
+            except ValueError:
+                pass
 
 
 class QueryStats:
@@ -402,6 +432,27 @@ class QueryStats:
         self._record(fp, acc, duration_s, engine, rows, error)
         return fp.fid
 
+    def _entry_locked(self, fp: Fingerprint) -> Optional[_Entry]:
+        """Get-or-create (and LRU-touch) the fingerprint's entry —
+        caller holds ``_lock``. None when the table is disabled
+        (capacity <= 0). THE insert/eviction block, shared by every
+        writer so the policy cannot diverge between paths."""
+        e = self._map.get(fp.fid)
+        if e is not None:
+            self._map.move_to_end(fp.fid)
+            return e
+        cap = (
+            self._capacity
+            if self._capacity is not None
+            else config.query_stats_capacity
+        )
+        if cap <= 0:
+            return None
+        while len(self._map) >= cap:
+            self._map.popitem(last=False)
+        e = self._map[fp.fid] = _Entry(fp.fid, fp.text)
+        return e
+
     def _record(
         self,
         fp: Fingerprint,
@@ -414,21 +465,10 @@ class QueryStats:
         import bisect
 
         bi = bisect.bisect_left(_LAT_BUCKETS, duration_s)
-        cap = (
-            self._capacity
-            if self._capacity is not None
-            else config.query_stats_capacity
-        )
         with self._lock:
-            e = self._map.get(fp.fid)
+            e = self._entry_locked(fp)
             if e is None:
-                if cap <= 0:
-                    return
-                while len(self._map) >= cap:
-                    self._map.popitem(last=False)
-                e = self._map[fp.fid] = _Entry(fp.fid, fp.text)
-            else:
-                self._map.move_to_end(fp.fid)
+                return
             e.calls += 1
             e.last_ts = time.time()
             e.total_s += duration_s
@@ -441,6 +481,7 @@ class QueryStats:
             e.engines[engine] = e.engines.get(engine, 0) + 1
             e.device_s += acc.device_s
             e.transfer_s += acc.transfer_s
+            e.queue_s += acc.queue_s
             e.bytes_fetched += acc.bytes_fetched
             e.compile_s += acc.compile_s
             e.compiles += acc.compiles
@@ -458,16 +499,41 @@ class QueryStats:
         engine: str,
         rows: Optional[int] = None,
         error: Optional[BaseException] = None,
+        queue_s: float = 0.0,
+        device_s: float = 0.0,
+        transfer_s: float = 0.0,
+        bytes_fetched: int = 0,
     ) -> Optional[str]:
         """Record a query that ran without a thread-local accumulator —
         batch members (``query_batch`` amortizes one wall clock across
-        its statements) and cached replays driven off-thread. Device and
-        compile attribution are absent by construction."""
+        its statements) and cached replays driven off-thread. Compile
+        attribution is absent by construction; coalesce lanes pass the
+        amortized device/transfer split they measured around the whole
+        micro-batch (:func:`capture`) plus each item's queue wait, so
+        the table splits "waiting for the lane" from "running"."""
         if not sampled():
             return None
         fp = fingerprint_cached(sql)
-        self._record(fp, _Acc(sql), duration_s, engine, rows, error)
+        acc = _Acc(sql)
+        acc.queue_s = queue_s
+        acc.device_s = device_s
+        acc.transfer_s = transfer_s
+        acc.bytes_fetched = bytes_fetched
+        self._record(fp, acc, duration_s, engine, rows, error)
         return fp.fid
+
+    def record_queue(self, sql: str, queue_s: float) -> None:
+        """Fold queue-wait seconds into a fingerprint's entry WITHOUT
+        counting a call — the execution path already recorded the call;
+        this adds the time the item spent parked in its coalesce lane
+        before that execution started."""
+        if queue_s <= 0.0 or not sampled():
+            return
+        fp = fingerprint_cached(sql)
+        with self._lock:
+            e = self._entry_locked(fp)
+            if e is not None:
+                e.queue_s += queue_s
 
     # -- reading ------------------------------------------------------------
 

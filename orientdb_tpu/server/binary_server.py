@@ -23,8 +23,9 @@ batched-dispatch speed, [E] the reference's server IS its wire path):
 - ``query_batch`` ships N statements in ONE frame and runs them through
   the engine's group dispatch (`exec/engine.execute_query_batch`);
 - single ``query`` ops route through the server's cross-session
-  coalescer (`server/coalesce.py`): concurrent sessions' singles merge
-  into one batched device dispatch;
+  coalescer (`server/coalesce.py`): concurrent sessions' singles land
+  in fingerprint-keyed dispatch lanes and merge into homogeneous
+  micro-batches replaying one compiled plan;
 - ``pipeline: true`` at db_open turns on out-of-order dispatch for this
   session: query ops run on a worker pool and respond by ``reqid`` when
   ready, so ONE client can keep many singles in flight (they coalesce
@@ -366,8 +367,8 @@ class _Session:
                     }
             if op == "query":
                 self.server.security.check(self.user, RES_RECORD, "read")
-                # singles ride the cross-session group path: concurrent
-                # sessions' queries merge into one batched dispatch
+                # singles ride the cross-session lane path: concurrent
+                # sessions' same-shape queries merge into one micro-batch
                 rows, engine = self.server.coalescer.submit(
                     self.db, req["sql"], req.get("params")
                 )

@@ -624,7 +624,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self.server.ot_server.security.check(user, RES_RECORD, "read")
                 sql = rest[2]
                 limit = int(rest[3]) if len(rest) > 3 else None
-                rows = db.query(sql).to_dicts()
+                # singles ride the cross-session lane path (server/
+                # coalesce.py) exactly like binary `query` ops do:
+                # concurrent HTTP sessions' queries merge into one
+                # micro-batch instead of each paying the lone-dispatch
+                # tunnel round trip
+                rows, _engine = self.server.ot_server.coalescer.submit(
+                    db, sql, None
+                )
                 if limit is not None:
                     rows = rows[:limit]
                 return self._send(200, {"result": rows})

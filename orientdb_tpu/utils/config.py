@@ -148,6 +148,22 @@ class GlobalConfiguration:
     # RetryPolicy (parallel/resilience) honors it over its own backoff
     retry_after_s: float = 0.5
 
+    # Cross-session micro-batching (server/coalesce): concurrent
+    # sessions' single queries land in per-database dispatch LANES
+    # keyed by query fingerprint, so a drain forms a homogeneous
+    # micro-batch hitting one compiled plan. Each lane's collection
+    # window adapts to recent arrival rate and device time per batch,
+    # hard-capped at coalesce_window_max_ms — the cap bounds the p50 a
+    # lone query can lose to batch formation. A drain takes at most
+    # coalesce_max_batch items; a lane idle longer than
+    # coalesce_lane_idle_s stops its worker thread (a fresh submit
+    # rebuilds it), and a database keeps at most coalesce_lanes_max
+    # lanes (least-recently-used lane reaped past that).
+    coalesce_window_max_ms: float = 5.0
+    coalesce_max_batch: int = 256
+    coalesce_lane_idle_s: float = 30.0
+    coalesce_lanes_max: int = 64
+
     # Change-data-capture (orientdb_tpu/cdc): per-consumer event queues
     # are bounded at cdc_queue_max — a slow consumer either blocks the
     # producer (policy "block", bounded by cdc_poll_timeout_s) or sheds
