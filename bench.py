@@ -212,32 +212,13 @@ def gate_regressions(
 
 
 def run_virtual_mesh_subprocess(module: str, argv, timeout: int, n_devices: int = 8):
-    """Run a bench probe module in a subprocess pinned to an n-device
-    virtual CPU mesh; returns the parsed last stdout JSON line, or an
-    {"error": ...} dict carrying the best diagnostic (probes print their
-    failure JSON to STDOUT before exiting nonzero)."""
-    import subprocess
+    """Per-shard-count probe subprocess — one protocol implementation
+    shared with the standalone sweep (tools/virtual_mesh.py)."""
+    from orientdb_tpu.tools.virtual_mesh import (
+        run_virtual_mesh_subprocess as _run,
+    )
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        f"{os.environ.get('XLA_FLAGS', '')} "
-        f"--xla_force_host_platform_device_count={n_devices}"
-    ).strip()
-    try:
-        out_s = subprocess.run(
-            [sys.executable, "-m", module, *[str(a) for a in argv]],
-            env=env, capture_output=True, text=True, timeout=timeout,
-        )
-        lines = out_s.stdout.strip().splitlines()
-        if out_s.returncode != 0 or not lines:
-            return {
-                "error": (lines[-1] if lines else "")[-200:]
-                or out_s.stderr[-200:]
-            }
-        return json.loads(lines[-1])
-    except Exception as e:  # noqa: BLE001 - diagnostics only
-        return {"error": str(e)[:200]}
+    return _run(module, argv, timeout, n_devices)
 
 
 def _timing_knobs():
@@ -1561,17 +1542,25 @@ def _measure() -> None:
             extras["degree_skew"] = skew
             ev("degree_skew", **skew)
 
-    # ---- shard-count scaling of the ring-compacted merge (VERDICT r3
-    # #6): per-S subprocesses on virtual CPU meshes; merge_rows must stay
-    # ~flat while the old all_gather design's row count grows with S ----
+    # ---- shard-count scaling of the frontier-sparse sharded MATCH
+    # (VERDICT r3 #6 + ISSUE 13): per-S subprocesses on virtual CPU
+    # meshes. wall_s must be ~monotone non-increasing across the sweep
+    # (r04 was ANTI-scaling: 35.9 → 54.0 → 95.4 s), merge_rows ~flat
+    # while the old all_gather design's row count grows with S, and the
+    # new fields record per-hop collective bytes, live-frontier
+    # occupancy, cond-skipped shards, and geometry kernel compiles.
+    # Budget is clamped PER SHARD COUNT: an 8-shard run launched near
+    # the budget edge records a skip marker instead of blowing the
+    # round (BENCH_r05's rc 124 shape) ----
     mesh_scaling = []
-    if os.environ.get("BENCH_MESH_SCALING", "1") != "0" and budget_ok(
-        "mesh_scaling", est_s=60
-    ):
+    if os.environ.get("BENCH_MESH_SCALING", "1") != "0":
         for S in (2, 4, 8):
+            if not budget_ok(f"mesh_scaling_{S}", est_s=30):
+                mesh_scaling.append({"shards": S, "skipped": "budget"})
+                continue
             res = run_virtual_mesh_subprocess(
                 "orientdb_tpu.tools.mesh_scaling", [S],
-                timeout=clamp_timeout(600), n_devices=S,
+                timeout=clamp_timeout(300), n_devices=S,
             )
             res.setdefault("shards", S)
             mesh_scaling.append(res)

@@ -34,7 +34,15 @@ inside traced regions —
 - **config reads**: ``config.<key>`` inside a traced region freezes
   the value into the executable — an operator retuning the declared
   key (configlint's table) changes nothing until a recompile. Read it
-  before the jit boundary and pass it in.
+  before the jit boundary and pass it in;
+- **full-capacity all_gather**: ``all_gather`` of a non-scalar buffer
+  in a region that also tracks a device count (a reduction-assigned
+  name) gathers whole capacity blocks when the count already bounds
+  the live prefix — O(S·cap) collective bytes where a packed-segment
+  psum merge ships O(total). Gathering the counts themselves
+  (``all_gather(tot)`` with ``tot = counts.sum()``) is the cheap
+  extent exchange and stays clean — exactly the
+  ``mesh_graph.expand_gather`` ring-merge contract.
 
 outside traced regions — recompile hazards:
 
@@ -82,6 +90,33 @@ IMPURE_SPAN_NAMES = frozenset({"span", "_span", "timed"})
 #: host-materialization callables on traced values
 HOST_COERCIONS = frozenset({"float", "int", "bool", "complex"})
 HOST_METHODS = frozenset({"item", "tolist"})
+
+#: reductions that produce a device COUNT / live-extent scalar — an
+#: all_gather of one of these is the cheap "exchange the extents"
+#: pattern; an all_gather of anything else in a function that also
+#: tracks such a count is gathering a full capacity block whose live
+#: prefix the count already bounds (the pre-ISSUE-13 expand_gather)
+REDUCTION_CALLS = frozenset(
+    {"sum", "max", "min", "any", "all", "prod", "count_nonzero", "mask_count"}
+)
+
+
+def _reduction_rooted(e: ast.expr) -> bool:
+    """True when an expression bottoms out in a reduction call after
+    unwrapping slicing / reshape / astype / [None]-style lifts."""
+    while True:
+        if isinstance(e, ast.Subscript):
+            e = e.value
+            continue
+        if isinstance(e, ast.Call):
+            name = _callee_name(e.func)
+            if name in ("reshape", "astype") and isinstance(
+                e.func, ast.Attribute
+            ):
+                e = e.func.value
+                continue
+            return name in REDUCTION_CALLS
+        return False
 
 
 def _callee_name(f: ast.expr) -> Optional[str]:
@@ -397,6 +432,16 @@ class _RegionChecker:
                     if a.arg != "self" and a.arg not in region.statics:
                         self.taint.add(a.arg)
         self.params = set(self.taint)
+        # names assigned from a reduction call anywhere in the region:
+        # the device counts that track a buffer's live extent. Plain
+        # Name targets ONLY — `buf[i] = x.sum()` must not whitelist the
+        # buffer (gathering THAT is the pattern the rule catches)
+        self.reduced_names: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign) and _reduction_rooted(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        self.reduced_names.add(t.id)
 
     def _flag(self, node: ast.AST, message: str) -> None:
         self.findings.append(
@@ -522,6 +567,23 @@ class _RegionChecker:
     def _check_call(self, call: ast.Call) -> None:
         f = call.func
         name = _callee_name(f)
+        if name == "all_gather" and call.args:
+            arg = call.args[0]
+            scalarish = _reduction_rooted(arg) or (
+                isinstance(arg, ast.Name) and arg.id in self.reduced_names
+            )
+            if not scalarish and self.reduced_names:
+                self._flag(
+                    call,
+                    "full-capacity all_gather of a buffer whose live "
+                    "extent is tracked by a device count "
+                    f"({', '.join(sorted(self.reduced_names))}) inside "
+                    f"a traced region ({self.region.why}) — every shard "
+                    "ships its whole capacity block when only the live "
+                    "prefix matters; scatter the packed segment at its "
+                    "extent offset and psum-merge it instead "
+                    "(mesh_graph.expand_gather's ring merge)",
+                )
         blocking = _blocking_callee(call)
         if blocking in ("block_until_ready", "device_get"):
             self._flag(

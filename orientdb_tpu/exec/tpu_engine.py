@@ -920,10 +920,11 @@ class TpuMatchSolver:
         p = mg.edge[dec.class_name].prefix
         ind_sh = arrays[f"{p}:{d}:indptr"]
         nbr_sh = arrays[f"{p}:{d}:nbr"]
+        span_sh = arrays["sh:rowspan"]
         extra_sh = (
             arrays[f"{p}:out:ebase"] if d == "out" else arrays[f"{p}:in:eid"]
         )
-        tots = expand_totals(mg.mesh, mg.rows_per_shard, ind_sh, srcs)
+        tots = expand_totals(mg.mesh, ind_sh, span_sh, srcs)
         total = self.sched.observe(tots.sum())
         max_local = self.sched.observe(tots.max())
         cap = _cap_of(max(max_local, 1))
@@ -934,18 +935,29 @@ class TpuMatchSolver:
         if self.sched.recording:
             # merge-traffic observability (tools/mesh_scaling.py plots
             # the S-curve): rows actually merged vs what the old
-            # all_gather-of-blocks design would have shipped
+            # all_gather-of-blocks design would have shipped, per-hop
+            # collective bytes (3 packed int32 psum segments), live-
+            # frontier occupancy of the expansion slots, and how many
+            # shards cond-skipped their gather/scatter outright
             S = mg.mesh.devices.size // (
                 mg.mesh.shape.get(config.mesh_replica_axis, 1)
             )
             metrics.incr("mesh.merge_rows", cap_total)
             metrics.incr("mesh.allgather_rows", S * cap)
+            metrics.incr("mesh.collective_bytes", 12 * cap_total)
+            metrics.incr("mesh.frontier_live_rows", total)
+            metrics.incr("mesh.frontier_slot_rows", S * cap)
+            # recording runs inside the allowlisted _record boundary,
+            # so this tiny [S] fetch is an intentional transfer
+            metrics.incr(
+                "mesh.empty_shard_skips", int((np.asarray(tots) == 0).sum())
+            )
         row, eid, nbr = expand_gather(
             mg.mesh,
-            mg.rows_per_shard,
             ind_sh,
             nbr_sh,
             extra_sh,
+            span_sh,
             srcs,
             cap,
             cap_total,
@@ -1226,10 +1238,14 @@ class TpuMatchSolver:
         V = self.dg.num_vertices
         vb = K.bucket(max(V, 1))
         mg = self.dg.mesh_graph
-        univ = None
-        if mg is not None:
-            univ = jnp.arange(vb, dtype=jnp.int32)
-            univ = jnp.where(univ < V, univ, -1)
+        # vertex universe for [vb]-wide node-mask precomputes: the mesh
+        # path always needs it; the single-device path uses it whenever
+        # the edge list outnumbers the vertices — evaluating a node
+        # predicate per EDGE emit re-gathers every referenced column
+        # [E]-wide per hop (2-3 extra 80M-row gathers per pass at SF100
+        # shape), where a [vb] precompute plus one bool gather does it
+        univ = jnp.arange(vb, dtype=jnp.int32)
+        univ = jnp.where(univ < V, univ, -1)
         from contextlib import nullcontext
 
         from orientdb_tpu.obs.trace import span as _span
@@ -1257,10 +1273,20 @@ class TpuMatchSolver:
             step.edge.from_alias if step.reverse else step.edge.to_alias
         )
         node_mask = self._node_masks[dst_alias]
-        ok_vec = node_mask(univ) if mg is not None else None
+        classes = self._resolve_edge_classes(item)
+        # the [vb]-wide precompute only pays for itself where a consumer
+        # exists: the mesh path always reads it, the single-device path
+        # only for classes whose edge list outnumbers the vertices —
+        # otherwise the eager recording would evaluate it for nothing
+        ok_vec = (
+            node_mask(univ)
+            if mg is not None
+            or any(self.dg.edges[c].num_edges >= vb for c in classes)
+            else None
+        )
         f = item.edge_filter
         new_w = jnp.zeros(vb, dtype)
-        for cname in self._resolve_edge_classes(item):
+        for cname in classes:
             dec = self.dg.edges[cname]
             E = dec.num_edges
             if E == 0:
@@ -1294,7 +1320,6 @@ class TpuMatchSolver:
                         emask,
                         ok_vec,
                         w if w is not None else jnp.ones(vb, dtype),
-                        vb,
                     )
                     continue
                 # both CSR orders exist in HBM, so either direction
@@ -1308,7 +1333,13 @@ class TpuMatchSolver:
                 else:
                     emit, ip = dec.src, dec.indptr_in
                     em = jnp.take(emask, dec.edge_id_in)
-                contrib = em & node_mask(emit)
+                if E >= vb:
+                    # [vb] mask precompute + one bool gather beats
+                    # re-evaluating the predicate's column gathers
+                    # [E]-wide (see _pushdown_weights)
+                    contrib = em & K.take_pad(ok_vec, emit, False)
+                else:
+                    contrib = em & node_mask(emit)
                 vals = contrib.astype(dtype)
                 if w is not None:
                     vals = vals * K.take_pad(w, emit, dtype(0))
@@ -2576,6 +2607,20 @@ class _CompiledPlan(_AotWarmup):
         self.dyn_spec = dict(solver.param_box.used)
         #: index-seeded root capacities (alias → padded length)
         self.seed_spec = dict(solver.seed_box.spec)
+        #: (ladder index, fits16) the LAST materialization elected —
+        #: dispatch() speculatively starts that page's device→host copy
+        #: so the transfer rides behind the compute instead of waiting
+        #: for the meta wave (the r04 rows-path 12 ms serialized tail)
+        self._page_guess: Optional[Tuple[int, bool]] = None
+        #: (B, rows, fits16) the last GROUP page election (group_page's
+        #: cache key) — _group_dispatch prefetches the slice when its
+        #: executable is already compiled
+        self._group_page_guess: Optional[Tuple[int, int, bool]] = None
+        #: data-stack shape the guess's page fn was compiled against:
+        #: a prefetch only fires on an exact shape match, so the jit
+        #: call is a guaranteed cache hit — a differently-sized batch
+        #: must never absorb a synchronous XLA compile on the drain path
+        self._group_page_shape: Optional[Tuple[int, ...]] = None
         self.jitted = jax.jit(self._replay)
 
     def _replay_core(self, arrays, dyn):
@@ -2649,6 +2694,14 @@ class _CompiledPlan(_AotWarmup):
         return meta, data
 
     @staticmethod
+    def _page_round(W: int, need: int) -> int:
+        """Rows of the compact group page covering ``need`` live rows:
+        pow-of-_GROUP_PAGE_ROUND rounding, capped at the full width —
+        ONE formula shared by the election and the speculative
+        dispatch-time prefetch so their keys can never drift."""
+        return min(W, -(-max(need, 1) // _GROUP_PAGE_ROUND) * _GROUP_PAGE_ROUND)
+
+    @staticmethod
     def _page_fn(B: int, n: int, fits16: bool):
         # both callers memoize the result in _group_page_fns keyed
         # (B, n, fits16) — the construction itself never serves a batch
@@ -2714,8 +2767,7 @@ class _CompiledPlan(_AotWarmup):
         compile and serves this batch from the smallest precompiled
         fallback (the pow2 ladder built by `precompile_group_pages`),
         or the raw full int32 stack when nothing is ready yet."""
-        W = int(data_dev.shape[2])
-        n = min(W, -(-max(need, 1) // _GROUP_PAGE_ROUND) * _GROUP_PAGE_ROUND)
+        n = self._page_round(int(data_dev.shape[2]), need)
         cache = self.__dict__.setdefault("_group_page_fns", {})
         fn = cache.get((B, n, fits16))
         if fn is not None:
@@ -2814,7 +2866,27 @@ class _CompiledPlan(_AotWarmup):
             # transfer implicitly on every dispatch — invisible to
             # profiling and flagged by the deviceguard transfer guard
             dyn = jax.device_put(dyn)
-        return self.jitted(self._arg_subset(), dyn)
+        dev = self.jitted(self._arg_subset(), dyn)
+        self._prefetch_elected(dev)
+        return dev
+
+    def _prefetch_elected(self, dev) -> None:
+        """Speculative result-page prefetch: start the device→host copy
+        of the page the LAST materialization elected, at DISPATCH time.
+        The D2H queues behind the producing compute, so the bytes move
+        during the next dispatch's formation instead of serializing
+        after the meta wave (r04 rows path: 20 ms device + 12 ms
+        transfer back-to-back; steady state re-elects the same page, so
+        the transfer hides). A wrong guess costs one redundant page
+        copy — the election itself stays exact."""
+        guess = self._page_guess
+        if guess is None or not (isinstance(dev, tuple) and len(dev) == 3):
+            return
+        idx, f16 = guess
+        pages = dev[2] if f16 else dev[1]
+        if pages and 0 <= idx < len(pages):
+            _copy_to_host_async(pages[idx])
+            metrics.incr("tpu.page_prefetch.start")
 
     def batchable(self) -> bool:
         """Eligible for the vmapped one-Execute group dispatch: count-only
@@ -3036,6 +3108,10 @@ class _CompiledPlan(_AotWarmup):
             with timed("tpu.host_s"):
                 return self.materialize(arr, params)
         meta_dev, pages32, _p16 = dev
+        if pages32:
+            # the lone-query path always ships the full int32 page:
+            # remember that election so the next dispatch prefetches it
+            self._page_guess = (len(pages32) - 1, False)
         data_dev = pages32[-1] if pages32 else None
         devs = [meta_dev] if data_dev is None else [meta_dev, data_dev]
         arrs = _fetch_profiled(devs, split_sync=False)
@@ -3472,7 +3548,15 @@ class _Group:
     (``shared_pages``); the batch fetch elects ONE compact page for the
     whole group after the meta wave."""
 
-    __slots__ = ("dev", "_np", "data_dev", "shared_pages", "data_np")
+    __slots__ = (
+        "dev",
+        "_np",
+        "data_dev",
+        "shared_pages",
+        "data_np",
+        "spec_key",
+        "spec_dev",
+    )
 
     def __init__(self, dev, data_dev=None, shared_pages=None) -> None:
         self.dev = dev
@@ -3480,6 +3564,10 @@ class _Group:
         self.data_dev = data_dev
         self.shared_pages = shared_pages
         self.data_np = None  # host copy of the elected group page
+        #: speculative page slice started at dispatch time (group_page
+        #: key + device buffer); the election keeps it only on a match
+        self.spec_key = None
+        self.spec_dev = None
 
     def arr(self) -> np.ndarray:
         if self._np is None:
@@ -3631,6 +3719,21 @@ def _group_dispatch(plan, dyns: List[Dict], ring: ParamRing = None):
     if isinstance(dev, tuple) and len(dev) == 2 and dev[1] is not None:
         # rows-group replay: (meta stack, data stack)
         grp = _Group(dev[0], data_dev=dev[1])
+        # speculative page prefetch: slice + start copying the page the
+        # last batch elected while THIS batch's device work runs —
+        # served only from an already-compiled page fn AND an exact
+        # data-stack shape match (the fn's jit cache keys shapes), so a
+        # guess can never absorb an XLA compile
+        guess = plan._group_page_guess
+        if guess is not None and plan._group_page_shape == tuple(
+            dev[1].shape
+        ):
+            fn = plan.__dict__.get("_group_page_fns", {}).get(guess)
+            if fn is not None:
+                grp.spec_key = guess
+                grp.spec_dev = fn(dev[1])
+                _copy_to_host_async(grp.spec_dev)
+                metrics.incr("tpu.page_prefetch.start")
     else:
         grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
     return grp, list(range(len(dyns)))
@@ -3683,9 +3786,22 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
         pair = data_devs[k]
         if pair is None or not pair[0] or meta.ndim != 1 or int(meta[1]):
             continue  # count-only result, traverse payload, or overflow
-        pages = pair[1] if int(meta[2]) else pair[0]
+        f16 = bool(int(meta[2]))
+        pages = pair[1] if f16 else pair[0]
         need = plan.fetch_rows_needed(int(meta[0]))
-        d = next(p for p in pages if int(p.shape[1]) >= need)
+        idx, d = next(
+            (i, p) for i, p in enumerate(pages) if int(p.shape[1]) >= need
+        )
+        # election bookkeeping for the speculative dispatch-time
+        # prefetch: a repeat election means the copy started with the
+        # dispatch and this async call is a no-op
+        if plan._page_guess is not None:
+            metrics.incr(
+                "tpu.page_prefetch.hit"
+                if plan._page_guess == (idx, f16)
+                else "tpu.page_prefetch.miss"
+            )
+        plan._page_guess = (idx, f16)
         _copy_to_host_async(d)
         pages_sel[k] = d
     # rows groups: elect ONE compact page for each group's whole lane
@@ -3716,11 +3832,34 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
         if grp.shared_pages is not None:
             p32, p16 = grp.shared_pages
             pages = p16 if fits16 else p32
-            d = next(p for p in pages if int(p.shape[1]) >= need)
-        else:
-            d = plan.group_page(
-                grp.data_dev, len(lane_metas), need, fits16
+            idx, d = next(
+                (i, p)
+                for i, p in enumerate(pages)
+                if int(p.shape[1]) >= need
             )
+            # the shared dispatch rode plan.dispatch(): its ladder
+            # prefetch reuses the per-query guess
+            plan._page_guess = (idx, fits16)
+        else:
+            key = (
+                len(lane_metas),
+                plan._page_round(int(grp.data_dev.shape[2]), need),
+                fits16,
+            )
+            if grp.spec_key is not None:
+                metrics.incr(
+                    "tpu.page_prefetch.hit"
+                    if grp.spec_key == key
+                    else "tpu.page_prefetch.miss"
+                )
+            plan._group_page_guess = key
+            plan._group_page_shape = tuple(grp.data_dev.shape)
+            if grp.spec_key == key:
+                d = grp.spec_dev  # copy already in flight since dispatch
+            else:
+                d = plan.group_page(
+                    grp.data_dev, len(lane_metas), need, fits16
+                )
         _copy_to_host_async(d)
         grp_fetch.append((grp, d))
     t1 = _time.perf_counter()

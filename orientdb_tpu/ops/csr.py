@@ -45,7 +45,9 @@ def degree_counts(indptr: jnp.ndarray, srcs: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def exclusive_cumsum(counts: jnp.ndarray) -> jnp.ndarray:
-    return jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
+    return jnp.concatenate(
+        [jnp.zeros(1, counts.dtype), value_cumsum(counts)[:-1]]
+    )
 
 
 @partial(jax.jit, static_argnames=("out_size",))
@@ -74,7 +76,7 @@ def gather_expand(
     # row's start offset, then row(pos) = #starts ≤ pos − 1. Zero-count rows
     # share an offset with their successor and never own a position.
     marks = jnp.zeros(out_size, jnp.int32).at[offsets].add(1, mode="drop")
-    row = jnp.clip(jnp.cumsum(marks) - 1, 0, K - 1).astype(jnp.int32)
+    row = jnp.clip(value_cumsum(marks) - 1, 0, K - 1).astype(jnp.int32)
     src = jnp.take(srcs, row)
     s = jnp.clip(src, 0, indptr.shape[0] - 2)
     edge_pos = jnp.take(indptr, s) + (pos - jnp.take(offsets, row))
@@ -104,12 +106,77 @@ def mask_cumsum(mask: jnp.ndarray) -> jnp.ndarray:
     B = _CS_BLOCK
     if n < 2 * B or n % B:
         return jnp.cumsum(mask.astype(jnp.int32))
-    rows = mask.reshape(-1, B).astype(jnp.float32)
-    tri = jnp.triu(jnp.ones((B, B), jnp.float32))
-    row_cs = jnp.dot(rows, tri).astype(jnp.int32)  # intra-block inclusive
+    # intra-block inclusive scans on the MXU (shared with value_cumsum)
+    row_cs = _block_scan_f32(mask.astype(jnp.float32)).astype(jnp.int32)
     block_tot = row_cs[:, -1]
     offs = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jnp.cumsum(block_tot)[:-1]]
+        [jnp.zeros(1, jnp.int32), value_cumsum(block_tot)[:-1]]
+    )
+    return (row_cs + offs[:, None]).reshape(-1)
+
+
+def _block_scan_f32(vals_f32: jnp.ndarray) -> jnp.ndarray:
+    """[n/B, B] per-block inclusive scans as ONE triangular matmul on
+    the systolic array. Exact while every block-local partial stays
+    under 2^24 (callers arrange that); cross-block offsets are the
+    caller's job — f32 cannot carry graph-scale totals exactly."""
+    B = _CS_BLOCK
+    rows = vals_f32.reshape(-1, B)
+    tri = jnp.triu(jnp.ones((B, B), jnp.float32))
+    return jnp.dot(rows, tri)
+
+
+def value_cumsum(vals: jnp.ndarray, force_blocked: bool = False) -> jnp.ndarray:
+    """Inclusive prefix sum of int32/f32 VALUES, MXU-shaped like
+    :func:`mask_cumsum` — the COUNT-pushdown weight chain runs this
+    over the whole edge list (80M rows at SF100 shape), where XLA's
+    log-depth plain cumsum was the measured per-query floor (~14 ms
+    per 1M elements → seconds per pass; the r04 16.8 q/s two-hop
+    cliff).
+
+    int32 stays EXACT on the f32 systolic array by scanning the low
+    and high 16-bit halves separately: per-block partials are
+    ≤ 256·2^16 < 2^24 (f32-exact), the halves recombine per block as
+    ``hi·2^16 + lo`` in int32, and the cross-block offsets accumulate
+    in int32 (recursively blocked) — exact for non-negative inputs
+    whose total fits int32, which callers overflow-guard already (the
+    pushdown's float-twin check). f32 inputs take the matmul path with
+    f32 offsets (the overflow twin tolerates its ~1e-7 error); other
+    dtypes, short inputs, and the padding tail fall back to plain
+    cumsum; non-multiple lengths are zero-padded to a block boundary.
+
+    The matmul path is gated to systolic backends at trace time: CPU's
+    native cumsum is linear and memory-bound, so the [n/B, B]·[B, B]
+    contraction would only add FLOPs there (backends are baked per
+    executable anyway — the read is a trace-time constant by design,
+    like the kernel platform itself)."""
+    n = vals.shape[0]
+    B = _CS_BLOCK
+    if n < 2 * B or (jax.default_backend() == "cpu" and not force_blocked):
+        return jnp.cumsum(vals)
+    if n % B:
+        pad = B - (n % B)
+        return value_cumsum(jnp.pad(vals, (0, pad)), force_blocked)[:n]
+    if vals.dtype == jnp.float32:
+        row_cs = _block_scan_f32(vals)
+        block_tot = row_cs[:, -1]
+        offs = jnp.concatenate(
+            [
+                jnp.zeros(1, jnp.float32),
+                value_cumsum(block_tot, force_blocked)[:-1],
+            ]
+        )
+        return (row_cs + offs[:, None]).reshape(-1)
+    if vals.dtype != jnp.int32:
+        return jnp.cumsum(vals)
+    lo = (vals & 0xFFFF).astype(jnp.float32)  # [0, 2^16)
+    hi = (vals >> 16).astype(jnp.float32)  # arithmetic shift: sign rides hi
+    row_cs = _block_scan_f32(hi).astype(jnp.int32) * jnp.int32(
+        65536
+    ) + _block_scan_f32(lo).astype(jnp.int32)
+    block_tot = row_cs[:, -1]
+    offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), value_cumsum(block_tot, force_blocked)[:-1]]
     )
     return (row_cs + offs[:, None]).reshape(-1)
 
@@ -167,8 +234,12 @@ def indptr_segment_sum(
     indptr boundaries — measured ~7x cheaper than the scatter-add
     `segment_sum` lowers to on TPU (2.8 ms vs 0.2+overhead ms at 200k
     rows), and it vmaps as a batched axis-wise scan instead of a
-    batched scatter. Result is zero-padded to the static `out_size`."""
-    tot = jnp.concatenate([jnp.zeros(1, vals.dtype), jnp.cumsum(vals)])
+    batched scatter. The prefix sum itself runs MXU-blocked
+    (:func:`value_cumsum`): at SF100 scale this cumsum over the 80M-row
+    edge list was ~2 s/pass of XLA's log-depth reduce-window — the
+    whole r04 two-hop COUNT cliff. Result is zero-padded to the static
+    `out_size`."""
+    tot = jnp.concatenate([jnp.zeros(1, vals.dtype), value_cumsum(vals)])
     seg = jnp.take(tot, indptr[1:]) - jnp.take(tot, indptr[:-1])
     pad = out_size - seg.shape[0]
     if pad > 0:

@@ -5,6 +5,14 @@ kwarg; older releases (e.g. 0.4.x) only have
 ``jax.experimental.shard_map.shard_map`` whose equivalent kwarg is
 ``check_rep``. Import :func:`shard_map` from here so the sharded
 execution plane runs on either.
+
+``WHILE_CHECK_OK`` gates the replication check for kernels whose body
+carries a ``lax.while_loop``: the legacy ``check_rep`` machinery has no
+replication rule for ``while`` (it raises NotImplementedError at trace
+time), while the modern ``check_vma`` path handles it. The
+frontier-sparse BFS step (parallel/sharded.py) early-exits with a
+``while_loop`` and passes ``check_vma=WHILE_CHECK_OK`` so the check
+stays on wherever the runtime supports it.
 """
 
 from __future__ import annotations
@@ -17,6 +25,10 @@ except ImportError:  # older jax: the experimental home, check_rep kwarg
     from jax.experimental.shard_map import shard_map as _shard_map
 
     _LEGACY = True
+
+#: True when the active shard_map's replication check can analyze a
+#: lax.while_loop body (legacy check_rep cannot)
+WHILE_CHECK_OK = not _LEGACY
 
 
 def shard_map(f, **kw):

@@ -4,8 +4,7 @@ The reference scales out with Hazelcast replication and per-cluster server
 ownership ([E] OHazelcastPlugin / ODistributedConfiguration, SURVEY.md §2
 "Distributed"); the TPU-native design shards the **CSR by source-vertex
 range across chips** and merges per-hop frontiers with XLA collectives over
-ICI (`psum` OR-merge of frontier bitmaps — SURVEY.md §5.7's ring-attention
-analog for deep traversal).
+ICI (SURVEY.md §5.7's ring-attention analog for deep traversal).
 
 Mesh axes (the DP×TP analog for a graph engine):
   - ``replicas`` — independent query streams (each replica holds a block of
@@ -14,16 +13,36 @@ Mesh axes (the DP×TP analog for a graph engine):
     [s·rows_per_shard, (s+1)·rows_per_shard) and their out-edges; the
     model-parallel axis).
 
-Everything compiles under one `jit(shard_map(...))`: the per-hop schedule is
-  local edge-activation gather → scatter-OR into a [Q, V] bitmap → psum
-over `shards`, iterated by `lax.fori_loop` for multi-hop BFS with a visited
-bitmap (the columnar analog of [E] OTraverseStatement's visited set).
+Frontier-sparse schedule (the "invert the mesh" rework): the BFS state is
+**vertex-sharded, never replicated** — each shard carries only its own
+[Q, rows_per_shard] slice of the frontier and visited bitmaps, so the
+per-hop collective is ONE ``psum_scatter`` of the hop's contribution
+(the reduce half of the old psum all-reduce; the broadcast half is gone
+because no shard ever needs the full [Q, V_pad] bitmap again). A shard
+whose local frontier slice is empty skips its gather/scatter entirely
+(``lax.cond`` on a device-side liveness scalar), the loop early-exits the
+moment the global frontier drains (a scalar ``psum`` carried through a
+``lax.while_loop`` — ``max_depth`` is a device operand, not a trace
+constant), and the loop body is double-buffered: hop N's ring merge is
+issued on the carried contribution slot BEFORE the local gather of the
+next frontier consumes it, so XLA's async-collective scheduler can
+overlap the merge with the expansion compute in front of it. The final
+[Q, V] assembly happens HOST-side after the last hop (per-shard
+``copy_to_host_async`` in :func:`fetch_sharded`) — the merge that used
+to ride an all-gather inside every hop.
+
+Recompile-free geometry: ``_BFS_STEP_CACHE`` keys executables by
+(mesh, axis names) only — padded dims ride the jit cache's shape key,
+and the scattered-state design removes shard row-range trace constants
+from the BFS entirely (the engine-side expansion kernels in
+``parallel/mesh_graph.py`` take their row spans as device operands for
+the same reason) — so a shard sweep or an elastic re-shard back to a
+previously-seen geometry never retraces.
 """
 
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -31,11 +50,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from orientdb_tpu.parallel.shard_compat import shard_map
+from orientdb_tpu.parallel.shard_compat import WHILE_CHECK_OK, shard_map
 
 from orientdb_tpu.storage.snapshot import GraphSnapshot
 from orientdb_tpu.utils.config import config
 from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
 
 log = get_logger("sharded")
 
@@ -99,6 +119,21 @@ def make_mesh(
     return Mesh(arr, (config.mesh_replica_axis, config.mesh_shard_axis))
 
 
+def fetch_sharded(arr) -> np.ndarray:
+    """Host-side assembly of a fully-sharded device result: start every
+    shard's device→host copy together (``copy_to_host_async`` per
+    addressable shard), then assemble — the per-shard result-page merge
+    moved OFF the hot loop, where it used to be the broadcast half of a
+    per-hop all-reduce."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards is not None:
+        for sh in shards:
+            fn = getattr(sh.data, "copy_to_host_async", None)
+            if fn is not None:
+                fn()
+    return np.asarray(arr)
+
+
 class ShardedCSR:
     """One edge class's out-CSR, row-sharded by vertex range.
 
@@ -144,86 +179,136 @@ class ShardedCSR:
         return cls(mesh, csr.indptr_out, csr.dst)
 
 
-def _local_hop(indptr_l, dst_l, frontier, rows_per_shard, v_pad, shard_axis):
-    """One shard's contribution to the next frontier.
-
-    indptr_l [rows+1] local CSR; dst_l [E_max] global dst (-1 pad);
-    frontier [Q, V_pad] replicated bitmap; ``shard_axis`` is the mesh
-    axis NAME, read from config on the host before the trace boundary.
-    Returns [Q, V_pad] bitmap of vertices reached through this shard's
-    edges.
-    """
-    e_max = dst_l.shape[0]
-    epos = jnp.arange(e_max, dtype=jnp.int32)
-    src_local = jnp.clip(
-        jnp.searchsorted(indptr_l, epos, side="right").astype(jnp.int32) - 1,
-        0,
-        rows_per_shard - 1,
-    )
-    shard_id = jax.lax.axis_index(shard_axis)
-    src_global = src_local + shard_id * rows_per_shard
-    edge_live = (dst_l >= 0) & (epos < indptr_l[-1])
-    # [Q, E_max]: edge active iff its source is in that query's frontier
-    active = frontier[:, src_global] & edge_live[None, :]
-    dst_c = jnp.clip(dst_l, 0, v_pad - 1)
-    contrib = jnp.zeros(frontier.shape, bool).at[:, dst_c].max(active)
-    return contrib
-
-
-#: (mesh, axes, geometry) → jitted BFS step. Un-memoized, every
-#: bfs_reachability call built a FRESH jax.jit wrapper — a fresh trace
-#: cache, so every query paid a full retrace+recompile (jaxlint's
-#: un-memoized-jit finding, confirmed by deviceguard's re-record
-#: counters). Meshes per process are few; the cache is unbounded.
+#: (mesh, axis names) → jitted BFS step. Padded dims (rows_per_shard,
+#: v_pad, query block) key the jit's OWN shape cache, and max_depth is a
+#: device operand — so a shard sweep revisiting a geometry, a re-shard,
+#: or a depth change NEVER retraces (the deviceguard-visible contract;
+#: tests/test_sharded.py asserts it). Meshes per process are few; the
+#: cache is unbounded.
 _BFS_STEP_CACHE: Dict[Tuple, object] = {}
 
 
-def build_bfs_step(
-    mesh: Mesh, rows_per_shard: int, v_pad: int, max_depth: int
-):
+def build_bfs_step(mesh: Mesh):
     """Compile the sharded multi-hop BFS step (the framework's
     `dryrun_multichip` "training step": DP over query replicas × TP over
-    CSR shards, psum OR-merge per hop over ICI)."""
+    CSR shards, one psum_scatter ring merge per hop over ICI). Geometry
+    rides operand shapes; depth rides a device operand."""
+    from orientdb_tpu.parallel.mesh_graph import _merge_dtype
+
     # axis names are host-side trace constants: read them here, not
     # inside the traced closure (they also key the memo — a retuned
     # axis name must not serve a stale executable)
     shard_ax = config.mesh_shard_axis
     rep_ax = config.mesh_replica_axis
-    key = (mesh, shard_ax, rep_ax, rows_per_shard, v_pad, max_depth)
+    key = (mesh, shard_ax, rep_ax)
     cached = _BFS_STEP_CACHE.get(key)
     if cached is not None:
         return cached
+    S = mesh.shape[shard_ax]
+    cdtype = _merge_dtype(mesh)
+    metrics.incr("mesh.kernel_builds")
 
-    def step(indptr_sh, dst_sh, roots):
-        # roots: [Q, V_pad] bool, replica-sharded on axis 0
-        def inner(indptr_l, dst_l, frontier0):
+    def step(indptr_sh, dst_sh, roots, depth_cap):
+        # roots: [Q, V_pad] bool — replica-sharded rows, SHARD-sharded
+        # columns: the frontier/visited state lives scattered by vertex
+        # range and is never replicated across shards
+        def inner(indptr_l, dst_l, frontier0_l, cap):
             indptr_l = indptr_l[0]  # drop the size-1 sharded block dims
             dst_l = dst_l[0]
-
-            def body(_, state):
-                frontier, visited = state
-                contrib = _local_hop(
-                    indptr_l, dst_l, frontier, rows_per_shard, v_pad,
-                    shard_ax,
+            R = indptr_l.shape[0] - 1
+            v_pad = R * S
+            Q = frontier0_l.shape[0]
+            # loop-invariant edge geometry, hoisted out of the hop loop
+            e_max = dst_l.shape[0]
+            epos = jnp.arange(e_max, dtype=jnp.int32)
+            src_local = jnp.clip(
+                jnp.searchsorted(indptr_l, epos, side="right").astype(
+                    jnp.int32
                 )
-                merged = (
-                    jax.lax.psum(contrib.astype(jnp.int32), shard_ax) > 0
-                )
-                nxt = merged & ~visited
-                return nxt, visited | nxt
-
-            frontier, visited = jax.lax.fori_loop(
-                0, max_depth, body, (frontier0, frontier0)
+                - 1,
+                0,
+                R - 1,
             )
-            return visited
+            edge_live = (dst_l >= 0) & (epos < indptr_l[-1])
+            dst_c = jnp.clip(dst_l, 0, v_pad - 1)
+
+            def expand(frontier_l):
+                # [Q, R] local frontier slice → [Q, v_pad] contribution:
+                # edge active iff its (locally-owned) source is lit
+                active = frontier_l[:, src_local] & edge_live[None, :]
+                return (
+                    jnp.zeros((Q, v_pad), cdtype)
+                    .at[:, dst_c]
+                    .max(active.astype(cdtype))
+                )
+
+            def contrib_of(frontier_l, go):
+                # frontier-sparse: a shard whose local frontier slice is
+                # empty — or a hop the depth cap will discard anyway —
+                # skips its gather/scatter entirely. The frontier half
+                # of the predicate varies per shard and the branches
+                # carry no collective, so each device decides alone.
+                return jax.lax.cond(
+                    go & frontier_l.any(),
+                    expand,
+                    lambda _f: jnp.zeros((Q, v_pad), cdtype),
+                    frontier_l,
+                )
+
+            live0 = jax.lax.psum(
+                frontier0_l.any().astype(jnp.int32), shard_ax
+            )
+            contrib0 = contrib_of(frontier0_l, jnp.int32(0) < cap[0])
+
+            def cond_fn(state):
+                depth, live, _contrib, _visited = state
+                return (depth < cap[0]) & (live > 0)
+
+            def body(state):
+                depth, _live, contrib, visited_l = state
+                # hop N's ring merge is ISSUED here on the carried
+                # (double-buffered) contribution slot, before the local
+                # gather of the NEXT frontier at the bottom of the body
+                # consumes its result — the reduce-scatter leaves each
+                # shard exactly its own [Q, R] slice of the merged
+                # frontier, so no broadcast half ever runs
+                merged_l = jax.lax.psum_scatter(
+                    contrib, shard_ax, scatter_dimension=1, tiled=True
+                )
+                nxt_l = (merged_l > 0) & ~visited_l
+                # scalar liveness psum: independent of the expansion
+                # below, so it overlaps the gather/scatter compute
+                live = jax.lax.psum(
+                    nxt_l.any().astype(jnp.int32), shard_ax
+                )
+                return (
+                    depth + 1,
+                    live,
+                    contrib_of(nxt_l, depth + 1 < cap[0]),
+                    visited_l | nxt_l,
+                )
+
+            _d, _l, _c, visited_l = jax.lax.while_loop(
+                cond_fn,
+                body,
+                (jnp.int32(0), live0, contrib0, frontier0_l),
+            )
+            return visited_l
 
         return shard_map(
             inner,
             mesh=mesh,
-            in_specs=(P(shard_ax, None), P(shard_ax, None), P(rep_ax, None)),
-            out_specs=P(rep_ax, None),
-            check_vma=True,
-        )(indptr_sh, dst_sh, roots)
+            in_specs=(
+                P(shard_ax, None),
+                P(shard_ax, None),
+                P(rep_ax, shard_ax),
+                P(None),
+            ),
+            out_specs=P(rep_ax, shard_ax),
+            # legacy check_rep has no replication rule for while_loop;
+            # newer check_vma analyzes it — shard_compat gates the check
+            check_vma=WHILE_CHECK_OK,
+        )(indptr_sh, dst_sh, roots, depth_cap)
 
     fn = jax.jit(step)
     _BFS_STEP_CACHE[key] = fn
@@ -235,7 +320,9 @@ def bfs_reachability(
 ) -> np.ndarray:
     """Multi-source BFS closure: roots [Q, V] bool → visited [Q, V] bool
     (roots included at depth 0, like TRAVERSE / MATCH-WHILE emit-origin
-    semantics)."""
+    semantics). ``max_depth`` is a device operand — sweeping it reuses
+    one executable — and the loop exits early when the global frontier
+    drains before the cap."""
     mesh = scsr.mesh
     Q = roots.shape[0]
     reps = mesh.shape[config.mesh_replica_axis]
@@ -243,10 +330,15 @@ def bfs_reachability(
     fr = np.zeros((q_pad, scsr.padded_vertices), bool)
     fr[:Q, : roots.shape[1]] = roots
     fr_dev = jax.device_put(
-        jnp.asarray(fr), NamedSharding(mesh, P(config.mesh_replica_axis, None))
+        jnp.asarray(fr),
+        NamedSharding(
+            mesh, P(config.mesh_replica_axis, config.mesh_shard_axis)
+        ),
     )
-    step = build_bfs_step(
-        mesh, scsr.rows_per_shard, scsr.padded_vertices, max_depth
+    cap_dev = jax.device_put(
+        np.asarray([max_depth], np.int32),
+        NamedSharding(mesh, P(None)),
     )
-    visited = step(scsr.indptr, scsr.dst, fr_dev)
-    return np.asarray(visited)[:Q, : scsr.num_vertices]
+    step = build_bfs_step(mesh)
+    visited = step(scsr.indptr, scsr.dst, fr_dev, cap_dev)
+    return fetch_sharded(visited)[:Q, : scsr.num_vertices]

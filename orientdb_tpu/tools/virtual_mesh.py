@@ -1,0 +1,51 @@
+"""Run a probe module in a subprocess pinned to a virtual CPU mesh.
+
+The CPU device count is fixed at process start (XLA reads
+``--xla_force_host_platform_device_count`` once), so every shard-count
+probe needs its own process. ONE implementation of the env pinning,
+launch, and last-JSON-line protocol, shared by ``bench.py``'s
+``mesh_scaling``/``sharded_sf`` blocks and the standalone
+``tools/mesh_scaling.py --sweep`` — the two must never diverge on the
+probe contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List
+
+
+def run_virtual_mesh_subprocess(
+    module: str, argv: List, timeout: int, n_devices: int = 8
+) -> Dict:
+    """Launch ``python -m module *argv`` on an ``n_devices``-CPU mesh;
+    returns the parsed last stdout JSON line, or an {"error": ...} dict
+    carrying the best diagnostic (probes print their failure JSON to
+    STDOUT before exiting nonzero; a hung or killed child reports too,
+    never hangs the caller)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"{os.environ.get('XLA_FLAGS', '')} "
+        f"--xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", module, *[str(a) for a in argv]],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        lines = p.stdout.strip().splitlines()
+        if p.returncode != 0 or not lines:
+            return {
+                "error": (lines[-1] if lines else "")[-300:]
+                or p.stderr[-300:]
+            }
+        return json.loads(lines[-1])
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": str(e)[:300]}

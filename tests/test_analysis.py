@@ -1234,6 +1234,73 @@ class TestJaxlintMutations:
             "jaxlint", {"orientdb_tpu/exec/m.py": src}
         ) == []
 
+    def test_full_capacity_all_gather_flags(self):
+        """The exact pattern ISSUE 13 removed from expand_gather: a
+        device count tracks the live extent, yet the whole cap block
+        rides an all_gather."""
+        src = (
+            "import jax\n"
+            "def kern(mesh):\n"
+            "    def local(ind_l, srcs):\n"
+            "        counts = degree_counts(ind_l, srcs)\n"
+            "        tot = counts.sum()\n"
+            "        blk = gather_expand(ind_l, srcs, tot)\n"
+            "        return jax.lax.all_gather(blk, 'shards')\n"
+            "    return shard_map(local, mesh=mesh)\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/parallel/m.py": src})
+        assert any(
+            "full-capacity all_gather" in f.message and f.line == 7
+            for f in fs
+        )
+        assert any("tot" in f.message for f in fs)
+
+    def test_subscript_store_does_not_whitelist_buffer(self):
+        """`acc[i] = counts.sum()` stores a count INTO a buffer; an
+        all_gather of that whole buffer is exactly the full-capacity
+        pattern and must still flag (only plain Name targets become
+        count names)."""
+        src = (
+            "import jax\n"
+            "def kern(mesh):\n"
+            "    def local(acc, counts):\n"
+            "        tot = counts.sum()\n"
+            "        acc[0] = counts.max()\n"
+            "        return jax.lax.all_gather(acc, 'shards')\n"
+            "    return shard_map(local, mesh=mesh)\n"
+        )
+        fs = run_pass("jaxlint", {"orientdb_tpu/parallel/m.py": src})
+        assert any(
+            "full-capacity all_gather" in f.message and f.line == 6
+            for f in fs
+        )
+
+    def test_all_gather_of_device_count_is_clean(self):
+        """Gathering the extents themselves (expand_totals' scalar
+        exchange) must stay clean, including [None]/reshape lifts."""
+        src = (
+            "import jax\n"
+            "def kern(mesh):\n"
+            "    def local(ind_l, srcs):\n"
+            "        counts = degree_counts(ind_l, srcs)\n"
+            "        tot = counts.sum()[None]\n"
+            "        g = jax.lax.all_gather(tot, 'shards').reshape(-1)\n"
+            "        return g, jax.lax.all_gather(counts.max(), 'shards')\n"
+            "    return shard_map(local, mesh=mesh)\n"
+        )
+        assert run_pass("jaxlint", {"orientdb_tpu/parallel/m.py": src}) == []
+
+    def test_all_gather_without_tracked_count_is_clean(self):
+        """No device count in the region → a block gather may be the
+        genuine need; the rule targets the tracked-extent pattern."""
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return jax.lax.all_gather(x, 'shards')\n"
+        )
+        assert run_pass("jaxlint", {"orientdb_tpu/parallel/m.py": src}) == []
+
     def test_suppression_with_justification_silences(self):
         src = (
             "import jax, time\n"
