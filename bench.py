@@ -1252,6 +1252,7 @@ def _measure() -> None:
         conc = {"sessions": n_sessions, "ops_per_session": per_session}
         _csp = _bench_span("bench.block", block="concurrent_sessions")
         _csp.__enter__()
+        _conc_t0 = time.monotonic()
         try:
             # ceiling: ONE client's explicit in-frame batch op
             with connect(url, "admin", "pw") as rdb:
@@ -1338,6 +1339,29 @@ def _measure() -> None:
                     "coalesce.window_ms", 0.0
                 ),
             }
+            # flight-recorder overlap verdict for THIS block's window
+            # (obs/timeline): did the lane double-buffer/ring/prefetch
+            # machinery actually hide work? The evidence record carries
+            # the derived fractions so the next perf round can prove
+            # its overlap claims numerically, and the full Perfetto
+            # export persists as the round's TIMELINE artifact.
+            from orientdb_tpu.obs.timeline import recorder as _flight
+
+            _conc_win = time.monotonic() - _conc_t0 + 1.0
+            conc["overlap"] = _flight.overlap(window_s=_conc_win)
+            try:
+                from orientdb_tpu.storage.durability import atomic_write
+
+                atomic_write(
+                    os.path.join(
+                        detail_dir, f"TIMELINE_r{round_n:02d}.json"
+                    ),
+                    json.dumps(
+                        _flight.chrome_trace(window_s=_conc_win)
+                    ).encode(),
+                )
+            except OSError as e:  # artifact loss must not fail the run
+                conc["timeline_artifact_error"] = f"{type(e).__name__}: {e}"
         finally:
             _csp.__exit__(None, None, None)
             block_trace["concurrent_sessions"] = _csp.trace_id

@@ -101,9 +101,17 @@ def _run(db, stmt: A.Statement, params, engine: Optional[str], strict: bool):
             metrics.incr("query.tpu.fallback")
             log.info("tpu engine fallback to oracle: %s", e)
     metrics.incr("query.oracle")
+    import orientdb_tpu.obs.timeline as TL
     from orientdb_tpu.exec.oracle import execute_statement
 
-    return execute_statement(db, stmt, params), "oracle"
+    # the oracle is a dispatch path too: its flight record carries no
+    # device intervals (host interpreter), but its wall time shows up
+    # in the timeline next to the compiled paths it is compared against
+    rec = TL.recorder.begin("oracle")
+    with TL.active(rec):
+        rows = execute_statement(db, stmt, params)
+    TL.recorder.commit(rec)
+    return rows, "oracle"
 
 
 def _result_set(rows, engine_used: str) -> ResultSet:
@@ -304,8 +312,17 @@ def execute_query_batch(
     # to all N shapes would fabricate exactly the aggregate evidence
     # this table exists to make trustworthy (the failure still lands in
     # query.latency_s / the caller's error path)
+    import orientdb_tpu.obs.timeline as TL
+
+    # one flight record for the whole in-frame batch (refined to
+    # "group" when a vmapped group dispatch forms inside it)
+    rec = TL.recorder.begin(
+        "batch", sql=sqls[0] if sqls else None, n=len(sqls)
+    )
     with span("query_batch", n=len(sqls)):
-        out = _execute_query_batch(db, sqls, params_list, engine, strict)
+        with TL.active(rec):
+            out = _execute_query_batch(db, sqls, params_list, engine, strict)
+    TL.recorder.commit(rec)
     # per-statement stats with the batch's amortized wall clock: device
     # time overlaps across the whole batch, so per-item attribution
     # would be fiction — calls/rows/engine are what aggregate honestly
@@ -369,7 +386,14 @@ def _execute_query_batch(
     return out
 
 
-def dispatch_lane_batch(db, sqls, params_list=None, ring_state=None):
+def dispatch_lane_batch(
+    db,
+    sqls,
+    params_list=None,
+    ring_state=None,
+    enqueue_ts=None,
+    window_s=None,
+):
     """Lane front door (server/coalesce): NON-BLOCKING dispatch of one
     fingerprint lane's homogeneous micro-batch. Returns a handle whose
     ``collect()`` yields the ResultSets (folding per-item stats
@@ -380,7 +404,11 @@ def dispatch_lane_batch(db, sqls, params_list=None, ring_state=None):
 
     ``ring_state`` is the lane's opaque per-plan staging state (a plain
     dict the engine keeps its :class:`tpu_engine.ParamRing` in), so the
-    coalescer never has to import the device stack."""
+    coalescer never has to import the device stack. ``enqueue_ts``
+    (monotonic: the first rider's lane entry) and ``window_s`` (the
+    collection window that formed this batch) stamp the dispatch's
+    flight record (obs/timeline) so overlap accounting can decompose
+    lane wait vs service."""
     n = len(sqls)
     if params_list is None:
         params_list = [None] * n
@@ -399,7 +427,14 @@ def dispatch_lane_batch(db, sqls, params_list=None, ring_state=None):
         ring = ring_state.get("ring")
         if ring is None:
             ring = ring_state["ring"] = tpu_engine.ParamRing()
-    h = tpu_engine.dispatch_lane(db, items, ring=ring)
+    h = tpu_engine.dispatch_lane(
+        db,
+        items,
+        ring=ring,
+        sql=sqls[0],
+        enqueue_ts=enqueue_ts,
+        window_s=window_s,
+    )
     if h is None:
         return None
     return _LaneHandle(sqls, h)

@@ -114,6 +114,12 @@ def _fetch_profiled(devs: List, split_sync: bool = True) -> List[np.ndarray]:
         from orientdb_tpu.obs.stats import add_device
 
         add_device(t1 - t0, t2 - t1, nbytes)
+        # flight-recorder intervals (obs/timeline): same thread-local
+        # discipline — the active dispatch record gets this wave's
+        # device-busy and transfer intervals for overlap accounting
+        from orientdb_tpu.obs.timeline import add_phase
+
+        add_phase(t1 - t0, t2 - t1, nbytes)
     return arrs
 
 
@@ -2859,6 +2865,10 @@ class _CompiledPlan(_AotWarmup):
     def dispatch(self, params: Optional[Dict] = None):
         """Enqueue the replay on device; returns the un-fetched result."""
         self.wait_compiled()
+        import orientdb_tpu.obs.timeline as _TL
+
+        if self.solver.dg.mesh_graph is not None:
+            _TL.note_path("sharded")
         dyn = self._dyn_args(params)
         if dyn:
             # EXPLICIT host→device upload of the parameter scalars/seed
@@ -2866,7 +2876,9 @@ class _CompiledPlan(_AotWarmup):
             # transfer implicitly on every dispatch — invisible to
             # profiling and flagged by the deviceguard transfer guard
             dyn = jax.device_put(dyn)
+            _TL.mark("param_upload")
         dev = self.jitted(self._arg_subset(), dyn)
+        _TL.mark("device_dispatch")
         self._prefetch_elected(dev)
         return dev
 
@@ -2887,6 +2899,9 @@ class _CompiledPlan(_AotWarmup):
         if pages and 0 <= idx < len(pages):
             _copy_to_host_async(pages[idx])
             metrics.incr("tpu.page_prefetch.start")
+            from orientdb_tpu.obs.timeline import note_prefetch_start
+
+            note_prefetch_start()
 
     def batchable(self) -> bool:
         """Eligible for the vmapped one-Execute group dispatch: count-only
@@ -3471,16 +3486,24 @@ def _run_variants(
 
 
 def execute(db, stmt, params) -> List[Result]:
-    variants, rows, _fresh = _prepare(db, stmt, params)
-    if variants is None:
-        return rows
-    plan = variants.pick(params)
-    try:
-        rows = plan.rows(params or {})
-        variants.remember(params, plan)
-        return rows
-    except ScheduleOverflow:
-        return _run_variants(db, stmt, params, variants, tried=plan)
+    import orientdb_tpu.obs.timeline as _TL
+
+    # flight record for the compiled single-dispatch path (refined to
+    # "sharded" by a mesh plan's dispatch); an Uncompilable/overflow
+    # escape drops the record uncommitted — only real dispatches ring
+    rec = _TL.recorder.begin("single")
+    with _TL.active(rec):
+        variants, rows, _fresh = _prepare(db, stmt, params)
+        if variants is not None:
+            plan = variants.pick(params)
+            _TL.mark("plan_resolve")
+            try:
+                rows = plan.rows(params or {})
+                variants.remember(params, plan)
+            except ScheduleOverflow:
+                rows = _run_variants(db, stmt, params, variants, tried=plan)
+    _TL.recorder.commit(rec)
+    return rows
 
 
 #: minimum same-plan items in a batch before the vmapped group dispatch
@@ -3523,16 +3546,18 @@ class ParamRing:
         """Device form of ``host`` (a dict of stacked numpy arrays):
         the staged copy when a slot's value set matches, a fresh
         explicit upload into the next slot otherwise."""
+        from orientdb_tpu.obs.timeline import note_ring
+
         for slot in self._slots:
             if slot is not None and self._same(slot[0], host):
                 metrics.incr("tpu.param_ring.hit")
+                note_ring(True)
                 return slot[1]
         dev = jax.device_put(host)
+        nbytes = sum(int(a.nbytes) for a in host.values())
         metrics.incr("tpu.param_ring.upload")
-        metrics.incr(
-            "tpu.param_ring.bytes",
-            sum(int(a.nbytes) for a in host.values()),
-        )
+        metrics.incr("tpu.param_ring.bytes", nbytes)
+        note_ring(False, nbytes)
         self._slots[self._next] = (host, dev)
         self._next = (self._next + 1) % len(self._slots)
         return dev
@@ -3702,6 +3727,9 @@ def _group_dispatch(plan, dyns: List[Dict], ring: ParamRing = None):
     executable is still compiling (callers dispatch per-lane instead).
     Shared by ``execute_batch``'s same-plan runs and the coalescer's
     lane drains (``dispatch_lane``)."""
+    import orientdb_tpu.obs.timeline as _TL
+
+    _TL.note_path("group")
     if not dyns[0]:
         # no dynamic args: every lane is the SAME program on the same
         # inputs — one plain dispatch serves the whole group
@@ -3716,6 +3744,7 @@ def _group_dispatch(plan, dyns: List[Dict], ring: ParamRing = None):
     dev = plan.dispatch_many(dyns, ring=ring)
     if dev is None:
         return None
+    _TL.mark("device_dispatch")
     if isinstance(dev, tuple) and len(dev) == 2 and dev[1] is not None:
         # rows-group replay: (meta stack, data stack)
         grp = _Group(dev[0], data_dev=dev[1])
@@ -3734,6 +3763,7 @@ def _group_dispatch(plan, dyns: List[Dict], ring: ParamRing = None):
                 grp.spec_dev = fn(dev[1])
                 _copy_to_host_async(grp.spec_dev)
                 metrics.incr("tpu.page_prefetch.start")
+                _TL.note_prefetch_start()
     else:
         grp = _Group(dev[0] if isinstance(dev, tuple) else dev)
     return grp, list(range(len(dyns)))
@@ -3764,6 +3794,11 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
     # further); the meta's bit-width flag picks the int16 copy when live
     # values allow, halving the bytes again.
     import time as _time
+
+    from orientdb_tpu.obs.timeline import (
+        add_phase as _tl_add_phase,
+        note_prefetch as _tl_note_prefetch,
+    )
 
     pages_sel: List = [None] * len(pending)
     seen_groups = set()
@@ -3796,11 +3831,11 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
         # prefetch: a repeat election means the copy started with the
         # dispatch and this async call is a no-op
         if plan._page_guess is not None:
+            hit = plan._page_guess == (idx, f16)
             metrics.incr(
-                "tpu.page_prefetch.hit"
-                if plan._page_guess == (idx, f16)
-                else "tpu.page_prefetch.miss"
+                "tpu.page_prefetch.hit" if hit else "tpu.page_prefetch.miss"
             )
+            _tl_note_prefetch(hit, int(d.nbytes) if hit else 0)
         plan._page_guess = (idx, f16)
         _copy_to_host_async(d)
         pages_sel[k] = d
@@ -3847,10 +3882,12 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
                 fits16,
             )
             if grp.spec_key is not None:
+                hit = grp.spec_key == key
                 metrics.incr(
-                    "tpu.page_prefetch.hit"
-                    if grp.spec_key == key
-                    else "tpu.page_prefetch.miss"
+                    "tpu.page_prefetch.hit" if hit else "tpu.page_prefetch.miss"
+                )
+                _tl_note_prefetch(
+                    hit, int(grp.spec_dev.nbytes) if hit else 0
                 )
             plan._group_page_guess = key
             plan._group_page_shape = tuple(grp.data_dev.shape)
@@ -3891,6 +3928,7 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
         from orientdb_tpu.obs.stats import add_device
 
         add_device(t1 - t0, t2 - t1, nbytes)
+        _tl_add_phase(t1 - t0, t2 - t1, nbytes)
     overflowed = []
     with timed("tpu.host_s"):
         for k, ((i, variants, plan, dev), meta) in enumerate(
@@ -3923,28 +3961,43 @@ class LaneDispatch:
     (staging its parameters into the lane's :class:`ParamRing`) BEFORE
     collecting batch N — double-buffered dispatch, so batch formation
     and parameter upload overlap the device execution in front of them
-    instead of serializing behind it."""
+    instead of serializing behind it. Carries the dispatch's flight
+    record (obs/timeline) across the dispatch→collect gap — the lane
+    worker thread runs other work in between, so the record cannot
+    stay thread-local."""
 
-    __slots__ = ("db", "items", "pending")
+    __slots__ = ("db", "items", "pending", "rec")
 
-    def __init__(self, db, items, pending) -> None:
+    def __init__(self, db, items, pending, rec=None) -> None:
         self.db = db
         self.items = items
         self.pending = pending
+        self.rec = rec
 
     def collect(self) -> List:
         """Fetch + marshal the dispatched batch; returns per-item row
         lists in submission order (blocking — the device round trip
         this batch amortizes across its members)."""
+        import orientdb_tpu.obs.timeline as _TL
+
         out: List = [None] * len(self.items)
         fresh: List = []
-        _finish_pending(self.db, self.items, self.pending, out, fresh)
+        with _TL.active(self.rec):
+            _finish_pending(self.db, self.items, self.pending, out, fresh)
         for plan in fresh:
             plan.wait_compiled()
+        _TL.recorder.commit(self.rec)
         return out
 
 
-def dispatch_lane(db, items, ring: ParamRing = None):
+def dispatch_lane(
+    db,
+    items,
+    ring: ParamRing = None,
+    sql: Optional[str] = None,
+    enqueue_ts: Optional[float] = None,
+    window_s: Optional[float] = None,
+):
     """Lane-aware dispatch entry: a fingerprint-keyed coalesce lane
     drains a HOMOGENEOUS micro-batch — every item the same statement
     shape — so ONE cached plan serves all of them, with the stacked
@@ -3973,6 +4026,20 @@ def dispatch_lane(db, items, ring: ParamRing = None):
     plan = variants.pick(params0)
     if getattr(plan, "batchable", None) is None or not plan.batchable():
         return None
+    import orientdb_tpu.obs.timeline as _TL
+
+    # the lane drain's flight record: enqueue (first rider's lane
+    # entry) and collection window come from the coalescer; it travels
+    # on the LaneDispatch handle because collect() runs later, after
+    # the worker double-buffers the next batch
+    rec = _TL.recorder.begin("lane", sql=sql, n=len(items))
+    if rec is not None:
+        if enqueue_ts is not None:
+            rec.add_event("enqueue", enqueue_ts)
+        if window_s:
+            rec.marks["window_s"] = float(window_s)
+            rec.add_event("lane_window")
+        rec.add_event("plan_resolve")
     dyns = []
     try:
         for stmt, params in items:
@@ -3991,14 +4058,15 @@ def dispatch_lane(db, items, ring: ParamRing = None):
             dyns.append(plan._dyn_args(params or {}))
     except ScheduleOverflow:
         return None  # the variant walk belongs to the generic path
-    g = _group_dispatch(plan, dyns, ring=ring)
+    with _TL.active(rec):
+        g = _group_dispatch(plan, dyns, ring=ring)
     if g is None:
         return None  # group executable still compiling: generic path
     grp, ks = g
     pending = [(i, variants, plan, _Lane(grp, k)) for i, k in enumerate(ks)]
     metrics.incr("tpu.lane_dispatch")
     metrics.incr("tpu.lane_items", len(items))
-    return LaneDispatch(db, items, pending)
+    return LaneDispatch(db, items, pending, rec)
 
 
 def explain_plan_steps(db, stmt) -> List[str]:

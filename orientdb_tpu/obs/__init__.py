@@ -50,7 +50,14 @@ both claims needs more than `utils/metrics.py`'s counters:
   with exemplar trace ids, served at ``GET /alerts``;
 - :mod:`orientdb_tpu.obs.watchdog` — the ``HealthWatchdog`` thread
   (started/stopped with ``Server``) that ticks the alert engine —
-  evaluation never rides the query hot path.
+  evaluation never rides the query hot path;
+- :mod:`orientdb_tpu.obs.timeline` — the dispatch flight recorder: a
+  bounded ring of per-dispatch lifecycle timelines (every dispatch
+  path: single, group, coalesce lane, sharded mesh, oracle) with an
+  overlap-accounting pass (device-idle fraction, transfer-hidden
+  bytes, lane window vs service, ring upload savings), Chrome-trace/
+  Perfetto export at ``GET /debug/timeline``, and scrape-time
+  ``orienttpu_overlap_*`` gauges.
 """
 
 from orientdb_tpu.obs.alerts import RULE_CATALOG, render_alerts_prometheus
@@ -86,6 +93,8 @@ from orientdb_tpu.obs.registry import (
     snapshot_all,
 )
 from orientdb_tpu.obs.slowlog import slowlog
+from orientdb_tpu.obs.timeline import FlightRecorder
+from orientdb_tpu.obs.timeline import recorder as flight_recorder
 from orientdb_tpu.obs.trace import (
     current_span,
     current_trace_id,
@@ -95,6 +104,8 @@ from orientdb_tpu.obs.trace import (
 
 __all__ = [
     "EvidenceSink",
+    "FlightRecorder",
+    "flight_recorder",
     "QueryStats",
     "RULE_CATALOG",
     "SPAN_CATALOG",

@@ -66,6 +66,11 @@ RULE_CATALOG: Dict[str, str] = {
     "exceeds its online EWMA baseline by alert_latency_mads deviations",
     "error_burn_rate": "query error rate burns the SLO error budget at "
     "more than alert_burn_factor x in BOTH burn windows",
+    "overlap_regression": "the dispatch timeline's device-idle "
+    "fraction (obs/timeline overlap accounting) exceeds its online "
+    "EWMA baseline by alert_overlap_idle_mads deviations — the "
+    "overlap machinery (prefetch, rings, double buffering) stopped "
+    "hiding work",
 }
 
 #: two-window burn-rate windows (seconds): the short window catches the
@@ -219,11 +224,20 @@ class _Baseline:
             self.ewma_s += _EWMA_ALPHA * (mean_s - self.ewma_s)
         self.n += 1
 
-    def breaches(self, mean_s: float) -> bool:
+    def exceeds(self, value: float, mads: float, floor: float) -> bool:
+        """True when ``value`` sits more than ``mads`` deviations above
+        the learned level (deviations floored at ``floor`` — the
+        signal's jitter scale)."""
         if self.n < _BASELINE_WARMUP:
             return False
-        return mean_s > self.ewma_s + config.alert_latency_mads * max(
-            self.mad_s, _MAD_FLOOR_S
+        return value > self.ewma_s + mads * max(self.mad_s, floor)
+
+    def threshold(self, mads: float, floor: float) -> float:
+        return self.ewma_s + mads * max(self.mad_s, floor)
+
+    def breaches(self, mean_s: float) -> bool:
+        return self.exceeds(
+            mean_s, config.alert_latency_mads, _MAD_FLOOR_S
         )
 
 
@@ -249,6 +263,7 @@ class AlertEngine:
         # online learning / windowed state (written only under
         # _eval_mu; read under _mu by summary())
         self._baselines: Dict[str, _Baseline] = {}
+        self._overlap_baseline = _Baseline()
         self._prev_qs: Dict[str, Tuple[int, float, int]] = {}
         self._prev_recompiles: Optional[int] = None
         self._prev_recompiles_ts = 0.0
@@ -444,6 +459,7 @@ class AlertEngine:
                 self._resolved_total = 0
                 self._last_tick_ts = None
                 self._baselines.clear()
+                self._overlap_baseline = _Baseline()
                 self._prev_qs.clear()
                 self._indoubt_seen.clear()
                 self._burn_samples.clear()
@@ -612,6 +628,40 @@ class AlertEngine:
             else:
                 base.update(mean_s)
 
+    #: deviation floor for the device-idle fraction baseline — idle
+    #: fractions are [0,1]; sub-2% wiggle is scheduler jitter
+    _IDLE_MAD_FLOOR = 0.02
+
+    def _check_overlap_regression(
+        self, ctx: AlertContext
+    ) -> Iterable[Breach]:
+        """Device-idle fraction (the obs/timeline overlap gauges,
+        refreshed by the scrape-time provider inside this tick's
+        ``snapshot_all``) vs its online EWMA baseline — the same
+        learn-unless-breaching discipline as the latency rule, so a
+        sustained regression cannot teach the baseline its own level
+        before the pending dwell elapses."""
+        mads = config.alert_overlap_idle_mads
+        min_records = max(int(config.alert_overlap_min_records), 1)
+        if mads <= 0:
+            return
+        idle = ctx.gauges.get("overlap.device_idle_fraction")
+        n_rec = ctx.gauges.get("overlap.window_records", 0)
+        if idle is None or n_rec < min_records:
+            return
+        base = self._overlap_baseline
+        if base.exceeds(idle, mads, self._IDLE_MAD_FLOOR):
+            yield Breach(
+                "device_idle", idle,
+                base.threshold(mads, self._IDLE_MAD_FLOOR),
+                f"device-idle fraction {idle:.3f} vs baseline "
+                f"{base.ewma_s:.3f} "
+                f"(±{max(base.mad_s, self._IDLE_MAD_FLOOR):.3f}) over "
+                f"{int(n_rec)} timeline records",
+            )
+        else:
+            base.update(idle)
+
     def _check_error_burn(self, ctx: AlertContext) -> Iterable[Breach]:
         slo = config.alert_slo_error_rate
         factor = config.alert_burn_factor
@@ -715,6 +765,11 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
     _rule(
         "error_burn_rate", "critical", AlertEngine._check_error_burn,
         exemplar="slowlog",
+    ),
+    _rule(
+        "overlap_regression", "warning",
+        AlertEngine._check_overlap_regression,
+        exemplar_spans=("coalesce.", "tpu.", "query"),
     ),
 )
 

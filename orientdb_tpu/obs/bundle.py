@@ -19,6 +19,11 @@ history as ONE artifact, not four endpoints scraped in a hurry:
   thing to read when a downstream pipeline reports missing events;
 - the alert plane (``obs/alerts``): active and recently-resolved
   alerts (exemplar trace ids included) + the watchdog summary;
+- the dispatch timeline (``obs/timeline``): the flight recorder's
+  overlap report (device-idle / transfer-hidden fractions, ring
+  savings) plus the most recent dispatch records — whether the perf
+  plane's claimed overlap actually happened, in the same artifact as
+  the traces that would explain why not;
 - the bounded log ring (``utils/logging.log_ring``): recent structured
   log records carrying the trace/span ids of whatever emitted them —
   an alert, its exemplar trace, and its log lines join on one id.
@@ -96,6 +101,8 @@ def debug_bundle(
     from orientdb_tpu.obs.alerts import engine
     from orientdb_tpu.obs.profile import profiler
     from orientdb_tpu.obs.stats import stats
+    from orientdb_tpu.obs.timeline import recorder
+    from orientdb_tpu.utils.config import config
     from orientdb_tpu.utils.logging import log_ring
 
     dbs = list(dbs)  # iterated twice: 2PC state and cdc state
@@ -113,6 +120,18 @@ def debug_bundle(
             "summary": engine.summary(),
             "active": engine.active(),
             "history": engine.history(50),
+        },
+        # the dispatch flight recorder's recent window: the overlap
+        # verdict plus a bounded slice of raw records (full Perfetto
+        # export stays on GET /debug/timeline — a bundle is for triage,
+        # not for a 2048-record trace dump)
+        "timeline": {
+            "overlap": recorder.overlap(
+                window_s=config.timeline_window_s
+            ),
+            "records": recorder.records(
+                window_s=config.timeline_window_s, limit=50
+            ),
         },
         # recent structured log records, trace/span-correlated — the
         # ring is bounded (config.log_ring_capacity) and ships only

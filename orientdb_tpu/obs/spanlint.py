@@ -69,6 +69,12 @@ SPAN_CATALOG: Dict[str, str] = {
     "op sequence (HTTP or binary transport)",
     "slo.evaluate": "one SLO-verdict evaluation over a run window "
     "(obs/slo: stats-table deltas + alert state + burn policy)",
+    "timeline.overlap": "one overlap-accounting pass over the flight "
+    "recorder's recent window (obs/timeline: scrape-time gauges, "
+    "bench evidence, the alert rule's signal)",
+    "timeline.export": "Chrome-trace/Perfetto export of the flight "
+    "recorder window (GET /debug/timeline, debug bundle, bench "
+    "TIMELINE artifact)",
 }
 
 #: dynamically named span families (f-string call sites the literal

@@ -210,8 +210,13 @@ def _mesh_kernel(name: str, mesh: Mesh, builder, *static):
         fn = jax.jit(builder(mesh, ax, *static))
         _MESH_KERNEL_CACHE[key] = fn
         # geometry-compile observability: the zero-retrace tests and the
-        # mesh_scaling evidence read this counter's deltas
+        # mesh_scaling evidence read this counter's deltas; the flight
+        # record gets the event so a compile-tainted dispatch is
+        # distinguishable from a steady-state replay on the timeline
         metrics.incr("mesh.kernel_builds")
+        from orientdb_tpu.obs.timeline import mark as _tl_mark
+
+        _tl_mark("kernel_build")
     return fn
 
 

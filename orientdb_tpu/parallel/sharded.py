@@ -124,14 +124,25 @@ def fetch_sharded(arr) -> np.ndarray:
     shard's device→host copy together (``copy_to_host_async`` per
     addressable shard), then assemble — the per-shard result-page merge
     moved OFF the hot loop, where it used to be the broadcast half of a
-    per-hop all-reduce."""
+    per-hop all-reduce. The blocking wall records as one transfer
+    interval on the active flight record (obs/timeline): the sharded
+    path's drain is compute+copy fused (no extra sync is inserted just
+    to split them), so it scores as hidden only where OTHER dispatches'
+    device work overlapped it."""
+    import time as _time
+
+    t0 = _time.monotonic()
     shards = getattr(arr, "addressable_shards", None)
     if shards is not None:
         for sh in shards:
             fn = getattr(sh.data, "copy_to_host_async", None)
             if fn is not None:
                 fn()
-    return np.asarray(arr)
+    out = np.asarray(arr)
+    from orientdb_tpu.obs.timeline import add_transfer
+
+    add_transfer(t0, _time.monotonic(), int(out.nbytes), "fetch")
+    return out
 
 
 class ShardedCSR:

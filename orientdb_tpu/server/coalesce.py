@@ -312,6 +312,11 @@ class _Lane:
                 [i.sql for i in batch],
                 [i.params for i in batch],
                 ring_state=self._ring_state,
+                # flight-recorder context (obs/timeline): when the
+                # first rider entered the lane, and the collection
+                # window that formed this micro-batch
+                enqueue_ts=min(i.t_enq for i in batch),
+                window_s=self._last_window,
             )
         except Exception:
             # eligibility probing must never kill the drain loop; the

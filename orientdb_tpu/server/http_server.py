@@ -470,6 +470,40 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if head == "debug" and rest == ["timeline"]:
+                # the dispatch flight recorder (obs/timeline): the
+                # recent window as Chrome-trace JSON — load it straight
+                # into Perfetto (ui.perfetto.dev) or chrome://tracing.
+                # Admin-only (records carry fingerprints + trace ids,
+                # like the bundle); ?window=<s> bounds it (default
+                # config.timeline_window_s), ?format=json serves raw
+                # records + the overlap report instead.
+                self.server.ot_server.security.check(
+                    user, "server.debug", "read"
+                )
+                from orientdb_tpu.obs.timeline import recorder
+                from orientdb_tpu.utils.config import config
+
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                try:
+                    window = float(q.get("window", ["0"])[0])
+                except ValueError:
+                    window = 0.0
+                if window <= 0:
+                    window = config.timeline_window_s
+                if "json" in q.get("format", []):
+                    return self._send(
+                        200,
+                        {
+                            "overlap": recorder.overlap(window_s=window),
+                            "records": recorder.records(
+                                window_s=window, limit=500
+                            ),
+                        },
+                    )
+                return self._send(200, recorder.chrome_trace(window_s=window))
             if head == "debug" and rest == ["bundle"]:
                 # the flight-recorder bundle (obs/bundle): recent
                 # cross-node traces assembled by trace_id, slowlog,

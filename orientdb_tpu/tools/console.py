@@ -15,7 +15,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   STATS QUERIES [<k>]                 STATS PROFILE / STATS RESET
   CDC LIST                            CDC LAG
   ALERTS [<n>|HISTORY]                HEALTH
-  SLO
+  SLO                                 TIMELINE [<n>]
 """
 
 from __future__ import annotations
@@ -314,6 +314,61 @@ class Console(cmd.Cmd):
                 f"{r['query'][:70]}"
             )
         self._p(f"({len(rows)} shapes)")
+
+    def do_timeline(self, arg: str) -> None:
+        """TIMELINE [<n>] — the dispatch flight recorder (obs/timeline):
+        the overlap verdict over the recent window (device-idle /
+        transfer-hidden fractions, ring savings, lane decomposition)
+        followed by the last n dispatch records (default 10). The full
+        Perfetto-loadable export is GET /debug/timeline."""
+        from orientdb_tpu.obs.timeline import recorder
+        from orientdb_tpu.utils.config import config
+
+        a = arg.strip()
+        n = int(a) if a.isdigit() else 10
+        rep = recorder.overlap(window_s=config.timeline_window_s)
+        if not rep.get("records"):
+            self._p(
+                "timeline empty (no dispatches in the last "
+                f"{config.timeline_window_s:g} s; capacity "
+                f"{config.timeline_capacity})"
+            )
+            return
+        tr = rep.get("transfer", {})
+        ring = rep.get("ring", {})
+        pf = rep.get("prefetch", {})
+        self._p(
+            f"{rep['records']} dispatches over {rep['span_s']:.2f} s  "
+            f"device idle {rep['device_idle_fraction']:.1%}  "
+            f"transfer hidden {tr.get('transfer_hidden_fraction', 0.0):.1%} "
+            f"({tr.get('hidden_bytes', 0)}/{tr.get('bytes', 0)} B)",
+            f"ring hits {ring.get('hits', 0)}/"
+            f"{ring.get('hits', 0) + ring.get('uploads', 0)}  "
+            f"prefetch {pf.get('hits', 0)} hit / {pf.get('misses', 0)} "
+            f"miss / {pf.get('starts', 0)} started  "
+            f"paths {rep.get('paths', {})}",
+        )
+        lane = rep.get("lane")
+        if lane:
+            self._p(
+                f"lane: queue {lane.get('queue_ms_mean')} ms  window "
+                f"{lane.get('window_ms_mean')} ms  service "
+                f"{lane.get('service_ms_mean')} ms "
+                f"({lane['dispatches']} drains)"
+            )
+        recs = recorder.records(
+            window_s=config.timeline_window_s, limit=n
+        )
+        for r in recs:
+            dev_ms = sum(b - a_ for a_, b in r.get("device", [])) * 1e3
+            nbytes = sum(t[2] for t in r.get("transfers", []))
+            fp = r.get("fingerprint") or "-"
+            self._p(
+                f"#{r['seq']:<6} {r['path']:<8} n={r['n']:<4} fp={fp:<16} "
+                f"device {dev_ms:>7.2f} ms  {nbytes:>8} B  "
+                f"{len(r['events'])} events"
+            )
+        self._p(f"({len(recs)} records)")
 
     def do_cdc(self, arg: str) -> None:
         """CDC LIST — changefeed consumers and durable cursors per

@@ -19,7 +19,13 @@ stores):
 
     {"shards": S, "merge_rows": N, "allgather_rows": M, "wall_s": T,
      "replay_s": R, "collective_kb": C, "frontier_occupancy": F,
-     "empty_shard_skips": K, "kernel_builds": J, "result_rows": n}
+     "empty_shard_skips": K, "kernel_builds": J, "result_rows": n,
+     "overlap": {"records": d, "device_idle_fraction": i,
+                 "transfer_hidden_fraction": h, "paths": {...}}}
+
+``overlap`` is the flight recorder's verdict over the probe's own
+dispatches (obs/timeline): how idle the devices sat between them and
+how many transferred bytes hid behind compute.
 
 ``merge_rows`` is what the ring-compacted merge shipped per recording
 (O(pow2 global total)); ``allgather_rows`` is what the pre-rework
@@ -80,6 +86,21 @@ def main(shards: int) -> None:
         replays.append(time.perf_counter() - t1)
     live = delta("mesh.frontier_live_rows")
     slots = delta("mesh.frontier_slot_rows")
+    # overlap verdict for the probe's own dispatches (obs/timeline):
+    # the sharded records land in THIS subprocess's flight recorder, so
+    # the bench mesh_scaling block's per-S evidence carries device-idle
+    # and transfer-hidden fractions next to the collective counters
+    from orientdb_tpu.obs.timeline import recorder as _flight
+
+    rep = _flight.overlap()
+    overlap = {
+        "records": rep.get("records", 0),
+        "device_idle_fraction": rep.get("device_idle_fraction"),
+        "transfer_hidden_fraction": (rep.get("transfer") or {}).get(
+            "transfer_hidden_fraction"
+        ),
+        "paths": rep.get("paths", {}),
+    }
     print(
         json.dumps(
             {
@@ -93,6 +114,7 @@ def main(shards: int) -> None:
                 "empty_shard_skips": delta("mesh.empty_shard_skips"),
                 "kernel_builds": delta("mesh.kernel_builds"),
                 "result_rows": len(rows),
+                "overlap": overlap,
             }
         )
     )

@@ -99,12 +99,56 @@ def main(
     return 0
 
 
+def probe(process_id: int, coordinator_port: int, n_procs: int = 2) -> int:
+    """Backend-capability probe: join a minimal process group (one
+    device per process) and run ONE cross-process collective — the
+    smallest operation the full corpus depends on. Prints
+    ``multihost collectives ok`` on success; a backend without
+    multiprocess collectives (jaxlib's CPU backend in most containers:
+    ``Multiprocess computations aren't implemented on the CPU
+    backend``) fails fast instead, so ``tests/test_multihost.py`` can
+    SKIP as an environment limitation rather than read red."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    kept = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    os.environ["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=1"]
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{coordinator_port}",
+        num_processes=n_procs,
+        process_id=process_id,
+    )
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(np.int32(41) + 1)
+    assert int(out) == 42, f"collective returned {out!r}"
+    print(f"multihost collectives ok: proc {process_id}", flush=True)
+    return 0
+
+
 if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--probe":
+        sys.exit(
+            probe(
+                int(argv[1]),
+                int(argv[2]),
+                int(argv[3]) if len(argv) > 3 else 2,
+            )
+        )
     sys.exit(
         main(
-            int(sys.argv[1]),
-            int(sys.argv[2]),
-            int(sys.argv[3]) if len(sys.argv) > 3 else 2,
-            int(sys.argv[4]) if len(sys.argv) > 4 else 4,
+            int(argv[0]),
+            int(argv[1]),
+            int(argv[2]) if len(argv) > 2 else 2,
+            int(argv[3]) if len(argv) > 3 else 4,
         )
     )

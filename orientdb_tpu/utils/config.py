@@ -136,6 +136,19 @@ class GlobalConfiguration:
     stats_sample_rate: float = 1.0
     query_stats_capacity: int = 512
 
+    # Dispatch flight recorder (obs/timeline): bounded ring of
+    # per-dispatch lifecycle records (enqueue → lane window → plan
+    # resolve → upload/ring hit → device dispatch → compute done →
+    # transfer → result delivered) feeding the overlap accounting pass,
+    # GET /debug/timeline (Chrome-trace/Perfetto export), and the
+    # orienttpu_overlap_* gauges. timeline_capacity is the ring size
+    # (0 disables recording entirely); recording also rides the
+    # stats_sample_rate sampling decision. timeline_window_s bounds the
+    # default export/accounting window (scrape-time gauges, the HTTP
+    # endpoint's default, the debug bundle's timeline section).
+    timeline_capacity: int = 2048
+    timeline_window_s: float = 120.0
+
     # Admission control (server/http_server, server/binary_server):
     # shed WRITE requests with 503 + Retry-After when the listener's
     # in-flight depth or a database's staged-2PC backlog crosses these
@@ -207,6 +220,14 @@ class GlobalConfiguration:
     # error-rate target.
     alert_slo_error_rate: float = 0.05
     alert_burn_factor: float = 4.0
+    # Overlap-regression rule (obs/timeline + obs/alerts): the
+    # device-idle fraction over the recent timeline window must exceed
+    # its online EWMA baseline by alert_overlap_idle_mads deviations to
+    # breach, and only when the window holds at least
+    # alert_overlap_min_records dispatch records (idle computed over
+    # two dispatches is noise, not regression evidence).
+    alert_overlap_idle_mads: float = 6.0
+    alert_overlap_min_records: int = 16
 
     # Trace-correlated logging (utils/logging): the bounded in-memory
     # ring of recent structured log records fed into the debug bundle's

@@ -37,6 +37,14 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.get(name, 0.0)
 
+    def drop_gauge(self, name: str) -> None:
+        """Remove a gauge so the series goes ABSENT in the exposition —
+        the honest shape for "no current data" (a window-derived gauge
+        whose window emptied must not keep exporting its last value as
+        if it were live)."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
             d = self._durations.get(name)

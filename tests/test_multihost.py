@@ -2,12 +2,23 @@
 (VERDICT r2 #5; SURVEY.md:149,352). Spawns 2 REAL processes that jointly
 execute the sharded-MATCH parity corpus over one global 8-device mesh
 (4 CPU devices per process, Gloo collectives over loopback TCP between
-them), asserting oracle parity and per-process memory sharding."""
+them), asserting oracle parity and per-process memory sharding.
 
+Gated on a backend-capability probe: most CPU-only containers ship a
+jaxlib whose CPU backend has NO multiprocess collectives ("Multiprocess
+computations aren't implemented on the CPU backend"), which is an
+environment limitation, not a product regression — the suite must SKIP
+there, not read red. The probe spawns two minimal one-device processes
+and runs one cross-process broadcast (``tools/multihost.py --probe``);
+only a working collective un-gates the real corpus test."""
+
+import functools
 import os
 import socket
 import subprocess
 import sys
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -20,7 +31,55 @@ def _free_port() -> int:
     return port
 
 
+@functools.lru_cache(maxsize=1)
+def _multiprocess_collectives_supported() -> bool:
+    """One cached probe per session: 2 subprocesses, 1 CPU device each,
+    one broadcast across them. Fails in seconds when the backend lacks
+    the capability (the jax runtime raises before any real work)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)  # the module pins cpu itself
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "orientdb_tpu.tools.multihost",
+                "--probe",
+                str(pid),
+                str(port),
+                "2",
+            ],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    ok = True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        ok = ok and p.returncode == 0 and "multihost collectives ok" in out
+    return ok
+
+
 def test_two_process_sharded_match_parity():
+    # probe at RUN time, not collection: --collect-only / deselected
+    # runs must not pay the two-subprocess capability check
+    if not _multiprocess_collectives_supported():
+        pytest.skip(
+            "jax backend lacks multiprocess collectives in this "
+            "container (CPU backend: 'Multiprocess computations "
+            "aren't implemented') — environment limitation, not a "
+            "regression"
+        )
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
