@@ -104,6 +104,11 @@ def compact_line(
             **_slim(
                 ex,
                 (
+                    # "warming" while the pre-warmup artifact is current:
+                    # a harness-timeout round's 0.0 headline must be
+                    # distinguishable from a measured zero on the LINE,
+                    # not only in the detail file
+                    "status",
                     "batch_size",
                     "single_query_qps",
                     "rows_1hop_batched_qps",
@@ -539,6 +544,198 @@ def run_mixed_slo_block(round_n: int, out_dir: str) -> dict:
     }
 
 
+def run_mixed_rw_block() -> dict:
+    """Mixed read/write block (ISSUE 15 acceptance): sustained writes
+    applied as CDC deltas DEVICE-SIDE (storage/deltas) while reads keep
+    serving from the same resident snapshot — no wholesale detach, no
+    full-CSR re-upload. Measures the read-only baseline q/s and the
+    same read shape under a paced writer thread, and evidences the
+    per-write upload bytes against the resident graph size (the
+    "bounded by delta size" criterion). Env knobs: BENCH_RW (0 skips),
+    BENCH_RW_PROFILES (4000), BENCH_RW_FRIENDS (8), BENCH_RW_WINDOW_S
+    (6), BENCH_RW_BATCH (16), BENCH_RW_WRITE_HZ (25 — roughly the SNB
+    interactive write share against this read rate; result-count
+    growth past a pow2 bucket re-records plans, so tiny graphs at
+    high write rates measure recompile churn, not the delta plane)."""
+    import random
+    import threading
+
+    from orientdb_tpu.exec.tpu_engine import drain_warmups
+    from orientdb_tpu.ops.device_graph import device_graph
+    from orientdb_tpu.storage.deltas import arm_delta_maintenance
+    from orientdb_tpu.storage.ingest import generate_demodb
+    from orientdb_tpu.utils.metrics import metrics
+
+    profiles = int(os.environ.get("BENCH_RW_PROFILES", "4000"))
+    friends = int(os.environ.get("BENCH_RW_FRIENDS", "8"))
+    window_s = float(os.environ.get("BENCH_RW_WINDOW_S", "6"))
+    rw_batch = int(os.environ.get("BENCH_RW_BATCH", "16"))
+    write_hz = float(os.environ.get("BENCH_RW_WRITE_HZ", "25"))
+
+    db = generate_demodb(n_profiles=profiles, avg_friends=friends)
+    maint = arm_delta_maintenance(db)
+    graph_bytes = sum(
+        sum(cat.values())
+        for cat in device_graph(
+            db.current_snapshot(require_fresh=True)
+        ).memory_report().values()
+        if isinstance(cat, dict)
+    )
+    sql = (
+        "MATCH {class:Profiles, as:p, where:(age > 40)}"
+        "-HasFriend->{as:f}"
+        "-HasFriend->{as:g, where:(age < 30)} "
+        "RETURN count(*) AS n"
+    )
+    qs = [sql] * rw_batch
+
+    def read_round() -> None:
+        for rs in db.query_batch(qs, engine="tpu", strict=True):
+            rs.to_dicts()
+
+    anchors = []
+    for i, doc in enumerate(db.browse_class("Profiles")):
+        anchors.append(doc)
+        if i >= 255:
+            break
+    rng = random.Random(15)
+    uid_next = [10 * profiles + 1]
+
+    def one_write() -> None:
+        v = db.new_vertex(
+            "Profiles", uid=uid_next[0], age=rng.randint(18, 70)
+        )
+        uid_next[0] += 1
+        db.new_edge("HasFriend", rng.choice(anchors), v)
+
+    def timed_reads(dur_s: float) -> float:
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < dur_s:
+            read_round()
+            n += rw_batch
+        return n / (time.perf_counter() - t0)
+
+    # warm clean plans, then drive the slab through the structural
+    # transition the measured window would otherwise absorb: the first
+    # writes flip topology dirty (slab-aware re-recordings) and the
+    # warm insert volume sizes the slab-scan buckets so the window's
+    # additional writes stay inside them (bucket crossings are
+    # log-spaced one-off recompiles — the read path's compile warmup
+    # is excluded the same way). Steady-state serving is the claim.
+    read_round()
+    drain_warmups()
+    # 1.5x the window's write volume: slab-scan buckets carry 2x
+    # headroom over the warm level, so bucket(2 x warm) > warm + window
+    # volume — the window's growth replays in place with no mid-window
+    # bucket crossing (each crossing is a one-off re-record + XLA
+    # compile, seconds of degraded serving on CPU; they are log-spaced
+    # in slab growth, so steady state excludes them the same way the
+    # read path's compile warmup is excluded)
+    warm_writes = max(8, int(1.5 * window_s * write_hz))
+    for k in range(warm_writes):
+        one_write()
+        if k % 32 == 31:
+            read_round()  # apply the delta batches as they build
+    # re-record at the warmed occupancy: recorded overflow thresholds
+    # pin at recording time, so without this the FIRST dirty recording
+    # (slab nearly empty) would keep its small buckets and the window
+    # would cross them mid-measurement
+    maint.refresh_plans()
+    read_round()
+    drain_warmups()
+    read_round()
+    drain_warmups()
+    baseline_qps = timed_reads(window_s / 2)
+
+    before = metrics.snapshot()["counters"]
+    stop = threading.Event()
+    writes = [0]
+
+    def writer() -> None:
+        # guard against zero/negative only: a sub-1Hz request must pace
+        # at the requested rate, not get silently clamped to 1 write/s
+        pace = 1.0 / max(1e-6, write_hz)
+        while not stop.is_set():
+            one_write()
+            writes[0] += 1
+            stop.wait(pace)
+
+    wt = threading.Thread(target=writer, daemon=True)
+    t0 = time.perf_counter()
+    wt.start()
+    mixed_qps = timed_reads(window_s)
+    stop.set()
+    wt.join(timeout=10)
+    wall = time.perf_counter() - t0
+    after = metrics.snapshot()["counters"]
+
+    def cdelta(name: str) -> int:
+        return int(after.get(name, 0)) - int(before.get(name, 0))
+
+    # result-set parity under the applied deltas seals correctness
+    tpu_n = db.query(sql, engine="tpu", strict=True).to_dicts()
+    oracle_n = db.query(sql, engine="oracle").to_dicts()
+    upload = cdelta("snapshot.delta.upload_bytes")
+    ov_stats = maint.stats()
+    out = {
+        "read_only_qps": round(baseline_qps, 2),
+        "mixed_read_qps": round(mixed_qps, 2),
+        "read_ratio": round(mixed_qps / baseline_qps, 3)
+        if baseline_qps
+        else 0.0,
+        "write_ops": writes[0],
+        "write_ops_s": round(writes[0] / wall, 2) if wall else 0.0,
+        "delta_events": cdelta("snapshot.delta.events"),
+        "delta_upload_bytes": upload,
+        "upload_bytes_per_write": round(upload / max(1, writes[0]), 1),
+        "graph_device_bytes": graph_bytes,
+        "upload_vs_full_csr": round(
+            (upload / max(1, writes[0])) / max(1, graph_bytes), 8
+        ),
+        "compactions": ov_stats["compactions"],
+        "slab_fill": (ov_stats["overlay"] or {}).get("slab_fill"),
+        "parity": tpu_n == oracle_n,
+    }
+    # free this block's HBM before the headline blocks run
+    maint.disarm()
+    db.detach_snapshot()
+    return out
+
+
+def _last_good_round(detail_dir: str, round_n: int) -> "str | None":
+    """The newest prior round artifact with usable numbers: a
+    ``BENCH_DETAIL_r{M}.json`` (M < this round) whose headline value is
+    non-zero, falling back to driver ``BENCH_r{M}.json`` records (the
+    perfdiff loader unwraps those). r05's rc-124 left parsed:null — the
+    walk skips such rounds, so the gate compares against the last round
+    that actually measured (r04)."""
+    import glob
+    import re
+
+    candidates = []
+    here = os.path.dirname(os.path.abspath(__file__))
+    for pat, root in (
+        (os.path.join(detail_dir, "BENCH_DETAIL_r*.json"), "detail"),
+        (os.path.join(here, "BENCH_r*.json"), "driver"),
+    ):
+        for p in glob.glob(pat):
+            m = re.search(r"_r(\d+)\.json$", p)
+            if m and int(m.group(1)) < round_n:
+                candidates.append((int(m.group(1)), root == "detail", p))
+    for _n, _is_detail, path in sorted(candidates, reverse=True):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and doc.get("parsed"):
+                doc = doc["parsed"]
+            if isinstance(doc, dict) and float(doc.get("value") or 0.0) > 0:
+                return path
+        except Exception:
+            continue
+    return None
+
+
 def _round_stamp() -> int:
     """THIS run's round number: one past the newest driver record
     (BENCH_r{N}.json) in the repo root. Stamps the detail file so a
@@ -659,6 +856,11 @@ def _measure() -> None:
             sys.exit(2)
         print(json.dumps(fn(*_timing_knobs())))
         return
+    # wall-clock budget accounting starts HERE — before the JAX
+    # platform warmup and the first compile (r05's rc 124 spent its
+    # budget before any artifact existed), not after dataset builds
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    t_start = time.perf_counter()
     # resolve the gate reference FIRST (see _resolve_gate_prev)
     gate_path = _gate_path_from_env()
     gate_prev = _resolve_gate_prev(gate_path) if gate_path else None
@@ -692,9 +894,8 @@ def _measure() -> None:
     # wall-clock budget (VERDICT r5: rc 124 with zero numbers): blocks
     # check remaining budget BEFORE starting; once it is spent, the
     # rest skip with {"skipped": "budget"} evidence records and the run
-    # exits rc 0 with whatever it measured.
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "420"))
-    t_start = time.perf_counter()
+    # exits rc 0 with whatever it measured. (budget_s/t_start are set
+    # at the very top of _measure, before any JAX-touching work.)
 
     def budget_left() -> float:
         return budget_s - (time.perf_counter() - t_start)
@@ -736,6 +937,26 @@ def _measure() -> None:
         compose=_compose_out,
         printed=False,
     )
+
+    # emit a parseable "warming" headline artifact + detail BEFORE the
+    # JAX platform warmup and first compile: a harness timeout killing
+    # the whole process mid-warmup (the r05 failure mode) still leaves
+    # a valid BENCH artifact on disk. The final headline overwrites it;
+    # the status key vanishes once the first measured number lands.
+    extras["status"] = "warming"
+    _flush_detail()
+    try:
+        from orientdb_tpu.storage.durability import atomic_write as _aw
+
+        _aw(
+            os.path.join(
+                detail_dir, f"BENCH_HEADLINE_r{round_n:02d}.json"
+            ),
+            (compact_line(_compose_out(), detail_name=detail_name)
+             + "\n").encode(),
+        )
+    except Exception as e:  # artifact is best-effort pre-warmup
+        print(f"warming headline write failed: {e}", file=sys.stderr)
 
     def ev(block: str, **data) -> None:
         tid = block_trace.get(block)
@@ -886,6 +1107,10 @@ def _measure() -> None:
             try:
                 _slo = run_mixed_slo_block(round_n, detail_dir)
                 extras["slo"] = _slo
+                # first measured block of the run (it precedes mixed_rw
+                # and parity): a BENCH_RW=0 + budget-starved-parity run
+                # must not publish these numbers under status=warming
+                extras.pop("status", None)
                 ev("mixed_slo", **_slo)
             except Exception as e:
                 # the traffic sim failing IS evidence, but it must not
@@ -896,6 +1121,24 @@ def _measure() -> None:
                 }
                 ev("mixed_slo", error=f"{type(e).__name__}: {e}")
 
+    # mixed read/write deltas block (ISSUE 15 acceptance): its own
+    # small dataset + delta-maintained snapshot, so it neither needs
+    # nor disturbs the demodb graph the perf blocks time
+    if os.environ.get("BENCH_RW", "1") != "0" and budget_ok(
+        "mixed_rw", est_s=60
+    ):
+        with block_span("mixed_rw"):
+            try:
+                _rw = run_mixed_rw_block()
+                extras["mixed_rw"] = _rw
+                extras.pop("status", None)  # first measured JAX block
+                ev("mixed_rw", **_rw)
+            except Exception as e:
+                extras["mixed_rw"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]
+                }
+                ev("mixed_rw", error=f"{type(e).__name__}: {e}")
+
     db = None
     if budget_ok("parity", est_s=120):
         from orientdb_tpu.storage.ingest import generate_demodb
@@ -903,6 +1146,9 @@ def _measure() -> None:
 
         db = generate_demodb(n_profiles=n_profiles, avg_friends=avg_friends)
         attach_fresh_snapshot(db)
+        # the JAX platform is warm and real numbers follow: the
+        # "warming" marker has served its purpose
+        extras.pop("status", None)
 
     # headline: the analytic multi-hop pattern (BASELINE config #2 shape) —
     # whole-class 2-hop expansion with vertex predicates on both ends
@@ -1623,6 +1869,39 @@ def _measure() -> None:
     # read it; _flush_detail has been rewriting it after every block),
     # and the printed line carries the required keys plus a compact
     # extras subset that stays well under the capture window.
+    # round-over-round regression gate (tools/perfdiff): compare this
+    # round's detail against the last good recorded round and ride the
+    # machine-readable verdict into the evidence stream — the bench
+    # trajectory carries its own diff, not just raw trees. Budget skips
+    # void the comparison (missing leaves would read as regressions).
+    try:
+        base_path = os.environ.get("BENCH_PERFDIFF_BASE") or _last_good_round(
+            detail_dir, round_n
+        )
+        if base_path is None:
+            ev("perfdiff", skipped="no_prior_round")
+        elif skipped:
+            ev("perfdiff", skipped="budget_truncated_run", base=os.path.basename(base_path))
+        else:
+            from orientdb_tpu.tools.perfdiff import _load as _pd_load, diff as _pd_diff
+
+            _base = _pd_load(base_path)
+            if _base is None:
+                ev("perfdiff", skipped="unreadable_base",
+                   base=os.path.basename(base_path))
+            else:
+                rep = _pd_diff(_base, _compose_out())
+                extras["perfdiff"] = {
+                    "base": os.path.basename(base_path),
+                    "verdict": rep["verdict"],
+                    "headline_ratio": rep["headline"].get("ratio"),
+                    "compared": rep["compared"],
+                    "regressions": len(rep["regressions"]),
+                }
+                ev("perfdiff", base=os.path.basename(base_path), **rep)
+    except Exception as e:  # the diff must never cost the headline
+        ev("perfdiff", error=f"{type(e).__name__}: {e}")
+
     out = _compose_out()
     _flush_detail()
     ev(

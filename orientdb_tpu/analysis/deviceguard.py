@@ -139,7 +139,14 @@ class DeviceGuard:
                 ck = tpu_engine._cache_key(stmt, params)
                 if ck is not None:
                     snap = db.current_snapshot()
-                    key = (id(snap), ck)
+                    # the delta plane's plan generation joins the
+                    # identity: a topology/dictionary structure bump
+                    # (storage/deltas) legitimately clears the plan
+                    # cache — recording again under a NEW generation is
+                    # the designed recompile boundary, not a cache miss
+                    ov = getattr(snap, "_overlay", None)
+                    gen = ov.plan_gen if ov is not None else 0
+                    key = (id(snap), gen, ck)
             except Exception:
                 key = None
             if key is not None and guard.active_item is not None:

@@ -43,13 +43,23 @@ _CLASS_CACHE_MAX = 65536
 
 def _record_payload(e: Dict) -> Dict:
     """Event ``record`` from a create/update entry: the WAL's
-    wire-encoded fields plus the @-meta keys ``to_dict`` would carry."""
+    wire-encoded fields plus the @-meta keys ``to_dict`` would carry.
+    Edge entries additionally surface their endpoints (``@out``/
+    ``@in``) and record kind (``@type``) so structural consumers — the
+    snapshot delta maintainer (storage/deltas) foremost — can apply
+    adjacency changes without a live-record lookup."""
     rec = dict(e.get("fields") or {})
     rec["@rid"] = e["rid"]
     if e.get("class") is not None:
         rec["@class"] = e["class"]
     if e.get("version") is not None:
         rec["@version"] = e["version"]
+    if e.get("type") is not None:
+        rec["@type"] = e["type"]
+    if e.get("out") is not None:
+        rec["@out"] = e["out"]
+    if e.get("in") is not None:
+        rec["@in"] = e["in"]
     return rec
 
 

@@ -557,6 +557,18 @@ class ChangeFeed:
         op = _HOOK_OPS.get(event)
         if op is None or getattr(self._tl, "in_apply", False):
             return
+        record = doc.to_dict()
+        from orientdb_tpu.models.record import Edge, Vertex
+
+        # structural meta the WAL decode path stamps too (decode.py
+        # _record_payload): edge endpoints + record kind, so the hook
+        # fallback feeds the snapshot delta maintainer identically
+        if isinstance(doc, Edge):
+            record["@type"] = "edge"
+            record["@out"] = str(doc.out_rid)
+            record["@in"] = str(doc.in_rid)
+        elif isinstance(doc, Vertex):
+            record["@type"] = "vertex"
         with self._lock:
             lsn = self.head_lsn + 1
             self.head_lsn = lsn
@@ -568,7 +580,7 @@ class ChangeFeed:
                 "op": op,
                 "class": doc.class_name,
                 "rid": str(doc.rid),
-                "record": doc.to_dict(),
+                "record": record,
                 "durable": False,
             }
             self._ring.append((lsn, [ev]))

@@ -225,17 +225,32 @@ def _execute_query(
 
     cache = cache_for(db) if db.tx is None else None
     key = cache.key(sql, norm, engine, strict) if cache is not None else None
+    # capture the epoch BEFORE running: a write landing mid-query must
+    # make the cache entry stale (not stamp post-write freshness onto
+    # pre-write rows) and must block view admission (the CDC callback
+    # cannot invalidate a view that is not registered yet)
+    epoch = db.mutation_epoch
     if key is not None:
-        # capture the epoch BEFORE running: a write landing mid-query
-        # must make the entry stale, not stamp post-write freshness onto
-        # pre-write rows
-        epoch = db.mutation_epoch
         hit = cache.get(key, epoch)
         if hit is not None:
             return _result_set(hit[0], hit[1])
+    # materialized continuous views (exec/views): hot fingerprints'
+    # results kept resident with CDC-EXACT invalidation — unlike the
+    # epoch-keyed command cache, an unrelated write does not kill them
+    vm = None
+    if db.tx is None:
+        from orientdb_tpu.exec.views import views_for
+
+        vm = views_for(db)
+        if vm is not None:
+            view = vm.lookup(sql, norm, engine, strict)
+            if view is not None:
+                return _result_set(view.rows, view.engine)
     rows, used = _run(db, stmt, norm, engine, strict)
     if key is not None:
         cache.put(key, rows, used, epoch)
+    if vm is not None:
+        vm.observe(sql, norm, engine, strict, rows, used, epoch=epoch)
     return _result_set(rows, used)
 
 
@@ -393,6 +408,7 @@ def dispatch_lane_batch(
     ring_state=None,
     enqueue_ts=None,
     window_s=None,
+    min_epoch=None,
 ):
     """Lane front door (server/coalesce): NON-BLOCKING dispatch of one
     fingerprint lane's homogeneous micro-batch. Returns a handle whose
@@ -434,6 +450,7 @@ def dispatch_lane_batch(
         sql=sqls[0],
         enqueue_ts=enqueue_ts,
         window_s=window_s,
+        min_epoch=min_epoch,
     )
     if h is None:
         return None

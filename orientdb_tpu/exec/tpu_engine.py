@@ -478,11 +478,19 @@ def build_bitmap_hops(dg: DeviceGraph, items) -> List:
     edge list. ``emask`` is an optional [E] per-edge prefilter in
     out-CSR order (fused edge WHERE)."""
     mg = dg.mesh_graph
+    armed = getattr(dg.snap, "_overlay", None) is not None
     hops = []
     for cname, d, emask in items:
         dec = dg.edges[cname]
         m = emask if emask is not None else jnp.ones(dec.num_edges, bool)
         if mg is None:
+            if armed:
+                # delta-maintained edge list: slab slots (appended
+                # edges) and tombstones flow through ONE liveness mask,
+                # read via dg.arrays so replays take it as a jit
+                # argument — a delta patch reaches every cached plan
+                lv = dg.arrays[f"e:{cname}:live"]
+                m = lv if emask is None else (emask & lv)
             if d == "out":
                 a, em = dec.edge_src, dec.dst
             else:  # follow edges backwards: activate dst, emit src
@@ -533,6 +541,20 @@ class TpuMatchSolver:
             raise Uncompilable("no fresh snapshot attached")
         self.snap = snap
         self.dg: DeviceGraph = device_graph(snap)
+        #: delta-slab overlay (storage/deltas) when the snapshot is
+        #: incrementally maintained; plans record its generation and
+        #: overflow-fail when the structure moves under them
+        self.overlay = getattr(snap, "_overlay", None)
+        self.delta_gen = (
+            self.overlay.plan_gen if self.overlay is not None else 0
+        )
+        #: slab-scan capacity floor (host-read here, NOT inside the
+        #: traced replay): recordings pre-allocate this many slab
+        #: window/match slots even when the slab is near-empty, so a
+        #: growing slab crosses far fewer pow2 buckets — each crossing
+        #: is a full plan re-record (the r-mixed churn that collapsed
+        #: read q/s under sustained writes)
+        self._slab_floor = max(8, int(config.delta_slab_edge_slots) // 16)
         self.sched = SizeSchedule()
         # reuse the oracle's pattern build + estimates (host planning data)
         self.interp = MatchInterpreter(db, stmt, params)
@@ -772,6 +794,17 @@ class TpuMatchSolver:
         needs env["bindings"] at evaluation (``mask.uses_bindings``)."""
         parts = []
         uses_bindings = False
+        has_class = any(f.class_name for f in node.filters)
+        if self.overlay is not None and not has_class:
+            # delta-maintained universe: spare slab rows and deleted
+            # vertices carry class -1 — a class filter excludes them via
+            # isin, but a bare node needs an explicit liveness conjunct
+            parts.append(
+                lambda idx, env: K.take_pad(
+                    self.dg.v_class, idx, jnp.int32(-1)
+                )
+                >= 0
+            )
         for f in node.filters:
             if f.class_name:
                 ids = self.dg.class_ids(f.class_name)
@@ -871,7 +904,60 @@ class TpuMatchSolver:
         row, edge_pos, nbr = K.gather_expand(
             indptr, nbrs, srcs, offsets, total_dev, _cap_of(total)
         )
+        if self.overlay is not None:
+            # delta-tombstoned base edges keep their CSR slot but carry
+            # a -1 endpoint: turn those slots into padding so the dead
+            # edge can never bind (matches gather_expand's own padding)
+            dead = nbr < 0
+            row = jnp.where(dead, -1, row)
+            edge_pos = jnp.where(dead, -1, edge_pos)
         return row, edge_pos, nbr, total
+
+    def _expand_slab(self, dec, d: str, srcs):
+        """Append-slab expansion for one (class, direction): scan the
+        slab tail of the padded edge list for live edges whose active
+        endpoint is in ``srcs``. The scan window is sized by the
+        OBSERVED used-slot count (SizeSchedule), so replays overflow —
+        and re-record with a wider window — when the slab outgrows the
+        recording; compaction folds the slab away entirely."""
+        ov = self.overlay
+        base = ov.edge_base(dec.class_name)
+        cap = dec.num_edges
+        if cap <= base:
+            return None
+        arrays = self.dg.arrays
+        p = f"e:{dec.class_name}"
+        tail_src = arrays[f"{p}:edge_src"][base:cap]
+        tail_dst = arrays[f"{p}:dst"][base:cap]
+        tail_live = arrays[f"{p}:live"][base:cap]
+        # used slots are append-only: edge_src >= 0 marks them even
+        # after a tombstone (live=False), so the window bound survives
+        # deletes. The _slab_floor keeps both buckets generous: a slab
+        # filling write-by-write must not re-record the plan at every
+        # pow2 crossing.
+        floor = min(cap - base, self._slab_floor)
+        used = self.sched.observe(
+            jnp.sum((tail_src >= 0).astype(jnp.int32)),
+            min_capacity=floor,
+        )
+        W = min(cap - base, max(_cap_of(max(used, 1)), floor))
+        a = tail_src[:W] if d == "out" else tail_dst[:W]
+        e = tail_dst[:W] if d == "out" else tail_src[:W]
+        m = (
+            (a[None, :] == srcs[:, None])
+            & tail_live[:W][None, :]
+            & (srcs >= 0)[:, None]
+        )
+        total_dev = m.sum(dtype=jnp.int32)
+        total = self.sched.observe(total_dev, min_capacity=floor)
+        out = max(_cap_of(max(total, 1)), floor)
+        idx = K.compact_indices(m.reshape(-1), out)
+        ok = idx >= 0
+        row = jnp.where(ok, idx // W, -1).astype(jnp.int32)
+        j = jnp.where(ok, idx % W, 0).astype(jnp.int32)
+        eid = jnp.where(ok, base + j, -1).astype(jnp.int32)
+        nbr = jnp.where(ok, jnp.take(e, j), -1).astype(jnp.int32)
+        return row, eid, nbr, total
 
     def _expand_one_dir_chunked(self, dec, d: str, srcs):
         """Expansion slabs for one (class, direction): usually ONE
@@ -919,6 +1005,16 @@ class TpuMatchSolver:
                 eid = edge_pos
             else:
                 eid = K.take_pad(dec.edge_id_in, edge_pos, jnp.int32(-1))
+            if self.overlay is not None and self.overlay.topology_dirty:
+                # append-slab edges live outside the base CSR: merge the
+                # slab scan's slots in (padding interleaves — downstream
+                # masks key on row >= 0, not prefix contiguity)
+                slab = self._expand_slab(dec, d, srcs)
+                if slab is not None:
+                    row = jnp.concatenate([row, slab[0]])
+                    eid = jnp.concatenate([eid, slab[1]])
+                    nbr = jnp.concatenate([nbr, slab[2]])
+                    total = total + slab[3]
             return row, eid, nbr, total
         from orientdb_tpu.parallel.mesh_graph import expand_gather, expand_totals
 
@@ -1112,6 +1208,12 @@ class TpuMatchSolver:
         COUNT throughput independent of fan-out.
         """
         if self.count_only_name() is None or self.stmt.group_by or self._not_compiled:
+            return []
+        if self.overlay is not None and self.overlay.topology_dirty:
+            # the weight chain sums degrees off the base CSR indptr:
+            # slab edges would be missed and tombstoned edges counted.
+            # Dirty-topology plans take the full (slab-aware) solve;
+            # compaction restores the pushdown on the next recording.
             return []
         suffix: List[PlanStep] = []
         # alias usage counts over all edges (from/to + edge-filter aliases)
@@ -1377,13 +1479,32 @@ class TpuMatchSolver:
             return cand, n, n_dev
         V = self.dg.num_vertices
         start, end = 0, V
+        has_class = False
         for f in node.filters:
             if f.class_name:
+                has_class = True
                 lo, hi = self.snap.vertex_hull(f.class_name)
                 start, end = max(start, lo), min(end, hi)
         size = max(end - start, 0)
-        idx = start + jnp.arange(K.bucket(max(size, 1)), dtype=jnp.int32)
-        idx = jnp.where(idx < end, idx, -1)
+        # delta-maintained snapshots: inserted vertices land in the
+        # append slab OUTSIDE every class hull — scan it as a second
+        # segment (class masks stay exact; classless hulls already end
+        # at the padded universe and need no extra segment)
+        slo, shi = (
+            self.snap.slab_vertex_range() if has_class else (0, 0)
+        )
+        slab = max(shi - slo, 0)
+        if slab:
+            width = K.bucket(max(size + slab, 1))
+            pos = jnp.arange(width, dtype=jnp.int32)
+            idx = jnp.where(
+                pos < size,
+                start + pos,
+                jnp.where(pos < size + slab, slo + (pos - size), -1),
+            )
+        else:
+            idx = start + jnp.arange(K.bucket(max(size, 1)), dtype=jnp.int32)
+            idx = jnp.where(idx < end, idx, -1)
         mask = self._node_masks[alias](idx)
         cand, n, n_dev = self._compact(mask)
         cand = K.take_pad(idx, cand, jnp.int32(-1))
@@ -2250,6 +2371,19 @@ class TpuTraverseSolver:
             raise Uncompilable("no fresh snapshot attached")
         self.snap = snap
         self.dg: DeviceGraph = device_graph(snap)
+        self.overlay = getattr(snap, "_overlay", None)
+        self.delta_gen = (
+            self.overlay.plan_gen if self.overlay is not None else 0
+        )
+        #: TRAVERSE replays are fully static — the roots array is baked
+        #: at record time and the schedule's overflow flag is dropped
+        #: (sound on immutable snapshots, where replay inputs are
+        #: identical by construction). On a delta-maintained snapshot
+        #: the plan therefore pins the overlay's data version and
+        #: re-records when ANY delta has landed since (dispatch checks).
+        self.delta_data_version = (
+            self.overlay.data_version if self.overlay is not None else 0
+        )
         self.sched = SizeSchedule()
         if stmt.limit is not None:
             raise Uncompilable("TRAVERSE LIMIT slices in traversal order")
@@ -2448,6 +2582,18 @@ class _AotWarmup:
             try:
                 for attempt in (0, 1):
                     try:
+                        snap = getattr(
+                            getattr(self, "solver", None), "snap", None
+                        )
+                        if (
+                            snap is not None
+                            and snap._device_cache is None
+                        ):
+                            # the snapshot's device graph was released
+                            # (delta-plane compaction swap): the plan is
+                            # dead, warming it would only KeyError
+                            metrics.incr("plan_cache.aot_skip_released")
+                            break
                         # the lock serializes TRACING (thread-local
                         # device-graph cache swaps); device execution
                         # is async, so wait for it after release
@@ -2455,6 +2601,11 @@ class _AotWarmup:
                             res = self._warm_call()
                         jax.block_until_ready(res)
                         metrics.incr("plan_cache.aot_compile")
+                        break
+                    except ScheduleOverflow:
+                        # stale delta generation (_check_delta_gen):
+                        # the next dispatch re-records — nothing to warm
+                        metrics.incr("plan_cache.aot_skip_stale")
                         break
                     except Exception:
                         if attempt:
@@ -2529,6 +2680,7 @@ class _CompiledTraverse(_AotWarmup):
         # TRAVERSE plans bake parameter values (their full values join the
         # plan-cache key), so `params` is accepted for interface parity
         # with _CompiledPlan and ignored
+        _check_traverse_static(self.solver)
         self.wait_compiled()
         return self.jitted(self._arg_subset())
 
@@ -2540,6 +2692,8 @@ class _CompiledTraverse(_AotWarmup):
         return self.solver.dg.mesh_graph is None
 
     def _dyn_args(self, params: Optional[Dict]) -> Dict:
+        _check_delta_gen(self.solver)
+        _check_traverse_static(self.solver)
         return {}  # no dynamic args: grouping uses the shared dispatch
 
     def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
@@ -2559,6 +2713,35 @@ class _CompiledTraverse(_AotWarmup):
 class ScheduleOverflow(Exception):
     """A parameter-generic replay's live sizes exceeded the recorded
     schedule's capacities; the result was discarded. Caller re-records."""
+
+
+def _check_delta_gen(solver) -> None:
+    """Fail a dispatch whose plan was recorded under an older delta
+    structure (storage/deltas bumps the generation on the first
+    topology delta and on dictionary appends, clearing the plan cache;
+    this guards plan objects picked BEFORE the bump). The overflow
+    surface routes the caller straight into the re-record path."""
+    ov = getattr(solver, "overlay", None)
+    if ov is not None and ov.plan_gen != solver.delta_gen:
+        raise ScheduleOverflow(
+            f"delta structure moved (gen {solver.delta_gen} -> "
+            f"{ov.plan_gen})"
+        )
+
+
+def _check_traverse_static(solver) -> None:
+    """TRAVERSE replays bake their host-resolved roots and drop the
+    size schedule's overflow flag — sound only while replay inputs are
+    identical to the recording (immutable snapshots). On a
+    delta-maintained snapshot ANY applied event invalidates that
+    assumption, so the dispatch re-records (MATCH keeps its full
+    delta-aware replay; TRAVERSE pays an eager solve under writes)."""
+    ov = getattr(solver, "overlay", None)
+    if ov is not None and ov.data_version != solver.delta_data_version:
+        raise ScheduleOverflow(
+            "traverse recording is stale under delta maintenance "
+            f"(data v{solver.delta_data_version} -> v{ov.data_version})"
+        )
 
 
 class _CompiledPlan(_AotWarmup):
@@ -2841,6 +3024,7 @@ class _CompiledPlan(_AotWarmup):
     def _dyn_args(self, params: Optional[Dict]) -> Dict:
         # host-side (numpy) values: the jit call transfers them, and
         # dispatch_many can stack B of them into ONE transfer per key
+        _check_delta_gen(self.solver)
         params = params if params is not None else self.solver.params
         dyn = {}
         for k, kind in self.dyn_spec.items():
@@ -3308,6 +3492,19 @@ def _record(db, stmt, params):
 
     stmt, element_alias = _translate(stmt)
     snap = db.current_snapshot(require_fresh=True)
+    if snap is not None:
+        # pin the buffers for the eager solve (see _snapshot_lease)
+        snap.retain()
+    try:
+        return _record_leased(db, stmt, params, snap, element_alias)
+    finally:
+        if snap is not None:
+            snap.release()
+
+
+def _record_leased(db, stmt, params, snap, element_alias):
+    from orientdb_tpu.obs.trace import span as _span
+
     with _span("tpu.load"):
         # snapshot → HBM upload (CSR + referenced columns); a warm cache
         # makes this span ~free, a cold one shows the real upload cost
@@ -3485,6 +3682,26 @@ def _run_variants(
     return rows
 
 
+from contextlib import contextmanager as _contextmanager
+
+
+@_contextmanager
+def _snapshot_lease(db):
+    """Pin the attached snapshot's device buffers for the duration of
+    one dispatch: a delta-plane compaction swapping the snapshot
+    mid-flight defers its buffer free until the lease drops
+    (``GraphSnapshot.retain``/``release``) — the in-flight dispatch
+    finishes on the epoch it was admitted under."""
+    snap = db.current_snapshot()
+    if snap is not None:
+        snap.retain()
+    try:
+        yield snap
+    finally:
+        if snap is not None:
+            snap.release()
+
+
 def execute(db, stmt, params) -> List[Result]:
     import orientdb_tpu.obs.timeline as _TL
 
@@ -3493,15 +3710,34 @@ def execute(db, stmt, params) -> List[Result]:
     # escape drops the record uncommitted — only real dispatches ring
     rec = _TL.recorder.begin("single")
     with _TL.active(rec):
-        variants, rows, _fresh = _prepare(db, stmt, params)
-        if variants is not None:
+        for _attempt in range(4):
+            variants, rows, _fresh = _prepare(db, stmt, params)
+            if variants is None:
+                break
             plan = variants.pick(params)
             _TL.mark("plan_resolve")
+            # pin the plan's snapshot across the dispatch: a delta-plane
+            # compaction swapping snapshots mid-flight defers its buffer
+            # free until this lease drops (epoch-gated dispatch). A swap
+            # landing BETWEEN plan resolution and the pin has already
+            # freed this plan's buffers — re-resolve against the new
+            # snapshot (try_retain refuses the stale DeviceGraph)
+            snap = plan.solver.snap
+            if not snap.try_retain(plan.solver.dg):
+                metrics.incr("tpu.lease_raced")
+                continue
             try:
                 rows = plan.rows(params or {})
                 variants.remember(params, plan)
             except ScheduleOverflow:
                 rows = _run_variants(db, stmt, params, variants, tried=plan)
+            finally:
+                snap.release()
+            break
+        else:
+            # four consecutive compaction swaps inside the resolve→pin
+            # window: degrade to the oracle rather than crash the query
+            raise Uncompilable("snapshot compaction raced plan dispatch")
     _TL.recorder.commit(rec)
     return rows
 
@@ -3638,20 +3874,61 @@ def execute_batch(db, items) -> List:
     out: List = [None] * len(items)
     prepared = []  # (i, variants, plan, params)
     fresh = []
-    for i, (stmt, params) in enumerate(items):
-        try:
-            variants, rows, plan_obj = _prepare(db, stmt, params)
-        except Uncompilable as e:
-            out[i] = e
-            continue
-        if variants is None:
-            out[i] = rows
-            if plan_obj is not None:
-                fresh.append(plan_obj)
-        else:
-            # sticky routing: repeated parameter values dispatch straight
-            # to the variant that last served them
-            prepared.append((i, variants, variants.pick(params), params))
+    # pin every prepared plan's snapshot across the dispatch + fetch
+    # waves: a delta-plane compaction swapping snapshots mid-batch
+    # defers the old buffers' free until the leases drop
+    leases: Dict[int, object] = {}
+    try:
+        for i, (stmt, params) in enumerate(items):
+            try:
+                variants, rows, plan_obj = _prepare(db, stmt, params)
+            except Uncompilable as e:
+                out[i] = e
+                continue
+            if variants is None:
+                out[i] = rows
+                if plan_obj is not None:
+                    fresh.append(plan_obj)
+                continue
+            for _attempt in range(4):
+                # sticky routing: repeated parameter values dispatch
+                # straight to the variant that last served them
+                plan = variants.pick(params)
+                snap = plan.solver.snap
+                # a held lease keeps the snapshot's device cache pinned
+                # (deferred free), so a second plan on the same snapshot
+                # needs no re-check; a NEW lease must refuse a plan
+                # whose DeviceGraph a compaction swap already freed
+                if id(snap) in leases or snap.try_retain(plan.solver.dg):
+                    leases.setdefault(id(snap), snap)
+                    prepared.append((i, variants, plan, params))
+                    break
+                metrics.incr("tpu.lease_raced")
+                try:
+                    variants, rows, plan_obj = _prepare(db, stmt, params)
+                except Uncompilable as e:
+                    out[i] = e
+                    break
+                if variants is None:
+                    out[i] = rows
+                    if plan_obj is not None:
+                        fresh.append(plan_obj)
+                    break
+            else:
+                out[i] = Uncompilable(
+                    "snapshot compaction raced plan dispatch"
+                )
+        if not prepared:
+            for plan in fresh:
+                plan.wait_compiled()
+            return out
+        return _execute_batch_leased(db, items, out, prepared, fresh)
+    finally:
+        for snap in leases.values():
+            snap.release()
+
+
+def _execute_batch_leased(db, items, out, prepared, fresh) -> List:
     groups: Dict[int, List[int]] = {}
     for j, (_i, _v, plan, _params) in enumerate(prepared):
         if getattr(plan, "batchable", None) is not None and plan.batchable():
@@ -3733,7 +4010,13 @@ def _group_dispatch(plan, dyns: List[Dict], ring: ParamRing = None):
     if not dyns[0]:
         # no dynamic args: every lane is the SAME program on the same
         # inputs — one plain dispatch serves the whole group
-        dev = plan.dispatch({})
+        try:
+            dev = plan.dispatch({})
+        except ScheduleOverflow:
+            # a delta landed between the _dyn_args probe and this
+            # dispatch (traverse static-replay guard): fall back to the
+            # per-lane path, whose overflow handling re-records
+            return None
         if isinstance(dev, tuple) and len(dev) == 3 and dev[1]:
             # rows plan: keep the single dispatch's page ladder so
             # the group elects one shared page after the meta wave
@@ -3947,12 +4230,26 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
             except ScheduleOverflow:
                 overflowed.append((i, variants, plan))
     # overflow fallbacks re-dispatch (and may re-record) whole plans —
-    # outside the host-marshalling timer so the phase split stays honest
+    # outside the host-marshalling timer so the phase split stays honest.
+    # A homogeneous batch overflows as a COHORT (e.g. a delta landed and
+    # every lane's replay outgrew the recorded schedule): identical
+    # (statement, params) items share one resolution's rows instead of
+    # each paying a lone re-dispatch behind the fresh plan's compile —
+    # measured 15x the fallback cost on the mixed read/write bench.
+    resolved: Dict[Tuple, object] = {}
     for i, variants, plan in overflowed:
         stmt, params = items[i]
-        out[i] = _run_variants(
+        pk = PlanVariants._pkey(params)
+        rk = (id(variants), pk) if pk is not None else None
+        if rk is not None and rk in resolved:
+            out[i] = resolved[rk]
+            continue
+        rows = _run_variants(
             db, stmt, params, variants, tried=plan, fresh=fresh
         )
+        out[i] = rows
+        if rk is not None:
+            resolved[rk] = rows
 
 
 class LaneDispatch:
@@ -3966,13 +4263,17 @@ class LaneDispatch:
     worker thread runs other work in between, so the record cannot
     stay thread-local."""
 
-    __slots__ = ("db", "items", "pending", "rec")
+    __slots__ = ("db", "items", "pending", "rec", "lease")
 
-    def __init__(self, db, items, pending, rec=None) -> None:
+    def __init__(self, db, items, pending, rec=None, lease=None) -> None:
         self.db = db
         self.items = items
         self.pending = pending
         self.rec = rec
+        #: retained snapshot pinning the dispatched buffers across the
+        #: double-buffered dispatch→collect gap (epoch-gated dispatch:
+        #: a compaction swap cannot free them while this batch flies)
+        self.lease = lease
 
     def collect(self) -> List:
         """Fetch + marshal the dispatched batch; returns per-item row
@@ -3982,8 +4283,13 @@ class LaneDispatch:
 
         out: List = [None] * len(self.items)
         fresh: List = []
-        with _TL.active(self.rec):
-            _finish_pending(self.db, self.items, self.pending, out, fresh)
+        try:
+            with _TL.active(self.rec):
+                _finish_pending(self.db, self.items, self.pending, out, fresh)
+        finally:
+            if self.lease is not None:
+                self.lease.release()
+                self.lease = None
         for plan in fresh:
             plan.wait_compiled()
         _TL.recorder.commit(self.rec)
@@ -3997,6 +4303,7 @@ def dispatch_lane(
     sql: Optional[str] = None,
     enqueue_ts: Optional[float] = None,
     window_s: Optional[float] = None,
+    min_epoch: Optional[int] = None,
 ):
     """Lane-aware dispatch entry: a fingerprint-keyed coalesce lane
     drains a HOMOGENEOUS micro-batch — every item the same statement
@@ -4017,6 +4324,12 @@ def dispatch_lane(
         return None
     snap = db.current_snapshot(require_fresh=True)
     if snap is None:
+        return None
+    if min_epoch is not None and db._snapshot_epoch < min_epoch:
+        # coalesce-lane epoch keying: an item was admitted AFTER a
+        # write this snapshot does not cover — a lane window formed
+        # pre-write must not serve that item stale results. The generic
+        # path re-resolves freshness (delta catch-up or oracle).
         return None
     cache = _plan_cache(snap)
     variants = cache.get(key)
@@ -4058,15 +4371,27 @@ def dispatch_lane(
             dyns.append(plan._dyn_args(params or {}))
     except ScheduleOverflow:
         return None  # the variant walk belongs to the generic path
-    with _TL.active(rec):
-        g = _group_dispatch(plan, dyns, ring=ring)
-    if g is None:
-        return None  # group executable still compiling: generic path
+    lease = plan.solver.snap
+    if not lease.try_retain(plan.solver.dg):
+        # compaction swap freed this plan's buffers between resolution
+        # and the pin: the generic path re-plans on the new snapshot
+        metrics.incr("tpu.lease_raced")
+        return None
+    handed_off = False
+    try:
+        with _TL.active(rec):
+            g = _group_dispatch(plan, dyns, ring=ring)
+        if g is None:
+            return None  # group executable still compiling: generic path
+        handed_off = True
+    finally:
+        if not handed_off:
+            lease.release()
     grp, ks = g
     pending = [(i, variants, plan, _Lane(grp, k)) for i, k in enumerate(ks)]
     metrics.incr("tpu.lane_dispatch")
     metrics.incr("tpu.lane_items", len(items))
-    return LaneDispatch(db, items, pending, rec)
+    return LaneDispatch(db, items, pending, rec, lease=lease)
 
 
 def explain_plan_steps(db, stmt) -> List[str]:
@@ -4095,7 +4420,9 @@ def profile_execute(db, stmt, params) -> Tuple[List[Result], Dict]:
         # same guard as engine._run: the snapshot cannot see the tx overlay
         raise Uncompilable("active transaction on this thread")
     phases: Dict[str, object] = {}
-    with _span("profile", statement=type(stmt).__name__) as root:
+    with _span("profile", statement=type(stmt).__name__) as root, (
+        _snapshot_lease(db)
+    ):
         t0 = _time.perf_counter()
         variants, rows, _fresh = _prepare(db, stmt, params)
         phases["prepareUs"] = round((_time.perf_counter() - t0) * 1e6, 1)

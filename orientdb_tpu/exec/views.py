@@ -1,0 +1,485 @@
+"""Materialized continuous MATCH views — CDC-exact result caching.
+
+The epoch-keyed command cache (``exec/command_cache.py``) dies on EVERY
+write: any committed mutation moves ``mutation_epoch`` and all entries
+stop matching, however unrelated. This plane keeps hot query results
+alive across writes by invalidating **CDC-exactly**:
+
+- **admission by heat**: a query becomes a view candidate once the PR-4
+  stats table has recorded ``config.view_min_calls`` calls for its
+  fingerprint — the same normalized-SQL id the coalesce lanes key on,
+  so the hottest lanes earn resident results first.
+- **class-footprint invalidation**: each view remembers the classes its
+  MATCH pattern can read (vertex classes + edge classes,
+  subclass-closed; a bare ``{as:x}`` target widens the footprint to
+  any VERTEX class). A callback-mode CDC consumer
+  (``cdc/feed.py``) checks every committed event against each view's
+  footprint — an insert into ``SimAudit`` leaves a ``Person`` view
+  serving at cache speed; only events that could change the result kill
+  it. An event with no class attribution conservatively kills
+  everything.
+- **incremental count maintenance**: views of single-node lone-COUNT
+  shape (``MATCH {class:C, where:(...)} RETURN count(*)``) do not die
+  on a matching insert/delete — the count adjusts by ±1 from the event
+  itself (``cdc.feed.event_matches`` evaluates the view's WHERE against
+  the event record), the delta sibling of the snapshot maintainer's
+  scatter patches. Updates and preimage-less deletes invalidate
+  (conservative: the old value is unknown).
+
+Rows are shared between hits (the command-cache convention: results are
+read-only by convention). The plane is bounded per database by
+``config.view_cache_size`` (LRU) and disabled at 0.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("views")
+
+
+class _View:
+    __slots__ = (
+        "key",
+        "rows",
+        "engine",
+        "classes",
+        "vertex_wildcard",
+        "count_shape",
+        "count_name",
+        "count_classes",
+        "where",
+        "valid",
+        "hits",
+        "refreshes",
+    )
+
+    def __init__(self, key, rows, engine, classes, vertex_wildcard) -> None:
+        self.key = key
+        self.rows = rows
+        self.engine = engine
+        #: lowered class names the statement can read
+        self.classes: Set[str] = classes
+        #: True when the pattern binds a BARE target (`{as:q}` with no
+        #: class): any VERTEX event can change the result (reached
+        #: vertices are unconstrained, and a vertex delete cascades
+        #: edge removals that produce no per-edge events) — but plain
+        #: DOCUMENT writes still cannot, which is the workload's noise
+        self.vertex_wildcard = vertex_wildcard
+        #: single-node lone-COUNT shape: maintained incrementally
+        self.count_shape = False
+        self.count_name: Optional[str] = None
+        self.count_classes: Optional[List[str]] = None
+        self.where = None
+        self.valid = True
+        self.hits = 0
+        self.refreshes = 0
+
+
+def _local_expr(e) -> bool:
+    """True when the expression reads ONLY the current record's own
+    values (plus literals/parameters/context vars). Graph functions
+    (``out()``/``in()``/``both()``…), method chains, and field
+    dereference all reach records OUTSIDE the node's class — a write
+    to those would never intersect the view's footprint, so a filter
+    using them would serve stale results forever. Conservative by
+    design: an unrecognized node shape refuses."""
+    from orientdb_tpu.sql import ast as A
+
+    if e is None:
+        return True
+    if isinstance(
+        e, (A.Literal, A.Parameter, A.ContextVar, A.RIDLiteral, A.Identifier)
+    ):
+        return True
+    if isinstance(e, A.Unary):
+        return _local_expr(e.expr)
+    if isinstance(e, A.Binary):
+        return _local_expr(e.left) and _local_expr(e.right)
+    if isinstance(e, A.Between):
+        return all(_local_expr(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, (A.IsNull, A.IsDefined)):
+        return _local_expr(e.expr)
+    if isinstance(e, A.ListExpr):
+        return all(_local_expr(x) for x in e.items)
+    return False  # FieldAccess / FunctionCall / MethodCall / IndexAccess…
+
+
+def _local_filter(f) -> bool:
+    return f is None or (
+        _local_expr(f.where) and _local_expr(f.while_cond)
+    )
+
+
+def _statement_classes(db, stmt):
+    """``(lowered class names, vertex_wildcard)`` describing what the
+    statement can read — the event check intersects the record class's
+    superclass closure with the names, so storing the named classes
+    suffices. ``(None, False)`` = cannot bound the footprint (no
+    admission). A classless node makes the footprint vertex-wildcard:
+    any vertex event invalidates, document events never do. Node/edge
+    filters must be LOCAL (``_local_expr``): a WHERE hopping through
+    ``out('X')`` reads class X without naming it in the pattern."""
+    from orientdb_tpu.sql import ast as A
+
+    names: Set[str] = set()
+    wildcard = False
+    try:
+        if not isinstance(stmt, A.MatchStatement):
+            return None, False
+        for path in stmt.paths:
+            if not _local_filter(path.first):
+                return None, False
+            if path.first.class_name is None:
+                wildcard = True
+            else:
+                names.add(path.first.class_name.lower())
+            for it in path.items:
+                if not it.edge_classes:
+                    return None, False  # any-edge-class hop: unbounded
+                if not (
+                    _local_filter(it.target)
+                    and _local_filter(it.edge_filter)
+                ):
+                    return None, False
+                names.update(c.lower() for c in it.edge_classes)
+                if it.target.class_name is not None:
+                    names.add(it.target.class_name.lower())
+                else:
+                    wildcard = True
+        if not names and not wildcard:
+            return None, False
+        return names, wildcard
+    except Exception:
+        return None, False
+
+
+#: aggregate / pure functions a view's RETURN may call; anything else
+#: (sequence(), date(), uuid(), format()...) may be impure or
+#: time-dependent — serving it from cache would change semantics
+_PURE_FUNCTIONS = frozenset({"count", "sum", "min", "max", "avg"})
+
+
+def _safe_projections(stmt) -> bool:
+    """True when every RETURN expression is a plain field access /
+    identifier or a pure aggregate — the shapes a cached result can
+    answer without re-evaluating anything impure."""
+    from orientdb_tpu.sql import ast as A
+
+    def safe(e) -> bool:
+        if isinstance(e, (A.Identifier, A.Star)):
+            return True
+        if isinstance(e, A.FieldAccess):
+            return isinstance(e.base, A.Identifier)
+        if isinstance(e, A.FunctionCall):
+            return e.name.lower() in _PURE_FUNCTIONS and all(
+                safe(a) for a in e.args
+            )
+        return False
+
+    try:
+        return all(safe(p.expr) for p in stmt.returns)
+    except Exception:
+        return False
+
+
+class ViewManager:
+    """Per-database registry of materialized views."""
+
+    def __init__(self, db) -> None:
+        self.db = db
+        self._lock = threading.Lock()
+        self._map: "OrderedDict[Tuple, _View]" = OrderedDict()
+        self._consumer_token: Optional[int] = None
+        # registration-only mutex: never taken by _on_event, so holding
+        # it across feed.register can't deadlock against the feed
+        # delivering on another thread
+        self._consumer_mu = threading.Lock()
+
+    # -- CDC wiring ---------------------------------------------------------
+
+    def _ensure_consumer(self) -> None:
+        if self._consumer_token is not None:
+            return
+        from orientdb_tpu.cdc.feed import feed_of
+
+        with self._consumer_mu:
+            if self._consumer_token is not None:
+                # lost the registration race: one consumer is enough
+                # (two would deliver every event twice, and a count-
+                # shape view would adjust by ±2 per matching write)
+                return
+            feed = feed_of(self.db, create=True)
+            c = feed.register(callback=self._on_event)
+            self._consumer_token = c.token
+
+    def _on_event(self, ev: Dict) -> None:
+        """Inline from the write path: MUST stay cheap. Footprint check
+        per view + flag flips; the count adjustment is host arithmetic."""
+        op = ev.get("op")
+        if op not in ("create", "update", "delete"):
+            return
+        cname = ev.get("class")
+        cls = (
+            self.db.schema.get_class(cname) if cname is not None else None
+        )
+        closure = None
+        if cls is not None:
+            # the record's class plus every superclass, lowered: a view
+            # footprinting any of them is affected (case-insensitive —
+            # query text and schema may disagree on case)
+            closure = {cls.name.lower()} | {
+                s.lower() for s in cls.all_superclass_names()
+            }
+        with self._lock:
+            views = list(self._map.values())
+        for v in views:
+            if not v.valid:
+                continue
+            if closure is None:
+                self._invalidate(v)  # classless event: assume the worst
+                continue
+            affected = bool(closure & v.classes) or (
+                v.vertex_wildcard
+                and cls is not None
+                and cls.is_vertex_type
+            )
+            if not affected:
+                continue  # the CDC-exact win: unrelated write, view lives
+            if v.count_shape and op in ("create", "delete"):
+                self._adjust_count(v, ev, op)
+            else:
+                self._invalidate(v)
+
+    def _adjust_count(self, v: _View, ev: Dict, op: str) -> None:
+        """±1 maintenance for single-node COUNT views; falls back to
+        invalidation when the event cannot be judged (no preimage)."""
+        from orientdb_tpu.cdc.feed import event_matches
+
+        if op == "delete" and not ev.get("record"):
+            self._invalidate(v)  # preimage unknown: cannot judge
+            return
+        try:
+            hit = event_matches(
+                self.db,
+                {**ev, "op": "create"},  # judge the record against WHERE
+                classes=v.count_classes,
+                where=v.where,
+            )
+        except Exception:
+            self._invalidate(v)
+            return
+        if not hit:
+            return
+        delta = 1 if op == "create" else -1
+        rows = v.rows
+        try:
+            from orientdb_tpu.exec.result import Result
+
+            row = rows[0]
+            cur = (
+                row.get(v.count_name)
+                if isinstance(row, dict)
+                else row.get_property(v.count_name)
+            )
+            if len(rows) != 1 or cur is None:
+                raise ValueError("not a count row")
+            # REPLACE the row (never mutate: hits share row objects)
+            v.rows = [Result(props={v.count_name: max(0, int(cur) + delta)})]
+            v.refreshes += 1
+            metrics.incr("views.incremental")
+        except Exception:
+            self._invalidate(v)
+
+    def _invalidate(self, v: _View) -> None:
+        if v.valid:
+            v.valid = False
+            metrics.incr("views.invalidated")
+
+    def invalidate_all(self, reason: str = "") -> None:
+        """Kill every view: schema mutations that bypass the CDC stream
+        (class rename/drop rewrite records in place) leave the class
+        footprints keyed by names that no longer exist — no future
+        event would ever match them. Called under ``db._lock`` (same
+        order as the CDC callback path: db._lock → our lock)."""
+        with self._lock:
+            views = list(self._map.values())
+            self._map.clear()
+        for v in views:
+            self._invalidate(v)
+        if views:
+            log.info("all %d views invalidated: %s", len(views), reason)
+
+    # -- serving ------------------------------------------------------------
+
+    @staticmethod
+    def _key(sql: str, params, engine, strict) -> Optional[Tuple]:
+        try:
+            pk = (
+                tuple(sorted((str(k), repr(v)) for k, v in params.items()))
+                if params
+                else ()
+            )
+        except Exception:
+            return None
+        return (sql, pk, engine or "", bool(strict))
+
+    def lookup(self, sql: str, params, engine, strict) -> Optional[_View]:
+        key = self._key(sql, params, engine, strict)
+        if key is None:
+            return None
+        with self._lock:
+            v = self._map.get(key)
+            if v is None:
+                return None
+            if not v.valid:
+                self._map.pop(key, None)
+                metrics.incr("views.refresh_needed")
+                return None
+            self._map.move_to_end(key)
+            v.hits += 1
+        metrics.incr("views.hit")
+        from orientdb_tpu.obs.stats import note_result_cache_hit
+
+        note_result_cache_hit()
+        return v
+
+    def observe(
+        self, sql: str, params, engine, strict, rows, used, epoch=None
+    ) -> None:
+        """Post-execution admission: materialize the result once the
+        fingerprint is hot enough. ``engine`` is the REQUESTED engine
+        (the lookup key); ``used`` is the engine that actually served
+        (the label a hit reports). ``epoch`` is ``db.mutation_epoch``
+        captured BEFORE the query ran: a write committing between the
+        run and this admission fires its CDC callback before the view
+        is registered, so nothing would ever invalidate the pre-write
+        rows — the epoch re-check under the lock closes that window
+        (writes bump the epoch before their hooks fire, both under
+        ``db._lock``)."""
+        cap = config.view_cache_size
+        if cap <= 0:
+            return
+        key = self._key(sql, params, engine, strict)
+        if key is None:
+            return
+        from orientdb_tpu.obs.stats import fingerprint_cached, stats
+
+        try:
+            from orientdb_tpu.exec.engine import parse_cached
+            from orientdb_tpu.sql import ast as A
+
+            stmt = parse_cached(sql)
+        except Exception:
+            return
+        # MATCH only (the plane's name is literal): SELECT projections
+        # can hide side effects (sequence('s').next()) and TRAVERSE
+        # footprints are unbounded — neither may be served from cache
+        if not isinstance(stmt, A.MatchStatement):
+            return
+        if not _safe_projections(stmt):
+            return
+        fid = fingerprint_cached(sql).fid
+        if stats.calls_of(fid) < max(1, config.view_min_calls):
+            return
+        classes, wildcard = _statement_classes(self.db, stmt)
+        if classes is None:
+            return  # unbounded footprint: every write would kill it
+        v = _View(key, rows, used, classes, wildcard)
+        self._mark_count_shape(v, stmt, params)
+        self._ensure_consumer()
+        with self._lock:
+            if epoch is not None and self.db.mutation_epoch != epoch:
+                metrics.incr("views.admission_raced")
+                return
+            while len(self._map) >= cap:
+                self._map.popitem(last=False)
+            self._map[key] = v
+        metrics.incr("views.materialized")
+
+    def _mark_count_shape(self, v: _View, stmt, params) -> None:
+        """Single-node lone-COUNT MATCH with a literal WHERE → eligible
+        for ±1 incremental maintenance."""
+        from orientdb_tpu.sql import ast as A
+
+        if params:
+            return  # parameterized WHEREs re-derive per value: skip
+        if not isinstance(stmt, A.MatchStatement):
+            return
+        if (
+            stmt.group_by
+            or stmt.order_by
+            or stmt.skip
+            or stmt.limit
+            or stmt.unwind
+        ):
+            return
+        if len(stmt.paths) != 1 or stmt.paths[0].items:
+            return
+        node = stmt.paths[0].first
+        if node.class_name is None or node.optional or node.rid is not None:
+            return
+        r = stmt.returns
+        if not (
+            len(r) == 1
+            and isinstance(r[0].expr, A.FunctionCall)
+            and r[0].expr.name.lower() == "count"
+            and len(r[0].expr.args) == 1
+            and isinstance(r[0].expr.args[0], A.Star)
+        ):
+            return
+        from orientdb_tpu.exec.oracle import expr_name
+
+        # resolve to the SCHEMA's casing: event_matches compares via
+        # is_subclass_of, which is case-sensitive
+        cls = self.db.schema.get_class(node.class_name)
+        v.count_shape = True
+        v.count_name = r[0].alias or expr_name(r[0].expr, 0)
+        v.count_classes = [cls.name if cls is not None else node.class_name]
+        v.where = node.where
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            views = list(self._map.values())
+        return {
+            "views": len(views),
+            "valid": sum(1 for v in views if v.valid),
+            "incremental": sum(1 for v in views if v.count_shape),
+            "hits": sum(v.hits for v in views),
+        }
+
+    def close(self) -> None:
+        if self._consumer_token is not None:
+            from orientdb_tpu.cdc.feed import feed_of
+
+            feed = feed_of(self.db, create=False)
+            if feed is not None:
+                feed.unregister(self._consumer_token)
+            self._consumer_token = None
+        with self._lock:
+            self._map.clear()
+
+
+_VM_CREATE_MU = threading.Lock()
+
+
+def views_for(db) -> Optional[ViewManager]:
+    """The database's view manager, created on first use; None when the
+    plane is disabled (``view_cache_size`` = 0)."""
+    if config.view_cache_size <= 0:
+        return None
+    vm = getattr(db, "_view_manager", None)
+    if vm is None:
+        with _VM_CREATE_MU:
+            vm = getattr(db, "_view_manager", None)
+            if vm is None:
+                vm = db._view_manager = ViewManager(db)
+    return vm

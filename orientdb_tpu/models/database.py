@@ -157,6 +157,11 @@ class Database:
         # path refuses them until the batch commits/aborts/expires.
         self._tx2pc_locks: Dict[RID, str] = {}
         self._tx2pc_registry = None
+        # Incremental snapshot maintenance (storage/deltas): when armed,
+        # the maintainer applies CDC deltas to the attached snapshot
+        # device-side instead of the wholesale detach+re-upload path;
+        # current_snapshot(require_fresh=True) catches up through it.
+        self._snapshot_maintainer = None
         # Replication apply serialization (parallel/replication): push
         # and pull applies to THIS database take it so a signal-stopped
         # puller's in-flight pull can't race its replacement. A real
@@ -352,6 +357,25 @@ class Database:
                     key
                 )
             self.mutation_epoch += 1
+            self._poison_overlay(f"class renamed: {old} -> {new}")
+
+    def _poison_overlay(self, reason: str) -> None:
+        """Schema mutations the CDC stream cannot express (renames,
+        drops) invalidate a delta-maintained snapshot: poison the
+        overlay so the next catch-up compacts. Lock-free flag write —
+        callers hold self._lock, and the maintainer's catch-up takes
+        its own lock BEFORE self._lock (taking it here would invert).
+        Materialized views die with the overlay: their class footprints
+        are keyed by the OLD names, so no future event would ever
+        invalidate them (a renamed-away class's view would serve its
+        stale result forever)."""
+        snap = self._snapshot
+        ov = getattr(snap, "_overlay", None) if snap is not None else None
+        if ov is not None:
+            ov.poison(reason)
+        vm = getattr(self, "_view_manager", None)
+        if vm is not None:
+            vm.invalidate_all(reason)
 
     def _check_2pc_lock(self, rid) -> None:
         """Refuse a write to a rid locked by an in-flight prepared
@@ -800,6 +824,7 @@ class Database:
             if self._indexes is not None:
                 self._indexes.drop_for_class(cls.name)
             self.schema.drop_class(cls.name)
+            self._poison_overlay(f"class dropped: {cls.name}")
 
     # -- indexes -----------------------------------------------------------
 
@@ -973,7 +998,18 @@ class Database:
         if self._snapshot is None:
             return None
         if require_fresh and self._snapshot_epoch != self.mutation_epoch:
-            return None
+            m = self._snapshot_maintainer
+            if m is not None:
+                # incremental path (storage/deltas): apply the pending
+                # CDC delta batch device-side — the epoch catches up
+                # without dropping a single HBM buffer. A poisoned
+                # overlay compacts (full rebuild) inside catch_up.
+                m.catch_up()
+            if (
+                self._snapshot is None
+                or self._snapshot_epoch != self.mutation_epoch
+            ):
+                return None
         return self._snapshot
 
     @property

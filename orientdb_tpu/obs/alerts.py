@@ -71,6 +71,9 @@ RULE_CATALOG: Dict[str, str] = {
     "EWMA baseline by alert_overlap_idle_mads deviations — the "
     "overlap machinery (prefetch, rings, double buffering) stopped "
     "hiding work",
+    "delta_slab_pressure": "a delta-maintained snapshot's fullest "
+    "append slab (snapshot.delta.slab_fill, storage/deltas) exceeds "
+    "alert_slab_fill — deltas are outpacing epoch compaction",
 }
 
 #: two-window burn-rate windows (seconds): the short window catches the
@@ -576,6 +579,17 @@ class AlertEngine:
                 "jax", v, thr, f"live jax buffers at {int(v)} bytes"
             )
 
+    def _check_slab_pressure(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_slab_fill
+        v = ctx.gauges.get("snapshot.delta.slab_fill", 0.0)
+        if thr > 0 and v > thr:
+            yield Breach(
+                "snapshot",
+                v,
+                thr,
+                f"delta slab {v:.0%} full (compaction falling behind)",
+            )
+
     def _check_recompile_storm(self, ctx: AlertContext) -> Iterable[Breach]:
         thr = config.alert_recompiles_per_min
         total = sum(
@@ -770,6 +784,11 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
         "overlap_regression", "warning",
         AlertEngine._check_overlap_regression,
         exemplar_spans=("coalesce.", "tpu.", "query"),
+    ),
+    _rule(
+        "delta_slab_pressure", "warning",
+        AlertEngine._check_slab_pressure,
+        exemplar_spans=("snapshot.",),
     ),
 )
 

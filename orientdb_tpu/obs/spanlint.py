@@ -57,6 +57,11 @@ SPAN_CATALOG: Dict[str, str] = {
     "its fingerprint lane, enqueue through result (submitter side)",
     "coalesce.dispatch": "one lane micro-batch executed on the lane "
     "worker (continues the first submitter's trace; lane/batch attrs)",
+    "snapshot.delta.apply": "one CDC delta batch applied device-side "
+    "to a maintained snapshot (storage/deltas: packed scatter "
+    "segments, no re-upload)",
+    "snapshot.compact": "epoch compaction: slabs folded back into a "
+    "clean CSR (rebuild + optional content-addressed epoch persist)",
     "cdc.catchup": "changefeed catch-up read: WAL entries above a "
     "consumer's cursor decoded to events",
     "cdc.push": "one changefeed delivery (binary push frame or HTTP "

@@ -432,6 +432,13 @@ class QueryStats:
         self._record(fp, acc, duration_s, engine, rows, error)
         return fp.fid
 
+    def calls_of(self, fid: str) -> int:
+        """Recorded call count for a fingerprint (0 when untracked) —
+        the materialized-view plane's hotness signal (exec/views)."""
+        with self._lock:
+            e = self._map.get(fid)
+            return int(e.calls) if e is not None else 0
+
     def _entry_locked(self, fp: Fingerprint) -> Optional[_Entry]:
         """Get-or-create (and LRU-touch) the fingerprint's entry —
         caller holds ``_lock``. None when the table is disabled

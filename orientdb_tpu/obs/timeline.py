@@ -739,14 +739,30 @@ def note_prefetch(hit: bool, nbytes: int = 0) -> None:
 # -- scrape-time gauges ------------------------------------------------------
 
 
+#: publish_overlap_gauges recompute floor: the overlap pass over a full
+#: ring costs ~10ms of host time, and the provider runs inside EVERY
+#: registry.snapshot_all() — a fast-ticking watchdog (tests tick at
+#: 50Hz; production every few seconds) must not pay it per tick. 250ms
+#: keeps /metrics effectively live while bounding the cost at any rate.
+_PUBLISH_MIN_INTERVAL_S = 0.25
+_publish_last_ts = 0.0
+
+
 def publish_overlap_gauges() -> None:
     """Refresh the ``orienttpu_overlap_*`` gauges from a bounded recent
     window (``config.timeline_window_s``). Registered as a scrape-time
     gauge provider (obs/profile), so ``/metrics``, the member-labeled
     ``/cluster/metrics`` fan-in, and every alert-engine snapshot carry
-    them without any hot-path cost."""
+    them without any hot-path cost. Recomputes at most once per
+    ``_PUBLISH_MIN_INTERVAL_S`` (consumers in between read the prior
+    gauge values — a racy double recompute is harmless)."""
     from orientdb_tpu.utils.metrics import metrics
 
+    global _publish_last_ts
+    now = time.monotonic()
+    if now - _publish_last_ts < _PUBLISH_MIN_INTERVAL_S:
+        return
+    _publish_last_ts = now
     # span-FREE accounting: this provider runs inside EVERY
     # registry.snapshot_all() (scrapes, watchdog ticks, bundles) — a
     # span here would stamp the tracer ring on every scrape and poison

@@ -40,7 +40,7 @@ class DeviceColumn:
     the cross-shard collectives (all_gather / all_to_all, its choice).
     """
 
-    __slots__ = ("name", "kind", "dictionary", "_g", "_kv", "_kp")
+    __slots__ = ("name", "kind", "dictionary", "_g", "_kv", "_kp", "_src")
 
     def __init__(
         self,
@@ -52,6 +52,7 @@ class DeviceColumn:
         self.name = col.name
         self.kind = col.kind
         self.dictionary = col.dictionary
+        self._src = col
         self._g = g
         # LAZY upload (per-query property pruning, SURVEY.md §7's SF100
         # memory plan): the host arrays are registered but reach HBM only
@@ -61,6 +62,21 @@ class DeviceColumn:
         self._kp = g._put_lazy(
             f"{prefix}:p", col.present, shard_pad=shard_pad
         )
+
+    @property
+    def dict_unsorted(self) -> bool:
+        """Read through to the HOST column: the delta maintainer flips
+        the flag when it appends a new string, which may happen long
+        after this proxy was built (predicates check it per compile —
+        O(1) where rescanning the dictionary is O(n))."""
+        return self._src.dict_unsorted
+
+    @property
+    def dict_lookup(self):
+        """Read through to the host column's exact value→code map (the
+        delta maintainer keeps it current across appends) — equality
+        compiles against a delta-appended dictionary in O(1)."""
+        return self._src.dict_lookup
 
     @property
     def values(self):
@@ -98,6 +114,12 @@ class DeviceEdgeClass:
             g._put(f"{p}:indptr_in", csr.indptr_in)
             g._put(f"{p}:src", csr.src)
             g._put(f"{p}:edge_id_in", csr.edge_id_in)
+            if getattr(csr, "live", None) is not None:
+                # delta-slab liveness (storage/deltas): spare slots and
+                # tombstoned edges read False; the bitmap-hop and slab
+                # expansion paths mask on it as a jit ARGUMENT, so
+                # delta patches reach every cached plan
+                g._put(f"{p}:live", csr.live)
         e_pad = g._shard_pad_rows(int(csr.dst.shape[0]))
         self.columns: Dict[str, DeviceColumn] = {
             n: DeviceColumn(c, g, f"{p}:c:{n}", shard_pad=e_pad)
@@ -188,6 +210,15 @@ class DeviceGraph:
         self._replicated_spec = None
         mesh = getattr(snap, "_mesh", None)
         if mesh is not None:
+            if getattr(snap, "_overlay", None) is not None:
+                # the mesh layout re-partitions adjacency per shard and
+                # does not upload the slab live masks — silently meshing
+                # a delta-maintained snapshot would serve spare/dead
+                # edges. Compact to a clean snapshot first.
+                raise ValueError(
+                    "delta-maintained snapshots are single-device; "
+                    "compact before attaching a mesh"
+                )
             from jax.sharding import NamedSharding, PartitionSpec
             from orientdb_tpu.parallel.mesh_graph import MeshGraph
 
@@ -290,16 +321,60 @@ class DeviceGraph:
 
     def ensure_key(self, key: str) -> None:
         """Upload a lazily registered array if it has not reached the
-        device yet; logs the touch when a recording is active."""
+        device yet; logs the touch when a recording is active. The
+        upload runs INSIDE the pending lock so a concurrent delta patch
+        (``apply_patches``) can never land between the pop and the
+        device store and be lost."""
         trk = getattr(self._tls, "tracker", None)
         if trk is not None:
             trk.log.add(key)
         if key in self._pending:
             with self._pending_lock:
                 spec = self._pending.pop(key, None)
-            if spec is not None:
-                arr, shard_pad, fill = spec
-                self._put(key, arr, shard_pad=shard_pad, fill=fill)
+                if spec is not None:
+                    arr, shard_pad, fill = spec
+                    self._put(key, arr, shard_pad=shard_pad, fill=fill)
+
+    def apply_patches(self, patches: Dict[str, tuple]) -> int:
+        """Scatter one delta batch into resident device arrays:
+        ``{key: (indices, values)}`` applied as a functional
+        ``arr.at[idx].set(vals)`` per key. Same shape in, same shape out
+        — compiled plans take these arrays as jit ARGUMENTS, so every
+        cached executable sees the patch with zero retrace and the
+        upload is bounded by the delta (the packed index/value
+        segments), never the graph. Keys still pending lazy upload are
+        skipped: their HOST arrays were already patched in place by the
+        maintainer, so the eventual upload carries the delta for free.
+        Returns the host→device bytes shipped."""
+        import jax
+
+        nbytes = 0
+        with self._pending_lock:
+            for key, (idx, vals) in patches.items():
+                cur = self._arrays.get(key)
+                if cur is None:
+                    continue  # lazy column not yet resident
+                ia = np.asarray(idx, np.int32)
+                va = np.asarray(vals).astype(cur.dtype)
+                # bucket the segment to a pow2 length by REPEATING the
+                # last (index, value) pair — a duplicate scatter of the
+                # same value is idempotent, and the bucketed shape keeps
+                # the .at[].set executable jit-cache-hot (per-delta
+                # shapes recompiled XLA on every batch otherwise: ~3x
+                # the whole read path's cost at bench shape)
+                cap = 1 << max(0, int(ia.shape[0] - 1).bit_length())
+                if cap > ia.shape[0]:
+                    ia = np.concatenate(
+                        [ia, np.full(cap - ia.shape[0], ia[-1], ia.dtype)]
+                    )
+                    va = np.concatenate(
+                        [va, np.full(cap - va.shape[0], va[-1], va.dtype)]
+                    )
+                self._arrays[key] = cur.at[jax.device_put(ia)].set(
+                    jax.device_put(va)
+                )
+                nbytes += int(ia.nbytes) + int(va.nbytes)
+        return nbytes
 
     def _put(
         self,

@@ -159,12 +159,15 @@ class ColumnScope:
 # 'int' 'float' 'bool' 'str' 'null'. For 'str', `dictionary` carries the
 # sorted host dictionary. A bool node: emit(idx, env) -> mask.
 class _Val:
-    __slots__ = ("kind", "emit", "dictionary")
+    __slots__ = ("kind", "emit", "dictionary", "column")
 
-    def __init__(self, kind: str, emit, dictionary=None):
+    def __init__(self, kind: str, emit, dictionary=None, column=None):
         self.kind = kind
         self.emit = emit
         self.dictionary = dictionary
+        #: source DeviceColumn for 'str' column reads — carries the
+        #: delta maintainer's dict_unsorted flag (O(1) sortedness check)
+        self.column = column
 
 
 BoolFn = Callable[[jnp.ndarray, dict], jnp.ndarray]
@@ -204,7 +207,7 @@ def _column_val(col: DeviceColumn) -> _Val:
             jnp.take(col.present, ci) & ok,
         )
 
-    return _Val(col.kind, emit, dictionary=col.dictionary)
+    return _Val(col.kind, emit, dictionary=col.dictionary, column=col)
 
 
 def _binding_val(alias: str, col: DeviceColumn) -> _Val:
@@ -224,7 +227,7 @@ def _binding_val(alias: str, col: DeviceColumn) -> _Val:
             jnp.take(col.present, ci) & ok,
         )
 
-    return _Val(col.kind, emit, dictionary=col.dictionary)
+    return _Val(col.kind, emit, dictionary=col.dictionary, column=col)
 
 
 _NUMERIC = ("int", "float", "bool")
@@ -576,9 +579,15 @@ class Compiler:
             return lambda idx, env: jnp.zeros(idx.shape, bool)
         if a_str and b.kind == "str":
             if a.dictionary is not None and a.dictionary is b.dictionary:
+                if op not in ("=", "!=") and not _dict_sorted(a):
+                    raise Uncompilable(
+                        "ordered string compare on a delta-appended "
+                        "dictionary (compaction re-sorts)"
+                    )
                 # same sorted dictionary (same property column on both
                 # sides): code rank order == lexicographic order, so the
-                # codes compare directly as ints
+                # codes compare directly as ints (codes compare by
+                # identity for =/!=, which appended dictionaries keep)
                 a = _Val("int", a.emit)
                 b = _Val("int", b.emit)
                 a_num = b_num = True
@@ -617,6 +626,41 @@ class Compiler:
 
     def _cmp_str_lit(self, op: str, col: _Val, lit: str) -> BoolFn:
         d: Sequence[str] = col.dictionary or []
+        if not _dict_sorted(col):
+            # the delta maintainer (storage/deltas) APPENDED new strings:
+            # codes no longer rank-ordered, so bisect is wrong. Equality
+            # still compiles (exact code lookup); ordered compares fall
+            # back to the oracle until compaction re-sorts.
+            if op not in ("=", "!="):
+                raise Uncompilable(
+                    "ordered string compare on a delta-appended "
+                    "dictionary (compaction re-sorts)"
+                )
+            lookup = (
+                col.column.dict_lookup if col.column is not None else None
+            )
+            if lookup is not None:
+                # the maintainer's value→code map: O(1) vs the O(n)
+                # dictionary rescan, on the path every dict append
+                # makes hot (appends bump plan_gen → re-record)
+                exact_u: Optional[int] = lookup.get(lit)
+            else:  # defensive: column-less _Vals are never delta-appended
+                try:
+                    exact_u = list(d).index(lit)
+                except ValueError:
+                    exact_u = None
+
+            def ufn(idx, env, col=col, op=op, exact=exact_u):
+                vals, pres = col.emit(idx, env)
+                if op == "=":
+                    if exact is None:
+                        return jnp.zeros(idx.shape, bool)
+                    return pres & (vals == exact)
+                if exact is None:
+                    return pres
+                return pres & (vals != exact)
+
+            return ufn
         lo = bisect.bisect_left(d, lit)
         hi = bisect.bisect_right(d, lit)
         exact = lo if (lo < len(d) and d[lo] == lit) else None
@@ -649,6 +693,21 @@ def _presence(v: _Val, idx, env) -> jnp.ndarray:
         return jnp.zeros(idx.shape, bool)
     _, pres = v.emit(idx, env)
     return pres
+
+
+def _dict_sorted(v: _Val) -> bool:
+    """True while the column dictionary's code order is lexicographic —
+    the build-time invariant ordered compares rely on. The delta
+    maintainer appends new strings at the tail, breaking it until
+    compaction; it flags the host column (``dict_unsorted``), so a
+    column-backed value answers in O(1). Only a _Val with no column
+    attribution pays the O(n) scan (defensive: snapshot builds always
+    sort, so untracked dictionaries are sorted in practice)."""
+    col = v.column
+    if col is not None:
+        return not col.dict_unsorted
+    d = v.dictionary or []
+    return all(d[i] <= d[i + 1] for i in range(len(d) - 1))
 
 
 def _flip(op: str) -> str:

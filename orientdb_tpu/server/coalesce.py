@@ -78,6 +78,7 @@ class _Item:
         "error",
         "ctx",
         "t_enq",
+        "epoch",
     )
 
     def __init__(self, sql: str, params) -> None:
@@ -91,6 +92,12 @@ class _Item:
         #: CONTINUES the first rider's trace (obs/propagation)
         self.ctx: Optional[Dict] = None
         self.t_enq: float = 0.0
+        #: db.mutation_epoch at ADMISSION: the lane dispatch refuses to
+        #: serve this item from a snapshot older than every write that
+        #: completed before the item was submitted (epoch keying — a
+        #: lane window formed pre-write cannot serve post-write queries
+        #: stale results)
+        self.epoch: int = 0
 
 
 class _Lane:
@@ -317,6 +324,9 @@ class _Lane:
                 # window that formed this micro-batch
                 enqueue_ts=min(i.t_enq for i in batch),
                 window_s=self._last_window,
+                # epoch keying: the snapshot must cover every rider's
+                # admission epoch or the batch takes the generic path
+                min_epoch=max(i.epoch for i in batch),
             )
         except Exception:
             # eligibility probing must never kill the drain loop; the
@@ -563,11 +573,29 @@ class QueryCoalescer:
         if not self._coalescable(db, sql):
             rs = db.query(sql, params)
             return rs.to_dicts(), rs.engine
+        # materialized-view fast path (exec/views): a CDC-valid resident
+        # result beats any micro-batch — served before lane formation,
+        # so hot fingerprints cost neither a window nor a dispatch
+        from orientdb_tpu.exec.engine import _normalize_params
+        from orientdb_tpu.exec.views import views_for
+
+        vm = views_for(db) if db.tx is None else None
+        if vm is not None:
+            view = vm.lookup(sql, _normalize_params(params), None, False)
+            if view is not None:
+                return (
+                    [
+                        r if isinstance(r, dict) else r.to_dict()
+                        for r in view.rows
+                    ],
+                    view.engine,
+                )
         from orientdb_tpu.obs.stats import fingerprint_cached
 
         fid = fingerprint_cached(sql).fid
         item = _Item(sql, params)
         item.ctx = current_context()
+        item.epoch = db.mutation_epoch
         with span("coalesce.lane", lane=fid) as sp:
             queued = False
             for _attempt in (0, 1):

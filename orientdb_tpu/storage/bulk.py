@@ -119,6 +119,23 @@ class BulkLoader:
                 from orientdb_tpu.cdc.feed import notify_commit
 
                 notify_commit(db, bulk_entry, lsn)
+            else:
+                # hooks do not fire and nothing reached the changefeed,
+                # yet the epoch bumped: a CDC-derived device plane would
+                # stamp itself fresh against an empty queue while
+                # missing this whole flush. Poison the delta overlay
+                # (next catch-up rebuilds from the host store) and drop
+                # materialized views — atomically with the epoch bump,
+                # so a racing catch_up can't stamp stale-fresh in
+                # between. (db._lock → view lock is the same edge the
+                # notify_commit callback path above already holds.)
+                maint = getattr(db, "_snapshot_maintainer", None)
+                ov = maint.overlay if maint is not None else None
+                if ov is not None:
+                    ov.poison("bulk flush bypassed the changefeed")
+                vm = getattr(db, "_view_manager", None)
+                if vm is not None:
+                    vm.invalidate_all("bulk flush bypassed the changefeed")
         n_v, n_e = len(self._vertices), len(self._edges)
         self._vertices = []
         self._edges = []

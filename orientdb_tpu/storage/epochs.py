@@ -60,6 +60,14 @@ def _col_restore(arrays, prefix: str, meta) -> PropertyColumn:
 
 def save_snapshot(snap: GraphSnapshot, directory: str) -> str:
     """Persist a snapshot epoch; returns its path."""
+    if getattr(snap, "_overlay", None) is not None:
+        # slab-padded form is a runtime layout, not an archival one
+        # (spare rows, None edge rids); the maintainer persists the
+        # CLEAN rebuild during compaction instead
+        raise ValueError(
+            "delta-maintained snapshots persist via epoch compaction "
+            "(storage/deltas.SnapshotMaintainer.compact), not directly"
+        )
     os.makedirs(directory, exist_ok=True)
     arrays: dict = {
         "v_cluster": snap.v_cluster,

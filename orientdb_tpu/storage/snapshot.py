@@ -21,13 +21,17 @@ moves into TPU HBM:
 - a **class-id column** + subclass closure table so `class:X` polymorphic
   filters compile to `isin(class_id, …)` masks.
 
-Snapshots are immutable; `Database.mutation_epoch` tracks staleness and
-`build_snapshot` is re-run to refresh (the snapshot-epoch model of
-SURVEY.md §5.4 — no WAL needed on the read-only TPU path).
+Snapshots are immutable by default; `Database.mutation_epoch` tracks
+staleness and `build_snapshot` is re-run to refresh (the snapshot-epoch
+model of SURVEY.md §5.4 — no WAL needed on the read-only TPU path).
+Delta-maintained snapshots (`storage/deltas.py`) relax this: writes
+apply device-side into pre-allocated append slabs off the CDC feed, and
+periodic epoch compaction folds them back into a clean CSR.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -55,6 +59,7 @@ class PropertyColumn:
         "present",
         "dictionary",
         "dict_lookup",
+        "dict_unsorted",
         "_dict_arr",
     )
 
@@ -67,6 +72,10 @@ class PropertyColumn:
         self.dict_lookup: Optional[Dict[str, int]] = (
             {s: i for i, s in enumerate(dictionary)} if dictionary else None
         )
+        #: True once the delta maintainer APPENDED a new string (codes
+        #: no longer sorted): equality predicates stay exact, ordered
+        #: compares refuse to compile until compaction re-sorts
+        self.dict_unsorted = False
         self._dict_arr = None
 
     def dict_array(self) -> np.ndarray:
@@ -135,6 +144,7 @@ class EdgeClassCSR:
         "non_columnar",
         "out_degree_max",
         "in_degree_max",
+        "live",
         "_edge_src",
     )
 
@@ -150,6 +160,9 @@ class EdgeClassCSR:
         self.non_columnar: set = set()
         self.out_degree_max = 0
         self.in_degree_max = 0
+        #: [Ecap] bool liveness when the snapshot carries delta slabs
+        #: (storage/deltas.pad_for_deltas); None on classic snapshots
+        self.live: Optional[np.ndarray] = None
 
     @property
     def num_edges(self) -> int:
@@ -203,6 +216,47 @@ class GraphSnapshot:
         #: optional jax.sharding.Mesh — set via attach, consumed by
         #: DeviceGraph to lay adjacency out shard-wise (parallel/mesh_graph)
         self._mesh = None
+        #: delta-slab overlay (storage/deltas.SnapshotOverlay) when the
+        #: snapshot is maintained incrementally; None = classic immutable
+        self._overlay = None
+        #: in-flight dispatch refcount: release_device defers the buffer
+        #: free until the last dispatch admitted on this snapshot drains
+        #: (epoch-gated dispatch — a compaction swap must never
+        #: use-after-free a buffer an executable still reads)
+        self._rc_lock = threading.Lock()
+        self._inflight = 0
+        self._release_pending = False
+
+    def retain(self) -> "GraphSnapshot":
+        """Pin the device buffers for an in-flight dispatch."""
+        with self._rc_lock:
+            self._inflight += 1
+        return self
+
+    def try_retain(self, dg) -> bool:
+        """Pin for a dispatch of a plan built against DeviceGraph
+        ``dg``, refusing when ``dg`` is no longer this snapshot's
+        canonical device cache — a compaction swap freed its buffers
+        between plan resolution and the pin (retain() alone cannot
+        tell: it would pin a corpse and the dispatch would read deleted
+        arrays). Once this succeeds, inflight > 0 keeps the buffers
+        alive until the matching release()."""
+        with self._rc_lock:
+            if self._device_cache is not dg:
+                return False
+            self._inflight += 1
+        return True
+
+    def release(self) -> None:
+        """Drop one dispatch pin; performs a deferred buffer free when
+        this was the last in-flight dispatch after a release_device."""
+        with self._rc_lock:
+            self._inflight = max(0, self._inflight - 1)
+            run_free = self._release_pending and self._inflight == 0
+            if run_free:
+                self._release_pending = False
+        if run_free:
+            self._free_device()
 
     def release_device(self) -> None:
         """Free every HBM buffer this snapshot pinned: device arrays are
@@ -211,9 +265,24 @@ class GraphSnapshot:
         the plan cache goes with them (its executables captured the
         arrays). The host-side snapshot survives; the next device use
         re-uploads. Multi-graph workloads (the bench's block sequence)
-        need this — 16 GB of HBM cannot hold every graph at once."""
-        dg = self._device_cache
-        self._device_cache = None
+        need this — 16 GB of HBM cannot hold every graph at once.
+
+        With in-flight dispatches retained on this snapshot the free is
+        DEFERRED to the last ``release()`` — dispatches admitted on
+        epoch N complete on epoch N's buffers."""
+        self._free_device()
+
+    def _free_device(self) -> None:
+        # decide AND detach in one lock acquisition: a try_retain landing
+        # between a caller's inflight check and this free would otherwise
+        # pin buffers we are about to delete (the pinned dispatch's final
+        # release() re-enters here once the deferral flag is set)
+        with self._rc_lock:
+            if self._inflight > 0:
+                self._release_pending = True
+                return
+            dg = self._device_cache
+            self._device_cache = None
         if dg is not None:
             # mutate the CANONICAL store: `dg.arrays = {}` would only
             # install a thread-local override (the jit-trace swap
@@ -234,7 +303,11 @@ class GraphSnapshot:
     def vertex_hull(self, name: str) -> tuple:
         """(start, end) dense-index hull of a class's polymorphic closure.
         The hull may include foreign-class vertices (subclass slabs are
-        not necessarily adjacent), so callers keep their class masks."""
+        not necessarily adjacent), so callers keep their class masks.
+
+        On delta-maintained snapshots (``_overlay``) inserted vertices
+        land in the append slab OUTSIDE every base hull — root scans add
+        :meth:`slab_vertex_range` as a second segment."""
         lo, hi = None, None
         for cid in self.class_closure.get(name.lower(), ()):
             rng = self.class_vertex_range.get(self.class_names[cid].lower())
@@ -245,6 +318,16 @@ class GraphSnapshot:
         if lo is None:
             return (0, 0)
         return (lo, hi)
+
+    def slab_vertex_range(self) -> tuple:
+        """(start, end) of the vertex append slab — ``(0, 0)`` on
+        classic snapshots. Root scans on armed snapshots cover it in
+        addition to the class hull (class masks stay exact, so the cost
+        is bounded by ``delta_slab_vertex_rows`` extra scan slots)."""
+        ov = self._overlay
+        if ov is None:
+            return (0, 0)
+        return (ov.base_vertices, ov.cap_vertices)
 
     def rid_of(self, idx: int) -> RID:
         return RID(int(self.v_cluster[idx]), int(self.v_position[idx]))

@@ -253,6 +253,32 @@ class GlobalConfiguration:
     slo_availability: float = 0.99
     slo_max_burn: float = 1.0
 
+    # Incremental HBM snapshot maintenance (storage/deltas): a
+    # delta-maintained snapshot pre-allocates this many spare vertex
+    # rows and per-edge-class spare edge slots; committed writes apply
+    # as device-side scatter patches into them instead of detaching the
+    # snapshot. When the fullest slab (or the tombstone fraction)
+    # crosses delta_compact_ratio, the maintainer folds the slabs back
+    # into a clean CSR (epoch compaction, storage/epochs idiom).
+    delta_slab_vertex_rows: int = 1024
+    delta_slab_edge_slots: int = 4096
+    delta_compact_ratio: float = 0.75
+
+    # Materialized continuous MATCH views (exec/views): results of hot
+    # fingerprints (>= view_min_calls recorded calls in the stats
+    # table) are kept resident and served at cache speed, invalidated
+    # CDC-EXACTLY — only events touching a view's class footprint kill
+    # it, so unrelated writes never cost a recompute (unlike the
+    # epoch-keyed command cache). view_cache_size bounds entries per
+    # database; 0 disables the plane.
+    view_min_calls: int = 8
+    view_cache_size: int = 64
+
+    # Alert threshold (obs/alerts delta_slab_pressure): fires when the
+    # snapshot.delta.slab_fill gauge crosses this fraction — deltas are
+    # outpacing compaction.
+    alert_slab_fill: float = 0.9
+
     # WAL / durability for the host record store
     # (orientdb_tpu.storage.durability): when wal_enabled and wal_dir are
     # set, server-created databases recover-or-create durably under

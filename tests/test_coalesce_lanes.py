@@ -690,3 +690,70 @@ class TestHttpLaneRoute:
             )
         finally:
             srv.shutdown()
+
+
+class TestLaneEpochKeying:
+    """Write-epoch correctness: a lane window formed pre-write must not
+    serve post-write queries stale results (ISSUE 15 satellite)."""
+
+    def test_items_carry_their_admission_epoch(self):
+        db = make_graph("lanes_epoch0", n=10)
+        attach_fresh_snapshot(db)
+        co = QueryCoalescer()
+        try:
+            co.submit(db, COUNT_SQL, None)
+            e0 = db.mutation_epoch
+            db.new_vertex("P", n=99)
+            assert db.mutation_epoch > e0
+            # the NEXT submit stamps the post-write epoch; the lane
+            # dispatch refuses any snapshot that does not cover it
+            # (tpu_engine.dispatch_lane min_epoch gate)
+            rows, _ = co.submit(db, COUNT_SQL, None)
+            oracle = db.query(COUNT_SQL, engine="oracle").to_dicts()
+            assert rows == oracle
+        finally:
+            co.stop()
+
+    def test_lane_never_serves_post_write_queries_stale_results(self):
+        """Interleave writes with coalesced reads on a delta-maintained
+        snapshot: every read admitted after a write reflects it — the
+        epoch-keyed lane either catches the snapshot up (delta apply)
+        or routes to the generic path, never a stale replay."""
+        from orientdb_tpu.storage.deltas import arm_delta_maintenance
+
+        db = make_graph("lanes_epoch1", n=30)
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        co = QueryCoalescer()
+        try:
+            rows, _ = co.submit(db, COUNT_SQL, None)
+            anchors = [d for d in db.browse_class("P")][:5]
+            for k in range(4):
+                w = db.new_vertex("P", n=5 + k)  # n<40: a result row
+                db.new_edge("K", anchors[k], w)
+                rows, _ = co.submit(db, COUNT_SQL, None)
+                oracle = db.query(COUNT_SQL, engine="oracle").to_dicts()
+                assert rows == oracle, (
+                    f"stale lane result after write {k}: "
+                    f"{rows} vs {oracle}"
+                )
+        finally:
+            co.stop()
+
+    def test_dispatch_lane_min_epoch_gate(self, snap_db):
+        from orientdb_tpu.exec import tpu_engine
+        from orientdb_tpu.exec.engine import parse_cached
+
+        db = snap_db
+        db.query(COUNT_SQL, engine="tpu", strict=True)
+        tpu_engine.drain_warmups()
+        items = [(parse_cached(COUNT_SQL), {})]
+        h = tpu_engine.dispatch_lane(db, items, min_epoch=db.mutation_epoch)
+        if h is not None:
+            h.collect()
+        # an admission epoch beyond the snapshot's coverage must refuse
+        assert (
+            tpu_engine.dispatch_lane(
+                db, items, min_epoch=db.mutation_epoch + 1
+            )
+            is None
+        )

@@ -1,0 +1,582 @@
+"""Incremental HBM snapshot maintenance (storage/deltas) + materialized
+views (exec/views): delta application parity, epoch-gated dispatch,
+compaction, poison/degrade paths, CDC-exact view invalidation."""
+
+import threading
+
+import pytest
+
+from orientdb_tpu.exec import tpu_engine
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.storage.deltas import arm_delta_maintenance
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.metrics import metrics
+
+
+def canon(rows):
+    return sorted(str(sorted(r.items())) for r in rows)
+
+
+def build_db(n=12):
+    db = Database("deltas")
+    vs = [
+        db.new_vertex("Person", name=f"p{i}", age=20 + i) for i in range(n)
+    ]
+    for i in range(n - 1):
+        db.new_edge("Knows", vs[i], vs[i + 1])
+    # a second edge class so class-filtered hops are exercised
+    for i in range(0, n - 2, 3):
+        db.new_edge("Likes", vs[i], vs[i + 2])
+    return db, vs
+
+
+ROWS_Q = (
+    "MATCH {class:Person, as:p, where:(age > 21)}-Knows->{as:q} "
+    "RETURN p.name AS p, q.name AS q"
+)
+COUNT_Q = (
+    "MATCH {class:Person, as:p, where:(age > 21)}-Knows->{as:q} "
+    "RETURN count(*) AS n"
+)
+VAR_Q = (
+    "MATCH {class:Person, as:p, where:(age = 20)}"
+    "-Knows->{as:f, while:($depth < 4)} RETURN count(*) AS n"
+)
+TRAV_Q = (
+    "TRAVERSE out('Knows') FROM (SELECT FROM Person WHERE age < 23) "
+    "WHILE $depth < 3 STRATEGY BREADTH_FIRST"
+)
+SEL_Q = "SELECT count(*) AS n FROM Person WHERE age > 21 AND age < 40"
+
+
+def assert_parity(db, queries=(ROWS_Q, COUNT_Q, VAR_Q, TRAV_Q, SEL_Q)):
+    for q in queries:
+        t = db.query(q, engine="tpu", strict=True).to_dicts()
+        o = db.query(q, engine="oracle").to_dicts()
+        assert canon(t) == canon(o), f"parity broke for {q}: {t} vs {o}"
+
+
+class TestDeltaParity:
+    def test_insert_update_delete_parity(self):
+        db, vs = build_db()
+        m = arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        assert_parity(db)
+        # inserts: vertex + edges in both classes
+        w = db.new_vertex("Person", name="w", age=30)
+        db.new_edge("Knows", vs[3], w)
+        db.new_edge("Likes", w, vs[0])
+        assert db.snapshot_is_stale
+        assert_parity(db)
+        assert not db.snapshot_is_stale  # the query caught up
+        # update flips predicate membership both ways
+        vs[2].set("age", 99)
+        db.save(vs[2])
+        vs[8].set("age", 5)
+        db.save(vs[8])
+        assert_parity(db)
+        # delete cascades incident edges
+        db.delete(vs[5])
+        assert_parity(db)
+        assert m.compactions == 0  # all applied as deltas
+        st = m.stats()["overlay"]
+        assert st["topology_dirty"] and st["poisoned"] is None
+
+    def test_no_reupload_same_device_graph(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        snap = db.current_snapshot()
+        dg = snap._device_cache
+        assert dg is not None
+        before = metrics.snapshot()["counters"].get(
+            "snapshot.delta.upload_bytes", 0
+        )
+        w = db.new_vertex("Person", name="nr", age=44)
+        db.new_edge("Knows", vs[0], w)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        # same snapshot, same DeviceGraph — no detach, no re-upload
+        assert db.current_snapshot() is snap
+        assert snap._device_cache is dg
+        uploaded = (
+            metrics.snapshot()["counters"].get(
+                "snapshot.delta.upload_bytes", 0
+            )
+            - before
+        )
+        assert 0 < uploaded < 4096, uploaded  # delta-sized, not graph-sized
+
+    def test_new_string_equality_and_ordered_fallback(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(ROWS_Q, engine="tpu", strict=True)
+        # 'aaa-new' sorts FIRST but appends LAST: the dictionary's code
+        # order is no longer lexicographic after this insert
+        db.new_vertex("Person", name="aaa-new", age=1)
+        eq = "MATCH {class:Person, as:p, where:(name = 'aaa-new')} RETURN p.age AS a"
+        assert db.query(eq, engine="tpu", strict=True).to_dicts() == [
+            {"a": 1}
+        ]
+        # ordered compare on the now-unsorted dictionary refuses to
+        # compile (bisect would place the appended code wrong)
+        rng = "MATCH {class:Person, as:p, where:(name < 'bbb')} RETURN p.age AS a"
+        with pytest.raises(tpu_engine.Uncompilable):
+            db.query(rng, engine="tpu", strict=True)
+        # ...and the auto engine serves it via the oracle, correctly
+        assert db.query(rng).to_dicts() == [{"a": 1}]
+
+    def test_slab_overflow_compacts_and_recovers(self):
+        db, vs = build_db()
+        m = arm_delta_maintenance(db, spare_vertices=4, spare_edges=4)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        for i in range(8):
+            w = db.new_vertex("Person", name=f"of{i}", age=50)
+            db.new_edge("Knows", vs[i], w)
+        assert_parity(db, queries=(ROWS_Q, COUNT_Q))
+        assert m.compactions >= 1
+        assert m.stats()["overlay"]["poisoned"] is None
+
+    def test_unknown_property_poisons_then_compaction_restores(self):
+        db, vs = build_db()
+        m = arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        # a NEW scalar property would silently miss device predicates:
+        # must poison, fall back, and compact on the next catch-up
+        db.new_vertex("Person", name="np", age=33, brandnew=7)
+        rs = db.query(COUNT_Q)  # auto engine: never wrong, maybe oracle
+        o = db.query(COUNT_Q, engine="oracle").to_dicts()
+        assert rs.to_dicts() == o
+        # compaction happened (poison -> rebuild) and tpu serves again
+        assert m.compactions >= 1
+        assert db.query(COUNT_Q, engine="tpu", strict=True).to_dicts() == o
+        new_q = (
+            "MATCH {class:Person, as:p, where:(brandnew = 7)} "
+            "RETURN p.name AS n"
+        )
+        assert db.query(new_q, engine="tpu", strict=True).to_dicts() == [
+            {"n": "np"}
+        ]
+
+
+class TestEpochGatedDispatch:
+    def test_inflight_dispatch_survives_compaction_swap(self):
+        """A dispatch admitted on epoch N completes on epoch N's
+        buffers while a delta lands and compaction swaps in N+1 — no
+        use-after-free of the old device arrays."""
+        db, vs = build_db()
+        m = arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        old = db.current_snapshot()
+        dg = old._device_cache
+        old.retain()  # the in-flight dispatch's lease
+        try:
+            w = db.new_vertex("Person", name="sw", age=25)
+            db.new_edge("Knows", vs[0], w)
+            m.compact("test swap")
+            assert db.current_snapshot() is not old
+            # old buffers still resident for the in-flight dispatch
+            assert old._device_cache is dg
+            assert dg._arrays.get("v_class") is not None
+            import jax.numpy as jnp
+
+            assert int(jnp.sum(dg._arrays["v_class"] >= 0)) > 0
+        finally:
+            old.release()
+        # the deferred free ran on the last release
+        assert old._device_cache is None
+        # and the new snapshot answers correctly
+        assert_parity(db, queries=(ROWS_Q, COUNT_Q))
+
+    def test_concurrent_reads_and_writes_no_torn_results(self):
+        db, vs = build_db(16)
+        arm_delta_maintenance(db, spare_vertices=256, spare_edges=256)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        errors = []
+        stop = threading.Event()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    rows = db.query(COUNT_Q, engine="tpu", strict=True)
+                    n = rows.to_dicts()[0]["n"]
+                    assert n >= 0
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(24):
+                w = db.new_vertex("Person", name=f"c{i}", age=40)
+                db.new_edge("Knows", vs[i % len(vs)], w)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        assert not errors, errors
+        assert_parity(db, queries=(ROWS_Q, COUNT_Q))
+
+    def test_detach_defers_free_under_retain(self):
+        db, _ = build_db()
+        arm_delta_maintenance(db, spare_vertices=8, spare_edges=8)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        snap = db.current_snapshot()
+        dg = snap._device_cache
+        snap.retain()
+        db.detach_snapshot()
+        assert snap._device_cache is dg  # free deferred
+        snap.release()
+        assert snap._device_cache is None  # freed on last release
+
+
+class TestCdcExactViews:
+    def _hot(self, db, sql, times=None):
+        times = times or (config.view_min_calls + 1)
+        for _ in range(times):
+            rows = db.query(sql).to_dicts()
+        return rows
+
+    def test_view_survives_unrelated_write(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        rows = self._hot(db, COUNT_Q)
+        before = metrics.snapshot()["counters"].get("views.hit", 0)
+        assert db.query(COUNT_Q).to_dicts() == rows  # served by the view
+        assert (
+            metrics.snapshot()["counters"].get("views.hit", 0) > before
+        )
+        # UNRELATED write: a plain-document class nowhere in the footprint
+        db.new_element("AuditLog", what="unrelated")
+        after_write_hits = metrics.snapshot()["counters"].get(
+            "views.hit", 0
+        )
+        assert db.query(COUNT_Q).to_dicts() == rows
+        assert (
+            metrics.snapshot()["counters"].get("views.hit", 0)
+            > after_write_hits
+        ), "unrelated write must NOT invalidate the view"
+
+    def test_view_invalidated_by_footprint_write(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        self._hot(db, ROWS_Q)
+        assert db.query(ROWS_Q).to_dicts() is not None
+        w = db.new_vertex("Person", name="vf", age=50)
+        db.new_edge("Knows", vs[3], w)
+        # the footprinted write killed the view: result reflects it
+        t = db.query(ROWS_Q).to_dicts()
+        o = db.query(ROWS_Q, engine="oracle").to_dicts()
+        assert canon(t) == canon(o)
+        assert any(r.get("q") == "vf" or r.get("p") == "vf" for r in t)
+
+    def test_count_view_incremental_maintenance(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        q = "MATCH {class:Person, as:p, where:(age > 25)} RETURN count(*) AS n"
+        rows = self._hot(db, q)
+        n0 = rows[0]["n"]
+        inc_before = metrics.snapshot()["counters"].get(
+            "views.incremental", 0
+        )
+        db.new_vertex("Person", name="iv1", age=90)  # matches WHERE
+        assert db.query(q).to_dicts() == [{"n": n0 + 1}]
+        db.new_vertex("Person", name="iv2", age=10)  # does NOT match
+        assert db.query(q).to_dicts() == [{"n": n0 + 1}]
+        assert (
+            metrics.snapshot()["counters"].get("views.incremental", 0)
+            > inc_before
+        )
+        # oracle agrees with the incrementally maintained number
+        assert db.query(q, engine="oracle").to_dicts() == [{"n": n0 + 1}]
+
+
+class TestLaneEpochKeying:
+    def test_dispatch_lane_refuses_uncovered_epoch(self):
+        from orientdb_tpu.exec.engine import parse_cached
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+        db, vs = build_db()
+        attach_fresh_snapshot(db)
+        stmt = parse_cached(COUNT_Q)
+        # record + cache the plan
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        tpu_engine.drain_warmups()
+        items = [(stmt, {})]
+        # covered epoch: the lane path accepts
+        ok = tpu_engine.dispatch_lane(
+            db, items, min_epoch=db.mutation_epoch
+        )
+        if ok is not None:
+            ok.collect()
+        # an admission epoch the snapshot does not cover must refuse
+        assert (
+            tpu_engine.dispatch_lane(
+                db, items, min_epoch=db.mutation_epoch + 1
+            )
+            is None
+        )
+
+    def test_coalesced_query_sees_preceding_write(self):
+        """End to end: a query submitted AFTER a write (through the
+        coalescer's lanes) reflects that write — the lane cannot serve
+        post-write queries pre-write results."""
+        from orientdb_tpu.server.coalesce import QueryCoalescer
+
+        db, vs = build_db()
+        m = arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        coal = QueryCoalescer()
+        try:
+            rows0, _ = coal.submit(db, COUNT_Q, None)
+            n0 = rows0[0]["n"]
+            for k in range(3):
+                w = db.new_vertex("Person", name=f"lw{k}", age=50)
+                # vs[5] has age 25 > 21: the new edge IS a result row
+                db.new_edge("Knows", vs[5], w)
+                rows, _eng = coal.submit(db, COUNT_Q, None)
+                o = db.query(COUNT_Q, engine="oracle").to_dicts()
+                assert rows == o, (rows, o)
+            assert rows[0]["n"] == n0 + 3
+            assert m.stats()["overlay"]["poisoned"] is None
+        finally:
+            coal.stop()
+
+
+class TestSameBatchDeltas:
+    """Multiple events touching one device cell inside ONE poll batch:
+    the patch set keeps the last write per (array, index) in its final
+    phase — duplicate scatter indices would apply in unspecified order,
+    and a create's LIVE-phase liveness would land after a same-batch
+    delete's DEAD-phase tombstone (resurrection)."""
+
+    def test_same_batch_create_then_delete_no_resurrection(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(ROWS_Q, engine="tpu", strict=True)
+        # no query between the writes: both events land in one batch
+        g = db.new_vertex("Person", name="ghost", age=50)
+        db.new_edge("Knows", vs[3], g)
+        db.delete(g)  # cascades the edge
+        assert_parity(db, queries=(ROWS_Q, COUNT_Q))
+        t = db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        assert not any("ghost" in (r.get("p"), r.get("q")) for r in t)
+
+    def test_same_batch_double_update_last_value_wins(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        q = "MATCH {class:Person, as:p, where:(age = 77)} RETURN p.name AS p"
+        db.query(q, engine="tpu", strict=True)
+        vs[4].set("age", 77)
+        db.save(vs[4])
+        vs[4].set("age", 78)  # same cell, same batch: 78 must win
+        db.save(vs[4])
+        assert db.query(q, engine="tpu", strict=True).to_dicts() == []
+        q78 = "MATCH {class:Person, as:p, where:(age = 78)} RETURN p.name AS p"
+        assert db.query(q78, engine="tpu", strict=True).to_dicts() == [
+            {"p": "p4"}
+        ]
+
+    def test_same_batch_edge_create_then_delete(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(ROWS_Q, engine="tpu", strict=True)
+        e = db.new_edge("Knows", vs[9], vs[1])
+        db.delete(e)
+        assert_parity(db, queries=(ROWS_Q, COUNT_Q))
+
+
+class TestDispatchRaces:
+    def test_try_retain_refuses_freed_device_graph(self):
+        """A compaction swap freeing a plan's buffers between plan
+        resolution and the lease pin must refuse the pin (retain alone
+        would pin a corpse and dispatch into deleted arrays) — and the
+        engine re-resolves against the revived snapshot."""
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        snap = db.current_snapshot()
+        dg = snap._device_cache
+        assert snap.try_retain(dg)
+        snap.release()
+        snap.release_device()  # no dispatches in flight: frees now
+        assert not snap.try_retain(dg)  # stale DeviceGraph refused
+        # end to end: the engine recovers by re-recording (revival)
+        assert_parity(db, queries=(COUNT_Q,))
+
+    def test_view_admission_refuses_raced_write(self):
+        """A write committing between a query's run and its view
+        admission fires its CDC callback before the view exists — the
+        stale rows must not be admitted (nothing would ever invalidate
+        them)."""
+        from orientdb_tpu.exec.views import views_for
+
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        vm = views_for(db)
+        for _ in range(config.view_min_calls + 1):
+            rows = db.query(COUNT_Q, engine="oracle").to_dicts()
+        epoch = db.mutation_epoch
+        db.new_vertex("Person", name="raced", age=99)  # the raced write
+        before = metrics.snapshot()["counters"].get(
+            "views.admission_raced", 0
+        )
+        vm.observe(COUNT_Q, None, None, False, rows, "oracle", epoch=epoch)
+        assert (
+            metrics.snapshot()["counters"].get("views.admission_raced", 0)
+            == before + 1
+        )
+        assert vm.lookup(COUNT_Q, None, None, False) is None
+
+    def test_cdc_gap_compacts_instead_of_crashing(self):
+        """A gapped changefeed (shed window rolled over) must degrade
+        to compaction — the rebuild reads the host store — not raise
+        CdcGapError into arbitrary querying threads."""
+        from orientdb_tpu.cdc.feed import CdcGapError
+
+        db, vs = build_db()
+        m = arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(COUNT_Q, engine="tpu", strict=True)
+        w = db.new_vertex("Person", name="gap", age=50)
+        db.new_edge("Knows", vs[0], w)
+        real_poll = m._consumer.poll
+        state = {"raised": False}
+
+        def gapped_poll(*a, **kw):
+            if not state["raised"]:
+                state["raised"] = True
+                raise CdcGapError("ring rolled over")
+            return real_poll(*a, **kw)
+
+        m._consumer.poll = gapped_poll
+        try:
+            assert_parity(db, queries=(ROWS_Q, COUNT_Q))
+        finally:
+            c = m._consumer
+            if c is not None and c.poll is gapped_poll:
+                c.poll = real_poll
+        assert m.compactions >= 1
+        assert m.stats()["overlay"]["poisoned"] is None
+
+
+class TestBulkBypass:
+    def test_bulk_flush_poisons_and_rebuilds(self):
+        """BulkLoader on a WAL-less db bumps mutation_epoch with no WAL
+        entry and no hooks — nothing reaches the changefeed. The
+        maintained snapshot must rebuild (poison → compact), never
+        stamp itself fresh against the empty queue and silently serve
+        results missing the whole load; admitted views must drop too."""
+        from orientdb_tpu.storage.bulk import BulkLoader
+
+        db, vs = build_db()
+        m = arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        # hot view admitted pre-load: it must not survive the bypass
+        q = "MATCH {class:Person, as:p, where:(age > 25)} RETURN count(*) AS n"
+        for _ in range(config.view_min_calls + 1):
+            db.query(q).to_dicts()
+        assert_parity(db)
+        with BulkLoader(db) as bl:
+            nv = [
+                bl.add_vertex("Person", name=f"b{i}", age=40 + i)
+                for i in range(5)
+            ]
+            for i in range(4):
+                bl.add_edge("Knows", nv[i], nv[i + 1])
+        assert db.snapshot_is_stale
+        assert_parity(db)
+        assert m.compactions >= 1, "bypassed flush must force a rebuild"
+        # the count view reflects the 5 bulk-loaded matching vertices
+        o = db.query(q, engine="oracle").to_dicts()
+        assert db.query(q).to_dicts() == o
+
+    def test_concurrent_admission_registers_one_cdc_consumer(self):
+        """Two threads racing the first view admission must end with
+        ONE feed consumer — a second registration would deliver every
+        write twice and double count-view adjustments."""
+        import time
+
+        from orientdb_tpu.cdc.feed import feed_of
+        from orientdb_tpu.exec.views import views_for
+
+        db, _ = build_db()
+        vm = views_for(db)
+        fd = feed_of(db, create=True)
+        real_register = fd.register
+        calls = []
+
+        def slow_register(*a, **kw):
+            calls.append(1)
+            time.sleep(0.05)  # widen the check-then-register window
+            return real_register(*a, **kw)
+
+        fd.register = slow_register
+        try:
+            ts = [
+                threading.Thread(target=vm._ensure_consumer)
+                for _ in range(4)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            del fd.__dict__["register"]
+        assert len(calls) == 1
+        assert vm._consumer_token is not None
+
+
+class TestLeaseRaceAndWhereFootprint:
+    def test_free_device_defers_when_pinned_mid_decision(self):
+        """A try_retain can land between release_device's inflight
+        check and the actual free — _free_device must re-check under
+        the refcount lock and defer, never delete pinned buffers."""
+        from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+
+        db, _ = build_db()
+        attach_fresh_snapshot(db)
+        db.query(COUNT_Q, engine="tpu", strict=True)  # device cache live
+        snap = db.current_snapshot()
+        assert snap._device_cache is not None
+        # simulate the TOCTOU winner: a dispatch pinned after the
+        # caller's inflight check but before the free body ran
+        snap._inflight = 1
+        snap._free_device()
+        assert snap._device_cache is not None, "freed under a live pin"
+        assert snap._release_pending
+        snap.release()  # last pin drains: NOW the deferred free runs
+        assert snap._device_cache is None
+
+    def test_non_local_where_refuses_view_admission(self):
+        """A WHERE hopping through graph functions or link dereference
+        reads classes outside the pattern footprint — no write to them
+        would ever invalidate the view, so admission must refuse."""
+        from orientdb_tpu.exec.engine import parse_cached
+        from orientdb_tpu.exec.views import _statement_classes
+
+        db, vs = build_db()
+        names, _ = _statement_classes(db, parse_cached(COUNT_Q))
+        assert names  # plain local WHERE still admits
+        graph_q = (
+            "MATCH {class:Person, as:p, where:(out('Likes').size() > 0)} "
+            "RETURN count(*) AS n"
+        )
+        deref_q = (
+            "MATCH {class:Person, as:p, where:(friend.name = 'x')} "
+            "RETURN count(*) AS n"
+        )
+        for bad in (graph_q, deref_q):
+            assert _statement_classes(db, parse_cached(bad)) == (
+                None,
+                False,
+            ), f"non-local WHERE admitted: {bad}"
+        # end-to-end: hot the graph-function query; no view materializes
+        # and a Likes edge write is reflected immediately
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        before = metrics.snapshot()["counters"].get("views.materialized", 0)
+        for _ in range(config.view_min_calls + 2):
+            rows = db.query(graph_q).to_dicts()
+        assert (
+            metrics.snapshot()["counters"].get("views.materialized", 0)
+            == before
+        )
+        n0 = rows[0]["n"]
+        db.new_edge("Likes", vs[11], vs[0])  # vs[11] had no out-Likes
+        assert db.query(graph_q).to_dicts() == [{"n": n0 + 1}]
