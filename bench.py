@@ -30,13 +30,27 @@ are jit arguments of one cached parameter-generic plan
 (predicates.ParamBox), so each batch measures plan replay across many
 parameter values, not compilation.
 
-Env knobs: BENCH_PROFILES (default 20000), BENCH_AVG_FRIENDS (10),
+The run is TIERED: the demodb headline trio (parity gate, single
+2-hop, batched 2-hop) runs FIRST on a graph of BENCH_HEADLINE_PROFILES
+(default min(BENCH_PROFILES, 8000)) so a non-zero headline + an early
+perfdiff verdict hit disk within ~60 s of a cold start; the evidence
+blocks (static analysis, watchdog, SLO sim, read/write deltas), the
+remaining lane blocks, and the heavy subprocess blocks (sf100, skew,
+tiered, mesh scaling) are all budget-gated BEHIND it. The final
+perfdiff verdict vs the last good round is a HARD gate (rc 2 on
+regression) unless the run was budget-truncated.
+
+Env knobs: BENCH_PROFILES (default 20000), BENCH_HEADLINE_PROFILES
+(min(BENCH_PROFILES, 8000) — the demodb scale the headline tier and
+the lane blocks measure at), BENCH_AVG_FRIENDS (10),
 BENCH_BATCH (64), BENCH_ITERS (3 batched iterations), BENCH_SINGLE_ITERS
 (10), BENCH_ORACLE_ITERS (1 — the oracle takes ~13 s per 2-hop query at
 the default size), BENCH_SNB_PERSONS (default 10000; 0 skips the IS and
 IC sections), BENCH_SF10_PERSONS (100000; 0 skips), BENCH_SF100_PERSONS
 (8000000 — the array-native SF100-shaped graph; 0 skips),
-BENCH_SKEW_PERSONS (1000000; 0 skips), BENCH_MESH_SCALING (1; 0 skips
+BENCH_SKEW_PERSONS (1000000; 0 skips), BENCH_TIERED (1; 0 skips the
+tiered-snapshot subprocess block), BENCH_TIERED_PROFILES (30000 — the
+demodb scale the tiered block pages at), BENCH_MESH_SCALING (1; 0 skips
 the per-shard-count subprocess probes), BENCH_SF100_SHARDED_PERSONS
 (1000000; 0 skips the 8-virtual-device sharded config-5 sub-block — one
 CPU core executes all 8 devices, so the default adds several minutes),
@@ -99,6 +113,10 @@ def compact_line(
         # a partial-failure run carries its diagnosis on the line (the
         # headline guard in main() sets it); absent on clean runs
         **({"error": str(out["error"])[:300]} if "error" in out else {}),
+        # "warming"/"truncated" hoisted to the TOP level: perfdiff and
+        # the harness must never mistake a not-yet-measured 0.0 for a
+        # measured 0 q/s round, even if extras get slimmed away below
+        **({"status": ex["status"]} if "status" in ex else {}),
         "extras": {
             "detail_file": detail_name,
             **_slim(
@@ -116,6 +134,10 @@ def compact_line(
                     "traverse_bfs_batched_qps",
                     "select_count_batched_qps",
                     "ldbc_is",
+                    # the round-over-round verdict rides the LINE (the
+                    # acceptance criterion: a measured number WITH a
+                    # perfdiff verdict, even on a truncated round)
+                    "perfdiff",
                 ),
             ),
             # the SLO verdict + burn from the mixed-traffic block
@@ -404,6 +426,112 @@ def bench_skew_block(batch: int, iters: int, reps: int) -> dict:
         sdb.detach_snapshot()
         del sdb, ssnap
     return skew
+
+
+def bench_tiered_block(batch: int, iters: int, reps: int) -> dict:
+    """The tiered-snapshot block in its own process (see
+    bench_sf100_block for why): the SAME demodb shape measured twice —
+    fully resident, then re-attached with ``tier_hbm_cap_bytes`` at
+    HALF the flat adjacency bytes, so the hot/cold plane must page.
+    The queries are uid-parametrized 1-hop counts whose roots rotate
+    inside a uid WINDOW of ~1/4 of the graph: each query's working set
+    is one or two blocks, the window's block set fits the hot tier, so
+    the warm phase faults + evicts its way to a stable hot set and the
+    timed phase measures SERVING against cold-capable plans (a 2-hop
+    frontier on this random graph spans every block — per-query
+    working set == whole graph — and a whole-class root would just
+    grow the pool to the full partition; neither ever pages). Both
+    passes time the same sequential single-dispatch loop (tiered plans
+    are not batchable, so a vmapped-lane denominator would measure the
+    batching machinery, not the tier). The bar: tiered q/s >= 0.5x
+    resident at zero parity loss."""
+    from orientdb_tpu.exec.tpu_engine import drain_warmups
+    from orientdb_tpu.storage import tiering
+    from orientdb_tpu.storage.ingest import generate_demodb
+    from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+    from orientdb_tpu.utils.config import config
+
+    n = int(os.environ.get("BENCH_TIERED_PROFILES", "30000"))
+    # the timed loop replays a FIXED param rotation: past view_min_calls
+    # the materialized-view plane would serve every repeat from cached
+    # rows and neither pass would touch the engine (ratio 1.0 forever).
+    # Disable admission for the block — both passes measure serving.
+    view_min_calls = config.view_min_calls
+    config.view_min_calls = 1 << 30
+    db = generate_demodb(n_profiles=n, avg_friends=10, seed=11)
+    q = (
+        "MATCH {class:Profiles, as:p, where:(uid = :u)}"
+        "-HasFriend->{as:f, where:(age < 30)} "
+        "RETURN count(*) AS n"
+    )
+    plist = [{"u": (i * 131) % max(1, n // 4)} for i in range(batch)]
+
+    def time_singles():
+        for _ in range(2):  # warm: fault the window in, settle plans
+            for p in plist:
+                db.query(q, params=p, engine="tpu", strict=True)
+            drain_warmups()
+        qpss = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                for p in plist:
+                    db.query(
+                        q, params=p, engine="tpu", strict=True
+                    ).to_dicts()
+            qpss.append(iters * len(plist) / (time.perf_counter() - t0))
+        return round(_median(qpss), 3)
+
+    def parity(tag):
+        for p in (plist[0], plist[len(plist) // 2], plist[-1]):
+            o = db.query(q, params=p, engine="oracle").to_dicts()
+            t = db.query(q, params=p, engine="tpu", strict=True).to_dicts()
+            if o != t:
+                _fatal_parity(f"tiered parity mismatch ({tag}): {p}")
+
+    # pass 1: fully resident — the ratio's denominator
+    snap = attach_fresh_snapshot(db)
+    adj = tiering.adjacency_bytes(snap)
+    parity("resident")
+    res = {"resident_qps": time_singles()}
+    db.detach_snapshot()
+
+    # pass 2: same graph at 2x the cap (cap = adjacency/2 -> the graph
+    # is twice what the hot tier may hold). Blocks sized so each
+    # direction splits ~16 ways — eviction and prefetch both exercise.
+    config.tier_hbm_cap_bytes = adj // 2
+    config.tier_block_edges = max(1024, (n * 10) // 16)
+    try:
+        snap2 = attach_fresh_snapshot(db)
+        if getattr(snap2, "_tier", None) is None:
+            return {
+                "error": "tiered block: snapshot was not admitted "
+                f"(adjacency {adj}B, cap {adj // 2}B)"
+            }
+        parity("tiered")
+        res["tiered_qps"] = time_singles()
+        st = snap2._tier.stats()
+        res.update(
+            profiles=n,
+            adjacency_bytes=int(adj),
+            cap_bytes=int(st["cap_bytes"]),
+            hot_bytes=int(st["hot_bytes"]),
+            partitions=st["partitions"],
+            prefetch_hits=st["prefetch_hits"],
+            prefetch_misses=st["prefetch_misses"],
+            evictions=st["evictions"],
+            thrash=st["thrash"],
+        )
+        if res["resident_qps"]:
+            res["tiered_vs_resident"] = round(
+                res["tiered_qps"] / res["resident_qps"], 3
+            )
+        db.detach_snapshot()
+    finally:
+        config.tier_hbm_cap_bytes = 0
+        config.tier_block_edges = 65536
+        config.view_min_calls = view_min_calls
+    return res
 
 
 def run_tpu_subprocess(block: str, timeout: int) -> dict:
@@ -849,9 +977,13 @@ def _measure() -> None:
     if "--block" in sys.argv:
         i = sys.argv.index("--block") + 1
         kind = sys.argv[i] if i < len(sys.argv) else ""
-        fn = {"sf100": bench_sf100_block, "skew": bench_skew_block}.get(kind)
+        fn = {
+            "sf100": bench_sf100_block,
+            "skew": bench_skew_block,
+            "tiered": bench_tiered_block,
+        }.get(kind)
         if fn is None:
-            print(f"usage: bench.py --block sf100|skew (got {kind!r})",
+            print(f"usage: bench.py --block sf100|skew|tiered (got {kind!r})",
                   file=sys.stderr)
             sys.exit(2)
         print(json.dumps(fn(*_timing_knobs())))
@@ -1003,6 +1135,57 @@ def _measure() -> None:
             return True
         return False
 
+    def run_perfdiff(stage: str):
+        """Round-over-round comparison (tools/perfdiff) vs the last
+        good recorded round, at two points: "headline" right after the
+        headline number lands (so even a run the harness kills early
+        carries a verdict next to a non-zero number), and "final" over
+        the full tree — the HARD gate below reads that one. The final
+        pass skips on a budget-truncated run (missing leaves would
+        read as regressions); perfdiff itself skips leaves absent from
+        the current tree, so the early pass compares only what it has.
+        Returns the report dict, or None when the comparison skipped."""
+        try:
+            base_path = os.environ.get(
+                "BENCH_PERFDIFF_BASE"
+            ) or _last_good_round(detail_dir, round_n)
+            if base_path is None:
+                ev("perfdiff", stage=stage, skipped="no_prior_round")
+                return None
+            if stage == "final" and skipped:
+                ev("perfdiff", stage=stage,
+                   skipped="budget_truncated_run",
+                   base=os.path.basename(base_path))
+                return None
+            from orientdb_tpu.tools.perfdiff import (
+                _load as _pd_load,
+                diff as _pd_diff,
+            )
+
+            _base = _pd_load(base_path)
+            if _base is None:
+                ev("perfdiff", stage=stage, skipped="unreadable_base",
+                   base=os.path.basename(base_path))
+                return None
+            rep = _pd_diff(_base, _compose_out())
+            extras["perfdiff"] = {
+                "base": os.path.basename(base_path),
+                "stage": stage,
+                "verdict": rep["verdict"],
+                "headline_ratio": rep["headline"].get("ratio"),
+                "compared": rep["compared"],
+                "regressions": len(rep["regressions"]),
+            }
+            # the full report nests under one key: its "qps"/"ms"
+            # sub-trees are dicts, and evidence consumers treat a
+            # top-level "qps" field as a scalar block measurement
+            ev("perfdiff", stage=stage,
+               base=os.path.basename(base_path), report=rep)
+            return rep
+        except Exception as e:  # the diff must never cost the headline
+            ev("perfdiff", stage=stage, error=f"{type(e).__name__}: {e}")
+            return None
+
     from contextlib import contextmanager
 
     from orientdb_tpu.obs.trace import span as _bench_span
@@ -1017,6 +1200,16 @@ def _measure() -> None:
         block_trace[tag] = sp.trace_id
 
     n_profiles = int(os.environ.get("BENCH_PROFILES", "20000"))
+    # headline-tier dataset scale: the demodb graph builds at the
+    # SMALLER of BENCH_PROFILES / BENCH_HEADLINE_PROFILES so the
+    # headline trio (parity gate -> single 2-hop -> batched 2-hop lane
+    # block) lands a non-zero measured number inside the first ~60 s
+    # of a cold run — r06 burned its whole budget on evidence blocks
+    # and shipped value 0.0. Every later demodb lane block reuses the
+    # same snapshot and warm plan cache.
+    n_head = int(
+        os.environ.get("BENCH_HEADLINE_PROFILES", str(min(n_profiles, 8000)))
+    )
     avg_friends = int(os.environ.get("BENCH_AVG_FRIENDS", "10"))
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
@@ -1024,127 +1217,35 @@ def _measure() -> None:
     oracle_iters = int(os.environ.get("BENCH_ORACLE_ITERS", "1"))
 
     extras["batch_size"] = batch
-    extras["graph"] = {"profiles": n_profiles, "avg_friends": avg_friends}
+    extras["graph"] = {"profiles": n_head, "avg_friends": avg_friends}
     ev(
         "start",
         round=round_n,
-        profiles=n_profiles,
+        profiles=n_head,
         avg_friends=avg_friends,
         batch=batch,
         iters=iters,
         budget_s=budget_s,
     )
 
-    # static-analysis gate, recorded per round: pass names + finding
-    # counts ride the evidence stream so a regression that slipped past
-    # tier-1 (or a run from a dirtied tree) is visible next to the
-    # numbers it may have tainted
-    if budget_ok("static_analysis", est_s=15):
-        try:
-            from orientdb_tpu.analysis import run as run_analysis
-
-            _rep = run_analysis()
-            extras["static_analysis"] = dict(_rep.counts)
-            # the runtime sanitizer's last tier-1 session dumps its
-            # dynamic lock-order graph + locklint cross-check (analysis/
-            # sanitizer): the dynamic-vs-static coverage ratio rides the
-            # same evidence record as the racelint counts — one place to
-            # watch both halves of race detection regress
-            _san = _read_sanitizer_edges()
-            if _san is not None:
-                extras["static_analysis"]["dyn_edge_coverage"] = (
-                    _san.get("cross_check", {}).get("coverage")
-                )
-            # deviceguard: the jax-boundary twin of the sanitizer dump —
-            # transfers blocked, recompile assertions, and the observed-
-            # vs-jaxlint coverage ratio ride the same evidence record
-            _dg = _read_deviceguard()
-            if _dg is not None:
-                extras["static_analysis"]["deviceguard_coverage"] = (
-                    _dg.get("static_coverage")
-                )
-            ev(
-                "static_analysis",
-                ok=_rep.ok,
-                passes=dict(_rep.counts),
-                findings=len(_rep.findings),
-                suppressed=len(_rep.suppressed),
-                racelint=_rep.counts.get("racelint", 0),
-                jaxlint=_rep.counts.get("jaxlint", 0),
-                sanitizer=_san,
-                deviceguard=_dg,
-            )
-        except Exception as e:
-            # the bench must still measure when the analysis can't run
-            # (e.g. stripped source tree); the failure itself is
-            # evidence
-            ev("static_analysis", error=f"{type(e).__name__}: {e}")
-
-    # health evidence per round (ISSUE 10): one watchdog evaluation
-    # over this process + the engine summary (rules evaluated, alerts
-    # fired/resolved, learned baselines, tick age) rides the evidence
-    # stream next to static_analysis — the perf trajectory carries
-    # health state, not just numbers
-    if budget_ok("watchdog", est_s=5):
-        try:
-            from orientdb_tpu.obs.watchdog import bench_watchdog_summary
-
-            _ws = bench_watchdog_summary()
-            extras["watchdog"] = _ws
-            ev("watchdog", **_ws)
-        except Exception as e:
-            ev("watchdog", error=f"{type(e).__name__}: {e}")
-
-    # mixed production-shaped traffic under chaos, judged by the SLO
-    # plane (ISSUE 11): the closed-loop simulator runs its OWN small
-    # cluster + dataset, so it neither needs nor disturbs the demodb
-    # graph the perf blocks time. Verdict + burn ride the headline
-    # extras; the full machine-readable report is BENCH_SLO_r{N}.json.
-    if os.environ.get("BENCH_SLO", "1") != "0" and budget_ok(
-        "mixed_slo", est_s=60
-    ):
-        with block_span("mixed_slo"):
-            try:
-                _slo = run_mixed_slo_block(round_n, detail_dir)
-                extras["slo"] = _slo
-                # first measured block of the run (it precedes mixed_rw
-                # and parity): a BENCH_RW=0 + budget-starved-parity run
-                # must not publish these numbers under status=warming
-                extras.pop("status", None)
-                ev("mixed_slo", **_slo)
-            except Exception as e:
-                # the traffic sim failing IS evidence, but it must not
-                # cost the perf numbers behind it
-                extras["slo"] = {
-                    "verdict": "error",
-                    "error": f"{type(e).__name__}: {e}"[:300],
-                }
-                ev("mixed_slo", error=f"{type(e).__name__}: {e}")
-
-    # mixed read/write deltas block (ISSUE 15 acceptance): its own
-    # small dataset + delta-maintained snapshot, so it neither needs
-    # nor disturbs the demodb graph the perf blocks time
-    if os.environ.get("BENCH_RW", "1") != "0" and budget_ok(
-        "mixed_rw", est_s=60
-    ):
-        with block_span("mixed_rw"):
-            try:
-                _rw = run_mixed_rw_block()
-                extras["mixed_rw"] = _rw
-                extras.pop("status", None)  # first measured JAX block
-                ev("mixed_rw", **_rw)
-            except Exception as e:
-                extras["mixed_rw"] = {
-                    "error": f"{type(e).__name__}: {e}"[:300]
-                }
-                ev("mixed_rw", error=f"{type(e).__name__}: {e}")
-
+    # ---- HEADLINE TIER (runs FIRST — before any evidence or traffic
+    # block): demodb build at the headline scale, the 5-query parity
+    # gate, the single-2hop and the batched-2hop lane block. The goal
+    # is a non-zero headline + early perfdiff verdict flushed to disk
+    # inside ~60 s of a cold start; everything else is budget-gated
+    # BEHIND it. ----
     db = None
-    if budget_ok("parity", est_s=120):
+    # est reflects the HEADLINE scale (n_head <= 8000: build + attach +
+    # first compiles land in well under 30 s on a cold CPU) — the old
+    # est of 120 s was sized for the full 20 k-profile build and made
+    # any sub-120 s budget skip the entire headline tier while cheaper
+    # blocks behind it still ran, which is exactly the r06 inversion
+    # this tier exists to prevent
+    if budget_ok("parity", est_s=30):
         from orientdb_tpu.storage.ingest import generate_demodb
         from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
 
-        db = generate_demodb(n_profiles=n_profiles, avg_friends=avg_friends)
+        db = generate_demodb(n_profiles=n_head, avg_friends=avg_friends)
         attach_fresh_snapshot(db)
         # the JAX platform is warm and real numbers follow: the
         # "warming" marker has served its purpose
@@ -1289,18 +1390,146 @@ def _measure() -> None:
             block_trace[tag] = sp.trace_id
         return _median(qpss)
 
-    if budget_ok("single_2hop", est_s=20, needs_db=True):
+    if budget_ok("single_2hop", est_s=10, needs_db=True):
         single_qps = time_single(sql, tag="single_2hop")
         extras["single_query_qps"] = round(single_qps, 3)
         ev("single_2hop", qps=round(single_qps, 3),
            split=splits.get("single_2hop"))
-    if budget_ok("batched_2hop", est_s=25, needs_db=True):
+    if budget_ok("batched_2hop", est_s=10, needs_db=True):
         batched_qps = time_batched(sql, tag="batched_2hop")
         # the headline lands in the detail artifact the moment it is
         # measured — a later timeout cannot lose it
         agg["value"] = round(batched_qps, 3)
         ev("batched_2hop", qps=round(batched_qps, 3),
            split=splits.get("batched_2hop"))
+        # the headline number exists: compare + persist NOW. A harness
+        # kill anywhere past this point still leaves a non-zero
+        # BENCH_HEADLINE artifact with a perfdiff verdict on disk (the
+        # final pass at the end of the run overwrites both).
+        run_perfdiff("headline")
+        try:
+            from orientdb_tpu.storage.durability import atomic_write as _aw
+
+            _aw(
+                os.path.join(
+                    detail_dir, f"BENCH_HEADLINE_r{round_n:02d}.json"
+                ),
+                (compact_line(_compose_out(), detail_name=detail_name)
+                 + "\n").encode(),
+            )
+        except Exception as e:  # best-effort; the final line still prints
+            print(f"early headline write failed: {e}", file=sys.stderr)
+
+    # ---- evidence + traffic tier (budget-gated BEHIND the headline):
+    # static analysis, watchdog health, the SLO'd chaos sim and the
+    # read/write deltas block. None of them touch the demodb graph
+    # above, but all of them used to run BEFORE it and could eat the
+    # whole budget cold (r06: value 0.0 with a full evidence stream).
+    # ----
+    # static-analysis gate, recorded per round: pass names + finding
+    # counts ride the evidence stream so a regression that slipped past
+    # tier-1 (or a run from a dirtied tree) is visible next to the
+    # numbers it may have tainted
+    if budget_ok("static_analysis", est_s=15):
+        try:
+            from orientdb_tpu.analysis import run as run_analysis
+
+            _rep = run_analysis()
+            extras["static_analysis"] = dict(_rep.counts)
+            # the runtime sanitizer's last tier-1 session dumps its
+            # dynamic lock-order graph + locklint cross-check (analysis/
+            # sanitizer): the dynamic-vs-static coverage ratio rides the
+            # same evidence record as the racelint counts — one place to
+            # watch both halves of race detection regress
+            _san = _read_sanitizer_edges()
+            if _san is not None:
+                extras["static_analysis"]["dyn_edge_coverage"] = (
+                    _san.get("cross_check", {}).get("coverage")
+                )
+            # deviceguard: the jax-boundary twin of the sanitizer dump —
+            # transfers blocked, recompile assertions, and the observed-
+            # vs-jaxlint coverage ratio ride the same evidence record
+            _dg = _read_deviceguard()
+            if _dg is not None:
+                extras["static_analysis"]["deviceguard_coverage"] = (
+                    _dg.get("static_coverage")
+                )
+            ev(
+                "static_analysis",
+                ok=_rep.ok,
+                passes=dict(_rep.counts),
+                findings=len(_rep.findings),
+                suppressed=len(_rep.suppressed),
+                racelint=_rep.counts.get("racelint", 0),
+                jaxlint=_rep.counts.get("jaxlint", 0),
+                sanitizer=_san,
+                deviceguard=_dg,
+            )
+        except Exception as e:
+            # the bench must still measure when the analysis can't run
+            # (e.g. stripped source tree); the failure itself is
+            # evidence
+            ev("static_analysis", error=f"{type(e).__name__}: {e}")
+
+    # health evidence per round (ISSUE 10): one watchdog evaluation
+    # over this process + the engine summary (rules evaluated, alerts
+    # fired/resolved, learned baselines, tick age) rides the evidence
+    # stream next to static_analysis — the perf trajectory carries
+    # health state, not just numbers
+    if budget_ok("watchdog", est_s=5):
+        try:
+            from orientdb_tpu.obs.watchdog import bench_watchdog_summary
+
+            _ws = bench_watchdog_summary()
+            extras["watchdog"] = _ws
+            ev("watchdog", **_ws)
+        except Exception as e:
+            ev("watchdog", error=f"{type(e).__name__}: {e}")
+
+    # mixed production-shaped traffic under chaos, judged by the SLO
+    # plane (ISSUE 11): the closed-loop simulator runs its OWN small
+    # cluster + dataset, so it neither needs nor disturbs the demodb
+    # graph the perf blocks time. Verdict + burn ride the headline
+    # extras; the full machine-readable report is BENCH_SLO_r{N}.json.
+    if os.environ.get("BENCH_SLO", "1") != "0" and budget_ok(
+        "mixed_slo", est_s=60
+    ):
+        with block_span("mixed_slo"):
+            try:
+                _slo = run_mixed_slo_block(round_n, detail_dir)
+                extras["slo"] = _slo
+                # a budget-starved run that skipped the headline tier
+                # still measured SOMETHING here: numbers must not
+                # publish under status=warming
+                extras.pop("status", None)
+                ev("mixed_slo", **_slo)
+            except Exception as e:
+                # the traffic sim failing IS evidence, but it must not
+                # cost the perf numbers behind it
+                extras["slo"] = {
+                    "verdict": "error",
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+                ev("mixed_slo", error=f"{type(e).__name__}: {e}")
+
+    # mixed read/write deltas block (ISSUE 15 acceptance): its own
+    # small dataset + delta-maintained snapshot, so it neither needs
+    # nor disturbs the demodb graph the perf blocks time
+    if os.environ.get("BENCH_RW", "1") != "0" and budget_ok(
+        "mixed_rw", est_s=60
+    ):
+        with block_span("mixed_rw"):
+            try:
+                _rw = run_mixed_rw_block()
+                extras["mixed_rw"] = _rw
+                extras.pop("status", None)  # measured: clear warming
+                ev("mixed_rw", **_rw)
+            except Exception as e:
+                extras["mixed_rw"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]
+                }
+                ev("mixed_rw", error=f"{type(e).__name__}: {e}")
+
     if budget_ok("rows_1hop", est_s=25, needs_db=True):
         rows_qps = time_batched(sql_rows, tag="rows_1hop")
         extras["rows_1hop_batched_qps"] = round(rows_qps, 3)
@@ -1812,6 +2041,31 @@ def _measure() -> None:
             extras["degree_skew"] = skew
             ev("degree_skew", **skew)
 
+    # ---- tiered snapshots (ISSUE 16 acceptance): the same demodb
+    # shape at 2x the HBM cap — the hot/cold plane pages blocks across
+    # uid-rotating 2-hop queries. Own subprocess (the second attach
+    # needs the first pass's buffers actually freed). The bar rides
+    # the record: tiered_vs_resident >= 0.5 at zero parity loss. ----
+    tiered = {}
+    if os.environ.get("BENCH_TIERED", "1") != "0" and budget_ok(
+        "tiered", est_s=90
+    ):
+        tiered = run_tpu_subprocess("tiered", timeout=clamp_timeout(1800))
+        if "error" in tiered:
+            if not budget_truncated("tiered", str(tiered["error"])):
+                if "parity mismatch" in str(tiered["error"]):
+                    print(tiered["error"])
+                else:
+                    print(json.dumps({
+                        "metric": "demodb_match_2hop_count_qps",
+                        "value": 0.0, "unit": "queries/sec",
+                        "vs_baseline": 0.0,
+                        "error": f"tiered block failed: {tiered['error']}"}))
+                sys.exit(1)
+        else:
+            extras["tiered"] = tiered
+            ev("tiered", **tiered)
+
     # ---- shard-count scaling of the frontier-sparse sharded MATCH
     # (VERDICT r3 #6 + ISSUE 13): per-S subprocesses on virtual CPU
     # meshes. wall_s must be ~monotone non-increasing across the sweep
@@ -1869,38 +2123,13 @@ def _measure() -> None:
     # read it; _flush_detail has been rewriting it after every block),
     # and the printed line carries the required keys plus a compact
     # extras subset that stays well under the capture window.
-    # round-over-round regression gate (tools/perfdiff): compare this
-    # round's detail against the last good recorded round and ride the
-    # machine-readable verdict into the evidence stream — the bench
-    # trajectory carries its own diff, not just raw trees. Budget skips
-    # void the comparison (missing leaves would read as regressions).
-    try:
-        base_path = os.environ.get("BENCH_PERFDIFF_BASE") or _last_good_round(
-            detail_dir, round_n
-        )
-        if base_path is None:
-            ev("perfdiff", skipped="no_prior_round")
-        elif skipped:
-            ev("perfdiff", skipped="budget_truncated_run", base=os.path.basename(base_path))
-        else:
-            from orientdb_tpu.tools.perfdiff import _load as _pd_load, diff as _pd_diff
-
-            _base = _pd_load(base_path)
-            if _base is None:
-                ev("perfdiff", skipped="unreadable_base",
-                   base=os.path.basename(base_path))
-            else:
-                rep = _pd_diff(_base, _compose_out())
-                extras["perfdiff"] = {
-                    "base": os.path.basename(base_path),
-                    "verdict": rep["verdict"],
-                    "headline_ratio": rep["headline"].get("ratio"),
-                    "compared": rep["compared"],
-                    "regressions": len(rep["regressions"]),
-                }
-                ev("perfdiff", base=os.path.basename(base_path), **rep)
-    except Exception as e:  # the diff must never cost the headline
-        ev("perfdiff", error=f"{type(e).__name__}: {e}")
+    # final round-over-round comparison (tools/perfdiff): the full
+    # tree vs the last good recorded round — the bench trajectory
+    # carries its own diff, not just raw trees. Budget skips void the
+    # comparison (missing leaves would read as regressions); a
+    # regression verdict HARD-fails the run after the headline prints
+    # (below), the same rc-2 convention as --gate.
+    pd_rep = run_perfdiff("final")
 
     out = _compose_out()
     _flush_detail()
@@ -1913,6 +2142,20 @@ def _measure() -> None:
     )
 
     _write_headline(out, detail_name)
+
+    # perfdiff is a HARD gate vs the last good round: a "regression"
+    # verdict fails the run with rc 2 (run_perfdiff already returned
+    # None — no gate — for budget-truncated runs, unreadable bases and
+    # first rounds). Diagnostics on stderr; the headline line above
+    # stays the final stdout line.
+    if pd_rep is not None and pd_rep["verdict"] == "regression":
+        for r in pd_rep["regressions"]:
+            print(
+                f"PERFDIFF REGRESSION [{r.get('kind')}] {r['metric']}: "
+                f"{r['base']} -> {r['cur']}",
+                file=sys.stderr,
+            )
+        sys.exit(2)
 
     # regression gate: `python bench.py --gate BENCH_r03.json` (or env
     # BENCH_GATE=...) fails the run when any workload drops >15% vs the

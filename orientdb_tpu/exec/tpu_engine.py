@@ -53,6 +53,7 @@ from orientdb_tpu.models.record import Document
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.ops import csr as K
 from orientdb_tpu.ops.device_graph import DeviceGraph, device_graph
+from orientdb_tpu.storage import tiering
 from orientdb_tpu.ops.predicates import (
     ColumnScope,
     ParamBox,
@@ -314,6 +315,18 @@ class SizeSchedule:
             self.overflow = flag if self.overflow is None else (self.overflow | flag)
         return v
 
+    def note_flag(self, dev_flag) -> None:
+        """OR an externally computed device-side failure bit into the
+        overflow surface (the tiered cold-miss flag: a replay whose
+        frontier wandered onto a non-resident block must discard and
+        re-record — the re-record faults the block in). No-op while
+        recording: the recording run ensures residency eagerly."""
+        if self.recording:
+            return
+        self.overflow = (
+            dev_flag if self.overflow is None else (self.overflow | dev_flag)
+        )
+
     def overflow_flag(self):
         if self.overflow is None:
             return jnp.zeros((), bool)
@@ -468,7 +481,8 @@ def _var_emit_mask(reached, node_mask_vec, bound_chunk, vb: int):
     return emit
 
 
-def build_bitmap_hops(dg: DeviceGraph, items) -> List:
+def build_bitmap_hops(dg: DeviceGraph, items, sched=None, tier=None,
+                      touched=None) -> List:
     """Frontier-hop closures for ``(class, direction, emask)`` items.
 
     Each closure maps a ``[C, vb]`` frontier bitmap to the bitmap of
@@ -476,12 +490,37 @@ def build_bitmap_hops(dg: DeviceGraph, items) -> List:
     via the sharded edge-list slices with a psum-OR merge over the shards
     axis (SURVEY.md §5.7); single-device graphs scatter over the flat
     edge list. ``emask`` is an optional [E] per-edge prefilter in
-    out-CSR order (fused edge WHERE)."""
+    out-CSR order (fused edge WHERE).
+
+    Tiered snapshots (``tier`` set, storage/tiering) hop over the paged
+    pool instead of the flat edge list: the recording run faults every
+    frontier-touched block resident (accumulating the plan's
+    ``touched`` footprint), replays read the pools through ``dg.arrays``
+    — jit arguments, so residency changes reach cached plans — and fold
+    a device-side cold-miss bit into ``sched`` so an off-footprint
+    replay re-records rather than dropping edges."""
     mg = dg.mesh_graph
     armed = getattr(dg.snap, "_overlay", None) is not None
     hops = []
     for cname, d, emask in items:
         dec = dg.edges[cname]
+        if mg is None and tier is not None and tier.pages_dir(cname, d):
+
+            def tiered_hop(fr, cname=cname, d=d, emask=emask):
+                if sched is None or sched.recording:
+                    tier.ensure_frontier(cname, d, fr, touched)
+                arrays = dg.arrays
+                out = tiering.paged_hop(arrays, cname, d, emask, fr)
+                # computed on the recording run too (and discarded):
+                # the touch log must see the miss path's keys or the
+                # replay's jit-arg subset would lack them
+                miss = tiering.paged_hop_miss(arrays, cname, d, fr)
+                if sched is not None:
+                    sched.note_flag(miss)
+                return out
+
+            hops.append(tiered_hop)
+            continue
         m = emask if emask is not None else jnp.ones(dec.num_edges, bool)
         if mg is None:
             if armed:
@@ -548,6 +587,12 @@ class TpuMatchSolver:
         self.delta_gen = (
             self.overlay.plan_gen if self.overlay is not None else 0
         )
+        #: hot/cold tier manager (storage/tiering) when the snapshot's
+        #: adjacency exceeds the HBM cap; the recording run accumulates
+        #: every faulted block into tier_touched — frozen at plan
+        #: construction as the plan's dispatch-prefetch footprint
+        self.tier = getattr(snap, "_tier", None)
+        self.tier_touched: set = set()
         #: slab-scan capacity floor (host-read here, NOT inside the
         #: traced replay): recordings pre-allocate this many slab
         #: window/match slots even when the slab is near-empty, so a
@@ -675,6 +720,20 @@ class TpuMatchSolver:
     # -- compile-time gating ------------------------------------------------
 
     def _check_supported(self) -> None:
+        if self.tier is not None:
+            # tiered snapshots page the flat edge arrays out of HBM —
+            # the method-form expansions (_expand_bind_edge /
+            # _expand_edge_endpoint) still read them directly, so those
+            # arms fall back to the oracle until they learn the paged
+            # gather. Plain arrows, var-depth, NOT arms and TRAVERSE
+            # all route through the paged kernels.
+            for e in self.pattern.edges:
+                if (e.item.method or "").lower() in (
+                    "oute", "ine", "bothe", "outv", "inv", "bothv"
+                ):
+                    raise Uncompilable(
+                        "method-form arm on a tiered snapshot"
+                    )
         for path in self.not_paths:
             # NOT arms compile to a bitmap anti-join (see
             # _apply_not_path); the chain subset mirrors what that
@@ -913,6 +972,35 @@ class TpuMatchSolver:
             edge_pos = jnp.where(dead, -1, edge_pos)
         return row, edge_pos, nbr, total
 
+    def _expand_paged(self, dec, d: str, srcs, part):
+        """CSR expansion over a tiered (paged) partition: row/edge_pos
+        come from the resident indptr exactly as the flat path; the
+        neighbor (and, reverse, the out-order edge id) gather from the
+        hot pool through the block→page indirection. The recording run
+        faults every touched block resident first (and logs it into the
+        plan's tier footprint); replays fold the device-side cold-miss
+        bit into the overflow surface instead of syncing."""
+        if self.sched.recording:
+            # eager run inside the allowlisted _record boundary: the
+            # host read of the frontier is the intentional fault path
+            self.tier.ensure_vertices(
+                dec.class_name, d, np.asarray(srcs), self.tier_touched
+            )
+        arrays = self.dg.arrays
+        indptr = arrays[
+            f"e:{dec.class_name}:indptr_{'out' if d == 'out' else 'in'}"
+        ]
+        counts = K.degree_counts(indptr, srcs)
+        offsets = K.exclusive_cumsum(counts)
+        total_dev = counts.sum()
+        total = self.sched.observe(total_dev)
+        row, eid, nbr, miss = tiering.paged_expand(
+            arrays, dec.class_name, d, srcs, offsets, total_dev,
+            _cap_of(total), part.Wp,
+        )
+        self.sched.note_flag(miss)
+        return row, eid, nbr, total
+
     def _expand_slab(self, dec, d: str, srcs):
         """Append-slab expansion for one (class, direction): scan the
         slab tail of the padded edge list for live edges whose active
@@ -925,6 +1013,14 @@ class TpuMatchSolver:
         cap = dec.num_edges
         if cap <= base:
             return None
+        if (
+            dec.class_name in getattr(ov, "bk", {})
+            and dec.class_name not in ov.bucket_overflow
+        ):
+            # O(touched buckets) path — falls back to the window scan
+            # below only when a bucket overflowed (plan_gen bumps then,
+            # so recorded plans never switch paths mid-replay)
+            return self._expand_slab_bucketed(dec, d, srcs, base)
         arrays = self.dg.arrays
         p = f"e:{dec.class_name}"
         tail_src = arrays[f"{p}:edge_src"][base:cap]
@@ -957,6 +1053,45 @@ class TpuMatchSolver:
         j = jnp.where(ok, idx % W, 0).astype(jnp.int32)
         eid = jnp.where(ok, base + j, -1).astype(jnp.int32)
         nbr = jnp.where(ok, jnp.take(e, j), -1).astype(jnp.int32)
+        return row, eid, nbr, total
+
+    def _expand_slab_bucketed(self, dec, d: str, srcs, base: int):
+        """Bucket-indexed slab expansion: probe each active endpoint's
+        BK-slot bucket instead of scanning the whole used window —
+        O(rows × BK) work per expansion however full the slab gets
+        (the r15 scan was O(rows × used slots): ~2× read cost at
+        500-edge occupancy). Same contract as the scan: (row, global
+        edge id, neighbor, host total)."""
+        ov = self.overlay
+        NB, BK = ov.bk_nb, ov.bk_bk
+        arrays = self.dg.arrays
+        p = f"e:{dec.class_name}"
+        tab = arrays[f"bk:{dec.class_name}:{'out' if d == 'out' else 'in'}"]
+        own_a = arrays[f"{p}:{'edge_src' if d == 'out' else 'dst'}"]
+        nbr_a = arrays[f"{p}:{'dst' if d == 'out' else 'edge_src'}"]
+        live = arrays[f"{p}:live"]
+        # int32 two's complement: -1 & (NB-1) is a valid (masked) bucket
+        b = srcs & jnp.int32(NB - 1)
+        slots = b[:, None] * BK + jnp.arange(BK, dtype=jnp.int32)[None, :]
+        rel = jnp.take(tab, slots)  # [R, BK] relative slab slots
+        ok = (rel >= 0) & (srcs >= 0)[:, None]
+        abs_ = base + jnp.clip(rel, 0)
+        m = (
+            ok
+            & (jnp.take(own_a, abs_) == srcs[:, None])
+            & jnp.take(live, abs_)
+        )
+        floor = min(dec.num_edges - base, self._slab_floor)
+        total = self.sched.observe(m.sum(dtype=jnp.int32), min_capacity=floor)
+        out = max(_cap_of(max(total, 1)), floor)
+        idx = K.compact_indices(m.reshape(-1), out)
+        okk = idx >= 0
+        row = jnp.where(okk, idx // BK, -1).astype(jnp.int32)
+        rel_sel = jnp.take(rel.reshape(-1), jnp.clip(idx, 0))
+        eid = jnp.where(okk, base + rel_sel, -1).astype(jnp.int32)
+        nbr = jnp.where(
+            okk, jnp.take(nbr_a, jnp.clip(base + rel_sel, 0)), -1
+        ).astype(jnp.int32)
         return row, eid, nbr, total
 
     def _expand_one_dir_chunked(self, dec, d: str, srcs):
@@ -996,6 +1131,10 @@ class TpuMatchSolver:
         neighbor, host total), on the single-device or mesh-sharded path."""
         mg = self.dg.mesh_graph
         if mg is None:
+            if self.tier is not None:
+                part = self.tier.parts.get((dec.class_name, d))
+                if part is not None:
+                    return self._expand_paged(dec, d, srcs, part)
             if d == "out":
                 indptr, nbrs = dec.indptr_out, dec.dst
             else:
@@ -1157,7 +1296,12 @@ class TpuMatchSolver:
                 dirs = ("out", "in") if it.direction == "both" else (it.direction,)
                 for d in dirs:
                     hop_items.append((cname, d, emask))
-            hops_per_item.append(build_bitmap_hops(self.dg, hop_items))
+            hops_per_item.append(
+                build_bitmap_hops(
+                    self.dg, hop_items, sched=self.sched, tier=self.tier,
+                    touched=self.tier_touched,
+                )
+            )
         vcol = jnp.arange(vb, dtype=jnp.int32)
         valid_dev = table.valid_device
         exists_chunks = []
@@ -1214,6 +1358,12 @@ class TpuMatchSolver:
             # slab edges would be missed and tombstoned edges counted.
             # Dirty-topology plans take the full (slab-aware) solve;
             # compaction restores the pushdown on the next recording.
+            return []
+        if self.tier is not None:
+            # the weight passes read the flat [E] arrays directly —
+            # paged out on a tiered snapshot. The frontier solve (paged
+            # gather + bitmap hops) covers COUNT correctly, just
+            # without the pushdown's O(E+V) collapse.
             return []
         suffix: List[PlanStep] = []
         # alias usage counts over all edges (from/to + edge-filter aliases)
@@ -1940,7 +2090,10 @@ class TpuMatchSolver:
                 emask = self._edge_where(cname, f.where)(eids, {})
             for d in ("out", "in") if direction == "both" else (direction,):
                 items.append((cname, d, emask))
-        hops = build_bitmap_hops(self.dg, items)
+        hops = build_bitmap_hops(
+            self.dg, items, sched=self.sched, tier=self.tier,
+            touched=self.tier_touched,
+        )
         parts: List[Table] = []
         counts: List[int] = []
         width = table.width or 1
@@ -2375,6 +2528,12 @@ class TpuTraverseSolver:
         self.delta_gen = (
             self.overlay.plan_gen if self.overlay is not None else 0
         )
+        #: hot/cold tier manager (storage/tiering) when the snapshot's
+        #: adjacency exceeds the HBM cap; the recording run accumulates
+        #: every faulted block into tier_touched — frozen at plan
+        #: construction as the plan's dispatch-prefetch footprint
+        self.tier = getattr(snap, "_tier", None)
+        self.tier_touched: set = set()
         #: TRAVERSE replays are fully static — the roots array is baked
         #: at record time and the schedule's overflow flag is dropped
         #: (sound on immutable snapshots, where replay inputs are
@@ -2458,7 +2617,10 @@ class TpuTraverseSolver:
         vb = K.bucket(max(V, 1))
         univ = jnp.arange(vb, dtype=jnp.int32)
         univ = jnp.where(univ < V, univ, -1)
-        hops = build_bitmap_hops(self.dg, self.hop_items)
+        hops = build_bitmap_hops(
+            self.dg, self.hop_items, sched=self.sched, tier=self.tier,
+            touched=self.tier_touched,
+        )
         # one logical traversal row: [1, vb] bitmap with every root set
         roots = jnp.zeros((1, vb), bool)
         if self.roots.shape[0]:
@@ -2658,6 +2820,7 @@ class _CompiledTraverse(_AotWarmup):
     def __init__(self, solver: TpuTraverseSolver, count: int) -> None:
         self.solver = solver
         self.count = count
+        self.tier_footprint = frozenset(solver.tier_touched)
         self.jitted = jax.jit(self._replay)
 
     def _warm_call(self):
@@ -2682,14 +2845,21 @@ class _CompiledTraverse(_AotWarmup):
         # with _CompiledPlan and ignored
         _check_traverse_static(self.solver)
         self.wait_compiled()
-        return self.jitted(self._arg_subset())
+        tier = self.solver.tier
+        if tier is not None:
+            args = tier.prepare_dispatch(self.tier_footprint, self._arg_subset)
+        else:
+            args = self._arg_subset()
+        return self.jitted(args)
 
     def batchable(self) -> bool:
         """TRAVERSE plans bake their parameters, so every batch item
         sharing this plan is the IDENTICAL program on identical inputs:
         the group path serves them all with ONE dispatch (the no-dyn
         shared-dispatch case of execute_batch's grouping)."""
-        return self.solver.dg.mesh_graph is None
+        return (
+            self.solver.dg.mesh_graph is None and self.solver.tier is None
+        )
 
     def _dyn_args(self, params: Optional[Dict]) -> Dict:
         _check_delta_gen(self.solver)
@@ -2697,6 +2867,9 @@ class _CompiledTraverse(_AotWarmup):
         return {}  # no dynamic args: grouping uses the shared dispatch
 
     def materialize(self, dev, params: Optional[Dict] = None) -> List[Result]:
+        tier = self.solver.tier
+        if tier is not None:
+            tier.release_footprint(self.tier_footprint)
         return self.solver.rows_from(np.asarray(dev), self.count)
 
     def rows(self, params: Optional[Dict] = None) -> List[Result]:
@@ -2810,6 +2983,10 @@ class _CompiledPlan(_AotWarmup):
         #: call is a guaranteed cache hit — a differently-sized batch
         #: must never absorb a synchronous XLA compile on the drain path
         self._group_page_shape: Optional[Tuple[int, ...]] = None
+        #: tiered snapshots: the blocks the recording run faulted —
+        #: every dispatch re-ensures them resident (pin + async
+        #: prefetch) before grabbing its argument pytree
+        self.tier_footprint = frozenset(solver.tier_touched)
         self.jitted = jax.jit(self._replay)
 
     def _replay_core(self, arrays, dyn):
@@ -3061,7 +3238,15 @@ class _CompiledPlan(_AotWarmup):
             # profiling and flagged by the deviceguard transfer guard
             dyn = jax.device_put(dyn)
             _TL.mark("param_upload")
-        dev = self.jitted(self._arg_subset(), dyn)
+        tier = self.solver.tier
+        if tier is not None:
+            # footprint prefetch + pin + atomic arg-pytree grab under
+            # the tier lock — a concurrent eviction can never hand this
+            # dispatch a torn (pool, page_of) pair; materialize unpins
+            args = tier.prepare_dispatch(self.tier_footprint, self._arg_subset)
+        else:
+            args = self._arg_subset()
+        dev = self.jitted(args, dyn)
         _TL.mark("device_dispatch")
         self._prefetch_elected(dev)
         return dev
@@ -3096,6 +3281,10 @@ class _CompiledPlan(_AotWarmup):
         `group_page`). Mesh plans keep per-query dispatch because
         vmap-over-shard_map is not exercised anywhere."""
         if self.solver.dg.mesh_graph is not None:
+            return False
+        if self.solver.tier is not None:
+            # tiered dispatches pin/ensure their footprint per call —
+            # the shared group lane would fuse different footprints
             return False
         if self.count_name is not None or self.width == 0 or self.direct_fetch:
             return True
@@ -3271,6 +3460,14 @@ class _CompiledPlan(_AotWarmup):
         a page-rounded live prefix of the full buffer (≥ `count` slots) —
         only the first `count` rows are read — and may arrive int16 when
         the dispatch's bit-width election shipped the half-size copy."""
+        tier = self.solver.tier
+        if tier is not None:
+            # the dispatch that produced `fetched` has drained (we hold
+            # its fetched buffers) — drop its footprint pins so
+            # eviction stops preferring around these blocks. Runs
+            # before the overflow raise: every dispatch path
+            # materializes exactly once, success or overflow.
+            tier.release_footprint(self.tier_footprint)
         if isinstance(fetched, tuple) and len(fetched) == 3:
             meta_dev, data_dev, _p16 = fetched  # raw dispatch triple
             if isinstance(data_dev, (list, tuple)):

@@ -982,6 +982,13 @@ class Database:
     def attach_snapshot(self, snapshot, mesh=None) -> None:
         if mesh is not None:
             snapshot._mesh = mesh
+        # tier admission (storage/tiering): with tier_hbm_cap_bytes set
+        # and the snapshot's adjacency over it, the device build pages
+        # adjacency hot/cold instead of uploading flat. Refuses loudly
+        # on a meshed or delta-maintained snapshot.
+        from orientdb_tpu.storage.tiering import maybe_tier_snapshot
+
+        maybe_tier_snapshot(snapshot)
         self._snapshot = snapshot
         self._snapshot_epoch = self.mutation_epoch
 

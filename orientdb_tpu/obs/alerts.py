@@ -74,6 +74,10 @@ RULE_CATALOG: Dict[str, str] = {
     "delta_slab_pressure": "a delta-maintained snapshot's fullest "
     "append slab (snapshot.delta.slab_fill, storage/deltas) exceeds "
     "alert_slab_fill — deltas are outpacing epoch compaction",
+    "tier_thrash": "a tiered snapshot (storage/tiering) is reloading "
+    "recently evicted blocks faster than alert_tier_thrash events per "
+    "window (tier.thrash gauge) — the hot working set does not fit "
+    "tier_hbm_cap_bytes and dispatches are churning the pool",
 }
 
 #: two-window burn-rate windows (seconds): the short window catches the
@@ -590,6 +594,18 @@ class AlertEngine:
                 f"delta slab {v:.0%} full (compaction falling behind)",
             )
 
+    def _check_tier_thrash(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_tier_thrash
+        v = ctx.gauges.get("tier.thrash", 0.0)
+        if thr > 0 and v > thr:
+            yield Breach(
+                "tier",
+                v,
+                thr,
+                f"{v:.0f} block reloads in the thrash window (working "
+                "set over tier_hbm_cap_bytes)",
+            )
+
     def _check_recompile_storm(self, ctx: AlertContext) -> Iterable[Breach]:
         thr = config.alert_recompiles_per_min
         total = sum(
@@ -789,6 +805,11 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
         "delta_slab_pressure", "warning",
         AlertEngine._check_slab_pressure,
         exemplar_spans=("snapshot.",),
+    ),
+    _rule(
+        "tier_thrash", "warning",
+        AlertEngine._check_tier_thrash,
+        exemplar_spans=("tier.",),
     ),
 )
 

@@ -80,6 +80,11 @@ SPAN_CATALOG: Dict[str, str] = {
     "timeline.export": "Chrome-trace/Perfetto export of the flight "
     "recorder window (GET /debug/timeline, debug bundle, bench "
     "TIMELINE artifact)",
+    "tier.prefetch": "tiered snapshot cold-block upload wave "
+    "(storage/tiering: recording fault or dispatch footprint ensure; "
+    "recorded as prefetch-kind transfers in the flight recorder)",
+    "tier.evict": "tiered snapshot block eviction (owner row cleared, "
+    "page recycled under tier_hbm_cap_bytes pressure)",
 }
 
 #: dynamically named span families (f-string call sites the literal

@@ -106,20 +106,38 @@ class DeviceEdgeClass:
         self._g = g
         p = self._p = f"e:{csr.class_name}"
         if g.mesh_graph is None:
+            # tiered snapshots (storage/tiering) page the four [E]
+            # value arrays between a hot device pool and host-pinned
+            # cold blocks — the flat uploads are what the HBM cap
+            # exists to avoid. Indptrs stay resident (O(V), and every
+            # paged gather sizes from them). Reading a skipped
+            # property below raises KeyError by design: every consumer
+            # is gated onto the paged kernels.
+            tier = getattr(g.snap, "_tier", None)
+            paged = tier is not None and tier.pages_dir(csr.class_name, "out")
             g._put(f"{p}:indptr_out", csr.indptr_out)
-            g._put(f"{p}:dst", csr.dst)
-            # per-edge source vertex in out-CSR order (bitmap-hop kernels
-            # index edges directly instead of walking indptr)
-            g._put(f"{p}:edge_src", csr.edge_src_np())
             g._put(f"{p}:indptr_in", csr.indptr_in)
-            g._put(f"{p}:src", csr.src)
-            g._put(f"{p}:edge_id_in", csr.edge_id_in)
+            if not paged:
+                g._put(f"{p}:dst", csr.dst)
+                # per-edge source vertex in out-CSR order (bitmap-hop
+                # kernels index edges directly instead of walking indptr)
+                g._put(f"{p}:edge_src", csr.edge_src_np())
+                g._put(f"{p}:src", csr.src)
+                g._put(f"{p}:edge_id_in", csr.edge_id_in)
             if getattr(csr, "live", None) is not None:
                 # delta-slab liveness (storage/deltas): spare slots and
                 # tombstoned edges read False; the bitmap-hop and slab
                 # expansion paths mask on it as a jit ARGUMENT, so
                 # delta patches reach every cached plan
                 g._put(f"{p}:live", csr.live)
+            ov = getattr(g.snap, "_overlay", None)
+            bk = getattr(ov, "bk", {}).get(csr.class_name) if ov else None
+            if bk is not None:
+                # bucketed slab index (storage/deltas): per-direction
+                # endpoint-keyed tables of slab slots — patch-maintained
+                # jit arguments like the live mask above
+                g._put(f"bk:{csr.class_name}:out", bk["out"])
+                g._put(f"bk:{csr.class_name}:in", bk["in"])
         e_pad = g._shard_pad_rows(int(csr.dst.shape[0]))
         self.columns: Dict[str, DeviceColumn] = {
             n: DeviceColumn(c, g, f"{p}:c:{n}", shard_pad=e_pad)
@@ -241,9 +259,23 @@ class DeviceGraph:
             for n, c in snap.v_columns.items()
         }
         self.non_columnar: Set[str] = set(getattr(snap, "v_non_columnar", ()))
+        tier = getattr(snap, "_tier", None)
+        if tier is not None and self.mesh_graph is not None:
+            # same composition rule as mesh + overlay below: the mesh
+            # layout re-partitions adjacency shard-wise and knows
+            # nothing of the hot/cold pools
+            raise ValueError(
+                "tiered snapshots are single-device; drop the mesh or "
+                "raise tier_hbm_cap_bytes"
+            )
         self.edges: Dict[str, DeviceEdgeClass] = {
             n: DeviceEdgeClass(c, self) for n, c in snap.edge_classes.items()
         }
+        if tier is not None:
+            # upload block indexes + pools, seed the hottest blocks
+            # (storage/tiering); re-runs per DeviceGraph build, so a
+            # _free_device → rebuild cycle re-establishes residency
+            tier.install(self)
         # class-id sets stay OUTSIDE `arrays`: they are lazily created per
         # query, and growing the jit-arg pytree would change its structure
         # and silently retrace every cached plan. They are tiny (a few
@@ -431,6 +463,10 @@ class DeviceGraph:
         logical = dict(cats)
         for key, arr in self._arrays.items():
             if key.startswith("sh:"):
+                cat = "adjacency"
+            elif key.startswith("t:") or key.startswith("bk:"):
+                # tier pools/indexes (storage/tiering) and overlay slab
+                # bucket tables are adjacency in paged/bucketed clothing
                 cat = "adjacency"
             elif key == "v_class" or key.startswith("v:"):
                 cat = "vertex_columns"

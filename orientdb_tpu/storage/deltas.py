@@ -138,6 +138,18 @@ class SnapshotOverlay:
         #: (database.rename_class holds db._lock; taking the maintainer
         #: lock there would invert the catch-up lock order).
         self.poisoned: Optional[str] = None
+        #: bucketed slab index (built by pad_for_deltas): per class,
+        #: flat [NB*BK] tables of RELATIVE slab slots keyed by
+        #: endpoint & (NB-1) — the O(touched buckets) replacement for
+        #: the O(table × slab-window) _expand_slab scan. Host mirrors
+        #: here; device twins upload as ``bk:{class}:{dir}`` and are
+        #: patch-maintained like every other delta array.
+        self.bk: Dict[str, Dict[str, np.ndarray]] = {}
+        self.bk_nb = 0
+        self.bk_bk = 0
+        #: classes whose bucket filled (BK same-bucket slab edges):
+        #: their plans fall back to the window scan until compaction
+        self.bucket_overflow: set = set()
 
     # -- state transitions --------------------------------------------------
 
@@ -165,6 +177,34 @@ class SnapshotOverlay:
 
     def edge_base(self, class_name: str) -> int:
         return self.edge_slabs[class_name].base
+
+    def bucket_add(
+        self, cname: str, src: int, dst: int, rel: int, patches=None
+    ) -> None:
+        """Index a freshly appended slab edge (relative slot ``rel``)
+        under both endpoints' buckets. A full bucket flips the class to
+        the scan fallback (and re-records its plans); tombstones need
+        no removal — the expansion ANDs the liveness mask."""
+        t = self.bk.get(cname)
+        if t is None or cname in self.bucket_overflow:
+            return
+        nb, bk = self.bk_nb, self.bk_bk
+        for tab, fill, key_v, dev in (
+            (t["out"], t["fill_out"], src, f"bk:{cname}:out"),
+            (t["in"], t["fill_in"], dst, f"bk:{cname}:in"),
+        ):
+            b = int(key_v) & (nb - 1)
+            n = int(fill[b])
+            if n >= bk:
+                self.bucket_overflow.add(cname)
+                metrics.incr("snapshot.delta.bucket_overflow")
+                self.bump_plan_gen()
+                return
+            slot = b * bk + n
+            tab[slot] = rel
+            fill[b] = n + 1
+            if patches is not None:
+                patches.add(_PH_DATA, dev, slot, np.int32(rel))
 
     def slab_fill(self) -> float:
         """Worst-case slab occupancy fraction (vertex slab and every
@@ -242,6 +282,15 @@ def pad_for_deltas(
     supported (the shard-wise layout re-partitions per geometry)."""
     if getattr(snap, "_mesh", None) is not None:
         raise ValueError("delta slabs are single-device only (no mesh)")
+    if getattr(snap, "_tier", None) is not None:
+        # the slab scan and patch kernels read the flat [E] arrays the
+        # tier pages out of HBM — the two planes don't compose (yet)
+        raise ValueError(
+            "tiered snapshots are immutable: delta maintenance needs the "
+            "flat resident edge arrays — detach the tier (raise "
+            "tier_hbm_cap_bytes) or serve reads tiered and compact writes "
+            "into fresh snapshots"
+        )
     if getattr(snap, "_device_cache", None) is not None:
         raise ValueError("pad_for_deltas must run before device upload")
     sv = config.delta_slab_vertex_rows if spare_vertices is None else spare_vertices
@@ -282,6 +331,18 @@ def pad_for_deltas(
         for col in csr.edge_columns.values():
             _pad_column(col, cap_e)
         ov.edge_slabs[cname] = _EdgeSlab(base_e, cap_e)
+    # bucketed slab index: NB pow2 buckets × BK slots per class+dir,
+    # keyed by endpoint & (NB-1) — sized ~2× the slab so same-bucket
+    # collisions (overflow → scan fallback) stay rare at full occupancy
+    ov.bk_bk = 8
+    ov.bk_nb = max(256, 1 << max(0, (se - 1).bit_length() - 2))
+    for cname in snap.edge_classes:
+        ov.bk[cname] = {
+            "out": np.full(ov.bk_nb * ov.bk_bk, -1, np.int32),
+            "in": np.full(ov.bk_nb * ov.bk_bk, -1, np.int32),
+            "fill_out": np.zeros(ov.bk_nb, np.int32),
+            "fill_in": np.zeros(ov.bk_nb, np.int32),
+        }
     snap._overlay = ov
     return ov
 
@@ -812,6 +873,10 @@ class SnapshotMaintainer:
         slab.rid_pos(csr)[rid] = pos
         patches.add(_PH_DATA, f"{p}:edge_src", pos, np.int32(src))
         patches.add(_PH_DATA, f"{p}:dst", pos, np.int32(dst))
+        # bucket-index the new slot (DATA phase: the entry lands before
+        # the LIVE flip below, so readers never see a live unindexed
+        # edge — a dead indexed slot is filtered by the live mask)
+        ov.bucket_add(cname, src, dst, pos - slab.base, patches)
         self._patch_columns(
             ov,
             csr.edge_columns,

@@ -1,0 +1,591 @@
+"""Tiered snapshots: a device-managed hot/cold adjacency plane.
+
+r04 measured ~1.34 GB of per-device adjacency at SF100 shape — with
+property columns and dictionaries on top, the north-star graphs do not
+fit one device's HBM. This module splits a :class:`GraphSnapshot`'s
+adjacency into a **device-resident hot tier** and a **host-pinned cold
+tier** so over-cap graphs keep serving instead of failing the upload:
+
+- Each (edge class, direction) partition's flat ``[E]`` arrays are cut
+  into contiguous **vertex-range blocks** of roughly
+  ``config.tier_block_edges`` edges (edge-balanced, so hub vertices
+  never split a block). Block values live in a fixed device **pool**
+  of ``P`` pages; a ``page_of[B]`` indirection maps blocks to pages
+  (−1 = cold). Pools, ``page_of`` and the per-vertex block index are
+  ordinary ``DeviceGraph.arrays`` entries, i.e. jit ARGUMENTS of every
+  compiled plan — residency changes are functional array updates that
+  reach every cached executable with zero retrace.
+- **Placement** is degree-skew seeded (blocks holding the
+  highest-degree vertices load first — the `degree_skew` bench block's
+  distribution says hubs dominate touch probability) and maintained
+  LRU by touch recency.
+- **Faulting** happens at recording time: the eager recording run sees
+  concrete frontiers, so the solver asks the manager to make every
+  touched block resident *before* the gather reads it, and the touched
+  set becomes the plan's **tier footprint**. Replays are sync-free:
+  `dispatch` re-ensures the footprint (async ``jax.device_put`` uploads
+  that overlap the dispatch plane — recorded as ``prefetch``-kind
+  transfers in the obs/timeline flight recorder), and a device-side
+  **cold-miss flag** folds into the SizeSchedule overflow surface so a
+  parameter-generic replay that wanders off its recorded footprint
+  re-records (which faults the new blocks in) instead of returning
+  garbage.
+- **Eviction** under ``config.tier_hbm_cap_bytes`` follows the PR-15
+  epoch discipline at the array level: updates are functional, so an
+  in-flight dispatch keeps the pool arrays it was handed alive until it
+  drains — use-after-free is structurally impossible. Dispatch-time
+  pins only steer the eviction CHOICE (prefer unpinned, LRU) and feed
+  the ``tier_thrash`` alert: reload of a recently evicted block counts
+  as thrash, surfaced as the ``tier.thrash`` gauge + alert rule rather
+  than a silent cliff.
+
+Composition guards: tiered snapshots are single-device and immutable —
+attaching a mesh or arming delta maintenance on one refuses loudly
+(mirroring the mesh + overlay guard in ops/device_graph).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import orientdb_tpu.obs.timeline as TL
+import orientdb_tpu.ops.csr as K
+from orientdb_tpu.obs.trace import span
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.metrics import metrics
+
+#: reload of a block evicted within this many ensure calls counts as a
+#: thrash event; the ``tier.thrash`` gauge is events over the window
+_THRASH_WINDOW = 32
+
+#: pool arrays per partition (own/nbr/eid), int32 each
+_POOL_ARRAYS = 3
+
+
+def adjacency_bytes(snap) -> int:
+    """Resident-form HBM bytes of the flat adjacency (the four ``[E]``
+    int32 arrays plus both indptrs, per edge class) — the quantity
+    ``tier_hbm_cap_bytes`` caps. Property columns upload lazily and are
+    budgeted separately (hbm.pruned_column_bytes)."""
+    total = 0
+    for csr in snap.edge_classes.values():
+        E = int(csr.dst.shape[0])
+        total += 4 * (4 * E + int(csr.indptr_out.shape[0]) + int(csr.indptr_in.shape[0]))
+    return total
+
+
+class _Partition:
+    """Host-side layout + residency bookkeeping for one
+    (edge class, direction) partition of the adjacency."""
+
+    __slots__ = (
+        "cname", "d", "V", "E", "W", "Wp", "B", "P",
+        "edge_start", "block_of_v", "vdeg", "prio",
+        "host", "page_of", "block_of_page", "free_pages",
+        "lru", "pins", "evicted_at", "neg_row",
+    )
+
+    def __init__(self, cname: str, d: str, indptr: np.ndarray,
+                 host: Dict[str, np.ndarray]) -> None:
+        self.cname = cname
+        self.d = d
+        self.V = int(indptr.shape[0]) - 1
+        self.E = int(host["nbr"].shape[0])
+        deg = np.diff(indptr).astype(np.int64)
+        deg_max = int(deg.max()) if deg.size else 0
+        self.W = max(int(config.tier_block_edges), deg_max, 1)
+        # quotient blocking: a vertex belongs to the block of its first
+        # edge's W-quotient, so a block spans < W + deg_max edges —
+        # vectorized, and hubs never split across blocks
+        self.Wp = K.bucket(self.W + deg_max, minimum=8)
+        q = (indptr[:-1].astype(np.int64) // self.W) if self.V else np.zeros(0, np.int64)
+        uq, inv = np.unique(q, return_inverse=True)
+        self.B = int(uq.shape[0])
+        self.block_of_v = inv.astype(np.int32)
+        first_v = np.searchsorted(inv, np.arange(self.B), side="left")
+        self.edge_start = np.concatenate(
+            [indptr[first_v].astype(np.int64), [self.E]]
+        ).astype(np.int32)
+        self.vdeg = deg.astype(np.int32)
+        # degree-skew placement priority: the hottest block holds the
+        # highest-degree vertex (hubs dominate frontier touch odds)
+        if self.B:
+            self.prio = np.maximum.reduceat(deg, first_v)
+        else:
+            self.prio = np.zeros(0, np.int64)
+        self.host = host  # name -> [E] int32 in this partition's order
+        # residency state (reset per install)
+        self.page_of = np.full(self.B, -1, np.int32)
+        self.block_of_page = np.zeros(0, np.int32)
+        self.free_pages: List[int] = []
+        self.lru: Dict[int, int] = {}
+        self.pins: Dict[int, int] = {}
+        self.evicted_at: Dict[int, int] = {}
+        self.P = 0
+        self.neg_row = None
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.cname, self.d)
+
+    def block_bytes(self) -> int:
+        return self.Wp * 4 * _POOL_ARRAYS
+
+    def block_values(self, name: str, b: int) -> np.ndarray:
+        lo, hi = int(self.edge_start[b]), int(self.edge_start[b + 1])
+        out = np.full(self.Wp, -1, np.int32)
+        out[: hi - lo] = self.host[name][lo:hi]
+        return out
+
+
+def _keys(cname: str, d: str) -> Dict[str, str]:
+    p = f"t:{cname}:{d}"
+    return {
+        "own": f"{p}:own", "nbr": f"{p}:nbr", "eid": f"{p}:eid",
+        "pageof": f"{p}:pageof", "blockv": f"{p}:blockv",
+        "estart": f"{p}:estart",
+    }
+
+
+class TierManager:
+    """Hot/cold residency manager for one snapshot's adjacency.
+
+    Built by :func:`maybe_tier_snapshot` when the snapshot's adjacency
+    exceeds ``config.tier_hbm_cap_bytes``; installed into the snapshot's
+    DeviceGraph at build time (`install`). All residency mutation runs
+    under ``self.lock``; dispatches grab their jit-arg pytree inside
+    `prepare_dispatch` so a concurrent eviction can never hand a plan a
+    torn (pool, page_of) pair."""
+
+    def __init__(self, snap, cap_bytes: int) -> None:
+        self.snap = snap
+        self.cap = int(cap_bytes)
+        self.lock = threading.RLock()
+        self.parts: Dict[Tuple[str, str], _Partition] = {}
+        for cname, csr in snap.edge_classes.items():
+            E = int(csr.dst.shape[0])
+            if E == 0:
+                continue
+            out_host = {
+                "own": csr.edge_src_np().astype(np.int32),
+                "nbr": np.asarray(csr.dst, np.int32),
+                # out-partition edge ids ARE the CSR positions
+                "eid": np.arange(E, dtype=np.int32),
+            }
+            in_host = {
+                # per-edge owning dst in in-CSR order (reverse hops
+                # activate the dst endpoint)
+                "own": np.repeat(
+                    np.arange(int(csr.indptr_in.shape[0]) - 1, dtype=np.int32),
+                    np.diff(csr.indptr_in),
+                ),
+                "nbr": np.asarray(csr.src, np.int32),
+                "eid": np.asarray(csr.edge_id_in, np.int32),
+            }
+            pair = [
+                _Partition(cname, d, indptr, host)
+                for d, indptr, host in (
+                    ("out", np.asarray(csr.indptr_out), out_host),
+                    ("in", np.asarray(csr.indptr_in), in_host),
+                )
+            ]
+            # a class tiers as a PAIR or not at all: the resident
+            # reverse hop reads the out-order arrays (dst/edge_src), so
+            # paging one direction while the other stays flat would
+            # leave the flat path without its arrays. Single-block
+            # partitions gain nothing from paging anyway.
+            if all(p.B >= 2 for p in pair):
+                for p in pair:
+                    self.parts[p.key] = p
+        self._size_pools()
+        self._dg = None
+        self.ensure_seq = 0
+        self.evictions = 0
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self._thrash: deque = deque()
+
+    def _size_pools(self) -> None:
+        """Split the byte cap across partitions proportionally to their
+        edge counts; each partition gets at least one page."""
+        tot = sum(p.E for p in self.parts.values()) or 1
+        for part in self.parts.values():
+            share = self.cap * part.E // tot
+            part.P = max(1, min(part.B, int(share // part.block_bytes())))
+
+    def pages_dir(self, cname: str, d: str) -> bool:
+        return (cname, d) in self.parts
+
+    # -- device install -----------------------------------------------------
+
+    def install(self, dg) -> None:
+        """Upload the tier plane into a freshly built DeviceGraph:
+        block indexes, empty pools, and the degree-skew hot seed."""
+        with self.lock:
+            self._dg = dg
+            for part in self.parts.values():
+                part.page_of = np.full(part.B, -1, np.int32)
+                part.block_of_page = np.full(part.P, -1, np.int32)
+                part.free_pages = list(range(part.P))
+                part.lru.clear()
+                part.pins.clear()
+                part.evicted_at.clear()
+                part.neg_row = jnp.full((part.Wp,), -1, jnp.int32)
+                keys = _keys(part.cname, part.d)
+                # seed the pool host-side (ONE upload per array) with
+                # the highest-priority blocks
+                order = np.argsort(-part.prio, kind="stable")[: part.P]
+                pools = {
+                    n: np.full((part.P, part.Wp), -1, np.int32)
+                    for n in ("own", "nbr", "eid")
+                }
+                for p, b in enumerate(order):
+                    b = int(b)
+                    for n in pools:
+                        pools[n][p] = part.block_values(n, b)
+                    part.page_of[b] = p
+                    part.block_of_page[p] = b
+                    part.lru[b] = 0
+                part.free_pages = list(range(len(order), part.P))
+                for n in ("own", "nbr", "eid"):
+                    dg._put(keys[n], pools[n])
+                dg._put(keys["pageof"], part.page_of)
+                dg._put(keys["blockv"], part.block_of_v)
+                dg._put(keys["estart"], part.edge_start)
+            self._publish()
+
+    # -- residency ----------------------------------------------------------
+
+    def ensure_vertices(self, cname: str, d: str, verts: np.ndarray,
+                        touched: Optional[Set] = None) -> None:
+        """Recording-time fault: make every block owning an edge of
+        these (concrete) frontier vertices resident before the gather
+        reads it. Runs inside the allowlisted recording boundary, so the
+        host-side index math is an intentional sync."""
+        part = self.parts.get((cname, d))
+        if part is None:
+            return
+        v = np.asarray(verts).reshape(-1)
+        v = v[(v >= 0) & (v < part.V)]
+        if v.size == 0:
+            return
+        v = v[part.vdeg[v] > 0]
+        if v.size == 0:
+            return
+        blocks = np.unique(part.block_of_v[v])
+        self._ensure_blocks(part, [int(b) for b in blocks], touched)
+
+    def ensure_frontier(self, cname: str, d: str, frontier: np.ndarray,
+                        touched: Optional[Set] = None) -> None:
+        """Recording-time fault for a [C, vb] frontier bitmap."""
+        part = self.parts.get((cname, d))
+        if part is None:
+            return
+        fa = np.asarray(frontier).any(axis=0)[: part.V]
+        self.ensure_vertices(cname, d, np.nonzero(fa)[0], touched)
+
+    def prepare_dispatch(self, footprint: FrozenSet, arg_subset):
+        """Dispatch-time footprint prefetch + atomic jit-arg grab: the
+        recorded footprint's cold blocks upload (async device_put — the
+        copies queue ahead of the dispatch and overlap the device work
+        in front of them), pins bump, and the plan's argument pytree is
+        snapshotted under the lock so eviction can never tear it."""
+        with self.lock:
+            by_part: Dict[Tuple[str, str], List[int]] = {}
+            for key, b in footprint:
+                by_part.setdefault(key, []).append(int(b))
+            for key, blocks in by_part.items():
+                part = self.parts.get(key)
+                if part is not None:
+                    self._ensure_blocks(part, blocks, None, pin=True)
+            return arg_subset()
+
+    def release_footprint(self, footprint: FrozenSet) -> None:
+        with self.lock:
+            for key, b in footprint:
+                part = self.parts.get(key)
+                if part is not None:
+                    n = part.pins.get(int(b), 0)
+                    if n <= 1:
+                        part.pins.pop(int(b), None)
+                    else:
+                        part.pins[int(b)] = n - 1
+
+    def _ensure_blocks(self, part: _Partition, blocks: List[int],
+                       touched: Optional[Set], pin: bool = False) -> None:
+        """Make ALL of ``blocks`` resident simultaneously.
+
+        Simultaneity is not optional: the caller is one expansion (the
+        recording's eager gather reads every block it touches in one
+        kernel) or one fused replay dispatch (which snapshots the pool
+        arrays ONCE as jit args). When the request exceeds the pool —
+        free pages plus evictable blocks outside the request — the pool
+        GROWS to the working set: the cap is enforced between queries
+        (LRU eviction shrinks residency back toward it), never inside a
+        dispatch, where violating it is the only way to be correct.
+        Growth is loud (``tier.pool_grow`` + the hot_bytes gauge)."""
+        dg = self._dg
+        if dg is None:
+            return
+        self.ensure_seq += 1
+        seq = self.ensure_seq
+        requested = set(blocks)
+        need = []
+        for b in blocks:
+            if touched is not None:
+                touched.add((part.key, b))
+            part.lru[b] = seq
+            if pin:
+                part.pins[b] = part.pins.get(b, 0) + 1
+            if part.page_of[b] < 0:
+                need.append(b)
+            else:
+                self.prefetch_hits += 1
+                metrics.incr("tier.prefetch.hits")
+        if need:
+            evictable = sum(
+                1
+                for b2 in range(part.B)
+                if part.page_of[b2] >= 0 and b2 not in requested
+            )
+            short = len(need) - len(part.free_pages) - evictable
+            if short > 0:
+                self._grow_pool(part, short)
+            self._load_blocks(part, need, seq, requested)
+        self._publish()
+
+    def _grow_pool(self, part: _Partition, extra: int) -> None:
+        dg = self._dg
+        keys = _keys(part.cname, part.d)
+        for n in ("own", "nbr", "eid"):
+            pad = jnp.full((extra, part.Wp), -1, jnp.int32)
+            dg._arrays[keys[n]] = jnp.concatenate([dg._arrays[keys[n]], pad])
+        part.free_pages.extend(range(part.P, part.P + extra))
+        part.block_of_page = np.concatenate(
+            [part.block_of_page, np.full(extra, -1, np.int32)]
+        )
+        part.P += extra
+        metrics.incr("tier.pool_grow")
+        metrics.incr("tier.pool_grow_pages", extra)
+
+    def _load_blocks(self, part: _Partition, need: List[int], seq: int,
+                     requested: Set[int]) -> None:
+        dg = self._dg
+        keys = _keys(part.cname, part.d)
+        nbytes = len(need) * part.block_bytes()
+        t0 = time.monotonic()
+        with span("tier.prefetch", cname=part.cname, d=part.d,
+                  blocks=len(need)):
+            for b in need:
+                last = part.evicted_at.get(b)
+                if last is not None and seq - last <= _THRASH_WINDOW:
+                    self._thrash.append(seq)
+                    metrics.incr("tier.thrash_events")
+                p = self._grab_page(part, requested)
+                for n in ("own", "nbr", "eid"):
+                    row = jax.device_put(part.block_values(n, b))
+                    dg._arrays[keys[n]] = dg._arrays[keys[n]].at[p].set(row)
+                dg._arrays[keys["pageof"]] = (
+                    dg._arrays[keys["pageof"]].at[b].set(p)
+                )
+                part.page_of[b] = p
+                part.block_of_page[p] = b
+                self.prefetch_misses += 1
+                metrics.incr("tier.prefetch.misses")
+        TL.add_transfer(t0, time.monotonic(), nbytes, "prefetch")
+        TL.mark("tier_prefetch")
+
+    def _grab_page(self, part: _Partition, protect: Set[int]) -> int:
+        if part.free_pages:
+            return part.free_pages.pop()
+        # LRU victim outside the current request, unpinned preferred; a
+        # fully pinned remainder still evicts (functional arrays keep
+        # in-flight dispatches safe) but counts the forced choice
+        resident = [
+            b
+            for b in range(part.B)
+            if part.page_of[b] >= 0 and b not in protect
+        ]
+        victim = min(
+            resident,
+            key=lambda b: (part.pins.get(b, 0) > 0, part.lru.get(b, -1)),
+        )
+        if part.pins.get(victim, 0) > 0:
+            metrics.incr("tier.evict_pinned")
+        return self._evict(part, victim)
+
+    def _evict(self, part: _Partition, b: int) -> int:
+        dg = self._dg
+        keys = _keys(part.cname, part.d)
+        with span("tier.evict", cname=part.cname, d=part.d, block=int(b)):
+            p = int(part.page_of[b])
+            # invalidate the page's owner row so the flattened bitmap
+            # hop masks its slots out; nbr/eid stay stale-but-masked,
+            # and the gather path guards via page_of
+            dg._arrays[keys["own"]] = (
+                dg._arrays[keys["own"]].at[p].set(part.neg_row)
+            )
+            dg._arrays[keys["pageof"]] = (
+                dg._arrays[keys["pageof"]].at[b].set(jnp.int32(-1))
+            )
+            part.page_of[b] = -1
+            part.block_of_page[p] = -1
+            part.lru.pop(b, None)
+            part.evicted_at[b] = self.ensure_seq
+            self.evictions += 1
+            metrics.incr("tier.evictions.total")
+        TL.mark("tier_evict")
+        return p
+
+    # -- observability ------------------------------------------------------
+
+    def hot_bytes(self) -> int:
+        total = 0
+        for part in self.parts.values():
+            total += part.P * part.block_bytes()
+            total += 4 * (part.B + part.B + 1 + part.V + part.P)
+        return total
+
+    def thrash_rate(self) -> float:
+        floor = self.ensure_seq - _THRASH_WINDOW
+        while self._thrash and self._thrash[0] <= floor:
+            self._thrash.popleft()
+        return float(len(self._thrash))
+
+    def _publish(self) -> None:
+        metrics.gauge("tier.hot_bytes", self.hot_bytes())
+        metrics.gauge("tier.evictions", self.evictions)
+        looked = self.prefetch_hits + self.prefetch_misses
+        metrics.gauge(
+            "tier.prefetch_hit",
+            (self.prefetch_hits / looked) if looked else 1.0,
+        )
+        metrics.gauge("tier.thrash", self.thrash_rate())
+
+    def stats(self) -> Dict:
+        return {
+            "cap_bytes": self.cap,
+            "hot_bytes": self.hot_bytes(),
+            "partitions": len(self.parts),
+            "evictions": self.evictions,
+            "prefetch_hits": self.prefetch_hits,
+            "prefetch_misses": self.prefetch_misses,
+            "thrash": self.thrash_rate(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# paged kernels (trace-safe: read everything through the arrays pytree)
+# ---------------------------------------------------------------------------
+
+
+def paged_hop(arrays, cname: str, d: str, emask, frontier):
+    """One frontier bitmap hop over a paged partition: the pool
+    flattens to a [P*Wp] edge list whose unused/evicted slots carry
+    owner −1 and mask out; an optional [E] emask gathers through the
+    per-slot global edge id."""
+    keys = _keys(cname, d)
+    own = arrays[keys["own"]].reshape(-1)
+    nbr = arrays[keys["nbr"]].reshape(-1)
+    m = own >= 0
+    if emask is not None:
+        eid = arrays[keys["eid"]].reshape(-1)
+        m = m & K.take_pad(emask, eid, False)
+    return K.bitmap_hop(own, nbr, m, frontier)
+
+
+def paged_hop_miss(arrays, cname: str, d: str, frontier):
+    """Device-side cold-miss flag for a frontier hop: any active vertex
+    with edges whose block is not resident."""
+    keys = _keys(cname, d)
+    blockv = arrays[keys["blockv"]]
+    pageof = arrays[keys["pageof"]]
+    ind_key = f"e:{cname}:indptr_{'out' if d == 'out' else 'in'}"
+    indptr = arrays[ind_key]
+    V = blockv.shape[0]
+    fa = frontier.any(axis=0)[:V]
+    deg = indptr[1:] - indptr[:-1]
+    act = fa & (deg > 0)
+    touched = jnp.zeros(pageof.shape[0], bool).at[blockv].max(act)
+    return (touched & (pageof < 0)).any()
+
+
+def paged_expand(arrays, cname: str, d: str, srcs, offsets, total_dev,
+                 out_size: int, Wp: int):
+    """CSR gather over a paged partition: row/edge_pos come from the
+    resident indptr exactly as the flat path's gather_expand; the
+    neighbor (and, reverse, the out-order edge id) read from the pool
+    through the block→page indirection. Returns
+    ``(row, eid, nbr, cold_miss_flag)`` — cold slots null out and flag,
+    so replays off their recorded footprint overflow-re-record."""
+    keys = _keys(cname, d)
+    ind_key = f"e:{cname}:indptr_{'out' if d == 'out' else 'in'}"
+    indptr = arrays[ind_key]
+    row, edge_pos, _n = K.gather_expand(
+        indptr, jnp.zeros((0,), jnp.int32), srcs, offsets, total_dev, out_size
+    )
+    blockv = arrays[keys["blockv"]]
+    pageof = arrays[keys["pageof"]]
+    estart = arrays[keys["estart"]]
+    V = blockv.shape[0]
+    src = K.take_pad(srcs, row, jnp.int32(-1))
+    live = row >= 0
+    b = jnp.take(blockv, jnp.clip(src, 0, max(V - 1, 0)))
+    p = jnp.take(pageof, b)
+    local = edge_pos - jnp.take(estart, b)
+    flat = jnp.clip(p, 0) * Wp + jnp.clip(local, 0, Wp - 1)
+    nbr = jnp.take(arrays[keys["nbr"]].reshape(-1), flat)
+    if d == "out":
+        eid = edge_pos
+    else:
+        eid = jnp.take(arrays[keys["eid"]].reshape(-1), flat)
+    cold = live & (p < 0)
+    ok = live & ~cold
+    row = jnp.where(ok, row, -1)
+    eid = jnp.where(ok, eid, -1)
+    nbr = jnp.where(ok, nbr, -1)
+    return row, eid, nbr, cold.any()
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+
+def maybe_tier_snapshot(snap) -> Optional[TierManager]:
+    """Snapshot admission: when ``tier_hbm_cap_bytes`` is set and the
+    snapshot's adjacency exceeds it, attach a TierManager so the device
+    build pages adjacency instead of uploading it flat. Under-cap
+    snapshots stay fully resident. Tiered + mesh and tiered + delta
+    overlay refuse loudly — both planes assume flat resident
+    adjacency."""
+    cap = int(config.tier_hbm_cap_bytes)
+    if cap <= 0:
+        return None
+    existing = getattr(snap, "_tier", None)
+    if existing is not None:
+        return existing
+    if adjacency_bytes(snap) <= cap:
+        return None
+    if getattr(snap, "_mesh", None) is not None:
+        raise ValueError(
+            "tiered snapshots are single-device: adjacency exceeds "
+            "tier_hbm_cap_bytes but a mesh is attached — raise the cap, "
+            "drop the mesh, or shard the graph instead"
+        )
+    if getattr(snap, "_overlay", None) is not None:
+        raise ValueError(
+            "delta-maintained snapshots cannot tier: adjacency exceeds "
+            "tier_hbm_cap_bytes with a delta overlay armed — compact to "
+            "a clean snapshot before tiering"
+        )
+    tier = snap._tier = TierManager(snap, cap)
+    metrics.incr("tier.admissions")
+    return tier

@@ -264,6 +264,20 @@ class GlobalConfiguration:
     delta_slab_edge_slots: int = 4096
     delta_compact_ratio: float = 0.75
 
+    # Tiered snapshots (storage/tiering; README "Tiered snapshots &
+    # HBM cap"): when tier_hbm_cap_bytes > 0 and a snapshot's flat
+    # adjacency exceeds it, admission attaches a TierManager — the
+    # adjacency pages between a device-resident hot pool and host-pinned
+    # cold blocks instead of uploading flat. 0 disables tiering.
+    # tier_block_edges sets the target edges per block (the quotient
+    # blocking widens a block that lands on a hub vertex rather than
+    # splitting it). alert_tier_thrash is the tier_thrash alert
+    # threshold: thrash events (reload of a recently evicted block)
+    # per thrash window before the rule fires.
+    tier_hbm_cap_bytes: int = 0
+    tier_block_edges: int = 65536
+    alert_tier_thrash: float = 8.0
+
     # Materialized continuous MATCH views (exec/views): results of hot
     # fingerprints (>= view_min_calls recorded calls in the stats
     # table) are kept resident and served at cache speed, invalidated

@@ -259,7 +259,21 @@ class TestEvidence:
             # would only slow the race to the first timed block and
             # drop a BENCH_SLO_r*.json in the repo root
         )
-        details_before = set(glob.glob(os.path.join(REPO, "BENCH_DETAIL_r*.json")))
+        def bench_art(pat):
+            return set(glob.glob(os.path.join(REPO, pat)))
+
+        details_before = (
+            bench_art("BENCH_DETAIL_r*.json")
+            | bench_art("BENCH_DETAIL_r*.json.prev")
+            | bench_art("BENCH_SLO_r*.json")
+        )
+        # the early headline flush OVERWRITES the repo-root (tracked)
+        # BENCH_HEADLINE_r{N}.json with this partial run's numbers —
+        # snapshot it for restore, not just unlink
+        heads_before = {
+            p: open(p, "rb").read()
+            for p in bench_art("BENCH_HEADLINE_r*.json")
+        }
         proc = subprocess.Popen(
             [sys.executable, os.path.join(REPO, "bench.py")],
             env=env,
@@ -289,11 +303,22 @@ class TestEvidence:
         finally:
             if proc.poll() is None:
                 proc.kill()
-            # a run that outraced the kill wrote its detail artifact —
-            # keep the worktree clean either way
-            for p in set(
-                glob.glob(os.path.join(REPO, "BENCH_DETAIL_r*.json"))
+            # a run that outraced the kill wrote its artifacts — keep
+            # the worktree clean either way
+            for p in (
+                bench_art("BENCH_DETAIL_r*.json")
+                | bench_art("BENCH_DETAIL_r*.json.prev")
+                | bench_art("BENCH_SLO_r*.json")
             ) - details_before:
+                os.unlink(p)
+            for p, data in heads_before.items():
+                if (not os.path.exists(p)
+                        or open(p, "rb").read() != data):
+                    with open(p, "wb") as f:
+                        f.write(data)
+            for p in bench_art("BENCH_HEADLINE_r*.json") - set(
+                heads_before
+            ):
                 os.unlink(p)
         recs = read_evidence(ev)
         blocks = [r["block"] for r in recs]
