@@ -1486,6 +1486,21 @@ def _measure() -> None:
         except Exception as e:
             ev("watchdog", error=f"{type(e).__name__}: {e}")
 
+    # device-memory evidence per round (ISSUE 17): the ledger's
+    # peak/steady bytes per owner, the reconciliation residue against
+    # jax.live_arrays, and the leak count ride the evidence stream —
+    # perfdiff gates on the peak-HBM leaf so a perf win that costs
+    # unattributed device memory cannot land silently
+    if budget_ok("memory", est_s=5):
+        try:
+            from orientdb_tpu.obs.memledger import bench_memory_summary
+
+            _ms = bench_memory_summary()
+            extras["memory"] = _ms
+            ev("memory", **_ms)
+        except Exception as e:
+            ev("memory", error=f"{type(e).__name__}: {e}")
+
     # mixed production-shaped traffic under chaos, judged by the SLO
     # plane (ISSUE 11): the closed-loop simulator runs its OWN small
     # cluster + dataset, so it neither needs nor disturbs the demodb

@@ -3268,8 +3268,15 @@ class _CompiledPlan(_AotWarmup):
         if pages and 0 <= idx < len(pages):
             _copy_to_host_async(pages[idx])
             metrics.incr("tpu.page_prefetch.start")
+            from orientdb_tpu.obs.memledger import memledger
             from orientdb_tpu.obs.timeline import note_prefetch_start
 
+            memledger.register(
+                "prefetched_page",
+                f"plan:{id(self):x}",
+                "spec_page",
+                arr=pages[idx],
+            )
             note_prefetch_start()
 
     def batchable(self) -> bool:
@@ -3991,6 +3998,16 @@ class ParamRing:
         metrics.incr("tpu.param_ring.upload")
         metrics.incr("tpu.param_ring.bytes", nbytes)
         note_ring(False, nbytes)
+        from orientdb_tpu.obs.memledger import memledger
+
+        memledger.register(
+            "param_ring",
+            f"ring:{id(self):x}",
+            f"slot:{self._next}",
+            arr=next(iter(dev.values()), None) if dev else None,
+            nbytes=nbytes,
+            pinned=True,
+        )
         self._slots[self._next] = (host, dev)
         self._next = (self._next + 1) % len(self._slots)
         return dev
@@ -4279,6 +4296,7 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
         add_phase as _tl_add_phase,
         note_prefetch as _tl_note_prefetch,
     )
+    from orientdb_tpu.obs.memledger import memledger as _ml
 
     pages_sel: List = [None] * len(pending)
     seen_groups = set()
@@ -4319,6 +4337,7 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
         plan._page_guess = (idx, f16)
         _copy_to_host_async(d)
         pages_sel[k] = d
+        _ml.register("result_page", f"plan:{id(plan):x}", "page", arr=d)
     # rows groups: elect ONE compact page for each group's whole lane
     # stack — a single slice(+int16 cast) Execute and a single host
     # copy replace B per-query ladders (the measured rows-path floor
@@ -4378,6 +4397,7 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
                     grp.data_dev, len(lane_metas), need, fits16
                 )
         _copy_to_host_async(d)
+        _ml.register("result_page", f"grp:{id(grp):x}", "page", arr=d)
         grp_fetch.append((grp, d))
     t1 = _time.perf_counter()
     datas: List = [None] * len(pending)

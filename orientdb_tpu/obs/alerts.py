@@ -78,6 +78,15 @@ RULE_CATALOG: Dict[str, str] = {
     "recently evicted blocks faster than alert_tier_thrash events per "
     "window (tier.thrash gauge) — the hot working set does not fit "
     "tier_hbm_cap_bytes and dispatches are churning the pool",
+    "hbm_epoch_leak": "a snapshot epoch's dispatch lease "
+    "(GraphSnapshot.retain) has been outstanding longer than "
+    "memledger_leak_s — its refcount pins device buffers with no "
+    "dispatch retiring it (obs/memledger; the exemplar is the "
+    "retaining lease's own trace id)",
+    "hbm_headroom": "attributed device-memory ledger bytes "
+    "(hbm.ledger_bytes) crossed memledger_headroom_fraction of the "
+    "tier plane's HBM budget (tier.cap_bytes / tier_hbm_cap_bytes) — "
+    "the next pool grow or snapshot upload may not fit",
 }
 
 #: two-window burn-rate windows (seconds): the short window catches the
@@ -106,15 +115,24 @@ class Breach:
     member name, breaker name, or fingerprint id), the measured value,
     the threshold it crossed, and a human detail line."""
 
-    __slots__ = ("key", "value", "threshold", "detail")
+    __slots__ = ("key", "value", "threshold", "detail", "trace_id")
 
     def __init__(
-        self, key: str, value: float, threshold: float, detail: str
+        self,
+        key: str,
+        value: float,
+        threshold: float,
+        detail: str,
+        trace_id: Optional[str] = None,
     ) -> None:
         self.key = key
         self.value = value
         self.threshold = threshold
         self.detail = detail
+        #: a breach that KNOWS its exemplar (e.g. the retaining lease's
+        #: trace id for hbm_epoch_leak) carries it; _exemplar prefers
+        #: this over the slowlog/span-ring heuristics
+        self.trace_id = trace_id
 
 
 class AlertRule:
@@ -379,6 +397,8 @@ class AlertEngine:
         from orientdb_tpu.obs.slowlog import slowlog
         from orientdb_tpu.obs.trace import tracer
 
+        if br.trace_id is not None:
+            return br.trace_id
         if rule.exemplar == "slowlog":
             best = None
             for e in slowlog.entries():
@@ -592,6 +612,49 @@ class AlertEngine:
                 v,
                 thr,
                 f"delta slab {v:.0%} full (compaction falling behind)",
+            )
+
+    def _check_hbm_epoch_leak(self, ctx: AlertContext) -> Iterable[Breach]:
+        """One breach per stale snapshot lease (obs/memledger): a
+        retain() outstanding past memledger_leak_s pins device buffers
+        with no dispatch retiring it. The breach carries the retaining
+        lease's own trace id — the exemplar joins the exact dispatch
+        that never released."""
+        leak_s = config.memledger_leak_s
+        if leak_s <= 0:
+            return
+        from orientdb_tpu.obs.memledger import memledger
+
+        for lease in memledger.stale_leases():
+            yield Breach(
+                f"e{lease['epoch']}",
+                lease["age_s"],
+                leak_s,
+                f"epoch {lease['epoch']} lease outstanding "
+                f"{lease['age_s']:.1f}s ({lease['outstanding']} pins) — "
+                "device buffers cannot free",
+                trace_id=lease["trace_id"],
+            )
+
+    def _check_hbm_headroom(self, ctx: AlertContext) -> Iterable[Breach]:
+        """Attributed ledger bytes vs the tier plane's HBM budget
+        (``tier_hbm_cap_bytes`` — the config value, NOT the published
+        ``tier.cap_bytes`` gauge: gauges outlive a detached tier, and a
+        stale cap from a long-gone plane must not keep this rule armed).
+        0 cap = unbounded plane = rule off."""
+        cap = float(config.tier_hbm_cap_bytes)
+        frac = config.memledger_headroom_fraction
+        if cap <= 0 or frac <= 0:
+            return
+        v = ctx.gauges.get("hbm.ledger_bytes", 0.0)
+        thr = cap * frac
+        if v > thr:
+            yield Breach(
+                "hbm",
+                v,
+                thr,
+                f"attributed HBM {v / (1 << 20):.1f} MiB past "
+                f"{frac:.0%} of the {cap / (1 << 20):.1f} MiB cap",
             )
 
     def _check_tier_thrash(self, ctx: AlertContext) -> Iterable[Breach]:
@@ -810,6 +873,16 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
         "tier_thrash", "warning",
         AlertEngine._check_tier_thrash,
         exemplar_spans=("tier.",),
+    ),
+    _rule(
+        "hbm_epoch_leak", "critical",
+        AlertEngine._check_hbm_epoch_leak,
+        exemplar_spans=("tpu.", "query"),
+    ),
+    _rule(
+        "hbm_headroom", "warning",
+        AlertEngine._check_hbm_headroom,
+        exemplar_spans=("tier.", "tpu.load"),
     ),
 )
 

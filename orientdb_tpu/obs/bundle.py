@@ -26,7 +26,11 @@ history as ONE artifact, not four endpoints scraped in a hurry:
   the traces that would explain why not;
 - the bounded log ring (``utils/logging.log_ring``): recent structured
   log records carrying the trace/span ids of whatever emitted them —
-  an alert, its exemplar trace, and its log lines join on one id.
+  an alert, its exemplar trace, and its log lines join on one id;
+- the device-memory ledger (``obs/memledger``): attributed HBM by
+  owner kind, the watermark ring, a reconciliation pass against
+  ``jax.live_arrays()``, and outstanding/stale epoch leases — what is
+  in HBM, who owns it, and whether anything is leaking.
 
 Served as ``GET /debug/bundle`` (admin-only) and from the console as
 ``DIAG [<path>]``. Everything here is JSON-friendly by construction.
@@ -37,6 +41,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Iterable, List, Optional
 
+from orientdb_tpu.obs.memledger import memledger
 from orientdb_tpu.obs.registry import snapshot_all
 from orientdb_tpu.obs.slowlog import slowlog
 from orientdb_tpu.obs.trace import tracer
@@ -133,6 +138,11 @@ def debug_bundle(
                 window_s=config.timeline_window_s, limit=50
             ),
         },
+        # the device-memory ledger (obs/memledger): per-owner HBM
+        # rollup, watermark ring, reconciliation vs jax.live_arrays,
+        # and lease/refusal state — what is in HBM and who owns it,
+        # next to the traces that put it there
+        "memory": memledger.report(),
         # recent structured log records, trace/span-correlated — the
         # ring is bounded (config.log_ring_capacity) and ships only
         # inside this admin-only bundle

@@ -85,6 +85,10 @@ SPAN_CATALOG: Dict[str, str] = {
     "recorded as prefetch-kind transfers in the flight recorder)",
     "tier.evict": "tiered snapshot block eviction (owner row cleared, "
     "page recycled under tier_hbm_cap_bytes pressure)",
+    "memledger.reconcile": "device-memory ledger reconciliation pass "
+    "(obs/memledger: ledger totals diffed against jax.live_arrays — "
+    "untracked = instrumentation gap, tracked-but-dead = leak "
+    "candidate, dead transients pruned)",
 }
 
 #: dynamically named span families (f-string call sites the literal

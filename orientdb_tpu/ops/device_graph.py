@@ -247,6 +247,12 @@ class DeviceGraph:
         #: keys they touch, so lazily uploaded columns growing this dict
         #: never change any cached plan's pytree structure)
         self._arrays: Dict[str, jnp.ndarray] = {}
+        #: device-memory ledger owner id (obs/memledger): every array
+        #: this graph puts in HBM is attributed here; _free_device
+        #: drops the whole owner in one call
+        self._ledger_owner = (
+            f"snap:{id(snap):x}:e{int(getattr(snap, 'epoch', 0) or 0)}"
+        )
         #: host arrays registered but not yet uploaded (lazy columns):
         #: key -> (host_array, shard_pad, fill)
         self._pending: Dict[str, tuple] = {}
@@ -264,6 +270,11 @@ class DeviceGraph:
             # same composition rule as mesh + overlay below: the mesh
             # layout re-partitions adjacency shard-wise and knows
             # nothing of the hot/cold pools
+            from orientdb_tpu.obs.memledger import memledger
+
+            memledger.note_refusal(
+                "mesh", "tiered snapshot built against a device mesh"
+            )
             raise ValueError(
                 "tiered snapshots are single-device; drop the mesh or "
                 "raise tier_hbm_cap_bytes"
@@ -406,6 +417,13 @@ class DeviceGraph:
                     jax.device_put(va)
                 )
                 nbytes += int(ia.nbytes) + int(va.nbytes)
+                # the overlay write produced a NEW device array under
+                # the same key: refresh its ledger attribution in place
+                from orientdb_tpu.obs.memledger import memledger
+
+                memledger.register_graph_array(
+                    self, key, self._arrays[key]
+                )
         return nbytes
 
     def _put(
@@ -436,12 +454,18 @@ class DeviceGraph:
                 self.mesh_graph.mesh, PartitionSpec(_cfg.mesh_shard_axis)
             )
             self._arrays[key] = jax.device_put(a, spec)
+            from orientdb_tpu.obs.memledger import memledger
+
+            memledger.register_graph_array(self, key, self._arrays[key])
             return key
         if self._replicated_spec is not None:
             import jax
 
             a = jax.device_put(a, self._replicated_spec)
         self._arrays[key] = a
+        from orientdb_tpu.obs.memledger import memledger
+
+        memledger.register_graph_array(self, key, a)
         return key
 
     @property
@@ -509,6 +533,13 @@ class DeviceGraph:
         if ids is None:
             ids = self._class_ids[key] = jnp.asarray(
                 self.snap.vertex_class_ids(class_name)
+            )
+            # baked into plan executables as constants — attributed so
+            # the ledger's snapshot rollup covers the whole footprint
+            from orientdb_tpu.obs.memledger import memledger
+
+            memledger.register(
+                "plan_const", self._ledger_owner, f"cls:{key}", arr=ids
             )
         return ids
 

@@ -504,6 +504,23 @@ class _Handler(BaseHTTPRequestHandler):
                         },
                     )
                 return self._send(200, recorder.chrome_trace(window_s=window))
+            if head == "debug" and rest == ["memory"]:
+                # the device-memory ledger (obs/memledger): per-owner
+                # rollup, watermark ring, live reconciliation vs
+                # jax.live_arrays, outstanding/stale epoch leases, and
+                # the last tier refusal. Admin-only (owner ids name
+                # snapshots and plans). ?reconcile=0 skips the live
+                # pass and serves the last cached report.
+                self.server.ot_server.security.check(
+                    user, "server.debug", "read"
+                )
+                from orientdb_tpu.obs.memledger import memledger
+
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                rec = q.get("reconcile", ["1"])[0] != "0"
+                return self._send(200, memledger.report(reconcile=rec))
             if head == "debug" and rest == ["bundle"]:
                 # the flight-recorder bundle (obs/bundle): recent
                 # cross-node traces assembled by trace_id, slowlog,

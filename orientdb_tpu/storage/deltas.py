@@ -285,6 +285,11 @@ def pad_for_deltas(
     if getattr(snap, "_tier", None) is not None:
         # the slab scan and patch kernels read the flat [E] arrays the
         # tier pages out of HBM — the two planes don't compose (yet)
+        from orientdb_tpu.obs.memledger import memledger
+
+        memledger.note_refusal(
+            "overlay", "delta maintenance requested on a tiered snapshot"
+        )
         raise ValueError(
             "tiered snapshots are immutable: delta maintenance needs the "
             "flat resident edge arrays — detach the tier (raise "
@@ -989,6 +994,17 @@ class SnapshotMaintainer:
                 "snapshot compacted (%s): epoch %d", reason, snap.epoch
             )
             if old is not None and old is not snap:
+                # the swap's device-side free routes through the epoch
+                # refcount (_free_device drops the old graph's ledger
+                # owner); the breadcrumb makes the swap itself visible
+                # in GET /debug/memory next to the watermark it moved
+                from orientdb_tpu.obs.memledger import memledger
+
+                memledger.note_event(
+                    "compaction",
+                    f"{reason}: epoch {getattr(old, 'epoch', '?')} -> "
+                    f"{snap.epoch}",
+                )
                 old.release_device()
             return snap
 

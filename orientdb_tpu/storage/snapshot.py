@@ -231,6 +231,9 @@ class GraphSnapshot:
         """Pin the device buffers for an in-flight dispatch."""
         with self._rc_lock:
             self._inflight += 1
+        from orientdb_tpu.obs.memledger import memledger
+
+        memledger.lease_acquired(self)
         return self
 
     def try_retain(self, dg) -> bool:
@@ -245,6 +248,9 @@ class GraphSnapshot:
             if self._device_cache is not dg:
                 return False
             self._inflight += 1
+        from orientdb_tpu.obs.memledger import memledger
+
+        memledger.lease_acquired(self)
         return True
 
     def release(self) -> None:
@@ -255,6 +261,9 @@ class GraphSnapshot:
             run_free = self._release_pending and self._inflight == 0
             if run_free:
                 self._release_pending = False
+        from orientdb_tpu.obs.memledger import memledger
+
+        memledger.lease_released(self)
         if run_free:
             self._free_device()
 
@@ -294,6 +303,15 @@ class GraphSnapshot:
                     pass
             dg._arrays.clear()
             dg._pending.clear()
+            from orientdb_tpu.obs.memledger import memledger
+
+            memledger.drop_graph(dg)
+        tier = getattr(self, "_tier", None)
+        if tier is not None:
+            # retract the tier gauges with the buffers: a stale
+            # tier.cap_bytes from a freed plane must not keep feeding
+            # alert rules for the rest of the process
+            tier.unpublish()
         cache = getattr(self, "_plan_cache", None)
         if cache is not None:
             cache.clear()

@@ -374,6 +374,14 @@ class TierManager:
         part.P += extra
         metrics.incr("tier.pool_grow")
         metrics.incr("tier.pool_grow_pages", extra)
+        from orientdb_tpu.obs.memledger import memledger
+
+        for n in ("own", "nbr", "eid"):
+            memledger.register_graph_array(dg, keys[n], dg._arrays[keys[n]])
+        memledger.note_event(
+            "pool_grow",
+            f"{part.cname}/{part.d}: +{extra} pages -> P={part.P}",
+        )
 
     def _load_blocks(self, part: _Partition, need: List[int], seq: int,
                      requested: Set[int]) -> None:
@@ -401,6 +409,13 @@ class TierManager:
                 metrics.incr("tier.prefetch.misses")
         TL.add_transfer(t0, time.monotonic(), nbytes, "prefetch")
         TL.mark("tier_prefetch")
+        # the functional .at[].set writes produced NEW pool arrays:
+        # refresh their ledger attribution (reconcile tracks liveness
+        # through the registered array identity)
+        from orientdb_tpu.obs.memledger import memledger
+
+        for n in ("own", "nbr", "eid", "pageof"):
+            memledger.register_graph_array(dg, keys[n], dg._arrays[keys[n]])
 
     def _grab_page(self, part: _Partition, protect: Set[int]) -> int:
         if part.free_pages:
@@ -441,6 +456,12 @@ class TierManager:
             part.evicted_at[b] = self.ensure_seq
             self.evictions += 1
             metrics.incr("tier.evictions.total")
+            from orientdb_tpu.obs.memledger import memledger
+
+            for n in ("own", "pageof"):
+                memledger.register_graph_array(
+                    dg, keys[n], dg._arrays[keys[n]]
+                )
         TL.mark("tier_evict")
         return p
 
@@ -453,6 +474,17 @@ class TierManager:
             total += 4 * (part.B + part.B + 1 + part.V + part.P)
         return total
 
+    def pool_bytes(self) -> int:
+        """Device bytes the hot pools occupy RIGHT NOW (pages only —
+        ``hot_bytes`` adds the per-partition index overhead). The
+        numerator the ``hbm_headroom`` rule's cap gauge divides."""
+        return sum(
+            part.P * part.block_bytes() for part in self.parts.values()
+        )
+
+    def headroom_bytes(self) -> int:
+        return max(0, int(self.cap) - self.hot_bytes())
+
     def thrash_rate(self) -> float:
         floor = self.ensure_seq - _THRASH_WINDOW
         while self._thrash and self._thrash[0] <= floor:
@@ -461,6 +493,12 @@ class TierManager:
 
     def _publish(self) -> None:
         metrics.gauge("tier.hot_bytes", self.hot_bytes())
+        # pool occupancy + the cap as gauges: the hbm_headroom rule's
+        # denominator, and the invisible-occupancy fix — pool_grow was
+        # a loud counter but nothing showed HOW BIG the pool is
+        metrics.gauge("tier.pool_bytes", self.pool_bytes())
+        metrics.gauge("tier.cap_bytes", float(self.cap))
+        metrics.gauge("tier.headroom_bytes", self.headroom_bytes())
         metrics.gauge("tier.evictions", self.evictions)
         looked = self.prefetch_hits + self.prefetch_misses
         metrics.gauge(
@@ -469,10 +507,29 @@ class TierManager:
         )
         metrics.gauge("tier.thrash", self.thrash_rate())
 
+    def unpublish(self) -> None:
+        """Retract this tier's gauges from the process-global registry
+        (device free / detach): gauges otherwise outlive the plane and
+        a stale ``tier.cap_bytes``/``tier.thrash`` keeps reading as a
+        live signal to alert rules and dashboards. A later re-admission
+        republishes on the next ``_publish()``."""
+        for g in (
+            "tier.hot_bytes",
+            "tier.pool_bytes",
+            "tier.cap_bytes",
+            "tier.headroom_bytes",
+            "tier.evictions",
+            "tier.prefetch_hit",
+            "tier.thrash",
+        ):
+            metrics.drop_gauge(g)
+
     def stats(self) -> Dict:
         return {
             "cap_bytes": self.cap,
             "hot_bytes": self.hot_bytes(),
+            "pool_bytes": self.pool_bytes(),
+            "headroom_bytes": self.headroom_bytes(),
             "partitions": len(self.parts),
             "evictions": self.evictions,
             "prefetch_hits": self.prefetch_hits,
@@ -574,13 +631,24 @@ def maybe_tier_snapshot(snap) -> Optional[TierManager]:
         return existing
     if adjacency_bytes(snap) <= cap:
         return None
+    from orientdb_tpu.obs.memledger import memledger
+
     if getattr(snap, "_mesh", None) is not None:
+        # refusals used to raise loudly and vanish: count them and
+        # keep the last reason visible in GET /debug/memory
+        memledger.note_refusal(
+            "mesh", "adjacency exceeds the cap but a mesh is attached"
+        )
         raise ValueError(
             "tiered snapshots are single-device: adjacency exceeds "
             "tier_hbm_cap_bytes but a mesh is attached — raise the cap, "
             "drop the mesh, or shard the graph instead"
         )
     if getattr(snap, "_overlay", None) is not None:
+        memledger.note_refusal(
+            "overlay",
+            "adjacency exceeds the cap with a delta overlay armed",
+        )
         raise ValueError(
             "delta-maintained snapshots cannot tier: adjacency exceeds "
             "tier_hbm_cap_bytes with a delta overlay armed — compact to "

@@ -16,6 +16,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   CDC LIST                            CDC LAG
   ALERTS [<n>|HISTORY]                HEALTH
   SLO                                 TIMELINE [<n>]
+  MEMORY [OWNERS|WATERMARK]
 """
 
 from __future__ import annotations
@@ -369,6 +370,72 @@ class Console(cmd.Cmd):
                 f"{len(r['events'])} events"
             )
         self._p(f"({len(recs)} records)")
+
+    def do_memory(self, arg: str) -> None:
+        """MEMORY [OWNERS|WATERMARK] — the device-memory ledger
+        (obs/memledger): OWNERS (the default) prints the per-kind HBM
+        rollup, the reconciliation verdict against jax.live_arrays,
+        and lease/refusal state; WATERMARK prints the recent
+        total-bytes watermark ring. The full document is
+        GET /debug/memory."""
+        from orientdb_tpu.obs.memledger import memledger
+
+        sub = (arg.strip().split() or ["owners"])[0].lower()
+        if sub not in ("owners", "watermark"):
+            self._p("!! usage: MEMORY [OWNERS|WATERMARK]")
+            return
+        if sub == "watermark":
+            marks = memledger.watermarks()
+            if not marks:
+                self._p("watermark ring empty (no device registrations)")
+                return
+            for ts, b in marks:
+                self._p(f"{ts:>14.3f}  {b:>14} B  ({b / (1 << 20):8.2f} MiB)")
+            self._p(
+                f"({len(marks)} marks, peak {memledger.peak_total()} B)"
+            )
+            return
+        rep = memledger.report()
+        for kind, row in rep["owners"].items():
+            self._p(
+                f"{kind:<16} {row['bytes']:>12} B  "
+                f"entries={row['entries']:<5} owners={row['owners']:<4} "
+                f"oldest={row['oldest_s']:g}s"
+            )
+        self._p(
+            f"total {rep['total_bytes']} B  peak {rep['peak_bytes']} B  "
+            f"pinned {rep['pinned_bytes']} B  entries {rep['entries']}"
+        )
+        rec = rep.get("reconcile") or {}
+        if rec:
+            self._p(
+                f"reconcile: {'ok' if rec.get('ok') else 'RESIDUE'}  "
+                f"untracked={rec.get('untracked_bytes', 0)} B  "
+                f"tracked_dead={rec.get('tracked_dead_bytes', 0)} B  "
+                f"reclaimed={rec.get('reclaimed_bytes', 0)} B"
+            )
+        leases = rep.get("leases", {})
+        stale = leases.get("stale", [])
+        self._p(
+            f"leases: {leases.get('outstanding', 0)} outstanding, "
+            f"{len(stale)} stale"
+        )
+        for lease in stale:
+            self._p(
+                f"  !! epoch {lease['epoch']} held {lease['age_s']:g}s "
+                f"trace={lease['trace_id'] or '-'}"
+            )
+        refusals = rep.get("refusals", {})
+        if refusals.get("counts"):
+            last = refusals.get("last") or {}
+            self._p(
+                f"refusals: {refusals['counts']}"
+                + (
+                    f"  last={last.get('reason')}: {last.get('detail')}"
+                    if last
+                    else ""
+                )
+            )
 
     def do_cdc(self, arg: str) -> None:
         """CDC LIST — changefeed consumers and durable cursors per

@@ -7,9 +7,9 @@ command with a machine-readable verdict (the standalone twin of
 
     python -m orientdb_tpu.tools.perfdiff BENCH_DETAIL_r12.json \
         BENCH_DETAIL_r14.json [--json] [--tol 0.55] [--ms-tol 0.85] \
-        [--overlap-tol 0.2]
+        [--overlap-tol 0.2] [--hbm-tol 1.5]
 
-Compared signals (the bench gate's two, plus the new third):
+Compared signals (the bench gate's two, plus overlap and peak HBM):
 
 - **q/s leaves** — every ``*qps`` number under ``extras`` (and the
   ``ldbc_is`` per-query families) plus the headline ``value``; a drop
@@ -23,7 +23,13 @@ Compared signals (the bench gate's two, plus the new third):
   ``mesh_scaling`` records): device-idle fraction RISING or
   transfer-hidden fraction FALLING by more than ``--overlap-tol``
   absolute (default 0.2) is a regression — the overlap machinery
-  stopped hiding work even if wall-clock noise masks it.
+  stopped hiding work even if wall-clock noise masks it;
+- **peak-HBM leaves** (once both rounds carry the obs/memledger
+  ``memory`` evidence record): the attributed device-memory peak and
+  each owner kind's peak; growth past ``--hbm-tol`` × base (default
+  1.5) is a regression — a perf win that silently costs half again as
+  much HBM is not a win. Sub-64 KiB bases are skipped as allocator
+  noise.
 
 Output: one JSON document on stdout — ``verdict`` ("pass" |
 "regression"), per-signal regression/improvement lists, and the
@@ -111,6 +117,21 @@ def overlap_leaves(extras: Dict) -> Iterator[Tuple[str, float]]:
             )
 
 
+def hbm_leaves(extras: Dict) -> Iterator[Tuple[str, float]]:
+    """(metric path, bytes) for the device-memory record a round
+    carried (the obs/memledger ``memory`` evidence block): the
+    attributed peak plus each owner kind's peak."""
+    mem = extras.get("memory")
+    if not isinstance(mem, dict):
+        return
+    v = mem.get("peak_bytes")
+    if isinstance(v, (int, float)):
+        yield "memory.peak_bytes", float(v)
+    for kind, pv in sorted((mem.get("peak_by_owner") or {}).items()):
+        if isinstance(pv, (int, float)):
+            yield f"memory.peak.{kind}", float(pv)
+
+
 def diff(
     base: Dict,
     cur: Dict,
@@ -118,6 +139,8 @@ def diff(
     ms_tol: float = 0.85,
     overlap_tol: float = 0.2,
     ms_floor: float = 0.5,
+    hbm_tol: float = 1.5,
+    hbm_floor: float = float(1 << 16),
 ) -> Dict:
     """The comparison document (pure function — tests drive it on
     synthetic rounds)."""
@@ -180,10 +203,30 @@ def diff(
             ov_reg.append(
                 {"metric": name, "base": bv, "cur": cv, "delta": delta}
             )
+    b_hbm = dict(hbm_leaves(b_ex))
+    c_hbm = dict(hbm_leaves(c_ex))
+    hbm_reg: List[Dict] = []
+    hbm_imp: List[Dict] = []
+    for name, bv in sorted(b_hbm.items()):
+        cv = c_hbm.get(name)
+        if cv is None or bv < hbm_floor:
+            continue
+        compared += 1
+        row = {
+            "metric": name,
+            "base": bv,
+            "cur": cv,
+            "ratio": round(cv / bv, 3),
+        }
+        if cv > bv * hbm_tol:
+            hbm_reg.append(row)
+        elif cv < bv / hbm_tol:
+            hbm_imp.append(row)
     regressions = (
         [dict(r, kind="qps") for r in qps_reg]
         + [dict(r, kind="ms") for r in ms_reg]
         + [dict(r, kind="overlap") for r in ov_reg]
+        + [dict(r, kind="hbm") for r in hbm_reg]
     )
     hb, hc = b_q["headline"], c_q["headline"]
     return {
@@ -196,12 +239,14 @@ def diff(
         "qps": {"regressions": qps_reg, "improvements": qps_imp},
         "ms": {"regressions": ms_reg, "improvements": ms_imp},
         "overlap": {"deltas": ov_deltas, "regressions": ov_reg},
+        "hbm": {"regressions": hbm_reg, "improvements": hbm_imp},
         "regressions": regressions,
         "verdict": "regression" if regressions else "pass",
         "thresholds": {
             "tol": tol,
             "ms_tol": ms_tol,
             "overlap_tol": overlap_tol,
+            "hbm_tol": hbm_tol,
         },
     }
 
@@ -221,7 +266,7 @@ def _human(rep: Dict, base_path: str, cur_path: str) -> None:
             f"{r['base']} -> {r['cur']}",
             file=sys.stderr,
         )
-    for kind in ("qps", "ms"):
+    for kind in ("qps", "ms", "hbm"):
         for r in rep[kind]["improvements"]:
             print(
                 f"  improvement [{kind}] {r['metric']}: "
@@ -234,12 +279,12 @@ def _human(rep: Dict, base_path: str, cur_path: str) -> None:
 _USAGE = (
     "usage: python -m orientdb_tpu.tools.perfdiff "
     "BASE_DETAIL.json CUR_DETAIL.json [--json] [--tol X] "
-    "[--ms-tol X] [--overlap-tol X]"
+    "[--ms-tol X] [--overlap-tol X] [--hbm-tol X]"
 )
 
 
 def main(argv: List[str]) -> int:
-    vals = {"tol": 0.55, "ms-tol": 0.85, "overlap-tol": 0.2}
+    vals = {"tol": 0.55, "ms-tol": 0.85, "overlap-tol": 0.2, "hbm-tol": 1.5}
     pos: List[str] = []
     as_json = False
     i = 0
@@ -276,6 +321,7 @@ def main(argv: List[str]) -> int:
         tol=vals["tol"],
         ms_tol=vals["ms-tol"],
         overlap_tol=vals["overlap-tol"],
+        hbm_tol=vals["hbm-tol"],
     )
     rep["base"] = pos[0]
     rep["cur"] = pos[1]

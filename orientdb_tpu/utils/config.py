@@ -278,6 +278,24 @@ class GlobalConfiguration:
     tier_block_edges: int = 65536
     alert_tier_thrash: float = 8.0
 
+    # Device-memory ledger (obs/memledger; README "Device-memory
+    # ledger"): every serving-path device allocation registers an
+    # attributed entry. memledger_sample_rate throttles only the
+    # trace-id capture (byte totals stay exact — the sampled fast path
+    # that holds registration under the <1.35x overhead guard).
+    # memledger_leak_s is the lease age past which an outstanding
+    # snapshot retain() reads as an epoch leak (hbm_epoch_leak rule;
+    # 0 disables). memledger_tolerance bounds the live-but-untracked
+    # residue reconcile() accepts as an instrumentation gap.
+    # memledger_headroom_fraction of tier.cap_bytes is where the
+    # hbm_headroom rule fires.
+    memledger_enabled: bool = True
+    memledger_sample_rate: float = 1.0
+    memledger_leak_s: float = 30.0
+    memledger_watermark_capacity: int = 256
+    memledger_tolerance: float = 0.25
+    memledger_headroom_fraction: float = 0.9
+
     # Materialized continuous MATCH views (exec/views): results of hot
     # fingerprints (>= view_min_calls recorded calls in the stats
     # table) are kept resident and served at cache speed, invalidated

@@ -1,0 +1,71 @@
+"""Headline-tier acceptance (ISSUE 17 satellite): a BENCH_BUDGET_S=60
+run on the small corpus must land a NON-ZERO measured headline — not a
+``status: warming`` placeholder — and the per-round ``memory``
+evidence record must ride the stream next to it.
+
+This is the r06-inversion regression guard made a tier-1 test: the
+headline trio (parity gate -> single 2-hop -> batched 2-hop) runs
+FIRST and is sized for the headline scale, so a ~60 s budget measures
+it before any evidence block can eat the clock.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestHeadlineTier:
+    def test_sixty_second_budget_measures_the_headline(self, tmp_path):
+        ev = str(tmp_path / "ev.jsonl")
+        detail_dir = tmp_path / "d"
+        detail_dir.mkdir()
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            BENCH_BUDGET_S="60",
+            BENCH_HEADLINE_PROFILES="400",
+            BENCH_SLO="0",
+            BENCH_DETAIL_DIR=str(detail_dir),
+            BENCH_EVIDENCE=ev,
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env,
+            cwd=str(tmp_path),
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "demodb_match_2hop_count_qps"
+        assert line.get("status") != "warming", (
+            "a 60 s budget must MEASURE the headline, not publish the "
+            "pre-warmup placeholder"
+        )
+        assert "error" not in line, line
+        assert line["value"] > 0, line
+        # the device-memory evidence record rode the stream (ISSUE 17):
+        # peak/steady bytes per owner + reconciliation residue + leaks
+        from orientdb_tpu.obs.evidence import read_evidence
+
+        recs = {r["block"]: r["data"] for r in read_evidence(ev)}
+        assert "memory" in recs, sorted(recs)
+        mem = recs["memory"]
+        assert mem["peak_bytes"] > 0
+        assert mem["peak_by_owner"].get("snapshot", 0) > 0
+        assert mem["leak_count"] == 0
+        assert "reconcile_ok" in mem
+        # and the same record is in the detail artifact perfdiff walks
+        details = [
+            f
+            for f in os.listdir(str(detail_dir))
+            if f.startswith("BENCH_DETAIL_r")
+        ]
+        assert details
+        with open(os.path.join(str(detail_dir), details[0])) as f:
+            detail = json.load(f)
+        assert detail["extras"]["memory"]["peak_bytes"] == mem["peak_bytes"]

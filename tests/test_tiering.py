@@ -208,6 +208,48 @@ class TestThrashAlert:
             engine.reset()
 
 
+class TestPoolGauges:
+    def test_pool_cap_headroom_gauges_and_stats(self, tiered):
+        """ISSUE 17 satellite: the tier plane publishes its pool
+        occupancy, the cap, and the derived headroom as gauges (the
+        ``hbm_headroom`` rule's denominator) and carries the same
+        numbers in ``stats()`` — pool growth was a loud counter but
+        nothing showed how big the pool actually is."""
+        db, snap = tiered
+        db.query(COUNT_2HOP, params={"u": 9}, engine="tpu", strict=True)
+        tier = snap._tier
+        tier._publish()
+        st = tier.stats()
+        assert st["pool_bytes"] == tier.pool_bytes() > 0
+        assert st["headroom_bytes"] == tier.headroom_bytes()
+        assert metrics.gauge_value("tier.pool_bytes") == float(
+            tier.pool_bytes()
+        )
+        assert metrics.gauge_value("tier.cap_bytes") == float(
+            config.tier_hbm_cap_bytes
+        )
+        assert metrics.gauge_value("tier.headroom_bytes") == float(
+            tier.headroom_bytes()
+        )
+        # headroom derives from the cap and the FULL hot footprint
+        # (pages + indexes), clamped at zero
+        assert tier.headroom_bytes() == max(
+            0, int(tier.cap) - tier.hot_bytes()
+        )
+        assert tier.pool_bytes() <= tier.hot_bytes()
+
+    def test_tiered_pool_is_attributed_in_the_ledger(self, tiered):
+        """The tier pool's device pages land in the memory ledger as
+        kind=tier_pool, attributed to the owning DeviceGraph."""
+        from orientdb_tpu.obs.memledger import memledger
+
+        db, snap = tiered
+        db.query(COUNT_2HOP, params={"u": 9}, engine="tpu", strict=True)
+        assert memledger.totals()["tier_pool"] > 0
+        owners = memledger.owners()["tier_pool"]
+        assert owners["entries"] > 0 and owners["owners"] >= 1
+
+
 class TestDeviceGuard:
     def test_warm_replay_no_implicit_transfers(self, tiered):
         """The tiered replay hot path under a disallow transfer guard:

@@ -98,6 +98,12 @@ class TestForwardedWrite:
         with span("client.save") as root:
             doc = n1db.new_vertex("P", uid=77)
         assert doc.rid.is_persistent
+        # the owner's http.POST span records on the HANDLER thread a
+        # beat AFTER the response unblocks this one — wait for it to
+        # land in the ring instead of racing the handler's span exit
+        assert wait_for(
+            lambda: "http.POST" in trace_names(root.trace_id)
+        ), trace_names(root.trace_id)
         names = trace_names(root.trace_id)
         # forwarder side + owner side, one trace
         assert "forward.request" in names
@@ -135,6 +141,11 @@ class TestForwardedWrite:
                 n1db._write_owner.update(
                     d.rid, {"x": 9}, base_version=stale_version
                 )
+        # the owner-side server span lands on the handler thread just
+        # after the 409 unblocks the client — don't race its exit
+        assert wait_for(
+            lambda: "http.PUT" in trace_names(root.trace_id)
+        ), trace_names(root.trace_id)
         names = trace_names(root.trace_id)
         assert "forward.request" in names and "http.PUT" in names
 
@@ -273,10 +284,14 @@ class TestReplicationApplyTrace:
             with span("client.quorum_write") as root:
                 pdb.new_vertex("P", uid=5)
             # the write blocked on the majority ack, so the apply span
-            # is already recorded
+            # is already recorded; the push's SERVER span (http.POST)
+            # records on the replica's handler thread just after the
+            # ack unblocks us — wait for it instead of racing
             names = trace_names(root.trace_id)
             assert "replication.apply_entry" in names
-            assert "http.POST" in names  # the push request itself
+            assert wait_for(
+                lambda: "http.POST" in trace_names(root.trace_id)
+            ), trace_names(root.trace_id)
         finally:
             cl.stop()
             for s in servers:
