@@ -851,14 +851,25 @@ def _last_good_round(detail_dir: str, round_n: int) -> "str | None":
             m = re.search(r"_r(\d+)\.json$", p)
             if m and int(m.group(1)) < round_n:
                 candidates.append((int(m.group(1)), root == "detail", p))
+    from orientdb_tpu.tools.perfdiff import degraded_round
+
     for _n, _is_detail, path in sorted(candidates, reverse=True):
         try:
             with open(path) as f:
                 doc = json.load(f)
             if isinstance(doc, dict) and doc.get("parsed"):
                 doc = doc["parsed"]
-            if isinstance(doc, dict) and float(doc.get("value") or 0.0) > 0:
-                return path
+            if not (
+                isinstance(doc, dict)
+                and float(doc.get("value") or 0.0) > 0
+            ):
+                continue
+            if degraded_round(doc):
+                # a round that served quarantine fallbacks / sheds
+                # measured the fault ladder, not the fast path — never
+                # a regression baseline
+                continue
+            return path
         except Exception:
             continue
     return None
@@ -1500,6 +1511,23 @@ def _measure() -> None:
             ev("memory", **_ms)
         except Exception as e:
             ev("memory", error=f"{type(e).__name__}: {e}")
+
+    # device-fault evidence per round (ISSUE 18): classified fault
+    # counts, quarantines, sheds, and relief actuations from the
+    # device fault domain (exec/devicefault) ride the evidence stream
+    # next to watchdog/memory — and perfdiff.degraded_round reads this
+    # block to keep a chaos round out of the regression baseline
+    if budget_ok("device_faults", est_s=2):
+        try:
+            from orientdb_tpu.exec.devicefault import (
+                bench_device_faults_summary,
+            )
+
+            _df = bench_device_faults_summary()
+            extras["device_faults"] = _df
+            ev("device_faults", **_df)
+        except Exception as e:
+            ev("device_faults", error=f"{type(e).__name__}: {e}")
 
     # mixed production-shaped traffic under chaos, judged by the SLO
     # plane (ISSUE 11): the closed-loop simulator runs its OWN small

@@ -21,8 +21,13 @@ from typing import Iterable, List
 
 from orientdb_tpu.analysis.core import Finding, SourceTree, register
 from orientdb_tpu.chaos.iolint import (
+    DEVICE_EXEMPT,
+    DEVICE_SCAN_DIRS,
+    DEVICE_SCAN_SUFFIXES,
     EXEMPT,
     SCAN_DIRS,
+    _is_device_io_call,
+    _is_device_route_call,
     _is_io_call,
     _is_point_call,
     _outermost_functions,
@@ -61,6 +66,40 @@ def run_iolint(tree: SourceTree) -> Iterable[Finding]:
                         "fault.point(...) — wrap the call site in a "
                         "named injection point (chaos/faults.py) or "
                         "add an EXEMPT entry with a justification",
+                    )
+                )
+    # device rule: raw device-boundary calls in the exec stack (and the
+    # tiered-snapshot upload plane) must route through the devicefault
+    # chaos crossings — un-routed dispatch sites bypass the escalation
+    # ladder the same way an un-pointed socket bypasses the breakers
+    for m in tree.in_dirs(*DEVICE_SCAN_DIRS):
+        if m.tree is None:
+            continue
+        rel = m.path[len(_PKG_PREFIX):] if m.path.startswith(
+            _PKG_PREFIX
+        ) else m.path
+        if not any(
+            rel.startswith(s) or rel == s.rstrip("/")
+            for s in DEVICE_SCAN_SUFFIXES
+        ):
+            continue
+        for fn in _outermost_functions(m.tree):
+            calls = [
+                n for n in ast.walk(fn) if isinstance(n, ast.Call)
+            ]
+            if not any(_is_device_io_call(c) for c in calls):
+                continue
+            if (rel, fn.name) in DEVICE_EXEMPT:
+                continue
+            if not any(_is_device_route_call(c) for c in calls):
+                findings.append(
+                    Finding(
+                        "iolint", m.path, fn.lineno,
+                        f"{fn.name}() crosses the device boundary "
+                        "with no tpu.* fault crossing — route through "
+                        "devicefault.dispatch_point()/transfer_point() "
+                        "(or a fault.point(...)) or add a "
+                        "DEVICE_EXEMPT entry with a justification",
                     )
                 )
     return findings

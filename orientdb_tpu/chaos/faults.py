@@ -72,6 +72,15 @@ POINTS = frozenset(
         "workload.http",  # traffic-simulator HTTP client sessions
         # (workloads/driver): every simulated HTTP request is
         # injectable like any real channel
+        "tpu.dispatch",  # device dispatch: compiled single / vmapped
+        # group / lane executions (exec/tpu_engine, guarded by the
+        # device fault domain's escalation ladder)
+        "tpu.transfer",  # device transfers: H2D param/block uploads
+        # and blocking D2H result drains (tpu_engine fetch sites,
+        # storage/tiering prefetch waves)
+        "tpu.oom",  # device memory exhaustion: crossed before every
+        # dispatch AND transfer, classifies oom and actuates the
+        # fault domain's memledger-guided relief
     }
 )
 

@@ -46,6 +46,65 @@ EXEMPT = frozenset(
     }
 )
 
+# -- device dispatch/transfer rule (exec/devicefault) ------------------------
+
+#: package-relative dirs scanned for raw DEVICE calls (the tpu.* fault
+#: points): the exec stack plus the tiered-snapshot upload plane
+DEVICE_SCAN_DIRS = ("exec", "storage")
+#: within DEVICE_SCAN_DIRS, only these path suffixes are device planes
+#: (the rest of storage/ is host-side WAL/records)
+DEVICE_SCAN_SUFFIXES = ("exec/", "storage/tiering.py")
+
+#: attribute calls that cross the device boundary (jax.device_put,
+#: arr.block_until_ready, arr.copy_to_host_async)
+DEVICE_IO_ATTRS = frozenset(
+    {"device_put", "block_until_ready", "copy_to_host_async"}
+)
+#: bare-name device sync helpers (tpu_engine's module-level wrappers)
+DEVICE_IO_NAMES = frozenset({"_block_until_ready", "_copy_to_host_async"})
+#: calls that count as routing through the device fault domain's chaos
+#: crossings (exec/devicefault.dispatch_point / transfer_point), in
+#: addition to a literal ``*.point(...)``
+DEVICE_ROUTE_HELPERS = frozenset({"dispatch_point", "transfer_point"})
+
+#: (module-relative path, function name) pairs allowed raw device calls
+#: without routing through tpu.dispatch / tpu.transfer / tpu.oom
+DEVICE_EXEMPT = frozenset(
+    {
+        # background AOT warm-ups / page-fn precompiles: off the
+        # serving hot path, with their own retry-then-sentinel
+        # discipline — a failed compile degrades to per-lane dispatch,
+        # never a query error
+        ("exec/tpu_engine.py", "ensure_compiled"),
+        ("exec/tpu_engine.py", "_compile_page_async"),
+        ("exec/tpu_engine.py", "precompile_group_pages"),
+        ("exec/tpu_engine.py", "_compile_group_async"),
+        # speculative result-page copies ride the dispatch they start
+        # from (dispatch/dispatch_many hold the tpu.dispatch crossing;
+        # a wrong guess is dropped, never awaited on its own)
+        ("exec/tpu_engine.py", "_prefetch_elected"),
+        ("exec/tpu_engine.py", "_group_dispatch"),
+    }
+)
+
+
+def _is_device_io_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in DEVICE_IO_NAMES
+    if isinstance(f, ast.Attribute):
+        return f.attr in DEVICE_IO_ATTRS
+    return False
+
+
+def _is_device_route_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "point" or f.attr in DEVICE_ROUTE_HELPERS
+    if isinstance(f, ast.Name):
+        return f.id in DEVICE_ROUTE_HELPERS
+    return False
+
 
 def _is_io_call(call: ast.Call) -> bool:
     f = call.func
@@ -95,6 +154,35 @@ def lint_source(src: str, rel: str) -> List[str]:
                 "I/O with no fault.point(...) — wrap the call site in a "
                 "named injection point (chaos/faults.py) or add an "
                 "EXEMPT entry with a justification"
+            )
+    return problems
+
+
+def lint_device_source(src: str, rel: str) -> List[str]:
+    """Device-rule twin of :func:`lint_source`: every outermost
+    function in the device planes (``DEVICE_SCAN_SUFFIXES``) performing
+    raw device calls must route through a chaos crossing — a literal
+    ``*.point(...)`` or one of the devicefault helpers."""
+    problems: List[str] = []
+    if not any(
+        rel.startswith(s) or rel == s.rstrip("/")
+        for s in DEVICE_SCAN_SUFFIXES
+    ):
+        return problems
+    tree = ast.parse(src, filename=rel)
+    for fn in _outermost_functions(tree):
+        calls = [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+        if not any(_is_device_io_call(c) for c in calls):
+            continue
+        if (rel, fn.name) in DEVICE_EXEMPT:
+            continue
+        if not any(_is_device_route_call(c) for c in calls):
+            problems.append(
+                f"{rel}:{fn.lineno}: {fn.name}() crosses the device "
+                "boundary with no tpu.* fault crossing — route through "
+                "devicefault.dispatch_point()/transfer_point() (or a "
+                "fault.point(...)) or add a DEVICE_EXEMPT entry with a "
+                "justification"
             )
     return problems
 

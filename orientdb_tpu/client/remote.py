@@ -37,6 +37,19 @@ class ServerOverloadedError(RemoteError):
         self.retry_after = retry_after
 
 
+class DeviceTransientError(RemoteError):
+    """The server's device fault domain shed or quarantined this
+    request (code 503 with the ``device`` marker): the plan is being
+    retried/relieved server-side, or its fingerprint sits in quarantine
+    with a probe window ahead. Safe to retry any op after honoring
+    ``retry_after`` — by then the ladder has either recovered the plan
+    or the request lands on the oracle fallback."""
+
+    def __init__(self, msg: str, retry_after: float = 0.5) -> None:
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
 class _ReconnectFailed(RemoteConnectionError):
     """No member accepted a connection during a failover scan — kept
     retryable (under the client's RetryPolicy budget) because a
@@ -358,6 +371,14 @@ class RemoteDatabase:
         resp = self._call(req)
         if not resp.get("ok"):
             if resp.get("code") == 503:
+                if resp.get("device"):
+                    # device fault domain shed/quarantine: retryable
+                    # like an admission 503, but flagged so callers can
+                    # distinguish device pressure from host overload
+                    raise DeviceTransientError(
+                        resp.get("error", "device fault"),
+                        retry_after=float(resp.get("retry_after", 0.5)),
+                    )
                 raise ServerOverloadedError(
                     resp.get("error", "server overloaded"),
                     retry_after=float(resp.get("retry_after", 0.5)),
@@ -739,6 +760,9 @@ class FailoverDatabase:
                     RemoteConnectionError,
                     OSError,
                     ServerOverloadedError,
+                    # device-side 503s carry the quarantine/shed
+                    # retry_after hint — honored the same way
+                    DeviceTransientError,
                 ),
                 give_up_on=(_Ambiguous,),
             )

@@ -79,20 +79,37 @@ def _choose_engine(db, stmt: A.Statement, engine: Optional[str]) -> str:
     return eng
 
 
-def _run(db, stmt: A.Statement, params, engine: Optional[str], strict: bool):
+def _run(
+    db,
+    stmt: A.Statement,
+    params,
+    engine: Optional[str],
+    strict: bool,
+    sql: Optional[str] = None,
+):
     from orientdb_tpu.utils.metrics import metrics
 
     eng = _choose_engine(db, stmt, engine)
     if eng == "tpu":
         from orientdb_tpu.exec import tpu_engine
+        from orientdb_tpu.exec.devicefault import domain as _fault_domain
 
         try:
+            # device fault quarantine gate: a fingerprint whose plan
+            # exhausted the escalation ladder serves the oracle until
+            # its TTL expires; "probe" admits exactly this dispatch as
+            # the re-admission trial (success inside execute() clears
+            # the entry, a fault re-quarantines with a doubled TTL)
+            if _fault_domain.admit(sql) == "quarantined":
+                raise tpu_engine.Uncompilable(
+                    "plan quarantined by device fault domain"
+                )
             # an active tx means the snapshot no longer reflects this
             # session's view (tx-created/-deleted records) — the oracle is
             # the only engine that applies the tx overlay
             if db.tx is not None:
                 raise tpu_engine.Uncompilable("active transaction on this thread")
-            rows = tpu_engine.execute(db, stmt, params)
+            rows = tpu_engine.execute(db, stmt, params, sql=sql)
             metrics.incr("query.tpu")
             return rows, "tpu"
         except tpu_engine.Uncompilable as e:
@@ -246,7 +263,7 @@ def _execute_query(
             view = vm.lookup(sql, norm, engine, strict)
             if view is not None:
                 return _result_set(view.rows, view.engine)
-    rows, used = _run(db, stmt, norm, engine, strict)
+    rows, used = _run(db, stmt, norm, engine, strict, sql=sql)
     if key is not None:
         cache.put(key, rows, used, epoch)
     if vm is not None:
@@ -293,7 +310,9 @@ def _execute_command(
     if isinstance(stmt, A.ExplainStatement):
         return explain_statement(db, stmt, _normalize_params(params))
     if stmt.is_idempotent:
-        rows, used = _run(db, stmt, _normalize_params(params), engine, strict)
+        rows, used = _run(
+            db, stmt, _normalize_params(params), engine, strict, sql=sql
+        )
         return _result_set(rows, used)
     from orientdb_tpu.exec.oracle import execute_statement
 
@@ -378,15 +397,32 @@ def _execute_query_batch(
     tpu_idx = [i for i, e in enumerate(engines) if e == "tpu"]
     if tpu_idx and db.tx is None:
         from orientdb_tpu.exec import tpu_engine
+        from orientdb_tpu.exec.devicefault import domain as _fault_domain
 
-        batch = tpu_engine.execute_batch(db, [items[i] for i in tpu_idx])
-        for i, res in zip(tpu_idx, batch):
-            if isinstance(res, tpu_engine.Uncompilable):
-                if strict:
-                    raise res
-                log.info("tpu batch fallback to oracle: %s", res)
-            else:
-                out[i] = _result_set(res, "tpu")
+        # per-item quarantine gate: quarantined fingerprints drop to
+        # the oracle loop below; "probe" items ride the batch and clear
+        # their entry on a clean result
+        gates = {i: _fault_domain.admit(sqls[i]) for i in tpu_idx}
+        if strict and any(g == "quarantined" for g in gates.values()):
+            raise tpu_engine.Uncompilable(
+                "plan quarantined by device fault domain"
+            )
+        run_idx = [i for i in tpu_idx if gates[i] != "quarantined"]
+        if run_idx:
+            batch = tpu_engine.execute_batch(
+                db,
+                [items[i] for i in run_idx],
+                sqls=[sqls[i] for i in run_idx],
+            )
+            for i, res in zip(run_idx, batch):
+                if isinstance(res, tpu_engine.Uncompilable):
+                    if strict:
+                        raise res
+                    log.info("tpu batch fallback to oracle: %s", res)
+                else:
+                    out[i] = _result_set(res, "tpu")
+                    if gates[i] == "probe":
+                        _fault_domain.note_success(sqls[i])
     elif tpu_idx:  # active tx: snapshot cannot see the tx overlay
         if strict:
             from orientdb_tpu.exec.tpu_engine import Uncompilable
@@ -437,7 +473,13 @@ def dispatch_lane_batch(
     if not items or _choose_engine(db, items[0][0], None) != "tpu":
         return None
     from orientdb_tpu.exec import tpu_engine
+    from orientdb_tpu.exec.devicefault import domain as _fault_domain
 
+    if _fault_domain.admit(sqls[0]) == "quarantined":
+        # homogeneous lane, one fingerprint: the whole drain degrades
+        # to the generic path, whose gate serves the oracle ("probe"
+        # proceeds — the lane dispatch IS the re-admission trial)
+        return None
     ring = None
     if ring_state is not None:
         ring = ring_state.get("ring")

@@ -27,6 +27,7 @@ surface grows.
 
 from __future__ import annotations
 
+import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -34,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orientdb_tpu.exec import devicefault
 from orientdb_tpu.exec.eval import EvalContext
 from orientdb_tpu.exec.oracle import (
     MatchInterpreter,
@@ -97,6 +99,7 @@ def _fetch_profiled(devs: List, split_sync: bool = True) -> List[np.ndarray]:
     wave); profile_execute decomposes singles instead."""
     import time as _time
 
+    devicefault.transfer_point()
     t0 = _time.perf_counter()
     if split_sync and len(devs) > 1:
         _block_until_ready(devs[-1])
@@ -3246,6 +3249,7 @@ class _CompiledPlan(_AotWarmup):
             args = tier.prepare_dispatch(self.tier_footprint, self._arg_subset)
         else:
             args = self._arg_subset()
+        devicefault.dispatch_point()
         dev = self.jitted(args, dyn)
         _TL.mark("device_dispatch")
         self._prefetch_elected(dev)
@@ -3358,6 +3362,7 @@ class _CompiledPlan(_AotWarmup):
         if fn is None:
             self._compile_group_async(Bb, _stack(0))
             return None
+        devicefault.dispatch_point()
         if nchunks == 1:
             return fn(self._arg_subset(), _stack(0))
         outs = [fn(self._arg_subset(), _stack(c)) for c in range(nchunks)]
@@ -3906,7 +3911,7 @@ def _snapshot_lease(db):
             snap.release()
 
 
-def execute(db, stmt, params) -> List[Result]:
+def execute(db, stmt, params, sql: Optional[str] = None) -> List[Result]:
     import orientdb_tpu.obs.timeline as _TL
 
     # flight record for the compiled single-dispatch path (refined to
@@ -3915,7 +3920,15 @@ def execute(db, stmt, params) -> List[Result]:
     rec = _TL.recorder.begin("single")
     with _TL.active(rec):
         for _attempt in range(4):
-            variants, rows, _fresh = _prepare(db, stmt, params)
+            # recording first executions run eagerly on device — the
+            # ladder guards them like replays (stage "record")
+            variants, rows, _fresh = devicefault.domain.run(
+                lambda: _prepare(db, stmt, params),
+                db=db,
+                sql=sql,
+                stage="record",
+                passthrough=(ScheduleOverflow,),
+            )
             if variants is None:
                 break
             plan = variants.pick(params)
@@ -3931,10 +3944,26 @@ def execute(db, stmt, params) -> List[Result]:
                 metrics.incr("tpu.lease_raced")
                 continue
             try:
-                rows = plan.rows(params or {})
+                # the device fault domain's escalation ladder wraps the
+                # whole dispatch+fetch section; ScheduleOverflow is the
+                # caller's control flow and passes through untouched
+                rows = devicefault.domain.run(
+                    lambda: plan.rows(params or {}),
+                    db=db,
+                    sql=sql,
+                    stage="dispatch",
+                    passthrough=(ScheduleOverflow,),
+                )
                 variants.remember(params, plan)
             except ScheduleOverflow:
-                rows = _run_variants(db, stmt, params, variants, tried=plan)
+                rows = devicefault.domain.run(
+                    lambda: _run_variants(
+                        db, stmt, params, variants, tried=plan
+                    ),
+                    db=db,
+                    sql=sql,
+                    stage="dispatch",
+                )
             finally:
                 snap.release()
             break
@@ -3968,13 +3997,17 @@ class ParamRing:
 
     NOT thread-safe by design: a ring belongs to exactly one lane
     worker thread (the coalesce lane owns it for the plan's lifetime).
+    The one cross-thread touch is :meth:`clear` (device fault relief
+    dropping staged buffers): a racing ``stage`` at worst misses a hit
+    and re-uploads — each slot write is a single list-item assignment.
     """
 
-    __slots__ = ("_slots", "_next")
+    __slots__ = ("_slots", "_next", "__weakref__")
 
     def __init__(self, depth: int = 2) -> None:
         self._slots: List = [None] * max(1, depth)
         self._next = 0
+        _PARAM_RINGS.add(self)
 
     @staticmethod
     def _same(a: Dict, b: Dict) -> bool:
@@ -3993,6 +4026,7 @@ class ParamRing:
                 metrics.incr("tpu.param_ring.hit")
                 note_ring(True)
                 return slot[1]
+        devicefault.transfer_point()
         dev = jax.device_put(host)
         nbytes = sum(int(a.nbytes) for a in host.values())
         metrics.incr("tpu.param_ring.upload")
@@ -4011,6 +4045,31 @@ class ParamRing:
         self._slots[self._next] = (host, dev)
         self._next = (self._next + 1) % len(self._slots)
         return dev
+
+    def clear(self) -> int:
+        """Drop every staged device buffer (a pure cache: the next
+        dispatch re-uploads). Returns slots dropped."""
+        dropped = 0
+        for i in range(len(self._slots)):
+            if self._slots[i] is not None:
+                self._slots[i] = None
+                dropped += 1
+        if dropped:
+            from orientdb_tpu.obs.memledger import memledger
+
+            memledger.drop_owner("param_ring", f"ring:{id(self):x}")
+        return dropped
+
+
+#: live ParamRings (weak — a reaped coalesce lane's ring just vanishes);
+#: the device fault domain's relief drops their staged buffers
+_PARAM_RINGS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def drop_param_rings() -> int:
+    """Device fault relief actuator: drop every lane's staged param
+    buffers. Pure cache, so the only cost is re-upload on next use."""
+    return sum(ring.clear() for ring in list(_PARAM_RINGS))
 
 
 class _Group:
@@ -4072,7 +4131,7 @@ class _Lane:
         return d if self.k is None or d.ndim == 2 else d[self.k]
 
 
-def execute_batch(db, items) -> List:
+def execute_batch(db, items, sqls: Optional[List[Optional[str]]] = None) -> List:
     """Execute ``[(stmt, params), ...]`` with one overlapped transfer phase.
 
     The single-chip DP axis (SURVEY.md §5 "replicas = independent query
@@ -4095,7 +4154,16 @@ def execute_batch(db, items) -> List:
     try:
         for i, (stmt, params) in enumerate(items):
             try:
-                variants, rows, plan_obj = _prepare(db, stmt, params)
+                # recording first executions are device work too: the
+                # ladder guard degrades an exhausted one per-item
+                # (DeviceQuarantined IS an Uncompilable)
+                variants, rows, plan_obj = devicefault.domain.run(
+                    lambda: _prepare(db, stmt, params),
+                    db=db,
+                    sql=(sqls[i] if sqls else None),
+                    stage="record",
+                    passthrough=(ScheduleOverflow,),
+                )
             except Uncompilable as e:
                 out[i] = e
                 continue
@@ -4119,7 +4187,13 @@ def execute_batch(db, items) -> List:
                     break
                 metrics.incr("tpu.lease_raced")
                 try:
-                    variants, rows, plan_obj = _prepare(db, stmt, params)
+                    variants, rows, plan_obj = devicefault.domain.run(
+                        lambda: _prepare(db, stmt, params),
+                        db=db,
+                        sql=(sqls[i] if sqls else None),
+                        stage="record",
+                        passthrough=(ScheduleOverflow,),
+                    )
                 except Uncompilable as e:
                     out[i] = e
                     break
@@ -4136,7 +4210,25 @@ def execute_batch(db, items) -> List:
             for plan in fresh:
                 plan.wait_compiled()
             return out
-        return _execute_batch_leased(db, items, out, prepared, fresh)
+        try:
+            # the escalation ladder wraps the whole dispatch+fetch wave;
+            # a retry re-dispatches the prepared plans (reads are
+            # idempotent and the leases stay held in the outer finally)
+            return devicefault.domain.run(
+                lambda: _execute_batch_leased(db, items, out, prepared, fresh),
+                db=db,
+                sql=(sqls[prepared[0][0]] if sqls else None),
+                stage="batch",
+                passthrough=(ScheduleOverflow,),
+            )
+        except devicefault.DeviceQuarantined as e:
+            # exhaustion mid-wave: per-item contract — hand the not-yet
+            # materialized items the Uncompilable so the front door
+            # falls back per statement (completed slots keep their rows)
+            for i in range(len(out)):
+                if out[i] is None:
+                    out[i] = e
+            return out
     finally:
         for snap in leases.values():
             snap.release()
@@ -4299,6 +4391,7 @@ def _finish_pending(db, items, pending, out, fresh) -> None:
     from orientdb_tpu.obs.memledger import memledger as _ml
 
     pages_sel: List = [None] * len(pending)
+    devicefault.transfer_point()
     seen_groups = set()
     for d in meta_devs:
         # direct-fetch plans ride this same wave: their dev IS the fused
@@ -4480,9 +4573,9 @@ class LaneDispatch:
     worker thread runs other work in between, so the record cannot
     stay thread-local."""
 
-    __slots__ = ("db", "items", "pending", "rec", "lease")
+    __slots__ = ("db", "items", "pending", "rec", "lease", "sql")
 
-    def __init__(self, db, items, pending, rec=None, lease=None) -> None:
+    def __init__(self, db, items, pending, rec=None, lease=None, sql=None) -> None:
         self.db = db
         self.items = items
         self.pending = pending
@@ -4491,6 +4584,9 @@ class LaneDispatch:
         #: double-buffered dispatch→collect gap (epoch-gated dispatch:
         #: a compaction swap cannot free them while this batch flies)
         self.lease = lease
+        #: the lane's fingerprint source text — the device fault
+        #: domain's quarantine key if collect's fetch faults out
+        self.sql = sql
 
     def collect(self) -> List:
         """Fetch + marshal the dispatched batch; returns per-item row
@@ -4502,7 +4598,20 @@ class LaneDispatch:
         fresh: List = []
         try:
             with _TL.active(self.rec):
-                _finish_pending(self.db, self.items, self.pending, out, fresh)
+                # escalation-ladder guard on the fetch/marshal wave; an
+                # exhausted fault raises DeviceQuarantined out of
+                # collect(), which the coalescer's batch-failure path
+                # catches and re-runs per item through the front door
+                # (admit gate → oracle while quarantined)
+                devicefault.domain.run(
+                    lambda: _finish_pending(
+                        self.db, self.items, self.pending, out, fresh
+                    ),
+                    db=self.db,
+                    sql=self.sql,
+                    stage="lane_collect",
+                    passthrough=(ScheduleOverflow,),
+                )
         finally:
             if self.lease is not None:
                 self.lease.release()
@@ -4596,8 +4705,21 @@ def dispatch_lane(
         return None
     handed_off = False
     try:
-        with _TL.active(rec):
-            g = _group_dispatch(plan, dyns, ring=ring)
+        try:
+            with _TL.active(rec):
+                # escalation-ladder guard on the lane's group dispatch;
+                # exhaustion degrades this drain to the generic path
+                # (whose admit gate serves the quarantined plan from
+                # the oracle) rather than failing the whole micro-batch
+                g = devicefault.domain.run(
+                    lambda: _group_dispatch(plan, dyns, ring=ring),
+                    db=db,
+                    sql=sql,
+                    stage="lane",
+                    passthrough=(ScheduleOverflow,),
+                )
+        except devicefault.DeviceQuarantined:
+            return None
         if g is None:
             return None  # group executable still compiling: generic path
         handed_off = True
@@ -4608,7 +4730,7 @@ def dispatch_lane(
     pending = [(i, variants, plan, _Lane(grp, k)) for i, k in enumerate(ks)]
     metrics.incr("tpu.lane_dispatch")
     metrics.incr("tpu.lane_items", len(items))
-    return LaneDispatch(db, items, pending, rec, lease=lease)
+    return LaneDispatch(db, items, pending, rec, lease=lease, sql=sql)
 
 
 def explain_plan_steps(db, stmt) -> List[str]:
@@ -4656,16 +4778,33 @@ def profile_execute(db, stmt, params) -> Tuple[List[Result], Dict]:
             phases["compileWaitUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
             t0 = _time.perf_counter()
             with _span("tpu.dispatch"):
-                dev = plan.dispatch(params or {})
+                dev = devicefault.domain.run(
+                    lambda: plan.dispatch(params or {}),
+                    db=db,
+                    stage="profile",
+                    passthrough=(ScheduleOverflow,),
+                )
             phases["dispatchUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
             t0 = _time.perf_counter()
             with _span("tpu.device"):
-                jax.block_until_ready(dev)
+                devicefault.domain.run(
+                    lambda: (
+                        devicefault.transfer_point(),
+                        jax.block_until_ready(dev),
+                    ),
+                    db=db,
+                    stage="profile",
+                )
             phases["deviceUs"] = round((_time.perf_counter() - t0) * 1e6, 1)
             t0 = _time.perf_counter()
             with _span("tpu.marshal"):
                 try:
-                    rows = plan.materialize(dev, params or {})
+                    rows = devicefault.domain.run(
+                        lambda: plan.materialize(dev, params or {}),
+                        db=db,
+                        stage="profile",
+                        passthrough=(ScheduleOverflow,),
+                    )
                     variants.remember(params, plan)
                 except ScheduleOverflow:
                     rows = _run_variants(db, stmt, params, variants, tried=plan)

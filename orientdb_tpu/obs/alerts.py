@@ -87,6 +87,10 @@ RULE_CATALOG: Dict[str, str] = {
     "(hbm.ledger_bytes) crossed memledger_headroom_fraction of the "
     "tier plane's HBM budget (tier.cap_bytes / tier_hbm_cap_bytes) — "
     "the next pool grow or snapshot upload may not fit",
+    "device_fault_storm": "classified device faults (exec/devicefault: "
+    "oom + transient + persistent across every dispatch path) per "
+    "minute exceed alert_device_faults_per_min — the device is failing "
+    "faster than the escalation ladder can contain",
 }
 
 #: two-window burn-rate windows (seconds): the short window catches the
@@ -292,6 +296,8 @@ class AlertEngine:
         self._prev_qs: Dict[str, Tuple[int, float, int]] = {}
         self._prev_recompiles: Optional[int] = None
         self._prev_recompiles_ts = 0.0
+        self._prev_device_faults: Optional[int] = None
+        self._prev_device_faults_ts = 0.0
         self._indoubt_seen: Dict[Tuple[str, str], float] = {}
         self._burn_samples: deque = deque()  # (ts, calls, errors)
 
@@ -491,6 +497,7 @@ class AlertEngine:
                 self._indoubt_seen.clear()
                 self._burn_samples.clear()
             self._prev_recompiles = None
+            self._prev_device_faults = None
 
     # -- rule conditions -----------------------------------------------------
 
@@ -688,6 +695,25 @@ class AlertEngine:
                 f"{rate:.1f} shape-overflow recompiles/min",
             )
 
+    def _check_device_fault_storm(self, ctx: AlertContext) -> Iterable[Breach]:
+        thr = config.alert_device_faults_per_min
+        from orientdb_tpu.exec.devicefault import domain as _fault_domain
+
+        total = _fault_domain.fault_total()
+        prev, prev_ts = self._prev_device_faults, self._prev_device_faults_ts
+        self._prev_device_faults = total
+        self._prev_device_faults_ts = ctx.now
+        if prev is None or thr <= 0:
+            return
+        dt = max(ctx.now - prev_ts, 1e-3)
+        rate = (total - prev) * 60.0 / dt
+        if rate > thr:
+            yield Breach(
+                "device", rate, thr,
+                f"{rate:.1f} classified device faults/min "
+                "(exec/devicefault escalation ladder engaged)",
+            )
+
     def _check_latency_regression(
         self, ctx: AlertContext
     ) -> Iterable[Breach]:
@@ -883,6 +909,11 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
         "hbm_headroom", "warning",
         AlertEngine._check_hbm_headroom,
         exemplar_spans=("tier.", "tpu.load"),
+    ),
+    _rule(
+        "device_fault_storm", "warning",
+        AlertEngine._check_device_fault_storm,
+        exemplar_spans=("devicefault.", "tpu."),
     ),
 )
 

@@ -77,6 +77,14 @@ def cdc_state(dbs: Iterable) -> Dict:
     return out
 
 
+def _device_faults() -> Dict:
+    """The device fault domain's state (lazy import: the bundle must
+    stay loadable without pulling the exec stack at module import)."""
+    from orientdb_tpu.exec.devicefault import domain as _fault_domain
+
+    return _fault_domain.snapshot()
+
+
 def in_doubt_state(dbs: Iterable) -> Dict:
     """Participant-side staged (prepared, undecided) 2PC batches per
     database plus the coordinator-side in-doubt reports."""
@@ -143,6 +151,11 @@ def debug_bundle(
         # and lease/refusal state — what is in HBM and who owns it,
         # next to the traces that put it there
         "memory": memledger.report(),
+        # the device fault domain (exec/devicefault): classified fault
+        # counts, quarantined plans (with reasons + TTLs), relief
+        # actuations, and the admission shed latch — the escalation
+        # ladder's state next to the memory it was relieving
+        "device_faults": _device_faults(),
         # recent structured log records, trace/span-correlated — the
         # ring is bounded (config.log_ring_capacity) and ships only
         # inside this admin-only bundle

@@ -136,6 +136,7 @@ def cluster_health(server) -> Dict:
             "breakers": breaker_snapshot(),
             "indoubt_pending": resolver.pending(),
             "alerts": _alerts_block(),
+            "device_faults": _device_faults_block(),
         }
     with cluster._lock:
         members = dict(cluster.members)
@@ -166,7 +167,17 @@ def cluster_health(server) -> Dict:
         # exemplar trace ids + the watchdog summary — the "is anything
         # wrong" answer next to the raw per-member signals above
         "alerts": _alerts_block(),
+        # the device fault domain's local state (exec/devicefault):
+        # quarantined plans, relief actuations, shed latch — the
+        # operator's "is the device degrading" answer
+        "device_faults": _device_faults_block(),
     }
+
+
+def _device_faults_block() -> Dict:
+    from orientdb_tpu.exec.devicefault import domain as _fault_domain
+
+    return _fault_domain.snapshot()
 
 
 def _member_snapshots(server) -> Dict[str, Optional[Dict]]:

@@ -89,6 +89,9 @@ SPAN_CATALOG: Dict[str, str] = {
     "(obs/memledger: ledger totals diffed against jax.live_arrays — "
     "untracked = instrumentation gap, tracked-but-dead = leak "
     "candidate, dead transients pruned)",
+    "devicefault.escalate": "device fault escalation (exec/devicefault: "
+    "retries exhausted or persistent fault — quarantine + optional "
+    "admission shed; attrs carry stage, kind, relief actions)",
 }
 
 #: dynamically named span families (f-string call sites the literal

@@ -73,6 +73,8 @@ EVENTS = (
     "transfer_start",   # blocking device→host drain began
     "transfer_done",    # bytes on host
     "result_delivered", # record committed (rows marshalled)
+    "device_fault",     # classified device fault crossed this dispatch
+                        # (exec/devicefault; marks carry the kind count)
 )
 
 #: dispatch path labels (``note_path`` refines; "lane" is sticky — a
@@ -689,6 +691,16 @@ def add_transfer(
     rec = current()
     if rec is not None:
         rec.transfers.append((t_start, t_end, int(nbytes), kind))
+
+
+def note_fault(kind: str) -> None:
+    """A classified device fault (exec/devicefault) crossed the active
+    dispatch: stamp the lifecycle event and bump the per-kind mark so
+    the flight recorder shows WHERE the ladder engaged."""
+    rec = current()
+    if rec is not None:
+        rec.add_event("device_fault")
+        rec.bump(f"device_fault.{kind}")
 
 
 def note_ring(hit: bool, nbytes: int = 0) -> None:

@@ -45,4 +45,15 @@ def db_pressure(db) -> Tuple[Optional[str], float]:
     q = getattr(db, "_repl_quorum", None)
     if q is not None and quorum_degraded(q):
         return "write quorum lost; serving read-only", max(retry, 1.0)
+    # device fault domain headroom shed (exec/devicefault): an OOM that
+    # survived relief, or a memledger total still over the headroom
+    # fraction after it, arms a half-open latch — writes shed for
+    # devicefault_shed_s so admission stops feeding a device that has
+    # nothing left to give (it clears itself; reads keep degrading to
+    # the oracle via quarantine)
+    from orientdb_tpu.exec.devicefault import domain as _fault_domain
+
+    reason, after = _fault_domain.shed_state()
+    if reason is not None:
+        return f"device memory pressure: {reason}", max(retry, after)
     return None, retry

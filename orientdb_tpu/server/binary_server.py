@@ -359,12 +359,17 @@ class _Session:
                     from orientdb_tpu.utils.metrics import metrics
 
                     metrics.incr("binary.shed")
-                    return {
+                    resp = {
                         "ok": False,
                         "error": shed,
                         "code": 503,
                         "retry_after": retry_after,
                     }
+                    if shed.startswith("device memory pressure"):
+                        # flag device-domain sheds so clients can tell
+                        # device pressure from host overload
+                        resp["device"] = True
+                    return resp
             if op == "query":
                 self.server.security.check(self.user, RES_RECORD, "read")
                 # singles ride the cross-session lane path: concurrent
@@ -554,6 +559,25 @@ class _Session:
         except SecurityError as e:
             return {"ok": False, "error": str(e), "code": 403}
         except Exception as e:  # protocol errors must not kill the session
+            # a device fault that escaped every fallback (quarantine
+            # raced the oracle path, or relief itself failed) maps to a
+            # retryable 503 with the ``device`` marker: by retry_after
+            # the escalation ladder has quarantined the plan and the
+            # retry lands on the oracle
+            from orientdb_tpu.exec import devicefault
+
+            if isinstance(
+                e, (devicefault.DeviceFaultError, devicefault.DeviceQuarantined)
+            ):
+                return {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                    "code": 503,
+                    "retry_after": float(
+                        getattr(e, "retry_after", None) or 0.5
+                    ),
+                    "device": True,
+                }
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 

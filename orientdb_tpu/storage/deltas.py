@@ -580,10 +580,31 @@ class SnapshotMaintainer:
         dg = ov.snap._device_cache
         if dg is None:
             return  # host arrays already patched; upload happens lazily
-        nbytes = 0
-        for phase in patches.phases:
-            if phase:
-                nbytes += dg.apply_patches(phase)
+        # the scatter-patch upload is a device transfer: guard it with
+        # the device fault domain (lazy import — this module loads
+        # before the exec stack). A retry re-applies the same patches —
+        # functional .at[].set of the same values, so idempotent. On
+        # exhaustion the overlay poisons itself: the next catch-up
+        # compacts (host-side rebuild, fresh upload) and queries serve
+        # the oracle meanwhile — compaction is the ladder's relief
+        # actuator here, not another faultable dispatch.
+        from orientdb_tpu.exec import devicefault
+
+        def _upload() -> int:
+            devicefault.transfer_point()
+            n = 0
+            for phase in patches.phases:
+                if phase:
+                    n += dg.apply_patches(phase)
+            return n
+
+        try:
+            nbytes = devicefault.domain.run(
+                _upload, db=self.db, stage="delta_apply"
+            )
+        except devicefault.DeviceQuarantined as e:
+            ov.poison(f"device fault during delta apply: {e}")
+            return
         ov.upload_bytes += nbytes
         metrics.incr("snapshot.delta.upload_bytes", nbytes)
 

@@ -385,13 +385,25 @@ class TierManager:
 
     def _load_blocks(self, part: _Partition, need: List[int], seq: int,
                      requested: Set[int]) -> None:
+        # the cold-block upload wave is a device transfer: run it under
+        # the device fault domain's escalation ladder (lazy import —
+        # tpu_engine module-imports this file). A retry re-grabs pages
+        # for not-yet-resident blocks; pages grabbed by a failed pass
+        # stay recyclable via the free/LRU machinery. Exhaustion raises
+        # DeviceQuarantined (an Uncompilable) — the dispatch above this
+        # ensure degrades to the oracle.
+        from orientdb_tpu.exec import devicefault
+
         dg = self._dg
         keys = _keys(part.cname, part.d)
         nbytes = len(need) * part.block_bytes()
         t0 = time.monotonic()
-        with span("tier.prefetch", cname=part.cname, d=part.d,
-                  blocks=len(need)):
+
+        def _upload() -> None:
+            devicefault.transfer_point()
             for b in need:
+                if part.page_of[b] >= 0:
+                    continue  # a prior attempt already landed it
                 last = part.evicted_at.get(b)
                 if last is not None and seq - last <= _THRASH_WINDOW:
                     self._thrash.append(seq)
@@ -407,6 +419,10 @@ class TierManager:
                 part.block_of_page[p] = b
                 self.prefetch_misses += 1
                 metrics.incr("tier.prefetch.misses")
+
+        with span("tier.prefetch", cname=part.cname, d=part.d,
+                  blocks=len(need)):
+            devicefault.domain.run(_upload, tier=self, stage="prefetch")
         TL.add_transfer(t0, time.monotonic(), nbytes, "prefetch")
         TL.mark("tier_prefetch")
         # the functional .at[].set writes produced NEW pool arrays:

@@ -132,6 +132,23 @@ def hbm_leaves(extras: Dict) -> Iterator[Tuple[str, float]]:
             yield f"memory.peak.{kind}", float(pv)
 
 
+def degraded_round(doc: Optional[Dict]) -> bool:
+    """True when a round's evidence records degraded-mode dispatches —
+    quarantine-driven oracle fallbacks, admission sheds, or plan
+    quarantines from the device fault domain (the per-round
+    ``device_faults`` evidence block, exec/devicefault). A chaos round
+    measures the ladder, not the fast path: ``bench._last_good_round``
+    skips these so one can never become the regression baseline."""
+    ex = (doc or {}).get("extras") or {}
+    df = ex.get("device_faults")
+    if not isinstance(df, dict):
+        return False
+    return any(
+        int(df.get(k) or 0) > 0
+        for k in ("oracle_served", "sheds", "quarantines")
+    )
+
+
 def diff(
     base: Dict,
     cur: Dict,

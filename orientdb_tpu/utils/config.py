@@ -306,6 +306,26 @@ class GlobalConfiguration:
     view_min_calls: int = 8
     view_cache_size: int = 64
 
+    # Device fault domain (exec/devicefault; README "Failure modes &
+    # recovery"): every dispatch/fetch path runs under an escalation
+    # ladder — classify, retry (devicefault_retry_attempts attempts
+    # within devicefault_retry_budget_s seconds under the shared
+    # RetryPolicy), memledger-guided relief on OOM, then quarantine the
+    # plan's fingerprint to the oracle for devicefault_quarantine_ttl_s
+    # seconds (probe re-admission after; failed probes double the TTL).
+    # When relief leaves the memledger total above
+    # devicefault_headroom_fraction x tier_hbm_cap_bytes (or an OOM
+    # survives relief), the admission plane sheds writes with 503 +
+    # Retry-After for devicefault_shed_s seconds.
+    # alert_device_faults_per_min is the device_fault_storm rule's
+    # classified-faults-per-minute threshold.
+    devicefault_retry_attempts: int = 3
+    devicefault_retry_budget_s: float = 2.0
+    devicefault_quarantine_ttl_s: float = 15.0
+    devicefault_shed_s: float = 2.0
+    devicefault_headroom_fraction: float = 0.9
+    alert_device_faults_per_min: float = 60.0
+
     # Alert threshold (obs/alerts delta_slab_pressure): fires when the
     # snapshot.delta.slab_fill gauge crosses this fraction — deltas are
     # outpacing compaction.
