@@ -1322,6 +1322,12 @@ def _measure() -> None:
     splits = {}
     # live reference: every _flush_detail sees the splits recorded so far
     extras["phase_split_ms_per_query"] = splits
+    # per-segment critical-path split per workload (obs/critpath):
+    # {tag: {segment: ms_per_query}} — perfdiff's segment leaves, so a
+    # headline regression names the segment that grew
+    crit_splits = {}
+    extras["critpath"] = crit_splits
+    from orientdb_tpu.obs.critpath import plane as _cp_plane
     # medians of >= 3 timed reps per workload (VERDICT r4 #6): one rep's
     # q/s rides the tunnel's ±40% noise; the median of 3 — and medians of
     # the per-phase ms — are what the gate compares round over round
@@ -1330,6 +1336,22 @@ def _measure() -> None:
     def _median_split(ss):
         return {
             k: round(_median([s[k] for s in ss]), 3) for k in ss[0]
+        }
+
+    def _crit_delta(before, after, n_queries):
+        """Per-query segment ms from two critpath cumulative totals."""
+        out = {}
+        for seg in after:
+            d = after[seg] - before.get(seg, 0.0)
+            if d > 0.0:
+                out[seg] = d * 1000.0 / n_queries
+        return out
+
+    def _median_crit(cs):
+        segs = sorted({s for c in cs for s in c})
+        return {
+            s: round(_median([c.get(s, 0.0) for c in cs]), 3)
+            for s in segs
         }
 
     def _phase_split(before, after, n_queries):
@@ -1358,16 +1380,20 @@ def _measure() -> None:
         # one span per measured block: every query inside nests under
         # it, so the block's trace id (recorded in the evidence stream)
         # joins the number to its per-query spans in the debug bundle
+        cs = []
         with _bench_span("bench.block", block=tag or "single") as sp:
             for _ in range(reps):
                 before = metrics.snapshot()
+                cp_before = _cp_plane.totals()
                 t0 = time.perf_counter()
                 for _ in range(n):
                     run("tpu", q)
                 qpss.append(n / (time.perf_counter() - t0))
                 ss.append(_phase_split(before, metrics.snapshot(), n))
+                cs.append(_crit_delta(cp_before, _cp_plane.totals(), n))
         if tag:
             splits[tag] = _median_split(ss)
+            crit_splits[tag] = _median_crit(cs)
             block_trace[tag] = sp.trace_id
         return _median(qpss)
 
@@ -1382,9 +1408,11 @@ def _measure() -> None:
         db.query_batch(qs, params_list, engine="tpu", strict=True)
         drain_warmups()
         qpss, ss = [], []
+        cs = []
         with _bench_span("bench.block", block=tag or "batched") as sp:
             for _ in range(reps):
                 before = metrics.snapshot()
+                cp_before = _cp_plane.totals()
                 t0 = time.perf_counter()
                 for _ in range(n):
                     rss = db.query_batch(
@@ -1396,8 +1424,14 @@ def _measure() -> None:
                 ss.append(
                     _phase_split(before, metrics.snapshot(), n * batch)
                 )
+                cs.append(
+                    _crit_delta(
+                        cp_before, _cp_plane.totals(), n * batch
+                    )
+                )
         if tag:
             splits[tag] = _median_split(ss)
+            crit_splits[tag] = _median_crit(cs)
             block_trace[tag] = sp.trace_id
         return _median(qpss)
 
@@ -1413,6 +1447,23 @@ def _measure() -> None:
         agg["value"] = round(batched_qps, 3)
         ev("batched_2hop", qps=round(batched_qps, 3),
            split=splits.get("batched_2hop"))
+        # ROADMAP item 4's named acceptance leaves: the flight
+        # recorder's device-idle / transfer-hidden fractions over the
+        # headline trio's dispatches, as a perfdiff-gated extras block
+        try:
+            from orientdb_tpu.obs.timeline import recorder as _tl_rec
+            from orientdb_tpu.utils.config import config as _cfg
+
+            _ov = _tl_rec.overlap(window_s=_cfg.timeline_window_s)
+            extras["headline_overlap"] = {
+                "device_idle_fraction": _ov.get("device_idle_fraction"),
+                "transfer_hidden_fraction": (_ov.get("transfer") or {}).get(
+                    "transfer_hidden_fraction"
+                ),
+                "records": _ov.get("records", 0),
+            }
+        except Exception as e:
+            print(f"headline overlap capture failed: {e}", file=sys.stderr)
         # the headline number exists: compare + persist NOW. A harness
         # kill anywhere past this point still leaves a non-zero
         # BENCH_HEADLINE artifact with a perfdiff verdict on disk (the

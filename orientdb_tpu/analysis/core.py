@@ -202,6 +202,7 @@ def load_passes() -> None:
     from orientdb_tpu.analysis import (  # noqa: F401
         alertlint,
         configlint,
+        critpathlint,
         exceptlint,
         iolint,
         jaxlint,

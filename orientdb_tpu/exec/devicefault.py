@@ -281,6 +281,8 @@ class DeviceFaultDomain:
         ``SimulatedCrash`` always pass through. Exhaustion raises
         :class:`DeviceQuarantined` (an ``Uncompilable``) — zero
         unclassified device exceptions escape."""
+        import time as _time
+
         from orientdb_tpu.parallel.resilience import (
             RetryBudgetExceeded,
             RetryPolicy,
@@ -288,10 +290,21 @@ class DeviceFaultDomain:
 
         give_up = (Uncompilable,) + tuple(passthrough)
         relief_done: List[str] = []
+        # fault_retry attribution: retry backoff sleep + failed attempts
+        # must not masquerade as device-compute growth in the critical-
+        # path blame diff, so everything run() spends beyond the single
+        # SUCCESSFUL attempt is stamped as its own segment
+        t_run0 = _time.perf_counter()
+        last_attempt_s = [0.0]
+        n_attempts = [0]
 
         def _attempt():
+            n_attempts[0] += 1
+            t_a = _time.perf_counter()
             try:
-                return fn()
+                out = fn()
+                last_attempt_s[0] = _time.perf_counter() - t_a
+                return out
             except give_up:
                 raise
             except Exception as e:
@@ -327,6 +340,12 @@ class DeviceFaultDomain:
         except give_up:
             raise
         except (_PersistentFault, RetryBudgetExceeded) as e:
+            import orientdb_tpu.obs.critpath as _CP
+
+            # exhaustion: the whole guarded section was retry churn
+            _CP.add_segment(
+                "fault_retry", _time.perf_counter() - t_run0
+            )
             cause = e if isinstance(e, DeviceFaultError) else e.__cause__
             kind = cause.kind if isinstance(
                 cause, DeviceFaultError
@@ -334,6 +353,14 @@ class DeviceFaultDomain:
             self._escalate(kind, cause, db=db, sql=sql, stage=stage,
                            relief_done=relief_done)
         else:
+            if n_attempts[0] > 1:
+                overhead = (
+                    _time.perf_counter() - t_run0
+                ) - last_attempt_s[0]
+                if overhead > 0.0:
+                    import orientdb_tpu.obs.critpath as _CP
+
+                    _CP.add_segment("fault_retry", overhead)
             if sql and self._q:
                 self.note_success(sql)
             return out

@@ -118,6 +118,7 @@ def _run(
             metrics.incr("query.tpu.fallback")
             log.info("tpu engine fallback to oracle: %s", e)
     metrics.incr("query.oracle")
+    import orientdb_tpu.obs.critpath as CP
     import orientdb_tpu.obs.timeline as TL
     from orientdb_tpu.exec.oracle import execute_statement
 
@@ -125,7 +126,7 @@ def _run(
     # device intervals (host interpreter), but its wall time shows up
     # in the timeline next to the compiled paths it is compared against
     rec = TL.recorder.begin("oracle")
-    with TL.active(rec):
+    with TL.active(rec), CP.segment("host_compute"):
         rows = execute_statement(db, stmt, params)
     TL.recorder.commit(rec)
     return rows, "oracle"
@@ -194,24 +195,29 @@ def execute_query(
     rejected here too."""
     import time
 
+    import orientdb_tpu.obs.critpath as CP
     import orientdb_tpu.obs.stats as S
     from orientdb_tpu.obs.trace import span
 
     t0 = time.perf_counter()
     acc = S.stats.begin(sql)
-    try:
-        with span("query", sql=sql[:120]) as sp:
-            rs = _execute_query(db, sql, params, engine, strict)
-            sp.set("engine", getattr(rs, "engine", None))
-            rows = getattr(rs, "_rows", None)
-            if hasattr(rows, "__len__"):
-                sp.set("rows", len(rows))
-                if acc is not None:
-                    acc._rows = len(rows)  # type: ignore[attr-defined]
-    except BaseException as e:
-        _observe_error(sql, t0, acc, e)
-        raise
-    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
+    with CP.request("engine", sql) as cp:
+        seg0 = cp.total() if cp is not None else 0.0
+        try:
+            with span("query", sql=sql[:120]) as sp:
+                rs = _execute_query(db, sql, params, engine, strict)
+                sp.set("engine", getattr(rs, "engine", None))
+                rows = getattr(rs, "_rows", None)
+                if hasattr(rows, "__len__"):
+                    sp.set("rows", len(rows))
+                    if acc is not None:
+                        acc._rows = len(rows)  # type: ignore[attr-defined]
+        except BaseException as e:
+            _observe_error(sql, t0, acc, e)
+            CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
+            raise
+        _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
+        CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
     return rs
 
 
@@ -280,22 +286,27 @@ def execute_command(
 ) -> ResultSet:
     import time
 
+    import orientdb_tpu.obs.critpath as CP
     import orientdb_tpu.obs.stats as S
     from orientdb_tpu.obs.trace import span
 
     t0 = time.perf_counter()
     acc = S.stats.begin(sql)
-    try:
-        with span("command", sql=sql[:120]) as sp:
-            rs = _execute_command(db, sql, params, engine, strict)
-            sp.set("engine", getattr(rs, "engine", None))
-            rows = getattr(rs, "_rows", None)
-            if acc is not None and hasattr(rows, "__len__"):
-                acc._rows = len(rows)  # type: ignore[attr-defined]
-    except BaseException as e:
-        _observe_error(sql, t0, acc, e)
-        raise
-    _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
+    with CP.request("command", sql) as cp:
+        seg0 = cp.total() if cp is not None else 0.0
+        try:
+            with span("command", sql=sql[:120]) as sp:
+                rs = _execute_command(db, sql, params, engine, strict)
+                sp.set("engine", getattr(rs, "engine", None))
+                rows = getattr(rs, "_rows", None)
+                if acc is not None and hasattr(rows, "__len__"):
+                    acc._rows = len(rows)  # type: ignore[attr-defined]
+        except BaseException as e:
+            _observe_error(sql, t0, acc, e)
+            CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
+            raise
+        _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
+        CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
     return rs
 
 
@@ -337,6 +348,7 @@ def execute_query_batch(
     """
     import time
 
+    import orientdb_tpu.obs.critpath as CP
     import orientdb_tpu.obs.stats as S
     from orientdb_tpu.obs.trace import span
 
@@ -353,23 +365,66 @@ def execute_query_batch(
     rec = TL.recorder.begin(
         "batch", sql=sqls[0] if sqls else None, n=len(sqls)
     )
-    with span("query_batch", n=len(sqls)):
-        with TL.active(rec):
-            out = _execute_query_batch(db, sqls, params_list, engine, strict)
-    TL.recorder.commit(rec)
-    # per-statement stats with the batch's amortized wall clock: device
-    # time overlaps across the whole batch, so per-item attribution
-    # would be fiction — calls/rows/engine are what aggregate honestly
-    per = (time.perf_counter() - t0) / max(len(sqls), 1)
-    for sql, rs in zip(sqls, out):
-        rows = getattr(rs, "_rows", None)
-        S.stats.record_external(
-            sql,
-            per,
-            engine=getattr(rs, "engine", "?"),
-            rows=len(rows) if hasattr(rows, "__len__") else None,
-        )
+    with CP.request("batch", sqls[0] if sqls else None) as cp:
+        seg0 = cp.total() if cp is not None else 0.0
+        with span("query_batch", n=len(sqls)):
+            # the capture collects the batch's device/transfer/compile
+            # attribution (no per-query accumulator runs on a batch)
+            with S.capture() as cap, TL.active(rec):
+                out = _execute_query_batch(
+                    db, sqls, params_list, engine, strict
+                )
+        TL.recorder.commit(rec)
+        dur = time.perf_counter() - t0
+        # per-statement stats with the batch's amortized wall clock:
+        # device time overlaps across the whole batch, so per-item
+        # attribution would be fiction — calls/rows/engine are what
+        # aggregate honestly
+        n = max(len(sqls), 1)
+        per = dur / n
+        per_segs = _amortized_segs(cp, dur, cap, seg0, n)
+        for sql, rs in zip(sqls, out):
+            rows = getattr(rs, "_rows", None)
+            S.stats.record_external(
+                sql,
+                per,
+                engine=getattr(rs, "engine", "?"),
+                rows=len(rows) if hasattr(rows, "__len__") else None,
+            )
+            if per_segs:
+                S.stats.record_segments(sql, per_segs)
     return out
+
+
+def _amortized_segs(cp, dur: float, cap, seg0: float, n: int):
+    """Fold one batch execution into the active critical-path record
+    and return the per-statement amortized segment split for the stats
+    table. The record takes the FULL batch cost (its segment sum must
+    match the request's wall — the caller waited for the whole batch);
+    the stats columns take the 1/n share next to record_external's
+    amortized wall, and the record is marked so commit does not write
+    the full-batch split over the amortized one."""
+    import orientdb_tpu.obs.critpath as CP
+
+    if cp is None:
+        return None
+    CP.fold_query(cp, dur, cap, seg0)
+    cp.stats_recorded = True
+    return {
+        k: v / n
+        for k, v in (
+            ("queue", cap.queue_s),
+            ("plan_resolve", cap.compile_s),
+            ("device_compute", cap.device_s),
+            ("result_transfer", cap.transfer_s),
+            ("host_compute", max(
+                0.0,
+                dur - cap.queue_s - cap.compile_s - cap.device_s
+                - cap.transfer_s,
+            )),
+        )
+        if v > 0.0
+    }
 
 
 def _execute_query_batch(
@@ -485,18 +540,26 @@ def dispatch_lane_batch(
         ring = ring_state.get("ring")
         if ring is None:
             ring = ring_state["ring"] = tpu_engine.ParamRing()
-    h = tpu_engine.dispatch_lane(
-        db,
-        items,
-        ring=ring,
-        sql=sqls[0],
-        enqueue_ts=enqueue_ts,
-        window_s=window_s,
-        min_epoch=min_epoch,
-    )
+    import orientdb_tpu.obs.critpath as CP
+
+    # detached worker-side harvest record: ring staging stamps its
+    # param_upload/ring_hit timing here (this lane worker thread has no
+    # per-request record); collect() amortizes the harvest across the
+    # batch members, whose dicts travel back to the submitting sessions
+    harvest = CP.CritPath("lane") if config.critpath_enabled else None
+    with CP.active(harvest):
+        h = tpu_engine.dispatch_lane(
+            db,
+            items,
+            ring=ring,
+            sql=sqls[0],
+            enqueue_ts=enqueue_ts,
+            window_s=window_s,
+            min_epoch=min_epoch,
+        )
     if h is None:
         return None
-    return _LaneHandle(sqls, h)
+    return _LaneHandle(sqls, h, harvest.segs if harvest else None)
 
 
 class _LaneHandle:
@@ -504,11 +567,17 @@ class _LaneHandle:
     blocks on the fetch, wraps rows in ResultSets, and attributes the
     batch's amortized cost to each member fingerprint."""
 
-    __slots__ = ("sqls", "_h")
+    __slots__ = ("sqls", "_h", "_stage_segs", "item_segs")
 
-    def __init__(self, sqls, h) -> None:
+    def __init__(self, sqls, h, stage_segs=None) -> None:
         self.sqls = sqls
         self._h = h
+        #: worker-side staging stamps (param_upload / ring_hit seconds
+        #: for the whole batch) harvested by dispatch_lane_batch
+        self._stage_segs = stage_segs
+        #: per-item critical-path splits built by collect(), read by
+        #: the coalescer and folded into each submitter's record
+        self.item_segs: Optional[List[Dict[str, float]]] = None
 
     def collect(self, queue_waits=None) -> List[ResultSet]:
         import time
@@ -518,9 +587,13 @@ class _LaneHandle:
         t0 = time.perf_counter()
         with S.capture() as cap:
             outs = self._h.collect()
+        wall = time.perf_counter() - t0
         n = max(len(outs), 1)
-        per = (time.perf_counter() - t0) / n
+        per = wall / n
+        host_per = max(0.0, wall - cap.device_s - cap.transfer_s) / n
+        stage = self._stage_segs or {}
         results = []
+        self.item_segs = []
         for k, (sql, rows) in enumerate(zip(self.sqls, outs)):
             rs = _result_set(rows, "tpu")
             S.stats.record_external(
@@ -532,6 +605,17 @@ class _LaneHandle:
                 device_s=cap.device_s / n,
                 transfer_s=cap.transfer_s / n,
                 bytes_fetched=cap.bytes_fetched // n,
+            )
+            segs = {
+                "queue": queue_waits[k] if queue_waits else 0.0,
+                "device_compute": cap.device_s / n,
+                "result_transfer": cap.transfer_s / n,
+                "host_compute": host_per,
+            }
+            for name, v in stage.items():
+                segs[name] = segs.get(name, 0.0) + v / n
+            self.item_segs.append(
+                {k2: v for k2, v in segs.items() if v > 0.0}
             )
             results.append(rs)
         return results

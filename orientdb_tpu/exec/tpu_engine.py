@@ -3239,7 +3239,13 @@ class _CompiledPlan(_AotWarmup):
             # arrays: handing the jitted call raw numpy made the same
             # transfer implicitly on every dispatch — invisible to
             # profiling and flagged by the deviceguard transfer guard
+            import time as _time
+
+            import orientdb_tpu.obs.critpath as _CP
+
+            _t_up = _time.perf_counter()
             dyn = jax.device_put(dyn)
+            _CP.add_segment("param_upload", _time.perf_counter() - _t_up)
             _TL.mark("param_upload")
         tier = self.solver.tier
         if tier is not None:
@@ -4019,15 +4025,21 @@ class ParamRing:
         """Device form of ``host`` (a dict of stacked numpy arrays):
         the staged copy when a slot's value set matches, a fresh
         explicit upload into the next slot otherwise."""
+        import time as _time
+
+        import orientdb_tpu.obs.critpath as _CP
         from orientdb_tpu.obs.timeline import note_ring
 
+        _t0 = _time.perf_counter()
         for slot in self._slots:
             if slot is not None and self._same(slot[0], host):
                 metrics.incr("tpu.param_ring.hit")
                 note_ring(True)
+                _CP.add_segment("ring_hit", _time.perf_counter() - _t0)
                 return slot[1]
         devicefault.transfer_point()
         dev = jax.device_put(host)
+        _CP.add_segment("param_upload", _time.perf_counter() - _t0)
         nbytes = sum(int(a.nbytes) for a in host.values())
         metrics.incr("tpu.param_ring.upload")
         metrics.incr("tpu.param_ring.bytes", nbytes)

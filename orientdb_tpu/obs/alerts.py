@@ -63,7 +63,9 @@ RULE_CATALOG: Dict[str, str] = {
     "recompile_storm": "shape-overflow recompiles per minute exceed "
     "alert_recompiles_per_min (plan cache thrash)",
     "latency_regression": "a fingerprint's per-tick mean latency "
-    "exceeds its online EWMA baseline by alert_latency_mads deviations",
+    "exceeds its online EWMA baseline by alert_latency_mads deviations; "
+    "carries a critical-path blame annotation (obs/critpath) naming the "
+    "segment(s) that grew, with the worst request's trace as exemplar",
     "error_burn_rate": "query error rate burns the SLO error budget at "
     "more than alert_burn_factor x in BOTH burn windows",
     "overlap_regression": "the dispatch timeline's device-idle "
@@ -119,7 +121,7 @@ class Breach:
     member name, breaker name, or fingerprint id), the measured value,
     the threshold it crossed, and a human detail line."""
 
-    __slots__ = ("key", "value", "threshold", "detail", "trace_id")
+    __slots__ = ("key", "value", "threshold", "detail", "trace_id", "blame")
 
     def __init__(
         self,
@@ -128,6 +130,7 @@ class Breach:
         threshold: float,
         detail: str,
         trace_id: Optional[str] = None,
+        blame: Optional[Dict] = None,
     ) -> None:
         self.key = key
         self.value = value
@@ -137,6 +140,9 @@ class Breach:
         #: trace id for hbm_epoch_leak) carries it; _exemplar prefers
         #: this over the slowlog/span-ring heuristics
         self.trace_id = trace_id
+        #: critical-path blame annotation (obs/critpath.plane.blame):
+        #: which segment(s) of the fingerprint's decomposition grew
+        self.blame = blame
 
 
 class AlertRule:
@@ -199,6 +205,7 @@ class Alert:
         "resolved_ts",
         "streak",
         "exemplar_trace_id",
+        "blame",
     )
 
     def __init__(self, rule: AlertRule, br: Breach, now: float) -> None:
@@ -214,6 +221,7 @@ class Alert:
         self.resolved_ts: Optional[float] = None
         self.streak = 1
         self.exemplar_trace_id: Optional[str] = None
+        self.blame: Optional[Dict] = br.blame
 
     def to_dict(self) -> Dict[str, object]:
         out: Dict[str, object] = {
@@ -228,6 +236,8 @@ class Alert:
             "last_ts": round(self.last_ts, 3),
             "exemplar_trace_id": self.exemplar_trace_id,
         }
+        if self.blame is not None:
+            out["blame"] = self.blame
         if self.resolved_ts is not None:
             out["resolved_ts"] = round(self.resolved_ts, 3)
         return out
@@ -353,6 +363,8 @@ class AlertEngine:
                     a.value = br.value
                     a.threshold = br.threshold
                     a.detail = br.detail
+                    if br.blame is not None:
+                        a.blame = br.blame
                     a.last_ts = now
                     a.streak += 1
                 if a.state == "pending" and a.streak >= pending_ticks:
@@ -734,15 +746,41 @@ class AlertEngine:
                 # otherwise teach the EWMA the new level before the
                 # dwell elapses and the alert could never reach firing
                 if d_calls >= min_calls:
+                    detail = (
+                        f"fingerprint {fid}: tick mean "
+                        f"{mean_s * 1e3:.2f} ms vs baseline "
+                        f"{base.ewma_s * 1e3:.2f} ms "
+                        f"(±{max(base.mad_s, _MAD_FLOOR_S) * 1e3:.2f})"
+                    )
+                    # critical-path blame: which segment of this
+                    # fingerprint's decomposition grew (obs/critpath
+                    # window diff), with the worst recent request's
+                    # trace id as the exemplar join key
+                    blame = None
+                    try:
+                        from orientdb_tpu.obs.critpath import plane
+
+                        blame = plane.blame(fid)
+                    except Exception:
+                        log.debug(
+                            "critpath blame failed for %s",
+                            fid, exc_info=True,
+                        )
+                    if blame:
+                        detail += "; blame: " + ", ".join(
+                            f"{g['segment']} +{g['delta_ms']:.2f}ms"
+                            for g in blame["segments"]
+                        )
                     yield Breach(
                         fid, mean_s * 1000.0,
                         (base.ewma_s
                          + config.alert_latency_mads
                          * max(base.mad_s, _MAD_FLOOR_S)) * 1000.0,
-                        f"fingerprint {fid}: tick mean "
-                        f"{mean_s * 1e3:.2f} ms vs baseline "
-                        f"{base.ewma_s * 1e3:.2f} ms "
-                        f"(±{max(base.mad_s, _MAD_FLOOR_S) * 1e3:.2f})",
+                        detail,
+                        trace_id=(
+                            blame.get("trace_id") if blame else None
+                        ),
+                        blame=blame,
                     )
             else:
                 base.update(mean_s)

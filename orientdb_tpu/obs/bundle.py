@@ -112,6 +112,7 @@ def debug_bundle(
     staged-2PC state); ``cluster`` (when attached) contributes the
     membership status block."""
     from orientdb_tpu.obs.alerts import engine
+    from orientdb_tpu.obs.critpath import plane as critpath_plane
     from orientdb_tpu.obs.profile import profiler
     from orientdb_tpu.obs.stats import stats
     from orientdb_tpu.obs.timeline import recorder
@@ -146,6 +147,11 @@ def debug_bundle(
                 window_s=config.timeline_window_s, limit=50
             ),
         },
+        # per-request critical-path attribution (obs/critpath): which
+        # segment of the request's life the latency lives in, per SLO
+        # class and per fingerprint, with recent decompositions — the
+        # blame evidence next to the alerts that cite it
+        "critpath": critpath_plane.report(8),
         # the device-memory ledger (obs/memledger): per-owner HBM
         # rollup, watermark ring, reconciliation vs jax.live_arrays,
         # and lease/refusal state — what is in HBM and who owns it,

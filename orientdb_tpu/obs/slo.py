@@ -179,7 +179,13 @@ class SloEngine:
 
     def begin(self, spec: SloSpec) -> SloRun:
         """Arm one evaluation window: snapshot every spec fingerprint's
-        histogram so :meth:`finish` scores only this run's traffic."""
+        histogram so :meth:`finish` scores only this run's traffic.
+        Also installs the spec's class membership into the critical-path
+        plane so its per-SloClass breakdowns roll up by the same
+        names."""
+        from orientdb_tpu.obs.critpath import register_slo_classes
+
+        register_slo_classes(spec.classes)
         fids = [f for c in spec.classes for f in c.fids()]
         return SloRun(spec, stats.histogram_snapshot(fids))
 

@@ -255,6 +255,7 @@ class _Entry:
         "first_ts",
         "last_ts",
         "plan",
+        "segs",
     )
 
     def __init__(self, fid: str, text: str) -> None:
@@ -280,6 +281,10 @@ class _Entry:
         self.first_ts = time.time()
         self.last_ts = self.first_ts
         self.plan: Optional[str] = None
+        #: cumulative critical-path segment seconds (obs/critpath
+        #: commit folds each sampled request's decomposition in here —
+        #: the per-fingerprint segment columns riding this table)
+        self.segs: Dict[str, float] = {}
 
     def to_dict(self) -> Dict[str, object]:
         out: Dict[str, object] = {
@@ -305,6 +310,10 @@ class _Entry:
         out["last_ts"] = round(self.last_ts, 3)
         if self.plan:
             out["plan"] = self.plan
+        if self.segs:
+            out["segments_s"] = {
+                k: round(v, 6) for k, v in sorted(self.segs.items())
+            }
         return out
 
 
@@ -528,6 +537,32 @@ class QueryStats:
         acc.bytes_fetched = bytes_fetched
         self._record(fp, acc, duration_s, engine, rows, error)
         return fp.fid
+
+    def record_segments(self, sql: str, segs: Dict[str, float]) -> None:
+        """Fold one committed critical-path decomposition
+        (obs/critpath) into the fingerprint's cumulative segment
+        columns WITHOUT counting a call — the execution path already
+        recorded the call, and the critpath plane already made the
+        sampling decision at begin_request (a second draw here would
+        thin the segment columns against their own calls)."""
+        if not segs:
+            return
+        fp = fingerprint_cached(sql)
+        with self._lock:
+            e = self._entry_locked(fp)
+            if e is None:
+                return
+            d = e.segs
+            for k, v in segs.items():
+                if v > 0.0:
+                    d[k] = d.get(k, 0.0) + v
+
+    def segments_of(self, fid: str) -> Dict[str, float]:
+        """One fingerprint's cumulative segment seconds ({} when
+        untracked) — windowed readers difference two of these."""
+        with self._lock:
+            e = self._map.get(fid)
+            return dict(e.segs) if e is not None else {}
 
     def record_queue(self, sql: str, queue_s: float) -> None:
         """Fold queue-wait seconds into a fingerprint's entry WITHOUT

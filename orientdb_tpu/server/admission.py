@@ -29,31 +29,36 @@ def quorum_degraded(q) -> bool:
 
 def db_pressure(db) -> Tuple[Optional[str], float]:
     """(shed reason or None, Retry-After seconds) for writes to ``db``."""
+    from orientdb_tpu.obs.critpath import segment
     from orientdb_tpu.utils.config import config
 
-    retry = config.retry_after_s
-    if db is None:
-        return None, retry
-    reg = getattr(db, "_tx2pc_registry", None)
-    if reg is not None and config.tx2pc_staged_max:
-        n = reg.staged_count()
-        if n > config.tx2pc_staged_max:
-            return (
-                f"staged 2PC backlog {n} > {config.tx2pc_staged_max}",
-                retry,
-            )
-    q = getattr(db, "_repl_quorum", None)
-    if q is not None and quorum_degraded(q):
-        return "write quorum lost; serving read-only", max(retry, 1.0)
-    # device fault domain headroom shed (exec/devicefault): an OOM that
-    # survived relief, or a memledger total still over the headroom
-    # fraction after it, arms a half-open latch — writes shed for
-    # devicefault_shed_s so admission stops feeding a device that has
-    # nothing left to give (it clears itself; reads keep degrading to
-    # the oracle via quarantine)
-    from orientdb_tpu.exec.devicefault import domain as _fault_domain
+    # the admission decision itself is a critical-path segment: under
+    # backlog the staged-count / quorum / fault-domain probes contend
+    # on their locks, and that wait must not blur into parse time
+    with segment("admission"):
+        retry = config.retry_after_s
+        if db is None:
+            return None, retry
+        reg = getattr(db, "_tx2pc_registry", None)
+        if reg is not None and config.tx2pc_staged_max:
+            n = reg.staged_count()
+            if n > config.tx2pc_staged_max:
+                return (
+                    f"staged 2PC backlog {n} > {config.tx2pc_staged_max}",
+                    retry,
+                )
+        q = getattr(db, "_repl_quorum", None)
+        if q is not None and quorum_degraded(q):
+            return "write quorum lost; serving read-only", max(retry, 1.0)
+        # device fault domain headroom shed (exec/devicefault): an OOM
+        # that survived relief, or a memledger total still over the
+        # headroom fraction after it, arms a half-open latch — writes
+        # shed for devicefault_shed_s so admission stops feeding a
+        # device that has nothing left to give (it clears itself;
+        # reads keep degrading to the oracle via quarantine)
+        from orientdb_tpu.exec.devicefault import domain as _fault_domain
 
-    reason, after = _fault_domain.shed_state()
-    if reason is not None:
-        return f"device memory pressure: {reason}", max(retry, after)
-    return None, retry
+        reason, after = _fault_domain.shed_state()
+        if reason is not None:
+            return f"device memory pressure: {reason}", max(retry, after)
+        return None, retry

@@ -40,6 +40,7 @@ import struct
 import threading
 from typing import Optional
 
+import orientdb_tpu.obs.critpath as critpath
 from orientdb_tpu.chaos import fault
 from orientdb_tpu.models.rid import RID
 from orientdb_tpu.models.security import (
@@ -58,18 +59,31 @@ def send_frame(sock: socket.socket, payload: dict) -> None:
     # non-JSON values keep the channel's historical stringification
     from orientdb_tpu.storage.durability import json_channel_default
 
-    data = json.dumps(payload, default=json_channel_default).encode()
-    with fault.point("bin.send"):
-        sock.sendall(struct.pack(">I", len(data)) + data)
+    # critpath stamps are thread-local no-ops on the client side of the
+    # wire (client/remote.py shares this helper but never opens a
+    # record); server-side, the bin.send fault point sits INSIDE the
+    # flush timing so an injected send delay blames flush, not marshal
+    with critpath.segment("marshal"):
+        data = json.dumps(payload, default=json_channel_default).encode()
+    with critpath.segment("flush"):
+        with fault.point("bin.send"):
+            sock.sendall(struct.pack(">I", len(data)) + data)
 
 
-def recv_frame(sock: socket.socket) -> Optional[dict]:
+def recv_frame_raw(sock: socket.socket) -> Optional[bytes]:
+    """One length-prefixed frame's body, undecoded — the server read
+    loop takes frames raw so the JSON decode lands inside the request's
+    ``parse`` segment (the record opens at frame arrival)."""
     with fault.point("bin.recv"):
         head = _recv_exact(sock, 4)
     if head is None:
         return None
     (n,) = struct.unpack(">I", head)
-    body = _recv_exact(sock, n)
+    return _recv_exact(sock, n)
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    body = recv_frame_raw(sock)
     if body is None:
         return None
     return json.loads(body.decode())
@@ -234,35 +248,51 @@ class _Session:
     def _dispatch_async(self, req: dict) -> None:
         """Pipeline mode: run on the session worker pool, respond by
         reqid when ready (the client demultiplexes out-of-order)."""
-        resp = self._dispatch(req)
-        resp["reqid"] = req["reqid"]
-        try:
-            self._send(resp)
-        except OSError:
-            pass  # client gone; the recv loop will notice
+        cp = critpath.begin_request("binary", req.get("sql"))
+        with critpath.active(cp):
+            resp = self._dispatch(req)
+            resp["reqid"] = req["reqid"]
+            try:
+                self._send(resp)
+            except OSError:
+                pass  # client gone; the recv loop will notice
+        critpath.commit(cp)
 
     def run(self) -> None:
         try:
             while True:
-                req = recv_frame(self.sock)
-                if req is None:
+                raw = recv_frame_raw(self.sock)
+                if raw is None:
                     break
+                # the decomposition record opens at frame arrival so the
+                # envelope decode is attributed as parse, not lost ahead
+                # of the handler window
+                cp = critpath.begin_request("binary")
+                with critpath.active(cp):
+                    with critpath.segment("parse"):
+                        req = json.loads(raw.decode())
+                    critpath.note_sql(req.get("sql"))
                 if (
                     self._pool is not None
                     and req.get("op") in ("query", "query_batch")
                     and "reqid" in req
                 ):
                     # pipelined session: don't block the read loop on
-                    # the device — in-flight singles coalesce
+                    # the device — in-flight singles coalesce. The read
+                    # loop's record is abandoned (never committed): the
+                    # worker owns the request end-to-end and opens its
+                    # own
                     self._pool.submit(self._dispatch_async, req)
                     continue
-                resp = self._dispatch(req)
-                # echo the client's correlation id so its channel can
-                # discard stale replies after a response timeout instead
-                # of desynchronizing (client/remote.py _call)
-                if "reqid" in req:
-                    resp["reqid"] = req["reqid"]
-                self._send(resp)
+                with critpath.active(cp):
+                    resp = self._dispatch(req)
+                    # echo the client's correlation id so its channel
+                    # can discard stale replies after a response timeout
+                    # instead of desynchronizing (client/remote.py _call)
+                    if "reqid" in req:
+                        resp["reqid"] = req["reqid"]
+                    self._send(resp)
+                critpath.commit(cp)
                 # a cdc_subscribe's pump starts only AFTER its response
                 # is on the wire: a catch-up batch pushed ahead of the
                 # response would land before the client knows the token

@@ -79,6 +79,7 @@ class _Item:
         "ctx",
         "t_enq",
         "epoch",
+        "segs",
     )
 
     def __init__(self, sql: str, params) -> None:
@@ -92,6 +93,10 @@ class _Item:
         #: CONTINUES the first rider's trace (obs/propagation)
         self.ctx: Optional[Dict] = None
         self.t_enq: float = 0.0
+        #: this item's amortized critical-path decomposition, built on
+        #: the lane worker and merged into the SUBMITTER's request
+        #: record when it wakes (obs/critpath.merge)
+        self.segs: Optional[Dict[str, float]] = None
         #: db.mutation_epoch at ADMISSION: the lane dispatch refuses to
         #: serve this item from a snapshot older than every write that
         #: completed before the item was submitted (epoch keying — a
@@ -349,8 +354,15 @@ class _Lane:
                 mode="lane",
             ):
                 results = handle.collect(queue_waits=waits)
-            for item, rs in zip(batch, results):
+            item_segs = getattr(handle, "item_segs", None) or []
+            for k, (item, rs) in enumerate(zip(batch, results)):
+                t_m = time.monotonic()
                 item.rows = rs.to_dicts()
+                segs = dict(item_segs[k]) if k < len(item_segs) else {}
+                segs["marshal"] = (
+                    segs.get("marshal", 0.0) + time.monotonic() - t_m
+                )
+                item.segs = segs
                 item.engine = rs.engine
             for item in batch:
                 item.event.set()
@@ -365,11 +377,20 @@ class _Lane:
         this thread — head-of-line isolation: the drain loop keeps
         forming and dispatching micro-batches while the poisoned
         cohort sorts itself out on a fallback thread."""
+        import orientdb_tpu.obs.critpath as CP
         import orientdb_tpu.obs.stats as S
         from orientdb_tpu.exec.engine import execute_query_batch
 
         ctx = next((i.ctx for i in batch if i.ctx), None)
+        n = max(len(batch), 1)
         try:
+            # worker-side harvest record: execute_query_batch's front
+            # door JOINS it (never commits), so its fold lands the whole
+            # batch's device/transfer/plan/host split here for the per-
+            # item amortization below
+            harvest = (
+                CP.CritPath("lane") if config.critpath_enabled else None
+            )
             with continue_trace(
                 "coalesce.dispatch",
                 ctx,
@@ -377,16 +398,31 @@ class _Lane:
                 n=len(batch),
                 mode="batch",
             ):
-                results = execute_query_batch(
-                    self.db,
-                    [i.sql for i in batch],
-                    [i.params for i in batch],
-                )
+                with CP.active(harvest):
+                    results = execute_query_batch(
+                        self.db,
+                        [i.sql for i in batch],
+                        [i.params for i in batch],
+                    )
+            per_segs = (
+                {k: v / n for k, v in harvest.segs.items()}
+                if harvest is not None
+                else {}
+            )
             # materialize INSIDE the try: a lazily-raising result (an
             # oracle row stream erroring in to_dicts) must route to the
             # per-item fallback, never escape and kill the drain loop
             for item, rs in zip(batch, results):
+                t_m = time.monotonic()
                 item.rows = rs.to_dicts()
+                segs = dict(per_segs)
+                segs["queue"] = (
+                    segs.get("queue", 0.0) + max(0.0, t0 - item.t_enq)
+                )
+                segs["marshal"] = (
+                    segs.get("marshal", 0.0) + time.monotonic() - t_m
+                )
+                item.segs = segs
                 item.engine = rs.engine
                 S.stats.record_queue(item.sql, max(0.0, t0 - item.t_enq))
         except Exception:
@@ -618,4 +654,10 @@ class QueryCoalescer:
             sp.set("engine", item.engine)
         if item.error is not None:
             raise item.error
+        # fold the lane-built decomposition into THIS session's request
+        # record — the amortized segments are sub-intervals of the wait
+        # the submitter just paid, so its segment sum tracks its wall
+        import orientdb_tpu.obs.critpath as CP
+
+        CP.merge(item.segs)
         return item.rows or [], item.engine

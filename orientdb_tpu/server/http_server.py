@@ -67,6 +67,7 @@ def _traced(fn):
     verb = fn.__name__[3:]
 
     def wrapper(self):
+        import orientdb_tpu.obs.critpath as critpath
         from orientdb_tpu.obs.propagation import (
             continue_trace,
             extract_headers,
@@ -78,13 +79,18 @@ def _traced(fn):
             srv.inflight += 1
             metrics.gauge("http.inflight", srv.inflight)
         path = urllib.parse.urlparse(self.path).path
+        # the critical-path record covers the whole handler window:
+        # route parse, admission, execution, response marshal+flush
+        cp = critpath.begin_request("http")
         try:
             with continue_trace(
                 f"http.{verb}", extract_headers(self.headers),
                 path=path[:120],
             ):
-                return fn(self)
+                with critpath.active(cp):
+                    return fn(self)
         finally:
+            critpath.commit(cp)
             with srv.inflight_lock:
                 srv.inflight -= 1
                 metrics.gauge("http.inflight", srv.inflight)
@@ -112,12 +118,16 @@ class _Handler(BaseHTTPRequestHandler):
             # 500), not silently stringified response data
             raise TypeError(f"not JSON-serializable: {type(v).__name__}")
 
-        body = json.dumps(payload, default=enc).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        import orientdb_tpu.obs.critpath as critpath
+
+        with critpath.segment("marshal"):
+            body = json.dumps(payload, default=enc).encode()
+        with critpath.segment("flush"):
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
     def _error(self, code: int, msg: str) -> None:
         self._send(code, {"errors": [{"code": code, "content": msg}]})
@@ -394,6 +404,21 @@ class _Handler(BaseHTTPRequestHandler):
                 from orientdb_tpu.obs.slo import engine as slo_engine
 
                 return self._send(200, slo_engine.report())
+            if head == "stats" and rest == ["critpath"]:
+                # the critical-path attribution plane (obs/critpath):
+                # per-class and per-fingerprint segment breakdowns with
+                # dominant bottleneck, the segment catalog, and recent
+                # decompositions; ?k= bounds the fingerprint list
+                from orientdb_tpu.obs.critpath import plane as cp_plane
+
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                try:
+                    k = int(q.get("k", ["20"])[0])
+                except ValueError:
+                    k = 20
+                return self._send(200, cp_plane.report(k))
             if head == "stats" and rest in (["queries"], ["profile"]):
                 # the query-statistics plane (obs/stats, obs/profile):
                 # per-fingerprint cumulative cost, top-K by any column,

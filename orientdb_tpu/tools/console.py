@@ -16,7 +16,7 @@ Commands (case-insensitive; anything unrecognized is sent as SQL):
   CDC LIST                            CDC LAG
   ALERTS [<n>|HISTORY]                HEALTH
   SLO                                 TIMELINE [<n>]
-  MEMORY [OWNERS|WATERMARK]
+  MEMORY [OWNERS|WATERMARK]           CRITPATH [<k>]
 """
 
 from __future__ import annotations
@@ -370,6 +370,49 @@ class Console(cmd.Cmd):
                 f"{len(r['events'])} events"
             )
         self._p(f"({len(recs)} records)")
+
+    def do_critpath(self, arg: str) -> None:
+        """CRITPATH [<k>] — per-request critical-path attribution
+        (obs/critpath): per-SLO-class segment breakdowns with the
+        dominant bottleneck, then the top-k fingerprints by cumulative
+        wall (default 10) with their mean per-segment split. The full
+        document (catalog, recent decompositions) is
+        GET /stats/critpath."""
+        from orientdb_tpu.obs.critpath import plane
+
+        a = arg.strip()
+        k = int(a) if a.isdigit() else 10
+        rep = plane.report(k)
+        if not rep["requests"]:
+            state = "enabled" if rep["enabled"] else "disabled"
+            self._p(f"no decompositions recorded (critpath {state})")
+            return
+        self._p(f"{rep['requests']} sampled requests decomposed")
+        for name, c in rep["by_class"].items():
+            segs = ", ".join(
+                f"{s} {ms:.2f}" for s, ms in
+                list(c["segments_ms_mean"].items())[:5]
+            )
+            self._p(
+                f"class {name}: {c['requests']} req  mean "
+                f"{c['wall_ms_mean']:.2f} ms  dominant "
+                f"{c['dominant'] or '-'}  [{segs}]"
+            )
+        self._p(
+            f"{'fingerprint':<16} {'req':>6} {'mean ms':>9} "
+            f"{'dominant':<16} segments (mean ms)"
+        )
+        for r in rep["fingerprints"]:
+            segs = ", ".join(
+                f"{s} {ms:.2f}" for s, ms in
+                list(r["segments_ms_mean"].items())[:4]
+            )
+            self._p(
+                f"{r['fingerprint']:<16} {r['requests']:>6} "
+                f"{r['wall_ms_mean']:>9.2f} "
+                f"{(r['dominant'] or '-'):<16} {segs}"
+            )
+        self._p(f"({len(rep['fingerprints'])} shapes)")
 
     def do_memory(self, arg: str) -> None:
         """MEMORY [OWNERS|WATERMARK] — the device-memory ledger

@@ -19,11 +19,22 @@ Compared signals (the bench gate's two, plus overlap and peak HBM):
   the STABLE signal (device time never crosses the tunnel), gated at
   ``--ms-tol`` (default 0.85), sub-0.5 ms bases skipped as jitter;
 - **overlap metrics** (once both rounds carry them — the obs/timeline
-  ``overlap`` blocks in ``concurrent_sessions`` and per-shard
-  ``mesh_scaling`` records): device-idle fraction RISING or
-  transfer-hidden fraction FALLING by more than ``--overlap-tol``
-  absolute (default 0.2) is a regression — the overlap machinery
-  stopped hiding work even if wall-clock noise masks it;
+  ``overlap`` blocks in ``concurrent_sessions``, per-shard
+  ``mesh_scaling`` records, and the headline tier's
+  ``headline_overlap`` block, ROADMAP item 4's named acceptance
+  leaves): device-idle fraction RISING or transfer-hidden fraction
+  FALLING by more than ``--overlap-tol`` absolute (default 0.2) is a
+  regression — the overlap machinery stopped hiding work even if
+  wall-clock noise masks it. The tolerance is ABSOLUTE (not a ratio)
+  because the fractions live in [0, 1]: a 0.2 swing is one fifth of
+  the whole scale, far past scheduler jitter (~0.02), while ratio
+  gates on near-zero idle fractions would trip on noise;
+- **critical-path segment leaves** (once both rounds carry the
+  obs/critpath ``critpath`` extras block — per-workload per-segment ms
+  from the headline tier): gated with the phase-split discipline —
+  ``--ms-tol`` ratio (default 0.85: current must stay under
+  base/0.85), sub-``ms_floor`` (0.5 ms) bases skipped as jitter — so
+  a regression names the SEGMENT that grew, not just the workload;
 - **peak-HBM leaves** (once both rounds carry the obs/memledger
   ``memory`` evidence record): the attributed device-memory peak and
   each owner kind's peak; growth past ``--hbm-tol`` × base (default
@@ -110,11 +121,29 @@ def overlap_leaves(extras: Dict) -> Iterator[Tuple[str, float]]:
     conc = (extras.get("concurrent_sessions") or {}).get("overlap")
     if conc:
         yield from emit("concurrent_sessions", conc)
+    # the headline tier's own overlap block (ROADMAP item 4's named
+    # acceptance leaves): device-idle / transfer-hidden over the
+    # headline trio's dispatches
+    head = extras.get("headline_overlap")
+    if head:
+        yield from emit("headline", head)
     for rec in extras.get("mesh_scaling") or []:
         if isinstance(rec, dict) and isinstance(rec.get("overlap"), dict):
             yield from emit(
                 f"mesh_scaling.{rec.get('shards', '?')}", rec["overlap"]
             )
+
+
+def segment_leaves(extras: Dict) -> Iterator[Tuple[str, float]]:
+    """(metric path, ms) for the critical-path segment breakdown a
+    round carried (the obs/critpath ``critpath`` extras block:
+    ``{workload: {segment: ms_per_query}}``)."""
+    for wl, segs in sorted((extras.get("critpath") or {}).items()):
+        if not isinstance(segs, dict):
+            continue
+        for seg, v in sorted(segs.items()):
+            if isinstance(v, (int, float)):
+                yield f"critpath.{wl}.{seg}", float(v)
 
 
 def hbm_leaves(extras: Dict) -> Iterator[Tuple[str, float]]:
@@ -220,6 +249,25 @@ def diff(
             ov_reg.append(
                 {"metric": name, "base": bv, "cur": cv, "delta": delta}
             )
+    b_seg = dict(segment_leaves(b_ex))
+    c_seg = dict(segment_leaves(c_ex))
+    seg_reg: List[Dict] = []
+    seg_imp: List[Dict] = []
+    for name, bv in sorted(b_seg.items()):
+        cv = c_seg.get(name)
+        if cv is None or bv < ms_floor:
+            continue
+        compared += 1
+        row = {
+            "metric": name,
+            "base": bv,
+            "cur": cv,
+            "ratio": round(cv / bv, 3),
+        }
+        if cv > bv / ms_tol:
+            seg_reg.append(row)
+        elif cv < bv * ms_tol:
+            seg_imp.append(row)
     b_hbm = dict(hbm_leaves(b_ex))
     c_hbm = dict(hbm_leaves(c_ex))
     hbm_reg: List[Dict] = []
@@ -243,6 +291,7 @@ def diff(
         [dict(r, kind="qps") for r in qps_reg]
         + [dict(r, kind="ms") for r in ms_reg]
         + [dict(r, kind="overlap") for r in ov_reg]
+        + [dict(r, kind="segment") for r in seg_reg]
         + [dict(r, kind="hbm") for r in hbm_reg]
     )
     hb, hc = b_q["headline"], c_q["headline"]
@@ -256,6 +305,7 @@ def diff(
         "qps": {"regressions": qps_reg, "improvements": qps_imp},
         "ms": {"regressions": ms_reg, "improvements": ms_imp},
         "overlap": {"deltas": ov_deltas, "regressions": ov_reg},
+        "segments": {"regressions": seg_reg, "improvements": seg_imp},
         "hbm": {"regressions": hbm_reg, "improvements": hbm_imp},
         "regressions": regressions,
         "verdict": "regression" if regressions else "pass",
@@ -283,7 +333,7 @@ def _human(rep: Dict, base_path: str, cur_path: str) -> None:
             f"{r['base']} -> {r['cur']}",
             file=sys.stderr,
         )
-    for kind in ("qps", "ms", "hbm"):
+    for kind in ("qps", "ms", "segments", "hbm"):
         for r in rep[kind]["improvements"]:
             print(
                 f"  improvement [{kind}] {r['metric']}: "

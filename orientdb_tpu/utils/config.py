@@ -149,6 +149,20 @@ class GlobalConfiguration:
     timeline_capacity: int = 2048
     timeline_window_s: float = 120.0
 
+    # Critical-path attribution (obs/critpath; README "Critical-path
+    # attribution"): each sampled request (the stats_sample_rate
+    # decision) becomes a waterfall of named segments feeding
+    # GET /stats/critpath, the per-SloClass rollups, and the
+    # latency_regression alert's blame annotation. critpath_enabled
+    # turns the plane off entirely; critpath_capacity bounds the ring
+    # of recent decompositions (0 keeps aggregates but no ring);
+    # critpath_blame_ratio is the fractional per-segment growth
+    # (current window vs older history) a segment must show before the
+    # blame diff names it.
+    critpath_enabled: bool = True
+    critpath_capacity: int = 512
+    critpath_blame_ratio: float = 0.25
+
     # Admission control (server/http_server, server/binary_server):
     # shed WRITE requests with 503 + Retry-After when the listener's
     # in-flight depth or a database's staged-2PC backlog crosses these

@@ -36,11 +36,11 @@ class TestTreeIsClean:
         assert rep.findings == [], "\n" + "\n".join(
             str(f) for f in rep.findings
         )
-        # all nine passes actually ran
+        # all ten passes actually ran
         assert set(rep.counts) >= {
             "locklint", "configlint", "exceptlint",
             "iolint", "spanlint", "promlint", "racelint", "jaxlint",
-            "alertlint",
+            "alertlint", "critpathlint",
         }
 
 
@@ -893,6 +893,55 @@ class TestSpanlintMutation:
         assert "no call site" in fs[0].message
 
 
+class TestCritpathlintMutation:
+    """The tenth pass: every literal segment()/add_segment() stamp
+    name is in SEGMENT_CATALOG (obs/critpath), stale entries flag —
+    the spanlint contract applied to critical-path stamps."""
+
+    def test_uncataloged_stamp_flags_exactly(self):
+        from orientdb_tpu.obs.critpath import SEGMENT_CATALOG
+
+        # a module exercising every cataloged name (so no stale-entry
+        # noise) plus ONE typo'd stamp
+        lines = ["def segment(name): pass"]
+        for name in SEGMENT_CATALOG:
+            lines.append(f"segment({name!r})")
+        lines.append('segment("marshall")')  # the seeded typo
+        src = "\n".join(lines) + "\n"
+        fs = run_pass("critpathlint", {"orientdb_tpu/obs/m.py": src})
+        assert len(fs) == 1
+        assert "marshall" in fs[0].message
+        assert fs[0].line == len(lines)
+
+    def test_method_spelling_is_a_stamp_site(self):
+        """cp.add_segment(...) counts the same as the module-level
+        call — fold_query stamps the held record directly."""
+        from orientdb_tpu.obs.critpath import SEGMENT_CATALOG
+
+        lines = ["def segment(name): pass", "cp = object()"]
+        names = sorted(SEGMENT_CATALOG)
+        lines.append(f"segment({names[0]!r})")
+        for name in names[1:]:
+            lines.append(f"cp.add_segment({name!r}, 0.1)")
+        src = "\n".join(lines) + "\n"
+        fs = run_pass("critpathlint", {"orientdb_tpu/obs/m.py": src})
+        assert fs == []
+
+    def test_stale_catalog_entry_flags(self):
+        from orientdb_tpu.obs.critpath import SEGMENT_CATALOG
+
+        lines = ["def segment(name): pass"]
+        for name in sorted(SEGMENT_CATALOG)[1:]:  # drop one usage
+            lines.append(f"segment({name!r})")
+        src = "\n".join(lines) + "\n"
+        fs = run_pass("critpathlint", {"orientdb_tpu/obs/m.py": src})
+        dropped = sorted(SEGMENT_CATALOG)[0]
+        assert len(fs) == 1
+        assert dropped in fs[0].message
+        assert "stamped by no" in fs[0].message
+        assert fs[0].path == "orientdb_tpu/obs/critpath.py"
+
+
 class TestPromlintMutation:
     def test_bad_metric_name_flags(self):
         src = (
@@ -1339,6 +1388,7 @@ class TestCli:
         for name in (
             "locklint", "configlint", "exceptlint",
             "iolint", "spanlint", "promlint", "racelint", "jaxlint",
+            "critpathlint",
         ):
             assert doc["counts"][name] == 0
 

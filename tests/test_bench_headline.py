@@ -69,3 +69,19 @@ class TestHeadlineTier:
         with open(os.path.join(str(detail_dir), details[0])) as f:
             detail = json.load(f)
         assert detail["extras"]["memory"]["peak_bytes"] == mem["peak_bytes"]
+        # the critical-path decomposition rode along (ISSUE 19): the
+        # headline trio's per-segment ms splits, for perfdiff's
+        # critpath.* leaves...
+        crit = detail["extras"]["critpath"]
+        for workload in ("single_2hop", "batched_2hop"):
+            assert workload in crit, sorted(crit)
+            split = crit[workload]
+            assert split, workload
+            from orientdb_tpu.obs.critpath import SEGMENT_CATALOG
+
+            assert set(split) <= set(SEGMENT_CATALOG)
+            assert all(v >= 0.0 for v in split.values())
+        # ...plus the overlap fractions the headline.* leaves gate on
+        overlap = detail["extras"]["headline_overlap"]
+        assert overlap["records"] > 0
+        assert 0.0 <= overlap["device_idle_fraction"] <= 1.0

@@ -1,0 +1,672 @@
+"""Critical-path attribution (obs/critpath, ISSUE 19): per-request
+latency decomposition across every dispatch path (oracle, compiled
+single, vmapped group batch, coalesce lane, remote batch, tiered
+prefetch) with the segment-sum == wall invariant held per path; the
+seeded chaos blame runs (tpu.dispatch transient retry -> fault_retry,
+bin.send delay -> flush, forced lane window -> queue) each landing a
+``latency_regression`` blame annotation that names the injected
+segment and carries a joinable exemplar trace id — the fault_retry one
+end-to-end through GET /alerts; the surfaces (GET /stats/critpath,
+debug bundle, console CRITPATH); the perfdiff segment +
+headline-overlap leaves; and the <1.35x overhead guard."""
+
+import base64
+import io
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from orientdb_tpu.chaos import FaultPlan, fault
+from orientdb_tpu.exec.devicefault import domain
+from orientdb_tpu.obs import critpath as CP
+from orientdb_tpu.obs.alerts import AlertEngine, engine as alert_engine
+from orientdb_tpu.obs.critpath import SEGMENT_CATALOG, plane
+from orientdb_tpu.obs.stats import fingerprint, stats
+from orientdb_tpu.obs.trace import span
+from orientdb_tpu.storage.ingest import generate_demodb
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+from orientdb_tpu.utils.config import config
+
+SQL = (
+    "MATCH {class:Profiles, as:p, where:(uid = :u)}"
+    "-HasFriend->{as:f} RETURN count(*) AS n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    # a materialized view would serve a hot fingerprint without ever
+    # touching the device — the dispatch paths under test must be real
+    monkeypatch.setattr(config, "view_min_calls", 1 << 30)
+    fault.disarm()
+    domain.reset()
+    stats.reset()
+    plane.reset()
+    alert_engine.reset()
+    yield
+    fault.disarm()
+    domain.reset()
+    plane.reset()
+    stats.reset()
+    alert_engine.reset()
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = generate_demodb(n_profiles=300, avg_friends=4, seed=18)
+    attach_fresh_snapshot(d)
+    return d
+
+
+def _warm(db):
+    from orientdb_tpu.exec.tpu_engine import drain_warmups
+
+    for u in (0, 3):
+        db.query(SQL, params={"u": u}, engine="tpu", strict=True)
+    drain_warmups()
+
+
+def _recent(k=1):
+    recs = plane.recent(k)
+    assert len(recs) >= k, f"expected >= {k} committed decompositions"
+    return recs[0] if k == 1 else recs
+
+
+def _assert_sum_matches_wall(rec):
+    """The acceptance invariant, per path: segment sum within 5% of
+    the measured request wall (commit folds the unattributed residual
+    into host_compute, so nothing can hide between segments)."""
+    s = sum(rec["segments_ms"].values())
+    assert rec["wall_ms"] > 0.0, rec
+    assert abs(s - rec["wall_ms"]) <= 0.05 * rec["wall_ms"] + 0.01, (
+        f"segment sum {s:.3f}ms vs wall {rec['wall_ms']:.3f}ms: {rec}"
+    )
+    assert set(rec["segments_ms"]) <= set(SEGMENT_CATALOG)
+
+
+# ---------------------------------------------------------------------------
+# the decomposition, per dispatch path
+# ---------------------------------------------------------------------------
+
+
+class TestDecompositionPerPath:
+    def test_oracle_path(self, db):
+        db.query(SQL, params={"u": 0}, engine="oracle").to_dicts()
+        rec = _recent()
+        assert rec["kind"] == "engine"
+        assert rec["segments_ms"].get("host_compute", 0.0) > 0.0
+        _assert_sum_matches_wall(rec)
+
+    def test_compiled_single_path(self, db):
+        _warm(db)
+        plane.reset()
+        rs = db.query(SQL, params={"u": 1}, engine="tpu", strict=True)
+        assert rs.engine == "tpu"
+        rec = _recent()
+        # presence = a positive measured share (CPU device sync can
+        # round to 0.0 ms at 3 decimals; zero seconds is never stored)
+        assert "device_compute" in rec["segments_ms"]
+        # parameters moved: a ring hit or a fresh upload, never neither
+        assert (
+            rec["segments_ms"].get("param_upload", 0.0) > 0.0
+            or rec["segments_ms"].get("ring_hit", 0.0) > 0.0
+        ), rec
+        _assert_sum_matches_wall(rec)
+
+    def test_vmapped_group_batch_path(self, db):
+        _warm(db)
+        plist = [{"u": i} for i in range(4)]
+        db.query_batch([SQL] * 4, params_list=plist, engine="tpu")
+        plane.reset()
+        stats.reset()
+        rss = db.query_batch([SQL] * 4, params_list=plist, engine="tpu")
+        assert all(rs.engine == "tpu" for rs in rss)
+        rec = _recent()
+        assert rec["kind"] == "batch"
+        assert "device_compute" in rec["segments_ms"]
+        _assert_sum_matches_wall(rec)
+        # the per-statement stats columns took the amortized 1/n share
+        # per member; four identical shapes re-sum to ~the batch total
+        # (commit did NOT write the full split on top: stats_recorded)
+        fid = fingerprint(SQL).fid
+        cols = stats.segments_of(fid)
+        assert cols and cols.get("device_compute", 0.0) > 0.0
+        batch_dev = rec["segments_ms"]["device_compute"] / 1000.0
+        assert cols["device_compute"] <= batch_dev + 1e-6
+
+    def test_coalesce_lane_path(self, db):
+        from orientdb_tpu.server.coalesce import QueryCoalescer
+
+        _warm(db)
+        plane.reset()
+        co = QueryCoalescer(window_ms=20)  # force a collection window
+        results, recs = {}, {}
+
+        def worker(i):
+            with span("query", sql=SQL):
+                cp = CP.begin_request("binary", SQL)
+                with CP.active(cp):
+                    results[i] = co.submit(db, SQL, {"u": i})
+                CP.commit(cp)
+                recs[i] = cp
+
+        barrier = threading.Barrier(3)
+
+        def sync_worker(i):
+            barrier.wait()
+            worker(i)
+
+        ts = [
+            threading.Thread(target=sync_worker, args=(i,))
+            for i in range(3)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        co.stop()
+        assert len(results) == 3
+        for i in range(3):
+            rec = recs[i].to_dict()
+            assert rec["segments_ms"].get("queue", 0.0) > 0.0, rec
+            _assert_sum_matches_wall(rec)
+
+    def test_remote_batch_path(self, db):
+        from orientdb_tpu.client.remote import connect
+        from orientdb_tpu.server import Server
+
+        srv = Server(admin_password="pw")
+        sdb = srv.create_database("demo")
+        prof = sdb.schema.create_vertex_class("Profiles")
+        sdb.schema.create_edge_class("HasFriend")
+        people = [
+            sdb.new_vertex("Profiles", name=f"p{i}", uid=i)
+            for i in range(20)
+        ]
+        for i in range(19):
+            sdb.new_edge("HasFriend", people[i], people[i + 1])
+        attach_fresh_snapshot(sdb)
+        srv.startup()
+        try:
+            plane.reset()
+            url = f"remote:127.0.0.1:{srv.binary_port}/demo"
+            with connect(url, "admin", "pw") as rdb:
+                res = rdb.query_batch(
+                    ["SELECT name FROM Profiles WHERE uid = :k"] * 3,
+                    [{"k": 1}, {"k": 5}, {"k": 7}],
+                )
+            assert [r.to_dicts()[0]["name"] for r in res] == [
+                "p1", "p5", "p7",
+            ]
+            recs = [
+                r for r in plane.recent(20) if r["kind"] == "binary"
+                and r["sql"] and "Profiles" in r["sql"]
+            ]
+            assert recs, plane.recent(20)
+            rec = recs[0]
+            # the wire listener's stamps are present alongside the
+            # engine window's fold
+            assert rec["segments_ms"].get("parse", 0.0) > 0.0
+            assert rec["segments_ms"].get("marshal", 0.0) > 0.0
+            assert rec["segments_ms"].get("flush", 0.0) > 0.0
+            _assert_sum_matches_wall(rec)
+        finally:
+            srv.shutdown()
+
+    def test_tiered_prefetch_path(self, monkeypatch):
+        from orientdb_tpu.storage import tiering
+
+        monkeypatch.setattr(config, "tier_block_edges", 32)
+        tdb = generate_demodb(n_profiles=200, avg_friends=6, seed=3)
+        snap = attach_fresh_snapshot(tdb)
+        adj = tiering.adjacency_bytes(snap)
+        tdb.detach_snapshot()
+        monkeypatch.setattr(
+            config, "tier_hbm_cap_bytes", max(1, adj // 2)
+        )
+        snap = attach_fresh_snapshot(tdb)
+        assert getattr(snap, "_tier", None) is not None
+        try:
+            _warm(tdb)
+            plane.reset()
+            rs = tdb.query(
+                SQL, params={"u": 7}, engine="tpu", strict=True
+            )
+            assert rs.engine == "tpu"
+            rec = _recent()
+            assert "device_compute" in rec["segments_ms"]
+            _assert_sum_matches_wall(rec)
+        finally:
+            tdb.detach_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# blame: seeded chaos per segment -> latency_regression annotation
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_regression_alert(fid, monkeypatch):
+    """Drive a latency_regression breach for ``fid`` with synthetic
+    per-tick stats snaps (the breach mechanics are test_alerts.py's
+    subject); the blame annotation is read live from the REAL critpath
+    plane — exactly the wiring under test here."""
+    monkeypatch.setattr(config, "alert_pending_ticks", 1)
+    monkeypatch.setattr(config, "alert_latency_min_calls", 5)
+
+    def snap(qs):
+        return {
+            "counters": {}, "gauges": {}, "durations": {},
+            "histograms": {}, "query_stats": qs, "alerts": {},
+        }
+
+    eng = AlertEngine()
+    calls, total = 0, 0.0
+    for _ in range(4):
+        calls += 10
+        total += 10 * 0.010
+        eng.evaluate(snap=snap({fid: {
+            "calls": calls, "total_s": round(total, 6), "errors": 0,
+        }}))
+    calls += 10
+    total += 10 * 0.200
+    eng.evaluate(snap=snap({fid: {
+        "calls": calls, "total_s": round(total, 6), "errors": 0,
+    }}))
+    alerts = [
+        a for a in eng.active() if a["rule"] == "latency_regression"
+    ]
+    assert len(alerts) == 1, alerts
+    return alerts[0]
+
+
+def _exemplar_record(trace_id):
+    """The committed decomposition the exemplar trace id joins to."""
+    assert trace_id, "blame exemplar must carry a trace id"
+    recs = [r for r in plane.recent(200) if r["trace_id"] == trace_id]
+    assert recs, f"exemplar {trace_id} not joinable to any record"
+    return recs[0]
+
+
+class TestChaosBlame:
+    def test_dispatch_transient_retry_blames_fault_retry_end_to_end(
+        self, db, monkeypatch
+    ):
+        """The acceptance scenario: a seeded FaultPlan injecting
+        tpu.dispatch transients slows ONLY the retry ladder; the
+        latency_regression alert walks pending -> firing through real
+        stats ticks, and its blame annotation — visible through
+        GET /alerts — names fault_retry with the worst chaos request's
+        trace id as exemplar."""
+        from orientdb_tpu.obs.watchdog import HealthWatchdog
+        from orientdb_tpu.server import Server
+
+        srv = Server(admin_password="pw")
+        srv.databases["demo"] = db  # serve the module corpus
+        srv.startup()
+        monkeypatch.setattr(config, "alert_pending_ticks", 2)
+        monkeypatch.setattr(config, "alert_latency_min_calls", 5)
+        monkeypatch.setattr(config, "alert_latency_mads", 3.0)
+        wd = HealthWatchdog(srv)  # manual ticks, no thread
+        try:
+            _warm(db)
+
+            def run_one(u):
+                db.query(
+                    SQL, params={"u": u % 50}, engine="tpu",
+                    strict=True,
+                ).to_dicts()
+
+            for i in range(8):  # settle variant routing before ticks
+                run_one(i)
+            stats.reset()
+            wd.tick()  # tick 0 arms the per-fid call deltas
+            for t in range(4):  # baseline: fast ticks learn the EWMA
+                for i in range(8):
+                    run_one(t * 8 + i)
+                wd.tick()
+            fid = fingerprint(SQL).fid
+            assert not [
+                a for a in alert_engine.active()
+                if a["rule"] == "latency_regression" and a["key"] == fid
+            ]
+            # chaos: two transient dispatch faults per query — every
+            # request pays the retry ladder (failed attempts + backoff)
+            states = []
+            for tick in range(2):
+                for i in range(8):
+                    p = FaultPlan(seed=100 + tick * 8 + i).at(
+                        "tpu.dispatch", "error", times=2
+                    )
+                    with fault.armed(p):
+                        run_one(tick * 8 + i)
+                    assert p.fired() >= 2
+                wd.tick()
+                a = next(
+                    x for x in alert_engine.active()
+                    if x["rule"] == "latency_regression"
+                    and x["key"] == fid
+                )
+                states.append(a["state"])
+            assert states == ["pending", "firing"], states
+
+            # end-to-end: the GET /alerts payload carries the blame
+            doc = _get(
+                f"http://127.0.0.1:{srv.http_port}/alerts"
+            )
+            a = next(
+                x for x in doc["alerts"]
+                if x["rule"] == "latency_regression" and x["key"] == fid
+            )
+            assert a["state"] == "firing"
+            blame = a.get("blame")
+            assert blame, a
+            assert blame["top"] == "fault_retry", blame
+            assert "fault_retry" in a["detail"], a["detail"]
+            assert a["exemplar_trace_id"] == blame["trace_id"]
+            rec = _exemplar_record(a["exemplar_trace_id"])
+            assert rec["segments_ms"].get("fault_retry", 0.0) > 0.0
+        finally:
+            wd.stop()
+            srv.databases.pop("demo", None)  # keep the module corpus
+            srv.shutdown()
+
+    def test_bin_send_delay_blames_flush(self, monkeypatch):
+        """A seeded delay at the bin.send crossing inflates ONLY the
+        response write: blame names flush (the marshal/flush tail), and
+        the alert annotation joins a chaos request's record."""
+        from orientdb_tpu.client.remote import connect
+        from orientdb_tpu.server import Server
+
+        srv = Server(admin_password="pw")
+        sdb = srv.create_database("demo")
+        sdb.schema.create_vertex_class("P")
+        for i in range(10):
+            sdb.new_vertex("P", uid=i)
+        srv.startup()
+        sql = "SELECT count(*) AS c FROM P WHERE uid < 5"
+        try:
+            url = f"remote:127.0.0.1:{srv.binary_port}/demo"
+            with connect(url, "admin", "pw") as rdb:
+                for _ in range(12):
+                    rdb.query(sql).to_dicts()
+                plan = FaultPlan(seed=9).at(
+                    "bin.send", "delay", times=None, delay_s=0.05
+                )
+                with fault.armed(plan):
+                    for _ in range(4):
+                        rdb.query(sql).to_dicts()
+                assert plan.fired("bin.send") >= 4
+            fid = fingerprint(sql).fid
+            blame = plane.blame(fid)
+            assert blame is not None
+            assert blame["top"] == "flush", blame
+            rec = _exemplar_record(blame["trace_id"])
+            assert rec["segments_ms"].get("flush", 0.0) >= 40.0, rec
+            a = _synthetic_regression_alert(fid, monkeypatch)
+            assert a["blame"]["top"] == "flush"
+            assert a["exemplar_trace_id"] == blame["trace_id"]
+        finally:
+            srv.shutdown()
+
+    def test_forced_lane_window_blames_queue(self, db, monkeypatch):
+        """Growing the coalescer's collection window parks requests in
+        the lane: blame names queue, with a windowed request's trace id
+        as exemplar."""
+        from orientdb_tpu.server.coalesce import QueryCoalescer
+
+        _warm(db)
+
+        def run_via(co, u):
+            with span("query", sql=SQL):
+                cp = CP.begin_request("binary", SQL)
+                with CP.active(cp):
+                    co.submit(db, SQL, {"u": u})
+                CP.commit(cp)
+
+        fast = QueryCoalescer(window_ms=1)
+        try:
+            for i in range(12):
+                run_via(fast, i)
+        finally:
+            fast.stop()
+        slow = QueryCoalescer(window_ms=60)  # the forced window
+        try:
+            for i in range(4):
+                run_via(slow, i)
+        finally:
+            slow.stop()
+        fid = fingerprint(SQL).fid
+        blame = plane.blame(fid)
+        assert blame is not None
+        assert blame["top"] == "queue", blame
+        rec = _exemplar_record(blame["trace_id"])
+        assert rec["segments_ms"].get("queue", 0.0) >= 40.0, rec
+        a = _synthetic_regression_alert(fid, monkeypatch)
+        assert a["blame"]["top"] == "queue"
+        assert a["exemplar_trace_id"] == blame["trace_id"]
+
+    def test_thin_history_yields_no_blame(self, db):
+        db.query(SQL, params={"u": 0}, engine="oracle").to_dicts()
+        assert plane.blame(fingerprint(SQL).fid) is None
+
+
+# ---------------------------------------------------------------------------
+# surfaces: GET /stats/critpath, debug bundle, console, SLO classes
+# ---------------------------------------------------------------------------
+
+
+def _get(url, user="admin", password="pw"):
+    cred = base64.b64encode(f"{user}:{password}".encode()).decode()
+    req = urllib.request.Request(
+        url, headers={"Authorization": f"Basic {cred}"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestSurfaces:
+    def test_http_stats_critpath_endpoint(self, db):
+        from orientdb_tpu.server import Server
+
+        srv = Server(admin_password="pw")
+        srv.databases["demo"] = db
+        srv.startup()
+        try:
+            db.query(SQL, params={"u": 0}, engine="oracle").to_dicts()
+            db.query(SQL, params={"u": 1}, engine="oracle").to_dicts()
+            url = f"http://127.0.0.1:{srv.http_port}/stats/critpath"
+            doc = _get(url)
+            assert doc["requests"] >= 2
+            assert doc["segment_catalog"] == SEGMENT_CATALOG
+            assert doc["fingerprints"]
+            fp = doc["fingerprints"][0]
+            assert fp["dominant"] in SEGMENT_CATALOG
+            assert doc["by_class"]["unclassified"]["requests"] >= 2
+            assert len(_get(url + "?k=0")["fingerprints"]) == 0
+        finally:
+            srv.databases.pop("demo", None)
+            srv.shutdown()
+
+    def test_debug_bundle_has_critpath_section(self, db):
+        from orientdb_tpu.obs.bundle import debug_bundle
+
+        db.query(SQL, params={"u": 0}, engine="oracle").to_dicts()
+        b = debug_bundle(dbs=[db])
+        assert b["critpath"]["requests"] >= 1
+        assert b["critpath"]["fingerprints"]
+
+    def test_console_critpath_verb(self, db):
+        from orientdb_tpu.tools.console import Console
+
+        buf = io.StringIO()
+        Console(stdout=buf).onecmd("CRITPATH")
+        assert "no decompositions recorded" in buf.getvalue()
+        db.query(SQL, params={"u": 0}, engine="oracle").to_dicts()
+        buf = io.StringIO()
+        Console(stdout=buf).onecmd("CRITPATH 5")
+        out = buf.getvalue()
+        assert "sampled requests decomposed" in out
+        assert "host_compute" in out
+        assert fingerprint(SQL).fid in out
+
+    def test_slo_class_rollup(self, db):
+        class _Cls:
+            name = "reads"
+
+            def fids(self):
+                return [fingerprint(SQL).fid]
+
+        CP.register_slo_classes([_Cls()])
+        db.query(SQL, params={"u": 0}, engine="oracle").to_dicts()
+        rep = plane.report(5)
+        assert rep["by_class"]["reads"]["requests"] == 1
+        assert rep["by_class"]["reads"]["dominant"] == "host_compute"
+
+    def test_disabled_plane_records_nothing(self, db, monkeypatch):
+        monkeypatch.setattr(config, "critpath_enabled", False)
+        db.query(SQL, params={"u": 0}, engine="oracle").to_dicts()
+        assert plane.report(5)["requests"] == 0
+        assert plane.report(5)["enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# perfdiff: segment leaves + the headline overlap leaves
+# ---------------------------------------------------------------------------
+
+
+class TestPerfdiffLeaves:
+    BASE = {
+        "value": 100.0,
+        "extras": {
+            "critpath": {
+                "single_2hop": {
+                    "device_compute": 2.0,
+                    "result_transfer": 1.0,
+                    "host_compute": 4.0,
+                    "ring_hit": 0.1,  # sub-floor: never gated
+                },
+            },
+            "headline_overlap": {
+                "records": 40,
+                "device_idle_fraction": 0.3,
+                "transfer_hidden_fraction": 0.8,
+            },
+        },
+    }
+
+    def _cur(self):
+        return json.loads(json.dumps(self.BASE))
+
+    def test_identical_rounds_pass(self):
+        from orientdb_tpu.tools.perfdiff import diff
+
+        rep = diff(self.BASE, self._cur())
+        assert rep["verdict"] == "pass"
+        assert rep["segments"] == {
+            "regressions": [], "improvements": [],
+        }
+        assert (
+            "headline.device_idle_fraction" in rep["overlap"]["deltas"]
+        )
+
+    def test_segment_growth_names_the_segment(self):
+        from orientdb_tpu.tools.perfdiff import diff
+
+        cur = self._cur()
+        cur["extras"]["critpath"]["single_2hop"]["device_compute"] = 5.0
+        rep = diff(self.BASE, cur)
+        assert rep["verdict"] == "regression"
+        regs = [
+            r for r in rep["regressions"] if r["kind"] == "segment"
+        ]
+        assert [r["metric"] for r in regs] == [
+            "critpath.single_2hop.device_compute"
+        ]
+
+    def test_segment_improvement_and_subfloor_skip(self):
+        from orientdb_tpu.tools.perfdiff import diff
+
+        cur = self._cur()
+        cur["extras"]["critpath"]["single_2hop"]["host_compute"] = 1.0
+        cur["extras"]["critpath"]["single_2hop"]["ring_hit"] = 3.0
+        rep = diff(self.BASE, cur)
+        assert rep["verdict"] == "pass"  # sub-floor base never gates
+        imps = {
+            i["metric"] for i in rep["segments"]["improvements"]
+        }
+        assert "critpath.single_2hop.host_compute" in imps
+
+    def test_ungated_headline_overlap_regression_exits_2(self, tmp_path):
+        from orientdb_tpu.tools.perfdiff import diff, main
+
+        cur = self._cur()
+        cur["extras"]["headline_overlap"]["device_idle_fraction"] = 0.9
+        rep = diff(self.BASE, cur)
+        assert rep["verdict"] == "regression"
+        names = {
+            r["metric"] for r in rep["regressions"]
+            if r["kind"] == "overlap"
+        }
+        assert "headline.device_idle_fraction" in names
+        b, c = tmp_path / "b.json", tmp_path / "c.json"
+        b.write_text(json.dumps(self.BASE))
+        c.write_text(json.dumps(cur))
+        assert main([str(b), str(c), "--json"]) == 2
+        assert main([str(b), str(b), "--json"]) == 0
+
+    def test_zero_record_overlap_block_is_ignored(self):
+        from orientdb_tpu.tools.perfdiff import diff
+
+        cur = self._cur()
+        cur["extras"]["headline_overlap"] = {
+            "records": 0, "device_idle_fraction": 0.99,
+            "transfer_hidden_fraction": 0.0,
+        }
+        assert diff(self.BASE, cur)["verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# overhead guard (the PR-4 stats-plane pattern, same 1.35x bar)
+# ---------------------------------------------------------------------------
+
+
+class TestOverheadGuard:
+    def test_full_sampling_overhead_is_bounded(self, monkeypatch):
+        """With the plane on (full sampling) a 1k-query loop through
+        the engine front door stays close to a critpath-disabled run:
+        begin/commit is one small object + one short lock, stamps are
+        one thread-local read. Best-of-3 interleaved reps; asserts the
+        mechanism, not the microbenchmark."""
+        from orientdb_tpu.models.database import Database
+        from orientdb_tpu.models.schema import PropertyType
+
+        db = Database("cp_overhead")
+        P = db.schema.create_vertex_class("P")
+        P.create_property("age", PropertyType.LONG)
+        for i in range(10):
+            db.new_vertex("P", uid=i, age=20 + i)
+        q = "SELECT count(*) AS n FROM P WHERE age > 25"
+        n = 1000
+        monkeypatch.setattr(config, "stats_sample_rate", 1.0)
+
+        def loop():
+            t0 = time.perf_counter()
+            for _ in range(n):
+                db.query(q).to_dicts()
+            return time.perf_counter() - t0
+
+        loop()  # warm parse/plan caches
+        on, off = [], []
+        for _ in range(3):
+            monkeypatch.setattr(config, "critpath_enabled", True)
+            on.append(loop())
+            monkeypatch.setattr(config, "critpath_enabled", False)
+            off.append(loop())
+        ratio = min(on) / min(off)
+        assert ratio < 1.35, (
+            f"critpath overhead {ratio:.2f}x (on={min(on):.3f}s "
+            f"off={min(off):.3f}s for {n} queries)"
+        )

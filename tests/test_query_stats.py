@@ -476,6 +476,9 @@ class TestOverheadGuard:
                 db.query(q).to_dicts()
             return time.perf_counter() - t0
 
+        # critpath rides the same sampled() gate but has its own guard
+        # (tests/test_critpath.py) — keep this one measuring stats only
+        monkeypatch.setattr(config, "critpath_enabled", False)
         monkeypatch.setattr(config, "stats_sample_rate", 1.0)
         loop()  # warm parse/plan caches
         on, off = [], []
