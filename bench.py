@@ -85,7 +85,12 @@ import time
 
 
 def canon(rows):
-    return sorted(tuple(sorted(r.items())) for r in rows)
+    # ONE canon: the bench parity gates and the production shadow-oracle
+    # auditor (exec/audit) share the same canonicalization so the two
+    # parity definitions cannot drift
+    from orientdb_tpu.exec.result import canonical_rows
+
+    return canonical_rows(rows)
 
 
 #: the driver records only the last ~2000 chars of stdout; leave room
@@ -1579,6 +1584,21 @@ def _measure() -> None:
             ev("device_faults", **_df)
         except Exception as e:
             ev("device_faults", error=f"{type(e).__name__}: {e}")
+
+    # continuous-correctness evidence per round (ISSUE 20): shadow-
+    # oracle audit volume + divergences and scrub corruption/repair
+    # counts (exec/audit, storage/scrub). perfdiff.degraded_round also
+    # reads this block: a round that diverged or repaired corruption
+    # measured the ladder, not the fast path — never a baseline.
+    if budget_ok("parity_audit", est_s=3):
+        try:
+            from orientdb_tpu.exec.audit import bench_parity_audit_summary
+
+            _pa = bench_parity_audit_summary()
+            extras["parity_audit"] = _pa
+            ev("parity_audit", **_pa)
+        except Exception as e:
+            ev("parity_audit", error=f"{type(e).__name__}: {e}")
 
     # mixed production-shaped traffic under chaos, judged by the SLO
     # plane (ISSUE 11): the closed-loop simulator runs its OWN small

@@ -81,6 +81,15 @@ POINTS = frozenset(
         "tpu.oom",  # device memory exhaustion: crossed before every
         # dispatch AND transfer, classifies oom and actuates the
         # fault domain's memledger-guided relief
+        "audit.mismatch",  # wrong compiled result: an `error` rule here
+        # (exec/audit.corrupt_point, crossed after every compiled
+        # execute) corrupts the SERVED rows so the shadow-oracle
+        # parity auditor provably detects + quarantines them
+        "scrub.flip",  # device-block bit flip: an `error` rule here
+        # corrupts the device-bound copy of a delta-patch segment
+        # (ops/device_graph.apply_patches) or a tier-pool block row
+        # (storage/tiering._load_blocks) — host truth keeps the
+        # original, so the scrub sweep provably detects + repairs
     }
 )
 

@@ -62,6 +62,9 @@ log = get_logger("devicefault")
 OOM = "oom"
 TRANSIENT = "transient"
 PERSISTENT = "persistent"
+#: parity conviction (exec/audit): the plan ran fine but served rows
+#: the shadow oracle disagrees with — wrong answers, not crashes
+PARITY = "parity"
 
 
 class DeviceFaultError(OSError):
@@ -405,6 +408,21 @@ class DeviceFaultDomain:
             f"retries ({cause}); serving oracle",
             retry_after=retry_after,
         ) from cause
+
+    def quarantine_parity(self, sql: str, reason: str) -> float:
+        """Parity-divergence conviction (exec/audit): the compiled plan
+        executed cleanly but served rows the shadow oracle disagrees
+        with. Quarantine its fingerprint so the engine front doors
+        serve degraded-but-correct oracle traffic; the existing probe
+        machinery re-admits after a clean (re-audited) trial. Returns
+        the TTL, like :meth:`_quarantine`."""
+        return self._quarantine(sql, PARITY, reason)
+
+    def parity_quarantined(self) -> int:
+        """Active quarantine entries convicted by the parity auditor
+        (the ``parity_divergence`` alert rule's active-state signal)."""
+        with self._mu:
+            return sum(1 for e in self._q.values() if e.kind == PARITY)
 
     # -- quarantine ----------------------------------------------------------
 

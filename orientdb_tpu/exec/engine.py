@@ -110,6 +110,11 @@ def _run(
             if db.tx is not None:
                 raise tpu_engine.Uncompilable("active transaction on this thread")
             rows = tpu_engine.execute(db, stmt, params, sql=sql)
+            from orientdb_tpu.exec import audit as _audit
+
+            # audit.mismatch chaos crossing: corrupts SERVED rows only,
+            # so the shadow-oracle auditor provably detects them
+            rows = _audit.corrupt_point(rows)
             metrics.incr("query.tpu")
             return rows, "tpu"
         except tpu_engine.Uncompilable as e:
@@ -218,6 +223,14 @@ def execute_query(
             raise
         _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
         CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
+        from orientdb_tpu.exec import audit as _audit
+
+        # shadow-oracle parity audit: rides the stats sampling decision
+        # (acc) so stats/slowlog/timeline/audit cover the same subset
+        _audit.auditor.maybe_submit(
+            db, sql, _normalize_params(params), rs, sp.trace_id,
+            acc is not None,
+        )
     return rs
 
 
@@ -307,6 +320,12 @@ def execute_command(
             raise
         _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
         CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
+        from orientdb_tpu.exec import audit as _audit
+
+        _audit.auditor.maybe_submit(
+            db, sql, _normalize_params(params), rs, sp.trace_id,
+            acc is not None,
+        )
     return rs
 
 
@@ -367,7 +386,7 @@ def execute_query_batch(
     )
     with CP.request("batch", sqls[0] if sqls else None) as cp:
         seg0 = cp.total() if cp is not None else 0.0
-        with span("query_batch", n=len(sqls)):
+        with span("query_batch", n=len(sqls)) as bsp:
             # the capture collects the batch's device/transfer/compile
             # attribution (no per-query accumulator runs on a batch)
             with S.capture() as cap, TL.active(rec):
@@ -383,7 +402,10 @@ def execute_query_batch(
         n = max(len(sqls), 1)
         per = dur / n
         per_segs = _amortized_segs(cp, dur, cap, seg0, n)
-        for sql, rs in zip(sqls, out):
+        from orientdb_tpu.exec import audit as _audit
+
+        plist = params_list if params_list is not None else [None] * n
+        for sql, p, rs in zip(sqls, plist, out):
             rows = getattr(rs, "_rows", None)
             S.stats.record_external(
                 sql,
@@ -393,6 +415,11 @@ def execute_query_batch(
             )
             if per_segs:
                 S.stats.record_segments(sql, per_segs)
+            # batch paths carry no per-query accumulator: the batch
+            # capture is always on, so every member is audit-eligible
+            _audit.auditor.maybe_submit(
+                db, sql, _normalize_params(p), rs, bsp.trace_id, True
+            )
     return out
 
 
@@ -559,7 +586,10 @@ def dispatch_lane_batch(
         )
     if h is None:
         return None
-    return _LaneHandle(sqls, h, harvest.segs if harvest else None)
+    return _LaneHandle(
+        sqls, h, harvest.segs if harvest else None,
+        db=db, params_list=params_list,
+    )
 
 
 class _LaneHandle:
@@ -567,11 +597,17 @@ class _LaneHandle:
     blocks on the fetch, wraps rows in ResultSets, and attributes the
     batch's amortized cost to each member fingerprint."""
 
-    __slots__ = ("sqls", "_h", "_stage_segs", "item_segs")
+    __slots__ = (
+        "sqls", "_h", "_stage_segs", "item_segs", "_db", "_params_list",
+    )
 
-    def __init__(self, sqls, h, stage_segs=None) -> None:
+    def __init__(
+        self, sqls, h, stage_segs=None, db=None, params_list=None
+    ) -> None:
         self.sqls = sqls
         self._h = h
+        self._db = db
+        self._params_list = params_list
         #: worker-side staging stamps (param_upload / ring_hit seconds
         #: for the whole batch) harvested by dispatch_lane_batch
         self._stage_segs = stage_segs
@@ -617,6 +653,18 @@ class _LaneHandle:
             self.item_segs.append(
                 {k2: v for k2, v in segs.items() if v > 0.0}
             )
+            if self._db is not None:
+                from orientdb_tpu.exec import audit as _audit
+
+                p = (
+                    self._params_list[k]
+                    if self._params_list is not None
+                    and k < len(self._params_list)
+                    else None
+                )
+                _audit.auditor.maybe_submit(
+                    self._db, sql, _normalize_params(p), rs, None, True
+                )
             results.append(rs)
         return results
 

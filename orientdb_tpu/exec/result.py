@@ -13,10 +13,59 @@ compare `[sorted] list(rs.to_dicts())` across engines.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Iterator, List, Optional
+import hashlib
+from collections import Counter
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 from orientdb_tpu.models.record import Document
 from orientdb_tpu.models.rid import RID
+
+
+# ---------------------------------------------------------------------------
+# result canonicalization (THE parity definition)
+# ---------------------------------------------------------------------------
+# bench.py's parity gates and the shadow-oracle auditor (exec/audit) must
+# agree on what "the same result set" means; both import these helpers so
+# the two parity planes cannot drift apart.
+
+
+def canonical_rows(rows: Iterable[Dict[str, object]]) -> List[Tuple]:
+    """Order-insensitive canonical form of a list of plain-dict rows
+    (the ``to_dicts()`` shape): each row becomes a sorted item tuple,
+    the rows sort as a multiset. Mixed-type rows that defeat tuple
+    ordering fall back to a repr sort key — multiset equality is
+    preserved either way (same deterministic key on both sides)."""
+    items = [tuple(sorted(r.items())) for r in rows]
+    try:
+        return sorted(items)
+    except TypeError:
+        return sorted(items, key=repr)
+
+
+def result_digest(rows: Iterable[Dict[str, object]]) -> str:
+    """Stable 64-bit hex digest of :func:`canonical_rows` — what the
+    auditor compares (and divergence records carry) instead of keeping
+    both row sets alive."""
+    h = hashlib.blake2b(digest_size=8)
+    for row in canonical_rows(rows):
+        h.update(repr(row).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def rows_diff_sample(
+    served: Iterable[Dict[str, object]],
+    oracle: Iterable[Dict[str, object]],
+    limit: int = 5,
+) -> Dict[str, List[str]]:
+    """Row-level divergence sample for a replayable divergence record:
+    up to ``limit`` canonical rows present only on each side."""
+    ca = Counter(repr(t) for t in canonical_rows(served))
+    cb = Counter(repr(t) for t in canonical_rows(oracle))
+    return {
+        "only_served": list((ca - cb).elements())[:limit],
+        "only_oracle": list((cb - ca).elements())[:limit],
+    }
 
 
 class Result:

@@ -93,6 +93,15 @@ RULE_CATALOG: Dict[str, str] = {
     "oom + transient + persistent across every dispatch path) per "
     "minute exceed alert_device_faults_per_min — the device is failing "
     "faster than the escalation ladder can contain",
+    "parity_divergence": "the shadow-oracle parity auditor "
+    "(exec/audit) convicted a fingerprint: a compiled result's "
+    "canonical digest disagrees with the oracle's — the fingerprint "
+    "is quarantined (oracle serves degraded-but-correct) until a "
+    "clean probe; the exemplar is the divergent request's trace id",
+    "scrub_corruption": "the device-state scrubber (storage/scrub) "
+    "found device bytes that disagree with their host-truth checksum "
+    "since the last clean sweep — the repair ladder (tier reload → "
+    "overlay poison/compaction → full re-upload) was engaged",
 }
 
 #: two-window burn-rate windows (seconds): the short window catches the
@@ -726,6 +735,43 @@ class AlertEngine:
                 "(exec/devicefault escalation ladder engaged)",
             )
 
+    def _check_parity_divergence(self, ctx: AlertContext) -> Iterable[Breach]:
+        """Active while any fingerprint sits in quarantine on a parity
+        conviction (exec/audit → devicefault.quarantine_parity): the
+        breach persists across ticks so the pending dwell can elapse,
+        and resolves when a clean probe re-admits the plan."""
+        from orientdb_tpu.exec.audit import auditor
+        from orientdb_tpu.exec.devicefault import domain as _fault_domain
+
+        n = _fault_domain.parity_quarantined()
+        if n <= 0:
+            return
+        yield Breach(
+            "parity", float(n), 0.0,
+            f"{n} fingerprint(s) quarantined on parity divergence "
+            f"({auditor.snapshot()['diverged']} divergence record(s)); "
+            "oracle serving degraded-but-correct traffic",
+            trace_id=auditor.last_divergence_trace(),
+        )
+
+    def _check_scrub_corruption(self, ctx: AlertContext) -> Iterable[Breach]:
+        """Active while the last completed scrub sweep (or any sweep
+        since the last clean one) found corrupt device bytes; a later
+        fully clean sweep resolves it — deterministic, no wall-clock
+        window."""
+        from orientdb_tpu.storage.scrub import scrubber
+
+        st = scrubber.alert_state()
+        if st is None:
+            return
+        yield Breach(
+            "scrub", float(st["corruptions"]), 0.0,
+            f"device-state scrub found {st['corruptions']} corrupt "
+            f"key(s) since the last clean sweep (latest: "
+            f"{st['last_key']}); repair ladder engaged "
+            f"({st['last_repair'] or 'repair pending'})",
+        )
+
     def _check_latency_regression(
         self, ctx: AlertContext
     ) -> Iterable[Breach]:
@@ -952,6 +998,16 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
         "device_fault_storm", "warning",
         AlertEngine._check_device_fault_storm,
         exemplar_spans=("devicefault.", "tpu."),
+    ),
+    _rule(
+        "parity_divergence", "critical",
+        AlertEngine._check_parity_divergence,
+        exemplar_spans=("audit.", "query"),
+    ),
+    _rule(
+        "scrub_corruption", "critical",
+        AlertEngine._check_scrub_corruption,
+        exemplar_spans=("scrub.", "tier."),
     ),
 )
 

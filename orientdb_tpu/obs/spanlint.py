@@ -92,6 +92,15 @@ SPAN_CATALOG: Dict[str, str] = {
     "devicefault.escalate": "device fault escalation (exec/devicefault: "
     "retries exhausted or persistent fault — quarantine + optional "
     "admission shed; attrs carry stage, kind, relief actions)",
+    "audit.shadow": "one shadow-oracle parity audit (exec/audit: "
+    "oracle re-execution + digest compare on the background worker; "
+    "attrs carry the verdict — parity / diverged / stale)",
+    "scrub.sweep": "one budgeted device-state scrub rotation "
+    "(storage/scrub: device blocks fetched + re-hashed against "
+    "host-truth checksums under scrub_budget_bytes)",
+    "scrub.repair": "one scrub repair-ladder walk for a corrupt device "
+    "key (storage/scrub: tier-block reload → overlay poison/compact → "
+    "full snapshot re-upload; attrs carry the rung taken)",
 }
 
 #: dynamically named span families (f-string call sites the literal

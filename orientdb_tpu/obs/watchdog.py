@@ -82,6 +82,14 @@ class HealthWatchdog:
         srv = self.server
         dbs = list(getattr(srv, "databases", {}).values())
         cluster = getattr(srv, "cluster", None)
+        if config.scrub_enabled and dbs:
+            # one budgeted device-state scrub rotation per tick — the
+            # continuous-correctness sweep rides the same cadence as
+            # rule evaluation (storage/scrub; never raises into the
+            # tick, repairs loudly via the scrub_corruption rule)
+            from orientdb_tpu.storage.scrub import scrubber
+
+            scrubber.sweep_all(dbs)
         with span("watchdog.tick") as sp:
             out = engine.evaluate(dbs=dbs, cluster=cluster)
             sp.set("fired", out["fired"])

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Set
 import jax.numpy as jnp
 import numpy as np
 
+from orientdb_tpu.chaos.faults import FaultError, fault
 from orientdb_tpu.storage.snapshot import GraphSnapshot, PropertyColumn
 
 
@@ -399,6 +400,16 @@ class DeviceGraph:
                     continue  # lazy column not yet resident
                 ia = np.asarray(idx, np.int32)
                 va = np.asarray(vals).astype(cur.dtype)
+                try:
+                    # scrub.flip chaos crossing: corrupt the DEVICE-
+                    # bound copy only — the maintainer already patched
+                    # host truth, so the scrub sweep provably detects
+                    with fault.point("scrub.flip"):
+                        pass
+                except FaultError:
+                    from orientdb_tpu.storage.scrub import chaos_flip
+
+                    va = chaos_flip(va)
                 # bucket the segment to a pow2 length by REPEATING the
                 # last (index, value) pair — a duplicate scatter of the
                 # same value is idempotent, and the bucketed shape keeps
@@ -424,7 +435,16 @@ class DeviceGraph:
                 memledger.register_graph_array(
                     self, key, self._arrays[key]
                 )
+                self._scrub_mark(key)
         return nbytes
+
+    def _scrub_mark(self, key: str) -> None:
+        """Host truth changed under ``key``: the scrubber re-hashes its
+        cached checksum on the next sweep (storage/scrub)."""
+        d = getattr(self, "_scrub_dirty", None)
+        if d is None:
+            d = self._scrub_dirty = set()
+        d.add(key)
 
     def _put(
         self,
@@ -457,6 +477,7 @@ class DeviceGraph:
             from orientdb_tpu.obs.memledger import memledger
 
             memledger.register_graph_array(self, key, self._arrays[key])
+            self._scrub_mark(key)
             return key
         if self._replicated_spec is not None:
             import jax
@@ -466,6 +487,7 @@ class DeviceGraph:
         from orientdb_tpu.obs.memledger import memledger
 
         memledger.register_graph_array(self, key, a)
+        self._scrub_mark(key)
         return key
 
     @property

@@ -546,6 +546,45 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 rec = q.get("reconcile", ["1"])[0] != "0"
                 return self._send(200, memledger.report(reconcile=rec))
+            if head == "debug" and rest == ["fsck"]:
+                # durable-state fsck (tools/fsck): per-database WAL
+                # CRC chains + segment continuity, checkpoint/delta/
+                # epoch content hashes, coldstore tails. Admin-only
+                # (reports name on-disk paths). ?dir=<path> checks an
+                # explicit tree instead of the server databases'
+                # durability directories.
+                self.server.ot_server.security.check(
+                    user, "server.debug", "read"
+                )
+                from orientdb_tpu.tools.fsck import fsck_tree
+
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                explicit = q.get("dir", [None])[0]
+                if explicit:
+                    dirs = {"": explicit}
+                else:
+                    dirs = {
+                        name: d
+                        for name, db in (
+                            self.server.ot_server.databases.items()
+                        )
+                        if (d := getattr(db, "_durability_dir", None))
+                    }
+                reports = {
+                    name or "tree": fsck_tree(d)
+                    for name, d in dirs.items()
+                }
+                return self._send(
+                    200,
+                    {
+                        "clean": all(
+                            r["clean"] for r in reports.values()
+                        ),
+                        "reports": reports,
+                    },
+                )
             if head == "debug" and rest == ["bundle"]:
                 # the flight-recorder bundle (obs/bundle): recent
                 # cross-node traces assembled by trace_id, slowlog,

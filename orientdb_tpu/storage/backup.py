@@ -23,6 +23,7 @@ Surfaces: console ``BACKUP DATABASE <path>`` / ``RESTORE DATABASE
 
 from __future__ import annotations
 
+import hashlib
 import json
 import zipfile
 from typing import Optional
@@ -72,17 +73,24 @@ def backup_database(db: Database, path: str) -> str:
         with db._lock:
             upto = db._wal.next_lsn - 1
         tail = _wal_tail(db, lsn, upto)
+    payload_bytes = json.dumps(payload, separators=(",", ":")).encode()
+    tail_bytes = json.dumps(tail, separators=(",", ":")).encode()
     manifest = {
-        "format": 2,
+        # format 3: the manifest carries content hashes of the exact
+        # payload/tail bytes, so `tools/fsck.py --backup` can verify an
+        # archive's integrity without (and before) restoring it
+        "format": 3,
         "name": db.name,
         "epoch": payload["epoch"],
         "lsn": lsn,
         "upto_lsn": upto,
+        "sha256_payload": hashlib.sha256(payload_bytes).hexdigest(),
+        "sha256_tail": hashlib.sha256(tail_bytes).hexdigest(),
     }
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(MANIFEST, json.dumps(manifest))
-        z.writestr(PAYLOAD, json.dumps(payload, separators=(",", ":")))
-        z.writestr(TAIL, json.dumps(tail, separators=(",", ":")))
+        z.writestr(PAYLOAD, payload_bytes)
+        z.writestr(TAIL, tail_bytes)
     return path
 
 

@@ -1,0 +1,499 @@
+"""Device-state scrubber: host-truth checksums over resident HBM.
+
+PRs 15-16 made the device state under every cached plan MUTABLE —
+delta-slab scatters, tier-pool paging, epoch compaction swaps — so a
+mis-applied patch or a torn page upload silently serves wrong rows at
+full speed. The host side of every one of those writes keeps the truth
+(the delta maintainer patches host mirrors in lockstep with its device
+scatters; a tier partition's ``host`` arrays back every pool block), so
+corruption is DETECTABLE: re-fetch a device block, re-hash it, compare
+with the host-truth checksum.
+
+Mechanics:
+
+- **checksums** — zlib.crc32 per device key, computed from host truth
+  and cached; ``DeviceGraph._put`` / ``apply_patches`` mark patched
+  keys dirty (``_scrub_dirty``) so the cache re-hashes exactly what
+  changed. Tier-pool keys (``t:*``) are checked block-wise against
+  ``_Partition.block_values`` under the tier lock — per-block CRCs,
+  since non-resident pages carry deliberately stale rows.
+- **sweep** — watchdog-driven (``HealthWatchdog.tick``; also callable
+  directly): a budgeted rotation (``scrub_budget_bytes`` per sweep,
+  round-robin cursor per DeviceGraph) fetches device blocks, re-hashes,
+  compares. Mesh-sharded graphs are skipped (replicated uploads are
+  immutable; the mesh plane has no host-patched state).
+- **repair ladder** — a mismatch is repaired loudly, cheapest rung
+  first: (1) tier-block invalidate + reload (PR 16 ``_evict`` +
+  ``_ensure_blocks``), (2) delta-overlay poison → epoch compaction
+  (PR 15 — the maintainer rebuilds a clean CSR and re-uploads), (3)
+  full snapshot re-upload (``release_device`` + DeviceGraph rebuild).
+  Every detection counts ``scrub.corruptions`` and fires the
+  ``scrub_corruption`` alert until a later sweep passes clean.
+
+Deterministically provable: the ``scrub.flip`` chaos point corrupts
+the DEVICE-BOUND copy of a delta-patch segment
+(``ops/device_graph.apply_patches``) or a tier-pool block row
+(``storage/tiering._load_blocks``) — host truth keeps the original, so
+a seeded :class:`~orientdb_tpu.chaos.faults.FaultPlan` drives detect →
+repair → alert → clean-sweep-resolve end to end in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.logging import get_logger
+from orientdb_tpu.utils.metrics import metrics
+
+log = get_logger("scrub")
+
+#: bounded ring of corruption records kept for the debug surfaces
+_RECENT_CAP = 64
+
+
+def _crc(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+
+
+def chaos_flip(arr: np.ndarray) -> np.ndarray:
+    """The ``scrub.flip`` chaos actuator: return a corrupted COPY of a
+    device-bound upload (host truth is never touched — that is what
+    makes the flip detectable)."""
+    a = np.array(arr)
+    if a.size:
+        flat = a.reshape(-1)
+        if a.dtype == np.bool_:
+            flat[0] = not bool(flat[0])
+        else:
+            flat[0] = flat[0] + 1
+    metrics.incr("scrub.chaos_flipped")
+    return a
+
+
+def _host_truth(snap, key: str) -> Optional[np.ndarray]:
+    """Resolve a device-array key to its host-truth array (None =
+    unscrubabble: derived layouts, mesh shards, pool keys handled
+    block-wise elsewhere). The delta maintainer patches these same
+    arrays in place, so they stay the truth across CDC batches."""
+    if key == "v_class":
+        return np.asarray(snap.v_class)
+    if key.startswith("v:"):
+        name, _, kind = key[2:].rpartition(":")
+        col = snap.v_columns.get(name)
+        if col is None:
+            return None
+        return np.asarray(col.values if kind == "v" else col.present)
+    if key.startswith("bk:"):
+        cname, _, d = key[3:].rpartition(":")
+        ov = getattr(snap, "_overlay", None)
+        bk = getattr(ov, "bk", {}).get(cname) if ov is not None else None
+        if bk is None or d not in bk:
+            return None
+        return np.asarray(bk[d])
+    if key.startswith("e:") and ":c:" in key:
+        cname, rest = key[2:].split(":c:", 1)
+        name, _, kind = rest.rpartition(":")
+        csr = snap.edge_classes.get(cname)
+        col = csr.edge_columns.get(name) if csr is not None else None
+        if col is None:
+            return None
+        return np.asarray(col.values if kind == "v" else col.present)
+    if key.startswith("e:"):
+        cname, _, field = key[2:].rpartition(":")
+        csr = snap.edge_classes.get(cname)
+        if csr is None:
+            return None
+        if field == "edge_src":
+            # derived on demand (edge_src_np); the maintainer patches
+            # the device copy directly, so rebuild-from-indptr is the
+            # same truth
+            try:
+                return np.asarray(csr.edge_src_np())
+            except Exception:
+                return None
+        arr = getattr(csr, field, None)
+        return np.asarray(arr) if arr is not None else None
+    return None
+
+
+class Scrubber:
+    """Process-wide scrub state (mirrors the metrics/stats singletons):
+    counters, the corruption ring, and the alert plane's
+    corrupt-until-clean-sweep latch."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._sweeps = 0
+        self._checked_keys = 0
+        self._checked_bytes = 0
+        self._corruptions = 0
+        self._repairs: Dict[str, int] = {}
+        self._recent: deque = deque()
+        #: monotonic stamps driving the scrub_corruption alert: the
+        #: rule breaches while the latest corruption is newer than the
+        #: latest fully clean sweep (deterministic — no wall-clock
+        #: window to tune)
+        self._last_corrupt_ts = 0.0
+        self._last_clean_ts = 0.0
+        self._last_key: Optional[str] = None
+        self._last_repair: Optional[str] = None
+        self._since_clean = 0
+
+    # -- sweeping ------------------------------------------------------------
+
+    def sweep_all(self, dbs) -> None:
+        """The watchdog hook: one budgeted sweep per database with a
+        resident device graph. Never raises into the tick."""
+        for db in dbs:
+            try:
+                self.sweep(db)
+            except Exception:
+                log.exception("scrub sweep failed for %s", db.name)
+
+    def sweep(self, db, budget_bytes: Optional[int] = None) -> Dict:
+        """One budgeted scrub rotation over ``db``'s resident device
+        arrays. Returns the sweep report (also folded into process
+        counters)."""
+        from orientdb_tpu.obs.trace import span
+
+        budget = int(
+            budget_bytes
+            if budget_bytes is not None
+            else config.scrub_budget_bytes
+        )
+        report: Dict = {
+            "db": db.name, "checked_keys": 0, "checked_bytes": 0,
+            "corrupt": [], "repairs": [],
+        }
+        snap = db.current_snapshot()
+        dg = getattr(snap, "_device_cache", None) if snap is not None else None
+        if dg is None or getattr(dg, "mesh_graph", None) is not None:
+            return report
+        with span("scrub.sweep", db=db.name) as sp:
+            keys = sorted(dg._arrays.keys())
+            n = len(keys)
+            cursor = int(getattr(dg, "_scrub_cursor", 0)) % max(n, 1)
+            stepped = 0
+            for i in range(n):
+                if report["checked_bytes"] >= budget:
+                    break
+                key = keys[(cursor + i) % n]
+                stepped = i + 1
+                try:
+                    res = self._check_key(snap, dg, key)
+                except Exception:
+                    log.exception("scrub check failed for %s", key)
+                    continue
+                if res is None:
+                    continue
+                ok, nbytes, blocks = res
+                report["checked_keys"] += 1
+                report["checked_bytes"] += nbytes
+                if ok:
+                    continue
+                self._note_corruption(db, key)
+                report["corrupt"].append(key)
+                rung = self._repair(db, snap, dg, key, blocks)
+                report["repairs"].append({"key": key, "rung": rung})
+                if rung in ("compact", "reupload"):
+                    # the repair replaced the snapshot/DeviceGraph this
+                    # sweep was iterating — stop here, the next sweep
+                    # scrubs the rebuilt state
+                    break
+            dg._scrub_cursor = (cursor + stepped) % n if n else 0
+            sp.set("keys", report["checked_keys"])
+            sp.set("corrupt", len(report["corrupt"]))
+        now = time.monotonic()
+        with self._mu:
+            self._sweeps += 1
+            self._checked_keys += report["checked_keys"]
+            self._checked_bytes += report["checked_bytes"]
+            if not report["corrupt"]:
+                self._last_clean_ts = now
+                self._since_clean = 0
+        metrics.gauge("scrub.sweep_keys", report["checked_keys"])
+        metrics.gauge("scrub.sweep_bytes", report["checked_bytes"])
+        return report
+
+    def _check_key(
+        self, snap, dg, key: str
+    ) -> Optional[Tuple[bool, int, List[int]]]:
+        """(clean?, device bytes fetched, corrupt tier blocks) — None
+        when the key has no scrubabble host truth."""
+        if key.startswith("sh:"):
+            return None
+        if key.startswith("t:"):
+            return self._check_tier_key(snap, dg, key)
+        host = _host_truth(snap, key)
+        if host is None:
+            return None
+        dev_arr = dg._arrays.get(key)
+        if dev_arr is None:
+            return None
+        dev = np.asarray(dev_arr)
+        if host.shape != dev.shape:
+            # shape drift means the key was re-laid-out mid-sweep (e.g.
+            # a compaction swap) — not comparable, not corruption
+            return None
+        expected = self._expected_crc(dg, key, host, dev.dtype)
+        actual = _crc(dev)
+        if actual == expected:
+            return True, int(dev.nbytes), []
+        # one re-check before conviction: a maintainer patch landing
+        # between the device fetch and the host hash is a benign race,
+        # not corruption
+        self._invalidate(dg, key)
+        host2 = _host_truth(snap, key)
+        if host2 is None or host2.shape != np.asarray(
+            dg._arrays.get(key, dev)
+        ).shape:
+            return None
+        dev2 = np.asarray(dg._arrays[key])
+        expected = self._expected_crc(dg, key, host2, dev2.dtype)
+        return _crc(dev2) == expected, int(dev.nbytes) * 2, []
+
+    def _check_tier_key(
+        self, snap, dg, key: str
+    ) -> Optional[Tuple[bool, int, List[int]]]:
+        """Block-wise CRC check of a tier-plane key: pool rows compare
+        against ``_Partition.block_values`` for RESIDENT blocks only
+        (evicted pages deliberately hold stale-but-masked rows); the
+        page table and block indexes compare whole."""
+        tier = getattr(snap, "_tier", None)
+        if tier is None:
+            return None
+        parts = key[2:].split(":")
+        if len(parts) < 3:
+            return None
+        name = parts[-1]
+        d = parts[-2]
+        cname = ":".join(parts[:-2])
+        part = tier.parts.get((cname, d))
+        if part is None:
+            return None
+        with tier.lock:
+            dev_arr = dg._arrays.get(key)
+            if dev_arr is None:
+                return None
+            dev = np.asarray(dev_arr)
+            if name in ("pageof", "blockv", "estart"):
+                host = {
+                    "pageof": part.page_of,
+                    "blockv": part.block_of_v,
+                    "estart": part.edge_start,
+                }[name]
+                host = np.asarray(host, dev.dtype)
+                if host.shape != dev.shape:
+                    return None
+                return _crc(dev) == _crc(host), int(dev.nbytes), []
+            if name not in ("own", "nbr", "eid"):
+                return None
+            bad: List[int] = []
+            nbytes = 0
+            for b in range(part.B):
+                p = int(part.page_of[b])
+                if p < 0 or p >= dev.shape[0]:
+                    continue
+                row = dev[p]
+                nbytes += int(row.nbytes)
+                if _crc(row) != _crc(
+                    np.asarray(part.block_values(name, b), row.dtype)
+                ):
+                    bad.append(b)
+            return not bad, nbytes, bad
+
+    def _expected_crc(self, dg, key: str, host: np.ndarray, dtype) -> int:
+        """Host-truth CRC, cached per DeviceGraph key; ``_put`` and
+        ``apply_patches`` mark dirty keys so only changed truth
+        re-hashes."""
+        cache = getattr(dg, "_scrub_crc", None)
+        if cache is None:
+            cache = dg._scrub_crc = {}
+        dirty = getattr(dg, "_scrub_dirty", None)
+        if dirty is None:
+            dirty = dg._scrub_dirty = set()
+        if key in cache and key not in dirty:
+            return cache[key]
+        c = _crc(np.asarray(host, dtype))
+        cache[key] = c
+        dirty.discard(key)
+        return c
+
+    @staticmethod
+    def _invalidate(dg, key: str) -> None:
+        getattr(dg, "_scrub_dirty", set()).add(key)
+
+    # -- repair ladder -------------------------------------------------------
+
+    def _note_corruption(self, db, key: str) -> None:
+        metrics.incr("scrub.corruptions")
+        with self._mu:
+            self._corruptions += 1
+            self._since_clean += 1
+            self._last_corrupt_ts = time.monotonic()
+            self._last_key = key
+            self._recent.append({
+                "db": db.name, "key": key, "ts": round(time.time(), 3),
+            })
+            while len(self._recent) > _RECENT_CAP:
+                self._recent.popleft()
+        log.error(
+            "SCRUB CORRUPTION: device bytes at %s (db %s) disagree "
+            "with host truth", key, db.name,
+        )
+
+    def _repair(self, db, snap, dg, key: str, blocks: List[int]) -> str:
+        """Walk the repair ladder for one corrupt key; returns the rung
+        taken. Each rung re-derives device state from host truth, so a
+        successful repair restores parity by construction."""
+        from orientdb_tpu.obs.trace import span
+
+        with span("scrub.repair", key=key) as sp:
+            rung = self._repair_rung(db, snap, dg, key, blocks)
+            sp.set("rung", rung)
+        with self._mu:
+            self._repairs[rung] = self._repairs.get(rung, 0) + 1
+            self._last_repair = rung
+            if self._recent:
+                self._recent[-1]["rung"] = rung
+        metrics.incr(f"scrub.repairs.{rung}")
+        log.warning("scrub repair (%s) for %s on %s", rung, key, db.name)
+        return rung
+
+    def _repair_rung(self, db, snap, dg, key: str, blocks) -> str:
+        tier = getattr(snap, "_tier", None)
+        if key.startswith("t:") and tier is not None:
+            parts = key[2:].split(":")
+            name = parts[-1]
+            d = parts[-2]
+            cname = ":".join(parts[:-2])
+            part = tier.parts.get((cname, d))
+            if part is not None:
+                with tier.lock:
+                    if blocks and name in ("own", "nbr", "eid"):
+                        # rung 1: invalidate + reload exactly the
+                        # corrupt blocks (PR-16 machinery)
+                        for b in blocks:
+                            if part.page_of[b] >= 0:
+                                tier._evict(part, b)
+                        tier._ensure_blocks(part, list(blocks), None)
+                        return "tier_reload"
+                    # page table / block index: re-upload host truth
+                    import jax
+
+                    host = {
+                        "pageof": part.page_of,
+                        "blockv": part.block_of_v,
+                        "estart": part.edge_start,
+                    }.get(name)
+                    if host is not None:
+                        dev = dg._arrays[key]
+                        dg._arrays[key] = jax.device_put(
+                            np.asarray(host, np.asarray(dev).dtype)
+                        )
+                        from orientdb_tpu.obs.memledger import memledger
+
+                        memledger.register_graph_array(
+                            dg, key, dg._arrays[key]
+                        )
+                        return "tier_reload"
+        maintainer = getattr(db, "_snapshot_maintainer", None)
+        ov = getattr(snap, "_overlay", None)
+        if maintainer is not None and ov is not None:
+            # rung 2: poison the overlay so the maintainer folds the
+            # slabs back into a clean CSR and re-uploads (PR-15 epoch
+            # compaction — the swap releases the corrupt device state)
+            if ov.poisoned is None:
+                ov.poison(f"scrub: device corruption at {key}")
+            try:
+                maintainer.catch_up()
+            except Exception:
+                log.exception("scrub-triggered compaction failed")
+            return "compact"
+        # rung 3: full snapshot re-upload from host truth
+        from orientdb_tpu.ops.device_graph import device_graph
+
+        snap.release_device()
+        self._invalidate_all(dg)
+        try:
+            if getattr(snap, "_device_cache", None) is dg:
+                # in-flight epoch leases deferred the free, so the
+                # corrupt DeviceGraph is still canonical — restore the
+                # corrupt key's bytes from host truth IN PLACE (served
+                # traffic reads correct rows now); the full free still
+                # lands when the last lease releases
+                import jax
+
+                host = _host_truth(snap, key)
+                cur = dg._arrays.get(key)
+                if host is not None and cur is not None:
+                    dg._arrays[key] = jax.device_put(
+                        np.asarray(host, np.asarray(cur).dtype)
+                    )
+                    from orientdb_tpu.obs.memledger import memledger
+
+                    memledger.register_graph_array(
+                        dg, key, dg._arrays[key]
+                    )
+            else:
+                device_graph(snap)
+        except Exception:
+            log.exception("scrub-triggered re-upload failed")
+        return "reupload"
+
+    @staticmethod
+    def _invalidate_all(dg) -> None:
+        cache = getattr(dg, "_scrub_crc", None)
+        if cache is not None:
+            cache.clear()
+
+    # -- views ---------------------------------------------------------------
+
+    def alert_state(self) -> Optional[Dict]:
+        """Non-None while corruption is newer than the last fully clean
+        sweep (the ``scrub_corruption`` rule's breach condition)."""
+        with self._mu:
+            if self._last_corrupt_ts <= self._last_clean_ts:
+                return None
+            return {
+                "corruptions": self._since_clean,
+                "last_key": self._last_key,
+                "last_repair": self._last_repair,
+            }
+
+    def snapshot(self) -> Dict:
+        with self._mu:
+            return {
+                "sweeps": self._sweeps,
+                "checked_keys": self._checked_keys,
+                "checked_bytes": self._checked_bytes,
+                "corruptions": self._corruptions,
+                "repairs": dict(self._repairs),
+                "recent": list(self._recent),
+            }
+
+    def reset(self) -> None:
+        """Test isolation (mirrors ``metrics.reset``)."""
+        with self._mu:
+            self._sweeps = 0
+            self._checked_keys = 0
+            self._checked_bytes = 0
+            self._corruptions = 0
+            self._repairs.clear()
+            self._recent.clear()
+            self._last_corrupt_ts = 0.0
+            self._last_clean_ts = 0.0
+            self._last_key = None
+            self._last_repair = None
+            self._since_clean = 0
+
+
+#: the process-wide scrubber (mirrors metrics/stats/tracer singletons)
+scrubber = Scrubber()

@@ -57,6 +57,7 @@ import numpy as np
 
 import orientdb_tpu.obs.timeline as TL
 import orientdb_tpu.ops.csr as K
+from orientdb_tpu.chaos.faults import FaultError, fault
 from orientdb_tpu.obs.trace import span
 from orientdb_tpu.utils.config import config
 from orientdb_tpu.utils.metrics import metrics
@@ -410,7 +411,19 @@ class TierManager:
                     metrics.incr("tier.thrash_events")
                 p = self._grab_page(part, requested)
                 for n in ("own", "nbr", "eid"):
-                    row = jax.device_put(part.block_values(n, b))
+                    vals = part.block_values(n, b)
+                    try:
+                        # scrub.flip chaos crossing: corrupt the
+                        # DEVICE-bound pool row only — the partition's
+                        # host blocks keep the truth, so the scrub
+                        # sweep provably detects + reloads
+                        with fault.point("scrub.flip"):
+                            pass
+                    except FaultError:
+                        from orientdb_tpu.storage.scrub import chaos_flip
+
+                        vals = chaos_flip(vals)
+                    row = jax.device_put(vals)
                     dg._arrays[keys[n]] = dg._arrays[keys[n]].at[p].set(row)
                 dg._arrays[keys["pageof"]] = (
                     dg._arrays[keys["pageof"]].at[b].set(p)

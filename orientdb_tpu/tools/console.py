@@ -219,6 +219,26 @@ class Console(cmd.Cmd):
             return
         self.default(f"restore {arg}")
 
+    def do_fsck(self, arg: str) -> None:
+        """FSCK <directory> | FSCK BACKUP <zip> — verify durable-state
+        integrity: WAL CRC chains + segment continuity, checkpoint/
+        delta/epoch content hashes, coldstore tails; BACKUP adds the
+        archive's restore-and-rehash round trip (tools/fsck)."""
+        parts = shlex.split(arg)
+        from orientdb_tpu.tools.fsck import (
+            format_report,
+            fsck_backup,
+            fsck_tree,
+        )
+
+        if len(parts) == 2 and parts[0].lower() == "backup":
+            self._p(format_report(fsck_backup(parts[1])))
+            return
+        if len(parts) == 1 and parts[0]:
+            self._p(format_report(fsck_tree(parts[0])))
+            return
+        self.default(f"fsck {arg}")
+
     def do_script(self, arg: str) -> None:
         """SCRIPT <sql batch>  — LET/IF/RETURN and ';'-separated
         statements in one session ([E] the console's script command)."""

@@ -165,17 +165,26 @@ def degraded_round(doc: Optional[Dict]) -> bool:
     """True when a round's evidence records degraded-mode dispatches —
     quarantine-driven oracle fallbacks, admission sheds, or plan
     quarantines from the device fault domain (the per-round
-    ``device_faults`` evidence block, exec/devicefault). A chaos round
-    measures the ladder, not the fast path: ``bench._last_good_round``
-    skips these so one can never become the regression baseline."""
+    ``device_faults`` evidence block, exec/devicefault) — or
+    correctness-plane findings: shadow-oracle parity divergences or
+    scrub repairs (the ``parity_audit`` block, exec/audit). A chaos or
+    diverged round measures the ladder, not the fast path:
+    ``bench._last_good_round`` skips these so one can never become the
+    regression baseline."""
     ex = (doc or {}).get("extras") or {}
     df = ex.get("device_faults")
-    if not isinstance(df, dict):
-        return False
-    return any(
+    if isinstance(df, dict) and any(
         int(df.get(k) or 0) > 0
         for k in ("oracle_served", "sheds", "quarantines")
-    )
+    ):
+        return True
+    pa = ex.get("parity_audit")
+    if isinstance(pa, dict) and any(
+        int(pa.get(k) or 0) > 0
+        for k in ("diverged", "scrub_corruptions", "scrub_repairs")
+    ):
+        return True
+    return False
 
 
 def diff(

@@ -340,6 +340,26 @@ class GlobalConfiguration:
     devicefault_headroom_fraction: float = 0.9
     alert_device_faults_per_min: float = 60.0
 
+    # Continuous correctness plane (exec/audit, storage/scrub; README
+    # "Continuous correctness: parity audits, scrub & fsck"):
+    # audit_sample_rate is the fraction of compiled results shadow-
+    # re-executed on the pure-Python oracle and digest-compared (rides
+    # the stats sampling decision; 0 disables the auditor — the
+    # default, parity audits are opt-in per deployment). The bounded
+    # audit queue holds audit_queue_max captures (overflow drops count
+    # parity.audit_dropped); a divergence record samples up to
+    # audit_diff_rows rows per side and the replayable divergence ring
+    # keeps audit_history_capacity records. scrub_enabled runs one
+    # budgeted device-state scrub rotation per watchdog tick,
+    # re-hashing at most scrub_budget_bytes of resident device blocks
+    # against host-truth checksums per sweep.
+    audit_sample_rate: float = 0.0
+    audit_queue_max: int = 256
+    audit_diff_rows: int = 5
+    audit_history_capacity: int = 64
+    scrub_enabled: bool = True
+    scrub_budget_bytes: int = 16 << 20
+
     # Alert threshold (obs/alerts delta_slab_pressure): fires when the
     # snapshot.delta.slab_fill gauge crosses this fraction — deltas are
     # outpacing compaction.

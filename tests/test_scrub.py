@@ -1,0 +1,316 @@
+"""Continuous correctness plane, scrubber half (ISSUE 20): the
+device-state scrubber (storage/scrub) driven end to end — host-truth
+CRC maintenance across ``_put``/``apply_patches``, budgeted round-robin
+sweeps, the seeded ``scrub.flip`` chaos proofs on BOTH mutable-device
+planes (a delta-patch segment repaired through the overlay-poison →
+compaction rung, a tier-pool block repaired through the
+invalidate-and-reload rung), the manual-rot → full-re-upload rung, the
+``scrub_corruption`` alert's corrupt-until-clean-sweep lifecycle, and
+the watchdog tick integration."""
+
+import numpy as np
+import pytest
+
+from orientdb_tpu.chaos.faults import FaultPlan, fault
+from orientdb_tpu.models.database import Database
+from orientdb_tpu.obs.alerts import engine as alert_engine
+from orientdb_tpu.obs.watchdog import HealthWatchdog
+from orientdb_tpu.storage import tiering
+from orientdb_tpu.storage.deltas import arm_delta_maintenance
+from orientdb_tpu.storage.ingest import generate_demodb
+from orientdb_tpu.storage.scrub import chaos_flip, scrubber
+from orientdb_tpu.storage.snapshot import attach_fresh_snapshot
+from orientdb_tpu.utils.config import config
+from orientdb_tpu.utils.metrics import metrics
+
+
+def canon(rows):
+    return sorted(str(sorted(r.items())) for r in rows)
+
+
+def assert_parity(db, sql, params=None):
+    t = db.query(sql, params=params, engine="tpu", strict=True).to_dicts()
+    o = db.query(sql, params=params, engine="oracle").to_dicts()
+    assert canon(t) == canon(o), f"parity broke for {sql}: {t} vs {o}"
+
+
+@pytest.fixture(autouse=True)
+def _clean_scrub_state():
+    fault.disarm()
+    scrubber.reset()
+    alert_engine.reset()
+    yield
+    fault.disarm()
+    scrubber.reset()
+    alert_engine.reset()
+
+
+def build_db(n=12):
+    db = Database("scrubdb")
+    vs = [
+        db.new_vertex("Person", name=f"p{i}", age=20 + i) for i in range(n)
+    ]
+    for i in range(n - 1):
+        db.new_edge("Knows", vs[i], vs[i + 1])
+    return db, vs
+
+
+ROWS_Q = (
+    "MATCH {class:Person, as:p, where:(age > 21)}-Knows->{as:q} "
+    "RETURN p.name AS p, q.name AS q"
+)
+
+
+# ---------------------------------------------------------------------------
+# units: the chaos actuator + sweep bookkeeping
+# ---------------------------------------------------------------------------
+
+
+class TestChaosFlip:
+    def test_flip_corrupts_a_copy_only(self):
+        src = np.arange(8, dtype=np.int32)
+        keep = src.copy()
+        flipped = chaos_flip(src)
+        assert np.array_equal(src, keep)  # host truth untouched
+        assert flipped[0] == src[0] + 1
+        assert np.array_equal(flipped[1:], src[1:])
+
+    def test_flip_inverts_bools(self):
+        src = np.array([True, False])
+        assert chaos_flip(src)[0] == np.False_
+
+    def test_flip_empty_is_noop(self):
+        assert chaos_flip(np.array([], dtype=np.int64)).size == 0
+
+
+class TestSweepMechanics:
+    def test_clean_sweep_checks_resident_state(self):
+        db, _ = build_db()
+        attach_fresh_snapshot(db)
+        db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        rep = scrubber.sweep(db)
+        assert rep["checked_keys"] > 0 and rep["checked_bytes"] > 0
+        assert rep["corrupt"] == [] and rep["repairs"] == []
+        assert scrubber.alert_state() is None
+        s = scrubber.snapshot()
+        assert s["sweeps"] == 1 and s["corruptions"] == 0
+
+    def test_no_device_graph_is_a_noop(self):
+        db, _ = build_db()
+        rep = scrubber.sweep(db)
+        assert rep["checked_keys"] == 0
+
+    def test_budget_bounds_one_rotation_and_cursor_advances(self):
+        db, _ = build_db()
+        snap = attach_fresh_snapshot(db)
+        db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        dg = snap._device_cache
+        total = scrubber.sweep(db, budget_bytes=1 << 30)["checked_keys"]
+        assert total > 1
+        c0 = dg._scrub_cursor
+        rep = scrubber.sweep(db, budget_bytes=1)
+        # the tiny budget stops the rotation at the first key that
+        # actually hashed bytes (empty arrays cost nothing)
+        assert rep["checked_bytes"] > 0
+        assert rep["checked_keys"] < total
+        assert dg._scrub_cursor != c0  # next sweep resumes further on
+
+    def test_sweep_all_never_raises(self):
+        class _Boom:
+            name = "boom"
+
+            def current_snapshot(self):
+                raise RuntimeError("no snapshot for you")
+
+        scrubber.sweep_all([_Boom()])  # swallowed + logged
+
+
+# ---------------------------------------------------------------------------
+# manual rot: detect → full re-upload rung → alert resolve
+# ---------------------------------------------------------------------------
+
+
+class TestManualCorruption:
+    def test_rot_detected_repaired_and_alert_resolves(self, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(config, "alert_pending_ticks", 2)
+        db, _ = build_db()
+        snap = attach_fresh_snapshot(db)
+        oracle = db.query(ROWS_Q, engine="oracle").to_dicts()
+        db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        dg = snap._device_cache
+        scrubber.sweep(db)  # primes the host-truth CRC cache, clean
+
+        # simulate silent device rot: corrupt the device copy, never
+        # the host truth
+        dg._arrays["v_class"] = jax.device_put(
+            chaos_flip(np.asarray(dg._arrays["v_class"]))
+        )
+        rep = scrubber.sweep(db)
+        assert rep["corrupt"] == ["v_class"]
+        # no maintainer and no tier on this snapshot: the ladder ends
+        # at the full re-upload rung
+        assert rep["repairs"] == [{"key": "v_class", "rung": "reupload"}]
+        st = scrubber.alert_state()
+        assert st is not None and st["last_key"] == "v_class"
+        assert st["last_repair"] == "reupload"
+        cnt = metrics.snapshot()["counters"]
+        assert cnt.get("scrub.corruptions", 0) >= 1
+        assert cnt.get("scrub.repairs.reupload", 0) >= 1
+
+        # the scrub_corruption alert walks pending → firing off the
+        # corrupt-until-clean-sweep latch
+        alert_engine.evaluate(dbs=[db])
+        alert_engine.evaluate(dbs=[db])
+        a = next(
+            x for x in alert_engine.active()
+            if x["rule"] == "scrub_corruption"
+        )
+        assert a["state"] == "firing"
+
+        # post-repair parity, then a clean sweep resolves the alert
+        assert_parity(db, ROWS_Q)
+        rep2 = scrubber.sweep(db)
+        assert rep2["corrupt"] == [] and rep2["checked_keys"] > 0
+        assert scrubber.alert_state() is None
+        alert_engine.evaluate(dbs=[db])
+        assert not [
+            x for x in alert_engine.active()
+            if x["rule"] == "scrub_corruption"
+        ]
+        assert any(
+            h["rule"] == "scrub_corruption" for h in alert_engine.history()
+        )
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos proof 1: a corrupted delta-patch segment
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaSlabFlip:
+    def test_flip_on_patch_upload_detected_and_compacted(self):
+        db, vs = build_db()
+        arm_delta_maintenance(db, spare_vertices=64, spare_edges=64)
+        db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        scrubber.sweep(db, budget_bytes=1 << 30)
+        assert scrubber.snapshot()["corruptions"] == 0
+
+        # the seeded plan corrupts the DEVICE-BOUND copy of the next
+        # delta-patch segment; the host mirror keeps the truth
+        plan = FaultPlan(seed=11).at("scrub.flip", "error", times=1)
+        with fault.armed(plan):
+            vs[2].set("age", 99)
+            db.save(vs[2])
+            db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        assert (
+            metrics.snapshot()["counters"].get("scrub.chaos_flipped", 0) >= 1
+        )
+
+        rep = scrubber.sweep(db, budget_bytes=1 << 30)
+        assert len(rep["corrupt"]) == 1  # detected within one sweep
+        # the snapshot carries a delta overlay: the ladder repairs via
+        # overlay poison → epoch compaction
+        assert rep["repairs"][0]["rung"] == "compact"
+        assert scrubber.snapshot()["repairs"].get("compact", 0) == 1
+        assert scrubber.alert_state() is not None
+
+        # compaction rebuilt a clean CSR: parity holds and the next
+        # sweep comes back clean, resolving the latch
+        assert_parity(db, ROWS_Q)
+        rep2 = scrubber.sweep(db, budget_bytes=1 << 30)
+        assert rep2["corrupt"] == [] and rep2["checked_keys"] > 0
+        assert scrubber.alert_state() is None
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos proof 2: a corrupted tier-pool block
+# ---------------------------------------------------------------------------
+
+
+class TestTierBlockFlip:
+    COUNT_2HOP = (
+        "MATCH {class:Profiles, as:p, where:(uid = :u)}"
+        "-HasFriend->{as:f}-HasFriend->{as:g} RETURN count(*) AS n"
+    )
+
+    def test_flip_on_block_upload_detected_and_reloaded(self, monkeypatch):
+        monkeypatch.setattr(config, "view_min_calls", 1 << 30)
+        monkeypatch.setattr(config, "tier_block_edges", 32)
+        db = generate_demodb(n_profiles=200, avg_friends=6, seed=3)
+        snap = attach_fresh_snapshot(db)
+        adj = tiering.adjacency_bytes(snap)
+        db.detach_snapshot()
+        monkeypatch.setattr(config, "tier_hbm_cap_bytes", max(1, adj // 2))
+        snap = attach_fresh_snapshot(db)
+        assert getattr(snap, "_tier", None) is not None
+
+        # the seeded plan corrupts the first pool-block row uploaded by
+        # the tier plane's block loader; the partition's host blocks
+        # keep the truth
+        plan = FaultPlan(seed=5).at("scrub.flip", "error", times=1)
+        with fault.armed(plan):
+            db.query(
+                self.COUNT_2HOP, params={"u": 0}, engine="tpu", strict=True
+            ).to_dicts()
+        assert (
+            metrics.snapshot()["counters"].get("scrub.chaos_flipped", 0) >= 1
+        )
+
+        rep = scrubber.sweep(db, budget_bytes=1 << 30)
+        assert len(rep["corrupt"]) == 1  # detected within one sweep
+        key = rep["corrupt"][0]
+        assert key.startswith("t:")
+        # resident pool block: the cheapest rung — invalidate exactly
+        # the corrupt blocks and reload them from host truth
+        assert rep["repairs"][0]["rung"] == "tier_reload"
+        assert scrubber.snapshot()["repairs"].get("tier_reload", 0) == 1
+
+        # post-repair parity on the tiered path, and a clean sweep
+        assert_parity(db, self.COUNT_2HOP, params={"u": 0})
+        rep2 = scrubber.sweep(db, budget_bytes=1 << 30)
+        assert rep2["corrupt"] == []
+        assert scrubber.alert_state() is None
+        db.detach_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# watchdog integration: the sweep rides the tick cadence
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdogIntegration:
+    class _Host:
+        def __init__(self, dbs):
+            self.databases = dbs
+            self.cluster = None
+
+    def test_tick_sweeps_every_database(self, monkeypatch):
+        db, _ = build_db()
+        attach_fresh_snapshot(db)
+        db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        seen = []
+        monkeypatch.setattr(
+            scrubber, "sweep_all", lambda dbs: seen.append(list(dbs))
+        )
+        wd = HealthWatchdog(self._Host({"scrubdb": db}))  # manual ticks
+        wd.tick()
+        assert seen == [[db]]
+
+    def test_scrub_disabled_skips_the_sweep(self, monkeypatch):
+        db, _ = build_db()
+        monkeypatch.setattr(config, "scrub_enabled", False)
+        seen = []
+        monkeypatch.setattr(
+            scrubber, "sweep_all", lambda dbs: seen.append(list(dbs))
+        )
+        HealthWatchdog(self._Host({"scrubdb": db})).tick()
+        assert seen == []
+
+    def test_real_tick_sweep_counts(self):
+        db, _ = build_db()
+        attach_fresh_snapshot(db)
+        db.query(ROWS_Q, engine="tpu", strict=True).to_dicts()
+        HealthWatchdog(self._Host({"scrubdb": db})).tick()
+        assert scrubber.snapshot()["sweeps"] == 1
