@@ -223,14 +223,17 @@ def execute_query(
             raise
         _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
         CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
-        from orientdb_tpu.exec import audit as _audit
-
         # shadow-oracle parity audit: rides the stats sampling decision
-        # (acc) so stats/slowlog/timeline/audit cover the same subset
-        _audit.auditor.maybe_submit(
-            db, sql, _normalize_params(params), rs, sp.trace_id,
-            acc is not None,
-        )
+        # (acc) so stats/slowlog/timeline/audit cover the same subset.
+        # One attribute read when auditing is off — the serving path
+        # must not pay normalize/submit costs for a disabled auditor.
+        if config.audit_sample_rate > 0.0:
+            from orientdb_tpu.exec import audit as _audit
+
+            _audit.auditor.maybe_submit(
+                db, sql, _normalize_params(params), rs, sp.trace_id,
+                acc is not None,
+            )
     return rs
 
 
@@ -320,12 +323,13 @@ def execute_command(
             raise
         _observe_query(sql, t0, getattr(rs, "engine", "?"), sp.trace_id, acc)
         CP.fold_query(cp, time.perf_counter() - t0, acc, seg0)
-        from orientdb_tpu.exec import audit as _audit
+        if config.audit_sample_rate > 0.0:
+            from orientdb_tpu.exec import audit as _audit
 
-        _audit.auditor.maybe_submit(
-            db, sql, _normalize_params(params), rs, sp.trace_id,
-            acc is not None,
-        )
+            _audit.auditor.maybe_submit(
+                db, sql, _normalize_params(params), rs, sp.trace_id,
+                acc is not None,
+            )
     return rs
 
 
@@ -402,7 +406,9 @@ def execute_query_batch(
         n = max(len(sqls), 1)
         per = dur / n
         per_segs = _amortized_segs(cp, dur, cap, seg0, n)
-        from orientdb_tpu.exec import audit as _audit
+        auditing = config.audit_sample_rate > 0.0
+        if auditing:
+            from orientdb_tpu.exec import audit as _audit
 
         plist = params_list if params_list is not None else [None] * n
         for sql, p, rs in zip(sqls, plist, out):
@@ -417,9 +423,10 @@ def execute_query_batch(
                 S.stats.record_segments(sql, per_segs)
             # batch paths carry no per-query accumulator: the batch
             # capture is always on, so every member is audit-eligible
-            _audit.auditor.maybe_submit(
-                db, sql, _normalize_params(p), rs, bsp.trace_id, True
-            )
+            if auditing:
+                _audit.auditor.maybe_submit(
+                    db, sql, _normalize_params(p), rs, bsp.trace_id, True
+                )
     return out
 
 
@@ -653,7 +660,7 @@ class _LaneHandle:
             self.item_segs.append(
                 {k2: v for k2, v in segs.items() if v > 0.0}
             )
-            if self._db is not None:
+            if self._db is not None and config.audit_sample_rate > 0.0:
                 from orientdb_tpu.exec import audit as _audit
 
                 p = (

@@ -242,6 +242,11 @@ class TestEvidence:
             os.environ,
             JAX_PLATFORMS="cpu",
             BENCH_EVIDENCE=ev,
+            # keep this run's detail/headline artifacts out of the repo
+            # root: the round stamp is one past the newest driver
+            # record, which collides with a committed BENCH_DETAIL_r{N}
+            # whose driver record hasn't landed yet
+            BENCH_DETAIL_DIR=str(tmp_path),
             BENCH_PROFILES="80",
             BENCH_AVG_FRIENDS="2",
             BENCH_BATCH="4",
@@ -262,17 +267,20 @@ class TestEvidence:
         def bench_art(pat):
             return set(glob.glob(os.path.join(REPO, pat)))
 
-        details_before = (
-            bench_art("BENCH_DETAIL_r*.json")
-            | bench_art("BENCH_DETAIL_r*.json.prev")
-            | bench_art("BENCH_SLO_r*.json")
-        )
-        # the early headline flush OVERWRITES the repo-root (tracked)
-        # BENCH_HEADLINE_r{N}.json with this partial run's numbers —
-        # snapshot it for restore, not just unlink
-        heads_before = {
+        # snapshot every repo-root (tracked) bench artifact for restore,
+        # not just unlink: a round-number collision makes bench rotate
+        # the committed BENCH_DETAIL_r{N}.json to .prev and rewrite the
+        # committed name in place, and the early headline flush
+        # overwrites BENCH_HEADLINE_r{N}.json with this partial run's
+        # numbers
+        arts_before = {
             p: open(p, "rb").read()
-            for p in bench_art("BENCH_HEADLINE_r*.json")
+            for p in (
+                bench_art("BENCH_DETAIL_r*.json")
+                | bench_art("BENCH_DETAIL_r*.json.prev")
+                | bench_art("BENCH_SLO_r*.json")
+                | bench_art("BENCH_HEADLINE_r*.json")
+            )
         }
         proc = subprocess.Popen(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -304,21 +312,19 @@ class TestEvidence:
             if proc.poll() is None:
                 proc.kill()
             # a run that outraced the kill wrote its artifacts — keep
-            # the worktree clean either way
-            for p in (
-                bench_art("BENCH_DETAIL_r*.json")
-                | bench_art("BENCH_DETAIL_r*.json.prev")
-                | bench_art("BENCH_SLO_r*.json")
-            ) - details_before:
-                os.unlink(p)
-            for p, data in heads_before.items():
+            # the worktree clean either way: restore every pre-existing
+            # artifact to its snapshot and drop anything new
+            for p, data in arts_before.items():
                 if (not os.path.exists(p)
                         or open(p, "rb").read() != data):
                     with open(p, "wb") as f:
                         f.write(data)
-            for p in bench_art("BENCH_HEADLINE_r*.json") - set(
-                heads_before
-            ):
+            for p in (
+                bench_art("BENCH_DETAIL_r*.json")
+                | bench_art("BENCH_DETAIL_r*.json.prev")
+                | bench_art("BENCH_SLO_r*.json")
+                | bench_art("BENCH_HEADLINE_r*.json")
+            ) - set(arts_before):
                 os.unlink(p)
         recs = read_evidence(ev)
         blocks = [r["block"] for r in recs]
